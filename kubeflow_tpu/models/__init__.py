@@ -1,0 +1,1 @@
+"""TPU-native Kubeflow-capability platform."""

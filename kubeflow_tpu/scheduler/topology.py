@@ -1,0 +1,343 @@
+"""Topology-aware TPU slice model + gang scheduler.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2c): volcano ``PodGroup`` gang
+scheduling used by training-operator (``RunPolicy.SchedulingPolicy``), plus the
+GKE TPU node conventions (``google.com/tpu`` extended resource,
+``cloud.google.com/gke-tpu-topology`` / ``gke-tpu-accelerator`` node labels).
+
+TPU-first design: the unit of placement for accelerated jobs is a *slice* —
+an all-or-nothing rectangular block of chips wired by ICI.  A job worker pod
+maps 1:1 to a TPU VM (host); intra-slice communication is ICI (invisible to
+the platform once ``jax.distributed`` forms the mesh); inter-slice is DCN.
+The scheduler therefore enforces: (a) gang semantics via PodGroup minMember,
+(b) slice affinity — all TPU pods of one gang land on hosts of one slice
+unless the job is explicitly multislice (then: one gang per slice + MEGASCALE
+env, injected by the job controller, not here).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core.api import APIServer, CRD, Obj
+from ..core.events import EventRecorder
+
+GROUP = "scheduling.kubeflow.org"
+POD_GROUP_LABEL = f"{GROUP}/pod-group"
+TPU_RESOURCE = "google.com/tpu"
+TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+SLICE_LABEL = f"{GROUP}/tpu-slice"
+HOST_INDEX_LABEL = f"{GROUP}/tpu-host-index"
+
+
+@dataclass(frozen=True)
+class TPUVariant:
+    """Per-generation host geometry."""
+
+    name: str                 # accelerator label value
+    chips_per_host: int
+    ndims: int                # topology rank (v5e/v6e: 2D, v4/v5p: 3D)
+    flops_bf16: float         # per-chip peak, for MFU math elsewhere
+
+
+VARIANTS = {
+    "v5e": TPUVariant("tpu-v5-lite-podslice", 4, 2, 197e12),
+    "v6e": TPUVariant("tpu-v6e-slice", 4, 2, 918e12),
+    "v4": TPUVariant("tpu-v4-podslice", 4, 3, 275e12),
+    "v5p": TPUVariant("tpu-v5p-slice", 4, 3, 459e12),
+}
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    return tuple(int(x) for x in topology.lower().split("x"))
+
+
+def chips_in(topology: str) -> int:
+    return math.prod(parse_topology(topology))
+
+
+def slice_shape(accelerator: str, num_chips: int) -> str:
+    """Pick the canonical topology string for a chip count (e.g. v5e-16 → 4x4)."""
+    v = VARIANTS[accelerator]
+    if v.ndims == 2:
+        a = int(math.isqrt(num_chips))
+        while a > 1 and num_chips % a:
+            a -= 1
+        return f"{a}x{num_chips // a}"
+    # 3D: factor as close to cubic as we can, chips_per_host-aligned on last dim
+    dims, rem = [], num_chips
+    for _ in range(2):
+        d = max(1, round(rem ** (1 / 3)))
+        while d > 1 and rem % d:
+            d -= 1
+        dims.append(d)
+        rem //= d
+    dims.append(rem)
+    return "x".join(str(d) for d in sorted(dims))
+
+
+def register(api: APIServer) -> None:
+    api.register_crd(
+        CRD(group=GROUP, version="v1", kind="PodGroup", plural="podgroups")
+    )
+
+
+def make_tpu_slice(
+    api: APIServer,
+    slice_name: str,
+    accelerator: str = "v5e",
+    topology: str = "4x4",
+    cpu_per_host: float = 112.0,
+    memory_per_host: float = 192 * 2**30,
+) -> list[str]:
+    """Create Node objects for one TPU pod slice (1 Node per TPU VM/host)."""
+    v = VARIANTS[accelerator]
+    n_chips = chips_in(topology)
+    n_hosts = max(1, n_chips // v.chips_per_host)
+    names = []
+    for host in range(n_hosts):
+        name = f"{slice_name}-host-{host}"
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {
+                    "name": name,
+                    "labels": {
+                        "kubernetes.io/hostname": name,
+                        ACCELERATOR_LABEL: v.name,
+                        TOPOLOGY_LABEL: topology,
+                        SLICE_LABEL: slice_name,
+                        HOST_INDEX_LABEL: str(host),
+                    },
+                },
+                "status": {
+                    "phase": "Ready",
+                    "capacity": {
+                        "cpu": cpu_per_host,
+                        "memory": memory_per_host,
+                        TPU_RESOURCE: min(v.chips_per_host, n_chips),
+                    },
+                },
+            }
+        )
+        names.append(name)
+    return names
+
+
+def make_cpu_node(api: APIServer, name: str, cpu: float = 64.0, memory: float = 128 * 2**30) -> str:
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+            "status": {"phase": "Ready", "capacity": {"cpu": cpu, "memory": memory}},
+        }
+    )
+    return name
+
+
+# --------------------------------------------------------------------- parse
+
+def parse_quantity(q) -> float:
+    """Parse k8s resource quantities: 500m, 2, 1Gi, 1.5G, 4Ki…"""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    suffixes = {
+        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+        "k": 1e3, "K": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+    }
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    for suf, mult in suffixes.items():
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * mult
+    return float(s)
+
+
+def pod_requests(pod: Obj) -> dict[str, float]:
+    """Effective pod requests: sum over containers, max'd with each init
+    container (init containers run alone, k8s semantics)."""
+    spec = pod.get("spec", {})
+
+    def container_req(c: dict) -> dict[str, float]:
+        res = c.get("resources", {})
+        req = res.get("requests") or res.get("limits") or {}
+        return {k: parse_quantity(v) for k, v in req.items()}
+
+    total: dict[str, float] = {}
+    for c in spec.get("containers", []):
+        for k, v in container_req(c).items():
+            total[k] = total.get(k, 0.0) + v
+    for c in spec.get("initContainers", []):
+        for k, v in container_req(c).items():
+            total[k] = max(total.get(k, 0.0), v)
+    return total
+
+
+# ----------------------------------------------------------------- scheduler
+
+class TopologyScheduler:
+    """Binds pods to nodes; gang groups bind all-or-nothing onto one slice."""
+
+    def __init__(self, api: APIServer):
+        self.api = api
+        self.recorder = EventRecorder(api, "tpu-scheduler")
+
+    # -- resource accounting
+
+    def _free(self) -> dict[str, dict[str, float]]:
+        nodes = {n["metadata"]["name"]: dict(n.get("status", {}).get("capacity", {})) for n in self.api.list("Node")}
+        for name in nodes:
+            nodes[name] = {k: parse_quantity(v) for k, v in nodes[name].items()}
+        for pod in self.api.list("Pod"):
+            node = pod.get("spec", {}).get("nodeName")
+            phase = pod.get("status", {}).get("phase", "Pending")
+            if node in nodes and phase not in ("Succeeded", "Failed"):
+                for k, v in pod_requests(pod).items():
+                    nodes[node][k] = nodes[node].get(k, 0.0) - v
+        return nodes
+
+    @staticmethod
+    def _fits(requests: dict, free: dict) -> bool:
+        return all(free.get(k, 0.0) >= v - 1e-9 for k, v in requests.items())
+
+    def _node_matches(self, pod: Obj, node: Obj) -> bool:
+        sel = pod.get("spec", {}).get("nodeSelector")
+        if not sel:
+            return True
+        labels = node["metadata"].get("labels", {})
+        return all(labels.get(k) == v for k, v in sel.items())
+
+    # -- main sync
+
+    def sync(self) -> bool:
+        changed = False
+        pending = [
+            p
+            for p in self.api.list("Pod")
+            if not p.get("spec", {}).get("nodeName")
+            and p.get("status", {}).get("phase", "Pending") == "Pending"
+        ]
+        if not pending:
+            return False
+        free = self._free()
+        nodes = {n["metadata"]["name"]: n for n in self.api.list("Node")}
+
+        singles = [p for p in pending if POD_GROUP_LABEL not in p["metadata"].get("labels", {})]
+        groups: dict[tuple[str, str], list[Obj]] = {}
+        for p in pending:
+            g = p["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
+            if g:
+                groups.setdefault((p["metadata"].get("namespace", "default"), g), []).append(p)
+
+        for pod in singles:
+            if self._bind_one(pod, nodes, free):
+                changed = True
+
+        for (ns, gname), pods in groups.items():
+            if self._bind_gang(ns, gname, pods, nodes, free):
+                changed = True
+        return changed
+
+    def _bind_one(self, pod: Obj, nodes: dict, free: dict) -> bool:
+        req = pod_requests(pod)
+        for name in sorted(nodes):
+            if not self._node_matches(pod, nodes[name]):
+                continue
+            if self._fits(req, free[name]):
+                self._bind(pod, name)
+                for k, v in req.items():
+                    free[name][k] = free[name].get(k, 0.0) - v
+                return True
+        self.recorder.warning(pod, "FailedScheduling", "no node with sufficient resources")
+        return False
+
+    def _bind_gang(self, ns: str, gname: str, pods: list[Obj], nodes: dict, free: dict) -> bool:
+        pg = self.api.try_get("PodGroup", gname, ns)
+        min_member = pg["spec"].get("minMember", len(pods)) if pg else len(pods)
+        if len(pods) < min_member:
+            return False  # gang not fully created yet
+
+        pods = sorted(pods, key=lambda p: p["metadata"]["name"])
+        assignment = self._plan_gang(pods, nodes, free)
+        if assignment is None:
+            if pg:
+                pgc = dict(pg)
+                pgc.setdefault("status", {})["phase"] = "Pending"
+                self.api.update_status(pgc)
+                self.recorder.warning(pg, "Unschedulable", f"gang {gname}: no feasible all-or-nothing placement")
+            return False
+        for pod, node in assignment:
+            self._bind(pod, node)
+            for k, v in pod_requests(pod).items():
+                free[node][k] = free[node].get(k, 0.0) - v
+        if pg:
+            pgc = dict(pg)
+            pgc.setdefault("status", {})["phase"] = "Running"
+            self.api.update_status(pgc)
+        return True
+
+    def _plan_gang(
+        self, pods: list[Obj], nodes: dict, free: dict
+    ) -> Optional[list[tuple[Obj, str]]]:
+        """All-or-nothing placement. TPU pods must co-locate on ONE slice."""
+        tpu_pods = [p for p in pods if pod_requests(p).get(TPU_RESOURCE, 0) > 0]
+        trial_free = {n: dict(f) for n, f in free.items()}
+        assignment: list[tuple[Obj, str]] = []
+
+        if tpu_pods:
+            slices: dict[str, list[str]] = {}
+            for name, n in nodes.items():
+                s = n["metadata"].get("labels", {}).get(SLICE_LABEL)
+                if s:
+                    slices.setdefault(s, []).append(name)
+            placed = False
+            for sname in sorted(slices):
+                snodes = sorted(
+                    slices[sname],
+                    key=lambda n: int(nodes[n]["metadata"]["labels"].get(HOST_INDEX_LABEL, "0")),
+                )
+                s_free = {n: dict(trial_free[n]) for n in snodes}
+                s_assign = []
+                ok = True
+                for pod in tpu_pods:
+                    req = pod_requests(pod)
+                    for n in snodes:
+                        if self._node_matches(pod, nodes[n]) and self._fits(req, s_free[n]):
+                            s_assign.append((pod, n))
+                            for k, v in req.items():
+                                s_free[n][k] = s_free[n].get(k, 0.0) - v
+                            break
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    for n, f in s_free.items():
+                        trial_free[n] = f
+                    assignment.extend(s_assign)
+                    placed = True
+                    break
+            if not placed:
+                return None
+
+        for pod in pods:
+            if pod in tpu_pods:
+                continue
+            req = pod_requests(pod)
+            for name in sorted(nodes):
+                if self._node_matches(pod, nodes[name]) and self._fits(req, trial_free[name]):
+                    assignment.append((pod, name))
+                    for k, v in req.items():
+                        trial_free[name][k] = trial_free[name].get(k, 0.0) - v
+                    break
+            else:
+                return None
+        return assignment
+
+    def _bind(self, pod: Obj, node: str) -> None:
+        self.api.patch("Pod", pod["metadata"]["name"], {"spec": {"nodeName": node}}, pod["metadata"].get("namespace", "default"))

@@ -1,0 +1,25 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh BEFORE jax imports.
+
+SURVEY.md §7: multi-chip sharding is validated on
+``--xla_force_host_platform_device_count=8`` CPU devices; the real single TPU
+chip is reserved for bench.py.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def cluster():
+    from kubeflow_tpu.core.cluster import Cluster
+
+    c = Cluster(cpu_nodes=1)
+    yield c
+    c.shutdown()

@@ -1,0 +1,151 @@
+"""Unit tests for the API machinery (SURVEY.md §4: fake-clientset-style tests)."""
+
+import pytest
+
+from kubeflow_tpu.core.api import (
+    APIServer,
+    AlreadyExists,
+    CRD,
+    Conflict,
+    NotFound,
+    WatchEvent,
+    owner_reference,
+)
+from kubeflow_tpu.core.conditions import get_condition, has_condition, set_condition
+from kubeflow_tpu.core.events import EventRecorder, events_for
+
+
+def make_pod(name, ns="default", labels=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"containers": [{"name": "main", "command": ["true"]}]},
+    }
+
+
+def test_create_get_roundtrip():
+    api = APIServer()
+    created = api.create(make_pod("a"))
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"]
+    got = api.get("Pod", "a")
+    assert got["metadata"]["uid"] == created["metadata"]["uid"]
+    # deep-copy semantics: mutating returned obj does not touch the store
+    got["spec"]["containers"][0]["name"] = "mutated"
+    assert api.get("Pod", "a")["spec"]["containers"][0]["name"] == "main"
+
+
+def test_create_duplicate_and_generate_name():
+    api = APIServer()
+    api.create(make_pod("a"))
+    with pytest.raises(AlreadyExists):
+        api.create(make_pod("a"))
+    p = api.create({"apiVersion": "v1", "kind": "Pod", "metadata": {"generateName": "x-"},
+                    "spec": {"containers": []}})
+    assert p["metadata"]["name"].startswith("x-")
+
+
+def test_update_conflict_on_stale_rv():
+    api = APIServer()
+    a = api.create(make_pod("a"))
+    b = api.get("Pod", "a")
+    b["metadata"]["labels"]["x"] = "1"
+    api.update(b)
+    a["metadata"]["labels"]["y"] = "2"
+    with pytest.raises(Conflict):
+        api.update(a)
+
+
+def test_status_subresource_only_touches_status():
+    api = APIServer()
+    p = api.create(make_pod("a"))
+    p["spec"] = {"containers": [{"name": "changed"}]}
+    p["status"] = {"phase": "Running"}
+    out = api.update_status(p)
+    assert out["status"]["phase"] == "Running"
+    assert api.get("Pod", "a")["spec"]["containers"][0]["name"] == "main"
+
+
+def test_patch_merge_semantics():
+    api = APIServer()
+    api.create(make_pod("a", labels={"keep": "1", "drop": "2"}))
+    api.patch("Pod", "a", {"metadata": {"labels": {"drop": None, "new": "3"}}})
+    labels = api.get("Pod", "a")["metadata"]["labels"]
+    assert labels == {"keep": "1", "new": "3"}
+
+
+def test_list_label_selector_and_namespace():
+    api = APIServer()
+    api.ensure_namespace("other")
+    api.create(make_pod("a", labels={"app": "x"}))
+    api.create(make_pod("b", labels={"app": "y"}))
+    api.create(make_pod("c", ns="other", labels={"app": "x"}))
+    assert {p["metadata"]["name"] for p in api.list("Pod", label_selector={"app": "x"})} == {"a", "c"}
+    assert {p["metadata"]["name"] for p in api.list("Pod", namespace="other")} == {"c"}
+
+
+def test_watch_stream_sees_crud():
+    api = APIServer()
+    w = api.watch("Pod")
+    api.create(make_pod("a"))
+    p = api.get("Pod", "a")
+    p["metadata"]["labels"]["x"] = "1"
+    api.update(p)
+    api.delete("Pod", "a")
+    evs = []
+    while (e := w.poll()) is not None:
+        evs.append(e.type)
+    assert evs == [WatchEvent.ADDED, WatchEvent.MODIFIED, WatchEvent.DELETED]
+
+
+def test_owner_reference_cascade_delete():
+    api = APIServer()
+    api.register_crd(CRD(group="kubeflow.org", version="v1", kind="TPUJob", plural="tpujobs"))
+    job = api.create({"apiVersion": "kubeflow.org/v1", "kind": "TPUJob",
+                      "metadata": {"name": "j"}, "spec": {}})
+    pod = make_pod("j-worker-0")
+    pod["metadata"]["ownerReferences"] = [owner_reference(job)]
+    api.create(pod)
+    api.delete("TPUJob", "j")
+    with pytest.raises(NotFound):
+        api.get("Pod", "j-worker-0")
+
+
+def test_conditions_transition_time_semantics():
+    status = {}
+    assert set_condition(status, "Running", "True", "JobRunning", "started")
+    t0 = get_condition(status, "Running")["lastTransitionTime"]
+    # same value: no transition-time change
+    set_condition(status, "Running", "True", "JobRunning", "started")
+    assert get_condition(status, "Running")["lastTransitionTime"] == t0
+    assert has_condition(status, "Running")
+    set_condition(status, "Running", "False", "JobDone", "finished")
+    assert not has_condition(status, "Running")
+
+
+def test_event_recorder():
+    api = APIServer()
+    pod = api.create(make_pod("a"))
+    rec = EventRecorder(api, "test-controller")
+    rec.normal(pod, "Created", "created pod")
+    rec.warning(pod, "Unhealthy", "bad")
+    evs = events_for(api, pod)
+    assert {e["reason"] for e in evs} == {"Created", "Unhealthy"}
+
+
+def test_validator_and_defaulter():
+    api = APIServer()
+
+    def validator(obj):
+        from kubeflow_tpu.core.api import Invalid
+        if "replicas" not in obj.get("spec", {}):
+            raise Invalid("spec.replicas required")
+
+    def defaulter(obj):
+        obj["spec"].setdefault("replicas", 1)
+
+    api.register_crd(CRD(group="t", version="v1", kind="Thing", plural="things",
+                         validator=validator, defaulter=defaulter))
+    out = api.create({"apiVersion": "t/v1", "kind": "Thing", "metadata": {"name": "a"}, "spec": {}})
+    assert out["spec"]["replicas"] == 1

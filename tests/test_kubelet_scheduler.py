@@ -1,0 +1,139 @@
+"""Kubelet (real subprocesses) + topology/gang scheduler tests."""
+
+import sys
+
+from kubeflow_tpu.core.cluster import Cluster
+from kubeflow_tpu.scheduler.topology import (
+    POD_GROUP_LABEL,
+    TPU_RESOURCE,
+    chips_in,
+    make_tpu_slice,
+    parse_quantity,
+    slice_shape,
+)
+
+
+def py_pod(name, code, ns="default", labels=None, restart="Never", resources=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {
+            "restartPolicy": restart,
+            "containers": [
+                {
+                    "name": "main",
+                    "command": [sys.executable, "-u", "-c", code],
+                    "resources": resources or {},
+                }
+            ],
+        },
+    }
+
+
+def phase(cluster, name, ns="default"):
+    pod = cluster.api.try_get("Pod", name, ns)
+    return pod.get("status", {}).get("phase") if pod else None
+
+
+def test_pod_runs_to_success_and_logs(cluster):
+    cluster.api.create(py_pod("hello", "print('hello from pod')"))
+    assert cluster.wait_for(lambda: phase(cluster, "hello") == "Succeeded", timeout=30)
+    assert "hello from pod" in cluster.logs("hello")
+
+
+def test_pod_failure_exit_code_recorded(cluster):
+    cluster.api.create(py_pod("boom", "import sys; sys.exit(3)"))
+    assert cluster.wait_for(lambda: phase(cluster, "boom") == "Failed", timeout=30)
+    st = cluster.api.get("Pod", "boom")["status"]["containerStatuses"][0]
+    assert st["state"]["terminated"]["exitCode"] == 3
+
+
+def test_init_containers_run_before_main(cluster):
+    pod = py_pod("withinit", "print('MAIN')")
+    pod["spec"]["initContainers"] = [
+        {"name": "init", "command": [sys.executable, "-u", "-c", "print('INIT')"]}
+    ]
+    cluster.api.create(pod)
+    assert cluster.wait_for(lambda: phase(cluster, "withinit") == "Succeeded", timeout=30)
+    log = cluster.logs("withinit")
+    assert log.index("INIT") < log.index("MAIN")
+
+
+def test_on_failure_restart(cluster):
+    # fails first run, succeeds after a marker file exists
+    code = (
+        "import os,sys\n"
+        "m = os.environ['MARKER']\n"
+        "if not os.path.exists(m):\n"
+        "    open(m,'w').close(); sys.exit(1)\n"
+        "print('second run ok')\n"
+    )
+    import tempfile
+
+    marker = tempfile.mktemp()
+    pod = py_pod("flaky", code, restart="OnFailure")
+    pod["spec"]["containers"][0]["env"] = [{"name": "MARKER", "value": marker}]
+    cluster.api.create(pod)
+    assert cluster.wait_for(lambda: phase(cluster, "flaky") == "Succeeded", timeout=30)
+    st = cluster.api.get("Pod", "flaky")["status"]["containerStatuses"][0]
+    assert st["restartCount"] == 1
+
+
+def test_pod_delete_kills_process(cluster):
+    cluster.api.create(py_pod("sleeper", "import time; time.sleep(300)"))
+    assert cluster.wait_for(lambda: phase(cluster, "sleeper") == "Running", timeout=30)
+    cluster.api.delete("Pod", "sleeper")
+    kubelet = cluster.kubelets["cpu-0"]
+    assert cluster.wait_for(lambda: not kubelet._runs, timeout=30)
+
+
+def test_quantity_parsing():
+    assert parse_quantity("500m") == 0.5
+    assert parse_quantity("2") == 2.0
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity("1.5G") == 1.5e9
+    assert parse_quantity(4) == 4.0
+
+
+def test_slice_shapes():
+    assert chips_in("4x4") == 16
+    assert slice_shape("v5e", 16) == "4x4"
+    assert chips_in(slice_shape("v4", 32)) == 32
+
+
+def test_tpu_slice_nodes_and_gang_all_or_nothing():
+    c = Cluster(cpu_nodes=0, tpu_slices=(("s0", "v5e", "2x4"),))  # 8 chips, 2 hosts
+    try:
+        assert len(c.api.list("Node")) == 2
+        # gang of 2 pods, each wanting 4 chips: fits on the slice (one per host)
+        c.api.create({"apiVersion": "scheduling.kubeflow.org/v1", "kind": "PodGroup",
+                      "metadata": {"name": "g"}, "spec": {"minMember": 2}})
+        for i in range(2):
+            c.api.create(py_pod(f"w-{i}", "print('ok')",
+                                labels={POD_GROUP_LABEL: "g"},
+                                resources={"requests": {TPU_RESOURCE: 4}}))
+        assert c.wait_for(lambda: all(phase(c, f"w-{i}") == "Succeeded" for i in range(2)), timeout=30)
+        nodes = {c.api.get("Pod", f"w-{i}")["spec"]["nodeName"] for i in range(2)}
+        assert nodes == {"s0-host-0", "s0-host-1"}
+    finally:
+        c.shutdown()
+
+
+def test_gang_does_not_bind_partial():
+    c = Cluster(cpu_nodes=0, tpu_slices=(("s0", "v5e", "2x2"),))  # 4 chips, 1 host
+    try:
+        c.api.create({"apiVersion": "scheduling.kubeflow.org/v1", "kind": "PodGroup",
+                      "metadata": {"name": "g"}, "spec": {"minMember": 2}})
+        for i in range(2):
+            c.api.create(py_pod(f"w-{i}", "print('ok')",
+                                labels={POD_GROUP_LABEL: "g"},
+                                resources={"requests": {TPU_RESOURCE: 4}}))
+        c.settle(quiet=0.3, timeout=10)
+        # infeasible gang (needs 8 chips, slice has 4): NOTHING binds
+        for i in range(2):
+            assert not c.api.get("Pod", f"w-{i}")["spec"].get("nodeName")
+        pg = c.api.get("PodGroup", "g")
+        assert pg["status"]["phase"] == "Pending"
+    finally:
+        c.shutdown()
