@@ -146,7 +146,8 @@ class LocalProcessKubelet:
         env = dict(os.environ)
         env.update(self.base_env)
         for e in container.get("env", []):
-            env[e["name"]] = str(e["value"])
+            if "value" in e:  # valueFrom (fieldRef/secretKeyRef) not resolvable here
+                env[e["name"]] = str(e["value"])
         env.setdefault("POD_NAME", run.name)
         env.setdefault("POD_NAMESPACE", run.namespace)
         log = open(run.log_path, "ab")

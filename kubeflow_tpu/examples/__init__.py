@@ -1,0 +1,1 @@
+"""Runnable example workloads (pod entrypoints)."""

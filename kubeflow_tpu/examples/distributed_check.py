@@ -1,0 +1,62 @@
+"""Pod entrypoint: verify the injected rendezvous env forms a real JAX
+process group, run a cross-process psum, and train a tiny data-parallel MLP.
+
+This is the e2e "aha" workload (SURVEY.md §7 phase 2): the platform's env
+injection → ``jax.distributed`` → pmap/psum collectives, end to end on
+localhost CPU processes (ICI on real hardware).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from kubeflow_tpu.parallel.distributed import initialize
+
+    penv = initialize(local_device_count=1)
+    import jax
+    import jax.numpy as jnp
+
+    n_global = jax.device_count()
+    print(f"RENDEZVOUS process={penv.process_id}/{penv.num_processes} global_devices={n_global}")
+
+    # cross-process collective: psum of (process_id + 1) over all devices
+    x = jnp.ones((jax.local_device_count(),)) * (penv.process_id + 1)
+    out = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)
+    expected = sum(p + 1 for p in range(penv.num_processes)) * (n_global // penv.num_processes)
+    print(f"PSUM got={float(out[0])} expected={float(expected)}")
+    assert float(out[0]) == float(expected), "psum mismatch"
+
+    # tiny data-parallel training step: grads psum'd across processes
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (8, 4)) * 0.1
+    data_key = jax.random.fold_in(key, penv.process_id + 1)
+    x_local = jax.random.normal(data_key, (jax.local_device_count(), 16, 8))
+    y_local = jnp.sin(x_local.sum(-1, keepdims=True)).repeat(4, -1)
+
+    def loss_fn(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    step = jax.pmap(
+        lambda w, x, y: (
+            w - 0.05 * jax.lax.psum(jax.grad(loss_fn)(w, x, y), "batch"),
+            jax.lax.psum(loss_fn(w, x, y), "batch"),
+        ),
+        axis_name="batch",
+    )
+    ws = jnp.broadcast_to(w, (jax.local_device_count(),) + w.shape)
+    first = last = None
+    for i in range(5):
+        ws, loss = step(ws, x_local, y_local)
+        val = float(loss[0])
+        first = val if first is None else first
+        last = val
+        print(f"STEP {i} loss={val:.5f}")
+    assert last < first, "loss did not decrease"
+    print("TRAIN-OK")
+
+
+if __name__ == "__main__":
+    main()

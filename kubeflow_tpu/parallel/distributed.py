@@ -1,0 +1,66 @@
+"""Workload-side distributed bootstrap: consume the env the TPUJob controller
+injects and form the JAX process group.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §3.1): the workload-side
+``tf.distribute`` / ``torch.distributed.init_process_group`` calls that read
+``TF_CONFIG`` / ``MASTER_ADDR``.  TPU-native: one call wires
+``jax.distributed`` — after that, ICI collectives are compiled into XLA
+programs and the platform never manages a communicator again.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ProcessEnv:
+    coordinator_address: Optional[str]
+    num_processes: int
+    process_id: int
+    num_slices: int
+    slice_id: int
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def read_env(environ=None) -> ProcessEnv:
+    env = environ if environ is not None else os.environ
+    return ProcessEnv(
+        coordinator_address=env.get("JAX_COORDINATOR_ADDRESS"),
+        num_processes=int(env.get("JAX_NUM_PROCESSES", "1")),
+        process_id=int(env.get("JAX_PROCESS_ID", "0")),
+        num_slices=int(env.get("MEGASCALE_NUM_SLICES", "1")),
+        slice_id=int(env.get("MEGASCALE_SLICE_ID", "0")),
+    )
+
+
+def initialize(local_device_count: Optional[int] = None) -> ProcessEnv:
+    """Join the job's process group (no-op for single-process jobs).
+
+    ``local_device_count`` forces N virtual CPU devices per process — the
+    simulator's stand-in for a TPU host's chips (tests use 1–2; a real v5e
+    host exposes 4 without any flag).
+    """
+    penv = read_env()
+    if local_device_count is not None:
+        kept = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        kept.append(f"--xla_force_host_platform_device_count={local_device_count}")
+        os.environ["XLA_FLAGS"] = " ".join(kept)
+    import jax
+
+    if penv.is_distributed:
+        jax.distributed.initialize(
+            coordinator_address=penv.coordinator_address,
+            num_processes=penv.num_processes,
+            process_id=penv.process_id,
+        )
+    return penv

@@ -31,6 +31,9 @@ TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 SLICE_LABEL = f"{GROUP}/tpu-slice"
 HOST_INDEX_LABEL = f"{GROUP}/tpu-host-index"
+# multislice jobs: pods sharing a slice-group co-locate on ONE slice; distinct
+# groups of the same gang land on DISTINCT slices (DCN between them)
+SLICE_GROUP_LABEL = f"{GROUP}/tpu-slice-group"
 
 
 @dataclass(frozen=True)
@@ -296,34 +299,45 @@ class TopologyScheduler:
                 s = n["metadata"].get("labels", {}).get(SLICE_LABEL)
                 if s:
                     slices.setdefault(s, []).append(name)
-            placed = False
-            for sname in sorted(slices):
-                snodes = sorted(
-                    slices[sname],
-                    key=lambda n: int(nodes[n]["metadata"]["labels"].get(HOST_INDEX_LABEL, "0")),
-                )
-                s_free = {n: dict(trial_free[n]) for n in snodes}
-                s_assign = []
-                ok = True
-                for pod in tpu_pods:
-                    req = pod_requests(pod)
-                    for n in snodes:
-                        if self._node_matches(pod, nodes[n]) and self._fits(req, s_free[n]):
-                            s_assign.append((pod, n))
-                            for k, v in req.items():
-                                s_free[n][k] = s_free[n].get(k, 0.0) - v
+            # group by slice-group label (multislice); single-slice gangs form one group
+            slice_groups: dict[str, list[Obj]] = {}
+            for p in tpu_pods:
+                g = p["metadata"].get("labels", {}).get(SLICE_GROUP_LABEL, "")
+                slice_groups.setdefault(g, []).append(p)
+            used_slices: set[str] = set()
+            for gkey in sorted(slice_groups):
+                gpods = slice_groups[gkey]
+                placed = False
+                for sname in sorted(slices):
+                    if gkey and sname in used_slices:
+                        continue  # distinct slices per slice-group
+                    snodes = sorted(
+                        slices[sname],
+                        key=lambda n: int(nodes[n]["metadata"]["labels"].get(HOST_INDEX_LABEL, "0")),
+                    )
+                    s_free = {n: dict(trial_free[n]) for n in snodes}
+                    s_assign = []
+                    ok = True
+                    for pod in gpods:
+                        req = pod_requests(pod)
+                        for n in snodes:
+                            if self._node_matches(pod, nodes[n]) and self._fits(req, s_free[n]):
+                                s_assign.append((pod, n))
+                                for k, v in req.items():
+                                    s_free[n][k] = s_free[n].get(k, 0.0) - v
+                                break
+                        else:
+                            ok = False
                             break
-                    else:
-                        ok = False
+                    if ok:
+                        for n, f in s_free.items():
+                            trial_free[n] = f
+                        assignment.extend(s_assign)
+                        used_slices.add(sname)
+                        placed = True
                         break
-                if ok:
-                    for n, f in s_free.items():
-                        trial_free[n] = f
-                    assignment.extend(s_assign)
-                    placed = True
-                    break
-            if not placed:
-                return None
+                if not placed:
+                    return None
 
         for pod in pods:
             if pod in tpu_pods:

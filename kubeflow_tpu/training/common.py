@@ -1,0 +1,368 @@
+"""Shared JobController: the reconcile engine behind every training job kind.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2a "Training operator: common
+JobController"): ``JobController.ReconcileJobs/ReconcilePods/
+ReconcileServices`` in training-operator's ``pkg/controller.v1/common``.
+
+Reconcile contract (framework subclasses override the hooks at the bottom):
+  1. terminal jobs: apply cleanPodPolicy, honor ttlSecondsAfterFinished;
+  2. allocate rendezvous ports once (persisted as an annotation so the
+     reconcile is idempotent);
+  3. ensure the gang PodGroup (minMember = total replicas, all-or-nothing);
+  4. create missing pods with framework rendezvous env injected
+     (``set_cluster_spec`` — the TF_CONFIG / MASTER_ADDR / jax.distributed
+     analogue, SURVEY.md §3.1) + a headless Service per replica;
+  5. restart policy: ExitCode treats exit codes >= 128 (signal/preemption)
+     as retryable (pod recreated, Restarting condition) and 1–127 as
+     permanent; Never fails the job; Always/OnFailure restart in place via
+     the kubelet.  ``backoffLimit`` caps total controller-driven recreations;
+  6. aggregate replicaStatuses + Created/Running/Restarting/Succeeded/Failed
+     conditions (success policy is a framework hook).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from ..core.api import APIServer, AlreadyExists, NotFound, Obj, owner_reference
+from ..core.conditions import has_condition, set_condition
+from ..core.controller import Request, Result
+from ..core.events import EventRecorder
+from ..scheduler.topology import (
+    ACCELERATOR_LABEL,
+    POD_GROUP_LABEL,
+    TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    VARIANTS,
+    chips_in,
+)
+from ..utils.net import find_free_ports
+from . import api as tapi
+
+PORTS_ANNOTATION = "training.kubeflow.org/rendezvous-ports"
+
+RETRYABLE_EXIT_MIN = 128  # signal-terminated / preempted → retryable
+
+
+class JobController:
+    kind: str = "TPUJob"
+
+    def __init__(self, api: APIServer):
+        self.api = api
+        self.recorder = EventRecorder(api, f"{self.kind.lower()}-controller")
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        job = self.api.try_get(self.kind, req.name, req.namespace)
+        if job is None:
+            return None
+        status = job.setdefault("status", {})
+
+        if has_condition(status, tapi.SUCCEEDED) or has_condition(status, tapi.FAILED):
+            return self._reconcile_terminal(job)
+
+        if not has_condition(status, tapi.CREATED):
+            set_condition(status, tapi.CREATED, "True", f"{self.kind}Created", "job accepted")
+            self.recorder.normal(job, "JobCreated", f"{self.kind} {req.name} created")
+            job = self.api.update_status(job)
+            status = job["status"]
+
+        replicas = self.effective_replicas(job)
+        total = sum(r["replicas"] for r in replicas.values())
+
+        job = self._ensure_ports(job, replicas)
+        status = job["status"]  # rebind: _ensure_ports returns a fresh copy
+        self._ensure_pod_group(job, total)
+
+        pods_by_type: dict[str, list[Optional[Obj]]] = {}
+        for rtype, rspec in replicas.items():
+            pods_by_type[rtype] = [
+                self.api.try_get("Pod", self.pod_name(job, rtype, i), req.namespace)
+                for i in range(rspec["replicas"])
+            ]
+
+        # --- restart / failure policy before creating anything.
+        # Two passes: detect any PERMANENT failure first so we never delete
+        # sibling pods (and their logs) of a job that is about to fail.
+        backoff_limit = job["spec"].get("runPolicy", {}).get("backoffLimit", 3)
+        failure_msg = None
+        retryable_failures: list[tuple[str, int, Obj, Optional[int]]] = []
+        for rtype, rspec in replicas.items():
+            policy = rspec.get("restartPolicy", "Never")
+            for i, pod in enumerate(pods_by_type[rtype]):
+                if pod is None or pod.get("status", {}).get("phase") != "Failed":
+                    continue
+                rc = _exit_code(pod)
+                retryable = policy in ("Always", "OnFailure") or (
+                    policy == "ExitCode" and rc is not None and rc >= RETRYABLE_EXIT_MIN
+                )
+                if not retryable:
+                    failure_msg = f"{rtype}[{i}] failed with exit code {rc} (permanent)"
+                    break
+                if self._restarts(status) + len(retryable_failures) >= backoff_limit:
+                    failure_msg = f"{rtype}[{i}] exceeded backoffLimit ({backoff_limit})"
+                    break
+                retryable_failures.append((rtype, i, pod, rc))
+            if failure_msg:
+                break
+
+        restarted = False
+        if failure_msg is None:
+            for rtype, i, pod, rc in retryable_failures:
+                self.api.try_delete("Pod", pod["metadata"]["name"], req.namespace)
+                pods_by_type[rtype][i] = None
+                status["restartCount"] = self._restarts(status) + 1
+                restarted = True
+                self.recorder.warning(
+                    job, "JobRestarting", f"{rtype}[{i}] exit {rc}: retryable, recreating"
+                )
+
+        if failure_msg:
+            set_condition(status, tapi.FAILED, "True", "JobFailed", failure_msg)
+            set_condition(status, tapi.RUNNING, "False", "JobFailed", failure_msg)
+            status["completionTime"] = time.time()
+            self.recorder.warning(job, "JobFailed", failure_msg)
+            self.api.update_status(job)
+            return self._reconcile_terminal(job)
+
+        if restarted:
+            set_condition(status, tapi.RESTARTING, "True", "JobRestarting", "recreating failed pods")
+            self.api.update_status(job)
+            return Result(requeue_after=0.05)
+
+        # --- create missing pods + services
+        for rtype, rspec in replicas.items():
+            for i, pod in enumerate(pods_by_type[rtype]):
+                if pod is None:
+                    created = self._create_pod(job, rtype, i, rspec, replicas)
+                    pods_by_type[rtype][i] = created
+                    self._ensure_service(job, created)
+
+        # --- aggregate status
+        replica_statuses = {}
+        any_active = False
+        for rtype, pods in pods_by_type.items():
+            phases = [((p or {}).get("status") or {}).get("phase", "Pending") for p in pods]
+            replica_statuses[rtype] = {
+                "active": sum(ph in ("Pending", "Running") for ph in phases),
+                "succeeded": sum(ph == "Succeeded" for ph in phases),
+                "failed": sum(ph == "Failed" for ph in phases),
+            }
+            any_active = any_active or any(ph == "Running" for ph in phases)
+        status["replicaStatuses"] = replica_statuses
+
+        if self.is_succeeded(job, pods_by_type):
+            set_condition(status, tapi.SUCCEEDED, "True", "JobSucceeded", "job completed")
+            set_condition(status, tapi.RUNNING, "False", "JobSucceeded", "job completed")
+            status["completionTime"] = time.time()
+            self.recorder.normal(job, "JobSucceeded", f"{self.kind} {req.name} succeeded")
+            self.api.update_status(job)
+            return self._reconcile_terminal(self.api.get(self.kind, req.name, req.namespace))
+
+        if any_active and not has_condition(status, tapi.RUNNING):
+            set_condition(status, tapi.RUNNING, "True", f"{self.kind}Running", "pods running")
+            self.recorder.normal(job, "JobRunning", "all pods scheduled")
+        self.api.update_status(job)
+        return None
+
+    # ------------------------------------------------------------- terminal
+
+    def _reconcile_terminal(self, job: Obj) -> Optional[Result]:
+        ns = job["metadata"].get("namespace", "default")
+        policy = job["spec"].get("runPolicy", {}).get("cleanPodPolicy", "None")
+        if policy != "None":
+            for pod in self._job_pods(job):
+                phase = pod.get("status", {}).get("phase", "Pending")
+                if policy == "All" or (policy == "Running" and phase in ("Pending", "Running")):
+                    self.api.try_delete("Pod", pod["metadata"]["name"], ns)
+        ttl = job["spec"].get("runPolicy", {}).get("ttlSecondsAfterFinished")
+        if ttl is not None:
+            done_at = job.get("status", {}).get("completionTime") or time.time()
+            remaining = done_at + ttl - time.time()
+            if remaining <= 0:
+                self.api.try_delete(self.kind, job["metadata"]["name"], ns)
+                return None
+            return Result(requeue_after=remaining)
+        return None
+
+    # --------------------------------------------------------------- helpers
+
+    def _restarts(self, status: dict) -> int:
+        return int(status.get("restartCount", 0))
+
+    def _job_pods(self, job: Obj) -> list[Obj]:
+        return self.api.list(
+            "Pod",
+            namespace=job["metadata"].get("namespace", "default"),
+            label_selector={tapi.LABEL_JOB_NAME: job["metadata"]["name"]},
+        )
+
+    def pod_name(self, job: Obj, rtype: str, index: int) -> str:
+        return f"{job['metadata']['name']}-{rtype.lower()}-{index}"
+
+    def _ensure_ports(self, job: Obj, replicas: dict) -> Obj:
+        if PORTS_ANNOTATION in job["metadata"].get("annotations", {}):
+            return job
+        total = sum(r["replicas"] for r in replicas.values())
+        ports = find_free_ports(self.num_ports(total))
+        job["metadata"].setdefault("annotations", {})[PORTS_ANNOTATION] = json.dumps(ports)
+        return self.api.update(job)
+
+    def ports_of(self, job: Obj) -> list[int]:
+        return json.loads(job["metadata"]["annotations"][PORTS_ANNOTATION])
+
+    def _ensure_pod_group(self, job: Obj, total: int) -> None:
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        min_member = total
+        sched = job["spec"].get("runPolicy", {}).get("schedulingPolicy") or {}
+        if "minAvailable" in sched:
+            min_member = sched["minAvailable"]
+        try:
+            self.api.create(
+                {
+                    "apiVersion": "scheduling.kubeflow.org/v1",
+                    "kind": "PodGroup",
+                    "metadata": {
+                        "name": name,
+                        "namespace": ns,
+                        "ownerReferences": [owner_reference(job)],
+                    },
+                    "spec": {"minMember": min_member, "queue": sched.get("queue", "default")},
+                }
+            )
+        except AlreadyExists:
+            pass
+
+    def _create_pod(self, job: Obj, rtype: str, index: int, rspec: dict, replicas: dict) -> Obj:
+        import copy
+
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        template = copy.deepcopy(rspec["template"])
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": self.pod_name(job, rtype, index),
+                "namespace": ns,
+                "labels": {
+                    tapi.LABEL_JOB_NAME: name,
+                    tapi.LABEL_REPLICA_TYPE: rtype.lower(),
+                    tapi.LABEL_REPLICA_INDEX: str(index),
+                    POD_GROUP_LABEL: name,
+                    **template.get("metadata", {}).get("labels", {}),
+                },
+                "ownerReferences": [owner_reference(job)],
+            },
+            "spec": copy.deepcopy(template["spec"]),
+        }
+        spec = pod["spec"]
+        spec.setdefault("restartPolicy", self._pod_restart_policy(rspec))
+        if spec.get("nodeSelector") is None:
+            spec.pop("nodeSelector", None)
+
+        tpu = job["spec"].get("tpu")
+        if tpu:
+            self._apply_tpu_placement(spec, tpu)
+            num_slices = int(tpu.get("numSlices", 1))
+            if num_slices > 1:
+                variant = VARIANTS[tpu.get("accelerator", "v5e")]
+                hosts_per_slice = max(1, chips_in(tpu.get("topology", "2x2")) // variant.chips_per_host)
+                from ..scheduler.topology import SLICE_GROUP_LABEL
+
+                pod["metadata"]["labels"][SLICE_GROUP_LABEL] = f"{name}-s{index // hosts_per_slice}"
+
+        # rendezvous env goes into EVERY container (sidecars need it too);
+        # template entries win on name collision, valueFrom entries pass through
+        cluster_env = self.set_cluster_spec(job, rtype, index, replicas)
+        for c in spec["containers"]:
+            existing = c.get("env", [])
+            names = {e["name"] for e in existing}
+            c["env"] = existing + [
+                {"name": k, "value": str(v)} for k, v in cluster_env.items() if k not in names
+            ]
+        return self.api.create(pod)
+
+    def _pod_restart_policy(self, rspec: dict) -> str:
+        policy = rspec.get("restartPolicy", "Never")
+        # ExitCode is controller-driven recreation; at pod level it is Never.
+        return "Never" if policy == "ExitCode" else policy
+
+    def _apply_tpu_placement(self, spec: dict, tpu: dict) -> None:
+        variant = VARIANTS[tpu.get("accelerator", "v5e")]
+        sel = spec.setdefault("nodeSelector", {})
+        sel.setdefault(ACCELERATOR_LABEL, variant.name)
+        sel.setdefault(TOPOLOGY_LABEL, tpu.get("topology", "2x2"))
+        res = spec["containers"][0].setdefault("resources", {})
+        req = res.setdefault("requests", {})
+        req.setdefault(TPU_RESOURCE, min(variant.chips_per_host, chips_in(tpu.get("topology", "2x2"))))
+
+    def _ensure_service(self, job: Obj, pod: Obj) -> None:
+        """Headless Service per replica — upstream gives each replica stable
+        DNS; in the simulator every address is 127.0.0.1 but the objects keep
+        API parity for tests and UIs."""
+        try:
+            self.api.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Service",
+                    "metadata": {
+                        "name": pod["metadata"]["name"],
+                        "namespace": pod["metadata"].get("namespace", "default"),
+                        "ownerReferences": [owner_reference(job)],
+                    },
+                    "spec": {
+                        "clusterIP": "None",
+                        "selector": {
+                            tapi.LABEL_JOB_NAME: job["metadata"]["name"],
+                            tapi.LABEL_REPLICA_INDEX: pod["metadata"]["labels"][tapi.LABEL_REPLICA_INDEX],
+                            tapi.LABEL_REPLICA_TYPE: pod["metadata"]["labels"][tapi.LABEL_REPLICA_TYPE],
+                        },
+                    },
+                }
+            )
+        except AlreadyExists:
+            pass
+
+    # ------------------------------------------------------ framework hooks
+
+    def effective_replicas(self, job: Obj) -> dict[str, dict]:
+        """Expanded replicaSpecs. TPU jobs with spec.tpu get replicas derived
+        from slice topology: one worker pod per TPU host per slice."""
+        replicas = {k: dict(v) for k, v in (job["spec"].get("replicaSpecs") or {}).items()}
+        tpu = job["spec"].get("tpu")
+        if tpu and "Worker" in replicas:
+            variant = VARIANTS[tpu.get("accelerator", "v5e")]
+            hosts = max(1, chips_in(tpu.get("topology", "2x2")) // variant.chips_per_host)
+            replicas["Worker"]["replicas"] = hosts * tpu.get("numSlices", 1)
+        return replicas
+
+    def num_ports(self, total_replicas: int) -> int:
+        return 1  # coordinator only; frameworks with per-task ports override
+
+    def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
+        """Rendezvous env for one replica. Framework-specific."""
+        return {}
+
+    def is_succeeded(self, job: Obj, pods_by_type: dict[str, list[Optional[Obj]]]) -> bool:
+        """Default success policy: the chief replica type fully succeeded;
+        if absent, all pods succeeded."""
+        chief = tapi.JOB_KINDS[self.kind]["chief"]
+        target = pods_by_type.get(chief)
+        if not target:
+            target = [p for pods in pods_by_type.values() for p in pods]
+        return bool(target) and all(
+            p is not None and p.get("status", {}).get("phase") == "Succeeded" for p in target
+        )
+
+
+def _exit_code(pod: Obj) -> Optional[int]:
+    for cs in pod.get("status", {}).get("containerStatuses", []):
+        term = cs.get("state", {}).get("terminated")
+        if term is not None and "exitCode" in term:
+            return int(term["exitCode"])
+    return None

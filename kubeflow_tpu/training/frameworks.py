@@ -1,0 +1,189 @@
+"""Per-framework job controllers: rendezvous env injection.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2a/§3.1): each framework controller
+overrides ``SetClusterSpec`` — TFJob renders ``TF_CONFIG``, PyTorchJob renders
+``MASTER_ADDR``/``RANK``, etc.  The TPU-native centerpiece is
+``TPUJobController``: it injects the ``jax.distributed`` coordinator plus
+``MEGASCALE_*`` multislice env — the direct analogue of the reference's
+``MASTER_ADDR``/``TF_CONFIG`` injection and "the single most important
+mechanism to replicate" (SURVEY.md §2c).
+
+In the simulator every host is 127.0.0.1; on a real cluster the same code
+would emit the headless-Service DNS names created by the common controller.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..core.api import APIServer, Obj
+from ..scheduler.topology import VARIANTS, chips_in
+from .common import JobController
+
+
+def _host(job: Obj, rtype: str, index: int) -> str:
+    # simulator address; real deployment: f"{job}-{rtype}-{i}.{ns}.svc"
+    return "127.0.0.1"
+
+
+class TPUJobController(JobController):
+    """TPUJob/JAXJob: jax.distributed over ICI, megascale over DCN."""
+
+    kind = "TPUJob"
+
+    def num_ports(self, total: int) -> int:
+        return 2  # [jax coordinator, megascale coordinator]
+
+    def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
+        ports = self.ports_of(job)
+        n = replicas["Worker"]["replicas"]
+        tpu = job["spec"].get("tpu") or {}
+        num_slices = int(tpu.get("numSlices", 1))
+        hosts_per_slice = max(1, n // num_slices)
+        env = {
+            "JAX_COORDINATOR_ADDRESS": f"{_host(job, rtype, 0)}:{ports[0]}",
+            "JAX_NUM_PROCESSES": str(n),
+            "JAX_PROCESS_ID": str(index),
+            "TPU_WORKER_ID": str(index % hosts_per_slice),
+            "TPU_WORKER_HOSTNAMES": ",".join(_host(job, rtype, i) for i in range(n)),
+        }
+        if tpu:
+            variant = VARIANTS[tpu.get("accelerator", "v5e")]
+            env["TPU_ACCELERATOR_TYPE"] = variant.name
+            env["TPU_TOPOLOGY"] = tpu.get("topology", "2x2")
+            env["TPU_CHIPS_PER_HOST"] = str(variant.chips_per_host)
+        if num_slices > 1:
+            # multislice: data parallel over DCN between slices (SURVEY.md §2c)
+            env.update(
+                {
+                    "MEGASCALE_COORDINATOR_ADDRESS": f"{_host(job, rtype, 0)}:{ports[1]}",
+                    "MEGASCALE_NUM_SLICES": str(num_slices),
+                    "MEGASCALE_SLICE_ID": str(index // hosts_per_slice),
+                }
+            )
+        return env
+
+
+class JAXJobController(TPUJobController):
+    kind = "JAXJob"
+
+
+class TFJobController(JobController):
+    """TFJob: TF_CONFIG cluster-spec env (PS/Worker/Chief/Evaluator)."""
+
+    kind = "TFJob"
+
+    _ORDER = ("Chief", "Master", "PS", "Worker", "Evaluator")
+
+    def num_ports(self, total: int) -> int:
+        return total
+
+    def _cluster(self, job: Obj, replicas: dict) -> dict[str, list[str]]:
+        ports = self.ports_of(job)
+        cluster: dict[str, list[str]] = {}
+        p = 0
+        for rtype in self._ORDER:
+            if rtype not in replicas:
+                continue
+            addrs = []
+            for i in range(replicas[rtype]["replicas"]):
+                addrs.append(f"{_host(job, rtype, i)}:{ports[p]}")
+                p += 1
+            cluster[rtype.lower()] = addrs
+        return cluster
+
+    def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
+        tf_config = {
+            "cluster": self._cluster(job, replicas),
+            "task": {"type": rtype.lower(), "index": index},
+        }
+        return {"TF_CONFIG": json.dumps(tf_config)}
+
+
+class PyTorchJobController(JobController):
+    """PyTorchJob: MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK (DDP rendezvous).
+
+    On the reference this fronts NCCL; here the same env boots
+    ``torch.distributed`` with gloo on localhost, or torch-xla on TPU hosts.
+    """
+
+    kind = "PyTorchJob"
+
+    def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
+        ports = self.ports_of(job)
+        has_master = "Master" in replicas
+        world = sum(r["replicas"] for r in replicas.values())
+        if rtype == "Master":
+            rank = 0
+        else:
+            rank = index + (1 if has_master else 0)
+        return {
+            "MASTER_ADDR": _host(job, "Master" if has_master else "Worker", 0),
+            "MASTER_PORT": str(ports[0]),
+            "WORLD_SIZE": str(world),
+            "RANK": str(rank),
+            "LOCAL_RANK": "0",
+        }
+
+
+class MPIJobController(JobController):
+    """MPIJob: launcher + workers; hostfile-style env for the launcher."""
+
+    kind = "MPIJob"
+
+    def num_ports(self, total: int) -> int:
+        return total
+
+    def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
+        ports = self.ports_of(job)
+        n_workers = replicas.get("Worker", {}).get("replicas", 0)
+        hosts = [f"{_host(job, 'Worker', i)}:{ports[i]}" for i in range(n_workers)]
+        env = {
+            "OMPI_MCA_orte_default_hostfile_contents": "\n".join(hosts),
+            "MPI_HOSTS": ",".join(hosts),
+            "MPI_NUM_WORKERS": str(n_workers),
+        }
+        if rtype == "Worker":
+            env["MPI_WORKER_ID"] = str(index)
+            env["MPI_WORKER_PORT"] = str(ports[index])
+        return env
+
+
+class XGBoostJobController(JobController):
+    """XGBoostJob: rabit/dmlc tracker env."""
+
+    kind = "XGBoostJob"
+
+    def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
+        ports = self.ports_of(job)
+        world = sum(r["replicas"] for r in replicas.values())
+        rank = 0 if rtype == "Master" else index + (1 if "Master" in replicas else 0)
+        return {
+            "DMLC_TRACKER_URI": _host(job, "Master", 0),
+            "DMLC_TRACKER_PORT": str(ports[0]),
+            "DMLC_NUM_WORKER": str(world),
+            "DMLC_TASK_ID": str(rank),
+        }
+
+
+ALL_CONTROLLERS = (
+    TPUJobController,
+    JAXJobController,
+    TFJobController,
+    PyTorchJobController,
+    MPIJobController,
+    XGBoostJobController,
+)
+
+
+def install(api: APIServer, manager) -> list[JobController]:
+    """Register CRDs and attach all training controllers to a Manager."""
+    from . import api as tapi
+
+    tapi.register(api)
+    out = []
+    for cls in ALL_CONTROLLERS:
+        ctrl = cls(api)
+        manager.add(ctrl, owns=("Pod",))
+        out.append(ctrl)
+    return out

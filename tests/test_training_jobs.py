@@ -1,0 +1,232 @@
+"""E2E training-operator tests: real multi-process rendezvous through the
+full reconcile path (SURVEY.md §4: go beyond upstream CI — actually run
+distributed workloads as local processes)."""
+
+import json
+import sys
+
+import pytest
+
+from kubeflow_tpu.core.cluster import Cluster
+from kubeflow_tpu.training import api as tapi
+from kubeflow_tpu.training.api import ReplicaSpec, TPUSpec, job
+from kubeflow_tpu.training.client import TrainingClient
+from kubeflow_tpu.training.frameworks import install
+
+
+@pytest.fixture()
+def tcluster():
+    c = Cluster(cpu_nodes=1, tpu_slices=(("s0", "v5e", "2x4"),))
+    install(c.api, c.manager)
+    yield c
+    c.shutdown()
+
+
+def _client(c):
+    return TrainingClient(c)
+
+
+def test_tpujob_distributed_psum_and_train(tcluster):
+    """TPUJob with 2 workers → real jax.distributed rendezvous + psum."""
+    spec = job(
+        "TPUJob",
+        "distcheck",
+        {"Worker": ReplicaSpec(
+            replicas=2,
+            command=[sys.executable, "-u", "-m", "kubeflow_tpu.examples.distributed_check"],
+            env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo"},
+        )},
+    )
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("TPUJob", "distcheck", timeout=180) == tapi.SUCCEEDED
+    logs = client.get_job_logs("TPUJob", "distcheck")
+    assert len(logs) == 2
+    joined = "\n".join(logs.values())
+    assert "PSUM got=3.0 expected=3.0" in joined
+    assert "TRAIN-OK" in joined
+
+
+def test_tpujob_env_injection_and_gang(tcluster):
+    """spec.tpu drives replica expansion, placement + rendezvous env."""
+    spec = job(
+        "TPUJob",
+        "envjob",
+        {"Worker": ReplicaSpec(
+            command=[sys.executable, "-u", "-c",
+                     "import os, json; print(json.dumps({k: v for k, v in os.environ.items() if k.startswith(('JAX_', 'TPU_', 'MEGASCALE_'))}))"],
+        )},
+        tpu=TPUSpec(accelerator="v5e", topology="2x4"),  # 8 chips → 2 hosts
+    )
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("TPUJob", "envjob", timeout=60) == tapi.SUCCEEDED
+
+    pods = [tcluster.api.get("Pod", f"envjob-worker-{i}") for i in range(2)]
+    # gang: both pods on the TPU slice, distinct hosts
+    assert {p["spec"]["nodeName"] for p in pods} == {"s0-host-0", "s0-host-1"}
+    # PodGroup created and bound
+    assert tcluster.api.get("PodGroup", "envjob")["status"]["phase"] == "Running"
+
+    for i, p in enumerate(pods):
+        # runtime env (NOTE: this sandbox's TPU tunnel sitecustomize rewrites
+        # TPU_TOPOLOGY at interpreter start, so TPU_* fidelity is asserted on
+        # the pod spec — the real kubelet surface — below)
+        envs = json.loads(tcluster.logs(f"envjob-worker-{i}").strip().splitlines()[-1])
+        assert envs["JAX_NUM_PROCESSES"] == "2"
+        assert envs["JAX_PROCESS_ID"] == str(i)
+        assert envs["JAX_COORDINATOR_ADDRESS"].startswith("127.0.0.1:")
+        assert "MEGASCALE_NUM_SLICES" not in envs  # single slice
+        spec_env = {e["name"]: e["value"] for e in p["spec"]["containers"][0]["env"]}
+        assert spec_env["TPU_TOPOLOGY"] == "2x4"
+        assert spec_env["TPU_ACCELERATOR_TYPE"] == "tpu-v5-lite-podslice"
+        assert spec_env["TPU_CHIPS_PER_HOST"] == "4"
+
+
+def test_tpujob_multislice_megascale_env(tcluster):
+    spec = job(
+        "TPUJob",
+        "ms",
+        {"Worker": ReplicaSpec(
+            command=[sys.executable, "-u", "-c",
+                     "import os; print(os.environ.get('MEGASCALE_SLICE_ID'), os.environ.get('MEGASCALE_NUM_SLICES'), os.environ.get('JAX_NUM_PROCESSES'))"],
+        )},
+        tpu=TPUSpec(accelerator="v5e", topology="2x2", num_slices=2),  # 1 host/slice × 2
+    )
+    # no second slice exists → pods can't all gang-place on one slice; but
+    # multislice jobs place per-slice. For the sim we only check env, so run
+    # on CPU nodes by dropping the nodeSelector: use a cluster w/ two slices.
+    c = Cluster(cpu_nodes=0, tpu_slices=(("a", "v5e", "2x2"), ("b", "v5e", "2x2")))
+    install(c.api, c.manager)
+    try:
+        client = TrainingClient(c)
+        client.create_job(spec)
+        assert client.wait_for_job("TPUJob", "ms", timeout=60) == tapi.SUCCEEDED
+        out = {i: c.logs(f"ms-worker-{i}").split() for i in range(2)}
+        assert out[0][:3] == ["0", "2", "2"]
+        assert out[1][:3] == ["1", "2", "2"]
+    finally:
+        c.shutdown()
+
+
+def test_tfjob_tf_config(tcluster):
+    spec = job(
+        "TFJob",
+        "tfj",
+        {
+            "PS": ReplicaSpec(command=[sys.executable, "-u", "-c", "import os; print(os.environ['TF_CONFIG'])"]),
+            "Worker": ReplicaSpec(replicas=2, command=[sys.executable, "-u", "-c", "import os; print(os.environ['TF_CONFIG'])"]),
+        },
+    )
+    client = _client(tcluster)
+    client.create_job(spec)
+    # no Chief → success = all workers done; PS runs a finite cmd here too
+    assert client.wait_for_job("TFJob", "tfj", timeout=60) == tapi.SUCCEEDED
+    cfg = json.loads(tcluster.logs("tfj-worker-1").strip())
+    assert cfg["task"] == {"type": "worker", "index": 1}
+    assert len(cfg["cluster"]["worker"]) == 2
+    assert len(cfg["cluster"]["ps"]) == 1
+    # distinct ports across the cluster spec
+    all_addrs = [a for addrs in cfg["cluster"].values() for a in addrs]
+    assert len(set(all_addrs)) == 3
+
+
+def test_pytorchjob_real_gloo_allreduce(tcluster):
+    code = (
+        "import os, datetime, torch, torch.distributed as dist\n"
+        "dist.init_process_group('gloo', timeout=datetime.timedelta(seconds=60))\n"
+        "t = torch.tensor([float(dist.get_rank() + 1)])\n"
+        "dist.all_reduce(t)\n"
+        "print('ALLREDUCE', t.item(), 'world', dist.get_world_size())\n"
+    )
+    spec = job(
+        "PyTorchJob",
+        "ptj",
+        {
+            "Master": ReplicaSpec(command=[sys.executable, "-u", "-c", code]),
+            "Worker": ReplicaSpec(command=[sys.executable, "-u", "-c", code]),
+        },
+    )
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("PyTorchJob", "ptj", timeout=120) == tapi.SUCCEEDED
+    assert "ALLREDUCE 3.0 world 2" in tcluster.logs("ptj-master-0")
+
+
+def test_exitcode_restart_policy(tcluster, tmp_path):
+    """exit 137 (SIGKILL/preemption) is retryable; pod is recreated."""
+    marker = str(tmp_path / "marker")
+    code = (
+        "import os, sys\n"
+        f"m = {marker!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close(); sys.exit(137)\n"
+        "print('RECOVERED')\n"
+    )
+    spec = job(
+        "TPUJob",
+        "pre",
+        {"Worker": ReplicaSpec(command=[sys.executable, "-u", "-c", code], restart_policy="ExitCode")},
+    )
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("TPUJob", "pre", timeout=60) == tapi.SUCCEEDED
+    j = client.get_job("TPUJob", "pre")
+    assert j["status"]["restartCount"] == 1
+    assert "RECOVERED" in tcluster.logs("pre-worker-0")
+
+
+def test_exitcode_permanent_failure(tcluster):
+    spec = job(
+        "TPUJob",
+        "perm",
+        {"Worker": ReplicaSpec(command=[sys.executable, "-c", "import sys; sys.exit(2)"],
+                               restart_policy="ExitCode")},
+    )
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("TPUJob", "perm", timeout=60) == tapi.FAILED
+    j = client.get_job("TPUJob", "perm")
+    from kubeflow_tpu.core.conditions import get_condition
+    assert "exit code 2" in get_condition(j["status"], tapi.FAILED)["message"]
+
+
+def test_backoff_limit(tcluster):
+    spec = job(
+        "TPUJob",
+        "loop",
+        {"Worker": ReplicaSpec(command=[sys.executable, "-c", "import sys; sys.exit(137)"],
+                               restart_policy="ExitCode")},
+        run_policy={"backoffLimit": 1},
+    )
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("TPUJob", "loop", timeout=60) == tapi.FAILED
+    assert client.get_job("TPUJob", "loop")["status"]["restartCount"] == 1
+
+
+def test_clean_pod_policy_and_ttl(tcluster):
+    spec = job(
+        "TPUJob",
+        "clean",
+        {"Worker": ReplicaSpec(command=[sys.executable, "-c", "print('ok')"])},
+        run_policy={"cleanPodPolicy": "All", "ttlSecondsAfterFinished": 1},
+    )
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("TPUJob", "clean", timeout=60) == tapi.SUCCEEDED
+    # pods cleaned
+    assert tcluster.wait_for(
+        lambda: not tcluster.api.list("Pod", label_selector={tapi.LABEL_JOB_NAME: "clean"}),
+        timeout=30,
+    )
+    # TTL deletes the job itself
+    assert tcluster.wait_for(lambda: client.get_job("TPUJob", "clean") is None, timeout=30)
+
+
+def test_job_validation_rejects_bad_spec(tcluster):
+    from kubeflow_tpu.core.api import Invalid
+    bad = job("TFJob", "bad", {"Worker": ReplicaSpec(command=["true"])})
+    bad["spec"]["replicaSpecs"]["Bogus"] = bad["spec"]["replicaSpecs"].pop("Worker")
+    with pytest.raises(Invalid):
+        tcluster.api.create(bad)
