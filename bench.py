@@ -1,0 +1,83 @@
+"""Benchmark: BERT-base MLM training step on the real TPU chip.
+
+Prints ONE JSON line: samples/sec/chip + MFU for the primary metric
+(BASELINE.md: "TPUJob samples/sec/chip (BERT-base)"; reference publishes no
+numbers — "establish" — so vs_baseline is reported against the harness's own
+first established value, 1.0 by definition this round).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+
+    from kubeflow_tpu.models import bert
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.scheduler.topology import VARIANTS
+    from kubeflow_tpu.train.data import synthetic_mlm_batches
+    from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    n_chips = len(devices)
+    mesh = build_mesh(MeshConfig(data=1, fsdp=n_chips, tensor=1), devices)
+
+    config = bert.BertConfig(remat=on_tpu)  # BERT-base, seq 128 (phase-1 pretrain shape)
+    seq_len = 128
+    max_predictions = 20  # standard BERT masking budget for seq 128
+    batch_size = 1024 * n_chips if on_tpu else 8
+    steps = 10 if on_tpu else 2
+
+    params = bert.init(jax.random.PRNGKey(0), config)
+
+    def loss_fn(p, b):
+        return bert.mlm_loss(p, config, b["input_ids"], b["labels"], b["attention_mask"],
+                             max_predictions=max_predictions)
+
+    flops_per_batch = config.train_flops(batch_size, seq_len, max_predictions)
+    trainer = Trainer(
+        loss_fn, params, mesh, bert.SHARDING_RULES,
+        TrainerConfig(learning_rate=1e-4, warmup_steps=2, total_steps=steps + 4),
+        flops_per_batch=flops_per_batch,
+    )
+
+    data = synthetic_mlm_batches(config.vocab_size, batch_size, seq_len)
+    # warmup (compile)
+    for _ in range(2):
+        trainer.train_step(next(data))
+    trainer.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.train_step(next(data))
+    trainer.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    samples_per_sec_per_chip = batch_size * steps / dt / n_chips
+    peak = VARIANTS["v5e"].flops_bf16 if on_tpu else 1.0
+    mfu = (flops_per_batch * steps / dt) / (n_chips * peak) if on_tpu else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_mlm_samples_per_sec_per_chip",
+                "value": round(samples_per_sec_per_chip, 2),
+                "unit": "samples/s/chip",
+                "vs_baseline": 1.0,
+                "mfu": round(mfu, 4),
+                "batch_size": batch_size,
+                "seq_len": seq_len,
+                "n_chips": n_chips,
+                "platform": devices[0].platform,
+                "step_time_ms": round(1000 * dt / steps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,227 @@
+"""BERT flagship: pure-functional JAX encoder with MLM head.
+
+The platform's north-star training workload (BASELINE.json: "train BERT-base
+on a v5e-16 slice at >=90% reference MFU").  TPU-first choices:
+
+  * layers stacked on a leading axis + ``lax.scan`` — one traced layer,
+    O(1) compile time, remat-friendly;
+  * params fp32 masters, compute in bf16 (MXU-native);
+  * sharding via path rules (parallel/sharding.py): fsdp shards the embed
+    dim, tensor shards heads/ffn, so the same model runs 1-chip or v5e-16
+    by changing only the MeshConfig;
+  * embedding tied to the MLM output projection.
+
+Upstream parity note: the reference platform carries no model code at all
+(SURVEY.md §0 — Kubeflow schedules other people's training code); this model
+family is the workload layer the TPU rebuild must add (SURVEY.md §5
+"long-context ... workload-layer feature we must add").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import multihead_attention, padding_mask
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: jnp.dtype = jnp.bfloat16
+    # rematerialize each encoder layer in backward (trade ~1/3 more FLOPs for
+    # O(L) → O(1) activation memory; lets batch 256 fit one v5e chip)
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_params(self) -> int:
+        h, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        per_layer = 4 * h * h + 2 * h * f + 9 * h + f  # qkv/o + ffn kernels, biases, 2 lns
+        embed = (v + self.max_position + self.type_vocab_size) * h + 2 * h
+        head = h * h + h + 2 * h + v  # transform + ln + bias (embedding tied)
+        return self.num_layers * per_layer + embed + head
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Fwd+bwd matmul FLOPs per token (6ND + attention term), full head."""
+        h, f, l = self.hidden_size, self.intermediate_size, self.num_layers
+        matmul_params = l * (4 * h * h + 2 * h * f) + self.hidden_size * self.vocab_size
+        attn = l * 2 * 2 * seq_len * h  # QK^T + PV per token
+        return 6 * (matmul_params + attn / 2)
+
+    def train_flops(self, batch: int, seq_len: int, num_predictions: Optional[int] = None) -> float:
+        """Fwd+bwd matmul FLOPs for one batch; MLM head on P positions only."""
+        h, f, l, v = self.hidden_size, self.intermediate_size, self.num_layers, self.vocab_size
+        p = seq_len if num_predictions is None else num_predictions
+        encoder = l * (4 * h * h + 2 * h * f) * seq_len
+        attn = l * 2 * seq_len * seq_len * h
+        head = (h * h + h * v) * p
+        return 6 * batch * (encoder + attn + head)
+
+
+# ----------------------------------------------------------------- sharding
+
+SHARDING_RULES = (
+    # embeddings: vocab on tensor, embed on fsdp
+    (r"embeddings/(word|position|type)", P("tensor", "fsdp")),
+    (r"embeddings/ln_", P()),
+    # attention: qkv fused kernel [h, 3, nh, hd] → heads on tensor
+    (r"layers/attn_qkv_kernel", P("fsdp", None, "tensor", None)),
+    (r"layers/attn_qkv_bias", P(None, "tensor", None)),
+    (r"layers/attn_out_kernel", P("tensor", None, "fsdp")),
+    # mlp: ffn dim on tensor
+    (r"layers/mlp_in_kernel", P("fsdp", "tensor")),
+    (r"layers/mlp_in_bias", P("tensor")),
+    (r"layers/mlp_out_kernel", P("tensor", "fsdp")),
+    # everything else (lns, small biases): replicated
+    (r".*", P()),
+)
+
+
+# --------------------------------------------------------------------- init
+
+def init(key: jax.Array, config: BertConfig) -> dict:
+    h, f = config.hidden_size, config.intermediate_size
+    l, nh, hd = config.num_layers, config.num_heads, config.head_dim
+    k = iter(jax.random.split(key, 16))
+
+    def dense(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape) * scale).astype(jnp.float32)
+
+    return {
+        "embeddings": {
+            "word": dense(next(k), (config.vocab_size, h)),
+            "position": dense(next(k), (config.max_position, h)),
+            "type": dense(next(k), (config.type_vocab_size, h)),
+            "ln_scale": jnp.ones((h,), jnp.float32),
+            "ln_bias": jnp.zeros((h,), jnp.float32),
+        },
+        # layer-stacked params: leading dim = num_layers (for lax.scan)
+        "layers": {
+            "attn_qkv_kernel": dense(next(k), (l, h, 3, nh, hd)),
+            "attn_qkv_bias": jnp.zeros((l, 3, nh, hd), jnp.float32),
+            "attn_out_kernel": dense(next(k), (l, nh, hd, h)),
+            "attn_out_bias": jnp.zeros((l, h), jnp.float32),
+            "ln1_scale": jnp.ones((l, h), jnp.float32),
+            "ln1_bias": jnp.zeros((l, h), jnp.float32),
+            "mlp_in_kernel": dense(next(k), (l, h, f)),
+            "mlp_in_bias": jnp.zeros((l, f), jnp.float32),
+            "mlp_out_kernel": dense(next(k), (l, f, h)),
+            "mlp_out_bias": jnp.zeros((l, h), jnp.float32),
+            "ln2_scale": jnp.ones((l, h), jnp.float32),
+            "ln2_bias": jnp.zeros((l, h), jnp.float32),
+        },
+        "mlm": {
+            "transform_kernel": dense(next(k), (h, h)),
+            "transform_bias": jnp.zeros((h,), jnp.float32),
+            "ln_scale": jnp.ones((h,), jnp.float32),
+            "ln_bias": jnp.zeros((h,), jnp.float32),
+            "output_bias": jnp.zeros((config.vocab_size,), jnp.float32),
+        },
+    }
+
+
+# ------------------------------------------------------------------ forward
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+    return out.astype(x.dtype)
+
+
+def encode(params: dict, config: BertConfig, input_ids: jax.Array,
+           attention_mask: Optional[jax.Array] = None,
+           token_type_ids: Optional[jax.Array] = None) -> jax.Array:
+    """[B, S] ids → [B, S, H] hidden states."""
+    dt = config.dtype
+    emb = params["embeddings"]
+    b, s = input_ids.shape
+    x = emb["word"][input_ids]
+    x = x + emb["position"][None, :s]
+    if token_type_ids is not None:
+        x = x + emb["type"][token_type_ids]
+    else:
+        x = x + emb["type"][0]
+    x = _layer_norm(x.astype(dt), emb["ln_scale"], emb["ln_bias"], config.layer_norm_eps)
+
+    mask = padding_mask(attention_mask) if attention_mask is not None else None
+
+    def layer(x, lp):
+        xn = x
+        qkv = jnp.einsum("bsh,hknd->bsknd", xn, lp["attn_qkv_kernel"].astype(dt))
+        qkv = qkv + lp["attn_qkv_bias"].astype(dt)
+        q, k_, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = multihead_attention(q, k_, v, mask=mask)
+        attn = jnp.einsum("bsnd,ndh->bsh", attn, lp["attn_out_kernel"].astype(dt))
+        attn = attn + lp["attn_out_bias"].astype(dt)
+        x = _layer_norm(x + attn, lp["ln1_scale"], lp["ln1_bias"], config.layer_norm_eps)
+
+        hmid = jnp.einsum("bsh,hf->bsf", x, lp["mlp_in_kernel"].astype(dt))
+        hmid = jax.nn.gelu(hmid + lp["mlp_in_bias"].astype(dt))
+        hout = jnp.einsum("bsf,fh->bsh", hmid, lp["mlp_out_kernel"].astype(dt))
+        hout = hout + lp["mlp_out_bias"].astype(dt)
+        x = _layer_norm(x + hout, lp["ln2_scale"], lp["ln2_bias"], config.layer_norm_eps)
+        return x, None
+
+    if config.remat:
+        layer = jax.checkpoint(layer, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return x
+
+
+def mlm_logits(params: dict, config: BertConfig, hidden: jax.Array) -> jax.Array:
+    """MLM head with tied embeddings: [B, S, H] → [B, S, V]."""
+    dt = config.dtype
+    mlm = params["mlm"]
+    h = jnp.einsum("bsh,hk->bsk", hidden, mlm["transform_kernel"].astype(dt))
+    h = jax.nn.gelu(h + mlm["transform_bias"].astype(dt))
+    h = _layer_norm(h, mlm["ln_scale"], mlm["ln_bias"], config.layer_norm_eps)
+    logits = jnp.einsum("bsh,vh->bsv", h, params["embeddings"]["word"].astype(dt))
+    return logits + mlm["output_bias"].astype(dt)
+
+
+def forward(params: dict, config: BertConfig, input_ids: jax.Array,
+            attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    return mlm_logits(params, config, encode(params, config, input_ids, attention_mask))
+
+
+def mlm_loss(params: dict, config: BertConfig, input_ids: jax.Array,
+             labels: jax.Array, attention_mask: Optional[jax.Array] = None,
+             max_predictions: Optional[int] = None) -> jax.Array:
+    """Masked-LM cross entropy; positions with label == -100 are ignored.
+
+    ``max_predictions``: gather only (up to) P masked positions per sequence
+    before the vocab projection — the [B, S, V] logits tensor becomes
+    [B, P, V] (~6x less HBM and vocab-matmul FLOPs at 15% masking; standard
+    BERT pretraining uses P=20 for seq 128).
+    """
+    hidden = encode(params, config, input_ids, attention_mask)
+    valid = labels != -100
+    if max_predictions is not None:
+        # indices of masked positions, padded with unmasked (weight-0) slots
+        weights, idx = jax.lax.top_k(valid.astype(jnp.int32), max_predictions)
+        hidden = jnp.take_along_axis(hidden, idx[..., None], axis=1)
+        labels = jnp.take_along_axis(labels, idx, axis=1)
+        valid = weights.astype(bool)
+    logits = mlm_logits(params, config, hidden).astype(jnp.float32)
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(valid.sum(), 1)
+    return (token_loss * valid).sum() / denom

@@ -1,0 +1,52 @@
+"""Attention ops, written for the MXU.
+
+Design (pallas_guide-informed): keep the contraction shapes large and static,
+let XLA fuse softmax into the matmuls; heads ride the ``tensor`` mesh axis via
+the models' sharding rules, sequence rides ``seq``.  A Pallas flash-attention
+kernel (ops/flash_attention.py) plugs in behind the same signature for long
+sequences; ring attention (ops/ring_attention.py) extends it across the ICI
+ring for context parallelism.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # bf16-safe large negative (not -inf: softmax of all-masked rows)
+
+
+def multihead_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, T, H, D]
+    v: jax.Array,  # [B, T, H, D]
+    mask: Optional[jax.Array] = None,  # broadcastable to [B, H, S, T]; True = attend
+    causal: bool = False,
+) -> jax.Array:
+    """Plain softmax attention over [batch, seq, heads, head_dim] tensors."""
+    *_, s, h, d = q.shape
+    t = k.shape[1]
+    scale = d ** -0.5
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((s, t), dtype=bool))
+        logits = jnp.where(causal_mask[None, None], logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    # softmax in fp32 for stability, output back in input dtype
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def attention_flops(batch: int, seq: int, heads: int, head_dim: int, causal: bool = False) -> float:
+    """Matmul FLOPs of one attention call (fwd only): QK^T + PV."""
+    f = 2 * 2 * batch * heads * seq * seq * head_dim
+    return f / 2 if causal else f
+
+
+def padding_mask(attention_mask: jax.Array) -> jax.Array:
+    """[B, T] {0,1} token mask → [B, 1, 1, T] broadcastable boolean."""
+    return attention_mask[:, None, None, :].astype(bool)

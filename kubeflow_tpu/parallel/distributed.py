@@ -47,15 +47,19 @@ def initialize(local_device_count: Optional[int] = None) -> ProcessEnv:
     host exposes 4 without any flag).
     """
     penv = read_env()
-    if local_device_count is not None:
-        kept = [
-            f
-            for f in os.environ.get("XLA_FLAGS", "").split()
-            if "xla_force_host_platform_device_count" not in f
-        ]
-        kept.append(f"--xla_force_host_platform_device_count={local_device_count}")
-        os.environ["XLA_FLAGS"] = " ".join(kept)
     import jax
+
+    if local_device_count is not None:
+        # config (not env): some sandboxes pre-set jax_platforms at interpreter
+        # start via sitecustomize, which masks JAX_PLATFORMS/XLA_FLAGS env vars.
+        # Best-effort: raises only inside jax.config if backends already
+        # initialized — in that case keep the existing device set.
+        try:
+            if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+                jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", local_device_count)
+        except RuntimeError:
+            pass  # backends already initialized; device count is fixed
 
     if penv.is_distributed:
         jax.distributed.initialize(

@@ -1,0 +1,74 @@
+"""Device mesh construction: the TPU-first scaling substrate.
+
+The reference platform never looks inside the model (SURVEY.md §2c: TP/PP/SP
+are user-code there).  Here parallelism is a first-class framework layer:
+one ``Mesh`` with named axes, models annotated with logical shardings, XLA
+inserts the collectives (scaling-book recipe: pick a mesh, annotate, let XLA
+insert collectives over ICI/DCN).
+
+Axis convention (MaxText-style):
+  data   — pure data parallel, laid across DCN (between slices)
+  fsdp   — ZeRO-3-style sharded data parallel, within a slice over ICI
+  tensor — tensor/model parallel (Megatron-style), innermost over ICI
+  seq    — sequence/context parallel (ring attention rides this axis)
+  expert — MoE expert parallel
+  stages — pipeline stages (sub-meshes per slice block)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "stages", "fsdp", "seq", "expert", "tensor")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Sizes per axis; -1 on at most one axis means "absorb remaining devices"."""
+
+    data: int = 1
+    stages: int = 1
+    fsdp: int = -1
+    seq: int = 1
+    expert: int = 1
+    tensor: int = 1
+
+    def sizes(self, n_devices: int) -> dict[str, int]:
+        vals = {a: getattr(self, a) for a in AXES}
+        fills = [a for a, v in vals.items() if v == -1]
+        if len(fills) > 1:
+            raise ValueError(f"at most one -1 axis, got {fills}")
+        fixed = math.prod(v for v in vals.values() if v != -1)
+        if fills:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by fixed axes {fixed}")
+            vals[fills[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {vals} needs {fixed} devices, have {n_devices}")
+        return vals
+
+
+def build_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
+    """Build the global mesh.
+
+    Axis order puts ``data`` outermost (slowest-varying → DCN-friendly) and
+    ``tensor`` innermost (fastest-varying → adjacent chips on the ICI torus),
+    matching how ``jax.devices()`` orders a slice.
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.sizes(len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    d = device if device is not None else jax.devices()[0]
+    return Mesh(np.array([d]).reshape((1,) * len(AXES)), AXES)

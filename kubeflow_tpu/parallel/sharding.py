@@ -1,0 +1,86 @@
+"""Path-rule sharding: map parameter pytree paths to PartitionSpecs.
+
+GSPMD style: models ship a list of ``(path-regex, PartitionSpec)`` rules;
+``shard_params`` resolves every leaf to a ``NamedSharding`` on the mesh.  XLA
+then inserts all-gathers/reduce-scatters for fsdp, all-reduces for tensor —
+no hand-written collectives in model code (SURVEY.md §2c TP/SP rows).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Iterable[tuple[str, P]]
+
+
+def path_of(keypath) -> str:
+    parts = []
+    for k in keypath:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_for(path: str, rules: Rules, ndim: int) -> P:
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            tup = tuple(spec)
+            if len(tup) < ndim:  # pad leading dims (e.g. scan-stacked layers)
+                tup = (None,) * (ndim - len(tup)) + tup
+            return P(*tup)
+    return P()
+
+
+def tree_specs(tree: Any, rules: Rules) -> Any:
+    """PartitionSpec pytree matching ``tree``'s structure."""
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, leaf: spec_for(path_of(kp), rules, getattr(leaf, "ndim", 0)), tree
+    )
+
+
+def sanitize_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Drop axes from dims they don't divide (e.g. vocab 30522 on tensor=4) —
+    the MaxText-style alternative is padding; replication is the safe default."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for d, entry in enumerate(tuple(spec)):
+        if entry is None or d >= len(shape):
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        total = 1
+        for a in axes:
+            total *= sizes.get(a, 1)
+        out.append(entry if shape[d] % total == 0 else None)
+    return P(*out)
+
+
+def tree_shardings(tree: Any, mesh: Mesh, rules: Rules) -> Any:
+    specs = tree_specs(tree, rules)
+    return jax.tree.map(
+        lambda leaf, s: NamedSharding(mesh, sanitize_spec(s, getattr(leaf, "shape", ()), mesh)),
+        tree,
+        specs,
+    )
+
+
+def shard_params(params: Any, mesh: Mesh, rules: Rules) -> Any:
+    """Device-put every leaf with its resolved NamedSharding."""
+    return jax.device_put(params, tree_shardings(params, mesh, rules))
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """Input batch sharding: batch dim over (data, fsdp)."""
+    return P(("data", "fsdp"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh))

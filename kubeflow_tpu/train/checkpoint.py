@@ -1,0 +1,51 @@
+"""Orbax-backed checkpointing: async save, latest-step resume.
+
+SURVEY.md §5: the reference platform leaves checkpointing to user code; here
+it is first-class so gang restarts (slice preemption = whole-slice restart)
+resume deterministically from step N.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, async_save: bool = True):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._ocp = ocp
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=3, enable_async_checkpointing=async_save
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self._mngr.save(step, args=self._ocp.args.StandardSave(state))
+
+    def restore_latest(self, like: Any) -> Optional[dict]:
+        """Restore newest checkpoint with structure/sharding of ``like``."""
+        step = self._mngr.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "shape")
+            else x,
+            like,
+        )
+        state = self._mngr.restore(step, args=self._ocp.args.StandardRestore(abstract))
+        return {"step": step, "state": state}
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()

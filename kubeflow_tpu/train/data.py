@@ -1,0 +1,57 @@
+"""Data loading: per-host sharded batches.
+
+SURVEY.md §2c DP row: each host loads its shard; ``global_batch`` assembles a
+globally-sharded array from process-local data (multi-host), or device_puts
+directly (single host).  Synthetic generators stand in for storage-backed
+datasets in the simulator.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def synthetic_mlm_batches(
+    vocab_size: int,
+    batch_size: int,
+    seq_len: int,
+    mask_prob: float = 0.15,
+    mask_token: int = 103,
+    seed: int = 0,
+) -> Iterator[dict]:
+    """Deterministic synthetic MLM stream: (input_ids, labels, attention_mask)."""
+    rng = np.random.default_rng(seed)
+    low = min(mask_token + 1, vocab_size - 1)
+    while True:
+        ids = rng.integers(low, vocab_size, size=(batch_size, seq_len), dtype=np.int32)
+        mask = rng.random((batch_size, seq_len)) < mask_prob
+        labels = np.where(mask, ids, -100).astype(np.int32)
+        input_ids = np.where(mask, mask_token, ids).astype(np.int32)
+        yield {
+            "input_ids": input_ids,
+            "labels": labels,
+            "attention_mask": np.ones((batch_size, seq_len), np.int32),
+        }
+
+
+def host_shard(global_batch_size: int) -> tuple[int, int]:
+    """(local_batch_size, offset) for this process."""
+    n = jax.process_count()
+    if global_batch_size % n:
+        raise ValueError(f"global batch {global_batch_size} not divisible by {n} hosts")
+    local = global_batch_size // n
+    return local, local * jax.process_index()
+
+
+def global_batch(local_batch: dict, mesh: Mesh) -> dict:
+    """Assemble a globally-sharded batch from per-process local arrays."""
+    sharding = NamedSharding(mesh, P(("data", "fsdp")))
+    if jax.process_count() == 1:
+        return jax.device_put(local_batch, sharding)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)), local_batch
+    )

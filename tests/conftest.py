@@ -1,17 +1,25 @@
-"""Test env: force JAX onto a virtual 8-device CPU mesh BEFORE jax imports.
+"""Test env: force JAX onto a virtual 8-device CPU mesh.
 
-SURVEY.md §7: multi-chip sharding is validated on
-``--xla_force_host_platform_device_count=8`` CPU devices; the real single TPU
-chip is reserved for bench.py.
+SURVEY.md §7: multi-chip sharding is validated on 8 virtual CPU devices; the
+real single TPU chip is reserved for bench.py.
+
+Environment gotcha (this sandbox): the axon TPU-tunnel sitecustomize calls
+``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter start, so
+the ``JAX_PLATFORMS`` env var is ignored — the override must go through
+jax.config too, before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+# subprocess pods inherit these; their interpreters get the same sitecustomize,
+# so workload code must ALSO route through kubeflow_tpu.parallel.distributed.initialize
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
