@@ -24,6 +24,18 @@ def main() -> None:
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
     n_chips = len(devices)
+    # map the actual chip generation to its peak (device_kind e.g. "TPU v5 lite")
+    kind = getattr(devices[0], "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        variant = "v5e"
+    elif "v6" in kind:
+        variant = "v6e"
+    elif "v5" in kind:
+        variant = "v5p"
+    elif "v4" in kind:
+        variant = "v4"
+    else:
+        variant = "v5e"
     mesh = build_mesh(MeshConfig(data=1, fsdp=n_chips, tensor=1), devices)
 
     config = bert.BertConfig(remat=on_tpu)  # BERT-base, seq 128 (phase-1 pretrain shape)
@@ -46,19 +58,22 @@ def main() -> None:
     )
 
     data = synthetic_mlm_batches(config.vocab_size, batch_size, seq_len)
-    # warmup (compile)
+    # warmup (compile); fence with a VALUE fetch — under some remote-execution
+    # tunnels block_until_ready returns before the work drains, a value fetch
+    # is a true data dependency
     for _ in range(2):
-        trainer.train_step(next(data))
-    trainer.block_until_ready()
+        m = trainer.train_step(next(data), sync=False)
+    float(m["loss"])
 
+    # async hot loop: dispatch overlaps compute; time the whole window
     t0 = time.perf_counter()
     for _ in range(steps):
-        trainer.train_step(next(data))
-    trainer.block_until_ready()
+        m = trainer.train_step(next(data), sync=False)
+    final_loss = float(m["loss"])
     dt = time.perf_counter() - t0
 
     samples_per_sec_per_chip = batch_size * steps / dt / n_chips
-    peak = VARIANTS["v5e"].flops_bf16 if on_tpu else 1.0
+    peak = VARIANTS[variant].flops_bf16 if on_tpu else 1.0
     mfu = (flops_per_batch * steps / dt) / (n_chips * peak) if on_tpu else 0.0
 
     print(

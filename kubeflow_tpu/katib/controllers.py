@@ -1,0 +1,320 @@
+"""Katib controllers: Experiment → Suggestion → Trial → training job.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §3.3): the experiment controller
+creates a Suggestion and Trials until goal/maxTrials; the trial controller
+renders the trialTemplate into a real job (full §3.1 stack nested) and reads
+metrics; the suggestion controller serves parameter assignments.
+
+Deviations from upstream, by design of the simulator:
+  * suggestion algorithms run in-process at reconcile time instead of in a
+    per-algorithm gRPC service pod (same request/response contract);
+  * metrics are pulled from kubelet logs at trial completion instead of
+    pushed by an injected sidecar (same StdOut parse rules + observation
+    schema) — see metrics.py.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+from typing import Callable, Optional
+
+from ..core.api import AlreadyExists, APIServer, Obj, owner_reference
+from ..core.conditions import has_condition, set_condition
+from ..core.controller import Request, Result
+from ..core.events import EventRecorder
+from ..training import api as tapi
+from . import api as kapi
+from .metrics import observation
+from .suggest import get_suggester
+
+_PLACEHOLDER = re.compile(r"\$\{trialParameters\.([\w\-]+)\}")
+
+
+def render_trial_spec(template: dict, assignments: dict) -> dict:
+    """Substitute ``${trialParameters.x}`` through the whole spec tree."""
+    trial_params = {p["name"]: p["reference"] for p in template.get("trialParameters", [])}
+
+    def sub(v):
+        if isinstance(v, str):
+            def repl(m):
+                pname = m.group(1)
+                ref = trial_params.get(pname, pname)
+                if ref not in assignments:
+                    raise KeyError(f"trial parameter {pname!r} (ref {ref!r}) has no assignment")
+                return str(assignments[ref])
+
+            return _PLACEHOLDER.sub(repl, v)
+        if isinstance(v, dict):
+            return {k: sub(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [sub(x) for x in v]
+        return v
+
+    return sub(copy.deepcopy(template["trialSpec"]))
+
+
+class ExperimentController:
+    kind = "Experiment"
+
+    def __init__(self, api: APIServer):
+        self.api = api
+        self.recorder = EventRecorder(api, "katib-experiment-controller")
+
+    def _trials(self, exp: Obj) -> list[Obj]:
+        return self.api.list(
+            "Trial",
+            namespace=exp["metadata"].get("namespace", "default"),
+            label_selector={kapi.LABEL_EXPERIMENT: exp["metadata"]["name"]},
+        )
+
+    def _optimal(self, exp: Obj, trials: list[Obj]) -> Optional[dict]:
+        metric = exp["spec"]["objective"]["objectiveMetricName"]
+        sign = 1.0 if exp["spec"]["objective"]["type"] == "maximize" else -1.0
+        best, best_val = None, None
+        for t in trials:
+            if not has_condition(t.get("status", {}), kapi.SUCCEEDED):
+                continue
+            for m in t.get("status", {}).get("observation", {}).get("metrics", []):
+                if m["name"] == metric:
+                    v = sign * float(m["latest"])
+                    if best_val is None or v > best_val:
+                        best_val = v
+                        best = {
+                            "bestTrialName": t["metadata"]["name"],
+                            "parameterAssignments": t["spec"].get("parameterAssignments", []),
+                            "observation": t["status"]["observation"],
+                        }
+        return best
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        exp = self.api.try_get("Experiment", req.name, req.namespace)
+        if exp is None:
+            return None
+        status = exp.setdefault("status", {})
+        if has_condition(status, kapi.SUCCEEDED) or has_condition(status, kapi.FAILED):
+            return None
+        if not has_condition(status, kapi.CREATED):
+            set_condition(status, kapi.CREATED, "True", "ExperimentCreated", "")
+            self.recorder.normal(exp, "Created", "experiment accepted")
+
+        spec = exp["spec"]
+        trials = self._trials(exp)
+        succeeded = [t for t in trials if has_condition(t.get("status", {}), kapi.SUCCEEDED)]
+        failed = [t for t in trials if has_condition(t.get("status", {}), kapi.FAILED)]
+        active = [t for t in trials if t not in succeeded and t not in failed]
+
+        status["trials"] = len(trials)
+        status["trialsSucceeded"] = len(succeeded)
+        status["trialsFailed"] = len(failed)
+        status["trialsRunning"] = len(active)
+        optimal = self._optimal(exp, trials)
+        if optimal:
+            status["currentOptimalTrial"] = optimal
+
+        # terminal conditions
+        goal = spec["objective"].get("goal")
+        metric_reached = False
+        if goal is not None and optimal:
+            sign = 1.0 if spec["objective"]["type"] == "maximize" else -1.0
+            for m in optimal["observation"]["metrics"]:
+                if m["name"] == spec["objective"]["objectiveMetricName"]:
+                    metric_reached = sign * float(m["latest"]) >= sign * float(goal)
+        if len(failed) > spec["maxFailedTrialCount"]:
+            set_condition(status, kapi.FAILED, "True", "TooManyFailedTrials",
+                          f"{len(failed)} trials failed")
+            self.recorder.warning(exp, "Failed", "too many failed trials")
+            self.api.update_status(exp)
+            return None
+        if metric_reached or len(succeeded) >= spec["maxTrialCount"]:
+            reason = "GoalReached" if metric_reached else "MaxTrialsReached"
+            set_condition(status, kapi.SUCCEEDED, "True", reason, "")
+            set_condition(status, kapi.RUNNING, "False", reason, "")
+            self.recorder.normal(exp, "Succeeded", reason)
+            self.api.update_status(exp)
+            return None
+
+        # ensure suggestion object, sized to keep parallelTrialCount running
+        free_slots = max(0, spec["parallelTrialCount"] - len(active))
+        budget_left = spec["maxTrialCount"] - len(succeeded) - len(active)
+        want = len(trials) + min(free_slots, max(0, budget_left))
+        sug = self.api.try_get("Suggestion", req.name, req.namespace)
+        if sug is None:
+            sug = self.api.create(
+                {
+                    "apiVersion": kapi.API_VERSION,
+                    "kind": "Suggestion",
+                    "metadata": {
+                        "name": req.name,
+                        "namespace": req.namespace,
+                        "labels": {kapi.LABEL_EXPERIMENT: req.name},
+                        "ownerReferences": [owner_reference(exp)],
+                    },
+                    "spec": {
+                        "algorithm": spec["algorithm"],
+                        "requests": want,
+                    },
+                }
+            )
+        elif sug["spec"].get("requests", 0) < want:
+            sug["spec"]["requests"] = want
+            sug = self.api.update(sug)
+
+        # create trials for issued-but-unconsumed assignments
+        issued = sug.get("status", {}).get("suggestions", [])
+        for idx in range(len(trials), min(len(issued), want)):
+            assignments = issued[idx]["assignments"]
+            run_spec = render_trial_spec(
+                spec["trialTemplate"],
+                {a["name"]: a["value"] for a in assignments},
+            )
+            trial_name = f"{req.name}-{idx:03d}"
+            try:
+                self.api.create(
+                    {
+                        "apiVersion": kapi.API_VERSION,
+                        "kind": "Trial",
+                        "metadata": {
+                            "name": trial_name,
+                            "namespace": req.namespace,
+                            "labels": {kapi.LABEL_EXPERIMENT: req.name},
+                            "ownerReferences": [owner_reference(exp)],
+                        },
+                        "spec": {
+                            "parameterAssignments": assignments,
+                            "objective": spec["objective"],
+                            "primaryContainerName": spec["trialTemplate"].get(
+                                "primaryContainerName", "main"
+                            ),
+                            "runSpec": run_spec,
+                        },
+                    }
+                )
+                self.recorder.normal(exp, "TrialCreated", trial_name)
+            except AlreadyExists:
+                pass
+
+        if active and not has_condition(status, kapi.RUNNING):
+            set_condition(status, kapi.RUNNING, "True", "ExperimentRunning", "")
+        self.api.update_status(exp)
+        return None
+
+
+class SuggestionController:
+    kind = "Suggestion"
+
+    def __init__(self, api: APIServer):
+        self.api = api
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        sug = self.api.try_get("Suggestion", req.name, req.namespace)
+        if sug is None:
+            return None
+        exp = self.api.try_get("Experiment", req.name, req.namespace)
+        if exp is None:
+            return None
+        status = sug.setdefault("status", {})
+        issued = status.get("suggestions", [])
+        want = sug["spec"].get("requests", 0)
+        if len(issued) >= want:
+            return None
+        trials = self.api.list(
+            "Trial", namespace=req.namespace,
+            label_selector={kapi.LABEL_EXPERIMENT: req.name},
+        )
+        algo = sug["spec"]["algorithm"]["algorithmName"]
+        suggester = get_suggester(algo)
+        new = suggester.suggest(exp, trials, want - len(issued))
+        for assignments in new:
+            issued.append(
+                {"assignments": [{"name": k, "value": v} for k, v in assignments.items()]}
+            )
+        status["suggestions"] = issued
+        status["suggestionCount"] = len(issued)
+        self.api.update_status(sug)
+        return None
+
+
+class TrialController:
+    kind = "Trial"
+
+    def __init__(self, api: APIServer, log_reader: Callable[[str, str], str]):
+        self.api = api
+        self.log_reader = log_reader
+        self.recorder = EventRecorder(api, "katib-trial-controller")
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        trial = self.api.try_get("Trial", req.name, req.namespace)
+        if trial is None:
+            return None
+        status = trial.setdefault("status", {})
+        if has_condition(status, kapi.SUCCEEDED) or has_condition(status, kapi.FAILED):
+            return None
+
+        run_spec = trial["spec"]["runSpec"]
+        kind = run_spec.get("kind", "TPUJob")
+        job = self.api.try_get(kind, req.name, req.namespace)
+        if job is None:
+            job_obj = copy.deepcopy(run_spec)
+            job_obj.setdefault("metadata", {})
+            job_obj["metadata"]["name"] = req.name
+            job_obj["metadata"]["namespace"] = req.namespace
+            job_obj["metadata"].setdefault("labels", {})[kapi.LABEL_EXPERIMENT] = (
+                trial["metadata"].get("labels", {}).get(kapi.LABEL_EXPERIMENT, "")
+            )
+            job_obj["metadata"]["ownerReferences"] = [owner_reference(trial)]
+            self.api.create(job_obj)
+            set_condition(status, kapi.RUNNING, "True", "TrialRunning", "")
+            self.api.update_status(trial)
+            return None
+
+        job_status = job.get("status", {})
+        if has_condition(job_status, tapi.FAILED):
+            set_condition(status, kapi.FAILED, "True", "TrialFailed", "job failed")
+            set_condition(status, kapi.RUNNING, "False", "TrialFailed", "")
+            self.recorder.warning(trial, "TrialFailed", "underlying job failed")
+            self.api.update_status(trial)
+            return None
+        if not has_condition(job_status, tapi.SUCCEEDED):
+            return None
+
+        # job done: pull logs from all job pods, parse observation
+        metric_names = [trial["spec"]["objective"]["objectiveMetricName"]] + list(
+            trial["spec"]["objective"].get("additionalMetricNames", [])
+        )
+        pods = self.api.list(
+            "Pod", namespace=req.namespace,
+            label_selector={tapi.LABEL_JOB_NAME: req.name},
+        )
+        log = "\n".join(self.log_reader(p["metadata"]["name"], req.namespace) for p in pods)
+        obs = observation(log, metric_names)
+        have = {m["name"] for m in obs["metrics"]}
+        if trial["spec"]["objective"]["objectiveMetricName"] not in have:
+            set_condition(status, kapi.FAILED, "True", "MetricsUnavailable",
+                          f"objective metric not found in logs (looked for {metric_names})")
+            self.api.update_status(trial)
+            return None
+        status["observation"] = obs
+        set_condition(status, kapi.SUCCEEDED, "True", "TrialSucceeded", "")
+        set_condition(status, kapi.RUNNING, "False", "TrialSucceeded", "")
+        self.recorder.normal(trial, "TrialSucceeded", str(obs["metrics"]))
+        self.api.update_status(trial)
+        return None
+
+
+def install(api: APIServer, manager, log_reader: Callable[[str, str], str]):
+    """Register Katib CRDs + controllers on a Manager."""
+    kapi.register(api)
+    exp = ExperimentController(api)
+    sug = SuggestionController(api)
+    trial = TrialController(api, log_reader)
+    manager.add(exp, owns=("Trial", "Suggestion"))
+    manager.add(sug, watches=((
+        "Trial",
+        lambda obj: Request(
+            obj["metadata"].get("labels", {}).get(kapi.LABEL_EXPERIMENT, ""),
+            obj["metadata"].get("namespace", "default"),
+        ) if obj["metadata"].get("labels", {}).get(kapi.LABEL_EXPERIMENT) else None,
+    ),))
+    manager.add(trial, owns=tuple(tapi.JOB_KINDS))
+    return exp, sug, trial

@@ -1,0 +1,54 @@
+"""Metrics collection from trial logs.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2a "Katib: metrics collectors"):
+a sidecar injected by webhook tails stdout/TFEvent files and pushes
+observation logs to the db-manager.  Architectural deviation (documented):
+the simulator's trial controller PULLS pod logs from the kubelet at reconcile
+time instead of running a push sidecar — same parse rules, same observation
+schema on Trial status.
+
+StdOut format (katib default): lines containing ``metric=value`` pairs, e.g.
+``epoch 3: accuracy=0.91 loss=0.32`` or ``{"accuracy": 0.91}`` JSON lines.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Iterable
+
+_PAIR = r"(?P<name>[A-Za-z][\w\-./]*)\s*=\s*(?P<value>[-+]?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"
+
+
+def parse_metrics(log: str, metric_names: Iterable[str]) -> dict[str, list[float]]:
+    """Extract all observations of each metric, in log order."""
+    wanted = set(metric_names)
+    out: dict[str, list[float]] = {m: [] for m in wanted}
+    for line in log.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+                for k, v in d.items():
+                    if k in wanted and isinstance(v, (int, float)):
+                        out[k].append(float(v))
+                continue
+            except (json.JSONDecodeError, TypeError):
+                pass
+        for m in re.finditer(_PAIR, line):
+            name = m.group("name")
+            if name in wanted:
+                out[name].append(float(m.group("value")))
+    return out
+
+
+def observation(log: str, metric_names: Iterable[str]) -> dict:
+    """Trial .status.observation from a log blob."""
+    parsed = parse_metrics(log, metric_names)
+    metrics = []
+    for name, values in parsed.items():
+        if values:
+            metrics.append(
+                {"name": name, "latest": values[-1], "min": min(values), "max": max(values)}
+            )
+    return {"metrics": metrics}

@@ -1,0 +1,65 @@
+"""Hyperband-style successive halving (upstream: katib hyperband service).
+
+Simplified rung model: the budget parameter (``resource_name``, e.g. epochs)
+is assigned per rung; survivors of each rung (top 1/eta by objective) are
+re-suggested at eta× budget with the same hyperparameters.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import register
+from .space import observed, param_specs, sample_one, settings_dict
+
+
+@register("hyperband")
+class HyperbandSuggester:
+    def suggest(self, experiment, trials, count):
+        specs = param_specs(experiment)
+        settings = settings_dict(experiment)
+        resource = settings.get("resource_name", "epochs")
+        eta = float(settings.get("eta", 3))
+        min_r = float(settings.get("min_resource", 1))
+        max_r = float(settings.get("max_resource", 9))
+        rng = np.random.default_rng(int(settings.get("random_state", 0)) + len(trials))
+
+        search_specs = [p for p in specs if p["name"] != resource]
+        X, y, raw = observed(experiment, trials)
+
+        # current rung = resource level of the most advanced completed trials
+        by_rung: dict[float, list[tuple[float, dict]]] = {}
+        for yi, assign in zip(y, raw):
+            r = float(assign.get(resource, min_r))
+            by_rung.setdefault(r, []).append((yi, assign))
+
+        out = []
+        for _ in range(count):
+            promoted = None
+            for r in sorted(by_rung, reverse=True):
+                nxt = r * eta
+                if nxt > max_r:
+                    continue
+                rung = sorted(by_rung[r], key=lambda t: -t[0])
+                keep = max(1, int(math.floor(len(rung) / eta)))
+                issued_next = {tuple(sorted((k, str(v)) for k, v in a.items() if k != resource))
+                               for _, a in by_rung.get(nxt, [])}
+                for _, assign in rung[:keep]:
+                    key = tuple(sorted((k, str(v)) for k, v in assign.items() if k != resource))
+                    if key not in issued_next:
+                        promoted = {**{k: v for k, v in assign.items() if k != resource},
+                                    resource: nxt}
+                        by_rung.setdefault(nxt, []).append((-np.inf, promoted))
+                        break
+                if promoted:
+                    break
+            if promoted is None:
+                fresh = {p["name"]: sample_one(rng, p) for p in search_specs}
+                fresh[resource] = min_r
+                by_rung.setdefault(min_r, []).append((-np.inf, fresh))
+                out.append(fresh)
+            else:
+                out.append(promoted)
+        return out

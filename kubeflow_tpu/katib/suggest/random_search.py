@@ -1,0 +1,20 @@
+"""Random search (upstream: katib random suggestion service)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import register
+from .space import param_specs, sample_one, settings_dict
+
+
+@register("random")
+class RandomSuggester:
+    def suggest(self, experiment, trials, count):
+        seed = int(settings_dict(experiment).get("random_state", 0)) or None
+        # fold in the number of existing trials so repeated calls differ
+        rng = np.random.default_rng(None if seed is None else seed + len(trials))
+        return [
+            {p["name"]: sample_one(rng, p) for p in param_specs(experiment)}
+            for _ in range(count)
+        ]

@@ -60,6 +60,8 @@ def initialize(local_device_count: Optional[int] = None) -> ProcessEnv:
             jax.config.update("jax_num_cpu_devices", local_device_count)
         except RuntimeError:
             pass  # backends already initialized; device count is fixed
+        # export for child processes (kubelet pods copy os.environ)
+        os.environ.setdefault("JAX_NUM_CPU_DEVICES", str(local_device_count))
 
     if penv.is_distributed:
         jax.distributed.initialize(

@@ -33,7 +33,6 @@ class TrainerConfig:
     max_grad_norm: float = 1.0
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000
-    remat: bool = False
 
 
 def default_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -80,12 +79,11 @@ class Trainer:
         self.opt_state = jax.jit(self.optimizer.init)(self.params)
         self._batch_sharding = batch_sharding(mesh)
 
-        loss = loss_fn
-        if self.config.remat:
-            loss = jax.checkpoint(loss)
-
+        # NOTE: activation remat is a MODEL-level choice (e.g. BertConfig.remat
+        # wraps each scanned layer) — wrapping the whole loss in jax.checkpoint
+        # here would add a full forward recompute without reducing peak memory.
         def step(params, opt_state, batch):
-            loss_val, grads = jax.value_and_grad(loss)(params, batch)
+            loss_val, grads = jax.value_and_grad(loss_fn)(params, batch)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             gnorm = optax.global_norm(grads)
@@ -103,17 +101,25 @@ class Trainer:
     def put_batch(self, batch: Any) -> Any:
         return jax.device_put(batch, self._batch_sharding)
 
-    def train_step(self, batch: Any) -> dict:
+    def train_step(self, batch: Any, sync: bool = True) -> dict:
+        """One optimizer step.
+
+        ``sync=False`` keeps the hot loop async (metrics stay device arrays,
+        no host-device round trip) so dispatch of step N+1 overlaps compute of
+        step N — use it in throughput loops and time externally around a final
+        ``block_until_ready()``.
+        """
         t0 = time.perf_counter()
         batch = self.put_batch(batch)
         self.params, self.opt_state, metrics = self._step(self.params, self.opt_state, batch)
-        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
-        dt = time.perf_counter() - t0
-        metrics["step_time_s"] = dt
-        if self.flops_per_batch:
-            metrics["tflops_per_s"] = self.flops_per_batch / dt / 1e12
+        if sync:
+            metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+            dt = time.perf_counter() - t0
+            metrics["step_time_s"] = dt
+            if self.flops_per_batch:
+                metrics["tflops_per_s"] = self.flops_per_batch / dt / 1e12
+            self._history.append(metrics)
         self.step_num += 1
-        self._history.append(metrics)
         if self._ckpt and self.step_num % self.config.checkpoint_every == 0:
             self.save()
         return metrics

@@ -1,0 +1,231 @@
+"""Katib tests: suggestion algorithms (unit), metrics parsing, and an e2e
+LR sweep running real trial pods through the nested TPUJob stack."""
+
+import sys
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.core.cluster import Cluster
+from kubeflow_tpu.katib import api as kapi
+from kubeflow_tpu.katib.api import Parameter, experiment
+from kubeflow_tpu.katib.client import KatibClient
+from kubeflow_tpu.katib.controllers import install as katib_install, render_trial_spec
+from kubeflow_tpu.katib.metrics import observation, parse_metrics
+from kubeflow_tpu.katib.suggest import algorithm_names, get_suggester
+from kubeflow_tpu.training.frameworks import install as training_install
+
+
+def make_exp_obj(algorithm="random", max_trials=6, goal=None, settings=None):
+    return experiment(
+        "e",
+        parameters=[
+            Parameter("lr", "double", min=0.01, max=1.0),
+            Parameter("units", "int", min=8, max=64),
+            Parameter("opt", "categorical", list=["sgd", "adam"]),
+        ],
+        trial_spec={"apiVersion": "kubeflow.org/v1", "kind": "TPUJob", "spec": {}},
+        objective_metric="accuracy",
+        goal=goal,
+        algorithm=algorithm,
+        algorithm_settings=settings,
+        max_trials=max_trials,
+    )
+
+
+def fake_trial(assignments, value, metric="accuracy"):
+    return {
+        "spec": {"parameterAssignments": [{"name": k, "value": v} for k, v in assignments.items()]},
+        "status": {
+            "conditions": [{"type": "Succeeded", "status": "True"}],
+            "observation": {"metrics": [{"name": metric, "latest": value}]},
+        },
+    }
+
+
+# ----------------------------------------------------------------- suggesters
+
+def test_all_algorithms_registered():
+    assert set(algorithm_names()) >= {"random", "grid", "tpe", "bayesianoptimization", "hyperband"}
+
+
+@pytest.mark.parametrize("algo", ["random", "tpe", "bayesianoptimization"])
+def test_suggester_respects_bounds(algo):
+    exp = make_exp_obj(algo)
+    trials = [fake_trial({"lr": 0.1 * i + 0.01, "units": 8 * (i + 1), "opt": "sgd"}, 0.5 + 0.01 * i)
+              for i in range(8)]
+    out = get_suggester(algo).suggest(exp, trials, 5)
+    assert len(out) == 5
+    for a in out:
+        assert 0.01 <= float(a["lr"]) <= 1.0
+        assert 8 <= int(a["units"]) <= 64
+        assert a["opt"] in ("sgd", "adam")
+
+
+def test_grid_enumerates_deterministically():
+    exp = make_exp_obj("grid", settings={"default_steps": 3})
+    s = get_suggester("grid")
+    first = s.suggest(exp, [], 4)
+    again = s.suggest(exp, [], 4)
+    assert first == again
+    nxt = s.suggest(exp, [{}] * 4, 4)  # 4 trials already issued
+    assert nxt[0] != first[0]
+
+
+def test_bayesian_concentrates_near_optimum():
+    """GP-UCB should sample near the known optimum once observations exist."""
+    exp = experiment(
+        "e1", [Parameter("x", "double", min=0.0, max=1.0)],
+        {"kind": "TPUJob", "spec": {}}, "acc", algorithm="bayesianoptimization",
+        algorithm_settings={"n_initial_points": 3, "kappa": 0.5, "random_state": 1},
+    )
+    # objective: peak at x=0.3
+    trials = [fake_trial({"x": x}, 1.0 - (x - 0.3) ** 2, "acc")
+              for x in [0.0, 0.1, 0.25, 0.3, 0.35, 0.6, 0.9, 1.0]]
+    out = get_suggester("bayesianoptimization").suggest(exp, trials, 8)
+    xs = np.array([float(a["x"]) for a in out])
+    assert (np.abs(xs - 0.3) < 0.25).mean() >= 0.5, xs
+
+
+def test_hyperband_promotes_best():
+    exp = experiment(
+        "e2",
+        [Parameter("lr", "double", min=0.1, max=1.0),
+         Parameter("epochs", "double", min=1, max=9)],
+        {"kind": "TPUJob", "spec": {}}, "acc", algorithm="hyperband",
+        algorithm_settings={"resource_name": "epochs", "eta": 3, "min_resource": 1, "max_resource": 9},
+    )
+    trials = [fake_trial({"lr": lr, "epochs": 1.0}, acc, "acc")
+              for lr, acc in [(0.1, 0.5), (0.4, 0.9), (0.8, 0.3)]]
+    out = get_suggester("hyperband").suggest(exp, trials, 1)
+    # best lr=0.4 promoted to epochs=3
+    assert float(out[0]["lr"]) == 0.4
+    assert float(out[0]["epochs"]) == 3.0
+
+
+# ------------------------------------------------------------------- metrics
+
+def test_parse_metrics_formats():
+    log = """
+epoch 1: accuracy=0.81 loss=0.9
+epoch 2: accuracy=0.92 loss=0.4
+{"accuracy": 0.95, "loss": 0.2}
+noise accuracy-ish=7 other=3
+final accuracy=0.93
+"""
+    out = parse_metrics(log, ["accuracy", "loss"])
+    assert out["accuracy"] == [0.81, 0.92, 0.95, 0.93]
+    assert out["loss"] == [0.9, 0.4, 0.2]
+    obs = observation(log, ["accuracy"])
+    m = obs["metrics"][0]
+    assert m["latest"] == 0.93 and m["max"] == 0.95 and m["min"] == 0.81
+
+
+def test_render_trial_spec_substitution():
+    template = {
+        "trialParameters": [{"name": "learningRate", "reference": "lr"}],
+        "trialSpec": {
+            "kind": "TPUJob",
+            "spec": {"env": [{"name": "LR", "value": "${trialParameters.learningRate}"}],
+                     "cmd": ["--lr=${trialParameters.learningRate}"]},
+        },
+    }
+    out = render_trial_spec(template, {"lr": 0.25})
+    assert out["spec"]["env"][0]["value"] == "0.25"
+    assert out["spec"]["cmd"][0] == "--lr=0.25"
+    with pytest.raises(KeyError):
+        render_trial_spec(template, {"other": 1})
+
+
+# ----------------------------------------------------------------------- e2e
+
+TRIAL_CODE = (
+    "import os, math\n"
+    "lr = float(os.environ['LR'])\n"
+    "acc = 1.0 - (lr - 0.1) ** 2\n"
+    "print(f'accuracy={acc:.6f}')\n"
+)
+
+
+def _sweep_spec(name, algorithm, max_trials, goal=None):
+    trial_spec = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TPUJob",
+        "spec": {
+            "replicaSpecs": {
+                "Worker": {
+                    "replicas": 1,
+                    "restartPolicy": "Never",
+                    "template": {"spec": {"containers": [{
+                        "name": "main",
+                        "command": [sys.executable, "-u", "-c", TRIAL_CODE],
+                        "env": [{"name": "LR", "value": "${trialParameters.lr}"}],
+                    }]}},
+                }
+            },
+            "runPolicy": {"cleanPodPolicy": "None"},
+        },
+    }
+    return experiment(
+        name,
+        parameters=[Parameter("lr", "double", min=0.01, max=1.0)],
+        trial_spec=trial_spec,
+        objective_metric="accuracy",
+        objective_type="maximize",
+        goal=goal,
+        algorithm=algorithm,
+        max_trials=max_trials,
+        parallel_trials=3,
+    )
+
+
+@pytest.fixture()
+def kcluster():
+    c = Cluster(cpu_nodes=1)
+    training_install(c.api, c.manager)
+    katib_install(c.api, c.manager, c.logs)
+    yield c
+    c.shutdown()
+
+
+def test_experiment_random_lr_sweep_e2e(kcluster):
+    client = KatibClient(kcluster)
+    client.create_experiment(_sweep_spec("sweep", "random", max_trials=5))
+    assert client.wait_for_experiment("sweep", timeout=300) == kapi.SUCCEEDED
+
+    exp = client.get_experiment("sweep")
+    assert exp["status"]["trialsSucceeded"] == 5
+    optimal = client.get_optimal_trial("sweep")
+    assert optimal is not None
+    # optimal is the max over observed trials
+    best_seen = max(
+        m["latest"]
+        for t in client.list_trials("sweep")
+        for m in t.get("status", {}).get("observation", {}).get("metrics", [])
+        if m["name"] == "accuracy"
+    )
+    got = [m for m in optimal["observation"]["metrics"] if m["name"] == "accuracy"][0]["latest"]
+    assert got == best_seen
+
+
+def test_experiment_goal_early_stop(kcluster):
+    client = KatibClient(kcluster)
+    # accuracy at lr in [0.01,1.0] is >= 1-(0.9)^2 = 0.19; goal 0.0 met by any trial
+    client.create_experiment(_sweep_spec("goal", "random", max_trials=50, goal=0.05))
+    assert client.wait_for_experiment("goal", timeout=300) == kapi.SUCCEEDED
+    exp = client.get_experiment("goal")
+    # stopped well before maxTrials
+    assert exp["status"]["trialsSucceeded"] < 50
+    reason = [c for c in exp["status"]["conditions"] if c["type"] == kapi.SUCCEEDED][0]["reason"]
+    assert reason == "GoalReached"
+
+
+def test_trial_metrics_unavailable_fails(kcluster):
+    client = KatibClient(kcluster)
+    spec = _sweep_spec("nometrics", "random", max_trials=2)
+    # trial prints nothing
+    spec["spec"]["trialTemplate"]["trialSpec"]["spec"]["replicaSpecs"]["Worker"]["template"][
+        "spec"]["containers"][0]["command"] = [sys.executable, "-c", "print('no metrics here')"]
+    spec["spec"]["maxFailedTrialCount"] = 1
+    client.create_experiment(spec)
+    assert client.wait_for_experiment("nometrics", timeout=300) == kapi.FAILED
