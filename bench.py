@@ -21,21 +21,13 @@ def main() -> None:
     from kubeflow_tpu.train.data import synthetic_mlm_batches
     from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
 
+    from kubeflow_tpu.scheduler.topology import variant_for_device_kind
+
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
     n_chips = len(devices)
     # map the actual chip generation to its peak (device_kind e.g. "TPU v5 lite")
-    kind = getattr(devices[0], "device_kind", "").lower()
-    if "v5 lite" in kind or "v5e" in kind:
-        variant = "v5e"
-    elif "v6" in kind:
-        variant = "v6e"
-    elif "v5" in kind:
-        variant = "v5p"
-    elif "v4" in kind:
-        variant = "v4"
-    else:
-        variant = "v5e"
+    variant = variant_for_device_kind(getattr(devices[0], "device_kind", "")) if on_tpu else "v5e"
     mesh = build_mesh(MeshConfig(data=1, fsdp=n_chips, tensor=1), devices)
 
     config = bert.BertConfig(remat=on_tpu)  # BERT-base, seq 128 (phase-1 pretrain shape)

@@ -24,6 +24,7 @@ from ..core.conditions import has_condition, set_condition
 from ..core.controller import Request, Result
 from ..core.events import EventRecorder
 from ..training import api as tapi
+from ..utils.render import deep_map_strings
 from . import api as kapi
 from .metrics import observation
 from .suggest import get_suggester
@@ -35,23 +36,16 @@ def render_trial_spec(template: dict, assignments: dict) -> dict:
     """Substitute ``${trialParameters.x}`` through the whole spec tree."""
     trial_params = {p["name"]: p["reference"] for p in template.get("trialParameters", [])}
 
-    def sub(v):
-        if isinstance(v, str):
-            def repl(m):
-                pname = m.group(1)
-                ref = trial_params.get(pname, pname)
-                if ref not in assignments:
-                    raise KeyError(f"trial parameter {pname!r} (ref {ref!r}) has no assignment")
-                return str(assignments[ref])
+    def repl(m):
+        pname = m.group(1)
+        ref = trial_params.get(pname, pname)
+        if ref not in assignments:
+            raise KeyError(f"trial parameter {pname!r} (ref {ref!r}) has no assignment")
+        return str(assignments[ref])
 
-            return _PLACEHOLDER.sub(repl, v)
-        if isinstance(v, dict):
-            return {k: sub(x) for k, x in v.items()}
-        if isinstance(v, list):
-            return [sub(x) for x in v]
-        return v
-
-    return sub(copy.deepcopy(template["trialSpec"]))
+    return deep_map_strings(
+        copy.deepcopy(template["trialSpec"]), lambda s: _PLACEHOLDER.sub(repl, s)
+    )
 
 
 class ExperimentController:
@@ -126,8 +120,22 @@ class ExperimentController:
             self.recorder.warning(exp, "Failed", "too many failed trials")
             self.api.update_status(exp)
             return None
-        if metric_reached or len(succeeded) >= spec["maxTrialCount"]:
-            reason = "GoalReached" if metric_reached else "MaxTrialsReached"
+        sug = self.api.try_get("Suggestion", req.name, req.namespace)
+        # a suggester that cannot produce more points (e.g. grid fully
+        # enumerated) ends the experiment once every issued trial finished —
+        # upstream's "SuggestionEndReached" terminal reason
+        exhausted = (
+            sug is not None
+            and sug.get("status", {}).get("exhausted", False)
+            and not active
+            and len(trials) >= sug.get("status", {}).get("suggestionCount", 0)
+        )
+        if metric_reached or len(succeeded) >= spec["maxTrialCount"] or exhausted:
+            reason = (
+                "GoalReached" if metric_reached
+                else "MaxTrialsReached" if len(succeeded) >= spec["maxTrialCount"]
+                else "SuggestionEndReached"
+            )
             set_condition(status, kapi.SUCCEEDED, "True", reason, "")
             set_condition(status, kapi.RUNNING, "False", reason, "")
             self.recorder.normal(exp, "Succeeded", reason)
@@ -138,7 +146,6 @@ class ExperimentController:
         free_slots = max(0, spec["parallelTrialCount"] - len(active))
         budget_left = spec["maxTrialCount"] - len(succeeded) - len(active)
         want = len(trials) + min(free_slots, max(0, budget_left))
-        sug = self.api.try_get("Suggestion", req.name, req.namespace)
         if sug is None:
             sug = self.api.create(
                 {
@@ -231,6 +238,9 @@ class SuggestionController:
             )
         status["suggestions"] = issued
         status["suggestionCount"] = len(issued)
+        # fewer than requested = the search space is exhausted (grid etc.);
+        # the experiment controller turns this into SuggestionEndReached
+        status["exhausted"] = len(issued) < want
         self.api.update_status(sug)
         return None
 

@@ -27,13 +27,24 @@ class HyperbandSuggester:
         rng = np.random.default_rng(int(settings.get("random_state", 0)) + len(trials))
 
         search_specs = [p for p in specs if p["name"] != resource]
-        X, y, raw = observed(experiment, trials)
+        _, y, raw = observed(experiment, trials)
 
-        # current rung = resource level of the most advanced completed trials
+        def config_key(assign: dict) -> tuple:
+            return tuple(sorted((k, str(v)) for k, v in assign.items() if k != resource))
+
+        scores = {(config_key(a), str(a.get(resource, min_r))): yi for yi, a in zip(y, raw)}
+
+        # rung state from ALL issued trials (running ones score -inf), so a
+        # promotion issued last round but still running is visible and is
+        # never re-issued
         by_rung: dict[float, list[tuple[float, dict]]] = {}
-        for yi, assign in zip(y, raw):
+        for t in trials:
+            assign = {a["name"]: a["value"] for a in t["spec"].get("parameterAssignments", [])}
+            if not assign:
+                continue
             r = float(assign.get(resource, min_r))
-            by_rung.setdefault(r, []).append((yi, assign))
+            s = scores.get((config_key(assign), str(assign.get(resource, min_r))), -np.inf)
+            by_rung.setdefault(r, []).append((s, assign))
 
         out = []
         for _ in range(count):
@@ -43,12 +54,13 @@ class HyperbandSuggester:
                 if nxt > max_r:
                     continue
                 rung = sorted(by_rung[r], key=lambda t: -t[0])
-                keep = max(1, int(math.floor(len(rung) / eta)))
-                issued_next = {tuple(sorted((k, str(v)) for k, v in a.items() if k != resource))
-                               for _, a in by_rung.get(nxt, [])}
-                for _, assign in rung[:keep]:
-                    key = tuple(sorted((k, str(v)) for k, v in assign.items() if k != resource))
-                    if key not in issued_next:
+                # only EVALUATED configs are promotion candidates; keep is
+                # computed over evaluated entries so placeholders can't pad it
+                evaluated = [(s, a) for s, a in rung if np.isfinite(s)]
+                keep = max(1, int(math.floor(len(evaluated) / eta))) if evaluated else 0
+                issued_next = {config_key(a) for _, a in by_rung.get(nxt, [])}
+                for _, assign in evaluated[:keep]:
+                    if config_key(assign) not in issued_next:
                         promoted = {**{k: v for k, v in assign.items() if k != resource},
                                     resource: nxt}
                         by_rung.setdefault(nxt, []).append((-np.inf, promoted))

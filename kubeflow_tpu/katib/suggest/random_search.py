@@ -11,9 +11,10 @@ from .space import param_specs, sample_one, settings_dict
 @register("random")
 class RandomSuggester:
     def suggest(self, experiment, trials, count):
-        seed = int(settings_dict(experiment).get("random_state", 0)) or None
+        raw = settings_dict(experiment).get("random_state")
         # fold in the number of existing trials so repeated calls differ
-        rng = np.random.default_rng(None if seed is None else seed + len(trials))
+        # (0 is a valid, deterministic seed — only absence means entropy)
+        rng = np.random.default_rng(None if raw is None else int(raw) + len(trials))
         return [
             {p["name"]: sample_one(rng, p) for p in param_specs(experiment)}
             for _ in range(count)

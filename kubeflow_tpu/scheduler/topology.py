@@ -54,6 +54,22 @@ VARIANTS = {
 }
 
 
+def variant_for_device_kind(device_kind: str) -> str:
+    """Map a jax Device.device_kind string to a VARIANTS key.
+
+    Ordered most-specific-first; unknown kinds raise so MFU math can't
+    silently use the wrong peak-FLOPs figure.
+    """
+    kind = device_kind.lower()
+    for needle, variant in (
+        ("v5 lite", "v5e"), ("v5e", "v5e"), ("v6", "v6e"),
+        ("v5", "v5p"), ("v4", "v4"),
+    ):
+        if needle in kind:
+            return variant
+    raise KeyError(f"unknown TPU device_kind {device_kind!r}; add it to VARIANTS")
+
+
 def parse_topology(topology: str) -> tuple[int, ...]:
     return tuple(int(x) for x in topology.lower().split("x"))
 

@@ -1,1 +1,28 @@
-"""TPU-native Kubeflow-capability platform."""
+"""Serving pillar: InferenceService / ServingRuntime / model server / router.
+
+TPU-native KServe-capability layer (SURVEY.md §2a KServe rows, §3.4).
+``install()`` wires the whole serving control plane into a Manager.
+"""
+
+from __future__ import annotations
+
+from ..core.api import APIServer
+from . import api as serving_api
+from .autoscaler import ConcurrencyAutoscaler
+from .controllers import DeploymentReconciler, InferenceServiceReconciler
+from .router import Router, ServiceProxy
+from .runtimes import install_default_runtimes
+
+
+def install(api: APIServer, manager, runtimes: bool = True):
+    """Register serving CRDs + controllers. Returns (router, service_proxy)."""
+    serving_api.register(api)
+    if runtimes:
+        install_default_runtimes(api)
+    manager.add(DeploymentReconciler(api), owns=("Pod",))
+    manager.add(InferenceServiceReconciler(api), owns=("Deployment",))
+    autoscaler = ConcurrencyAutoscaler(api)
+    manager.add_ticker(autoscaler.sync)
+    proxy = ServiceProxy(api)
+    manager.add_ticker(proxy.sync)
+    return Router(api), proxy

@@ -1,0 +1,188 @@
+"""Serving API types: InferenceService + ServingRuntime CRDs.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2a "KServe"): the
+``serving.kserve.io/v1beta1 InferenceService`` and ``v1alpha1
+(Cluster)ServingRuntime`` types.  TPU-first departures:
+
+  * the default accelerator resource is ``google.com/tpu`` — no
+    ``nvidia.com/gpu`` anywhere (BASELINE.json north star);
+  * the flagship runtime is a JetStream-style continuous-batching JAX engine
+    (see serving/engine/) rather than Triton/TF-Serving;
+  * "serverless" is a concurrency-driven autoscaler with scale-to-zero and an
+    activator in the router (serving/autoscaler.py, serving/router.py) — the
+    in-process equivalent of Knative KPA + activator.
+
+An InferenceService has up to three components (predictor required,
+transformer/explainer optional).  Each component is either a catalog model
+(``model: {modelFormat, storageUri, ...}`` resolved against ServingRuntimes)
+or a custom container list.  Canary rollout: ``canaryTrafficPercent`` splits
+traffic between the promoted revision (kept in an annotation) and the latest
+spec, mirroring KServe's previous-rolledout-revision mechanism.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from ..core.api import APIServer, CRD, Invalid, Obj
+
+GROUP = "serving.kubeflow.org"
+VERSION = "v1beta1"
+RUNTIME_VERSION = "v1alpha1"
+
+# condition types (status.conditions on InferenceService)
+PREDICTOR_READY = "PredictorReady"
+TRANSFORMER_READY = "TransformerReady"
+EXPLAINER_READY = "ExplainerReady"
+INGRESS_READY = "IngressReady"
+READY = "Ready"
+
+COMPONENTS = ("predictor", "transformer", "explainer")
+
+# annotation holding the promoted (last fully-rolled-out) spec for canary
+PROMOTED_SPEC_ANNOTATION = f"{GROUP}/promoted-spec"
+# deployment annotations driving the autoscaler
+TARGET_CONCURRENCY_ANNOTATION = f"{GROUP}/target-concurrency"
+MIN_REPLICAS_ANNOTATION = f"{GROUP}/min-replicas"
+MAX_REPLICAS_ANNOTATION = f"{GROUP}/max-replicas"
+SCALE_TO_ZERO_GRACE_ANNOTATION = f"{GROUP}/scale-to-zero-grace"
+# label wiring pods/services back to their isvc + component + revision
+LABEL_ISVC = f"{GROUP}/inferenceservice"
+LABEL_COMPONENT = f"{GROUP}/component"
+LABEL_REVISION = f"{GROUP}/revision"
+
+
+def _validate_component(name: str, comp: dict) -> None:
+    has_model = "model" in comp
+    has_containers = bool(comp.get("containers"))
+    if name == "predictor" and not (has_model or has_containers):
+        raise Invalid("predictor needs .model or .containers")
+    if has_model and has_containers:
+        raise Invalid(f"{name}: .model and .containers are mutually exclusive")
+    if has_model:
+        model = comp["model"]
+        if "modelFormat" not in model:
+            raise Invalid(f"{name}.model.modelFormat is required")
+    for field in ("minReplicas", "maxReplicas"):
+        v = comp.get(field)
+        if v is not None and v < 0:
+            raise Invalid(f"{name}.{field} must be >= 0")
+    mn, mx = comp.get("minReplicas"), comp.get("maxReplicas")
+    if mn is not None and mx is not None and mx != 0 and mx < mn:
+        raise Invalid(f"{name}: maxReplicas < minReplicas")
+
+
+def validate_isvc(obj: Obj) -> None:
+    spec = obj.get("spec") or {}
+    if "predictor" not in spec:
+        raise Invalid("spec.predictor is required")
+    for name in COMPONENTS:
+        if name in spec:
+            _validate_component(name, spec[name])
+    canary = spec.get("canaryTrafficPercent")
+    if canary is not None and not (0 <= canary <= 100):
+        raise Invalid("canaryTrafficPercent must be in [0, 100]")
+
+
+def default_isvc(obj: Obj) -> None:
+    spec = obj.setdefault("spec", {})
+    for name in COMPONENTS:
+        comp = spec.get(name)
+        if comp is None:
+            continue
+        comp.setdefault("minReplicas", 1)
+        comp.setdefault("maxReplicas", 3)
+        comp.setdefault("scaleTarget", 4)  # target concurrent requests/replica
+        if "model" in comp:
+            model = comp["model"]
+            fmt = model.get("modelFormat")
+            if isinstance(fmt, str):  # accept shorthand "jax" for {name: "jax"}
+                model["modelFormat"] = {"name": fmt}
+
+
+def validate_runtime(obj: Obj) -> None:
+    spec = obj.get("spec") or {}
+    if not spec.get("supportedModelFormats"):
+        raise Invalid("spec.supportedModelFormats is required")
+    if not spec.get("containers"):
+        raise Invalid("spec.containers is required")
+
+
+def register(api: APIServer) -> None:
+    api.register_crd(
+        CRD(
+            group=GROUP,
+            version=VERSION,
+            kind="InferenceService",
+            plural="inferenceservices",
+            validator=validate_isvc,
+            defaulter=default_isvc,
+        )
+    )
+    api.register_crd(
+        CRD(
+            group=GROUP,
+            version=RUNTIME_VERSION,
+            kind="ServingRuntime",
+            plural="servingruntimes",
+            validator=validate_runtime,
+        )
+    )
+    api.register_crd(
+        CRD(
+            group=GROUP,
+            version=RUNTIME_VERSION,
+            kind="ClusterServingRuntime",
+            plural="clusterservingruntimes",
+            namespaced=False,
+            validator=validate_runtime,
+        )
+    )
+
+
+# ------------------------------------------------------------------ builders
+
+
+def inference_service(
+    name: str,
+    *,
+    namespace: str = "default",
+    model_format: Optional[str] = None,
+    storage_uri: Optional[str] = None,
+    runtime: Optional[str] = None,
+    predictor: Optional[dict] = None,
+    transformer: Optional[dict] = None,
+    explainer: Optional[dict] = None,
+    canary_traffic_percent: Optional[int] = None,
+    min_replicas: int = 1,
+    max_replicas: int = 3,
+    scale_target: int = 4,
+) -> Obj:
+    """Typed builder — the Python-SDK analogue of kserve's V1beta1InferenceService."""
+    if predictor is None:
+        if model_format is None:
+            raise ValueError("either predictor= or model_format= is required")
+        model: dict = {"modelFormat": {"name": model_format}}
+        if storage_uri is not None:
+            model["storageUri"] = storage_uri
+        if runtime is not None:
+            model["runtime"] = runtime
+        predictor = {"model": model}
+    predictor = copy.deepcopy(predictor)
+    predictor.setdefault("minReplicas", min_replicas)
+    predictor.setdefault("maxReplicas", max_replicas)
+    predictor.setdefault("scaleTarget", scale_target)
+    spec: dict = {"predictor": predictor}
+    if transformer is not None:
+        spec["transformer"] = copy.deepcopy(transformer)
+    if explainer is not None:
+        spec["explainer"] = copy.deepcopy(explainer)
+    if canary_traffic_percent is not None:
+        spec["canaryTrafficPercent"] = canary_traffic_percent
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
+    }

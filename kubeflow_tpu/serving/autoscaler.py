@@ -1,0 +1,141 @@
+"""Concurrency autoscaler — the in-process Knative KPA.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §3.4): Knative's pod autoscaler,
+which scrapes queue-proxy concurrency metrics and drives the revision's
+Deployment, including scale-to-zero.  Here the model server itself exposes the
+``inflight_requests`` gauge (serving/server.py /metrics); this ticker scrapes
+ready pods, computes desired = ceil(total_inflight / target), and patches
+``spec.replicas`` within [minReplicas, maxReplicas].
+
+Scale-down is damped (a stability window) and scale-to-zero additionally
+waits for `grace` seconds of zero traffic; the router's activator path
+(router.py) un-zeroes on the next request.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+import urllib.request
+from typing import Optional
+
+from ..core.api import APIServer, Obj
+from .api import (
+    MAX_REPLICAS_ANNOTATION,
+    MIN_REPLICAS_ANNOTATION,
+    SCALE_TO_ZERO_GRACE_ANNOTATION,
+    TARGET_CONCURRENCY_ANNOTATION,
+)
+from .controllers import SCALED_TO_ZERO_ANNOTATION, pod_is_ready, pod_port
+
+DEFAULT_SCALE_TO_ZERO_GRACE = 1.5  # seconds (simulator timescale)
+SCALE_DOWN_WINDOW = 1.0
+ACTIVATED_AT_ANNOTATION = "serving.kubeflow.org/activated-at"
+
+
+def scrape_metrics(port: int, timeout: float = 0.25) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=timeout) as r:
+            text = r.read().decode()
+    except Exception:  # noqa: BLE001
+        return None
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        k, _, v = line.partition(" ")
+        try:
+            out[k] = float(v)
+        except ValueError:
+            pass
+    return out
+
+
+class ConcurrencyAutoscaler:
+    def __init__(self, api: APIServer):
+        self.api = api
+        # per-deployment uid: time the current lower desired value was first seen
+        self._downscale_since: dict[str, tuple[int, float]] = {}
+        self._last_traffic: dict[str, float] = {}
+
+    def sync(self) -> bool:
+        changed = False
+        for deploy in self.api.list("Deployment"):
+            ann = deploy["metadata"].get("annotations", {})
+            if TARGET_CONCURRENCY_ANNOTATION not in ann:
+                continue
+            if self._autoscale(deploy, ann):
+                changed = True
+        return changed
+
+    def _autoscale(self, deploy: Obj, ann: dict) -> bool:
+        target = max(1.0, float(ann[TARGET_CONCURRENCY_ANNOTATION]))
+        min_r = int(ann.get(MIN_REPLICAS_ANNOTATION, 1))
+        max_r = int(ann.get(MAX_REPLICAS_ANNOTATION, 3)) or 10**9
+        grace = float(ann.get(SCALE_TO_ZERO_GRACE_ANNOTATION, DEFAULT_SCALE_TO_ZERO_GRACE))
+        ns = deploy["metadata"].get("namespace", "default")
+        uid = deploy["metadata"]["uid"]
+        current = int(deploy["spec"].get("replicas", 1))
+
+        selector = (deploy["spec"].get("selector") or {}).get("matchLabels") or {}
+        pods = self.api.list("Pod", namespace=ns, label_selector=selector)
+        inflight = 0.0
+        ready = 0
+        last_traffic = self._last_traffic.get(uid, 0.0)
+        for p in pods:
+            if not pod_is_ready(p):
+                continue
+            ready += 1
+            port = pod_port(p)
+            m = scrape_metrics(port) if port else None
+            if m:
+                inflight += m.get("inflight_requests", 0.0)
+                last_traffic = max(last_traffic, m.get("last_request_timestamp", 0.0))
+        self._last_traffic[uid] = last_traffic
+
+        if current == 0:
+            return False  # activation is the router's job
+
+        now = time.time()
+        desired = math.ceil(inflight / target) if inflight > 0 else 0
+        desired = max(desired, min_r, 0)
+        desired = min(desired, max_r)
+
+        if desired > current:
+            self._downscale_since.pop(uid, None)
+            return self._scale(deploy, desired, zero=False)
+
+        floor = max(min_r, 1)
+        if desired < current:
+            if current > floor:
+                # damp: shrink toward floor after a stability window
+                seen = self._downscale_since.get(uid)
+                if seen is None or seen[0] != desired:
+                    self._downscale_since[uid] = (desired, now)
+                elif now - seen[1] >= SCALE_DOWN_WINDOW:
+                    self._downscale_since.pop(uid, None)
+                    return self._scale(deploy, max(desired, floor), zero=False)
+            if (
+                min_r == 0
+                and inflight == 0
+                and ready == current  # pods still starting: an activation is in flight
+                and (last_traffic == 0.0 or now - last_traffic >= grace)
+                and now - float(ann.get(ACTIVATED_AT_ANNOTATION, 0.0)) >= grace
+                and _age(deploy) >= grace
+            ):
+                return self._scale(deploy, 0, zero=True)
+        return False
+
+    def _scale(self, deploy: Obj, replicas: int, zero: bool) -> bool:
+        ann_patch = {SCALED_TO_ZERO_ANNOTATION: "true" if zero else None}
+        self.api.patch(
+            "Deployment",
+            deploy["metadata"]["name"],
+            {"spec": {"replicas": replicas}, "metadata": {"annotations": ann_patch}},
+            deploy["metadata"].get("namespace", "default"),
+        )
+        return True
+
+
+def _age(deploy: Obj) -> float:
+    return time.time() - deploy["metadata"].get("creationTimestamp", 0.0)

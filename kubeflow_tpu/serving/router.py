@@ -1,0 +1,245 @@
+"""Service proxy + ingress router + activator.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §3.4 request path): Istio ingress
+(Envoy) → Knative activator/queue-proxy → model server.  In-process
+equivalents:
+
+  * ``ServiceProxy`` — one HTTP listener per serving Service (port pinned in
+    the Service's proxy-port annotation by the ISVC controller).  Each request
+    picks a revision by the Service's traffic-split annotation (canary), then
+    round-robins over that revision's READY pods.  This is what makes
+    ``PREDICTOR_HOST`` a stable address for transformers while revisions and
+    replicas churn underneath.
+  * activator — when a request arrives and every backing Deployment is scaled
+    to zero, the proxy patches replicas back to >=1 and holds the request
+    until a pod reports ready (Knative's activator hand-off).
+  * ``Router`` — the client-facing entry: resolves an InferenceService to its
+    entry component (transformer if present, else predictor) and speaks
+    V1/V2 protocol to its service proxy.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..core.api import APIServer, Obj
+from .api import LABEL_ISVC, LABEL_REVISION
+from .controllers import (
+    DEPLOYMENT_FOR_SERVICE_ANNOTATION,
+    PROXY_PORT_ANNOTATION,
+    SCALED_TO_ZERO_ANNOTATION,
+    TRAFFIC_ANNOTATION,
+    pod_is_ready,
+    pod_port,
+)
+
+ACTIVATION_TIMEOUT = 30.0
+
+
+class _ProxyState:
+    def __init__(self, service_name: str, namespace: str):
+        self.service_name = service_name
+        self.namespace = namespace
+        self.rr = 0
+        self.split_key: Optional[str] = None
+        self.credits: dict[str, int] = {}
+
+
+class ServiceProxy:
+    """Manages one HTTP listener per serving Service. Run .sync() as a ticker."""
+
+    def __init__(self, api: APIServer):
+        self.api = api
+        self._servers: dict[tuple[str, str], ThreadingHTTPServer] = {}
+
+    def sync(self) -> bool:
+        changed = False
+        seen = set()
+        for svc in self.api.list("Service", label_selector=None):
+            ann = svc["metadata"].get("annotations", {})
+            if PROXY_PORT_ANNOTATION not in ann or LABEL_ISVC not in svc["metadata"].get("labels", {}):
+                continue
+            key = (svc["metadata"].get("namespace", "default"), svc["metadata"]["name"])
+            seen.add(key)
+            if key not in self._servers:
+                self._start(key, int(ann[PROXY_PORT_ANNOTATION]))
+                changed = True
+        for key in list(self._servers):
+            if key not in seen:
+                self._stop(key)
+                changed = True
+        return False if not changed else True
+
+    def _start(self, key: tuple[str, str], port: int) -> None:
+        proxy = self
+        ns, name = key
+        state = _ProxyState(name, ns)
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _forward(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else None
+                try:
+                    backend = proxy._pick_backend(state)
+                except LookupError as e:
+                    self._reply(503, json.dumps({"error": str(e)}).encode())
+                    return
+                url = f"http://127.0.0.1:{backend}{self.path}"
+                req = urllib.request.Request(
+                    url,
+                    data=body,
+                    method=self.command,
+                    headers={"Content-Type": self.headers.get("Content-Type", "application/json")},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        self._reply(r.status, r.read(), r.headers.get("Content-Type"))
+                except urllib.error.HTTPError as e:
+                    self._reply(e.code, e.read(), e.headers.get("Content-Type"))
+                except Exception as e:  # noqa: BLE001
+                    self._reply(502, json.dumps({"error": f"backend: {e}"}).encode())
+
+            def _reply(self, code: int, data: bytes, ctype: Optional[str] = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype or "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _forward
+
+        server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, kwargs={"poll_interval": 0.05}, daemon=True).start()
+        self._servers[key] = server
+
+    def _stop(self, key: tuple[str, str]) -> None:
+        server = self._servers.pop(key)
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    # ----------------------------------------------------------- backend pick
+
+    def _pick_backend(self, state: _ProxyState) -> int:
+        svc = self.api.try_get("Service", state.service_name, state.namespace)
+        if svc is None:
+            raise LookupError(f"service {state.service_name} gone")
+        ann = svc["metadata"].get("annotations", {})
+        traffic: dict[str, int] = json.loads(ann.get(TRAFFIC_ANNOTATION, "{}"))
+        selector = svc["spec"].get("selector") or {}
+
+        revision = self._pick_revision(state, traffic)
+        pods = self._ready_pods(state.namespace, selector, revision)
+        if not pods:
+            self._activate(svc)
+            deadline = time.monotonic() + ACTIVATION_TIMEOUT
+            while time.monotonic() < deadline:
+                pods = self._ready_pods(state.namespace, selector, revision)
+                if pods:
+                    break
+                time.sleep(0.05)
+            if not pods:
+                raise LookupError(f"no ready backend for {state.service_name} (rev={revision})")
+        state.rr += 1
+        return pod_port(pods[state.rr % len(pods)])
+
+    def _pick_revision(self, state: _ProxyState, traffic: dict[str, int]) -> Optional[str]:
+        live = {r: p for r, p in traffic.items() if p > 0}
+        if not live:
+            return None  # no split recorded: any revision
+        # smooth weighted round-robin (nginx algorithm): deterministic AND
+        # interleaved, so a 20% canary sees ~1-in-5 requests from the start
+        key = json.dumps(live, sort_keys=True)
+        if state.split_key != key:
+            state.split_key = key
+            state.credits = {r: 0 for r in live}
+        total = sum(live.values())
+        for r, w in live.items():
+            state.credits[r] += w
+        best = max(sorted(live), key=lambda r: state.credits[r])
+        state.credits[best] -= total
+        return best
+
+    def _ready_pods(self, ns: str, selector: dict, revision: Optional[str]) -> list[Obj]:
+        sel = dict(selector)
+        if revision is not None:
+            sel[LABEL_REVISION] = revision
+        pods = [
+            p
+            for p in self.api.list("Pod", namespace=ns, label_selector=sel)
+            if pod_is_ready(p) and pod_port(p) is not None
+        ]
+        return sorted(pods, key=lambda p: p["metadata"]["name"])
+
+    def _activate(self, svc: Obj) -> None:
+        ns = svc["metadata"].get("namespace", "default")
+        ann = svc["metadata"].get("annotations", {})
+        for dname in json.loads(ann.get(DEPLOYMENT_FOR_SERVICE_ANNOTATION, "[]")):
+            deploy = self.api.try_get("Deployment", dname, ns)
+            if deploy is None:
+                continue
+            if int(deploy["spec"].get("replicas", 1)) == 0:
+                from .autoscaler import ACTIVATED_AT_ANNOTATION
+
+                self.api.patch(
+                    "Deployment",
+                    dname,
+                    {
+                        "spec": {"replicas": 1},
+                        "metadata": {
+                            "annotations": {
+                                SCALED_TO_ZERO_ANNOTATION: None,
+                                ACTIVATED_AT_ANNOTATION: str(time.time()),
+                            }
+                        },
+                    },
+                    ns,
+                )
+
+    def shutdown(self) -> None:
+        for key in list(self._servers):
+            self._stop(key)
+
+
+class Router:
+    """Client-facing entry — the kubectl-port-forward/ingress equivalent."""
+
+    def __init__(self, api: APIServer, pump=None):
+        self.api = api
+        self.pump = pump  # optional callable(predicate, timeout) driving the cluster
+
+    def _entry_port(self, name: str, namespace: str) -> int:
+        isvc = self.api.get("InferenceService", name, namespace)
+        url = isvc.get("status", {}).get("url")
+        if not url:
+            raise LookupError(f"InferenceService {name} has no status.url yet")
+        return int(url.rsplit(":", 1)[1])
+
+    def _post(self, port: int, path: str, payload: dict, timeout: float = 60.0) -> dict:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def predict(self, name: str, payload: dict, namespace: str = "default", protocol: str = "v1") -> dict:
+        port = self._entry_port(name, namespace)
+        if protocol == "v1":
+            return self._post(port, f"/v1/models/{name}:predict", payload)
+        return self._post(port, f"/v2/models/{name}/infer", payload)
+
+    def explain(self, name: str, payload: dict, namespace: str = "default") -> dict:
+        port = self._entry_port(name, namespace)
+        return self._post(port, f"/v1/models/{name}:explain", payload)

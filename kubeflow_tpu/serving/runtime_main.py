@@ -1,0 +1,199 @@
+"""Runtime container entrypoint: load a model, serve V1/V2 protocols.
+
+Upstream analogue (UNVERIFIED): the per-runtime server images referenced by
+``kserve/config/runtimes`` (sklearnserver, huggingfaceserver, tritonserver…).
+One entrypoint + pluggable loaders replaces the image zoo — the simulator's
+kubelet execs this module with the args rendered from the ServingRuntime
+template (serving/runtimes.py).
+
+Loaders:
+  pyfunc       model dir contains ``model.py`` defining either a ``UserModel``
+               (subclass of serving.server.Model) or ``predict(instances)``.
+  sklearn      ``model.joblib``/``model.pkl`` with a ``.predict`` method.
+  xgboost      ``model.json``/``model.ubj`` loaded via xgboost if present,
+               else pickled booster.
+  jax          ``model.py`` defining ``load_jax(model_dir) -> (apply, params)``;
+               served as jit-compiled batched apply.
+  jetstream    LLM decode engine (serving/engine) on a checkpoint dir.
+  huggingface  transformers AutoModel pipeline (CPU torch in this image).
+  echo         identity model (tests, smoke).
+
+A transformer component sets ``PREDICTOR_HOST``; the loaded model's
+``predict`` then delegates over HTTP — same chain as upstream transformers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import urllib.request
+from typing import Any, Optional
+
+from .server import Model, ModelServer
+
+
+class EchoModel(Model):
+    def predict(self, payload: Any, headers: Optional[dict] = None) -> Any:
+        if isinstance(payload, dict) and "instances" in payload:
+            return payload["instances"]
+        return payload
+
+
+class PredictorClient:
+    """HTTP client a transformer uses to call its predictor (V1 protocol)."""
+
+    def __init__(self, host: str):
+        self.host = host if host.startswith("http") else f"http://{host}"
+
+    def predict(self, model_name: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            f"{self.host}/v1/models/{model_name}:predict",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+
+def _load_module(path: str):
+    spec = importlib.util.spec_from_file_location("user_model", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    return mod
+
+
+def _find(model_dir: str, *names: str) -> Optional[str]:
+    for n in names:
+        p = os.path.join(model_dir, n)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+class _FnModel(Model):
+    def __init__(self, name: str, fn):
+        super().__init__(name)
+        self._fn = fn
+
+    def predict(self, payload: Any, headers: Optional[dict] = None) -> Any:
+        if isinstance(payload, dict) and "inputs" in payload:  # V2 protocol
+            import numpy as np
+
+            t = payload["inputs"][0]
+            instances = np.asarray(t["data"]).reshape(t["shape"]).tolist()
+        elif isinstance(payload, dict):
+            instances = payload.get("instances", payload)
+        else:
+            instances = payload
+        return self._fn(instances)
+
+
+def load_model(loader: str, name: str, model_dir: str) -> Model:
+    predictor_host = os.environ.get("PREDICTOR_HOST", "")
+
+    if loader == "echo":
+        return EchoModel(name)
+
+    if loader == "pyfunc":
+        path = _find(model_dir, "model.py")
+        if path is None:
+            raise FileNotFoundError(f"pyfunc: no model.py in {model_dir}")
+        mod = _load_module(path)
+        if hasattr(mod, "UserModel"):
+            m = mod.UserModel(name)
+            if predictor_host and not getattr(m, "predictor", None):
+                m.predictor = PredictorClient(predictor_host)  # type: ignore[attr-defined]
+            return m
+        if hasattr(mod, "predict"):
+            return _FnModel(name, mod.predict)
+        raise AttributeError("pyfunc: model.py must define UserModel or predict()")
+
+    if loader == "sklearn":
+        path = _find(model_dir, "model.joblib", "model.pkl")
+        if path is None:
+            raise FileNotFoundError(f"sklearn: no model.joblib/model.pkl in {model_dir}")
+        try:
+            import joblib  # type: ignore
+
+            est = joblib.load(path)
+        except ImportError:
+            import pickle
+
+            with open(path, "rb") as f:
+                est = pickle.load(f)
+        return _FnModel(name, lambda instances: _np_list(est.predict(_np(instances))))
+
+    if loader == "xgboost":
+        path = _find(model_dir, "model.json", "model.ubj", "model.pkl")
+        if path is None:
+            raise FileNotFoundError(f"xgboost: no model file in {model_dir}")
+        if path.endswith(".pkl"):
+            import pickle
+
+            with open(path, "rb") as f:
+                booster = pickle.load(f)
+        else:
+            import xgboost  # type: ignore  # gated: not baked in this image
+
+            booster = xgboost.Booster()
+            booster.load_model(path)
+        return _FnModel(name, lambda instances: _np_list(booster.predict(_np(instances))))
+
+    if loader == "jax":
+        path = _find(model_dir, "model.py")
+        if path is None:
+            raise FileNotFoundError(f"jax: no model.py in {model_dir}")
+        mod = _load_module(path)
+        import jax
+
+        apply_fn, params = mod.load_jax(model_dir)
+        jitted = jax.jit(apply_fn)
+        return _FnModel(name, lambda instances: _np_list(jitted(params, _np(instances))))
+
+    if loader == "jetstream":
+        from .engine.serve import JetStreamModel
+
+        return JetStreamModel(name, model_dir)
+
+    if loader == "huggingface":
+        from transformers import pipeline  # CPU torch path in this image
+
+        task = os.environ.get("HF_TASK", "text-generation")
+        pipe = pipeline(task, model=model_dir)
+        return _FnModel(name, lambda instances: [pipe(x) for x in instances])
+
+    raise ValueError(f"unknown loader {loader!r}")
+
+
+def _np(instances):
+    import numpy as np
+
+    return np.asarray(instances)
+
+
+def _np_list(arr):
+    import numpy as np
+
+    return np.asarray(arr).tolist()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--loader", required=True)
+    p.add_argument("--model-name", required=True)
+    p.add_argument("--model-dir", default="")
+    p.add_argument("--port", type=int, required=True)
+    args = p.parse_args(argv)
+
+    model = load_model(args.loader, args.model_name, args.model_dir)
+    server = ModelServer([model], port=args.port)
+    print(f"runtime_main: serving {args.model_name} ({args.loader}) on :{server.port}", flush=True)
+    server.start(block=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,256 @@
+"""Model server: V1 + V2 (Open Inference) protocol HTTP server.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2a "KServe: Python model server"):
+``kserve.Model`` / ``kserve.ModelServer`` — FastAPI/Tornado servers exposing
+``/v1/models/:name:predict`` and the V2 ``/v2/models/:name/infer`` protocol.
+Here it is a dependency-free ThreadingHTTPServer so it runs identically inside
+pod subprocesses and in unit tests.
+
+The server also exposes ``/metrics`` (Prometheus text format) with an
+``inflight_requests`` gauge — that gauge is the signal the concurrency
+autoscaler (serving/autoscaler.py) scrapes, playing the role of Knative's
+queue-proxy metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+
+class Model:
+    """Base model: override load/predict (and optionally pre/postprocess).
+
+    The call chain for one request is
+    ``preprocess -> predict -> postprocess`` — transformers override the outer
+    two and delegate ``predict`` to the predictor host.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.ready = False
+
+    def load(self) -> None:
+        self.ready = True
+
+    def preprocess(self, payload: Any, headers: Optional[dict] = None) -> Any:
+        return payload
+
+    def predict(self, payload: Any, headers: Optional[dict] = None) -> Any:
+        raise NotImplementedError
+
+    def postprocess(self, payload: Any, headers: Optional[dict] = None) -> Any:
+        return payload
+
+    def explain(self, payload: Any, headers: Optional[dict] = None) -> Any:
+        raise NotImplementedError(f"model {self.name} has no explainer")
+
+    def __call__(self, payload: Any, headers: Optional[dict] = None, verb: str = "predict") -> Any:
+        x = self.preprocess(payload, headers)
+        y = self.explain(x, headers) if verb == "explain" else self.predict(x, headers)
+        return self.postprocess(y, headers)
+
+
+class _Metrics:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.inflight = 0
+        self.total = 0
+        self.latency_sum = 0.0
+        self.last_request_time = 0.0
+
+    def start(self) -> float:
+        with self.lock:
+            self.inflight += 1
+            self.total += 1
+            self.last_request_time = time.time()
+        return time.perf_counter()
+
+    def finish(self, t0: float) -> None:
+        with self.lock:
+            self.inflight -= 1
+            self.latency_sum += time.perf_counter() - t0
+
+    def render(self) -> str:
+        with self.lock:
+            return (
+                "# TYPE inflight_requests gauge\n"
+                f"inflight_requests {self.inflight}\n"
+                "# TYPE request_count counter\n"
+                f"request_count {self.total}\n"
+                "# TYPE request_latency_seconds_sum counter\n"
+                f"request_latency_seconds_sum {self.latency_sum:.6f}\n"
+                "# TYPE last_request_timestamp gauge\n"
+                f"last_request_timestamp {self.last_request_time:.3f}\n"
+            )
+
+
+class ModelServer:
+    """Serves registered models over V1 + V2 protocols on one port."""
+
+    def __init__(self, models: list[Model], port: int = 0, host: str = "127.0.0.1"):
+        self.models = {m.name: m for m in models}
+        self.metrics = _Metrics()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: Any, content_type: str = "application/json"):
+                data = body.encode() if isinstance(body, str) else json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> Any:
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b"{}"
+                return json.loads(raw or b"{}")
+
+            def do_GET(self):
+                server._handle_get(self)
+
+            def do_POST(self):
+                server._handle_post(self)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, block: bool = False) -> None:
+        for m in self.models.values():
+            if not m.ready:
+                m.load()
+        if block:
+            self.httpd.serve_forever(poll_interval=0.05)
+        else:
+            self._thread = threading.Thread(target=self.httpd.serve_forever, kwargs={"poll_interval": 0.05}, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # ------------------------------------------------------------- handlers
+
+    def _handle_get(self, h) -> None:
+        path = h.path.split("?")[0].rstrip("/")
+        if path == "/metrics":
+            h._send(200, self.metrics.render(), content_type="text/plain")
+        elif path in ("", "/", "/healthz", "/v2/health/live"):
+            h._send(200, {"status": "alive"})
+        elif path == "/v2/health/ready":
+            ready = all(m.ready for m in self.models.values())
+            h._send(200 if ready else 503, {"ready": ready})
+        elif path == "/v1/models":
+            h._send(200, {"models": sorted(self.models)})
+        elif path == "/v2":
+            h._send(200, {"name": "kubeflow-tpu-server", "extensions": []})
+        elif path == "/v2/models":
+            h._send(200, {"models": sorted(self.models)})
+        elif path.startswith("/v1/models/"):
+            name = path[len("/v1/models/"):]
+            m = self.models.get(name)
+            if m is None:
+                h._send(404, {"error": f"model {name} not found"})
+            else:
+                h._send(200 if m.ready else 503, {"name": name, "ready": m.ready})
+        elif path.startswith("/v2/models/"):
+            rest = path[len("/v2/models/"):]
+            name = rest.split("/")[0]
+            m = self.models.get(name)
+            if m is None:
+                h._send(404, {"error": f"model {name} not found"})
+            elif rest.endswith("/ready"):
+                h._send(200 if m.ready else 503, {"name": name, "ready": m.ready})
+            else:
+                h._send(200, {"name": name, "platform": "jax", "versions": ["1"]})
+        else:
+            h._send(404, {"error": f"no route {path}"})
+
+    def _handle_post(self, h) -> None:
+        path = h.path.split("?")[0]
+        t0 = self.metrics.start()
+        try:
+            if path.startswith("/v1/models/") and ":" in path:
+                name, _, verb = path[len("/v1/models/"):].partition(":")
+                self._v1(h, name, verb)
+            elif path.startswith("/v2/models/") and path.endswith("/infer"):
+                name = path[len("/v2/models/"):-len("/infer")]
+                self._v2(h, name)
+            else:
+                h._send(404, {"error": f"no route {path}"})
+        except Exception as e:  # noqa: BLE001 — server must answer
+            h._send(500, {"error": f"{type(e).__name__}: {e}"})
+        finally:
+            self.metrics.finish(t0)
+
+    def _v1(self, h, name: str, verb: str) -> None:
+        m = self.models.get(name)
+        if m is None:
+            h._send(404, {"error": f"model {name} not found"})
+            return
+        if verb not in ("predict", "explain"):
+            h._send(400, {"error": f"unknown verb {verb}"})
+            return
+        body = h._body()
+        headers = dict(h.headers.items())
+        result = m(body, headers, verb=verb)
+        # V1 contract: {"instances": [...]} -> {"predictions": [...]}
+        if isinstance(result, dict) and ("predictions" in result or "explanations" in result):
+            h._send(200, result)
+        else:
+            key = "explanations" if verb == "explain" else "predictions"
+            h._send(200, {key: result})
+
+    def _v2(self, h, name: str) -> None:
+        m = self.models.get(name)
+        if m is None:
+            h._send(404, {"error": f"model {name} not found"})
+            return
+        body = h._body()
+        headers = dict(h.headers.items())
+        # V2 request: {"inputs": [{name, shape, datatype, data}]}
+        result = m(body, headers)
+        if isinstance(result, dict) and "outputs" in result:
+            out = result
+            out.setdefault("model_name", name)
+        else:
+            data, shape, dtype = _as_v2_tensor(result)
+            out = {
+                "model_name": name,
+                "outputs": [{"name": "output-0", "shape": shape, "datatype": dtype, "data": data}],
+            }
+        h._send(200, out)
+
+
+def _as_v2_tensor(result: Any) -> tuple[list, list[int], str]:
+    """Flatten a nested-list/np result into (flat data, shape, datatype)."""
+    import numpy as np
+
+    arr = np.asarray(result)
+    dtype = {"f": "FP32", "i": "INT64", "b": "BOOL", "u": "UINT64"}.get(arr.dtype.kind, "FP32")
+    if arr.dtype.kind == "U" or arr.dtype.kind == "O":
+        return arr.reshape(-1).tolist(), list(arr.shape), "BYTES"
+    return arr.reshape(-1).tolist(), list(arr.shape), dtype
+
+
+def v2_inputs_to_arrays(body: dict):
+    """Decode a V2 request's inputs into numpy arrays (helper for models)."""
+    import numpy as np
+
+    out = {}
+    for t in body.get("inputs", []):
+        out[t["name"]] = np.asarray(t["data"]).reshape(t["shape"])
+    return out

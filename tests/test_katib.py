@@ -103,6 +103,39 @@ def test_hyperband_promotes_best():
     assert float(out[0]["epochs"]) == 3.0
 
 
+def running_trial(assignments):
+    return {
+        "spec": {"parameterAssignments": [{"name": k, "value": v} for k, v in assignments.items()]},
+        "status": {"conditions": [{"type": "Running", "status": "True"}]},
+    }
+
+
+def test_hyperband_no_duplicate_promotion_while_running():
+    """A promotion issued last round but still running must not be re-issued."""
+    exp = experiment(
+        "e3",
+        [Parameter("lr", "double", min=0.1, max=1.0),
+         Parameter("epochs", "double", min=1, max=9)],
+        {"kind": "TPUJob", "spec": {}}, "acc", algorithm="hyperband",
+        algorithm_settings={"resource_name": "epochs", "eta": 3, "min_resource": 1, "max_resource": 9},
+    )
+    trials = [fake_trial({"lr": lr, "epochs": 1.0}, acc, "acc")
+              for lr, acc in [(0.1, 0.5), (0.4, 0.9), (0.8, 0.3)]]
+    trials.append(running_trial({"lr": 0.4, "epochs": 3.0}))  # the earlier promotion
+    out = get_suggester("hyperband").suggest(exp, trials, 2)
+    for a in out:
+        assert not (float(a["lr"]) == 0.4 and float(a["epochs"]) == 3.0), out
+        # unevaluated rung-3 placeholder must not cascade to rung 9 either
+        assert float(a["epochs"]) == 1.0, out
+
+
+def test_random_state_zero_is_deterministic():
+    exp = make_exp_obj("random", settings={"random_state": 0})
+    a = get_suggester("random").suggest(exp, [], 4)
+    b = get_suggester("random").suggest(exp, [], 4)
+    assert a == b
+
+
 # ------------------------------------------------------------------- metrics
 
 def test_parse_metrics_formats():
@@ -218,6 +251,20 @@ def test_experiment_goal_early_stop(kcluster):
     assert exp["status"]["trialsSucceeded"] < 50
     reason = [c for c in exp["status"]["conditions"] if c["type"] == kapi.SUCCEEDED][0]["reason"]
     assert reason == "GoalReached"
+
+
+def test_grid_exhaustion_ends_experiment(kcluster):
+    """A grid smaller than maxTrialCount must end with SuggestionEndReached,
+    not hang (the experiment used to stay Running forever)."""
+    client = KatibClient(kcluster)
+    spec = _sweep_spec("smallgrid", "grid", max_trials=10)
+    spec["spec"]["algorithm"]["algorithmSettings"] = [{"name": "default_steps", "value": "3"}]
+    client.create_experiment(spec)
+    assert client.wait_for_experiment("smallgrid", timeout=300) == kapi.SUCCEEDED
+    exp = client.get_experiment("smallgrid")
+    assert exp["status"]["trialsSucceeded"] == 3  # the full 3-point grid
+    reason = [c for c in exp["status"]["conditions"] if c["type"] == kapi.SUCCEEDED][0]["reason"]
+    assert reason == "SuggestionEndReached"
 
 
 def test_trial_metrics_unavailable_fails(kcluster):

@@ -1,0 +1,266 @@
+"""Serving pillar tests: protocol server units + full ISVC e2e through the
+reconcile path (SURVEY.md §4: envtest-equivalent + real pod processes)."""
+
+import json
+import os
+import textwrap
+import threading
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.core.api import APIServer, Invalid
+from kubeflow_tpu.core.cluster import Cluster
+from kubeflow_tpu.serving import install
+from kubeflow_tpu.serving import api as sapi
+from kubeflow_tpu.serving.api import inference_service
+from kubeflow_tpu.serving.controllers import SCALED_TO_ZERO_ANNOTATION
+from kubeflow_tpu.serving.runtimes import install_default_runtimes, select_runtime
+from kubeflow_tpu.serving.server import Model, ModelServer
+from kubeflow_tpu.serving.storage import StorageError, download
+
+
+# --------------------------------------------------------------------- units
+
+
+def test_isvc_validation_and_defaulting():
+    api = APIServer()
+    sapi.register(api)
+    with pytest.raises(Invalid):
+        api.create({"apiVersion": f"{sapi.GROUP}/v1beta1", "kind": "InferenceService",
+                    "metadata": {"name": "x"}, "spec": {}})
+    with pytest.raises(Invalid):
+        api.create(inference_service("x", model_format="jax", canary_traffic_percent=150))
+    obj = api.create(inference_service("ok", model_format="sklearn", storage_uri="file:///tmp/m"))
+    pred = obj["spec"]["predictor"]
+    assert pred["minReplicas"] == 1 and pred["maxReplicas"] == 3 and pred["scaleTarget"] == 4
+    assert pred["model"]["modelFormat"] == {"name": "sklearn"}
+
+
+def test_runtime_selection():
+    api = APIServer()
+    sapi.register(api)
+    install_default_runtimes(api)
+    assert select_runtime(api, "default", {"modelFormat": {"name": "sklearn"}})["metadata"]["name"] == "kserve-sklearn"
+    # llama routes to the high-priority jetstream runtime
+    assert select_runtime(api, "default", {"modelFormat": {"name": "llama"}})["metadata"]["name"] == "kserve-jetstream"
+    # explicit runtime name wins
+    assert select_runtime(api, "default", {"modelFormat": {"name": "sklearn"}, "runtime": "kserve-sklearn"})["metadata"]["name"] == "kserve-sklearn"
+    with pytest.raises(LookupError):
+        select_runtime(api, "default", {"modelFormat": {"name": "nope"}})
+    # namespaced runtime beats cluster runtime at equal priority
+    api.create({
+        "apiVersion": f"{sapi.GROUP}/v1alpha1", "kind": "ServingRuntime",
+        "metadata": {"name": "my-sklearn", "namespace": "default"},
+        "spec": {"supportedModelFormats": [{"name": "sklearn", "autoSelect": True}],
+                 "containers": [{"name": "c", "command": ["x"]}]},
+    })
+    assert select_runtime(api, "default", {"modelFormat": {"name": "sklearn"}})["metadata"]["name"] == "my-sklearn"
+
+
+class _Doubler(Model):
+    def predict(self, payload, headers=None):
+        instances = payload["instances"] if isinstance(payload, dict) and "instances" in payload else payload
+        if isinstance(payload, dict) and "inputs" in payload:  # v2
+            t = payload["inputs"][0]
+            return [x * 2 for x in t["data"]]
+        return [x * 2 for x in instances]
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(url, body):
+    req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_model_server_v1_v2_protocols():
+    server = ModelServer([_Doubler("m")], port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        assert _get(f"{base}/v1/models")[1] == {"models": ["m"]}
+        assert _get(f"{base}/v1/models/m")[1] == {"name": "m", "ready": True}
+        assert _get(f"{base}/v2/health/ready")[0] == 200
+        code, out = _post(f"{base}/v1/models/m:predict", {"instances": [1, 2, 3]})
+        assert out == {"predictions": [2, 4, 6]}
+        code, out = _post(f"{base}/v2/models/m/infer",
+                          {"inputs": [{"name": "in", "shape": [3], "datatype": "INT64", "data": [1, 2, 3]}]})
+        assert out["outputs"][0]["data"] == [2, 4, 6]
+        assert out["model_name"] == "m"
+        # metrics endpoint feeds the autoscaler
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "request_count 2" in text and "inflight_requests 0" in text
+    finally:
+        server.stop()
+
+
+def test_storage_initializer(tmp_path):
+    src = tmp_path / "model"
+    src.mkdir()
+    (src / "model.py").write_text("x = 1")
+    dest = tmp_path / "out"
+    download(f"file://{src}", str(dest))
+    assert (dest / "model.py").read_text() == "x = 1"
+    with pytest.raises(StorageError):
+        download("gs://bucket/model", str(tmp_path / "out2"))
+    os.environ["KSERVE_STORAGE_MIRROR"] = str(tmp_path / "mirror")
+    try:
+        mirrored = tmp_path / "mirror" / "gs" / "bucket" / "model"
+        mirrored.mkdir(parents=True)
+        (mirrored / "w.txt").write_text("hi")
+        download("gs://bucket/model", str(tmp_path / "out3"))
+        assert (tmp_path / "out3" / "w.txt").read_text() == "hi"
+    finally:
+        del os.environ["KSERVE_STORAGE_MIRROR"]
+
+
+# ----------------------------------------------------------------------- e2e
+
+
+def _write_pyfunc_model(tmp_path, name: str, factor: int):
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    (d / "model.py").write_text(f"def predict(instances):\n    return [x * {factor} for x in instances]\n")
+    return d
+
+
+@pytest.fixture()
+def scluster(tmp_path):
+    c = Cluster(cpu_nodes=1, base_env={"PYTHONPATH": os.getcwd()})
+    router, proxy = install(c.api, c.manager)
+    yield c, router, tmp_path
+    proxy.shutdown()
+    c.shutdown()
+
+
+def _wait_ready(c, name, timeout=60):
+    def ready():
+        isvc = c.api.try_get("InferenceService", name)
+        st = (isvc or {}).get("status", {})
+        return any(x["type"] == "Ready" and x["status"] == "True" for x in st.get("conditions", []))
+    assert c.wait_for(ready, timeout=timeout), _debug(c, name)
+
+
+def _debug(c, name):
+    isvc = c.api.try_get("InferenceService", name)
+    pods = [(p["metadata"]["name"], p.get("status", {}).get("phase"),
+             c.logs(p["metadata"]["name"])[-500:]) for p in c.api.list("Pod")]
+    return f"status={json.dumps((isvc or {}).get('status', {}), default=str)[:800]} pods={pods}"
+
+
+def test_isvc_pyfunc_end_to_end(scluster):
+    c, router, tmp_path = scluster
+    model_dir = _write_pyfunc_model(tmp_path, "m1", factor=2)
+    c.apply(inference_service("double", model_format="pyfunc",
+                              storage_uri=f"file://{model_dir}", max_replicas=2))
+    _wait_ready(c, "double")
+    isvc = c.api.get("InferenceService", "double")
+    assert isvc["status"]["url"].startswith("http://127.0.0.1:")
+    assert isvc["status"]["components"]["predictor"]["latestReadyRevision"]
+    out = router.predict("double", {"instances": [1, 2, 3]})
+    assert out == {"predictions": [2, 4, 6]}
+    # V2 path through the same proxy
+    out = router.predict("double", {"inputs": [{"name": "in", "shape": [2], "datatype": "FP32",
+                                                "data": [1.5, 2.5]}]}, protocol="v2")
+    assert out["outputs"][0]["data"] == [3.0, 5.0]
+
+
+def test_isvc_transformer_chain(scluster):
+    c, router, tmp_path = scluster
+    model_dir = _write_pyfunc_model(tmp_path, "m1", factor=2)
+    tdir = tmp_path / "t"
+    tdir.mkdir()
+    (tdir / "model.py").write_text(textwrap.dedent("""
+        from kubeflow_tpu.serving.server import Model
+
+        class UserModel(Model):
+            predictor = None  # injected PredictorClient
+
+            def preprocess(self, payload, headers=None):
+                return {"instances": [x + 1 for x in payload["instances"]]}
+
+            def predict(self, payload, headers=None):
+                return self.predictor.predict(self.name, payload)["predictions"]
+
+            def postprocess(self, payload, headers=None):
+                return [x - 1 for x in payload]
+    """))
+    c.apply(inference_service(
+        "chain",
+        model_format="pyfunc",
+        storage_uri=f"file://{model_dir}",
+        transformer={"model": {"modelFormat": {"name": "pyfunc"}, "storageUri": f"file://{tdir}"}},
+    ))
+    _wait_ready(c, "chain")
+    # (x+1)*2 - 1
+    out = router.predict("chain", {"instances": [1, 2, 3]})
+    assert out == {"predictions": [3, 5, 7]}
+
+
+def test_isvc_canary_split_and_promotion(scluster):
+    c, router, tmp_path = scluster
+    m_old = _write_pyfunc_model(tmp_path, "old", factor=2)
+    m_new = _write_pyfunc_model(tmp_path, "new", factor=10)
+    c.apply(inference_service("canary", model_format="pyfunc", storage_uri=f"file://{m_old}"))
+    _wait_ready(c, "canary")
+
+    # roll out a canary at 30%
+    c.apply(inference_service("canary", model_format="pyfunc",
+                              storage_uri=f"file://{m_new}", canary_traffic_percent=30))
+
+    def both_ready():
+        isvc = c.api.try_get("InferenceService", "canary")
+        tr = (isvc or {}).get("status", {}).get("components", {}).get("predictor", {}).get("traffic", [])
+        deploys = c.api.list("Deployment", label_selector={sapi.LABEL_ISVC: "canary"})
+        return len(tr) == 2 and len(deploys) == 2 and all(
+            d.get("status", {}).get("readyReplicas", 0) >= 1 for d in deploys)
+    assert c.wait_for(both_ready, timeout=60), _debug(c, "canary")
+
+    results = [router.predict("canary", {"instances": [1]})["predictions"][0] for _ in range(100)]
+    new_hits = sum(1 for r in results if r == 10)
+    assert new_hits == 30, f"expected exactly 30/100 canary hits (deterministic split), got {new_hits}"
+    assert sum(1 for r in results if r == 2) == 70
+
+    # promote: clear canary → old revision garbage-collected
+    c.apply(inference_service("canary", model_format="pyfunc", storage_uri=f"file://{m_new}"))
+
+    def promoted():
+        deploys = c.api.list("Deployment", label_selector={sapi.LABEL_ISVC: "canary"})
+        return len(deploys) == 1 and deploys[0].get("status", {}).get("readyReplicas", 0) >= 1
+    assert c.wait_for(promoted, timeout=60), _debug(c, "canary")
+    assert all(router.predict("canary", {"instances": [1]})["predictions"][0] == 10 for _ in range(5))
+
+
+def test_isvc_scale_to_zero_and_activation(scluster):
+    c, router, tmp_path = scluster
+    model_dir = _write_pyfunc_model(tmp_path, "m1", factor=3)
+    isvc = inference_service("zero", model_format="pyfunc",
+                             storage_uri=f"file://{model_dir}", min_replicas=0)
+    c.apply(isvc)
+    _wait_ready(c, "zero")
+
+    def scaled_to_zero():
+        deploys = c.api.list("Deployment", label_selector={sapi.LABEL_ISVC: "zero"})
+        return deploys and all(d["spec"]["replicas"] == 0 for d in deploys)
+    assert c.wait_for(scaled_to_zero, timeout=60), _debug(c, "zero")
+    assert not [p for p in c.api.list("Pod") if p["metadata"]["labels"].get(sapi.LABEL_ISVC) == "zero"]
+    # isvc stays Ready while scaled to zero
+    deploys = c.api.list("Deployment", label_selector={sapi.LABEL_ISVC: "zero"})
+    assert deploys[0]["metadata"]["annotations"].get(SCALED_TO_ZERO_ANNOTATION) == "true"
+    _wait_ready(c, "zero", timeout=10)
+
+    # activator: request against zero scale wakes the deployment up
+    result = {}
+    def fire():
+        result["out"] = router.predict("zero", {"instances": [2]})
+    t = threading.Thread(target=fire, daemon=True)
+    t.start()
+    assert c.wait_for(lambda: "out" in result, timeout=60), _debug(c, "zero")
+    assert result["out"] == {"predictions": [6]}
