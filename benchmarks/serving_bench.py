@@ -1,0 +1,96 @@
+"""Serving latency/throughput bench for the JetStream-style engine.
+
+BASELINE.md row "KServe Llama-3-8B p50": the reference publishes no numbers
+("establish").  This harness measures, on the real chip:
+
+  * decode throughput (tokens/s) under a closed-loop concurrent load,
+  * per-request p50/p99 latency and TTFT,
+
+for a configurable decoder size.  Default is a ~1B-param Llama-style config
+sized for one v5e chip (bf16 weights + paged KV must fit 16 GB HBM); pass
+``--config llama3_8b`` on a pod slice.
+
+Usage: python benchmarks/serving_bench.py [--config tiny|1b|llama3_8b]
+       [--requests 32] [--concurrency 8] [--prompt-len 128] [--max-tokens 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def configs():
+    from kubeflow_tpu.serving.engine.model import DecoderConfig
+
+    return {
+        "tiny": DecoderConfig(vocab_size=2048, d_model=256, n_layers=4,
+                              n_heads=8, n_kv_heads=4, d_ff=688),
+        "1b": DecoderConfig(vocab_size=32128, d_model=2048, n_layers=16,
+                            n_heads=16, n_kv_heads=8, d_ff=5504),
+        "llama3_8b": DecoderConfig.llama3_8b(),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="1b", choices=["tiny", "1b", "llama3_8b"])
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--max-tokens", type=int, default=64)
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import Engine, EngineConfig
+    from kubeflow_tpu.serving.engine.model import init
+
+    config = configs()[args.config]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    params = init(jax.random.PRNGKey(0), config)
+    engine = Engine(
+        params, config,
+        EngineConfig(max_slots=args.concurrency, num_pages=1024, page_size=32,
+                     max_pages_per_slot=(args.prompt_len + args.max_tokens) // 32 + 2),
+    )
+    engine.start()
+    rng = np.random.default_rng(0)
+
+    def prompt():
+        return rng.integers(1, config.vocab_size, size=args.prompt_len).tolist()
+
+    # warmup: compile prefill bucket + decode step
+    engine.generate(prompt(), 4)
+
+    t0 = time.perf_counter()
+    futs = [engine.generate_async(prompt(), args.max_tokens) for _ in range(args.requests)]
+    results = [f.result(timeout=1800) for f in futs]
+    wall = time.perf_counter() - t0
+    engine.stop()
+
+    lat = np.array([r["latency_s"] for r in results])
+    ttft = np.array([r["ttft_s"] for r in results])
+    toks = sum(r["num_tokens"] for r in results)
+    print(json.dumps({
+        "metric": f"serving_decode_tokens_per_sec_{args.config}",
+        "value": round(toks / wall, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+        "p50_latency_s": round(float(np.percentile(lat, 50)), 4),
+        "p99_latency_s": round(float(np.percentile(lat, 99)), 4),
+        "p50_ttft_s": round(float(np.percentile(ttft, 50)), 4),
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len,
+        "max_tokens": args.max_tokens,
+        "param_count": config.param_count(),
+        "platform": jax.devices()[0].platform,
+        "on_tpu": on_tpu,
+    }))
+
+
+if __name__ == "__main__":
+    main()

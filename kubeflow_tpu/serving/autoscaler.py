@@ -88,9 +88,13 @@ class ConcurrencyAutoscaler:
             ready += 1
             port = pod_port(p)
             m = scrape_metrics(port) if port else None
-            if m:
-                inflight += m.get("inflight_requests", 0.0)
-                last_traffic = max(last_traffic, m.get("last_request_timestamp", 0.0))
+            if m is None:
+                # a ready pod we cannot scrape (busy with a long request, or
+                # mid-restart) means traffic state is UNKNOWN — never make a
+                # scale-down decision on missing data
+                return False
+            inflight += m.get("inflight_requests", 0.0)
+            last_traffic = max(last_traffic, m.get("last_request_timestamp", 0.0))
         self._last_traffic[uid] = last_traffic
 
         if current == 0:

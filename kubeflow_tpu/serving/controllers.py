@@ -139,11 +139,12 @@ class DeploymentReconciler:
             if self._probe_pod(p):
                 ready += 1
 
+        # NOTE: no resourceVersion-derived fields here — status must be a pure
+        # function of pod state or the != guard below self-retriggers forever
         status = {
             "replicas": len(by_name),
             "readyReplicas": ready,
             "updatedReplicas": len(by_name),
-            "observedGeneration": deploy["metadata"]["resourceVersion"],
         }
         fresh = self.api.get("Deployment", req.name, req.namespace)
         if fresh.get("status") != status:
@@ -443,7 +444,3 @@ class InferenceServiceReconciler:
         ):
             if d["metadata"]["labels"].get(LABEL_REVISION) not in keep:
                 self.api.try_delete("Deployment", d["metadata"]["name"], ns)
-
-
-def render_container_port(port) -> str:  # convenience for runtimes.render_container
-    return str(port)

@@ -1,0 +1,5 @@
+"""JetStream-style TPU inference engine (SURVEY.md §2b: the Triton/TF-Serving
+replacement): C++ continuous batcher + paged-KV JAX decode."""
+
+from .engine import Engine, EngineConfig  # noqa: F401
+from .model import DecoderConfig  # noqa: F401

@@ -1,0 +1,214 @@
+// Continuous-batching engine core: request queue, slot scheduler, paged-KV
+// allocator.  C ABI for ctypes (this image has no pybind11).
+//
+// Role in the stack (SURVEY.md §2b): the TPU-native replacement for the
+// reference stack's Triton C++ serving core — "request queue / batcher /
+// KV-paging in C++ with JAX compute".  The Python side (engine.py) owns the
+// JAX prefill/decode; this core owns admission, slot lifecycle and KV page
+// accounting, and is safe to call from server threads (one mutex, no
+// allocation on the hot path).
+//
+// Memory model: a fixed pool of `num_pages` KV pages of `page_size` tokens.
+// Each active slot holds ceil(seq_len / page_size) pages, capped at
+// max_pages_per_slot.  Admission is all-or-nothing: a request enters a slot
+// only if its whole prompt fits in free pages (decode growth may still hit
+// OOM; commit_token reports it so the scheduler can preempt).
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Request {
+  int64_t id;
+  int32_t prompt_len;
+  int32_t max_new_tokens;
+};
+
+struct Slot {
+  bool active = false;
+  int64_t req_id = -1;
+  int32_t seq_len = 0;        // tokens currently in KV (prompt + generated)
+  int32_t generated = 0;
+  int32_t max_new_tokens = 0;
+  std::vector<int32_t> pages; // page ids owned by this slot
+};
+
+struct Engine {
+  std::mutex mu;
+  int32_t max_slots;
+  int32_t num_pages;
+  int32_t page_size;
+  int32_t max_pages_per_slot;
+  std::deque<Request> queue;
+  std::vector<Slot> slots;
+  std::vector<int32_t> free_pages; // LIFO free list
+  int64_t total_admitted = 0;
+  int64_t total_completed = 0;
+};
+
+int32_t pages_needed(const Engine* e, int32_t tokens) {
+  return (tokens + e->page_size - 1) / e->page_size;
+}
+
+}  // namespace
+
+extern "C" {
+
+Engine* eng_create(int32_t max_slots, int32_t num_pages, int32_t page_size,
+                   int32_t max_pages_per_slot) {
+  if (max_slots <= 0 || num_pages <= 0 || page_size <= 0 ||
+      max_pages_per_slot <= 0)
+    return nullptr;
+  Engine* e = new Engine();
+  e->max_slots = max_slots;
+  e->num_pages = num_pages;
+  e->page_size = page_size;
+  e->max_pages_per_slot = max_pages_per_slot;
+  e->slots.resize(max_slots);
+  // Page 0 is RESERVED as the trash page and never allocated: the fused
+  // decode step writes every slot's current-token KV unconditionally (static
+  // shapes), and inactive/padded slots point at page 0 — reserving it makes
+  // those writes harmless by construction.  Usable capacity: num_pages - 1.
+  e->free_pages.reserve(num_pages - 1);
+  for (int32_t p = num_pages - 1; p >= 1; --p) e->free_pages.push_back(p);
+  return e;
+}
+
+void eng_destroy(Engine* e) { delete e; }
+
+// Enqueue a request. Returns 0, or -1 if the prompt can never fit.
+int32_t eng_submit(Engine* e, int64_t req_id, int32_t prompt_len,
+                   int32_t max_new_tokens) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  if (pages_needed(e, prompt_len + max_new_tokens) > e->max_pages_per_slot)
+    return -1;
+  e->queue.push_back({req_id, prompt_len, max_new_tokens});
+  return 0;
+}
+
+// Admit the head-of-line request into a free slot if its prompt fits in free
+// pages.  Returns the slot id (prompt pages already allocated) or -1.
+int32_t eng_admit(Engine* e, int64_t* out_req_id, int32_t* out_prompt_len,
+                  int32_t* out_max_new) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  if (e->queue.empty()) return -1;
+  int32_t slot_id = -1;
+  for (int32_t s = 0; s < e->max_slots; ++s)
+    if (!e->slots[s].active) { slot_id = s; break; }
+  if (slot_id < 0) return -1;
+  const Request& r = e->queue.front();
+  int32_t need = pages_needed(e, r.prompt_len);
+  if (need > static_cast<int32_t>(e->free_pages.size())) return -1;
+  Slot& slot = e->slots[slot_id];
+  slot.active = true;
+  slot.req_id = r.id;
+  slot.seq_len = r.prompt_len;
+  slot.generated = 0;
+  slot.max_new_tokens = r.max_new_tokens;
+  slot.pages.clear();
+  for (int32_t i = 0; i < need; ++i) {
+    slot.pages.push_back(e->free_pages.back());
+    e->free_pages.pop_back();
+  }
+  *out_req_id = r.id;
+  *out_prompt_len = r.prompt_len;
+  *out_max_new = r.max_new_tokens;
+  e->queue.pop_front();
+  e->total_admitted++;
+  return slot_id;
+}
+
+// Record one generated token for a slot, growing its KV by one position.
+// Returns 1 = keep decoding, 0 = request finished (eos or budget),
+// -2 = page pool exhausted (caller should preempt/release), -1 = bad slot.
+int32_t eng_commit_token(Engine* e, int32_t slot_id, int32_t is_eos) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  if (slot_id < 0 || slot_id >= e->max_slots) return -1;
+  Slot& slot = e->slots[slot_id];
+  if (!slot.active) return -1;
+  int32_t need = pages_needed(e, slot.seq_len + 1);
+  if (need > static_cast<int32_t>(slot.pages.size())) {
+    if (need > e->max_pages_per_slot) return 0;  // hit the per-slot cap: done
+    if (e->free_pages.empty()) return -2;
+    slot.pages.push_back(e->free_pages.back());
+    e->free_pages.pop_back();
+  }
+  slot.seq_len++;
+  slot.generated++;
+  if (is_eos || slot.generated >= slot.max_new_tokens) return 0;
+  return 1;
+}
+
+void eng_release(Engine* e, int32_t slot_id) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  if (slot_id < 0 || slot_id >= e->max_slots) return;
+  Slot& slot = e->slots[slot_id];
+  if (!slot.active) return;
+  for (int32_t p : slot.pages) e->free_pages.push_back(p);
+  slot.pages.clear();
+  slot.active = false;
+  slot.req_id = -1;
+  slot.seq_len = 0;
+  e->total_completed++;
+}
+
+// Snapshots for the JAX side (caller provides buffers).
+void eng_page_table(Engine* e, int32_t* out /* max_slots*max_pages_per_slot */) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  for (int32_t s = 0; s < e->max_slots; ++s) {
+    const Slot& slot = e->slots[s];
+    for (int32_t i = 0; i < e->max_pages_per_slot; ++i) {
+      out[s * e->max_pages_per_slot + i] =
+          (slot.active && i < static_cast<int32_t>(slot.pages.size()))
+              ? slot.pages[i]
+              : 0;  // trash page: safe to write AND gather; masked by seq_lens
+    }
+  }
+}
+
+void eng_seq_lens(Engine* e, int32_t* out /* max_slots */) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  for (int32_t s = 0; s < e->max_slots; ++s)
+    out[s] = e->slots[s].active ? e->slots[s].seq_len : 0;
+}
+
+void eng_active_mask(Engine* e, int32_t* out /* max_slots */) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  for (int32_t s = 0; s < e->max_slots; ++s)
+    out[s] = e->slots[s].active ? 1 : 0;
+}
+
+int64_t eng_slot_req(Engine* e, int32_t slot_id) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  if (slot_id < 0 || slot_id >= e->max_slots) return -1;
+  return e->slots[slot_id].active ? e->slots[slot_id].req_id : -1;
+}
+
+int32_t eng_slot_seq_len(Engine* e, int32_t slot_id) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  if (slot_id < 0 || slot_id >= e->max_slots) return 0;
+  return e->slots[slot_id].seq_len;
+}
+
+int32_t eng_num_free_pages(Engine* e) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  return static_cast<int32_t>(e->free_pages.size());
+}
+
+int32_t eng_queue_depth(Engine* e) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  return static_cast<int32_t>(e->queue.size());
+}
+
+int32_t eng_num_active(Engine* e) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  int32_t n = 0;
+  for (const Slot& s : e->slots) n += s.active ? 1 : 0;
+  return n;
+}
+
+}  // extern "C"

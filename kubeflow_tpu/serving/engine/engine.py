@@ -1,0 +1,243 @@
+"""The inference engine: C++ batcher + JAX paged prefill/decode loop.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2b "Triton Inference Server" row):
+the TPU-native continuous-batching decode server (JetStream-class).  Request
+admission, slot lifecycle and KV page accounting live in the C++ core
+(core.cc via native.py); this module runs the decode loop on the accelerator:
+
+    loop:
+      admit queued requests into free slots  (C++ decides, all-or-nothing)
+      for each admission: bucketed prefill -> scatter KV pages -> first token
+      one fused decode_step over ALL slots  (static shapes, no recompiles)
+      commit sampled tokens (C++ grows pages; reports finish/OOM)
+
+Continuous batching means a long generation never blocks a short one: slots
+free individually and the queue drains into them mid-flight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from .model import DecoderConfig, decode_step, prefill, write_pages
+from .native import NativeBatcher
+
+PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    max_slots: int = 8
+    num_pages: int = 512
+    page_size: int = 32
+    max_pages_per_slot: int = 64
+    eos_id: int = -1           # -1: never stop early
+    temperature: float = 0.0   # 0 = greedy
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class _Pending:
+    tokens: list          # prompt token ids
+    max_new_tokens: int
+    future: Future
+    generated: list = dataclasses.field(default_factory=list)
+    submitted_at: float = 0.0
+    first_token_at: float = 0.0
+
+
+class Engine:
+    """Continuous-batching generation engine over one jit'd model."""
+
+    def __init__(self, params, config: DecoderConfig, engine_config: EngineConfig = EngineConfig()):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = params
+        self.config = config
+        self.ec = engine_config
+        self.batcher = NativeBatcher(
+            engine_config.max_slots, engine_config.num_pages,
+            engine_config.page_size, engine_config.max_pages_per_slot,
+        )
+        c = config
+        shape = (c.n_layers, engine_config.num_pages, engine_config.page_size,
+                 c.n_kv_heads, c.head_dim)
+        self.k_pool = jnp.zeros(shape, jnp.bfloat16)
+        self.v_pool = jnp.zeros(shape, jnp.bfloat16)
+        self._requests: dict[int, _Pending] = {}
+        self._slot_req: dict[int, int] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._rng = np.random.default_rng(engine_config.seed)
+        self._jax = jax
+        self._jnp = jnp
+
+    # ---------------------------------------------------------------- public
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.batcher.close()
+
+    def generate_async(self, tokens: list[int], max_new_tokens: int = 32) -> Future:
+        """Submit a prompt; the Future resolves to a result dict."""
+        if not tokens:
+            raise ValueError("empty prompt")
+        fut: Future = Future()
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._requests[rid] = _Pending(
+                tokens=list(tokens), max_new_tokens=max_new_tokens,
+                future=fut, submitted_at=time.perf_counter(),
+            )
+        if not self.batcher.submit(rid, len(tokens), max_new_tokens):
+            with self._lock:
+                del self._requests[rid]
+            raise ValueError(
+                f"prompt+generation ({len(tokens)}+{max_new_tokens}) exceeds engine capacity "
+                f"({self.ec.max_pages_per_slot * self.ec.page_size} tokens/slot)"
+            )
+        self._wake.set()
+        return fut
+
+    def generate(self, tokens: list[int], max_new_tokens: int = 32, timeout: float = 300.0) -> dict:
+        return self.generate_async(tokens, max_new_tokens).result(timeout=timeout)
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "active_slots": self.batcher.num_active,
+            "queue_depth": self.batcher.queue_depth,
+            "free_pages": self.batcher.free_pages,
+        }
+
+    # ------------------------------------------------------------------ loop
+
+    def _bucket(self, n: int) -> int:
+        for b in PREFILL_BUCKETS:
+            if n <= b:
+                return b
+        return PREFILL_BUCKETS[-1]
+
+    def _sample(self, logits: np.ndarray) -> np.ndarray:
+        if self.ec.temperature <= 0.0:
+            return np.argmax(logits, axis=-1).astype(np.int32)
+        z = logits / self.ec.temperature
+        z = z - z.max(axis=-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=-1, keepdims=True)
+        return np.array(
+            [self._rng.choice(logits.shape[-1], p=p[i]) for i in range(logits.shape[0])],
+            np.int32,
+        )
+
+    def _loop(self) -> None:
+        jnp = self._jnp
+        while self._running:
+            did_work = False
+
+            # --- admission + prefill (C++ decides; Python runs the compute)
+            while True:
+                admitted = self.batcher.admit()
+                if admitted is None:
+                    break
+                did_work = True
+                slot, rid, plen, _ = admitted
+                with self._lock:
+                    pending = self._requests.get(rid)
+                if pending is None:  # cancelled
+                    self.batcher.release(slot)
+                    continue
+                self._slot_req[slot] = rid
+                bucket = self._bucket(plen)
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :plen] = pending.tokens[:plen]
+                logits, pk, pv = prefill(
+                    self.params, self.config, jnp.asarray(toks),
+                    jnp.int32(plen), self.ec.page_size,
+                )
+                page_ids = self.batcher.page_table()[slot][: self._pages_for(bucket)]
+                # prefill produced bucket/page_size pages; slot owns
+                # ceil(plen/page_size) — scatter only the owned prefix
+                owned = (plen + self.ec.page_size - 1) // self.ec.page_size
+                self.k_pool, self.v_pool = write_pages(
+                    self.k_pool, self.v_pool,
+                    pk[:, :owned], pv[:, :owned], jnp.asarray(page_ids[:owned]),
+                )
+                first = int(np.asarray(logits).argmax(-1)[0]) if self.ec.temperature <= 0 \
+                    else int(self._sample(np.asarray(logits))[0])
+                pending.first_token_at = time.perf_counter()
+                self._commit(slot, first)
+
+            # --- one decode step over all active slots
+            active = self.batcher.active_mask()
+            if active.any():
+                did_work = True
+                tokens = np.zeros((self.ec.max_slots,), np.int32)
+                for slot in range(self.ec.max_slots):
+                    rid = self._slot_req.get(slot)
+                    if active[slot] and rid is not None:
+                        gen = self._requests[rid].generated
+                        tokens[slot] = gen[-1] if gen else 0
+                logits, self.k_pool, self.v_pool = decode_step(
+                    self.params, self.config, jnp.asarray(tokens),
+                    jnp.asarray(self.batcher.seq_lens()),
+                    jnp.asarray(self.batcher.page_table()),
+                    self.k_pool, self.v_pool,
+                )
+                sampled = self._sample(np.asarray(logits))
+                for slot in range(self.ec.max_slots):
+                    if active[slot] and slot in self._slot_req:
+                        self._commit(slot, int(sampled[slot]))
+
+            if not did_work:
+                self._wake.wait(timeout=0.02)
+                self._wake.clear()
+
+    def _pages_for(self, tokens: int) -> int:
+        return (tokens + self.ec.page_size - 1) // self.ec.page_size
+
+    def _commit(self, slot: int, token: int) -> None:
+        rid = self._slot_req[slot]
+        pending = self._requests[rid]
+        pending.generated.append(token)
+        is_eos = token == self.ec.eos_id
+        rc = self.batcher.commit_token(slot, is_eos)
+        if rc == 1:
+            return
+        # finished (0) or page-pool OOM (-2): either way the slot frees; OOM
+        # truncates the generation rather than deadlocking the pool
+        self._finish(slot, rid, truncated=(rc == -2))
+
+    def _finish(self, slot: int, rid: int, truncated: bool) -> None:
+        pending = self._requests.pop(rid)
+        self._slot_req.pop(slot, None)
+        self.batcher.release(slot)
+        now = time.perf_counter()
+        pending.future.set_result(
+            {
+                "tokens": pending.generated,
+                "num_tokens": len(pending.generated),
+                "truncated": truncated,
+                "ttft_s": pending.first_token_at - pending.submitted_at,
+                "latency_s": now - pending.submitted_at,
+            }
+        )

@@ -1,0 +1,269 @@
+"""Llama-class decoder in JAX with a paged KV cache.
+
+TPU-first design notes (not a port of any CUDA server):
+  * all shapes static under jit — prompt lengths bucketed, decode batch is
+    always the full slot set with a mask (inactive slots compute garbage that
+    is never read; far cheaper than recompiles);
+  * KV lives in a page pool ``[layers, num_pages, page_size, kv_heads, hd]``;
+    the page table gathers per-slot pages — the JAX analogue of paged
+    attention, with the page bookkeeping in the C++ core (native.py);
+  * weights bf16 (MXU native), attention math f32 accumulations via
+    ``preferred_element_type`` where it matters;
+  * GQA (n_kv_heads <= n_heads), RoPE, RMSNorm, SwiGLU — the Llama-3 family
+    block (reference serves Llama-3-8B via Triton; BASELINE.md KServe row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 2048
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 688
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "DecoderConfig":
+        return DecoderConfig(vocab_size=128256, d_model=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=8, d_ff=14336, rope_theta=500000.0)
+
+    @staticmethod
+    def from_dir(model_dir: str) -> Optional["DecoderConfig"]:
+        path = os.path.join(model_dir, "config.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            raw = json.load(f)
+        fields = {f.name for f in dataclasses.fields(DecoderConfig)}
+        return DecoderConfig(**{k: v for k, v in raw.items() if k in fields})
+
+    def param_count(self) -> int:
+        hd = self.head_dim
+        per_layer = (
+            self.d_model * self.n_heads * hd          # wq
+            + 2 * self.d_model * self.n_kv_heads * hd  # wk, wv
+            + self.n_heads * hd * self.d_model         # wo
+            + 3 * self.d_model * self.d_ff             # w1, w2, w3
+            + 2 * self.d_model                         # norms
+        )
+        return self.vocab_size * self.d_model * 2 + self.n_layers * per_layer + self.d_model
+
+
+def init(key: jax.Array, config: DecoderConfig, dtype=jnp.bfloat16) -> dict:
+    """Random-init params (serving benches use these; loaders overwrite)."""
+    c = config
+    hd = c.head_dim
+    n = c.n_layers
+    keys = jax.random.split(key, 8)
+
+    def w(k, *shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "embed": w(keys[0], c.vocab_size, c.d_model, fan_in=1.0),
+        "wq": w(keys[1], n, c.d_model, c.n_heads * hd, fan_in=c.d_model),
+        "wk": w(keys[2], n, c.d_model, c.n_kv_heads * hd, fan_in=c.d_model),
+        "wv": w(keys[3], n, c.d_model, c.n_kv_heads * hd, fan_in=c.d_model),
+        "wo": w(keys[4], n, c.n_heads * hd, c.d_model, fan_in=c.n_heads * hd),
+        "w1": w(keys[5], n, c.d_model, c.d_ff, fan_in=c.d_model),
+        "w3": w(keys[6], n, c.d_model, c.d_ff, fan_in=c.d_model),
+        "w2": w(keys[7], n, c.d_ff, c.d_model, fan_in=c.d_ff),
+        "ln_attn": jnp.ones((n, c.d_model), dtype),
+        "ln_mlp": jnp.ones((n, c.d_model), dtype),
+        "ln_out": jnp.ones((c.d_model,), dtype),
+        "unembed": w(keys[0], c.d_model, c.vocab_size, fan_in=c.d_model),
+    }
+
+
+def load_params(model_dir: str, config: DecoderConfig):
+    """Load weights from model_dir/params.npz if present, else random."""
+    path = os.path.join(model_dir, "params.npz")
+    if os.path.exists(path):
+        raw = np.load(path)
+        return {k: jnp.asarray(raw[k], jnp.bfloat16) for k in raw.files}
+    return init(jax.random.PRNGKey(0), config)
+
+
+def _rms_norm(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale
+
+
+def _rope(x, positions, theta):
+    """x: [..., S, H, hd]; positions: [..., S]."""
+    hd = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, hd // 2, dtype=jnp.float32) / (hd // 2))
+    angles = positions[..., None, None].astype(jnp.float32) * freqs  # [..., S, 1, hd/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attn(q, k, v, mask):
+    """q: [B,S,Hq,hd], k/v: [B,T,Hkv,hd], mask: [B,S,T] bool (True=visible)."""
+    group = q.shape[2] // k.shape[2]
+    k = jnp.repeat(k, group, axis=2)
+    v = jnp.repeat(v, group, axis=2)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(q.shape[-1])
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd", probs.astype(v.dtype), v)
+
+
+def _block(params, l, config, x, k_cache, v_cache, positions, mask):
+    """One transformer block. k_cache/v_cache: [B, T, Hkv, hd] (already incl.
+    this step's k/v at the right positions). Returns block output."""
+    c = config
+    h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
+    B, S = x.shape[:2]
+    q = (h @ params["wq"][l]).reshape(B, S, c.n_heads, c.head_dim)
+    q = _rope(q, positions, c.rope_theta)
+    attn = _attn(q, k_cache, v_cache, mask)
+    x = x + attn.reshape(B, S, -1) @ params["wo"][l]
+    h = _rms_norm(x, params["ln_mlp"][l], c.norm_eps)
+    x = x + (jax.nn.silu(h @ params["w1"][l]) * (h @ params["w3"][l])) @ params["w2"][l]
+    return x
+
+
+def _kv_proj(params, l, config, h, positions):
+    c = config
+    B, S = h.shape[:2]
+    k = (h @ params["wk"][l]).reshape(B, S, c.n_kv_heads, c.head_dim)
+    v = (h @ params["wv"][l]).reshape(B, S, c.n_kv_heads, c.head_dim)
+    k = _rope(k, positions, c.rope_theta)
+    return k, v
+
+
+# ------------------------------------------------------------------- prefill
+
+
+@functools.partial(jax.jit, static_argnames=("config", "page_size"))
+def prefill(params, config: DecoderConfig, tokens, length, page_size: int):
+    """Process one prompt (batch of 1, padded to a bucket).
+
+    tokens: [1, S] int32 (padded); length: [] int32 actual prompt length.
+    Returns (logits_last [1, vocab], paged_k, paged_v) where paged_k/v are
+    [layers, S/page_size, page_size, Hkv, hd] — ready to scatter into the
+    global page pool at the slot's page ids.
+    """
+    c = config
+    B, S = tokens.shape
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :]
+    x = params["embed"][tokens]
+    causal = jnp.tril(jnp.ones((S, S), bool))[None]
+    valid = (positions < length)[:, None, :]
+    mask = causal & valid
+    ks, vs = [], []
+    for l in range(c.n_layers):
+        h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
+        k, v = _kv_proj(params, l, c, h, positions)
+        ks.append(k)
+        vs.append(v)
+        x = _block(params, l, c, x, k, v, positions, mask)
+    x = _rms_norm(x, params["ln_out"], c.norm_eps)
+    # logits at the last REAL token (length-1)
+    last = x[jnp.arange(B), length - 1]
+    logits = (last @ params["unembed"]).astype(jnp.float32)
+    n_pages = S // page_size
+    paged_k = jnp.stack(ks).reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)[:, 0]
+    paged_v = jnp.stack(vs).reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)[:, 0]
+    return logits, paged_k, paged_v
+
+
+@functools.partial(jax.jit, donate_argnames=("k_pool", "v_pool"))
+def write_pages(k_pool, v_pool, paged_k, paged_v, page_ids):
+    """Scatter a prompt's paged KV into the global pools at page_ids.
+
+    k_pool/v_pool: [layers, num_pages, page_size, Hkv, hd] (donated).
+    page_ids: [n_pages] int32.
+    """
+    return k_pool.at[:, page_ids].set(paged_k), v_pool.at[:, page_ids].set(paged_v)
+
+
+# -------------------------------------------------------------------- decode
+
+
+@functools.partial(jax.jit, static_argnames=("config",), donate_argnames=("k_pool", "v_pool"))
+def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
+                k_pool, v_pool):
+    """One decode step for ALL slots.
+
+    tokens: [B] int32 current token per slot; seq_lens: [B] int32 length
+    INCLUDING the current token; page_table: [B, max_pages] int32;
+    k_pool/v_pool: [L, P, page_size, Hkv, hd] (donated, updated in place).
+    Returns (logits [B, vocab], k_pool, v_pool).
+
+    The current token's KV is written into its page slot BEFORE attention, so
+    attention covers positions [0, seq_len).  Inactive slots (seq_len==0) are
+    clamped to position 0 and produce garbage logits that the caller ignores
+    — static shapes beat recompiles (XLA semantics, system brief).
+    """
+    c = config
+    B = tokens.shape[0]
+    page_size = k_pool.shape[2]
+    max_pages = page_table.shape[1]
+    T = max_pages * page_size
+    pos = jnp.maximum(seq_lens - 1, 0)  # current token's position
+    positions = pos[:, None]
+
+    x = params["embed"][tokens][:, None, :]  # [B, 1, D]
+    t_range = jnp.arange(T, dtype=jnp.int32)
+    mask = (t_range[None, :] < seq_lens[:, None])[:, None, :]  # [B, 1, T]
+
+    page_of = pos // page_size
+    page_id = jnp.take_along_axis(page_table, page_of[:, None], axis=1)[:, 0]
+    offset = pos % page_size
+
+    for l in range(c.n_layers):
+        h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
+        k_new, v_new = _kv_proj(params, l, c, h, positions)  # [B,1,Hkv,hd]
+        # scatter this step's kv into the pool: one (page, offset) per slot
+        k_pool = k_pool.at[l, page_id, offset].set(k_new[:, 0])
+        v_pool = v_pool.at[l, page_id, offset].set(v_new[:, 0])
+        # gather each slot's pages -> [B, T, Hkv, hd]
+        k_cache = k_pool[l, page_table].reshape(B, T, c.n_kv_heads, c.head_dim)
+        v_cache = v_pool[l, page_table].reshape(B, T, c.n_kv_heads, c.head_dim)
+        x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
+    x = _rms_norm(x, params["ln_out"], c.norm_eps)
+    logits = (x[:, 0] @ params["unembed"]).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
+# ----------------------------------------------------------------- reference
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def forward_full(params, config: DecoderConfig, tokens):
+    """Plain full-sequence forward (correctness oracle for the paged path)."""
+    c = config
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    x = params["embed"][tokens]
+    mask = jnp.tril(jnp.ones((S, S), bool))[None].repeat(B, 0)
+    for l in range(c.n_layers):
+        h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
+        k, v = _kv_proj(params, l, c, h, positions)
+        x = _block(params, l, c, x, k, v, positions, mask)
+    x = _rms_norm(x, params["ln_out"], c.norm_eps)
+    return (x @ params["unembed"]).astype(jnp.float32)

@@ -1,0 +1,143 @@
+"""ctypes bindings + build-on-first-use for the C++ engine core (core.cc).
+
+No pybind11 in this image (SURVEY.md §7 env notes), so the core exposes a C
+ABI and we bind with ctypes.  The shared object is compiled once per source
+hash into the package directory (also buildable via the Makefile here).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "core.cc")
+_LOCK = threading.Lock()
+_LIB = None
+
+
+def _build() -> str:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.md5(f.read()).hexdigest()[:10]
+    so_path = os.path.join(_DIR, f"_core_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-Wall", _SRC, "-o", tmp]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so_path)  # atomic under concurrent builders
+    return so_path
+
+
+def load_library() -> ctypes.CDLL:
+    global _LIB
+    with _LOCK:
+        if _LIB is None:
+            lib = ctypes.CDLL(_build())
+            i32, i64, p = ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p
+            ip = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+            lib.eng_create.restype = p
+            lib.eng_create.argtypes = [i32, i32, i32, i32]
+            lib.eng_destroy.argtypes = [p]
+            lib.eng_submit.restype = i32
+            lib.eng_submit.argtypes = [p, i64, i32, i32]
+            lib.eng_admit.restype = i32
+            lib.eng_admit.argtypes = [p, ctypes.POINTER(i64), ctypes.POINTER(i32), ctypes.POINTER(i32)]
+            lib.eng_commit_token.restype = i32
+            lib.eng_commit_token.argtypes = [p, i32, i32]
+            lib.eng_release.argtypes = [p, i32]
+            lib.eng_page_table.argtypes = [p, ip]
+            lib.eng_seq_lens.argtypes = [p, ip]
+            lib.eng_active_mask.argtypes = [p, ip]
+            lib.eng_slot_req.restype = i64
+            lib.eng_slot_req.argtypes = [p, i32]
+            lib.eng_slot_seq_len.restype = i32
+            lib.eng_slot_seq_len.argtypes = [p, i32]
+            for fn in ("eng_num_free_pages", "eng_queue_depth", "eng_num_active"):
+                getattr(lib, fn).restype = i32
+                getattr(lib, fn).argtypes = [p]
+            _LIB = lib
+    return _LIB
+
+
+class NativeBatcher:
+    """Thin OO wrapper over the C core. Thread-safe (the core has the mutex)."""
+
+    def __init__(self, max_slots: int, num_pages: int, page_size: int, max_pages_per_slot: int):
+        self.lib = load_library()
+        self.max_slots = max_slots
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_pages_per_slot = max_pages_per_slot
+        self._e = self.lib.eng_create(max_slots, num_pages, page_size, max_pages_per_slot)
+        if not self._e:
+            raise ValueError("bad engine geometry")
+
+    def close(self) -> None:
+        if self._e:
+            self.lib.eng_destroy(self._e)
+            self._e = None
+
+    def submit(self, req_id: int, prompt_len: int, max_new_tokens: int) -> bool:
+        return self.lib.eng_submit(self._e, req_id, prompt_len, max_new_tokens) == 0
+
+    def admit(self):
+        """-> (slot, req_id, prompt_len, max_new_tokens) or None."""
+        rid = ctypes.c_int64()
+        plen = ctypes.c_int32()
+        mnew = ctypes.c_int32()
+        slot = self.lib.eng_admit(self._e, ctypes.byref(rid), ctypes.byref(plen), ctypes.byref(mnew))
+        if slot < 0:
+            return None
+        return slot, rid.value, plen.value, mnew.value
+
+    def commit_token(self, slot: int, is_eos: bool) -> int:
+        """1=continue, 0=finished, -2=page pool exhausted."""
+        return self.lib.eng_commit_token(self._e, slot, 1 if is_eos else 0)
+
+    def release(self, slot: int) -> None:
+        self.lib.eng_release(self._e, slot)
+
+    def page_table(self) -> np.ndarray:
+        out = np.zeros((self.max_slots, self.max_pages_per_slot), np.int32)
+        self.lib.eng_page_table(self._e, out.reshape(-1))
+        return out
+
+    def seq_lens(self) -> np.ndarray:
+        out = np.zeros((self.max_slots,), np.int32)
+        self.lib.eng_seq_lens(self._e, out)
+        return out
+
+    def active_mask(self) -> np.ndarray:
+        out = np.zeros((self.max_slots,), np.int32)
+        self.lib.eng_active_mask(self._e, out)
+        return out
+
+    def slot_req(self, slot: int) -> int:
+        return self.lib.eng_slot_req(self._e, slot)
+
+    def slot_seq_len(self, slot: int) -> int:
+        return self.lib.eng_slot_seq_len(self._e, slot)
+
+    @property
+    def free_pages(self) -> int:
+        return self.lib.eng_num_free_pages(self._e)
+
+    @property
+    def queue_depth(self) -> int:
+        return self.lib.eng_queue_depth(self._e)
+
+    @property
+    def num_active(self) -> int:
+        return self.lib.eng_num_active(self._e)
+
+    def __del__(self):  # pragma: no cover - defensive
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,119 @@
+"""JetStreamModel: the engine behind the ``jetstream`` serving runtime.
+
+Plugs the continuous-batching engine into the V1/V2 model server
+(serving/server.py).  Request shape (V1):
+
+    {"instances": [{"prompt": "...", "max_tokens": 32} | "plain string", ...]}
+    -> {"predictions": [{"text": ..., "tokens": N, "latency_s": ...}, ...]}
+
+Tokenization: ``tokenizer.json`` (a {token: id} vocab with greedy longest-
+match) if the model dir has one, else byte-level (ids 0..255) — serving
+infrastructure must not depend on network tokenizer downloads (zero egress).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+from ..server import Model
+from .engine import Engine, EngineConfig
+from .model import DecoderConfig, load_params
+
+
+class ByteTokenizer:
+    vocab_size = 256
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8", errors="replace"))
+
+    def decode(self, ids: list[int]) -> str:
+        return bytes(i % 256 for i in ids).decode("utf-8", errors="replace")
+
+
+class VocabTokenizer:
+    """Greedy longest-match over a {token_string: id} vocab."""
+
+    def __init__(self, vocab: dict[str, int]):
+        self.vocab = vocab
+        self.inv = {i: t for t, i in vocab.items()}
+        self.max_len = max(len(t) for t in vocab)
+        self.vocab_size = max(vocab.values()) + 1
+
+    def encode(self, text: str) -> list[int]:
+        out, i = [], 0
+        while i < len(text):
+            for ln in range(min(self.max_len, len(text) - i), 0, -1):
+                tid = self.vocab.get(text[i : i + ln])
+                if tid is not None:
+                    out.append(tid)
+                    i += ln
+                    break
+            else:
+                i += 1  # unknown char: skip
+        return out
+
+    def decode(self, ids: list[int]) -> str:
+        return "".join(self.inv.get(i, "") for i in ids)
+
+
+def load_tokenizer(model_dir: str):
+    path = os.path.join(model_dir, "tokenizer.json")
+    if model_dir and os.path.exists(path):
+        with open(path) as f:
+            return VocabTokenizer(json.load(f))
+    return ByteTokenizer()
+
+
+class JetStreamModel(Model):
+    """kserve-style Model serving generate() from the TPU engine."""
+
+    def __init__(self, name: str, model_dir: str = "", engine: Optional[Engine] = None):
+        super().__init__(name)
+        self.model_dir = model_dir
+        self.engine = engine
+        self.tokenizer = load_tokenizer(model_dir)
+
+    def load(self) -> None:
+        if self.engine is None:
+            config = DecoderConfig.from_dir(self.model_dir) or DecoderConfig()
+            params = load_params(self.model_dir, config)
+            ec = EngineConfig()
+            path = os.path.join(self.model_dir, "engine.json")
+            if self.model_dir and os.path.exists(path):
+                with open(path) as f:
+                    raw = json.load(f)
+                import dataclasses
+
+                fields = {f.name for f in dataclasses.fields(EngineConfig)}
+                ec = EngineConfig(**{k: v for k, v in raw.items() if k in fields})
+            self.engine = Engine(params, config, ec)
+        self.engine.start()
+        self.ready = True
+
+    def predict(self, payload: Any, headers: Optional[dict] = None) -> Any:
+        instances = payload.get("instances", []) if isinstance(payload, dict) else payload
+        futures = []
+        for inst in instances:
+            if isinstance(inst, str):
+                prompt, max_tokens = inst, 32
+            else:
+                prompt = inst.get("prompt", "")
+                max_tokens = int(inst.get("max_tokens", 32))
+            ids = self.tokenizer.encode(prompt) or [0]
+            futures.append(self.engine.generate_async(ids, max_tokens))
+        out = []
+        for fut in futures:
+            r = fut.result(timeout=300)
+            out.append(
+                {
+                    "text": self.tokenizer.decode(r["tokens"]),
+                    "token_ids": r["tokens"],
+                    "tokens": r["num_tokens"],
+                    "ttft_s": round(r["ttft_s"], 4),
+                    "latency_s": round(r["latency_s"], 4),
+                    "truncated": r["truncated"],
+                }
+            )
+        return out

@@ -96,12 +96,12 @@ class ServiceProxy:
                     self._reply(503, json.dumps({"error": str(e)}).encode())
                     return
                 url = f"http://127.0.0.1:{backend}{self.path}"
-                req = urllib.request.Request(
-                    url,
-                    data=body,
-                    method=self.command,
-                    headers={"Content-Type": self.headers.get("Content-Type", "application/json")},
-                )
+                hop_by_hop = {"host", "content-length", "connection", "keep-alive",
+                              "transfer-encoding", "upgrade", "te", "trailers"}
+                fwd_headers = {k: v for k, v in self.headers.items()
+                               if k.lower() not in hop_by_hop}
+                fwd_headers.setdefault("Content-Type", "application/json")
+                req = urllib.request.Request(url, data=body, method=self.command, headers=fwd_headers)
                 try:
                     with urllib.request.urlopen(req, timeout=60) as r:
                         self._reply(r.status, r.read(), r.headers.get("Content-Type"))
@@ -126,7 +126,12 @@ class ServiceProxy:
 
     def _stop(self, key: tuple[str, str]) -> None:
         server = self._servers.pop(key)
-        threading.Thread(target=server.shutdown, daemon=True).start()
+
+        def close():
+            server.shutdown()
+            server.server_close()  # release the listening socket, not just the loop
+
+        threading.Thread(target=close, daemon=True).start()
 
     # ----------------------------------------------------------- backend pick
 

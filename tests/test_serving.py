@@ -238,6 +238,34 @@ def test_isvc_canary_split_and_promotion(scluster):
     assert all(router.predict("canary", {"instances": [1]})["predictions"][0] == 10 for _ in range(5))
 
 
+def test_isvc_jetstream_llm_end_to_end(tmp_path):
+    """Full stack for the flagship path: llama-format ISVC -> jetstream
+    runtime -> continuous-batching engine pod on a TPU-labelled node."""
+    c = Cluster(cpu_nodes=1, tpu_slices=(("s0", "v5e", "2x2"),),
+                base_env={"PYTHONPATH": os.getcwd(), "JAX_PLATFORMS": "cpu"})
+    router, proxy = install(c.api, c.manager)
+    try:
+        d = tmp_path / "llm"
+        d.mkdir()
+        (d / "config.json").write_text(json.dumps(
+            {"vocab_size": 64, "d_model": 32, "n_layers": 1, "n_heads": 2,
+             "n_kv_heads": 1, "d_ff": 64}))
+        (d / "engine.json").write_text(json.dumps(
+            {"max_slots": 2, "num_pages": 32, "page_size": 8}))
+        c.apply(inference_service("llm", model_format="llama", storage_uri=f"file://{d}"))
+        _wait_ready(c, "llm", timeout=120)
+        isvc = c.api.get("InferenceService", "llm")
+        # flagship runtime selected, pod landed on the TPU slice
+        pods = [p for p in c.api.list("Pod") if p["metadata"]["labels"].get(sapi.LABEL_ISVC) == "llm"]
+        assert pods and pods[0]["spec"]["nodeName"].startswith("s0-host-")
+        out = router.predict("llm", {"instances": [{"prompt": "hi", "max_tokens": 4}]})
+        assert out["predictions"][0]["tokens"] == 4
+        assert isvc["status"]["url"]
+    finally:
+        proxy.shutdown()
+        c.shutdown()
+
+
 def test_isvc_scale_to_zero_and_activation(scluster):
     c, router, tmp_path = scluster
     model_dir = _write_pyfunc_model(tmp_path, "m1", factor=3)
