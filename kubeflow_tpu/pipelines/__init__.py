@@ -1,1 +1,15 @@
-"""TPU-native Kubeflow-capability platform."""
+"""Pipelines (KFP-equivalent): DSL, compiler, DAG executor, metadata, client.
+
+SURVEY.md §2/§3.5/§7 phase 7.  Layers:
+  * ``dsl`` + ``compiler`` — @component/@pipeline → IR JSON (golden-tested);
+  * ``metadata`` — MLMD-equivalent native store (C++ core, WAL-backed);
+  * ``artifacts`` — MinIO-equivalent local object store;
+  * ``workflow`` — Argo-equivalent DAG controller + embedded v2 driver
+    (caching, condition gating) and the step-pod launcher;
+  * ``schedule`` — ScheduledWorkflow (cron/interval recurring runs);
+  * ``service`` + ``client`` — API server + kfp.Client equivalents.
+"""
+
+from . import dsl  # noqa: F401
+from .client import Client, install  # noqa: F401
+from .compiler import Compiler  # noqa: F401

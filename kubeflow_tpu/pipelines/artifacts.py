@@ -1,0 +1,81 @@
+"""Artifact object store: the MinIO-equivalent, on the local filesystem.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2/§3.5): KFP stores step artifacts
+in MinIO under ``minio://mlpipeline/artifacts/...``; the launcher uploads
+outputs and downloads inputs.  SURVEY.md §2b allows "SQLite + local FS
+equivalents" for these external native deps, so this is a bucket/key object
+store rooted at a directory, with the URI scheme ``mstore://bucket/key``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+SCHEME = "mstore://"
+
+
+class ObjectStore:
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------------ uris
+
+    def uri(self, bucket: str, key: str) -> str:
+        return f"{SCHEME}{bucket}/{key}"
+
+    def _path(self, uri: str) -> str:
+        if not uri.startswith(SCHEME):
+            raise ValueError(f"not an object-store uri: {uri!r}")
+        rel = uri[len(SCHEME):]
+        path = os.path.normpath(os.path.join(self.root, rel))
+        # commonpath (not a prefix check) so "root-sibling" dirs can't pass
+        if os.path.commonpath([path, self.root]) != self.root:
+            raise ValueError(f"uri escapes the store root: {uri!r}")
+        return path
+
+    # ------------------------------------------------------------------- ops
+
+    def put(self, uri: str, local_path: str) -> str:
+        """Upload a file or directory to the store. Returns the uri."""
+        dst = self._path(uri)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.isdir(local_path):
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(local_path, dst)
+        else:
+            shutil.copy2(local_path, dst)
+        return uri
+
+    def get(self, uri: str, local_path: str) -> str:
+        """Download to a local path. Returns the local path."""
+        src = self._path(uri)
+        if not os.path.exists(src):
+            raise FileNotFoundError(f"object not found: {uri}")
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        if os.path.isdir(src):
+            if os.path.exists(local_path):
+                shutil.rmtree(local_path)
+            shutil.copytree(src, local_path)
+        else:
+            shutil.copy2(src, local_path)
+        return local_path
+
+    def put_bytes(self, uri: str, data: bytes) -> str:
+        dst = self._path(uri)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        with open(dst, "wb") as f:
+            f.write(data)
+        return uri
+
+    def get_bytes(self, uri: str) -> bytes:
+        src = self._path(uri)
+        if not os.path.exists(src):
+            raise FileNotFoundError(f"object not found: {uri}")
+        with open(src, "rb") as f:
+            return f.read()
+
+    def exists(self, uri: str) -> bool:
+        return os.path.exists(self._path(uri))

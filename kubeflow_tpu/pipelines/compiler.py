@@ -1,0 +1,277 @@
+"""Pipeline compiler: traced DSL → deterministic IR JSON.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2/§3.5): ``kfp.compiler.Compiler``
+compiling the DSL to PipelineSpec IR proto / Argo YAML, golden-tested against
+snapshots.  Here the IR is a plain JSON document (sorted keys, stable task
+naming) executed by the Workflow controller (workflow.py) — goldens compare
+byte-for-byte.
+
+Compile steps:
+  1. trace the pipeline function (dsl.Pipeline.trace);
+  2. expand ``ParallelFor`` groups — clone the sub-DAG per item, substituting
+     ``LoopItem`` references with constants and remapping intra-loop data
+     dependencies (nested loops expand recursively, outermost first);
+  3. attach runtime conditions (enclosing ``dsl.Condition`` expressions) and
+     derive ``dependentTasks`` = explicit ``.after`` + data deps + tasks
+     referenced by conditions;
+  4. emit components (deduped per component) + executors (embedded function
+     source) + the root DAG.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Optional
+
+from . import dsl
+from .dsl import (
+    ConditionExpr,
+    LoopItem,
+    LoopItemField,
+    Pipeline,
+    PipelineParam,
+    Task,
+    TaskOutput,
+    _Group,
+)
+
+IR_SCHEMA = "kubeflow-tpu-pipelines/v1"
+
+
+class CompileError(Exception):
+    pass
+
+
+# ------------------------------------------------------------ loop expansion
+
+
+def _substitute(value: Any, gid: int, item: Any, clone_map: dict) -> Any:
+    """Replace loop refs of group `gid` with `item`, remap cloned task refs."""
+    if isinstance(value, LoopItem) and value.group_id == gid:
+        return item
+    if isinstance(value, LoopItemField) and value.group_id == gid:
+        if not isinstance(item, dict) or value.key not in item:
+            raise CompileError(f"ParallelFor item {item!r} has no field {value.key!r}")
+        return item[value.key]
+    if isinstance(value, TaskOutput) and id(value.task) in clone_map:
+        return TaskOutput(clone_map[id(value.task)], value.name, value.is_artifact, value.type)
+    if isinstance(value, ConditionExpr):
+        return ConditionExpr(
+            value.op,
+            _substitute(value.left, gid, item, clone_map),
+            _substitute(value.right, gid, item, clone_map),
+        )
+    return value
+
+
+def _clone_task(t: Task, suffix: str, gid: int, item: Any, clone_map: dict) -> Task:
+    c = Task(
+        f"{t.name}{suffix}",
+        t.component,
+        {k: _substitute(v, gid, item, clone_map) for k, v in t.inputs.items()},
+        tuple(
+            _Group(g.kind, g.group_id, condition=_substitute(g.condition, gid, item, clone_map))
+            if g.kind == "condition"
+            else g
+            for g in t.group_path
+            if not (g.kind == "loop" and g.group_id == gid)
+        ),
+    )
+    c.display_name = t.display_name if t.display_name != t.name else c.name
+    c.resources = dict(t.resources)
+    c.tpu = copy.deepcopy(t.tpu)
+    c.enable_caching = t.enable_caching
+    c.retries = t.retries
+    c.dependencies = [
+        clone_map.get(id(d), d) for d in t.dependencies
+    ]
+    return c
+
+
+def _expand_loops(tasks: list[Task]) -> list[Task]:
+    """Expand the first (outermost) loop group found; recurse until none left."""
+    loop: Optional[_Group] = None
+    for t in tasks:
+        for g in t.group_path:
+            if g.kind == "loop":
+                loop = g if loop is None or g.group_id < loop.group_id else loop
+                break  # outermost in this task's path
+    if loop is None:
+        return tasks
+    inside = [t for t in tasks if any(g is loop for g in t.group_path)]
+    inside_ids = {id(t) for t in inside}
+    out: list[Task] = []
+    clones_by_orig: dict[int, list[Task]] = {id(t): [] for t in inside}
+    for t in tasks:
+        if id(t) not in inside_ids:
+            out.append(t)
+            continue
+        for i, item in enumerate(loop.items or []):
+            # map of already-cloned iteration-i tasks, for ref remapping
+            clone_map = {
+                oid: clones[i]
+                for oid, clones in clones_by_orig.items()
+                if len(clones) > i
+            }
+            c = _clone_task(t, f"-it{i}", loop.group_id, item, clone_map)
+            clones_by_orig[id(t)].append(c)
+            out.append(c)
+    # references from OUTSIDE the loop to a task inside it are ambiguous —
+    # catch data inputs, explicit .after() deps, and Condition references
+    def _check_fanin(t: Task, ref_name: str) -> None:
+        raise CompileError(
+            f"task {t.name!r} references {ref_name!r} inside a ParallelFor "
+            "from outside the loop; fan-in is not supported"
+        )
+
+    for t in out:
+        for v in t.inputs.values():
+            if isinstance(v, TaskOutput) and id(v.task) in inside_ids:
+                _check_fanin(t, v.task.name)
+        for d in t.dependencies:
+            if id(d) in inside_ids:
+                _check_fanin(t, d.name)
+        for g in t.group_path:
+            if g.kind == "condition" and g.condition is not None:
+                for rt in g.condition.referenced_tasks():
+                    if id(rt) in inside_ids:
+                        _check_fanin(t, rt.name)
+    return _expand_loops(out)
+
+
+# -------------------------------------------------------------- IR emission
+
+
+def _param_ref(value: Any) -> dict:
+    if isinstance(value, PipelineParam):
+        return {"componentInputParameter": value.name}
+    if isinstance(value, TaskOutput):
+        if value.is_artifact:
+            raise CompileError(f"artifact output {value.name!r} passed to a parameter input")
+        return {
+            "taskOutputParameter": {"producerTask": value.task.name, "outputParameterKey": value.name}
+        }
+    if isinstance(value, (LoopItem, LoopItemField)):
+        raise CompileError("loop item escaped expansion (used outside its ParallelFor?)")
+    return {"constant": value}
+
+
+def _expr_ir(e: Any) -> Any:
+    if isinstance(e, ConditionExpr):
+        return {"op": e.op, "left": _expr_ir(e.left), "right": _expr_ir(e.right)}
+    return _param_ref(e)
+
+
+class Compiler:
+    def compile(self, pipeline: Pipeline, output_path: Optional[str] = None) -> dict:
+        if not isinstance(pipeline, Pipeline):
+            raise TypeError("Compiler.compile takes a @dsl.pipeline-decorated function")
+        ctx = pipeline.trace()
+        tasks = _expand_loops(ctx.tasks)
+
+        names = [t.name for t in tasks]
+        if len(set(names)) != len(names):
+            raise CompileError(f"duplicate task names after expansion: {sorted(names)}")
+
+        components: dict = {}
+        executors: dict = {}
+        dag: dict = {}
+        for t in tasks:
+            spec = t.component.spec
+            comp_key = f"comp-{spec.name}"
+            if comp_key not in components:
+                components[comp_key] = {
+                    "executorLabel": f"exec-{spec.name}",
+                    "inputDefinitions": {
+                        "parameters": {
+                            p: {"parameterType": d["type"]} for p, d in spec.input_params.items()
+                        },
+                        "artifacts": {
+                            a: {"schemaTitle": s} for a, s in spec.input_artifacts.items()
+                        },
+                    },
+                    "outputDefinitions": {
+                        "parameters": {p: {"parameterType": ty} for p, ty in spec.output_params.items()},
+                        "artifacts": {a: {"schemaTitle": s} for a, s in spec.output_artifacts.items()},
+                    },
+                }
+                executors[f"exec-{spec.name}"] = {
+                    "python": {
+                        "functionName": spec.function_name,
+                        "source": spec.source,
+                        "defaults": dict(sorted(spec.defaults.items())),
+                    }
+                }
+            deps = {d.name for d in t.dependencies}
+            params_ir: dict = {}
+            artifacts_ir: dict = {}
+            for pname, value in sorted(t.inputs.items()):
+                if pname in spec.input_artifacts:
+                    if not (isinstance(value, TaskOutput) and value.is_artifact):
+                        raise CompileError(
+                            f"task {t.name!r} input {pname!r} expects an artifact "
+                            f"(another task's Output[...]), got {value!r}"
+                        )
+                    artifacts_ir[pname] = {
+                        "taskOutputArtifact": {
+                            "producerTask": value.task.name,
+                            "outputArtifactKey": value.name,
+                        }
+                    }
+                    deps.add(value.task.name)
+                else:
+                    params_ir[pname] = _param_ref(value)
+                    if isinstance(value, TaskOutput):
+                        deps.add(value.task.name)
+            conditions = []
+            for g in t.group_path:
+                if g.kind == "condition" and g.condition is not None:
+                    conditions.append(_expr_ir(g.condition))
+                    for rt in g.condition.referenced_tasks():
+                        deps.add(rt.name)
+            node: dict = {
+                "componentRef": comp_key,
+                "displayName": t.display_name,
+                "dependentTasks": sorted(deps),
+                "inputs": {"parameters": params_ir, "artifacts": artifacts_ir},
+                "cachingOptions": {"enableCache": t.enable_caching},
+            }
+            if conditions:
+                node["conditions"] = conditions
+            if t.retries:
+                node["retries"] = t.retries
+            if t.resources:
+                node["resources"] = dict(sorted(t.resources.items()))
+            if t.tpu:
+                node["tpu"] = t.tpu
+            dag[t.name] = node
+
+        ir = {
+            "schemaVersion": IR_SCHEMA,
+            "pipelineInfo": {"name": pipeline.name, "description": pipeline.description},
+            "root": {
+                "inputDefinitions": {
+                    "parameters": {
+                        p: (
+                            {"parameterType": ty, "defaultValue": pipeline.defaults[p]}
+                            if p in pipeline.defaults
+                            else {"parameterType": ty}
+                        )
+                        for p, ty in pipeline.params.items()
+                    }
+                },
+                "dag": {"tasks": dag},
+            },
+            "components": components,
+            "deploymentSpec": {"executors": executors},
+        }
+        if output_path:
+            with open(output_path, "w") as f:
+                json.dump(ir, f, indent=2, sort_keys=True)
+                f.write("\n")
+        return ir
+
+
+def compile_to_json(pipeline: Pipeline) -> str:
+    return json.dumps(Compiler().compile(pipeline), indent=2, sort_keys=True) + "\n"

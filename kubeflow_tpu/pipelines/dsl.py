@@ -1,0 +1,491 @@
+"""Pipelines DSL: ``@component`` + ``@pipeline`` with typed params/artifacts.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2 "KFP: Python SDK"): ``kfp.dsl`` —
+``@dsl.component`` lightweight Python components, ``@dsl.pipeline`` tracing,
+``Input[...]``/``Output[...]`` artifact IO, ``dsl.Condition``, ``dsl.ParallelFor``.
+
+Rebuild design (not a port):
+  * A component is a plain Python function; its **source is embedded in the
+    compiled IR** and re-exec'd by the launcher inside the step pod — the same
+    lightweight-component mechanism upstream uses, without container images
+    (the process kubelet runs ``python -m …launcher_main``).
+  * Tracing is eager and deterministic: calling a component inside a pipeline
+    function registers a ``Task``; all naming is insertion-ordered so compiled
+    IR is byte-stable (golden tests, SURVEY.md §4 "compiler golden files").
+  * ``Condition`` compiles to an expression evaluated by the driver at
+    runtime (skipped steps are first-class node phases); ``ParallelFor``
+    over a static list is expanded at compile time (cloned sub-DAG per item —
+    dynamic fan-out over a task output is rejected at compile time).
+  * TPU-first resourcing: ``task.set_tpu("v5e-8")`` requests ``google.com/tpu``
+    chips + topology, the scheduler's gang/topology semantics apply
+    (scheduler/topology.py) — the analogue of upstream's
+    ``set_accelerator_type('nvidia.com/gpu')``, which never appears here.
+"""
+
+from __future__ import annotations
+
+import copy
+import inspect
+import textwrap
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Union
+
+# --------------------------------------------------------------------- types
+
+_PARAM_TYPES = {int: "Int", float: "Float", str: "String", bool: "Bool", dict: "Dict", list: "List"}
+
+
+class Artifact:
+    """A file-backed artifact with a URI, a local path, and metadata.
+
+    Inside a component the launcher hands the function an instance whose
+    ``.path`` is a real local file/dir path; metadata written here is
+    persisted to the metadata store after the step.
+    """
+
+    schema_title = "system.Artifact"
+
+    def __init__(self, name: str = "", uri: str = "", metadata: Optional[dict] = None):
+        self.name = name
+        self.uri = uri
+        self.metadata = dict(metadata or {})
+        self.path = ""  # set by the launcher to the local staging path
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"{type(self).__name__}(name={self.name!r}, uri={self.uri!r})"
+
+
+class Dataset(Artifact):
+    schema_title = "system.Dataset"
+
+
+class Model(Artifact):
+    schema_title = "system.Model"
+
+
+class Metrics(Artifact):
+    schema_title = "system.Metrics"
+
+    def log_metric(self, key: str, value: float) -> None:
+        self.metadata[key] = float(value)
+
+
+ARTIFACT_TYPES = {c.schema_title: c for c in (Artifact, Dataset, Model, Metrics)}
+
+
+class _IOSpec:
+    __slots__ = ("artifact_type",)
+
+    def __init__(self, artifact_type: type):
+        if not (isinstance(artifact_type, type) and issubclass(artifact_type, Artifact)):
+            raise TypeError(f"Input[...]/Output[...] takes an Artifact subclass, got {artifact_type!r}")
+        self.artifact_type = artifact_type
+
+
+class _InputSpec(_IOSpec):
+    pass
+
+
+class _OutputSpec(_IOSpec):
+    pass
+
+
+class Input:
+    """``Input[Dataset]`` annotation marker for input artifacts."""
+
+    def __class_getitem__(cls, item: type) -> _InputSpec:
+        return _InputSpec(item)
+
+
+class Output:
+    """``Output[Model]`` annotation marker for output artifacts."""
+
+    def __class_getitem__(cls, item: type) -> _OutputSpec:
+        return _OutputSpec(item)
+
+
+# ---------------------------------------------------------------- references
+
+
+class _Comparable:
+    """Operator overloads building Condition expressions from references."""
+
+    def _cmp(self, op: str, other: Any) -> "ConditionExpr":
+        return ConditionExpr(op, self, other)
+
+    def __eq__(self, other):  # type: ignore[override]
+        return self._cmp("==", other)
+
+    def __ne__(self, other):  # type: ignore[override]
+        return self._cmp("!=", other)
+
+    def __lt__(self, other):
+        return self._cmp("<", other)
+
+    def __le__(self, other):
+        return self._cmp("<=", other)
+
+    def __gt__(self, other):
+        return self._cmp(">", other)
+
+    def __ge__(self, other):
+        return self._cmp(">=", other)
+
+    def __hash__(self):
+        return id(self)
+
+
+@dataclass(eq=False)
+class PipelineParam(_Comparable):
+    """Reference to a pipeline input parameter."""
+
+    name: str
+    type: str = "String"
+
+
+@dataclass(eq=False)
+class LoopItem(_Comparable):
+    """Placeholder for the current item of a ParallelFor (compile-time expanded)."""
+
+    group_id: int
+
+    def __getitem__(self, key: str) -> "LoopItemField":
+        return LoopItemField(self.group_id, key)
+
+
+@dataclass(eq=False)
+class LoopItemField(_Comparable):
+    group_id: int
+    key: str
+
+
+@dataclass(eq=False)
+class TaskOutput(_Comparable):
+    """Reference to another task's output parameter or artifact."""
+
+    task: "Task"
+    name: str
+    is_artifact: bool
+    type: str = "String"
+
+
+class ConditionExpr:
+    """A binary comparison over references/constants, evaluated by the driver."""
+
+    def __init__(self, op: str, left: Any, right: Any):
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def referenced_tasks(self) -> list["Task"]:
+        return [x.task for x in (self.left, self.right) if isinstance(x, TaskOutput)]
+
+
+# -------------------------------------------------------------------- groups
+
+
+@dataclass
+class _Group:
+    kind: str  # "root" | "condition" | "loop"
+    group_id: int
+    condition: Optional[ConditionExpr] = None
+    items: Optional[Union[list, TaskOutput]] = None
+    loop_item: Optional[LoopItem] = None
+    tasks: list["Task"] = field(default_factory=list)
+
+
+class Condition:
+    """``with dsl.Condition(task.output > 0.5):`` — runtime-gated sub-DAG."""
+
+    def __init__(self, expr: ConditionExpr, name: str = ""):
+        if not isinstance(expr, ConditionExpr):
+            raise TypeError("dsl.Condition takes a comparison over a task output or pipeline param")
+        self.expr = expr
+        self.name = name
+
+    def __enter__(self):
+        ctx = _require_context("dsl.Condition")
+        ctx.push_group(_Group("condition", ctx.next_group_id(), condition=self.expr))
+        return self
+
+    def __exit__(self, *exc):
+        _require_context("dsl.Condition").pop_group()
+        return False
+
+
+class ParallelFor:
+    """``with dsl.ParallelFor([...]) as item:`` — static fan-out (cloned per item)."""
+
+    def __init__(self, items: Union[list, tuple, TaskOutput]):
+        if isinstance(items, TaskOutput):
+            raise NotImplementedError(
+                "dynamic ParallelFor over a task output is not supported; "
+                "pass a static list (fan-out is expanded at compile time)"
+            )
+        self.items = list(items)
+
+    def __enter__(self) -> LoopItem:
+        ctx = _require_context("dsl.ParallelFor")
+        gid = ctx.next_group_id()
+        g = _Group("loop", gid, items=self.items, loop_item=LoopItem(gid))
+        ctx.push_group(g)
+        return g.loop_item
+
+    def __exit__(self, *exc):
+        _require_context("dsl.ParallelFor").pop_group()
+        return False
+
+
+# ----------------------------------------------------------------- component
+
+
+@dataclass
+class ComponentSpec:
+    name: str
+    source: str
+    function_name: str
+    input_params: dict  # name -> {"type": str, "default": present?}
+    input_artifacts: dict  # name -> schema_title
+    output_params: dict  # name -> type
+    output_artifacts: dict  # name -> schema_title
+    defaults: dict
+
+
+class Component:
+    """A Python-function component; calling it inside a pipeline adds a Task."""
+
+    def __init__(self, fn: Callable, name: Optional[str] = None):
+        self.fn = fn
+        self.name = name or fn.__name__.replace("_", "-")
+        self.spec = self._introspect(fn)
+
+    def _introspect(self, fn: Callable) -> ComponentSpec:
+        sig = inspect.signature(fn)
+        in_params: dict = {}
+        in_artifacts: dict = {}
+        out_params: dict = {}
+        out_artifacts: dict = {}
+        defaults: dict = {}
+        for pname, p in sig.parameters.items():
+            ann = p.annotation
+            if isinstance(ann, _OutputSpec):
+                out_artifacts[pname] = ann.artifact_type.schema_title
+            elif isinstance(ann, _InputSpec):
+                in_artifacts[pname] = ann.artifact_type.schema_title
+            elif isinstance(ann, type) and issubclass(ann, Artifact):
+                raise TypeError(
+                    f"component {self.name!r} param {pname!r}: use Input[{ann.__name__}] "
+                    f"or Output[{ann.__name__}], not the bare artifact type"
+                )
+            else:
+                ptype = _PARAM_TYPES.get(ann, "String")
+                in_params[pname] = {"type": ptype}
+                if p.default is not inspect.Parameter.empty:
+                    defaults[pname] = p.default
+        ret = sig.return_annotation
+        if ret is not inspect.Signature.empty and ret is not None:
+            out_params["Output"] = _PARAM_TYPES.get(ret, "String")
+        try:
+            source = textwrap.dedent(inspect.getsource(fn))
+        except (OSError, TypeError) as e:
+            raise ValueError(
+                f"component {self.name!r}: cannot extract source for the launcher ({e}); "
+                "define components at module/function top level"
+            ) from e
+        # strip decorator lines so the source is a plain function definition
+        lines = source.splitlines()
+        start = next(i for i, ln in enumerate(lines) if ln.lstrip().startswith("def "))
+        return ComponentSpec(
+            name=self.name,
+            source="\n".join(lines[start:]) + "\n",
+            function_name=fn.__name__,
+            input_params=in_params,
+            input_artifacts=in_artifacts,
+            output_params=out_params,
+            output_artifacts=out_artifacts,
+            defaults=defaults,
+        )
+
+    def __call__(self, **kwargs: Any) -> "Task":
+        ctx = _current_context()
+        if ctx is None:
+            # outside a pipeline: run the function directly (unit-test ergonomics)
+            return self.fn(**kwargs)
+        return ctx.add_task(self, kwargs)
+
+
+def component(fn: Optional[Callable] = None, *, name: Optional[str] = None):
+    """Decorator: ``@dsl.component`` or ``@dsl.component(name=...)``."""
+    if fn is None:
+        return lambda f: Component(f, name=name)
+    return Component(fn)
+
+
+# ---------------------------------------------------------------------- task
+
+
+class _TaskOutputs:
+    def __init__(self, task: "Task"):
+        self._task = task
+
+    def __getitem__(self, name: str) -> TaskOutput:
+        spec = self._task.component.spec
+        if name in spec.output_artifacts:
+            return TaskOutput(self._task, name, is_artifact=True, type=spec.output_artifacts[name])
+        if name in spec.output_params:
+            return TaskOutput(self._task, name, is_artifact=False, type=spec.output_params[name])
+        raise KeyError(
+            f"component {spec.name!r} has no output {name!r} "
+            f"(params: {sorted(spec.output_params)}, artifacts: {sorted(spec.output_artifacts)})"
+        )
+
+
+class Task:
+    """One node of the pipeline DAG."""
+
+    def __init__(self, name: str, component_: Component, inputs: dict, group_path: tuple):
+        self.name = name
+        self.component = component_
+        self.inputs = inputs  # pname -> constant | PipelineParam | TaskOutput | LoopItem(Field)
+        self.group_path = group_path  # enclosing Condition/ParallelFor groups, outermost first
+        self.dependencies: list[Task] = []
+        self.display_name = name
+        self.resources: dict = {}
+        self.tpu: Optional[dict] = None
+        self.enable_caching = True
+        self.retries = 0
+        self.outputs = _TaskOutputs(self)
+
+    @property
+    def output(self) -> TaskOutput:
+        spec = self.component.spec
+        if len(spec.output_params) == 1:
+            return self.outputs[next(iter(spec.output_params))]
+        if not spec.output_params and len(spec.output_artifacts) == 1:
+            return self.outputs[next(iter(spec.output_artifacts))]
+        raise AttributeError(
+            f"task {self.name!r} has multiple outputs; use .outputs['name']"
+        )
+
+    # -------- fluent config (subset of upstream PipelineTask methods) --------
+
+    def after(self, *tasks: "Task") -> "Task":
+        self.dependencies.extend(tasks)
+        return self
+
+    def set_display_name(self, name: str) -> "Task":
+        self.display_name = name
+        return self
+
+    def set_cpu_limit(self, cpu: str) -> "Task":
+        self.resources["cpu"] = cpu
+        return self
+
+    def set_memory_limit(self, memory: str) -> "Task":
+        self.resources["memory"] = memory
+        return self
+
+    def set_tpu(self, accelerator: str, chips: int = 0) -> "Task":
+        """Request a TPU slice for this step, e.g. ``set_tpu("v5e-8")``.
+
+        The compiled node asks the topology scheduler for a ``google.com/tpu``
+        gang placement; chips defaults to the slice size encoded in the name.
+        """
+        self.tpu = {"accelerator": accelerator, "chips": chips}
+        return self
+
+    def set_caching_options(self, enable: bool) -> "Task":
+        self.enable_caching = enable
+        return self
+
+    def set_retry(self, num_retries: int) -> "Task":
+        self.retries = int(num_retries)
+        return self
+
+
+# ------------------------------------------------------------------ pipeline
+
+
+class _BuildContext:
+    def __init__(self):
+        self.root = _Group("root", 0)
+        self._stack = [self.root]
+        self.tasks: list[Task] = []
+        self._names: dict[str, int] = {}
+        self._gid = 0
+
+    def next_group_id(self) -> int:
+        self._gid += 1
+        return self._gid
+
+    def push_group(self, g: _Group) -> None:
+        self._stack.append(g)
+
+    def pop_group(self) -> None:
+        self._stack.pop()
+
+    def add_task(self, component_: Component, kwargs: dict) -> Task:
+        spec = component_.spec
+        known = set(spec.input_params) | set(spec.input_artifacts)
+        unknown = set(kwargs) - known
+        if unknown:
+            raise TypeError(f"component {spec.name!r}: unknown inputs {sorted(unknown)}")
+        missing = [
+            p for p in spec.input_params
+            if p not in kwargs and p not in spec.defaults
+        ] + [a for a in spec.input_artifacts if a not in kwargs]
+        if missing:
+            raise TypeError(f"component {spec.name!r}: missing inputs {missing}")
+        n = self._names.get(spec.name, 0) + 1
+        self._names[spec.name] = n
+        name = spec.name if n == 1 else f"{spec.name}-{n}"
+        task = Task(name, component_, dict(kwargs), tuple(self._stack[1:]))
+        self._stack[-1].tasks.append(task)
+        self.tasks.append(task)
+        return task
+
+
+_ctx_stack: list[_BuildContext] = []
+
+
+def _current_context() -> Optional[_BuildContext]:
+    return _ctx_stack[-1] if _ctx_stack else None
+
+
+def _require_context(what: str) -> _BuildContext:
+    ctx = _current_context()
+    if ctx is None:
+        raise RuntimeError(f"{what} used outside a @dsl.pipeline function")
+    return ctx
+
+
+class Pipeline:
+    """A traced pipeline definition (compile with compiler.Compiler)."""
+
+    def __init__(self, fn: Callable, name: Optional[str] = None, description: str = ""):
+        self.fn = fn
+        self.name = name or fn.__name__.replace("_", "-")
+        self.description = description
+        sig = inspect.signature(fn)
+        self.params: dict = {}
+        self.defaults: dict = {}
+        for pname, p in sig.parameters.items():
+            self.params[pname] = _PARAM_TYPES.get(p.annotation, "String")
+            if p.default is not inspect.Parameter.empty:
+                self.defaults[pname] = p.default
+
+    def trace(self) -> _BuildContext:
+        ctx = _BuildContext()
+        _ctx_stack.append(ctx)
+        try:
+            self.fn(**{p: PipelineParam(p, t) for p, t in self.params.items()})
+        finally:
+            _ctx_stack.pop()
+        return ctx
+
+
+def pipeline(fn: Optional[Callable] = None, *, name: Optional[str] = None, description: str = ""):
+    """Decorator: ``@dsl.pipeline`` or ``@dsl.pipeline(name=..., description=...)``."""
+    if fn is None:
+        return lambda f: Pipeline(f, name=name, description=description)
+    return Pipeline(fn)

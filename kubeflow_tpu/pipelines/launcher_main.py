@@ -1,0 +1,93 @@
+"""Step-pod launcher: run one component function inside its pod.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §3.5): KFP v2's launcher container
+(`[U:pipelines/backend/src/v2/component/launcher_v2.go]`) — download input
+artifacts, execute the user component, upload outputs.  Here the component is
+an embedded Python function (lightweight-component style): the source from
+the IR is exec'd with the dsl artifact types in scope, inputs are staged from
+the object store, outputs are uploaded and reported via ``outputs.json`` in
+the node workspace (the controller is the metadata-store writer, not us).
+
+Usage (what the Workflow controller puts in the pod command):
+    python -m kubeflow_tpu.pipelines.launcher_main <workspace-dir>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import traceback
+
+
+def run(workspace: str) -> int:
+    from kubeflow_tpu.pipelines import dsl
+    from kubeflow_tpu.pipelines.artifacts import ObjectStore
+
+    with open(os.path.join(workspace, "task.json")) as f:
+        task = json.load(f)
+    store = ObjectStore(task["storeRoot"])
+
+    kwargs: dict = dict(task["defaults"])
+    kwargs.update(task["parameters"])
+
+    staged_in = os.path.join(workspace, "inputs")
+    staged_out = os.path.join(workspace, "outputs")
+    os.makedirs(staged_in, exist_ok=True)
+    os.makedirs(staged_out, exist_ok=True)
+
+    for aname, art in task["inputArtifacts"].items():
+        cls = dsl.ARTIFACT_TYPES.get(art.get("type", "system.Artifact"), dsl.Artifact)
+        a = cls(name=aname, uri=art["uri"], metadata=art.get("metadata", {}))
+        a.path = store.get(art["uri"], os.path.join(staged_in, aname))
+        kwargs[aname] = a
+
+    out_objs: dict = {}
+    for aname, art in task["outputArtifacts"].items():
+        cls = dsl.ARTIFACT_TYPES.get(art["type"], dsl.Artifact)
+        a = cls(name=aname, uri=art["uri"])
+        a.path = os.path.join(staged_out, aname)
+        out_objs[aname] = a
+        kwargs[aname] = a
+
+    # exec the component source with the dsl names lightweight components use
+    ns: dict = {
+        "dsl": dsl,
+        "Input": dsl.Input,
+        "Output": dsl.Output,
+        "Artifact": dsl.Artifact,
+        "Dataset": dsl.Dataset,
+        "Model": dsl.Model,
+        "Metrics": dsl.Metrics,
+    }
+    exec(compile(task["source"], f"<component {task['functionName']}>", "exec"), ns)
+    fn = ns[task["functionName"]]
+
+    ret = fn(**kwargs)
+
+    outputs: dict = {"outputParameters": {}, "artifactMetadata": {}}
+    if "Output" in task["outputParameters"]:
+        outputs["outputParameters"]["Output"] = ret
+    for aname, a in out_objs.items():
+        if os.path.exists(a.path):
+            store.put(a.uri, a.path)
+        outputs["artifactMetadata"][aname] = a.metadata
+
+    with open(os.path.join(workspace, "outputs.json"), "w") as f:
+        json.dump(outputs, f)
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print("usage: launcher_main <workspace>", file=sys.stderr)
+        return 2
+    try:
+        return run(sys.argv[1])
+    except Exception:
+        traceback.print_exc()
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""PipelineService: the KFP API-server equivalent, + the persistence agent.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2/§3.5): the KFP API server keeps
+pipelines / experiments / runs in MySQL, submits Argo Workflows, and a
+persistence agent reports Workflow state back.  Here the records persist in
+the native metadata store (contexts — the "MySQL is native, SQLite-equiv
+acceptable" rule of SURVEY §2b), runs are Workflow CRs, and ``sync_runs`` is
+the persistence-agent ticker folding final workflow state into the run record.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Optional, Union
+
+from ..core.api import APIServer, Obj
+from . import api as papi
+from . import metadata as md
+from .artifacts import ObjectStore
+from .compiler import Compiler
+from .dsl import Pipeline
+
+PIPELINE_CTX = "kfp.pipeline"
+EXPERIMENT_CTX = "kfp.experiment"
+RUN_CTX = "kfp.run"
+
+
+class PipelineService:
+    def __init__(self, api: APIServer, metadata_store: md.MetadataStore, store: ObjectStore):
+        self.api = api
+        self.metadata = metadata_store
+        self.store = store
+
+    # -------------------------------------------------------------- pipelines
+
+    def upload_pipeline(
+        self, pipeline: Union[Pipeline, dict], name: Optional[str] = None, description: str = ""
+    ) -> str:
+        """Register a pipeline (compiled on upload if given as a dsl.Pipeline)."""
+        ir = Compiler().compile(pipeline) if isinstance(pipeline, Pipeline) else pipeline
+        pname = name or ir["pipelineInfo"]["name"]
+        existing = self.metadata.get_context_by_name(PIPELINE_CTX, pname)
+        versions = existing.properties.get("versions", []) if existing else []
+        uri = self.store.uri("mlpipeline", f"pipelines/{pname}/v{len(versions) + 1}.json")
+        self.store.put_bytes(uri, json.dumps(ir, sort_keys=True).encode())
+        versions.append({"version": len(versions) + 1, "uri": uri, "createdAt": time.time()})
+        self.metadata.put_context(
+            PIPELINE_CTX,
+            pname,
+            {"description": description or ir["pipelineInfo"].get("description", ""), "versions": versions},
+        )
+        self._register_name(PIPELINE_CTX, pname)
+        return pname
+
+    def get_pipeline(self, name: str, version: Optional[int] = None) -> dict:
+        ctx = self.metadata.get_context_by_name(PIPELINE_CTX, name)
+        if ctx is None:
+            raise KeyError(f"pipeline {name!r} not found")
+        versions = ctx.properties["versions"]
+        v = versions[-1] if version is None else versions[version - 1]
+        return json.loads(self.store.get_bytes(v["uri"]).decode())
+
+    def list_pipelines(self) -> list[str]:
+        return sorted(c.name for c in self._contexts(PIPELINE_CTX))
+
+    def _contexts(self, ctx_type: str) -> list:
+        # context ids are discoverable via the (type,name) index only through
+        # names we know; keep a registry context listing all names.
+        reg = self.metadata.get_context_by_name(ctx_type, "__registry__")
+        names = reg.properties.get("names", []) if reg else []
+        out = []
+        for n in names:
+            c = self.metadata.get_context_by_name(ctx_type, n)
+            if c is not None:
+                out.append(c)
+        return out
+
+    def _register_name(self, ctx_type: str, name: str) -> None:
+        reg = self.metadata.get_context_by_name(ctx_type, "__registry__")
+        names = reg.properties.get("names", []) if reg else []
+        if name not in names:
+            names.append(name)
+            self.metadata.put_context(ctx_type, "__registry__", {"names": names})
+
+    # ------------------------------------------------------------ experiments
+
+    def create_experiment(self, name: str, description: str = "") -> str:
+        self.metadata.put_context(EXPERIMENT_CTX, name, {"description": description, "createdAt": time.time()})
+        self._register_name(EXPERIMENT_CTX, name)
+        return name
+
+    def list_experiments(self) -> list[str]:
+        return sorted(c.name for c in self._contexts(EXPERIMENT_CTX))
+
+    # ------------------------------------------------------------------- runs
+
+    def create_run(
+        self,
+        pipeline: Union[Pipeline, dict, str],
+        arguments: Optional[dict] = None,
+        run_name: Optional[str] = None,
+        experiment: Optional[str] = None,
+        namespace: str = "default",
+    ) -> str:
+        if isinstance(pipeline, str):
+            ir = self.get_pipeline(pipeline)
+        elif isinstance(pipeline, Pipeline):
+            ir = Compiler().compile(pipeline)
+        else:
+            ir = pipeline
+        run_id = run_name or f"run-{uuid.uuid4().hex[:8]}"
+        wf = papi.workflow(run_id, ir, arguments=arguments, namespace=namespace, labels={papi.LABEL_RUN: run_id})
+        self.api.create(wf)
+        self.metadata.put_context(
+            RUN_CTX,
+            run_id,
+            {
+                "pipeline": ir["pipelineInfo"]["name"],
+                "experiment": experiment or "Default",
+                "namespace": namespace,
+                "arguments": arguments or {},
+                "createdAt": time.time(),
+                "phase": papi.PENDING,
+            },
+        )
+        self._register_name(RUN_CTX, run_id)
+        return run_id
+
+    def get_run(self, run_id: str) -> dict:
+        ctx = self.metadata.get_context_by_name(RUN_CTX, run_id)
+        if ctx is None:
+            raise KeyError(f"run {run_id!r} not found")
+        rec = dict(ctx.properties)
+        wf = self.api.try_get("Workflow", run_id, rec.get("namespace", "default"))
+        if wf is not None:
+            rec["phase"] = wf.get("status", {}).get("phase", papi.PENDING)
+            rec["nodes"] = wf.get("status", {}).get("nodes", {})
+        return rec
+
+    def list_runs(self, experiment: Optional[str] = None) -> list[dict]:
+        out = []
+        for c in self._contexts(RUN_CTX):
+            if experiment and c.properties.get("experiment") != experiment:
+                continue
+            out.append({"run": c.name, **c.properties})
+        return sorted(out, key=lambda r: r.get("createdAt", 0))
+
+    # ------------------------------------------- persistence agent equivalent
+
+    def sync_runs(self) -> bool:
+        """Fold terminal Workflow state into run records (ticker)."""
+        changed = False
+        for c in self._contexts(RUN_CTX):
+            props = dict(c.properties)
+            if props.get("phase") in papi.WORKFLOW_TERMINAL:
+                continue
+            wf = self.api.try_get("Workflow", c.name, props.get("namespace", "default"))
+            if wf is None:
+                continue
+            phase = wf.get("status", {}).get("phase")
+            if phase and phase != props.get("phase"):
+                props["phase"] = phase
+                if phase in papi.WORKFLOW_TERMINAL:
+                    props["finishedAt"] = wf["status"].get("finishedAt")
+                self.metadata.put_context(RUN_CTX, c.name, props)
+                changed = True
+        return changed

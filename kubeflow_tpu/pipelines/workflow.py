@@ -1,0 +1,387 @@
+"""Workflow DAG controller: the Argo-equivalent executor + embedded v2 driver.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §3.5): Argo's workflow-controller
+schedules DAG nodes as pods; KFP v2 adds a per-node *driver* (input
+resolution + cache check against MLMD) and a *launcher* (runs user code,
+uploads artifacts).  Deviations by design of the deterministic simulator
+(same pattern as katib/controllers.py):
+
+  * the driver runs **in-process at reconcile time** instead of as a separate
+    driver container — identical inputs-resolution/fingerprint contract;
+  * the launcher pod reports results through its node workspace directory
+    (``outputs.json``) rather than a sidecar API call, because pods here are
+    plain OS processes with no apiserver endpoint;
+  * the controller is the **single writer** to the metadata store (WAL is a
+    one-writer format); the launcher only touches the object store.
+
+Node lifecycle: Pending → (driver: skip | cache-hit | pod created) →
+Running → Succeeded/Failed (with retries) ; condition false → Skipped ;
+upstream dep failed/skipped → Omitted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Any, Optional
+
+from ..core.api import APIServer, AlreadyExists, Obj, owner_reference
+from ..core.events import EventRecorder
+from ..core.controller import Request, Result
+from ..scheduler.topology import TPU_RESOURCE, chips_in
+from . import api as papi
+from .artifacts import ObjectStore
+from . import metadata as md
+
+
+def _resolve_ref(ref: dict, args: dict, nodes: dict) -> Any:
+    """Resolve one IR value reference against run args + completed nodes."""
+    if "constant" in ref:
+        return ref["constant"]
+    if "componentInputParameter" in ref:
+        return args[ref["componentInputParameter"]]
+    if "taskOutputParameter" in ref:
+        src = ref["taskOutputParameter"]
+        node = nodes.get(src["producerTask"], {})
+        outs = node.get("outputParameters", {})
+        if src["outputParameterKey"] not in outs:
+            raise KeyError(
+                f"task {src['producerTask']!r} produced no output "
+                f"parameter {src['outputParameterKey']!r}"
+            )
+        return outs[src["outputParameterKey"]]
+    raise ValueError(f"unresolvable reference: {ref!r}")
+
+
+_OPS = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+
+
+def _eval_condition(expr: dict, args: dict, nodes: dict) -> bool:
+    left = _resolve_ref(expr["left"], args, nodes)
+    right = _resolve_ref(expr["right"], args, nodes)
+    return bool(_OPS[expr["op"]](left, right))
+
+
+class WorkflowController:
+    kind = "Workflow"
+
+    def __init__(
+        self,
+        api: APIServer,
+        store: ObjectStore,
+        metadata_store: md.MetadataStore,
+        workdir: str,
+    ):
+        self.api = api
+        self.store = store
+        self.metadata = metadata_store
+        self.workdir = workdir
+        self.recorder = EventRecorder(api, "workflow-controller")
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        wf = self.api.try_get("Workflow", req.name, req.namespace)
+        if wf is None:
+            return None
+        status = wf.setdefault("status", {})
+        if status.get("phase") in papi.WORKFLOW_TERMINAL:
+            return None
+        if "phase" not in status:
+            status["phase"] = papi.RUNNING
+            status["startedAt"] = time.time()
+            status["nodes"] = {}
+            ctx_id = self.metadata.put_context(
+                "pipeline_run",
+                f"{req.namespace}/{req.name}",
+                {"pipeline": wf["spec"]["pipelineSpec"]["pipelineInfo"]["name"]},
+            )
+            status["contextId"] = ctx_id
+            self.recorder.normal(wf, "WorkflowStarted", "DAG execution started")
+
+        ir = wf["spec"]["pipelineSpec"]
+        dag = ir["root"]["dag"]["tasks"]
+        try:
+            args = self._arguments(wf, ir)
+        except ValueError as e:
+            # user-supplied arguments are wrong: terminal, not retryable
+            status["phase"] = papi.FAILED
+            status["message"] = str(e)
+            status["finishedAt"] = time.time()
+            self.recorder.warning(wf, "InvalidArguments", str(e))
+            self.api.update_status(wf)
+            return None
+        nodes = status["nodes"]
+        progressed = False
+
+        # iterate to fixpoint: phase changes (Failed/Skipped/Succeeded) must
+        # propagate to dependents within one reconcile regardless of task
+        # name ordering
+        while True:
+            pass_progressed = False
+            for tname in sorted(dag):
+                node = nodes.setdefault(tname, {"phase": papi.PENDING, "retries": 0})
+                if node["phase"] in papi.NODE_TERMINAL:
+                    continue
+                if node["phase"] == papi.RUNNING:
+                    if self._check_pod(wf, tname, dag[tname], node, args):
+                        pass_progressed = True
+                    continue
+                # Pending: gate on dependencies
+                dep_phases = [nodes.get(d, {}).get("phase", papi.PENDING) for d in dag[tname].get("dependentTasks", [])]
+                if any(p in (papi.FAILED, papi.SKIPPED, papi.OMITTED) for p in dep_phases):
+                    node["phase"] = papi.OMITTED
+                    pass_progressed = True
+                    continue
+                if not all(p == papi.SUCCEEDED for p in dep_phases):
+                    continue
+                if self._drive(wf, tname, dag[tname], node, args, ir):
+                    pass_progressed = True
+            if not pass_progressed:
+                break
+            progressed = True
+
+        phase = self._aggregate(nodes, dag)
+        if phase != status["phase"]:
+            status["phase"] = phase
+            if phase in papi.WORKFLOW_TERMINAL:
+                status["finishedAt"] = time.time()
+                self.recorder.normal(wf, f"Workflow{phase}", f"workflow {phase.lower()}")
+            progressed = True
+        if progressed:
+            self.api.update_status(wf)
+        return None
+
+    def _arguments(self, wf: Obj, ir: dict) -> dict:
+        defs = ir["root"]["inputDefinitions"]["parameters"]
+        args = {}
+        for pname, d in defs.items():
+            if "defaultValue" in d:
+                args[pname] = d["defaultValue"]
+        args.update(wf["spec"].get("arguments", {}))
+        unknown = set(wf["spec"].get("arguments", {})) - set(defs)
+        if unknown:
+            raise ValueError(f"unknown pipeline arguments: {sorted(unknown)}")
+        missing = set(defs) - set(args)
+        if missing:
+            raise ValueError(f"missing pipeline arguments: {sorted(missing)}")
+        return args
+
+    def _aggregate(self, nodes: dict, dag: dict) -> str:
+        phases = [nodes.get(t, {}).get("phase", papi.PENDING) for t in dag]
+        if any(p == papi.FAILED for p in phases):
+            # a failed node can never unblock the rest; finish once nothing runs
+            if not any(p == papi.RUNNING for p in phases):
+                return papi.FAILED
+            return papi.RUNNING
+        if all(p in (papi.SUCCEEDED, papi.SKIPPED, papi.OMITTED) for p in phases):
+            return papi.SUCCEEDED
+        return papi.RUNNING
+
+    # ---------------------------------------------------------------- driver
+
+    def _drive(self, wf: Obj, tname: str, tspec: dict, node: dict, args: dict, ir: dict) -> bool:
+        """KFP-v2-driver equivalent: conditions, input resolution, cache, pod."""
+        nodes = wf["status"]["nodes"]
+        for cond in tspec.get("conditions", []):
+            if not _eval_condition(cond, args, nodes):
+                node["phase"] = papi.SKIPPED
+                return True
+
+        params = {
+            p: _resolve_ref(ref, args, nodes)
+            for p, ref in tspec["inputs"]["parameters"].items()
+        }
+        in_artifacts = {}
+        for aname, ref in tspec["inputs"]["artifacts"].items():
+            src = ref["taskOutputArtifact"]
+            prod = nodes.get(src["producerTask"], {})
+            art = prod.get("outputArtifacts", {}).get(src["outputArtifactKey"])
+            if art is None:
+                raise KeyError(
+                    f"task {src['producerTask']!r} produced no artifact {src['outputArtifactKey']!r}"
+                )
+            in_artifacts[aname] = art
+
+        comp = ir["components"][tspec["componentRef"]]
+        executor = ir["deploymentSpec"]["executors"][comp["executorLabel"]]
+        out_param_defs = comp["outputDefinitions"]["parameters"]
+        out_artifact_defs = comp["outputDefinitions"]["artifacts"]
+
+        fp = _fingerprint(executor, params, in_artifacts, out_artifact_defs)
+        node["fingerprint"] = fp
+        if tspec.get("cachingOptions", {}).get("enableCache", True):
+            cached = self.metadata.find_cached_execution(fp)
+            if cached is not None:
+                outs = cached.properties.get("outputs", {})
+                node.update(
+                    phase=papi.SUCCEEDED,
+                    cached=True,
+                    executionId=cached.id,
+                    outputParameters=outs.get("parameters", {}),
+                    outputArtifacts=outs.get("artifacts", {}),
+                )
+                self.recorder.normal(wf, "CacheHit", f"node {tname}: reused execution {cached.id}")
+                return True
+
+        # stage the node workspace + launcher pod
+        run_uid = wf["metadata"]["uid"]
+        workspace = os.path.join(self.workdir, run_uid, f"{tname}-r{node['retries']}")
+        os.makedirs(workspace, exist_ok=True)
+        out_artifacts = {
+            aname: {
+                "uri": self.store.uri("mlpipeline", f"{run_uid}/{tname}/{aname}"),
+                "type": adef["schemaTitle"],
+            }
+            for aname, adef in out_artifact_defs.items()
+        }
+        task_doc = {
+            "functionName": executor["python"]["functionName"],
+            "source": executor["python"]["source"],
+            "defaults": executor["python"].get("defaults", {}),
+            "parameters": params,
+            "inputArtifacts": in_artifacts,
+            "outputArtifacts": out_artifacts,
+            "outputParameters": sorted(out_param_defs),
+            "storeRoot": self.store.root,
+        }
+        with open(os.path.join(workspace, "task.json"), "w") as f:
+            json.dump(task_doc, f)
+
+        pod_name = f"{wf['metadata']['name']}-{tname}-r{node['retries']}"
+        pod = self._pod(wf, tname, tspec, pod_name, workspace)
+        try:
+            self.api.create(pod)
+        except AlreadyExists:
+            pass
+        node.update(phase=papi.RUNNING, podName=pod_name, workspace=workspace)
+        node["inputParameters"] = params
+        node["inputArtifacts"] = in_artifacts
+        node["stagedOutputArtifacts"] = out_artifacts
+        return True
+
+    def _pod(self, wf: Obj, tname: str, tspec: dict, pod_name: str, workspace: str) -> Obj:
+        resources: dict = dict(tspec.get("resources", {}))
+        tpu = tspec.get("tpu")
+        if tpu:
+            # accelerator is "v5e-4" (chip count) or a topology like "2x2"
+            acc = tpu["accelerator"]
+            tail = acc.rsplit("-", 1)[-1]
+            chips = tpu.get("chips") or (chips_in(tail) if "x" in tail else int(tail))
+            resources[TPU_RESOURCE] = chips
+        container = {
+            "name": "main",
+            "command": [sys.executable, "-m", "kubeflow_tpu.pipelines.launcher_main", workspace],
+            "env": [{"name": "PYTHONPATH", "value": _repo_root()}],
+        }
+        if resources:
+            container["resources"] = {"limits": {k: v for k, v in resources.items()}}
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod_name,
+                "namespace": wf["metadata"].get("namespace", "default"),
+                "labels": {
+                    papi.LABEL_WORKFLOW: wf["metadata"]["name"],
+                    papi.LABEL_NODE: tname,
+                },
+                "ownerReferences": [owner_reference(wf)],
+            },
+            "spec": {"restartPolicy": "Never", "containers": [container]},
+        }
+
+    # ------------------------------------------------------------ completion
+
+    def _check_pod(self, wf: Obj, tname: str, tspec: dict, node: dict, args: dict) -> bool:
+        pod = self.api.try_get("Pod", node["podName"], wf["metadata"].get("namespace", "default"))
+        if pod is None:
+            # pod vanished (evicted/deleted) — treat as a retryable failure
+            return self._fail(wf, tname, tspec, node, "pod disappeared")
+        phase = pod.get("status", {}).get("phase")
+        if phase == "Succeeded":
+            outputs_path = os.path.join(node["workspace"], "outputs.json")
+            if not os.path.exists(outputs_path):
+                return self._fail(wf, tname, tspec, node, "pod succeeded but wrote no outputs.json")
+            with open(outputs_path) as f:
+                outs = json.load(f)
+            return self._complete(wf, tname, node, outs)
+        if phase == "Failed":
+            msg = pod.get("status", {}).get("message", "container exited nonzero")
+            return self._fail(wf, tname, tspec, node, msg)
+        return False
+
+    def _complete(self, wf: Obj, tname: str, node: dict, outs: dict) -> bool:
+        ctx_id = wf["status"]["contextId"]
+        artifacts: dict = {}
+        for aname, spec in node["stagedOutputArtifacts"].items():
+            meta = outs.get("artifactMetadata", {}).get(aname, {})
+            aid = self.metadata.put_artifact(spec["type"], spec["uri"], md.LIVE, meta)
+            self.metadata.put_attribution(ctx_id, aid)
+            artifacts[aname] = {"id": aid, "uri": spec["uri"], "type": spec["type"], "metadata": meta}
+        out_params = outs.get("outputParameters", {})
+        exec_id = self.metadata.put_execution(
+            f"component:{tname.split('-it')[0]}",
+            md.COMPLETE,
+            fingerprint=node["fingerprint"],
+            properties={
+                "task": tname,
+                "run": wf["metadata"]["name"],
+                "outputs": {"parameters": out_params, "artifacts": artifacts},
+            },
+        )
+        self.metadata.put_association(ctx_id, exec_id)
+        for aname, art in artifacts.items():
+            self.metadata.put_event(exec_id, art["id"], md.OUTPUT, aname)
+        for aname, art in (node.get("inputArtifacts") or {}).items():
+            if "id" in art:
+                self.metadata.put_event(exec_id, art["id"], md.INPUT, aname)
+        node.update(
+            phase=papi.SUCCEEDED,
+            executionId=exec_id,
+            outputParameters=out_params,
+            outputArtifacts=artifacts,
+            cached=False,
+        )
+        return True
+
+    def _fail(self, wf: Obj, tname: str, tspec: dict, node: dict, msg: str) -> bool:
+        max_retries = tspec.get("retries", 0)
+        if node["retries"] < max_retries:
+            node["retries"] += 1
+            node["phase"] = papi.PENDING
+            node.pop("podName", None)
+            self.recorder.warning(wf, "NodeRetry", f"node {tname}: {msg} (retry {node['retries']}/{max_retries})")
+            return True
+        node["phase"] = papi.FAILED
+        node["message"] = msg
+        self.recorder.warning(wf, "NodeFailed", f"node {tname}: {msg}")
+        return True
+
+
+def _fingerprint(executor: dict, params: dict, in_artifacts: dict, out_artifact_defs: dict) -> str:
+    """KFP cache key: component spec + resolved inputs (+ output surface)."""
+    doc = {
+        "source": executor["python"]["source"],
+        "functionName": executor["python"]["functionName"],
+        "parameters": params,
+        "inputArtifacts": {
+            a: {"uri": art.get("uri"), "id": art.get("id")} for a, art in sorted(in_artifacts.items())
+        },
+        "outputs": sorted(out_artifact_defs),
+    }
+    return hashlib.sha256(json.dumps(doc, sort_keys=True).encode()).hexdigest()
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -81,6 +81,7 @@ class ConcurrencyAutoscaler:
         pods = self.api.list("Pod", namespace=ns, label_selector=selector)
         inflight = 0.0
         ready = 0
+        unscraped = 0
         last_traffic = self._last_traffic.get(uid, 0.0)
         for p in pods:
             if not pod_is_ready(p):
@@ -90,9 +91,11 @@ class ConcurrencyAutoscaler:
             m = scrape_metrics(port) if port else None
             if m is None:
                 # a ready pod we cannot scrape (busy with a long request, or
-                # mid-restart) means traffic state is UNKNOWN — never make a
-                # scale-down decision on missing data
-                return False
+                # mid-restart) means traffic state is UNKNOWN for that pod —
+                # scale-UP must still work (overload is exactly when scrapes
+                # fail); only scale-DOWN decisions are vetoed below
+                unscraped += 1
+                continue
             inflight += m.get("inflight_requests", 0.0)
             last_traffic = max(last_traffic, m.get("last_request_timestamp", 0.0))
         self._last_traffic[uid] = last_traffic
@@ -108,6 +111,13 @@ class ConcurrencyAutoscaler:
         if desired > current:
             self._downscale_since.pop(uid, None)
             return self._scale(deploy, desired, zero=False)
+
+        if unscraped:
+            # missing data can only hide load, never invent it: with any
+            # unscraped pod the true desired can be higher but not lower, so
+            # scale-down (incl. to zero) is off the table this round
+            self._downscale_since.pop(uid, None)
+            return False
 
         floor = max(min_r, 1)
         if desired < current:

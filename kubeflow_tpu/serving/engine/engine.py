@@ -100,6 +100,13 @@ class Engine:
         """Submit a prompt; the Future resolves to a result dict."""
         if not tokens:
             raise ValueError("empty prompt")
+        if len(tokens) > PREFILL_BUCKETS[-1]:
+            # the prefill is bucketed; a longer prompt would overflow the
+            # largest bucket inside the loop thread and kill the engine
+            raise ValueError(
+                f"prompt of {len(tokens)} tokens exceeds the largest prefill "
+                f"bucket ({PREFILL_BUCKETS[-1]})"
+            )
         fut: Future = Future()
         with self._lock:
             rid = self._next_id

@@ -8,12 +8,12 @@ hash into the package directory (also buildable via the Makefile here).
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
 import threading
 
 import numpy as np
+
+from ...utils.native_build import load_native
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "core.cc")
@@ -21,24 +21,11 @@ _LOCK = threading.Lock()
 _LIB = None
 
 
-def _build() -> str:
-    with open(_SRC, "rb") as f:
-        tag = hashlib.md5(f.read()).hexdigest()[:10]
-    so_path = os.path.join(_DIR, f"_core_{tag}.so")
-    if os.path.exists(so_path):
-        return so_path
-    tmp = so_path + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-Wall", _SRC, "-o", tmp]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(tmp, so_path)  # atomic under concurrent builders
-    return so_path
-
-
 def load_library() -> ctypes.CDLL:
     global _LIB
     with _LOCK:
         if _LIB is None:
-            lib = ctypes.CDLL(_build())
+            lib = load_native(_SRC, "core")
             i32, i64, p = ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p
             ip = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
             lib.eng_create.restype = p

@@ -1,0 +1,49 @@
+"""Build-on-first-use for first-party C++ cores (C ABI via ctypes).
+
+No pybind11 in this image (SURVEY.md §7 env notes): each native component
+(serving engine core, pipelines metadata core) ships a .cc exposing a C ABI
+and binds with ctypes.  The shared object is compiled once per source hash
+into the source's directory; concurrent builders race safely via an atomic
+rename.  Sanitizer builds (ASAN/TSAN, SURVEY.md §5) live in each component's
+Makefile.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_LOCK = threading.Lock()
+_CACHE: dict[str, ctypes.CDLL] = {}
+
+
+def build_native(src_path: str, prefix: str) -> str:
+    """Compile ``src_path`` to ``<dir>/_<prefix>_<srchash>.so``; return the path."""
+    src_dir = os.path.dirname(os.path.abspath(src_path))
+    with open(src_path, "rb") as f:
+        tag = hashlib.md5(f.read()).hexdigest()[:10]
+    so_path = os.path.join(src_dir, f"_{prefix}_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-Wall", src_path, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"native build failed for {src_path}:\n{e.stderr.decode(errors='replace')}"
+        ) from e
+    os.replace(tmp, so_path)  # atomic under concurrent builders
+    return so_path
+
+
+def load_native(src_path: str, prefix: str) -> ctypes.CDLL:
+    """Build (if needed) and dlopen; one CDLL per source file per process."""
+    key = os.path.abspath(src_path)
+    with _LOCK:
+        if key not in _CACHE:
+            _CACHE[key] = ctypes.CDLL(build_native(src_path, prefix))
+        return _CACHE[key]

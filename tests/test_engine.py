@@ -53,6 +53,24 @@ def test_native_batcher_lifecycle():
     b.close()
 
 
+def test_native_batcher_rejects_pool_unfittable_prompt():
+    # per-slot cap (64) would admit it, but the whole pool has 31 usable
+    # pages: queueing it would block head-of-line admission forever
+    b = NativeBatcher(max_slots=2, num_pages=32, page_size=8, max_pages_per_slot=64)
+    assert not b.submit(1, 300, 4)   # 38 pages > 32-page pool
+    assert b.submit(2, 100, 4)       # 13 pages: fits the pool
+    b.close()
+
+
+def test_engine_rejects_prompt_over_largest_bucket(params):
+    eng = Engine(params, CFG, EngineConfig(max_slots=2, num_pages=4096, page_size=32, max_pages_per_slot=64))
+    try:
+        with pytest.raises(ValueError, match="prefill"):
+            eng.generate_async(list(range(1100)), 4)  # > 1024 bucket, fits pages
+    finally:
+        eng.stop()
+
+
 def test_native_batcher_gang_admission_waits_for_pages():
     b = NativeBatcher(max_slots=2, num_pages=5, page_size=4, max_pages_per_slot=4)
     assert b.submit(1, 12, 1)  # 3 pages

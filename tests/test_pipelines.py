@@ -1,0 +1,301 @@
+"""Pipelines: compiler goldens, metadata store, DAG execution, caching, cron.
+
+Mirrors the reference test strategy (SURVEY.md §4): golden-file compiler
+snapshots + reconciler-driven E2E on the in-process cluster with real step
+subprocesses.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from kubeflow_tpu.pipelines import dsl
+from kubeflow_tpu.pipelines import api as papi
+from kubeflow_tpu.pipelines import cron
+from kubeflow_tpu.pipelines.client import Client
+from kubeflow_tpu.pipelines.compiler import CompileError, Compiler, compile_to_json
+from kubeflow_tpu.pipelines.metadata import COMPLETE, MetadataStore, OUTPUT, RUNNING
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldens")
+
+
+@pytest.fixture()
+def tpu_cluster():
+    """CPU node + one simulated v5e 2x2 slice (for steps with set_tpu)."""
+    from kubeflow_tpu.core.cluster import Cluster
+
+    c = Cluster(cpu_nodes=1, tpu_slices=(("s0", "v5e", "2x2"),))
+    yield c
+    c.shutdown()
+
+
+# ----------------------------------------------------------------- components
+
+
+@dsl.component
+def make_data(rows: int, data: dsl.Output[dsl.Dataset]) -> int:
+    with open(data.path, "w") as f:
+        f.write("x,y\n" * rows)
+    data.metadata["rows"] = rows
+    return rows
+
+
+@dsl.component
+def train(data: dsl.Input[dsl.Dataset], lr: float, model: dsl.Output[dsl.Model],
+          metrics: dsl.Output[dsl.Metrics]) -> float:
+    with open(data.path) as f:
+        n = len(f.readlines())
+    acc = min(0.5 + lr * n / 100.0, 0.99)
+    with open(model.path, "w") as f:
+        f.write(f"weights lr={lr}\n")
+    metrics.log_metric("accuracy", acc)
+    return acc
+
+
+@dsl.component
+def deploy(model: dsl.Input[dsl.Model], name: str = "svc") -> str:
+    with open(model.path) as f:
+        assert "weights" in f.read()
+    return name
+
+
+@dsl.component
+def flaky(marker_dir: str) -> int:
+    import os as _os
+    marker = _os.path.join(marker_dir, "attempted")
+    if not _os.path.exists(marker):
+        with open(marker, "w") as f:
+            f.write("1")
+        raise RuntimeError("first attempt always fails")
+    return 7
+
+
+@dsl.pipeline(name="train-and-deploy", description="golden: artifacts + condition")
+def train_and_deploy(rows: int = 20, lr: float = 0.5, threshold: float = 0.2):
+    d = make_data(rows=rows)
+    t = train(data=d.outputs["data"], lr=lr)
+    with dsl.Condition(t.output > threshold):
+        deploy(model=t.outputs["model"]).set_tpu("v5e-4")
+
+
+@dsl.pipeline(name="lr-sweep", description="golden: static ParallelFor fan-out")
+def lr_sweep(rows: int = 10):
+    d = make_data(rows=rows)
+    with dsl.ParallelFor([0.1, 0.9]) as lr:
+        train(data=d.outputs["data"], lr=lr)
+
+
+# ------------------------------------------------------------------- compiler
+
+
+def _check_golden(name: str, text: str):
+    path = os.path.join(GOLDEN_DIR, name)
+    if os.environ.get("GOLDEN_UPDATE") == "1":
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+    with open(path) as f:
+        assert f.read() == text, f"golden mismatch for {name} (GOLDEN_UPDATE=1 to refresh)"
+
+
+def test_compiler_golden_train_and_deploy():
+    _check_golden("pipeline_train_and_deploy.json", compile_to_json(train_and_deploy))
+
+
+def test_compiler_golden_lr_sweep():
+    _check_golden("pipeline_lr_sweep.json", compile_to_json(lr_sweep))
+
+
+def test_compiler_loop_expansion_and_conditions():
+    ir = Compiler().compile(train_and_deploy)
+    dag = ir["root"]["dag"]["tasks"]
+    assert set(dag) == {"make-data", "train", "deploy"}
+    assert dag["deploy"]["conditions"][0]["op"] == ">"
+    assert dag["deploy"]["tpu"] == {"accelerator": "v5e-4", "chips": 0}
+    ir2 = Compiler().compile(lr_sweep)
+    dag2 = ir2["root"]["dag"]["tasks"]
+    assert set(dag2) == {"make-data", "train-it0", "train-it1"}
+    assert dag2["train-it0"]["inputs"]["parameters"]["lr"] == {"constant": 0.1}
+    assert dag2["train-it1"]["inputs"]["parameters"]["lr"] == {"constant": 0.9}
+
+
+def test_compiler_rejects_fan_in():
+    @dsl.pipeline(name="bad")
+    def bad(rows: int = 1):
+        d = make_data(rows=rows)
+        with dsl.ParallelFor([0.1, 0.2]) as lr:
+            t = train(data=d.outputs["data"], lr=lr)
+        deploy(model=t.outputs["model"])  # consumes one iteration from outside
+
+    with pytest.raises(CompileError, match="fan-in"):
+        Compiler().compile(bad)
+
+
+def test_component_called_outside_pipeline_runs_directly(tmp_path):
+    out = dsl.Dataset(uri="")
+    out.path = str(tmp_path / "d.csv")
+    assert make_data(rows=3, data=out) == 3
+    assert out.metadata["rows"] == 3
+
+
+# ------------------------------------------------------------- metadata store
+
+
+def test_metadata_store_roundtrip_and_wal(tmp_path):
+    path = str(tmp_path / "meta.wal")
+    s = MetadataStore(path)
+    ctx = s.put_context("pipeline_run", "r1", {"pipeline": "demo"})
+    aid = s.put_artifact("system.Dataset", "mstore://b/k", properties={"rows": 5})
+    eid = s.put_execution("comp-x", RUNNING, fingerprint="fp1")
+    s.put_event(eid, aid, OUTPUT, "data")
+    s.put_association(ctx, eid)
+    s.put_attribution(ctx, aid)
+    s.put_execution("comp-x", COMPLETE, fingerprint="fp1", execution_id=eid,
+                    properties={"outputs": {"parameters": {"Output": 5}}})
+    hit = s.find_cached_execution("fp1")
+    assert hit is not None and hit.id == eid
+    assert hit.properties["outputs"]["parameters"]["Output"] == 5
+    assert [e.artifact_id for e in s.events_by_execution(eid)] == [aid]
+    s.close()
+
+    s2 = MetadataStore(path)  # WAL replay
+    assert s2.counts() == {"artifacts": 1, "executions": 1, "contexts": 1, "events": 1}
+    assert s2.get_artifact(aid).properties == {"rows": 5}
+    assert s2.get_context_by_name("pipeline_run", "r1").id == ctx
+    assert [x.id for x in s2.executions_by_context(ctx)] == [eid]
+    s2.close()
+
+
+def test_metadata_store_rejects_dangling_refs(tmp_path):
+    s = MetadataStore()
+    with pytest.raises(KeyError):
+        s.put_event(999, 999, OUTPUT, "x")
+    s.close()
+
+
+# ------------------------------------------------------------------ execution
+
+
+def _wf_nodes(client, run_id):
+    return client.service.get_run(run_id)["nodes"]
+
+
+def test_pipeline_e2e_artifacts_condition_caching(tpu_cluster):
+    cluster = tpu_cluster
+    client = Client(cluster)
+    run = client.create_run_from_pipeline_func(train_and_deploy, arguments={"rows": 30})
+    rec = run.wait(timeout=90)
+    assert rec["phase"] == papi.SUCCEEDED
+    nodes = rec["nodes"]
+    assert nodes["make-data"]["outputParameters"]["Output"] == 30
+    assert nodes["train"]["outputArtifacts"]["metrics"]["metadata"]["accuracy"] > 0.2
+    assert nodes["deploy"]["phase"] == papi.SUCCEEDED  # condition true
+    assert not nodes["train"].get("cached")
+
+    # identical run → every node a cache hit, no new pods
+    pods_before = len(cluster.api.list("Pod"))
+    run2 = client.create_run_from_pipeline_func(train_and_deploy, arguments={"rows": 30})
+    rec2 = run2.wait(timeout=30)
+    assert rec2["phase"] == papi.SUCCEEDED
+    assert all(n.get("cached") for n in rec2["nodes"].values() if n["phase"] == papi.SUCCEEDED)
+    assert len(cluster.api.list("Pod")) == pods_before
+
+    # different argument → cache miss on the producer chain
+    run3 = client.create_run_from_pipeline_func(train_and_deploy, arguments={"rows": 31})
+    rec3 = run3.wait(timeout=90)
+    assert rec3["phase"] == papi.SUCCEEDED
+    assert not rec3["nodes"]["make-data"].get("cached")
+
+
+def test_pipeline_condition_false_skips(tpu_cluster):
+    cluster = tpu_cluster
+    client = Client(cluster)
+    # threshold above any achievable accuracy → deploy skipped
+    run = client.create_run_from_pipeline_func(
+        train_and_deploy, arguments={"rows": 4, "lr": 0.01, "threshold": 5.0}
+    )
+    rec = run.wait(timeout=90)
+    assert rec["phase"] == papi.SUCCEEDED
+    assert rec["nodes"]["deploy"]["phase"] == papi.SKIPPED
+
+
+def test_pipeline_retry_recovers(cluster, tmp_path):
+    @dsl.pipeline(name="retry-pipe")
+    def retry_pipe(marker_dir: str = ""):
+        flaky(marker_dir=marker_dir).set_retry(2).set_caching_options(False)
+
+    client = Client(cluster)
+    run = client.create_run_from_pipeline_func(retry_pipe, arguments={"marker_dir": str(tmp_path)})
+    rec = run.wait(timeout=90)
+    assert rec["phase"] == papi.SUCCEEDED
+    assert rec["nodes"]["flaky"]["retries"] == 1
+    assert rec["nodes"]["flaky"]["outputParameters"]["Output"] == 7
+
+
+def test_pipeline_failure_marks_workflow_failed(cluster):
+    @dsl.component
+    def boom() -> int:
+        raise RuntimeError("kaboom")
+
+    @dsl.component
+    def downstream(x: int) -> int:
+        return x
+
+    @dsl.pipeline(name="fail-pipe")
+    def fail_pipe():
+        b = boom().set_caching_options(False)
+        downstream(x=b.output)
+
+    client = Client(cluster)
+    run = client.create_run_from_pipeline_func(fail_pipe)
+    rec = run.wait(timeout=90)  # FAILED is terminal; wait returns the record
+    assert rec["phase"] == papi.FAILED
+    assert rec["nodes"]["boom"]["phase"] == papi.FAILED
+    assert rec["nodes"]["downstream"]["phase"] == papi.OMITTED
+
+
+def test_parallelfor_executes_all_iterations(cluster):
+    client = Client(cluster)
+    run = client.create_run_from_pipeline_func(lr_sweep, arguments={"rows": 6})
+    rec = run.wait(timeout=90)
+    assert rec["phase"] == papi.SUCCEEDED
+    accs = {
+        name: n["outputParameters"]["Output"]
+        for name, n in rec["nodes"].items()
+        if name.startswith("train-")
+    }
+    assert len(accs) == 2 and accs["train-it0"] != accs["train-it1"]
+
+
+# ------------------------------------------------------------ recurring + cron
+
+
+def test_scheduled_workflow_interval(cluster):
+    client = Client(cluster)
+    ir = Compiler().compile(lr_sweep)
+    swf = papi.scheduled_workflow("tick", ir, interval_seconds=0.5, arguments={"rows": 2})
+    cluster.api.create(swf)
+    ok = cluster.manager.run_until(
+        lambda: len(cluster.api.list("Workflow", label_selector={"scheduledworkflow": "tick"})) >= 2,
+        timeout=60,
+    )
+    assert ok, "scheduled workflow fired fewer than 2 times"
+    # disable → no more fires
+    obj = cluster.api.get("ScheduledWorkflow", "tick")
+    obj["spec"]["enabled"] = False
+    cluster.api.update(obj)
+
+
+def test_cron_parse_and_next_fire():
+    t0 = time.mktime((2026, 7, 29, 10, 0, 30, 0, 0, -1))
+    nxt = cron.next_fire("*/15 * * * *", t0)
+    assert time.localtime(nxt).tm_min == 15
+    nxt2 = cron.next_fire("0 3 * * *", t0)
+    lt = time.localtime(nxt2)
+    assert (lt.tm_hour, lt.tm_min) == (3, 0)
+    with pytest.raises(ValueError):
+        cron.parse("61 * * * *")
+    with pytest.raises(ValueError):
+        cron.parse("* * * *")
