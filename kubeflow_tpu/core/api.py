@@ -169,6 +169,7 @@ class APIServer:
         self._crds: dict[str, CRD] = {}          # by kind
         self._objects: dict[str, dict[tuple, Obj]] = {}
         self._watchers: dict[str, list[Watcher]] = {}
+        self._mutating_webhooks: dict[str, list[Callable[[Obj], None]]] = {}
         self._rv = 0
         self.register_crd(CRD(group="", version="v1", kind="Namespace", plural="namespaces", namespaced=False))
         self.register_crd(CRD(group="", version="v1", kind="Pod", plural="pods"))
@@ -196,6 +197,14 @@ class APIServer:
             return self._crds[kind]
         except KeyError:
             raise NotFound(f"no resource type registered for kind {kind!r}")
+
+    def register_mutating_webhook(self, kind: str, fn: Callable[[Obj], None]) -> None:
+        """Admission-webhook equivalent: fn mutates the object at create time
+        (after defaulting, before validation) — upstream analogue is the
+        PodDefaults mutating webhook (SURVEY.md §2a)."""
+        with self._lock:
+            self.crd_for(kind)
+            self._mutating_webhooks.setdefault(kind, []).append(fn)
 
     # ------------------------------------------------------------- namespaces
 
@@ -240,6 +249,8 @@ class APIServer:
             meta.setdefault("annotations", {})
             if crd.defaulter:
                 crd.defaulter(obj)
+            for hook in self._mutating_webhooks.get(kind, []):
+                hook(obj)
             if crd.validator:
                 crd.validator(obj)
             self._objects[kind][key] = obj

@@ -1,1 +1,13 @@
-"""TPU-native Kubeflow-capability platform."""
+"""Platform shell (SURVEY.md §2a/§7 phase 8): multi-tenancy + deployment.
+
+  * ``api`` + ``controllers`` — Profile / Notebook / PodDefault CRDs and
+    their reconcilers (namespace+RBAC+quota, StatefulSet+Service+culling,
+    mutating pod injection);
+  * ``kfam`` — access management (contributors as RoleBindings);
+  * ``spawner`` — jupyter-web-app backend with a TPU-first image/chip form;
+  * ``dashboard`` — central-dashboard aggregation API;
+  * ``kfadm`` — kfctl-equivalent: KfDef apply wires pillars into a Cluster.
+"""
+
+from .controllers import install  # noqa: F401
+from .kfadm import KfAdm, kfdef  # noqa: F401

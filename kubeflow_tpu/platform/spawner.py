@@ -1,0 +1,121 @@
+"""Notebook spawner backend (jupyter-web-app equivalent), TPU-first.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2a/§5): the jupyter-web-app Flask
+backend rendering ``spawner_ui_config.yaml`` — default images, CPU/RAM
+options, and the accelerator dropdown.  That dropdown is where
+``nvidia.com/gpu`` lives upstream; here the accelerator surface is TPU-VM
+images + ``google.com/tpu`` chips, and the config is a typed dataclass
+rendered into the same ConfigMap semantics (SURVEY.md §5 config system).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.api import AlreadyExists, APIServer
+from . import api as papi
+
+
+@dataclass(frozen=True)
+class SpawnerConfig:
+    """The spawner form's option space (spawner_ui_config.yaml equivalent)."""
+
+    images: tuple = (
+        "jupyter-tpu:v5e",          # TPU-VM image: jax preinstalled
+        "jupyter-scipy:latest",
+        "jupyter-pytorch-xla:v5e",
+    )
+    default_image: str = "jupyter-tpu:v5e"
+    cpu_options: tuple = ("0.5", "1", "2", "4")
+    memory_options: tuple = ("1Gi", "2Gi", "4Gi", "8Gi")
+    # TPU-first: the accelerator list is slices of chips, not GPU counts
+    tpu_options: tuple = (0, 1, 4, 8)
+    default_command: tuple = ("python", "-c", "import time; time.sleep(3600)")
+
+    def to_configmap(self, namespace: str = "kubeflow") -> dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "spawner-ui-config", "namespace": namespace},
+            "data": {
+                "spawner_ui_config.json": json.dumps(
+                    {
+                        "images": list(self.images),
+                        "defaultImage": self.default_image,
+                        "cpu": list(self.cpu_options),
+                        "memory": list(self.memory_options),
+                        "tpuChips": list(self.tpu_options),
+                    },
+                    sort_keys=True,
+                )
+            },
+        }
+
+
+class Spawner:
+    """Form-validated Notebook creation + activity tracking."""
+
+    def __init__(self, api: APIServer, config: SpawnerConfig = SpawnerConfig()):
+        self.api = api
+        self.config = config
+        try:
+            api.create(config.to_configmap())
+        except AlreadyExists:
+            pass
+
+    def options(self) -> dict:
+        cm = self.api.get("ConfigMap", "spawner-ui-config", "kubeflow")
+        return json.loads(cm["data"]["spawner_ui_config.json"])
+
+    def spawn(
+        self,
+        name: str,
+        namespace: str,
+        image: Optional[str] = None,
+        cpu: str = "1",
+        memory: str = "2Gi",
+        tpu_chips: int = 0,
+        command: Optional[list] = None,
+        env: Optional[dict] = None,
+    ) -> dict:
+        opts = self.options()
+        image = image or opts["defaultImage"]
+        if image not in opts["images"]:
+            raise ValueError(f"image {image!r} not in spawner config {opts['images']}")
+        if cpu not in opts["cpu"]:
+            raise ValueError(f"cpu {cpu!r} not in {opts['cpu']}")
+        if memory not in opts["memory"]:
+            raise ValueError(f"memory {memory!r} not in {opts['memory']}")
+        if tpu_chips not in opts["tpuChips"]:
+            raise ValueError(f"tpu_chips {tpu_chips} not in {opts['tpuChips']}")
+        nb = papi.notebook(
+            name,
+            namespace,
+            list(command or self.config.default_command),
+            cpu=cpu,
+            memory=memory,
+            tpu_chips=tpu_chips,
+            env=env,
+        )
+        nb["metadata"].setdefault("annotations", {})[papi.LAST_ACTIVITY_ANNOTATION] = str(time.time())
+        nb["metadata"]["annotations"]["notebooks.kubeflow.org/image"] = image
+        return self.api.create(nb)
+
+    def touch(self, name: str, namespace: str) -> None:
+        """Record user activity (resets the culling clock, un-culls)."""
+        self.api.patch(
+            "Notebook",
+            name,
+            {
+                "metadata": {
+                    "annotations": {
+                        papi.LAST_ACTIVITY_ANNOTATION: str(time.time()),
+                        papi.CULLED_ANNOTATION: None,
+                    }
+                }
+            },
+            namespace,
+        )

@@ -1,0 +1,163 @@
+"""Platform shell: profiles/RBAC, KFAM, notebooks+culling, PodDefaults,
+spawner, dashboard, kfadm full-platform bring-up."""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.core.conditions import has_condition
+from kubeflow_tpu.platform import api as papi
+from kubeflow_tpu.platform import controllers as pc
+from kubeflow_tpu.platform.dashboard import Dashboard
+from kubeflow_tpu.platform.kfadm import KfAdm, kfdef
+from kubeflow_tpu.platform.kfam import AccessManagement
+from kubeflow_tpu.platform.spawner import Spawner
+
+
+@pytest.fixture()
+def platform(cluster):
+    culler = pc.install(cluster.api, cluster.manager, cull_idle_seconds=0.6)
+    return cluster, culler
+
+
+def test_profile_provisions_namespace_rbac_quota(platform):
+    cluster, _ = platform
+    cluster.api.create(papi.profile("team-ml", "alice@example.com", {"cpu": "16", "google.com/tpu": "8"}))
+    assert cluster.wait_for(
+        lambda: has_condition(cluster.api.try_get("Profile", "team-ml").get("status", {}) or {}, papi.READY),
+        timeout=10,
+    )
+    assert cluster.api.try_get("Namespace", "team-ml") is not None
+    assert cluster.api.get("Role", "namespaceAdmin", "team-ml")["rules"]
+    bindings = cluster.api.list("RoleBinding", namespace="team-ml")
+    assert any(b["metadata"]["labels"].get("user") == "alice@example.com" for b in bindings)
+    quota = cluster.api.get("ResourceQuota", "kf-resource-quota", "team-ml")
+    assert quota["spec"]["hard"]["google.com/tpu"] == "8"
+    assert cluster.api.get("AuthorizationPolicy", "ns-owner-access", "team-ml")
+
+    # deleting the profile cascades the namespace
+    cluster.api.delete("Profile", "team-ml")
+    cluster.settle(quiet=0.3)
+    assert cluster.api.try_get("Namespace", "team-ml") is None
+
+
+def test_kfam_bindings_and_namespace_listing(platform):
+    cluster, _ = platform
+    cluster.api.create(papi.profile("ns-a", "owner@x.com"))
+    cluster.api.create(papi.profile("ns-b", "other@x.com"))
+    cluster.settle(quiet=0.2)
+    kfam = AccessManagement(cluster.api)
+    kfam.create_binding("ns-b", "owner@x.com", "edit")
+    assert {"user": "owner@x.com", "role": "edit"} in kfam.list_bindings("ns-b")
+    assert kfam.namespaces_for("owner@x.com") == ["ns-a", "ns-b"]
+    kfam.delete_binding("ns-b", "owner@x.com", "edit")
+    assert kfam.namespaces_for("owner@x.com") == ["ns-a"]
+    with pytest.raises(Exception):
+        kfam.create_binding("missing-ns", "x@x.com")
+
+
+def test_notebook_runs_and_culls(platform):
+    cluster, _ = platform
+    spawner = Spawner(cluster.api)
+    nb = spawner.spawn("nb1", "default", cpu="1", memory="2Gi")
+    assert nb["metadata"]["annotations"][papi.LAST_ACTIVITY_ANNOTATION]
+
+    def ready():
+        n = cluster.api.get("Notebook", "nb1")
+        return has_condition(n.get("status", {}), papi.READY)
+
+    assert cluster.wait_for(ready, timeout=20)
+    assert cluster.api.get("StatefulSet", "nb1")["status"]["readyReplicas"] == 1
+    assert cluster.api.get("Service", "nb1")
+
+    # idle past the threshold → culled, pod gone
+    def culled():
+        n = cluster.api.get("Notebook", "nb1")
+        return has_condition(n.get("status", {}), papi.CULLED)
+
+    assert cluster.wait_for(culled, timeout=20)
+    cluster.settle(quiet=0.3)
+    assert cluster.api.try_get("Pod", "nb1-0") is None
+
+    # activity resets the clock and resurrects the pod
+    spawner.touch("nb1", "default")
+    assert cluster.wait_for(ready, timeout=20)
+    assert cluster.api.try_get("Pod", "nb1-0") is not None
+
+
+def test_spawner_validates_form(platform):
+    cluster, _ = platform
+    spawner = Spawner(cluster.api)
+    assert 8 in spawner.options()["tpuChips"]
+    with pytest.raises(ValueError, match="image"):
+        spawner.spawn("nb2", "default", image="bogus:latest")
+    with pytest.raises(ValueError, match="tpu_chips"):
+        spawner.spawn("nb2", "default", tpu_chips=3)
+
+
+def test_poddefaults_injects_env_and_volumes(platform):
+    cluster, _ = platform
+    cluster.api.create(
+        papi.pod_default(
+            "tpu-cache", "default",
+            selector={"matchLabels": {"inject-tpu-cache": "true"}},
+            env={"JAX_COMPILATION_CACHE_DIR": "/cache/jax"},
+            volumes=[{"name": "cache", "emptyDir": {}}],
+            volume_mounts=[{"name": "cache", "mountPath": "/cache"}],
+        )
+    )
+    pod = cluster.api.create(
+        {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p1", "labels": {"inject-tpu-cache": "true"}},
+            "spec": {"containers": [{"name": "main", "command": ["true"], "env": []}]},
+        }
+    )
+    env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+    assert env["JAX_COMPILATION_CACHE_DIR"] == "/cache/jax"
+    assert pod["spec"]["volumes"] == [{"name": "cache", "emptyDir": {}}]
+    # non-matching pod untouched
+    pod2 = cluster.api.create(
+        {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p2"},
+            "spec": {"containers": [{"name": "main", "command": ["true"]}]},
+        }
+    )
+    assert "env" not in pod2["spec"]["containers"][0] or not pod2["spec"]["containers"][0]["env"]
+
+
+def test_dashboard_aggregates(platform):
+    cluster, _ = platform
+    cluster.api.create(papi.profile("dash-ns", "dash@x.com"))
+    cluster.settle(quiet=0.2)
+    spawner = Spawner(cluster.api)
+    spawner.spawn("nb-dash", "dash-ns")
+    cluster.settle(quiet=0.2)
+    dash = Dashboard(cluster.api)
+    assert dash.namespaces("dash@x.com") == ["dash-ns"]
+    summary = dash.summary("dash-ns")
+    assert summary["resources"]["Notebook"]["count"] == 1
+    acts = dash.activity("dash-ns")
+    assert isinstance(acts, list)
+
+
+def test_kfadm_full_platform_bringup(cluster):
+    """kfctl-equivalent: one KfDef apply installs every pillar; a workload
+    from each pillar then round-trips through its controller."""
+    adm = KfAdm(cluster)
+    obj = adm.apply(kfdef(applications=("platform", "training", "katib", "serving", "pipelines")))
+    assert obj["status"]["phase"] == "Ready"
+    assert {a["name"] for a in obj["status"]["applications"]} == {
+        "platform", "training", "katib", "serving", "pipelines"
+    }
+    # every pillar's CRDs are registered now
+    for kind in ("Profile", "Notebook", "PodDefault", "TPUJob", "Experiment",
+                 "InferenceService", "Workflow", "ScheduledWorkflow"):
+        cluster.api.crd_for(kind)
+    # idempotent re-apply
+    obj2 = adm.apply(kfdef())
+    assert all(a["status"] == "Ready" for a in obj2["status"]["applications"])
+    # platform pillar actually reconciles
+    cluster.api.create(papi.profile("kfadm-ns", "kfadm@x.com"))
+    assert cluster.wait_for(lambda: cluster.api.try_get("Namespace", "kfadm-ns") is not None, timeout=10)
