@@ -1,1 +1,14 @@
-"""TPU-native Kubeflow-capability platform."""
+"""TPU compute ops (SURVEY.md §2c/§5): attention family + MoE.
+
+  * ``attention`` — dense MHA (MXU-shaped einsums), the reference impl;
+  * ``flash_attention`` — Pallas TPU kernel, blockwise-recompute backward;
+  * ``ring_attention`` — context parallelism over the ICI ring (``seq`` axis);
+  * ``ulysses`` — head all-to-all sequence parallelism (short-context CP);
+  * ``moe`` — expert-parallel mixture-of-experts FFN (``expert`` axis).
+"""
+
+from .attention import multihead_attention  # noqa: F401
+from .flash_attention import flash_attention  # noqa: F401
+from .moe import MoEConfig, init_moe, moe_ffn  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,194 @@
+"""Flash attention: Pallas TPU forward kernel + blockwise-recompute backward.
+
+Role (SURVEY.md §5 long-context, §7 phase 9): the single-chip building block
+the long-context layer composes — ring attention runs this per KV block, the
+workload layer uses it directly for seq ≤ a few k.
+
+Design (pallas_guide.md):
+  * forward: grid over (batch·heads, q blocks); K/V for the row live in VMEM,
+    inner ``fori_loop`` walks K blocks with an online-softmax accumulator in
+    f32 scratch; causal blocks beyond the diagonal are skipped via ``pl.when``
+    on whole blocks (the main win over dense attention);
+  * the kernel also emits the log-sum-exp rows, so backward can recompute
+    probabilities blockwise in plain XLA (standard flash backward) — memory
+    stays O(S·block) and the op is fully differentiable without a second
+    hand-written kernel;
+  * ``interpret=`` auto-selects: compiled on TPU, interpreter elsewhere
+    (the CPU test mesh), same numerics either way.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9
+
+
+def _auto_interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+# ------------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale              # [block_q, d]
+    num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)   # [block_k, d]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=1))
+        p = jnp.exp(logits - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    if causal:
+        # blocks entirely above the diagonal contribute nothing — skip them
+        last_kb = jnp.minimum(((qi + 1) * block_q - 1) // block_k + 1, num_kb)
+    else:
+        last_kb = num_kb
+    acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, last_kb, body, (acc0, m0, l0))
+
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    grid = (bh, seq_q // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_k=seq_k,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------- backward (blockwise XLA)
+
+
+def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_k):
+    """Standard flash backward: recompute P per K block from saved lse."""
+    f32 = jnp.float32
+    q32, k32, v32 = q.astype(f32), k.astype(f32), v.astype(f32)
+    o32, do32 = out.astype(f32), do.astype(f32)
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    delta = jnp.sum(o32 * do32, axis=-1)                    # [bh, seq_q]
+    num_kb = seq_k // block_k
+
+    q_pos = jnp.arange(seq_q)
+
+    def body(kb, dq):
+        ks = jax.lax.dynamic_slice_in_dim(k32, kb * block_k, block_k, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(v32, kb * block_k, block_k, axis=1)
+        logits = jnp.einsum("bqd,bkd->bqk", q32, ks) * scale
+        if causal:
+            k_pos = kb * block_k + jnp.arange(block_k)
+            logits = jnp.where(q_pos[:, None] >= k_pos[None, :], logits, NEG_INF)
+        p = jnp.exp(logits - lse[:, :, None])               # [bh, q, blk]
+        dv = jnp.einsum("bqk,bqd->bkd", p, do32)
+        dp = jnp.einsum("bqd,bkd->bqk", do32, vs)
+        ds = p * (dp - delta[:, :, None]) * scale
+        dk = jnp.einsum("bqk,bqd->bkd", ds, q32)
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, ks)
+        return dq, (dk, dv)
+
+    dq0 = jnp.zeros_like(q32)
+    dq, (dks, dvs) = jax.lax.scan(
+        lambda c, kb: body(kb, c), dq0, jnp.arange(num_kb)
+    )
+    dk = jnp.moveaxis(dks, 0, 1).reshape(k.shape[0], seq_k, k.shape[2])
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(v.shape)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ----------------------------------------------------------------- public op
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    scale = q.shape[-1] ** -0.5
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    scale = q.shape[-1] ** -0.5
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    scale = q.shape[-1] ** -0.5
+    return _flash_bwd(q, k, v, out, lse, do, scale, causal, block_k)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, T, H, D]
+    v: jax.Array,  # [B, T, H, D]
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Drop-in for ops.attention.multihead_attention (no padding mask)."""
+    if interpret is None:
+        interpret = _auto_interpret()
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, t)
+    if s % block_q or t % block_k:
+        raise ValueError(f"seq lengths ({s},{t}) must divide blocks ({block_q},{block_k})")
+    # [B, S, H, D] -> [B*H, S, D] rows for the kernel grid
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    of = _flash(qf, kf, vf, causal, block_q, block_k, interpret)
+    return of.reshape(b, h, s, d).transpose(0, 2, 1, 3)

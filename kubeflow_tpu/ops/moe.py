@@ -1,0 +1,105 @@
+"""Mixture-of-Experts FFN with expert parallelism (``expert`` mesh axis).
+
+Role (SURVEY.md §2c "EP" row): absent from the reference; a capability add of
+the TPU rebuild.  Switch-Transformer-style top-k routing with capacity:
+
+  * routing, dispatch and combine are dense one-hot einsums — static shapes,
+    MXU-friendly, no gathers (the TPU idiom for MoE);
+  * expert weights and the dispatched token buffer are sharding-constrained
+    onto the ``expert`` axis, so under jit XLA lowers the dispatch/combine
+    einsums into the all-to-alls of classic expert parallelism;
+  * tokens over an expert's capacity are dropped (residual passes through),
+    reported via the aux losses dict — load-balance loss (Switch eq. 4) and
+    router z-loss keep the router honest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    d_model: int = 512
+    d_ff: int = 2048
+
+
+def init_moe(key: jax.Array, config: MoEConfig) -> dict:
+    kr, k1, k2 = jax.random.split(key, 3)
+    E, d, f = config.num_experts, config.d_model, config.d_ff
+    s = d ** -0.5
+    return {
+        "router": jax.random.normal(kr, (d, E), jnp.float32) * 0.02,
+        "wi": (jax.random.normal(k1, (E, d, f), jnp.float32) * s).astype(jnp.bfloat16),
+        "wo": (jax.random.normal(k2, (E, f, d), jnp.float32) * (f ** -0.5)).astype(jnp.bfloat16),
+    }
+
+
+def moe_ffn(
+    params: dict,
+    x: jax.Array,  # [B, S, d_model]
+    config: MoEConfig,
+    shard: bool = True,
+) -> tuple[jax.Array, dict]:
+    """Returns (output [B,S,d], aux {load_balance_loss, router_z_loss, fraction_dropped})."""
+    b, s, d = x.shape
+    E, k = config.num_experts, config.top_k
+    T = b * s
+    cap = max(1, int(config.capacity_factor * T * k / E))
+    xt = x.reshape(T, d)
+
+    # ---- routing (f32: router logits are precision-sensitive)
+    logits = xt.astype(jnp.float32) @ params["router"]          # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-k dispatch: iterate k choices, masking previous picks
+    combine = jnp.zeros((T, E, cap), jnp.float32)
+    dispatch = jnp.zeros((T, E, cap), bool)
+    fills = jnp.zeros((E,), jnp.int32)
+    masked = probs
+    for _ in range(k):
+        choice = masked.argmax(axis=-1)                          # [T]
+        gate = jnp.take_along_axis(masked, choice[:, None], axis=1)[:, 0]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)      # [T, E]
+        pos = fills[None, :] + jnp.cumsum(onehot, axis=0) - onehot  # position within expert
+        keep = (pos < cap) & (onehot > 0)
+        posc = jnp.clip(pos, 0, cap - 1)
+        oh_cap = jax.nn.one_hot(posc, cap, dtype=jnp.float32) * keep[..., None]  # [T,E,cap]
+        combine = combine + oh_cap * gate[:, None, None]
+        dispatch = dispatch | (oh_cap > 0)
+        fills = fills + jnp.sum(onehot * keep, axis=0)
+        masked = masked * (1.0 - onehot)
+
+    # ---- dispatch -> expert compute -> combine (dense einsums)
+    disp_f = dispatch.astype(x.dtype)
+    expert_in = jnp.einsum("tec,td->ecd", disp_f, xt)            # [E, cap, d]
+    if shard:
+        expert_in = jax.lax.with_sharding_constraint(expert_in, P("expert", None, None))
+    h = jnp.einsum("ecd,edf->ecf", expert_in, params["wi"])
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["wo"])     # [E, cap, d]
+    if shard:
+        expert_out = jax.lax.with_sharding_constraint(expert_out, P("expert", None, None))
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+
+    # ---- aux losses
+    # Switch load-balance: E * sum_e fraction_tokens_e * mean_router_prob_e
+    top1 = jax.nn.one_hot(probs.argmax(axis=-1), E, dtype=jnp.float32)
+    load_balance = E * jnp.sum(top1.mean(axis=0) * probs.mean(axis=0))
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    routed = jnp.sum(combine > 0, axis=(1, 2))                   # assignments kept per token
+    dropped = 1.0 - jnp.sum(routed) / (T * k)
+
+    return out.reshape(b, s, d), {
+        "load_balance_loss": load_balance,
+        "router_z_loss": z_loss,
+        "fraction_dropped": dropped,
+    }

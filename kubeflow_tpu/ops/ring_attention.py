@@ -1,0 +1,94 @@
+"""Ring attention: context parallelism over the ICI ring (``seq`` mesh axis).
+
+Role (SURVEY.md §2c "CP / context parallel", §5 long-context): the reference
+platform has NO sequence scaling — this is the TPU-native capability add.
+Each device owns one sequence block of Q/K/V; K/V blocks rotate around the
+ring via ``ppermute`` (one ICI hop per step, bandwidth-optimal), and each device
+folds each visiting block into a numerically-stable online-softmax
+accumulator (blockwise attention).  Peak memory per device stays
+O(S/n · S/n) — sequence length scales linearly with ring size.
+
+The op is plain differentiable JAX (``lax.scan`` + ``ppermute``): autodiff
+derives the reverse ring pass, so it composes with jit/grad/fsdp unchanged.
+Causal masking is block-level: a visiting block strictly in the future is
+skipped entirely; the diagonal block gets the intra-block triangle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e9
+
+
+def _ring_block(q, k, v, my_idx, src_idx, block_len, causal, scale):
+    """One online-softmax update: q attends to the visiting (k, v) block."""
+    # q,k,v: [B, s, H, D]; returns the partial (logits-exp, weighted-V) stats
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        s = q.shape[1]
+        q_pos = my_idx * block_len + jnp.arange(s)
+        k_pos = src_idx * block_len + jnp.arange(s)
+        logits = jnp.where(q_pos[:, None] >= k_pos[None, :], logits, NEG_INF)
+    return logits
+
+
+def _ring_attention_sharded(q, k, v, *, axis_name, causal):
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    scale = d ** -0.5
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, r):
+        kv, m, l, acc = carry
+        k_r, v_r = kv
+        src = (my_idx - r) % n
+        logits = _ring_block(q, k_r, v_r, my_idx, src, s, causal, scale)  # [B,H,s,t]
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m - m_new)                                         # [B,H,s]
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhst,bthd->bshd", p, v_r.astype(jnp.float32))
+        acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
+        kv_next = jax.lax.ppermute((k_r, v_r), axis_name, perm)
+        return (kv_next, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    acc0 = jnp.zeros((b, s, h, d), jnp.float32)
+    (kv, m, l, acc), _ = jax.lax.scan(step, ((k, v), m0, l0, acc0), jnp.arange(n))
+    l = jnp.maximum(l, 1e-30)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, H, D] — S sharded over `axis` in the caller's mesh
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    axis: str = "seq",
+    qkv_spec: Optional[P] = None,
+) -> jax.Array:
+    """Context-parallel attention; call under jit with S-sharded operands.
+
+    ``qkv_spec`` defaults to ``P(None, axis, None, None)`` (batch replicated
+    over the ring); give the full spec if batch/heads ride other axes too.
+    """
+    spec = qkv_spec if qkv_spec is not None else P(None, axis, None, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_sharded, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,65 @@
+"""Ulysses sequence parallelism: head all-to-all over the ``seq`` mesh axis.
+
+Role (SURVEY.md §2c "Ulysses" row): the short-context alternative to ring CP.
+Operands arrive sequence-sharded ([B, S/n, H, D] per device); one all-to-all
+re-shards them head-wise ([B, S, H/n, D]) so every device runs *full-length*
+attention on its head subset, then a second all-to-all restores sequence
+sharding.  Two collectives total (vs. n-1 ppermute steps for ring) — cheaper
+while S/n blocks still fit in memory; ring wins when they don't.
+
+Requires heads % ring-size == 0.  Differentiable end-to-end (all_to_all has
+a transpose rule), so grads flow without custom VJPs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import multihead_attention
+
+
+def _ulysses_sharded(q, k, v, *, axis_name, causal, inner):
+    # [B, S/n, H, D] --all_to_all--> [B, S, H/n, D]
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    out = inner(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, S, H, D] — S sharded over `axis`
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    axis: str = "seq",
+    qkv_spec: Optional[P] = None,
+    inner: Optional[Callable] = None,
+) -> jax.Array:
+    """Head-scattered full attention; ``inner`` defaults to dense MHA and can
+    be the flash kernel (ops.flash_attention) on TPU."""
+    n = mesh.shape[axis]
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"heads ({h}) must divide the {axis!r} axis size ({n}) for Ulysses")
+    if inner is None:
+        def inner(q_, k_, v_, c):
+            return multihead_attention(q_, k_, v_, causal=c)
+    spec = qkv_spec if qkv_spec is not None else P(None, axis, None, None)
+    fn = shard_map(
+        functools.partial(_ulysses_sharded, axis_name=axis, causal=causal, inner=inner),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,1 +1,5 @@
-"""TPU-native Kubeflow-capability platform."""
+"""Parallelism layer (SURVEY.md §2c): mesh, shardings, distributed init,
+named presets (DP/FSDP/TP/SP/CP/EP) consumed by JAXJob workloads."""
+
+from .mesh import MeshConfig, build_mesh  # noqa: F401
+from .presets import Preset, get_preset, preset_from_env  # noqa: F401

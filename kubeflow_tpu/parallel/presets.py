@@ -1,0 +1,81 @@
+"""Named parallelism presets: the JAXJob-facing surface of §2c.
+
+A preset maps a strategy name (what a TPUJob/JAXJob spec or the
+``TPU_PARALLELISM_PRESET`` env var carries) to a concrete MeshConfig + the
+attention implementation that rides it.  This is how the platform exposes
+DP/FSDP/TP/SP/CP/EP without the workload hand-rolling mesh math — the
+reference has no equivalent (parallelism is user-code there, SURVEY.md §2c).
+
+    preset = get_preset("ring-cp", n_devices=16)
+    mesh = build_mesh(preset.mesh, jax.devices())
+    out = preset.attention(q, k, v, mesh, causal=True)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Optional
+
+from ..ops.attention import multihead_attention
+from ..ops.flash_attention import flash_attention
+from ..ops.ring_attention import ring_attention
+from ..ops.ulysses import ulysses_attention
+from .mesh import MeshConfig
+
+ENV_PRESET = "TPU_PARALLELISM_PRESET"
+
+
+@dataclasses.dataclass(frozen=True)
+class Preset:
+    name: str
+    mesh: MeshConfig
+    #: attention(q, k, v, mesh, causal=...) for sharded presets;
+    #: attention(q, k, v, causal=...) for single-axis presets (mesh unused)
+    attention: Callable
+    description: str = ""
+
+
+def _dense(q, k, v, mesh=None, causal=True):
+    return multihead_attention(q, k, v, causal=causal)
+
+
+def _flash(q, k, v, mesh=None, causal=True):
+    return flash_attention(q, k, v, causal=causal)
+
+
+def get_preset(name: str, n_devices: int, tensor: int = 1) -> Preset:
+    """Resolve a strategy name to a preset sized for n_devices."""
+    if name in ("dp", "data"):
+        return Preset(name, MeshConfig(data=n_devices, fsdp=1), _flash,
+                      "pure data parallel (gradients psum over `data`)")
+    if name == "fsdp":
+        return Preset(name, MeshConfig(fsdp=n_devices), _flash,
+                      "ZeRO-3-style sharded data parallel over ICI")
+    if name in ("tp", "tensor"):
+        return Preset(name, MeshConfig(fsdp=n_devices // max(tensor, 2), tensor=max(tensor, 2)),
+                      _flash, "Megatron-style tensor parallel innermost, fsdp outer")
+    if name in ("ring-cp", "ring", "cp"):
+        return Preset(
+            name, MeshConfig(fsdp=1, seq=n_devices),
+            lambda q, k, v, mesh, causal=True: ring_attention(q, k, v, mesh, causal=causal),
+            "ring attention: KV rotates the ICI ring; S scales with devices",
+        )
+    if name in ("ulysses", "sp"):
+        return Preset(
+            name, MeshConfig(fsdp=1, seq=n_devices),
+            lambda q, k, v, mesh, causal=True: ulysses_attention(q, k, v, mesh, causal=causal),
+            "Ulysses: head all-to-all, full-length attention per device",
+        )
+    if name in ("moe-ep", "ep", "expert"):
+        return Preset(name, MeshConfig(fsdp=1, expert=n_devices), _flash,
+                      "expert parallel: MoE FFN dispatched over `expert`")
+    raise ValueError(
+        f"unknown parallelism preset {name!r}; "
+        "available: dp, fsdp, tp, ring-cp, ulysses, moe-ep"
+    )
+
+
+def preset_from_env(n_devices: int, default: str = "fsdp") -> Preset:
+    """What a JAXJob worker calls: the controller sets TPU_PARALLELISM_PRESET."""
+    return get_preset(os.environ.get(ENV_PRESET, default), n_devices)

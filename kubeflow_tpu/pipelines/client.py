@@ -21,10 +21,15 @@ from .workflow import WorkflowController
 
 
 def install(api, manager, workdir: str, metadata_path: Optional[str] = None):
-    """Wire the pipelines control plane into a Manager.
+    """Wire the pipelines control plane into a Manager (idempotent per api).
 
-    Returns the PipelineService (the user-facing API).
+    Returns the PipelineService (the user-facing API).  A second install on
+    the same apiserver returns the existing service — a second MetadataStore
+    on the same WAL would corrupt it (single-writer format).
     """
+    existing = getattr(api, "_kfp_service", None)
+    if existing is not None:
+        return existing
     papi.register(api)
     store = ObjectStore(os.path.join(workdir, "objects"))
     metadata = MetadataStore(metadata_path or os.path.join(workdir, "metadata.wal"))
@@ -33,6 +38,7 @@ def install(api, manager, workdir: str, metadata_path: Optional[str] = None):
     manager.add(ScheduledWorkflowController(api), owns=("Workflow",))
     service = PipelineService(api, metadata, store)
     manager.add_ticker(service.sync_runs)
+    api._kfp_service = service
     return service
 
 

@@ -43,8 +43,8 @@ def parse(expr: str) -> list[set[int]]:
     return [_parse_field(f, lo, hi) for f, (lo, hi) in zip(fields, _RANGES)]
 
 
-def matches(expr: str, ts: float) -> bool:
-    minute, hour, dom, month, dow = parse(expr)
+def _matches_fields(fields: list[set[int]], ts: float) -> bool:
+    minute, hour, dom, month, dow = fields
     t = time.localtime(ts)
     return (
         t.tm_min in minute
@@ -55,6 +55,10 @@ def matches(expr: str, ts: float) -> bool:
     )
 
 
+def matches(expr: str, ts: float) -> bool:
+    return _matches_fields(parse(expr), ts)
+
+
 def _to_cron_dow(dow: set[int]) -> set[int]:
     # struct_time: Monday=0..Sunday=6; cron: Sunday=0..Saturday=6
     return {(d - 1) % 7 for d in dow}
@@ -62,11 +66,11 @@ def _to_cron_dow(dow: set[int]) -> set[int]:
 
 def next_fire(expr: str, after: float, horizon_days: int = 366) -> Optional[float]:
     """Next minute-aligned timestamp strictly after `after` matching the expr."""
-    parse(expr)  # validate upfront
+    fields = parse(expr)  # parse once; the probe loop is minute-by-minute
     t = int(after // 60 + 1) * 60
     end = after + horizon_days * 86400
     while t <= end:
-        if matches(expr, t):
+        if _matches_fields(fields, t):
             return float(t)
         t += 60
     return None

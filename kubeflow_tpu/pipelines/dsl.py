@@ -389,9 +389,26 @@ class Task:
         """Request a TPU slice for this step, e.g. ``set_tpu("v5e-8")``.
 
         The compiled node asks the topology scheduler for a ``google.com/tpu``
-        gang placement; chips defaults to the slice size encoded in the name.
+        placement; chips defaults to the count encoded in the name (the
+        ``-N`` suffix, or an ``AxB`` topology tail).  Validated here so a bad
+        accelerator string fails at pipeline-definition time, not inside the
+        workflow controller.
         """
-        self.tpu = {"accelerator": accelerator, "chips": chips}
+        if not chips:
+            tail = accelerator.rsplit("-", 1)[-1]
+            try:
+                if "x" in tail:
+                    chips = 1
+                    for part in tail.split("x"):
+                        chips *= int(part)
+                else:
+                    chips = int(tail)
+            except ValueError:
+                raise ValueError(
+                    f"set_tpu: cannot infer chip count from {accelerator!r}; "
+                    "use e.g. 'v5e-8' / 'v5e-2x4' or pass chips= explicitly"
+                ) from None
+        self.tpu = {"accelerator": accelerator, "chips": int(chips)}
         return self
 
     def set_caching_options(self, enable: bool) -> "Task":

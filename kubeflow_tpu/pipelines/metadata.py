@@ -60,7 +60,12 @@ def _load() -> ctypes.CDLL:
                 getattr(lib, fn).argtypes = [p, i64]
             lib.mds_context_id_by_name.restype = i64
             lib.mds_context_id_by_name.argtypes = [p, c, c]
-            for fn in ("mds_artifacts_by_type", "mds_executions_by_type", "mds_executions_by_fingerprint"):
+            for fn in (
+                "mds_artifacts_by_type",
+                "mds_executions_by_type",
+                "mds_executions_by_fingerprint",
+                "mds_contexts_by_type",
+            ):
                 getattr(lib, fn).restype = i64
                 getattr(lib, fn).argtypes = [p, c]
             for fn in (
@@ -257,6 +262,9 @@ class MetadataStore:
 
     def executions_by_type(self, type: str) -> list[ExecutionRecord]:
         return [self.get_execution(i) for i in self._id_query("mds_executions_by_type", type.encode())]
+
+    def contexts_by_type(self, type: str) -> list[ContextRecord]:
+        return [self.get_context(i) for i in self._id_query("mds_contexts_by_type", type.encode())]
 
     def executions_by_fingerprint(self, fingerprint: str) -> list[ExecutionRecord]:
         return [

@@ -90,6 +90,7 @@ struct Store {
   std::unordered_map<std::string, std::vector<int64_t>> artifacts_by_type;
   std::unordered_map<std::string, std::vector<int64_t>> executions_by_type;
   std::unordered_map<std::string, std::vector<int64_t>> executions_by_fp;
+  std::unordered_map<std::string, std::vector<int64_t>> contexts_by_type;
   std::unordered_map<std::string, int64_t> context_by_key;  // type + '\0' + name
   std::unordered_map<int64_t, std::vector<int64_t>> events_by_execution;  // -> event idx
   std::unordered_map<int64_t, std::vector<int64_t>> events_by_artifact;
@@ -200,6 +201,7 @@ void apply(Store* st, uint8_t op, const std::string& payload) {
       Context c;
       c.id = r.i64(); r.u32(); c.type = r.lp(); c.name = r.lp(); c.props = r.lp();
       if (!r.ok) return;
+      if (!st->contexts.count(c.id)) st->contexts_by_type[c.type].push_back(c.id);
       st->context_by_key[c.type + '\0' + c.name] = c.id;
       if (c.id >= st->next_id) st->next_id = c.id + 1;
       st->contexts[c.id] = std::move(c);
@@ -426,6 +428,14 @@ int64_t mds_executions_by_type(void* h, const char* type) {
   std::lock_guard<std::mutex> lk(st->mu);
   auto it = st->executions_by_type.find(cstr(type));
   list_ids(st, it == st->executions_by_type.end() ? nullptr : &it->second);
+  return static_cast<int64_t>(st->scratch.size());
+}
+
+int64_t mds_contexts_by_type(void* h, const char* type) {
+  auto* st = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(st->mu);
+  auto it = st->contexts_by_type.find(cstr(type));
+  list_ids(st, it == st->contexts_by_type.end() ? nullptr : &it->second);
   return static_cast<int64_t>(st->scratch.size());
 }
 

@@ -51,7 +51,6 @@ class PipelineService:
             pname,
             {"description": description or ir["pipelineInfo"].get("description", ""), "versions": versions},
         )
-        self._register_name(PIPELINE_CTX, pname)
         return pname
 
     def get_pipeline(self, name: str, version: Optional[int] = None) -> dict:
@@ -59,36 +58,26 @@ class PipelineService:
         if ctx is None:
             raise KeyError(f"pipeline {name!r} not found")
         versions = ctx.properties["versions"]
-        v = versions[-1] if version is None else versions[version - 1]
+        if version is None:
+            v = versions[-1]
+        else:
+            if not 1 <= version <= len(versions):
+                raise KeyError(
+                    f"pipeline {name!r} has versions 1..{len(versions)}, not {version}"
+                )
+            v = versions[version - 1]
         return json.loads(self.store.get_bytes(v["uri"]).decode())
 
     def list_pipelines(self) -> list[str]:
         return sorted(c.name for c in self._contexts(PIPELINE_CTX))
 
     def _contexts(self, ctx_type: str) -> list:
-        # context ids are discoverable via the (type,name) index only through
-        # names we know; keep a registry context listing all names.
-        reg = self.metadata.get_context_by_name(ctx_type, "__registry__")
-        names = reg.properties.get("names", []) if reg else []
-        out = []
-        for n in names:
-            c = self.metadata.get_context_by_name(ctx_type, n)
-            if c is not None:
-                out.append(c)
-        return out
-
-    def _register_name(self, ctx_type: str, name: str) -> None:
-        reg = self.metadata.get_context_by_name(ctx_type, "__registry__")
-        names = reg.properties.get("names", []) if reg else []
-        if name not in names:
-            names.append(name)
-            self.metadata.put_context(ctx_type, "__registry__", {"names": names})
+        return self.metadata.contexts_by_type(ctx_type)
 
     # ------------------------------------------------------------ experiments
 
     def create_experiment(self, name: str, description: str = "") -> str:
         self.metadata.put_context(EXPERIMENT_CTX, name, {"description": description, "createdAt": time.time()})
-        self._register_name(EXPERIMENT_CTX, name)
         return name
 
     def list_experiments(self) -> list[str]:
@@ -125,7 +114,6 @@ class PipelineService:
                 "phase": papi.PENDING,
             },
         )
-        self._register_name(RUN_CTX, run_id)
         return run_id
 
     def get_run(self, run_id: str) -> dict:

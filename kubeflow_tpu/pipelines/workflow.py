@@ -31,7 +31,7 @@ from typing import Any, Optional
 from ..core.api import APIServer, AlreadyExists, Obj, owner_reference
 from ..core.events import EventRecorder
 from ..core.controller import Request, Result
-from ..scheduler.topology import TPU_RESOURCE, chips_in
+from ..scheduler.topology import TPU_RESOURCE
 from . import api as papi
 from .artifacts import ObjectStore
 from . import metadata as md
@@ -274,11 +274,8 @@ class WorkflowController:
         resources: dict = dict(tspec.get("resources", {}))
         tpu = tspec.get("tpu")
         if tpu:
-            # accelerator is "v5e-4" (chip count) or a topology like "2x2"
-            acc = tpu["accelerator"]
-            tail = acc.rsplit("-", 1)[-1]
-            chips = tpu.get("chips") or (chips_in(tail) if "x" in tail else int(tail))
-            resources[TPU_RESOURCE] = chips
+            # chips resolved and validated at DSL time (Task.set_tpu)
+            resources[TPU_RESOURCE] = int(tpu["chips"])
         container = {
             "name": "main",
             "command": [sys.executable, "-m", "kubeflow_tpu.pipelines.launcher_main", workspace],
@@ -315,13 +312,13 @@ class WorkflowController:
                 return self._fail(wf, tname, tspec, node, "pod succeeded but wrote no outputs.json")
             with open(outputs_path) as f:
                 outs = json.load(f)
-            return self._complete(wf, tname, node, outs)
+            return self._complete(wf, tname, tspec, node, outs)
         if phase == "Failed":
             msg = pod.get("status", {}).get("message", "container exited nonzero")
             return self._fail(wf, tname, tspec, node, msg)
         return False
 
-    def _complete(self, wf: Obj, tname: str, node: dict, outs: dict) -> bool:
+    def _complete(self, wf: Obj, tname: str, tspec: dict, node: dict, outs: dict) -> bool:
         ctx_id = wf["status"]["contextId"]
         artifacts: dict = {}
         for aname, spec in node["stagedOutputArtifacts"].items():
@@ -331,7 +328,7 @@ class WorkflowController:
             artifacts[aname] = {"id": aid, "uri": spec["uri"], "type": spec["type"], "metadata": meta}
         out_params = outs.get("outputParameters", {})
         exec_id = self.metadata.put_execution(
-            f"component:{tname.split('-it')[0]}",
+            f"component:{tspec['componentRef'].removeprefix('comp-')}",
             md.COMPLETE,
             fingerprint=node["fingerprint"],
             properties={

@@ -87,7 +87,7 @@ int32_t eng_submit(Engine* e, int64_t req_id, int32_t prompt_len,
   // Admission is head-of-line: a request that exceeds either the per-slot cap
   // OR the whole page pool would block the queue forever — reject it here.
   if (pages_needed(e, prompt_len + max_new_tokens) > e->max_pages_per_slot ||
-      pages_needed(e, prompt_len) > e->num_pages)
+      pages_needed(e, prompt_len) >= e->num_pages)  // page 0 is reserved
     return -1;
   e->queue.push_back({req_id, prompt_len, max_new_tokens});
   return 0;

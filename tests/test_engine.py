@@ -58,6 +58,7 @@ def test_native_batcher_rejects_pool_unfittable_prompt():
     # pages: queueing it would block head-of-line admission forever
     b = NativeBatcher(max_slots=2, num_pages=32, page_size=8, max_pages_per_slot=64)
     assert not b.submit(1, 300, 4)   # 38 pages > 32-page pool
+    assert not b.submit(3, 256, 4)   # exactly 32 pages: page 0 reserved, still unfittable
     assert b.submit(2, 100, 4)       # 13 pages: fits the pool
     b.close()
 

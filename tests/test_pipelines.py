@@ -113,7 +113,7 @@ def test_compiler_loop_expansion_and_conditions():
     dag = ir["root"]["dag"]["tasks"]
     assert set(dag) == {"make-data", "train", "deploy"}
     assert dag["deploy"]["conditions"][0]["op"] == ">"
-    assert dag["deploy"]["tpu"] == {"accelerator": "v5e-4", "chips": 0}
+    assert dag["deploy"]["tpu"] == {"accelerator": "v5e-4", "chips": 4}
     ir2 = Compiler().compile(lr_sweep)
     dag2 = ir2["root"]["dag"]["tasks"]
     assert set(dag2) == {"make-data", "train-it0", "train-it1"}
