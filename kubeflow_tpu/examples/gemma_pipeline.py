@@ -1,0 +1,119 @@
+"""Gemma fine-tune → eval → deploy pipeline (BASELINE.json config[4]).
+
+The Pipelines benchmark workload: a three-step KFP DAG where
+  1. ``finetune`` trains a Gemma-family decoder (models/decoder.py) and
+     emits the weights as a Model artifact (npz + config.json — exactly the
+     layout the serving engine loads),
+  2. ``evaluate`` computes held-out perplexity and gates deployment,
+  3. ``deploy`` packages the model dir for the InferenceService path.
+
+Sizes come from pipeline arguments so the SAME pipeline runs CI-tiny (the
+test) and gemma-7b (real hardware): pass d_model/n_layers/etc. matching
+``models.decoder.gemma_7b()``.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.pipelines import dsl
+
+
+@dsl.component
+def finetune(
+    vocab_size: int, d_model: int, n_layers: int, n_heads: int, n_kv_heads: int,
+    d_ff: int, steps: int, batch_size: int, seq_len: int,
+    model: dsl.Output[dsl.Model], metrics: dsl.Output[dsl.Metrics],
+) -> float:
+    import json
+    import os
+
+    import jax
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models import decoder
+    from kubeflow_tpu.serving.engine.model import DecoderConfig
+
+    config = DecoderConfig(vocab_size=vocab_size, d_model=d_model, n_layers=n_layers,
+                           n_heads=n_heads, n_kv_heads=n_kv_heads, d_ff=d_ff)
+    params = decoder.init(jax.random.PRNGKey(0), config)
+    opt = optax.adamw(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(decoder.lm_loss)(params, config, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    batches = decoder.synthetic_lm_batches(vocab_size, batch_size, seq_len)
+    first = last = None
+    for _ in range(steps):
+        b = next(batches)
+        params, opt_state, loss = step(params, opt_state, b["tokens"])
+        last = float(loss)
+        first = first if first is not None else last
+
+    os.makedirs(model.path, exist_ok=True)
+    # npz has no bfloat16: persist f32, serving/eval casts back on load
+    np.savez(os.path.join(model.path, "params.npz"),
+             **{k: np.asarray(v, dtype=np.float32) for k, v in params.items()})
+    with open(os.path.join(model.path, "config.json"), "w") as f:
+        json.dump({"vocab_size": vocab_size, "d_model": d_model, "n_layers": n_layers,
+                   "n_heads": n_heads, "n_kv_heads": n_kv_heads, "d_ff": d_ff}, f)
+    metrics.log_metric("first_loss", first)
+    metrics.log_metric("final_loss", last)
+    model.metadata["family"] = "gemma"
+    return last
+
+
+@dsl.component
+def evaluate(model: dsl.Input[dsl.Model], batch_size: int, seq_len: int,
+             metrics: dsl.Output[dsl.Metrics]) -> float:
+    import json
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models import decoder
+    from kubeflow_tpu.serving.engine.model import DecoderConfig
+
+    with open(os.path.join(model.path, "config.json")) as f:
+        config = DecoderConfig(**json.load(f))
+    raw = np.load(os.path.join(model.path, "params.npz"))
+    params = {k: jnp.asarray(raw[k], dtype=jnp.bfloat16) for k in raw.files}
+    batch = next(decoder.synthetic_lm_batches(config.vocab_size, batch_size, seq_len, seed=99))
+    loss = float(decoder.lm_loss(params, config, batch["tokens"]))
+    ppl = float(jnp.exp(jnp.minimum(loss, 20.0)))
+    metrics.log_metric("eval_loss", loss)
+    metrics.log_metric("perplexity", ppl)
+    return ppl
+
+
+@dsl.component
+def deploy(model: dsl.Input[dsl.Model], service_name: str = "gemma") -> str:
+    """Package the model dir for serving (the InferenceService storageUri)."""
+    import os
+
+    assert os.path.exists(os.path.join(model.path, "params.npz"))
+    assert os.path.exists(os.path.join(model.path, "config.json"))
+    # the artifact uri IS the deployable storage location (mstore://...)
+    return model.uri
+
+
+@dsl.pipeline(name="gemma-finetune-eval-deploy",
+              description="BASELINE config[4]: fine-tune -> eval -> gated deploy")
+def gemma_pipeline(
+    vocab_size: int = 512, d_model: int = 64, n_layers: int = 2, n_heads: int = 4,
+    n_kv_heads: int = 2, d_ff: int = 128, steps: int = 30, batch_size: int = 8,
+    seq_len: int = 32, max_perplexity: float = 1000.0,
+):
+    ft = finetune(
+        vocab_size=vocab_size, d_model=d_model, n_layers=n_layers, n_heads=n_heads,
+        n_kv_heads=n_kv_heads, d_ff=d_ff, steps=steps, batch_size=batch_size,
+        seq_len=seq_len,
+    )
+    ev = evaluate(model=ft.outputs["model"], batch_size=batch_size, seq_len=seq_len)
+    with dsl.Condition(ev.output < max_perplexity):
+        deploy(model=ft.outputs["model"])

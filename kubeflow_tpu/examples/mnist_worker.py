@@ -1,0 +1,55 @@
+"""TFJob MNIST CNN worker (BASELINE.json config[0]).
+
+Runs as the pod command of a TFJob/TPUJob replica: joins the process group
+from the injected env, trains the CNN on synthetic MNIST, prints Katib-style
+``key=value`` metrics to stdout (the stdout metrics collector's format).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def main() -> None:
+    from kubeflow_tpu.parallel.distributed import initialize
+
+    penv = initialize(local_device_count=int(os.environ.get("LOCAL_DEVICES", "1")))
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.models import mnist
+
+    steps = int(os.environ.get("TRAIN_STEPS", "60"))
+    batch = int(os.environ.get("BATCH_SIZE", "64"))
+    lr = float(os.environ.get("LEARNING_RATE", "1e-3"))
+
+    config = mnist.MnistConfig()
+    params = mnist.init(jax.random.PRNGKey(0), config)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch_):
+        loss, grads = jax.value_and_grad(mnist.loss)(params, config, batch_["images"], batch_["labels"])
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    t0 = time.perf_counter()
+    loss = None
+    for i in range(steps):
+        b = mnist.synthetic_batch(jax.random.PRNGKey(i + 1), batch)
+        params, opt_state, loss = step(params, opt_state, b)
+    loss = float(loss)
+    dt = time.perf_counter() - t0
+    acc = float(mnist.accuracy(params, config, **mnist.synthetic_batch(jax.random.PRNGKey(0), 256)))
+    print(f"loss={loss:.4f}")
+    print(f"accuracy={acc:.4f}")
+    print(f"samples_per_sec={steps * batch / dt:.1f}")
+    print("MNIST-OK")
+
+
+if __name__ == "__main__":
+    main()

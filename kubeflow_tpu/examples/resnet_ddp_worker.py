@@ -1,0 +1,93 @@
+"""PyTorchJob-parity ResNet-50 DDP worker (BASELINE.json config[1]).
+
+Runs as a PyTorchJob replica: maps the operator-injected ``MASTER_ADDR`` /
+``WORLD_SIZE`` / ``RANK`` rendezvous env (the reference's NCCL bootstrap
+surface) onto ``jax.distributed``, then runs data-parallel ResNet-50 — the
+gradient all-reduce the reference gets from NCCL comes from one ``psum``
+compiled over ICI.  Prints samples/sec/chip, the primary BASELINE metric.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def _map_torch_env() -> None:
+    """MASTER_ADDR/RANK/WORLD_SIZE → the JAX coordinator env (torch compat)."""
+    env = os.environ
+    if "MASTER_ADDR" in env and "JAX_COORDINATOR_ADDRESS" not in env:
+        env["JAX_COORDINATOR_ADDRESS"] = f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', '29500')}"
+        env["JAX_NUM_PROCESSES"] = env.get("WORLD_SIZE", "1")
+        env["JAX_PROCESS_ID"] = env.get("RANK", "0")
+
+
+def main() -> None:
+    _map_torch_env()
+    from kubeflow_tpu.parallel.distributed import initialize
+
+    penv = initialize(local_device_count=int(os.environ.get("LOCAL_DEVICES", "1")))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeflow_tpu.models import resnet
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    steps = int(os.environ.get("TRAIN_STEPS", "3"))
+    per_chip_batch = int(os.environ.get("PER_CHIP_BATCH", "8"))
+    image_size = int(os.environ.get("IMAGE_SIZE", "64"))
+
+    devices = jax.devices()  # GLOBAL device list across all processes
+    mesh = build_mesh(MeshConfig(data=len(devices), fsdp=1), devices)
+    repl = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P("data"))
+
+    config = resnet.ResNetConfig(num_classes=100)
+    params = jax.device_put(resnet.init(jax.random.PRNGKey(0), config), repl)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = jax.device_put(opt.init(params), repl)
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(resnet.loss)(params, config, images, labels)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    global_batch = per_chip_batch * len(devices)
+
+    local = global_batch // penv.num_processes
+    lo = penv.process_id * local
+
+    def make_batch(seed):
+        # deterministic global batch; each process materializes its own slice
+        np.random.seed(seed)
+        imgs = np.random.randn(global_batch, image_size, image_size, 3).astype(np.float32)
+        lbls = np.random.randint(0, 100, (global_batch,))
+        return (
+            jax.make_array_from_process_local_data(data_sh, imgs[lo:lo + local], imgs.shape),
+            jax.make_array_from_process_local_data(data_sh, lbls[lo:lo + local], lbls.shape),
+        )
+
+    imgs, lbls = make_batch(0)
+    params, opt_state, loss = step(params, opt_state, imgs, lbls)  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        imgs, lbls = make_batch(i + 1)
+        params, opt_state, loss = step(params, opt_state, imgs, lbls)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    sps = steps * global_batch / dt
+    print(f"loss={float(loss):.4f}")
+    print(f"samples_per_sec={sps:.1f}")
+    print(f"samples_per_sec_per_chip={sps / len(devices):.1f}")
+    print(f"world size={penv.num_processes} global devices={len(devices)}")
+    print("RESNET-DDP-OK")
+
+
+if __name__ == "__main__":
+    main()

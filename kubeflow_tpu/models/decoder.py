@@ -1,0 +1,71 @@
+"""Trainable decoder LM family: Llama-3 / Gemma-class (BASELINE configs 3,4).
+
+The architecture (GQA + RoPE + RMSNorm + SwiGLU) is shared with the serving
+engine (serving/engine/model.py owns the paged-decode path; this module owns
+training): importing the same init/forward keeps the fine-tune→deploy
+pipeline honest — the weights trained here serve there unchanged.
+
+``gemma_7b``-class configs map onto the same block family (Gemma's GeGLU ≈
+SwiGLU at this granularity; head/ff dims differ per config) — what the
+Pipelines Gemma benchmark (BASELINE.json config[4]) fine-tunes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ..serving.engine.model import DecoderConfig, forward_full, init  # noqa: F401
+
+# params are layer-stacked ([n_layers, ...] leading dim, engine init layout)
+SHARDING_RULES = (
+    (r"^embed$", P("tensor", "fsdp")),
+    (r"^w[qkv]$", P(None, "fsdp", "tensor")),
+    (r"^wo$", P(None, "tensor", "fsdp")),
+    (r"^w[13]$", P(None, "fsdp", "tensor")),
+    (r"^w2$", P(None, "tensor", "fsdp")),
+    (r"^unembed$", P("fsdp", "tensor")),
+    (r".*", P()),
+)
+
+
+def gemma_7b() -> DecoderConfig:
+    return DecoderConfig(
+        vocab_size=256128, d_model=3072, n_layers=28, n_heads=16,
+        n_kv_heads=16, d_ff=24576, rope_theta=10000.0,
+    )
+
+
+def tiny(vocab_size: int = 512) -> DecoderConfig:
+    """Test/CI-scale config (same family, minutes-not-hours)."""
+    return DecoderConfig(vocab_size=vocab_size, d_model=64, n_layers=2,
+                         n_heads=4, n_kv_heads=2, d_ff=128)
+
+
+def lm_loss(params: dict, config: DecoderConfig, tokens: jax.Array) -> jax.Array:
+    """Next-token cross-entropy over [B, S] token ids (causal shift inside)."""
+    logits = forward_full(params, config, tokens[:, :-1])       # [B, S-1, V]
+    targets = tokens[:, 1:]
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    ).mean()
+
+
+def train_flops(config: DecoderConfig, batch: int, seq_len: int) -> float:
+    """6·N·D matmul FLOPs (fwd+bwd) + attention term, for MFU accounting."""
+    n = config.param_count() - config.vocab_size * config.d_model  # embed lookup is free
+    attn = config.n_layers * 2 * seq_len * config.d_model  # per token QK^T+PV
+    return 6 * batch * seq_len * (n + attn / 2)
+
+
+def synthetic_lm_batches(vocab_size: int, batch_size: int, seq_len: int, seed: int = 0):
+    """Markov-ish synthetic token stream (learnable: next ≈ f(current))."""
+    key = jax.random.PRNGKey(seed)
+    while True:
+        key, k1, k2 = jax.random.split(key, 3)
+        start = jax.random.randint(k1, (batch_size, 1), 0, vocab_size)
+        steps = jax.random.randint(k2, (batch_size, seq_len - 1), 0, 3)
+        toks = jnp.concatenate([start, jnp.cumsum(steps, axis=1) + start], axis=1) % vocab_size
+        yield {"tokens": toks.astype(jnp.int32)}

@@ -1,0 +1,91 @@
+"""MNIST CNN: the TFJob benchmark workload (BASELINE.json config[0]).
+
+The classic two-conv CNN the reference's TFJob MNIST examples train.
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bf16 weights with
+f32 loss math, static shapes throughout — the whole step jits to a handful
+of fused convolutions on the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MnistConfig:
+    num_classes: int = 10
+    conv1_features: int = 32
+    conv2_features: int = 64
+    dense_features: int = 512
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def num_params(self) -> int:
+        c1, c2, d = self.conv1_features, self.conv2_features, self.dense_features
+        return (25 * c1 + c1) + (25 * c1 * c2 + c2) + (49 * c2 * d + d) + (d * self.num_classes + self.num_classes)
+
+
+SHARDING_RULES = (
+    (r"dense_kernel", P("fsdp", "tensor")),
+    (r"out_kernel", P("tensor", None)),
+    (r".*", P()),
+)
+
+
+def init(key: jax.Array, config: MnistConfig = MnistConfig()) -> dict:
+    c1, c2, d = config.conv1_features, config.conv2_features, config.dense_features
+    k = iter(jax.random.split(key, 4))
+    he = lambda k_, shape, fan_in: (jax.random.normal(k_, shape, jnp.float32) * (2.0 / fan_in) ** 0.5).astype(config.dtype)
+    return {
+        "conv1_kernel": he(next(k), (5, 5, 1, c1), 25),
+        "conv1_bias": jnp.zeros((c1,), config.dtype),
+        "conv2_kernel": he(next(k), (5, 5, c1, c2), 25 * c1),
+        "conv2_bias": jnp.zeros((c2,), config.dtype),
+        "dense_kernel": he(next(k), (49 * c2, d), 49 * c2),
+        "dense_bias": jnp.zeros((d,), config.dtype),
+        "out_kernel": he(next(k), (d, config.num_classes), d),
+        "out_bias": jnp.zeros((config.num_classes,), config.dtype),
+    }
+
+
+def _max_pool_2x2(x):
+    return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "SAME")
+
+
+def forward(params: dict, config: MnistConfig, images: jax.Array) -> jax.Array:
+    """images [B, 28, 28, 1] → logits [B, 10]."""
+    x = images.astype(config.dtype)
+    for i in (1, 2):
+        x = jax.lax.conv_general_dilated(
+            x, params[f"conv{i}_kernel"], (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + params[f"conv{i}_bias"]
+        x = jax.nn.relu(x)
+        x = _max_pool_2x2(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense_kernel"] + params["dense_bias"])
+    return (x @ params["out_kernel"] + params["out_bias"]).astype(jnp.float32)
+
+
+def loss(params: dict, config: MnistConfig, images: jax.Array, labels: jax.Array) -> jax.Array:
+    logits = forward(params, config, images)
+    onehot = jax.nn.one_hot(labels, config.num_classes)
+    return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+
+def accuracy(params: dict, config: MnistConfig, images: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((forward(params, config, images).argmax(-1) == labels).astype(jnp.float32))
+
+
+def synthetic_batch(key: jax.Array, batch_size: int) -> dict:
+    """Deterministic class-structured fake MNIST (labels recoverable → the
+    model can actually fit it, which the loss-decreases tests rely on)."""
+    kl, kn = jax.random.split(key)
+    labels = jax.random.randint(kl, (batch_size,), 0, 10)
+    base = jax.nn.one_hot(labels, 28)[:, :, None] * jnp.ones((1, 1, 28))
+    noise = 0.3 * jax.random.normal(kn, (batch_size, 28, 28))
+    return {"images": (base + noise)[..., None], "labels": labels}

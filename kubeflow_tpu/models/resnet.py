@@ -1,0 +1,138 @@
+"""ResNet-50: the PyTorchJob DDP benchmark workload (BASELINE.json config[1]).
+
+TPU-first choices (not a torch port):
+  * NHWC + bf16 — XLA's native TPU conv layout, MXU-friendly;
+  * GroupNorm instead of BatchNorm: identical quality class for ResNet-50,
+    but stateless — no running-stats buffers to all-reduce, no train/eval
+    divergence, and the whole step stays a pure function (jit/pjit clean).
+    This is the standard JAX rewrite of torchvision's BN ResNet;
+  * data parallelism comes from the platform (mesh ``data``/``fsdp`` axes +
+    the PyTorchJob-compat operator wiring rendezvous), not from the model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+STAGES_50 = (3, 4, 6, 3)
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stages: tuple = STAGES_50
+    width: int = 64
+    num_classes: int = 1000
+    groups: int = 32  # GroupNorm groups
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def flops_per_image(self) -> float:
+        """Matmul-equivalent fwd FLOPs for 224×224 (the standard ~4.1 GFLOP)."""
+        return 4.1e9
+
+
+def count_params(params: dict) -> int:
+    return sum(int(jnp.size(x)) for x in jax.tree.leaves(params))
+
+
+SHARDING_RULES = (
+    (r"fc_kernel", P("fsdp", "tensor")),
+    (r".*conv.*", P(None, None, None, "fsdp")),
+    (r".*", P()),
+)
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * (2.0 / fan_in) ** 0.5).astype(dtype)
+
+
+def init(key: jax.Array, config: ResNetConfig = ResNetConfig()) -> dict:
+    keys = iter(jax.random.split(key, 256))
+    dt = config.dtype
+    w = config.width
+    params: dict = {
+        "stem_conv": _conv_init(next(keys), 7, 7, 3, w, dt),
+        "stem_gn": {"scale": jnp.ones((w,), dt), "bias": jnp.zeros((w,), dt)},
+        "blocks": [],
+    }
+    cin = w
+    for stage, n_blocks in enumerate(config.stages):
+        mid = w * (2 ** stage)
+        cout = mid * 4
+        for b in range(n_blocks):
+            blk = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, mid, dt),
+                "gn1": {"scale": jnp.ones((mid,), dt), "bias": jnp.zeros((mid,), dt)},
+                "conv2": _conv_init(next(keys), 3, 3, mid, mid, dt),
+                "gn2": {"scale": jnp.ones((mid,), dt), "bias": jnp.zeros((mid,), dt)},
+                "conv3": _conv_init(next(keys), 1, 1, mid, cout, dt),
+                # zero-init the last norm scale: residual branch starts as
+                # identity (the standard ResNet trick for stable large-batch)
+                "gn3": {"scale": jnp.zeros((cout,), dt), "bias": jnp.zeros((cout,), dt)},
+            }
+            if b == 0:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout, dt)
+                blk["proj_gn"] = {"scale": jnp.ones((cout,), dt), "bias": jnp.zeros((cout,), dt)}
+            params["blocks"].append(blk)
+            cin = cout
+    params["fc_kernel"] = (jax.random.normal(next(keys), (cin, config.num_classes), jnp.float32) * cin ** -0.5).astype(dt)
+    params["fc_bias"] = jnp.zeros((config.num_classes,), dt)
+    return params
+
+
+def _conv(x, kernel, stride):
+    return jax.lax.conv_general_dilated(
+        x, kernel, (stride, stride), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+def _group_norm(x, gn, groups, eps=1e-5):
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    xf = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (xf.reshape(b, h, w, c) * gn["scale"].astype(jnp.float32) + gn["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def forward(params: dict, config: ResNetConfig, images: jax.Array) -> jax.Array:
+    """images [B, H, W, 3] → logits [B, num_classes]."""
+    x = images.astype(config.dtype)
+    x = _conv(x, params["stem_conv"], 2)
+    x = jax.nn.relu(_group_norm(x, params["stem_gn"], config.groups))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    # strides are STATIC structure (from config), never params: jit traces
+    # params, and a conv stride must be a compile-time constant
+    strides = [
+        2 if (b == 0 and stage > 0) else 1
+        for stage, n_blocks in enumerate(config.stages)
+        for b in range(n_blocks)
+    ]
+    for blk, stride in zip(params["blocks"], strides):
+        residual = x
+        y = jax.nn.relu(_group_norm(_conv(x, blk["conv1"], 1), blk["gn1"], config.groups))
+        y = jax.nn.relu(_group_norm(_conv(y, blk["conv2"], stride), blk["gn2"], config.groups))
+        y = _group_norm(_conv(y, blk["conv3"], 1), blk["gn3"], config.groups)
+        if "proj" in blk:
+            residual = _group_norm(_conv(x, blk["proj"], stride), blk["proj_gn"], config.groups)
+        x = jax.nn.relu(residual + y)
+    x = x.mean(axis=(1, 2))
+    return (x @ params["fc_kernel"] + params["fc_bias"]).astype(jnp.float32)
+
+
+def loss(params: dict, config: ResNetConfig, images: jax.Array, labels: jax.Array) -> jax.Array:
+    logits = forward(params, config, images)
+    onehot = jax.nn.one_hot(labels, config.num_classes)
+    return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+
+def synthetic_batch(key: jax.Array, batch_size: int, image_size: int = 224, num_classes: int = 1000) -> dict:
+    kl, kn = jax.random.split(key)
+    labels = jax.random.randint(kl, (batch_size,), 0, num_classes)
+    images = jax.random.normal(kn, (batch_size, image_size, image_size, 3))
+    return {"images": images, "labels": labels}

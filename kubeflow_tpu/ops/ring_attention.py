@@ -50,15 +50,25 @@ def _ring_attention_sharded(q, k, v, *, axis_name, causal):
         kv, m, l, acc = carry
         k_r, v_r = kv
         src = (my_idx - r) % n
-        logits = _ring_block(q, k_r, v_r, my_idx, src, s, causal, scale)  # [B,H,s,t]
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        p = jnp.exp(logits - m_new[..., None])
-        corr = jnp.exp(m - m_new)                                         # [B,H,s]
-        l_new = l * corr + p.sum(axis=-1)
-        pv = jnp.einsum("bhst,bthd->bshd", p, v_r.astype(jnp.float32))
-        acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
+
+        def fold(args):
+            m, l, acc = args
+            logits = _ring_block(q, k_r, v_r, my_idx, src, s, causal, scale)  # [B,H,s,t]
+            m_new = jnp.maximum(m, logits.max(axis=-1))
+            p = jnp.exp(logits - m_new[..., None])
+            corr = jnp.exp(m - m_new)                                         # [B,H,s]
+            l_new = l * corr + p.sum(axis=-1)
+            pv = jnp.einsum("bhst,bthd->bshd", p, v_r.astype(jnp.float32))
+            return m_new, l_new, acc * corr.transpose(0, 2, 1)[..., None] + pv
+
+        if causal:
+            # a visiting block strictly in the future contributes nothing —
+            # skip BOTH einsums, not just mask them (half the ring on average)
+            m, l, acc = jax.lax.cond(src > my_idx, lambda args: args, fold, (m, l, acc))
+        else:
+            m, l, acc = fold((m, l, acc))
         kv_next = jax.lax.ppermute((k_r, v_r), axis_name, perm)
-        return (kv_next, m_new, l_new, acc_new), None
+        return (kv_next, m, l, acc), None
 
     m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s), jnp.float32)

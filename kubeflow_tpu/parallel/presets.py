@@ -53,7 +53,12 @@ def get_preset(name: str, n_devices: int, tensor: int = 1) -> Preset:
         return Preset(name, MeshConfig(fsdp=n_devices), _flash,
                       "ZeRO-3-style sharded data parallel over ICI")
     if name in ("tp", "tensor"):
-        return Preset(name, MeshConfig(fsdp=n_devices // max(tensor, 2), tensor=max(tensor, 2)),
+        t = tensor if tensor > 1 else 2  # tp means tensor>1; default 2
+        if tensor == 1 and n_devices % 2:
+            raise ValueError(f"tp preset needs an even device count, got {n_devices}")
+        if n_devices % t:
+            raise ValueError(f"tensor={t} does not divide {n_devices} devices")
+        return Preset(name, MeshConfig(fsdp=n_devices // t, tensor=t),
                       _flash, "Megatron-style tensor parallel innermost, fsdp outer")
     if name in ("ring-cp", "ring", "cp"):
         return Preset(
@@ -76,6 +81,14 @@ def get_preset(name: str, n_devices: int, tensor: int = 1) -> Preset:
     )
 
 
+ENV_TENSOR = "TPU_TENSOR_PARALLEL"
+
+
 def preset_from_env(n_devices: int, default: str = "fsdp") -> Preset:
-    """What a JAXJob worker calls: the controller sets TPU_PARALLELISM_PRESET."""
-    return get_preset(os.environ.get(ENV_PRESET, default), n_devices)
+    """What a JAXJob worker calls: the controller sets TPU_PARALLELISM_PRESET
+    (and optionally TPU_TENSOR_PARALLEL for the tp preset's axis size)."""
+    return get_preset(
+        os.environ.get(ENV_PRESET, default),
+        n_devices,
+        tensor=int(os.environ.get(ENV_TENSOR, "1")),
+    )

@@ -29,6 +29,11 @@ def install(api, manager, workdir: str, metadata_path: Optional[str] = None):
     """
     existing = getattr(api, "_kfp_service", None)
     if existing is not None:
+        if os.path.abspath(os.path.join(workdir, "objects")) != existing.store.root:
+            raise ValueError(
+                f"pipelines already installed with workdir {existing.store.root!r}; "
+                f"refusing a second install at {workdir!r} (one WAL writer per cluster)"
+            )
         return existing
     papi.register(api)
     store = ObjectStore(os.path.join(workdir, "objects"))

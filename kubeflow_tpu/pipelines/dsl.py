@@ -260,7 +260,9 @@ class Component:
         self.spec = self._introspect(fn)
 
     def _introspect(self, fn: Callable) -> ComponentSpec:
-        sig = inspect.signature(fn)
+        # eval_str: modules with `from __future__ import annotations` deliver
+        # annotations as strings; Input/Output markers must be real objects
+        sig = inspect.signature(fn, eval_str=True)
         in_params: dict = {}
         in_artifacts: dict = {}
         out_params: dict = {}

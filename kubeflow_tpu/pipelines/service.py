@@ -72,7 +72,9 @@ class PipelineService:
         return sorted(c.name for c in self._contexts(PIPELINE_CTX))
 
     def _contexts(self, ctx_type: str) -> list:
-        return self.metadata.contexts_by_type(ctx_type)
+        # "__registry__" was internal bookkeeping in stores written before the
+        # contexts_by_type index existed; never surface it as a record
+        return [c for c in self.metadata.contexts_by_type(ctx_type) if c.name != "__registry__"]
 
     # ------------------------------------------------------------ experiments
 

@@ -31,7 +31,7 @@ from typing import Any, Optional
 from ..core.api import APIServer, AlreadyExists, Obj, owner_reference
 from ..core.events import EventRecorder
 from ..core.controller import Request, Result
-from ..scheduler.topology import TPU_RESOURCE
+from ..scheduler.topology import TPU_RESOURCE, chips_in
 from . import api as papi
 from .artifacts import ObjectStore
 from . import metadata as md
@@ -274,8 +274,13 @@ class WorkflowController:
         resources: dict = dict(tspec.get("resources", {}))
         tpu = tspec.get("tpu")
         if tpu:
-            # chips resolved and validated at DSL time (Task.set_tpu)
-            resources[TPU_RESOURCE] = int(tpu["chips"])
+            # chips resolved at DSL time (Task.set_tpu); chips=0 covers IRs
+            # compiled before that existed — infer from the accelerator name
+            chips = int(tpu.get("chips") or 0)
+            if not chips:
+                tail = tpu["accelerator"].rsplit("-", 1)[-1]
+                chips = chips_in(tail) if "x" in tail else int(tail)
+            resources[TPU_RESOURCE] = chips
         container = {
             "name": "main",
             "command": [sys.executable, "-m", "kubeflow_tpu.pipelines.launcher_main", workspace],

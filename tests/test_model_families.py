@@ -1,0 +1,151 @@
+"""Model families for the BASELINE configs: MNIST CNN (TFJob), ResNet-50
+(PyTorchJob DDP), decoder LM (Gemma/Llama family), and the Gemma
+fine-tune→eval→deploy pipeline end-to-end."""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from kubeflow_tpu.models import decoder, mnist, resnet
+
+
+# -------------------------------------------------------------------- mnist
+
+
+def test_mnist_cnn_learns():
+    config = mnist.MnistConfig()
+    params = mnist.init(jax.random.PRNGKey(0), config)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, s, b):
+        loss, g = jax.value_and_grad(mnist.loss)(p, config, b["images"], b["labels"])
+        u, s = opt.update(g, s)
+        return optax.apply_updates(p, u), s, loss
+
+    first = last = None
+    for i in range(40):
+        b = mnist.synthetic_batch(jax.random.PRNGKey(i), 64)
+        params, opt_state, loss = step(params, opt_state, b)
+        last = float(loss)
+        first = first if first is not None else last
+    assert last < first * 0.5, (first, last)
+    acc = float(mnist.accuracy(params, config, **mnist.synthetic_batch(jax.random.PRNGKey(100), 256)))
+    assert acc > 0.8, acc
+
+
+# ------------------------------------------------------------------- resnet
+
+
+def test_resnet50_shapes_and_step():
+    config = resnet.ResNetConfig(num_classes=10)
+    params = resnet.init(jax.random.PRNGKey(0), config)
+    assert len(params["blocks"]) == sum(resnet.STAGES_50)  # 16 bottlenecks
+    n_params = resnet.count_params(params)
+    assert 2.3e7 < n_params < 2.7e7, n_params  # ResNet-50 ≈ 25.6M
+
+    batch = resnet.synthetic_batch(jax.random.PRNGKey(1), 2, image_size=64, num_classes=10)
+    logits = jax.jit(lambda p, x: resnet.forward(p, config, x))(params, batch["images"])
+    assert logits.shape == (2, 10)
+    assert bool(jnp.isfinite(logits).all())
+
+    loss, grads = jax.value_and_grad(resnet.loss)(params, config, batch["images"], batch["labels"])
+    assert bool(jnp.isfinite(loss))
+    gnorm = optax.global_norm(grads)
+    assert float(gnorm) > 0
+
+
+def test_resnet_ddp_worker_runs_multiprocess(tmp_path):
+    """BASELINE config[1] shape: 2-worker DDP through the PyTorchJob path."""
+    from kubeflow_tpu.core.cluster import Cluster
+    from kubeflow_tpu.training import api as tapi
+    from kubeflow_tpu.training.api import ReplicaSpec, job
+    from kubeflow_tpu.training.client import TrainingClient
+    from kubeflow_tpu.training.frameworks import install
+
+    c = Cluster(cpu_nodes=1)
+    install(c.api, c.manager)
+    try:
+        spec = job(
+            "PyTorchJob",
+            "resnet-ddp",
+            {
+                "Master": ReplicaSpec(
+                    replicas=1,
+                    command=[sys.executable, "-u", "-m", "kubeflow_tpu.examples.resnet_ddp_worker"],
+                    env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo",
+                         "TRAIN_STEPS": "2", "PER_CHIP_BATCH": "4", "IMAGE_SIZE": "32"},
+                ),
+                "Worker": ReplicaSpec(
+                    replicas=1,
+                    command=[sys.executable, "-u", "-m", "kubeflow_tpu.examples.resnet_ddp_worker"],
+                    env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo",
+                         "TRAIN_STEPS": "2", "PER_CHIP_BATCH": "4", "IMAGE_SIZE": "32"},
+                ),
+            },
+        )
+        client = TrainingClient(c)
+        client.create_job(spec)
+        assert client.wait_for_job("PyTorchJob", "resnet-ddp", timeout=300) == tapi.SUCCEEDED
+        logs = "\n".join(client.get_job_logs("PyTorchJob", "resnet-ddp").values())
+        assert "RESNET-DDP-OK" in logs
+        assert "world size=2 global devices=2" in logs
+    finally:
+        c.shutdown()
+
+
+# ------------------------------------------------------------------ decoder
+
+
+def test_decoder_lm_learns():
+    config = decoder.tiny()
+    params = decoder.init(jax.random.PRNGKey(0), config)
+    opt = optax.adamw(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, s, toks):
+        loss, g = jax.value_and_grad(decoder.lm_loss)(p, config, toks)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    batches = decoder.synthetic_lm_batches(config.vocab_size, 8, 32)
+    first = last = None
+    for _ in range(60):
+        params, opt_state, loss = step(params, opt_state, next(batches)["tokens"])
+        last = float(loss)
+        first = first if first is not None else last
+    assert last < first * 0.7, (first, last)
+
+
+def test_decoder_presets():
+    l3 = decoder.DecoderConfig.llama3_8b()
+    assert 7.5e9 < l3.param_count() < 8.5e9
+    g7 = decoder.gemma_7b()
+    assert 7e9 < g7.param_count() < 10e9
+    assert decoder.train_flops(decoder.tiny(), 8, 32) > 0
+
+
+# --------------------------------------------------------- gemma pipeline e2e
+
+
+def test_gemma_pipeline_e2e(cluster):
+    """BASELINE config[4] at CI scale: finetune -> eval -> gated deploy."""
+    from kubeflow_tpu.examples.gemma_pipeline import gemma_pipeline
+    from kubeflow_tpu.pipelines import api as papi
+    from kubeflow_tpu.pipelines.client import Client
+
+    client = Client(cluster)
+    run = client.create_run_from_pipeline_func(gemma_pipeline, arguments={"steps": 20})
+    rec = run.wait(timeout=240)
+    assert rec["phase"] == papi.SUCCEEDED, rec
+    nodes = rec["nodes"]
+    ft = nodes["finetune"]["outputArtifacts"]["metrics"]["metadata"]
+    assert ft["final_loss"] < ft["first_loss"]
+    assert nodes["evaluate"]["outputParameters"]["Output"] < 1000.0
+    assert nodes["deploy"]["phase"] == papi.SUCCEEDED
+    assert nodes["deploy"]["outputParameters"]["Output"].startswith("mstore://")
