@@ -17,6 +17,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Protocol
 
 from .api import APIServer, Obj, Watcher
+from .metrics import RECONCILE_ERRORS, RECONCILE_TOTAL
 
 
 @dataclass(frozen=True)
@@ -115,9 +116,15 @@ class Controller:
                 result = self.reconciler.reconcile(req)
             except Exception as e:  # noqa: BLE001 — controller loop must survive
                 self.errors.append((req, e))
+                RECONCILE_TOTAL.inc(controller=self.kind, result="error")
+                RECONCILE_ERRORS.inc(controller=self.kind)
                 traceback.print_exc()
                 self._enqueue_after(req, 0.2)
             else:
+                RECONCILE_TOTAL.inc(
+                    controller=self.kind,
+                    result="requeue_after" if result and result.requeue_after else "success",
+                )
                 if result is not None and result.requeue_after is not None:
                     self._enqueue_after(req, result.requeue_after)
             n += 1

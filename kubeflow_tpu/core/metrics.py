@@ -1,0 +1,125 @@
+"""Controller metrics: Prometheus-style registry + /metrics endpoint.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §5): controller-runtime's
+``controller_runtime_reconcile_total``/``_errors_total`` plus
+training-operator's jobs created/successful/failed counters, exposed on each
+manager's /metrics.  One process-global registry (controllers in this
+simulator share a process), text exposition format, optional HTTP server.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def labels_key(self, labels: dict) -> tuple:
+        return tuple(sorted(labels.items()))
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                label_s = ",".join(f'{k}="{val}"' for k, val in key)
+                lines.append(f"{self.name}{{{label_s}}} {v:g}" if label_s else f"{self.name} {v:g}")
+        return "\n".join(lines)
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_, "counter")
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self.labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self.labels_key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_, "gauge")
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self.labels_key(labels)] = float(value)
+
+    def value(self, **labels) -> float:
+        return self._values.get(self.labels_key(labels), 0.0)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Counter(name, help_)
+            return m  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Gauge(name, help_)
+            return m  # type: ignore[return-value]
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+
+REGISTRY = Registry()
+
+# the controller-runtime-equivalent core metrics
+RECONCILE_TOTAL = REGISTRY.counter(
+    "controller_runtime_reconcile_total", "reconciles per controller kind and result"
+)
+RECONCILE_ERRORS = REGISTRY.counter(
+    "controller_runtime_reconcile_errors_total", "reconcile panics/errors per kind"
+)
+JOBS_CREATED = REGISTRY.counter("training_operator_jobs_created_total", "jobs accepted")
+JOBS_SUCCESSFUL = REGISTRY.counter("training_operator_jobs_successful_total", "jobs succeeded")
+JOBS_FAILED = REGISTRY.counter("training_operator_jobs_failed_total", "jobs failed")
+JOBS_RESTARTED = REGISTRY.counter("training_operator_jobs_restarted_total", "job pod restarts")
+
+
+def serve(port: int = 0) -> tuple[int, object]:
+    """Expose /metrics over HTTP; returns (bound_port, server). port=0 picks
+    a free port.  Runs in a daemon thread (shutdown() the server to stop)."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = REGISTRY.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # pragma: no cover - silence stdlib
+            pass
+
+    server = HTTPServer(("127.0.0.1", port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server.server_address[1], server

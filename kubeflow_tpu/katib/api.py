@@ -25,6 +25,7 @@ CREATED = "Created"
 RUNNING = "Running"
 SUCCEEDED = "Succeeded"
 FAILED = "Failed"
+EARLY_STOPPED = "EarlyStopped"
 
 LABEL_EXPERIMENT = "katib.kubeflow.org/experiment"
 

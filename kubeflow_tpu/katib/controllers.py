@@ -194,6 +194,8 @@ class ExperimentController:
                                 "primaryContainerName", "main"
                             ),
                             "runSpec": run_spec,
+                            **({"earlyStopping": spec["earlyStopping"]}
+                               if spec.get("earlyStopping") else {}),
                         },
                     }
                 )
@@ -286,7 +288,7 @@ class TrialController:
             self.api.update_status(trial)
             return None
         if not has_condition(job_status, tapi.SUCCEEDED):
-            return None
+            return self._maybe_early_stop(trial, status, req)
 
         # job done: pull logs from all job pods, parse observation
         metric_names = [trial["spec"]["objective"]["objectiveMetricName"]] + list(
@@ -308,6 +310,65 @@ class TrialController:
         set_condition(status, kapi.SUCCEEDED, "True", "TrialSucceeded", "")
         set_condition(status, kapi.RUNNING, "False", "TrialSucceeded", "")
         self.recorder.normal(trial, "TrialSucceeded", str(obs["metrics"]))
+        self.api.update_status(trial)
+        return None
+
+    # --------------------------------------------------- early stopping
+
+    def _maybe_early_stop(self, trial: Obj, status: dict, req: Request) -> Optional[Result]:
+        """medianstop (upstream katib earlystopping): stop a running trial
+        whose current objective is worse than the median of completed
+        siblings' final objectives.  Polls pod logs while running — the
+        pull-based analogue of the sidecar's intermediate observations."""
+        es = trial["spec"].get("earlyStopping") or {}
+        if es.get("algorithmName") != "medianstop":
+            return None
+        settings = {s["name"]: s["value"] for s in es.get("algorithmSettings", [])}
+        min_trials = int(settings.get("min_trials_required", 3))
+
+        exp_name = trial["metadata"].get("labels", {}).get(kapi.LABEL_EXPERIMENT, "")
+        siblings = self.api.list(
+            "Trial", namespace=req.namespace,
+            label_selector={kapi.LABEL_EXPERIMENT: exp_name},
+        )
+        metric = trial["spec"]["objective"]["objectiveMetricName"]
+        sign = 1.0 if trial["spec"]["objective"]["type"] == "maximize" else -1.0
+        finals = []
+        for t in siblings:
+            if t["metadata"]["name"] == trial["metadata"]["name"]:
+                continue
+            if not has_condition(t.get("status", {}), kapi.SUCCEEDED):
+                continue
+            for m in t["status"].get("observation", {}).get("metrics", []):
+                if m["name"] == metric:
+                    finals.append(sign * float(m["latest"]))
+        if len(finals) < min_trials:
+            return Result(requeue_after=0.3)
+
+        pods = self.api.list(
+            "Pod", namespace=req.namespace,
+            label_selector={tapi.LABEL_JOB_NAME: req.name},
+        )
+        log = "\n".join(self.log_reader(p["metadata"]["name"], req.namespace) for p in pods)
+        obs = observation(log, [metric])
+        current = next((sign * m["latest"] for m in obs["metrics"] if m["name"] == metric), None)
+        if current is None:
+            return Result(requeue_after=0.3)
+        finals.sort()
+        median = finals[len(finals) // 2]
+        if current >= median:
+            return Result(requeue_after=0.3)
+
+        # stop: kill the job (pods cascade), keep the partial observation
+        run_kind = trial["spec"]["runSpec"].get("kind", "TPUJob")
+        self.api.try_delete(run_kind, req.name, req.namespace)
+        status["observation"] = obs
+        set_condition(status, kapi.EARLY_STOPPED, "True", "TrialEarlyStopped",
+                      f"{metric}={sign * current} worse than median {sign * median}")
+        set_condition(status, kapi.SUCCEEDED, "True", "TrialEarlyStopped", "stopped early")
+        set_condition(status, kapi.RUNNING, "False", "TrialEarlyStopped", "")
+        self.recorder.normal(trial, "TrialEarlyStopped",
+                             f"{metric} {sign * current} < median {sign * median}")
         self.api.update_status(trial)
         return None
 

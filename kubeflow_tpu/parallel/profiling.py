@@ -1,0 +1,46 @@
+"""Workload-side XLA profiler surfacing (SURVEY.md §5).
+
+The TPUJob controller injects ``TPU_PROFILE_DIR``/``TPU_PROFILE_STEPS`` when
+``spec.profile.enabled``; a training loop wraps its hot loop with
+``maybe_trace`` and gets a ``jax.profiler`` trace (viewable in
+TensorBoard/XProf) for the configured number of steps — no workload code
+changes needed to turn profiling on or off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+
+def profile_dir(environ=None) -> Optional[str]:
+    env = environ if environ is not None else os.environ
+    return env.get("TPU_PROFILE_DIR") or None
+
+
+def profile_steps(environ=None) -> int:
+    env = environ if environ is not None else os.environ
+    return int(env.get("TPU_PROFILE_STEPS", "5"))
+
+
+@contextlib.contextmanager
+def maybe_trace(step: int, environ=None) -> Iterator[bool]:
+    """Trace this step iff profiling is enabled and step < TPU_PROFILE_STEPS.
+
+    Yields whether the step is being traced.  Steps after the window are
+    zero-overhead (no context at all beyond the env check).
+    """
+    d = profile_dir(environ)
+    if d is None or step >= profile_steps(environ):
+        yield False
+        return
+    import jax
+
+    os.makedirs(d, exist_ok=True)
+    with jax.profiler.StepTraceAnnotation("train", step_num=step):
+        if step == 0:
+            jax.profiler.start_trace(d)
+        yield True
+        if step == profile_steps(environ) - 1:
+            jax.profiler.stop_trace()

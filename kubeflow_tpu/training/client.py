@@ -60,3 +60,18 @@ class TrainingClient:
 
     def delete_job(self, kind: str, name: str) -> None:
         self.cluster.api.try_delete(kind, name, self.namespace)
+
+    def scale_job(self, kind: str, name: str, replicas: int, rtype: str = "Worker") -> Obj:
+        """Elastic scale (upstream: HPA on ElasticPolicy): clamp to the
+        job's [minReplicas, maxReplicas] and update the spec; the controller
+        converges pods to the new world size."""
+        job = self.cluster.api.get(kind, name, self.namespace)
+        elastic = job["spec"].get("elasticPolicy") or {}
+        lo = int(elastic.get("minReplicas", 1))
+        hi = int(elastic.get("maxReplicas", replicas))
+        replicas = max(lo, min(int(replicas), hi))
+        job["spec"]["replicaSpecs"][rtype]["replicas"] = replicas
+        # an explicit scale supersedes any elastic shrink recorded in status
+        if (job.get("status") or {}).get("elasticReplicas", {}).get(rtype) is not None:
+            job["status"]["elasticReplicas"].pop(rtype)
+        return self.cluster.api.update(job)

@@ -38,6 +38,7 @@ from ..scheduler.topology import (
     VARIANTS,
     chips_in,
 )
+from ..core.metrics import JOBS_CREATED, JOBS_FAILED, JOBS_RESTARTED, JOBS_SUCCESSFUL
 from ..utils.net import find_free_ports
 from . import api as tapi
 
@@ -67,6 +68,7 @@ class JobController:
         if not has_condition(status, tapi.CREATED):
             set_condition(status, tapi.CREATED, "True", f"{self.kind}Created", "job accepted")
             self.recorder.normal(job, "JobCreated", f"{self.kind} {req.name} created")
+            JOBS_CREATED.inc(kind=self.kind)
             job = self.api.update_status(job)
             status = job["status"]
 
@@ -100,6 +102,12 @@ class JobController:
                     policy == "ExitCode" and rc is not None and rc >= RETRYABLE_EXIT_MIN
                 )
                 if not retryable:
+                    if self.absorb_failure(job, status, rtype, i, pod, rc):
+                        # elastic shrink: the framework accepted the loss;
+                        # drop the pod, requeue to re-render the world
+                        self.api.try_delete("Pod", pod["metadata"]["name"], req.namespace)
+                        self.api.update_status(job)
+                        return Result(requeue_after=0.05)
                     failure_msg = f"{rtype}[{i}] failed with exit code {rc} (permanent)"
                     break
                 if self._restarts(status) + len(retryable_failures) >= backoff_limit:
@@ -116,6 +124,7 @@ class JobController:
                 pods_by_type[rtype][i] = None
                 status["restartCount"] = self._restarts(status) + 1
                 restarted = True
+                JOBS_RESTARTED.inc(kind=self.kind)
                 self.recorder.warning(
                     job, "JobRestarting", f"{rtype}[{i}] exit {rc}: retryable, recreating"
                 )
@@ -125,6 +134,7 @@ class JobController:
             set_condition(status, tapi.RUNNING, "False", "JobFailed", failure_msg)
             status["completionTime"] = time.time()
             self.recorder.warning(job, "JobFailed", failure_msg)
+            JOBS_FAILED.inc(kind=self.kind)
             self.api.update_status(job)
             return self._reconcile_terminal(job)
 
@@ -133,13 +143,18 @@ class JobController:
             self.api.update_status(job)
             return Result(requeue_after=0.05)
 
-        # --- create missing pods + services
+        # --- create missing pods + services; delete pods beyond the desired
+        # count (elastic scale-down / spec.replicas shrink)
         for rtype, rspec in replicas.items():
             for i, pod in enumerate(pods_by_type[rtype]):
                 if pod is None:
                     created = self._create_pod(job, rtype, i, rspec, replicas)
                     pods_by_type[rtype][i] = created
                     self._ensure_service(job, created)
+            i = rspec["replicas"]
+            while self.api.try_delete("Pod", self.pod_name(job, rtype, i), req.namespace):
+                self.recorder.normal(job, "JobScaledDown", f"removed {rtype}[{i}]")
+                i += 1
 
         # --- aggregate status
         replica_statuses = {}
@@ -159,6 +174,7 @@ class JobController:
             set_condition(status, tapi.RUNNING, "False", "JobSucceeded", "job completed")
             status["completionTime"] = time.time()
             self.recorder.normal(job, "JobSucceeded", f"{self.kind} {req.name} succeeded")
+            JOBS_SUCCESSFUL.inc(kind=self.kind)
             self.api.update_status(job)
             return self._reconcile_terminal(self.api.get(self.kind, req.name, req.namespace))
 
@@ -219,8 +235,12 @@ class JobController:
         ns = job["metadata"].get("namespace", "default")
         min_member = total
         sched = job["spec"].get("runPolicy", {}).get("schedulingPolicy") or {}
+        elastic = job["spec"].get("elasticPolicy") or {}
         if "minAvailable" in sched:
             min_member = sched["minAvailable"]
+        elif "minReplicas" in elastic:
+            # elastic jobs gang only on the floor: the job is viable at min
+            min_member = min(total, elastic["minReplicas"])
         try:
             self.api.create(
                 {
@@ -339,6 +359,10 @@ class JobController:
             variant = VARIANTS[tpu.get("accelerator", "v5e")]
             hosts = max(1, chips_in(tpu.get("topology", "2x2")) // variant.chips_per_host)
             replicas["Worker"]["replicas"] = hosts * tpu.get("numSlices", 1)
+        # elastic shrink recorded by absorb_failure overrides the spec count
+        for rtype, n in ((job.get("status") or {}).get("elasticReplicas") or {}).items():
+            if rtype in replicas:
+                replicas[rtype]["replicas"] = n
         return replicas
 
     def num_ports(self, total_replicas: int) -> int:
@@ -347,6 +371,12 @@ class JobController:
     def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
         """Rendezvous env for one replica. Framework-specific."""
         return {}
+
+    def absorb_failure(self, job: Obj, status: dict, rtype: str, index: int,
+                       pod: Obj, rc: Optional[int]) -> bool:
+        """Hook: return True to absorb a permanent pod failure instead of
+        failing the job (elastic frameworks shrink the replica set here)."""
+        return False
 
     def is_succeeded(self, job: Obj, pods_by_type: dict[str, list[Optional[Obj]]]) -> bool:
         """Default success policy: the chief replica type fully succeeded;

@@ -61,6 +61,17 @@ class TPUJobController(JobController):
                     "MEGASCALE_SLICE_ID": str(index // hosts_per_slice),
                 }
             )
+        profile = job["spec"].get("profile") or {}
+        if profile.get("enabled"):
+            # first-class XLA profiler surfacing (SURVEY.md §5): workloads
+            # pick these up via parallel.profiling.maybe_trace
+            env["TPU_PROFILE_DIR"] = profile.get("dir", "/tmp/tpu-profiles")
+            env["TPU_PROFILE_STEPS"] = str(profile.get("steps", 5))
+        preset = job["spec"].get("parallelism") or {}
+        if preset.get("preset"):
+            env["TPU_PARALLELISM_PRESET"] = preset["preset"]
+            if preset.get("tensor"):
+                env["TPU_TENSOR_PARALLEL"] = str(preset["tensor"])
         return env
 
 
@@ -117,13 +128,38 @@ class PyTorchJobController(JobController):
             rank = 0
         else:
             rank = index + (1 if has_master else 0)
-        return {
+        env = {
             "MASTER_ADDR": _host(job, "Master" if has_master else "Worker", 0),
             "MASTER_PORT": str(ports[0]),
             "WORLD_SIZE": str(world),
             "RANK": str(rank),
             "LOCAL_RANK": "0",
         }
+        elastic = job["spec"].get("elasticPolicy") or {}
+        if elastic:
+            # torchrun-style rendezvous bounds (upstream ElasticPolicy surface)
+            env["PET_MIN_REPLICAS"] = str(elastic.get("minReplicas", 1))
+            env["PET_MAX_REPLICAS"] = str(elastic.get("maxReplicas", world))
+            env["PET_RDZV_ENDPOINT"] = f"{env['MASTER_ADDR']}:{env['MASTER_PORT']}"
+        return env
+
+    def absorb_failure(self, job: Obj, status: dict, rtype: str, index: int,
+                       pod: Obj, rc) -> bool:
+        """ElasticPolicy: a dead Worker shrinks the world instead of failing
+        the job, down to minReplicas (upstream: torchrun re-rendezvous)."""
+        elastic = job["spec"].get("elasticPolicy") or {}
+        if not elastic or rtype != "Worker":
+            return False
+        current = self.effective_replicas(job)["Worker"]["replicas"]
+        floor = max(1, int(elastic.get("minReplicas", 1)))
+        if current - 1 < floor:
+            return False
+        status.setdefault("elasticReplicas", {})["Worker"] = current - 1
+        self.recorder.warning(
+            job, "JobScaledDown",
+            f"elastic: Worker[{index}] exit {rc}; world {current} -> {current - 1} (min {floor})",
+        )
+        return True
 
 
 class MPIJobController(JobController):

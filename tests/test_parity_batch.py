@@ -1,0 +1,195 @@
+"""Parity batch: CMA-ES, medianstop early stopping, controller metrics,
+profiler/parallelism env surfacing."""
+
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.core.cluster import Cluster
+from kubeflow_tpu.core import metrics as cmetrics
+from kubeflow_tpu.katib import api as kapi
+from kubeflow_tpu.katib.api import Parameter, experiment
+from kubeflow_tpu.katib.client import KatibClient
+from kubeflow_tpu.katib.controllers import install as katib_install
+from kubeflow_tpu.katib.suggest import algorithm_names, get_suggester
+from kubeflow_tpu.training.api import ReplicaSpec, job
+from kubeflow_tpu.training.client import TrainingClient
+from kubeflow_tpu.training.frameworks import install as training_install
+
+
+@pytest.fixture()
+def kcluster():
+    c = Cluster(cpu_nodes=1)
+    training_install(c.api, c.manager)
+    katib_install(c.api, c.manager, c.logs)
+    yield c
+    c.shutdown()
+
+
+# ------------------------------------------------------------------- cma-es
+
+
+def _quadratic_experiment(n_trials_done: int):
+    """Experiment + synthetic completed trials for f(x) = 1 - (x-0.3)^2."""
+    exp = experiment(
+        "cma",
+        parameters=[Parameter("x", "double", min=0.0, max=1.0)],
+        trial_spec={"kind": "TPUJob", "spec": {}},
+        objective_metric="acc",
+        objective_type="maximize",
+        algorithm="cmaes",
+        max_trials=50,
+    )
+    rng = np.random.default_rng(0)
+    trials = []
+    for i in range(n_trials_done):
+        x = float(rng.uniform(0, 1))
+        trials.append(
+            {
+                "metadata": {"name": f"t{i}"},
+                "spec": {"parameterAssignments": [{"name": "x", "value": x}]},
+                "status": {
+                    "conditions": [{"type": kapi.SUCCEEDED, "status": "True"}],
+                    "observation": {"metrics": [{"name": "acc", "latest": 1 - (x - 0.3) ** 2}]},
+                },
+            }
+        )
+    return exp, trials
+
+
+def test_cmaes_registered_and_converges_toward_optimum():
+    assert "cmaes" in algorithm_names()
+    s = get_suggester("cmaes")
+    exp, trials = _quadratic_experiment(0)
+    first = s.suggest(exp, trials, 4)
+    assert len(first) == 4 and all(0.0 <= a["x"] <= 1.0 for a in first)
+
+    # after several generations of observations, the sampling mean should
+    # have moved toward x*=0.3
+    exp, trials = _quadratic_experiment(40)
+    later = s.suggest(exp, trials, 16)
+    mean_later = np.mean([a["x"] for a in later])
+    assert abs(mean_later - 0.3) < 0.2, mean_later
+
+
+# ----------------------------------------------------------- early stopping
+
+SLOW_BAD_TRIAL = (
+    "import os, time\n"
+    "lr = float(os.environ['LR'])\n"
+    "acc = 1.0 - (lr - 0.1) ** 2\n"
+    "print(f'accuracy={acc:.6f}', flush=True)\n"
+    # bad trials linger: early stopping must kill them before the sleep ends
+    "time.sleep(0 if acc > 0.5 else 20)\n"
+)
+
+
+def test_medianstop_early_stops_bad_trials(kcluster):
+    trial_spec = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TPUJob",
+        "spec": {
+            "replicaSpecs": {"Worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {"spec": {"containers": [{
+                    "name": "main",
+                    "command": [sys.executable, "-u", "-c", SLOW_BAD_TRIAL],
+                    "env": [{"name": "LR", "value": "${trialParameters.lr}"}],
+                }]}},
+            }},
+            "runPolicy": {"cleanPodPolicy": "None"},
+        },
+    }
+    spec = experiment(
+        "medstop",
+        parameters=[Parameter("lr", "double", min=0.01, max=2.0)],
+        trial_spec=trial_spec,
+        objective_metric="accuracy",
+        objective_type="maximize",
+        algorithm="grid",
+        max_trials=6,
+        parallel_trials=2,
+    )
+    spec["spec"]["earlyStopping"] = {
+        "algorithmName": "medianstop",
+        "algorithmSettings": [{"name": "min_trials_required", "value": 2}],
+    }
+    client = KatibClient(kcluster)
+    client.create_experiment(spec)
+    assert client.wait_for_experiment("medstop", timeout=300) == kapi.SUCCEEDED
+    trials = client.list_trials("medstop")
+    stopped = [
+        t for t in trials
+        if any(c["type"] == kapi.EARLY_STOPPED and c["status"] == "True"
+               for c in t.get("status", {}).get("conditions", []))
+    ]
+    assert stopped, "no trial was early-stopped"
+    # early-stopped trials still carry their observation
+    assert all(t["status"].get("observation", {}).get("metrics") for t in stopped)
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_controller_metrics_counted_and_served(kcluster):
+    client = TrainingClient(kcluster)
+    base_created = cmetrics.JOBS_CREATED.value(kind="TPUJob")
+    base_ok = cmetrics.JOBS_SUCCESSFUL.value(kind="TPUJob")
+    spec = job("TPUJob", "mjob", {"Worker": ReplicaSpec(
+        replicas=1, command=[sys.executable, "-c", "print('ok')"],
+    )})
+    client.create_job(spec)
+    client.wait_for_job("TPUJob", "mjob", timeout=60)
+    assert cmetrics.JOBS_CREATED.value(kind="TPUJob") == base_created + 1
+    assert cmetrics.JOBS_SUCCESSFUL.value(kind="TPUJob") == base_ok + 1
+    assert cmetrics.RECONCILE_TOTAL.value(controller="TPUJob", result="success") > 0
+
+    port, server = cmetrics.serve(0)
+    try:
+        body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "training_operator_jobs_successful_total" in body
+        assert 'controller_runtime_reconcile_total{controller="TPUJob"' in body
+    finally:
+        server.shutdown()
+
+
+def test_jobs_failed_metric(kcluster):
+    client = TrainingClient(kcluster)
+    base = cmetrics.JOBS_FAILED.value(kind="TPUJob")
+    spec = job("TPUJob", "failjob", {"Worker": ReplicaSpec(
+        replicas=1, command=[sys.executable, "-c", "raise SystemExit(1)"],
+    )})
+    client.create_job(spec)
+    client.wait_for_job("TPUJob", "failjob", timeout=60)
+    assert cmetrics.JOBS_FAILED.value(kind="TPUJob") == base + 1
+
+
+# ----------------------------------------- profiler + parallelism env wiring
+
+
+def test_tpujob_profile_and_preset_env(kcluster):
+    spec = job("TPUJob", "profjob", {"Worker": ReplicaSpec(
+        replicas=1,
+        command=[sys.executable, "-u", "-c",
+                 "import os; print('DIR', os.environ.get('TPU_PROFILE_DIR'));"
+                 "print('STEPS', os.environ.get('TPU_PROFILE_STEPS'));"
+                 "print('PRESET', os.environ.get('TPU_PARALLELISM_PRESET'))"],
+    )})
+    spec["spec"]["profile"] = {"enabled": True, "dir": "/tmp/prof", "steps": 3}
+    spec["spec"]["parallelism"] = {"preset": "ring-cp"}
+    client = TrainingClient(kcluster)
+    client.create_job(spec)
+    client.wait_for_job("TPUJob", "profjob", timeout=60)
+    logs = "\n".join(client.get_job_logs("TPUJob", "profjob").values())
+    assert "DIR /tmp/prof" in logs
+    assert "STEPS 3" in logs
+    assert "PRESET ring-cp" in logs
+
+
+def test_maybe_trace_noop_without_env(tmp_path):
+    from kubeflow_tpu.parallel.profiling import maybe_trace
+
+    with maybe_trace(0, environ={}) as tracing:
+        assert tracing is False

@@ -230,3 +230,64 @@ def test_job_validation_rejects_bad_spec(tcluster):
     bad["spec"]["replicaSpecs"]["Bogus"] = bad["spec"]["replicaSpecs"].pop("Worker")
     with pytest.raises(Invalid):
         tcluster.api.create(bad)
+
+
+def test_pytorchjob_elastic_shrinks_on_worker_failure(tcluster, tmp_path):
+    """ElasticPolicy: a permanently-failed Worker shrinks the world instead
+    of failing the job; PET_* rendezvous bounds are injected."""
+    worker_code = (
+        "import os, time, sys\n"
+        "marker = os.path.join(os.environ['MARKER_DIR'], 'died')\n"
+        "assert os.environ['PET_MIN_REPLICAS'] == '1'\n"
+        "if os.environ['RANK'] == '2' and not os.path.exists(marker):\n"
+        "    open(marker, 'w').write('x'); sys.exit(1)\n"  # permanent (rc 1)
+        "time.sleep(3)\n"
+    )
+    spec = job(
+        "PyTorchJob",
+        "elastic",
+        {
+            "Master": ReplicaSpec(
+                replicas=1,
+                command=[sys.executable, "-u", "-c", "import time; time.sleep(1.5); print('MASTER-DONE')"],
+                env={"PYTHONPATH": "/root/repo"},
+            ),
+            "Worker": ReplicaSpec(
+                replicas=2,
+                command=[sys.executable, "-u", "-c", worker_code],
+                env={"PYTHONPATH": "/root/repo", "MARKER_DIR": str(tmp_path)},
+            ),
+        },
+    )
+    spec["spec"]["elasticPolicy"] = {"minReplicas": 1, "maxReplicas": 4}
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("PyTorchJob", "elastic", timeout=120) == tapi.SUCCEEDED
+    final = client.get_job("PyTorchJob", "elastic")
+    assert final["status"]["elasticReplicas"]["Worker"] == 1
+    events = [e.get("reason") for e in tcluster.api.list("Event")]
+    assert "JobScaledDown" in events
+
+
+def test_pytorchjob_scale_job_clamps(tcluster):
+    spec = job(
+        "PyTorchJob",
+        "scaleme",
+        {"Worker": ReplicaSpec(
+            replicas=2,
+            command=[sys.executable, "-u", "-c", "import time; time.sleep(8)"],
+        )},
+    )
+    spec["spec"]["elasticPolicy"] = {"minReplicas": 1, "maxReplicas": 3}
+    client = _client(tcluster)
+    client.create_job(spec)
+    tcluster.wait_for(
+        lambda: len([p for p in tcluster.api.list("Pod") if p["metadata"]["name"].startswith("scaleme")]) == 2,
+        timeout=30,
+    )
+    client.scale_job("PyTorchJob", "scaleme", 10)  # clamped to max 3
+    assert tcluster.wait_for(
+        lambda: len([p for p in tcluster.api.list("Pod") if p["metadata"]["name"].startswith("scaleme")]) == 3,
+        timeout=30,
+    )
+    client.delete_job("PyTorchJob", "scaleme")
