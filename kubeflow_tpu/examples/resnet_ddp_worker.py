@@ -5,6 +5,13 @@ Runs as a PyTorchJob replica: maps the operator-injected ``MASTER_ADDR`` /
 surface) onto ``jax.distributed``, then runs data-parallel ResNet-50 — the
 gradient all-reduce the reference gets from NCCL comes from one ``psum``
 compiled over ICI.  Prints samples/sec/chip, the primary BASELINE metric.
+
+``DDP_TRANSPORT=shim`` selects the torch-DDP-shaped path instead (SURVEY.md
+§2b NCCL row): every process keeps a full model replica on its own device and
+the per-step gradient sync goes through the C++ ring-collective core
+(kubeflow_tpu/transport/) — the shim standing in for NCCL — rather than an
+XLA psum.  Numerics match the XLA path: mean-allreduced grads over equal
+local batches equal the global-batch gradient.
 """
 
 from __future__ import annotations
@@ -22,7 +29,67 @@ def _map_torch_env() -> None:
         env["JAX_PROCESS_ID"] = env.get("RANK", "0")
 
 
+def main_shim() -> None:
+    """DDP via the C++ transport shim: local compute, ring allreduce sync."""
+    import jax
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models import resnet
+    from kubeflow_tpu.transport import RingTransport, grad_allreduce
+
+    steps = int(os.environ.get("TRAIN_STEPS", "3"))
+    per_chip_batch = int(os.environ.get("PER_CHIP_BATCH", "8"))
+    image_size = int(os.environ.get("IMAGE_SIZE", "64"))
+
+    tr = RingTransport.from_env()
+    world, rank = tr.world, tr.rank
+    global_batch = per_chip_batch * world
+
+    config = resnet.ResNetConfig(num_classes=100)
+    params = resnet.init(jax.random.PRNGKey(0), config)  # deterministic: all ranks equal
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+    grad_fn = jax.jit(jax.value_and_grad(resnet.loss), static_argnums=1)
+    apply_fn = jax.jit(
+        lambda p, s, g: (lambda u, ns: (optax.apply_updates(p, u), ns))(*opt.update(g, s, p))
+    )
+
+    def local_batch(seed):
+        np.random.seed(seed)
+        imgs = np.random.randn(global_batch, image_size, image_size, 3).astype(np.float32)
+        lbls = np.random.randint(0, 100, (global_batch,))
+        lo = rank * per_chip_batch
+        return imgs[lo:lo + per_chip_batch], lbls[lo:lo + per_chip_batch]
+
+    imgs, lbls = local_batch(0)
+    loss, grads = grad_fn(params, config, imgs, lbls)  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        imgs, lbls = local_batch(i + 1)
+        loss, grads = grad_fn(params, config, imgs, lbls)
+        grads = grad_allreduce(tr, grads)      # the NCCL-role hop
+        params, opt_state = apply_fn(params, opt_state, grads)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    # global mean loss so every rank prints the single-process-comparable value
+    mean_loss = float(tr.allreduce(np.array([float(loss)], np.float32), mean=True)[0])
+    sps = steps * global_batch / dt
+    tr.barrier()
+    tr.close()
+    print(f"loss={mean_loss:.4f}")
+    print(f"samples_per_sec={sps:.1f}")
+    print(f"samples_per_sec_per_chip={sps / world:.1f}")
+    print(f"world size={world} global devices={world}")
+    print("transport=shim")
+    print("RESNET-DDP-OK")
+
+
 def main() -> None:
+    if os.environ.get("DDP_TRANSPORT") == "shim":
+        main_shim()
+        return
     _map_torch_env()
     from kubeflow_tpu.parallel.distributed import initialize
 

@@ -24,9 +24,11 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import multihead_attention, padding_mask
+from ..ops.flash_attention import flash_attention
 
 
 @dataclass(frozen=True)
@@ -40,9 +42,28 @@ class BertConfig:
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
     dtype: jnp.dtype = jnp.bfloat16
-    # rematerialize each encoder layer in backward (trade ~1/3 more FLOPs for
-    # O(L) → O(1) activation memory; lets batch 256 fit one v5e chip)
+    # rematerialize each encoder layer in backward (trade extra FLOPs for
+    # O(L) → O(1) activation memory; lets batch 1024 fit one v5e chip)
     remat: bool = False
+    # remat policy: "nothing" = full recompute (max memory savings, ~1/3 extra
+    # encoder FLOPs); "dots" = save matmul outputs that lack batch dims (the
+    # projections: qkv/out/mlp), recompute only elementwise + attention — the
+    # standard transformer sweet spot (recompute is cheap, memory stays O(1)
+    # in depth for the big [B,S,F] tensors)
+    # "nothing" | "dots" | "save_qkv" | "save_attn" (checkpoint_name-based:
+    # keep the named projection outputs, recompute the rest)
+    remat_policy: str = "nothing"
+    # attention impl in the encoder: "dense" materializes [B,H,S,T] logits
+    # (supports padding mask); "flash" uses the Pallas kernel
+    # (ops/flash_attention.py) whose custom VJP recomputes P blockwise —
+    # no [B,H,S,T] tensor ever hits HBM. Flash ignores the padding mask, so
+    # use it for packed/full-length pretraining batches.
+    attention: str = "dense"
+    # pipeline parallelism (SURVEY.md §2c PP row): >1 runs the encoder stack
+    # as a GPipe schedule over the `stages` mesh axis (parallel/pipeline.py);
+    # num_layers must divide into stages, batch into microbatches
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 4
 
     @property
     def head_dim(self) -> int:
@@ -90,6 +111,19 @@ SHARDING_RULES = (
     # everything else (lns, small biases): replicated
     (r".*", P()),
 )
+
+
+def pp_sharding_rules() -> tuple:
+    """SHARDING_RULES variant for pipeline parallelism: the layer-stack dim
+    (leading dim of every layers/* leaf) is pinned to the `stages` mesh axis,
+    so each stage's device block holds only its own layers."""
+    out = []
+    for pat, spec in SHARDING_RULES:
+        if pat.startswith("layers/"):
+            out.append((pat, P("stages", *tuple(spec))))
+        else:
+            out.append((pat, spec))
+    return tuple(out)
 
 
 # --------------------------------------------------------------------- init
@@ -160,14 +194,25 @@ def encode(params: dict, config: BertConfig, input_ids: jax.Array,
         x = x + emb["type"][0]
     x = _layer_norm(x.astype(dt), emb["ln_scale"], emb["ln_bias"], config.layer_norm_eps)
 
+    if config.attention == "flash" and attention_mask is not None:
+        raise ValueError(
+            "attention='flash' does not support a padding mask (the Pallas "
+            "kernel attends over the full block); pass attention_mask=None "
+            "with packed/full-length batches, or use attention='dense'"
+        )
     mask = padding_mask(attention_mask) if attention_mask is not None else None
 
     def layer(x, lp):
         xn = x
         qkv = jnp.einsum("bsh,hknd->bsknd", xn, lp["attn_qkv_kernel"].astype(dt))
         qkv = qkv + lp["attn_qkv_bias"].astype(dt)
+        qkv = checkpoint_name(qkv, "qkv")
         q, k_, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = multihead_attention(q, k_, v, mask=mask)
+        if config.attention == "flash":
+            attn = flash_attention(q, k_, v, causal=False)
+        else:
+            attn = multihead_attention(q, k_, v, mask=mask)
+        attn = checkpoint_name(attn, "attn_out")
         attn = jnp.einsum("bsnd,ndh->bsh", attn, lp["attn_out_kernel"].astype(dt))
         attn = attn + lp["attn_out_bias"].astype(dt)
         x = _layer_norm(x + attn, lp["ln1_scale"], lp["ln1_bias"], config.layer_norm_eps)
@@ -179,10 +224,41 @@ def encode(params: dict, config: BertConfig, input_ids: jax.Array,
         x = _layer_norm(x + hout, lp["ln2_scale"], lp["ln2_bias"], config.layer_norm_eps)
         return x, None
 
+    if config.pipeline_stages > 1:
+        # GPipe over the `stages` mesh axis: each stage scans its local
+        # layer slice; gpipe handles microbatching + remat per stage tick
+        from ..parallel.pipeline import gpipe, stack_stages
+
+        if mask is not None:
+            raise ValueError(
+                "pipeline_stages > 1 requires attention_mask=None (the mask "
+                "is full-batch shaped; microbatches would mis-slice it) — "
+                "use packed/full-length sequences under pipeline parallelism"
+            )
+        staged = stack_stages(params["layers"], config.pipeline_stages)
+
+        def stage(lp, xmb):
+            y, _ = jax.lax.scan(layer, xmb, lp)
+            return y
+
+        return gpipe(stage, staged, x, config.pipeline_microbatches,
+                     mb_spec=P(("data", "fsdp"), None, None), remat=config.remat,
+                     remat_policy=_remat_policy(config))
+
     if config.remat:
-        layer = jax.checkpoint(layer, policy=jax.checkpoint_policies.nothing_saveable)
+        layer = jax.checkpoint(layer, policy=_remat_policy(config))
     x, _ = jax.lax.scan(layer, x, params["layers"])
     return x
+
+
+def _remat_policy(config: BertConfig):
+    cp = jax.checkpoint_policies
+    return {
+        "nothing": cp.nothing_saveable,
+        "dots": cp.dots_with_no_batch_dims_saveable,
+        "save_qkv": cp.save_only_these_names("qkv"),
+        "save_attn": cp.save_only_these_names("qkv", "attn_out"),
+    }[config.remat_policy]
 
 
 def mlm_logits(params: dict, config: BertConfig, hidden: jax.Array) -> jax.Array:

@@ -74,7 +74,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, 
 
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    # lse is [1, block_q, 1]: the trailing singleton keeps the block's last two
+    # dims TPU-legal ((block_q, 1) = (divisible by 8, equal to array dim));
+    # a 2-D (1, block_q) block fails Mosaic's layout check on real hardware
+    lse_ref[0, :, 0] = m + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -94,15 +97,15 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
-    return out, lse
+    return out, lse[..., 0]
 
 
 # ---------------------------------------------------- backward (blockwise XLA)

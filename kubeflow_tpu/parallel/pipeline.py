@@ -1,0 +1,118 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``stages`` axis.
+
+Role (SURVEY.md §2c PP row: "jax stage-sharded scan / GSPMD ``stages`` axis
+across pod-slice sub-meshes").  TPU-first design — the schedule is pure GSPMD,
+no shard_map:
+
+  * layer stacks [L, ...] are regrouped into [S, L/S, ...] with the leading
+    stage dim sharded over ``stages`` (each device block holds its stage's
+    layers only — model memory scales 1/S);
+  * activations live in a shift register [S, mb, ...] whose stage dim is
+    sharded over ``stages``; each tick applies ``vmap``-ed stage compute (XLA
+    partitions the vmap spatially — every stage computes simultaneously) and
+    ``jnp.roll``s the register one stage forward, which XLA lowers to a
+    collective-permute over the ICI ring;
+  * because everything is jit-level GSPMD, PP composes freely with
+    data/fsdp/tensor/seq/expert shardings in the same step, and autodiff
+    derives the reverse schedule (grads ride the same ring backwards).
+
+Bubble accounting is the GPipe classic: (S-1)/(M+S-1) of ticks are warmup/
+drain — pick microbatches M >= 4·S to keep it under ~20%.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def num_stages(stage_params: Any) -> int:
+    return jax.tree.leaves(stage_params)[0].shape[0]
+
+
+def stack_stages(layer_params: Any, stages: int) -> Any:
+    """Regroup layer-stacked params [L, ...] → stage-stacked [S, L/S, ...].
+
+    The leading stage dim is what the model's PP sharding rules pin to the
+    ``stages`` mesh axis.
+    """
+
+    def regroup(leaf):
+        l = leaf.shape[0]
+        if l % stages:
+            raise ValueError(f"{l} layers not divisible into {stages} stages")
+        return leaf.reshape(stages, l // stages, *leaf.shape[1:])
+
+    return jax.tree.map(regroup, layer_params)
+
+
+def unstack_stages(stage_params: Any) -> Any:
+    """Inverse of stack_stages: [S, L/S, ...] → [L, ...]."""
+    return jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), stage_params)
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    num_microbatches: int,
+    mb_spec: Optional[P] = None,
+    remat: bool = True,
+    remat_policy: Optional[Callable] = None,
+) -> jax.Array:
+    """Run x [B, ...] through S pipeline stages, microbatched.
+
+    ``stage_fn(params_slice, x_mb) -> x_mb`` applies ONE stage (its params
+    slice has leading dim L/S); it must be shape-preserving on x and contain
+    only jit-level ops (sharding constraints fine, shard_map not — the
+    schedule vmaps it over the stage dim).
+
+    ``mb_spec``: PartitionSpec of one microbatch activation [mb, ...]
+    (defaults to batch over (data, fsdp)); the shift register is constrained
+    to P("stages", *mb_spec).
+    """
+    S = num_stages(stage_params)
+    M = num_microbatches
+    b = x.shape[0]
+    if b % M:
+        raise ValueError(f"batch {b} not divisible into {M} microbatches")
+    mb = b // M
+    if mb_spec is None:
+        mb_spec = P(("data", "fsdp"))
+    reg_spec = P("stages", *mb_spec)
+
+    if remat:
+        stage_fn = jax.checkpoint(
+            stage_fn,
+            policy=remat_policy or jax.checkpoint_policies.nothing_saveable,
+        )
+    vstage = jax.vmap(stage_fn)
+
+    xs = x.reshape(M, mb, *x.shape[1:])
+    state = jnp.zeros((S, mb) + x.shape[1:], x.dtype)
+    outs = jnp.zeros_like(xs)
+
+    def tick(carry, t):
+        state, outs = carry
+        # feed slot 0 (bubble ticks t >= M refeed the last microbatch; their
+        # output falls off the end of the schedule and is never read)
+        feed = jax.lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        state = jax.lax.dynamic_update_index_in_dim(state, feed.astype(state.dtype), 0, 0)
+        state = jax.lax.with_sharding_constraint(state, reg_spec)
+        state = vstage(stage_params, state)
+        state = jax.lax.with_sharding_constraint(state, reg_spec)
+        # collect the last stage's output for microbatch t-(S-1); warmup ticks
+        # write garbage to slot 0, overwritten when the real t=S-1 tick lands
+        out_t = jax.lax.index_in_dim(state, S - 1, 0, keepdims=False)
+        j = jnp.clip(t - (S - 1), 0, M - 1)
+        outs = jax.lax.dynamic_update_index_in_dim(outs, out_t, j, 0)
+        # shift register: stage s's output becomes stage s+1's next input
+        # (lowered to a collective-permute over the stages ring)
+        state = jnp.roll(state, 1, axis=0)
+        return (state, outs), None
+
+    (_, outs), _ = jax.lax.scan(tick, (state, outs), jnp.arange(M + S - 1))
+    return outs.reshape(b, *x.shape[1:])

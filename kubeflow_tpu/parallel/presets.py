@@ -44,7 +44,7 @@ def _flash(q, k, v, mesh=None, causal=True):
     return flash_attention(q, k, v, causal=causal)
 
 
-def get_preset(name: str, n_devices: int, tensor: int = 1) -> Preset:
+def get_preset(name: str, n_devices: int, tensor: int = 1, stages: int = 1) -> Preset:
     """Resolve a strategy name to a preset sized for n_devices."""
     if name in ("dp", "data"):
         return Preset(name, MeshConfig(data=n_devices, fsdp=1), _flash,
@@ -75,9 +75,15 @@ def get_preset(name: str, n_devices: int, tensor: int = 1) -> Preset:
     if name in ("moe-ep", "ep", "expert"):
         return Preset(name, MeshConfig(fsdp=1, expert=n_devices), _flash,
                       "expert parallel: MoE FFN dispatched over `expert`")
+    if name in ("pp", "pipeline"):
+        s = stages if stages > 1 else 2
+        if n_devices % s:
+            raise ValueError(f"stages={s} does not divide {n_devices} devices")
+        return Preset(name, MeshConfig(stages=s, fsdp=n_devices // s), _dense,
+                      "GPipe pipeline over `stages` (parallel/pipeline.py), fsdp within")
     raise ValueError(
         f"unknown parallelism preset {name!r}; "
-        "available: dp, fsdp, tp, ring-cp, ulysses, moe-ep"
+        "available: dp, fsdp, tp, pp, ring-cp, ulysses, moe-ep"
     )
 
 

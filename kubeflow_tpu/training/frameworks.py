@@ -128,12 +128,21 @@ class PyTorchJobController(JobController):
             rank = 0
         else:
             rank = index + (1 if has_master else 0)
+        # per-rank host list (rank order: Master then Workers) — the hostfile
+        # analogue the C++ transport shim (kubeflow_tpu/transport/) uses to
+        # dial its ring neighbor on multi-pod gangs
+        hosts = []
+        if has_master:
+            hosts.append(_host(job, "Master", 0))
+        for i in range(replicas.get("Worker", {}).get("replicas", 0)):
+            hosts.append(_host(job, "Worker", i))
         env = {
             "MASTER_ADDR": _host(job, "Master" if has_master else "Worker", 0),
             "MASTER_PORT": str(ports[0]),
             "WORLD_SIZE": str(world),
             "RANK": str(rank),
             "LOCAL_RANK": "0",
+            "TRANSPORT_HOSTS": ",".join(hosts),
         }
         elastic = job["spec"].get("elasticPolicy") or {}
         if elastic:

@@ -1,0 +1,11 @@
+"""Collective-transport shim: the NCCL-stand-in for the torch-compat path.
+
+SURVEY.md §2b NCCL row: the reference platform's DDP rides NCCL, a native
+collective library.  The TPU rebuild keeps "native stays native": ring
+allreduce/allgather/reduce-scatter implemented in C++ (transport_core.cc)
+over TCP between the gang's processes, bound via ctypes.
+"""
+
+from .transport import RingTransport, grad_allreduce
+
+__all__ = ["RingTransport", "grad_allreduce"]

@@ -20,16 +20,19 @@ _LOCK = threading.Lock()
 _CACHE: dict[str, ctypes.CDLL] = {}
 
 
-def build_native(src_path: str, prefix: str) -> str:
+def build_native(src_path: str, prefix: str, extra_flags: list[str] | None = None) -> str:
     """Compile ``src_path`` to ``<dir>/_<prefix>_<srchash>.so``; return the path."""
     src_dir = os.path.dirname(os.path.abspath(src_path))
     with open(src_path, "rb") as f:
-        tag = hashlib.md5(f.read()).hexdigest()[:10]
+        # flags are part of the key: a flag change (e.g. sanitizers) must not
+        # silently reuse a binary built without them
+        tag = hashlib.md5(f.read() + repr(extra_flags or []).encode()).hexdigest()[:10]
     so_path = os.path.join(src_dir, f"_{prefix}_{tag}.so")
     if os.path.exists(so_path):
         return so_path
     tmp = so_path + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-Wall", src_path, "-o", tmp]
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-Wall",
+           *(extra_flags or []), src_path, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True)
     except subprocess.CalledProcessError as e:
@@ -40,10 +43,10 @@ def build_native(src_path: str, prefix: str) -> str:
     return so_path
 
 
-def load_native(src_path: str, prefix: str) -> ctypes.CDLL:
+def load_native(src_path: str, prefix: str, extra_flags: list[str] | None = None) -> ctypes.CDLL:
     """Build (if needed) and dlopen; one CDLL per source file per process."""
     key = os.path.abspath(src_path)
     with _LOCK:
         if key not in _CACHE:
-            _CACHE[key] = ctypes.CDLL(build_native(src_path, prefix))
+            _CACHE[key] = ctypes.CDLL(build_native(src_path, prefix, extra_flags))
         return _CACHE[key]

@@ -1,0 +1,149 @@
+"""Pipeline parallelism (SURVEY.md §2c PP row, VERDICT r1 item 3).
+
+Correctness bar: GPipe over the `stages` axis produces the same outputs/loss
+as the plain single-stage layer scan, on the 8-device CPU mesh, and grads
+flow through the schedule (autodiff derives the reverse ring).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models import bert
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.pipeline import gpipe, stack_stages, unstack_stages
+
+
+def _toy_params(key, n_layers=4, d=16):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w": jax.random.normal(k1, (n_layers, d, d)) * 0.3,
+        "b": jax.random.normal(k2, (n_layers, d)) * 0.1,
+    }
+
+
+def _toy_layer(x, lp):
+    return jnp.tanh(x @ lp["w"] + lp["b"]), None
+
+
+def _toy_ref(params, x):
+    y, _ = jax.lax.scan(_toy_layer, x, params)
+    return y
+
+
+@pytest.mark.parametrize("stages,microbatches", [(2, 4), (4, 2), (2, 8)])
+def test_gpipe_matches_sequential(stages, microbatches):
+    params = _toy_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    mesh = build_mesh(MeshConfig(stages=stages, fsdp=8 // stages))
+
+    staged = stack_stages(params, stages)
+
+    def stage_fn(lp, xmb):
+        y, _ = jax.lax.scan(lambda c, l: _toy_layer(c, l), xmb, lp)
+        return y
+
+    with jax.set_mesh(mesh):
+        out = jax.jit(
+            lambda sp, x: gpipe(stage_fn, sp, x, microbatches, mb_spec=P(("data", "fsdp")))
+        )(staged, x)
+    ref = _toy_ref(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_stack_unstack_roundtrip():
+    params = _toy_params(jax.random.PRNGKey(0), n_layers=6)
+    staged = stack_stages(params, 3)
+    assert staged["w"].shape == (3, 2, 16, 16)
+    rt = unstack_stages(staged)
+    np.testing.assert_array_equal(np.asarray(rt["w"]), np.asarray(params["w"]))
+
+
+def test_gpipe_grads_flow():
+    params = _toy_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    mesh = build_mesh(MeshConfig(stages=2, fsdp=4))
+    staged = stack_stages(params, 2)
+
+    def stage_fn(lp, xmb):
+        y, _ = jax.lax.scan(lambda c, l: _toy_layer(c, l), xmb, lp)
+        return y
+
+    def pp_loss(sp):
+        return gpipe(stage_fn, sp, x, 4).sum()
+
+    def ref_loss(p):
+        return _toy_ref(p, x).sum()
+
+    with jax.set_mesh(mesh):
+        g_pp = jax.jit(jax.grad(pp_loss))(staged)
+    g_ref = jax.grad(ref_loss)(params)
+    np.testing.assert_allclose(
+        np.asarray(unstack_stages(g_pp)["w"]), np.asarray(g_ref["w"]), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_bert_pp_loss_matches_single_stage():
+    """VERDICT done bar: pp loss == single-stage loss on the 8-dev mesh."""
+    base = dict(vocab_size=256, hidden_size=32, num_layers=4, num_heads=4,
+                intermediate_size=64, max_position=32, dtype=jnp.float32)
+    cfg_ref = bert.BertConfig(**base)
+    cfg_pp = bert.BertConfig(**base, pipeline_stages=2, pipeline_microbatches=4)
+    params = bert.init(jax.random.PRNGKey(0), cfg_ref)
+
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 256)
+    labels = jnp.where(ids % 3 == 0, ids, -100)
+
+    mesh = build_mesh(MeshConfig(stages=2, fsdp=2, data=2))
+    from kubeflow_tpu.parallel.sharding import shard_params
+
+    sharded = shard_params(params, mesh, bert.pp_sharding_rules())
+    with jax.set_mesh(mesh):
+        loss_pp = jax.jit(
+            lambda p: bert.mlm_loss(p, cfg_pp, ids, labels)
+        )(sharded)
+        grads = jax.jit(jax.grad(lambda p: bert.mlm_loss(p, cfg_pp, ids, labels)))(sharded)
+    loss_ref = bert.mlm_loss(params, cfg_ref, ids, labels)
+    assert abs(float(loss_pp) - float(loss_ref)) < 1e-4, (float(loss_pp), float(loss_ref))
+    gnorm = float(optax.global_norm(grads))
+    assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_moe_transformer_composed_mesh_matches_unsharded():
+    """stages×seq×expert in ONE step: loss on the composed 8-dev mesh equals
+    the unsharded single-stage reference (same math, different layout)."""
+    from kubeflow_tpu.models import moe_transformer as mt
+
+    base = dict(vocab_size=128, d_model=32, n_layers=2, n_heads=4,
+                num_experts=2, top_k=1, capacity_factor=4.0, dtype=jnp.float32)
+    cfg_ref = mt.MoeTransformerConfig(**base)
+    cfg_pp = mt.MoeTransformerConfig(**base, pipeline_stages=2, pipeline_microbatches=2)
+    params = mt.init(jax.random.PRNGKey(0), cfg_ref)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 128)
+
+    loss_ref = float(mt.lm_loss(params, cfg_ref, toks))
+
+    mesh = build_mesh(MeshConfig(stages=2, fsdp=1, seq=2, expert=2))
+    from kubeflow_tpu.parallel.sharding import shard_params
+
+    sharded = shard_params(params, mesh, mt.SHARDING_RULES)
+    with jax.set_mesh(mesh):
+        loss_pp = float(jax.jit(lambda p: mt.lm_loss(p, cfg_pp, toks))(sharded))
+        grads = jax.jit(jax.grad(lambda p: mt.lm_loss(p, cfg_pp, toks)))(sharded)
+    assert abs(loss_pp - loss_ref) < 1e-4, (loss_pp, loss_ref)
+    gnorm = float(optax.global_norm(grads))
+    assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_pp_preset():
+    from kubeflow_tpu.parallel.presets import get_preset
+
+    p = get_preset("pp", 8, stages=4)
+    assert p.mesh.stages == 4 and p.mesh.fsdp == 2
+    with pytest.raises(ValueError):
+        get_preset("pp", 7, stages=2)

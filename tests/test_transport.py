@@ -1,0 +1,184 @@
+"""C++ ring-collective transport shim (SURVEY.md §2b NCCL row).
+
+Spawns real processes (the gang's shape) and checks collective numerics
+against numpy; sanitizer builds are exercised by `make asan/tsan` in
+kubeflow_tpu/transport/ (see test_sanitizer_builds).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.transport import RingTransport
+
+BASE_PORT = 24800
+
+
+def _worker(rank: int, world: int, port: int, q: mp.Queue) -> None:
+    try:
+        with RingTransport(rank, world, base_port=port) as tr:
+            rng = np.random.default_rng(rank)
+            x = rng.standard_normal(1000).astype(np.float32)
+            expect = np.sum(
+                [np.random.default_rng(r).standard_normal(1000).astype(np.float32)
+                 for r in range(world)],
+                axis=0,
+            )
+            got = tr.allreduce(x.copy())
+            np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+            rs = tr.reduce_scatter(x.copy())
+            base, rem = divmod(1000, world)
+            mine = (rank + 1) % world
+            lo = mine * base + min(mine, rem)
+            ln = base + (1 if mine < rem else 0)
+            np.testing.assert_allclose(rs, expect[lo:lo + ln], rtol=1e-5, atol=1e-5)
+
+            ag = tr.allgather(np.array([rank, rank * 2], np.int64))
+            np.testing.assert_array_equal(
+                ag, np.array([[r, r * 2] for r in range(world)], np.int64)
+            )
+
+            b = tr.broadcast(
+                np.full(17, rank, np.float32) if rank == 1 else np.zeros(17, np.float32),
+                root=1,
+            )
+            np.testing.assert_array_equal(b, np.full(17, 1, np.float32))
+            tr.barrier()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - failure path
+        q.put((rank, f"{type(e).__name__}: {e}"))
+
+
+@pytest.mark.parametrize("world", [2, 4, 3])
+def test_ring_collectives_multiprocess(world):
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = BASE_PORT + world * 10
+    procs = [ctx.Process(target=_worker, args=(r, world, port, q)) for r in range(world)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=60) for _ in range(world)]
+    for p in procs:
+        p.join(timeout=30)
+    assert all(msg == "ok" for _, msg in results), results
+
+
+def test_world_one_identity():
+    with RingTransport(0, 1) as tr:
+        x = np.arange(5, dtype=np.float32)
+        np.testing.assert_array_equal(tr.allreduce(x.copy()), x)
+        np.testing.assert_array_equal(tr.reduce_scatter(x.copy()), x)
+        tr.barrier()
+
+
+def worker_uneven(rank: int, world: int, port: int, q) -> None:
+    try:
+        with RingTransport(rank, world, base_port=port) as tr:
+            x = np.full(7, float(rank + 1), np.float32)
+            got = tr.allreduce(x)
+            np.testing.assert_allclose(got, np.full(7, 6.0, np.float32))
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, repr(e)))
+
+
+def test_uneven_sizes():
+    """n not divisible by world exercises the remainder chunk paths."""
+    world = 3
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=worker_uneven, args=(r, world, BASE_PORT + 500, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=60) for _ in range(world)]
+    for p in procs:
+        p.join(timeout=30)
+    assert all(msg == "ok" for _, msg in results), results
+
+
+def test_grad_allreduce_pytree():
+    """grad_allreduce flattens a pytree into one bucket and averages."""
+    from kubeflow_tpu.transport import grad_allreduce
+
+    with RingTransport(0, 1) as tr:
+        tree = {"a": np.ones((2, 3), np.float32), "b": [np.full(4, 2.0, np.float32)]}
+        out = grad_allreduce(tr, tree)
+        np.testing.assert_array_equal(out["a"], tree["a"])
+        np.testing.assert_array_equal(out["b"][0], tree["b"][0])
+
+
+@pytest.mark.slow
+def test_resnet_ddp_through_shim_matches_single_process(tmp_path):
+    """VERDICT r1 item 2 'done' bar: 4-process ResNet DDP through the
+    PyTorchJob reconcile path with gradient sync via the C++ shim; final
+    loss matches a single-process run on the same global batch."""
+    from kubeflow_tpu.core.cluster import Cluster
+    from kubeflow_tpu.training import api as tapi
+    from kubeflow_tpu.training.api import ReplicaSpec, job
+    from kubeflow_tpu.training.client import TrainingClient
+    from kubeflow_tpu.training.frameworks import install
+
+    wenv = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": "/root/repo",
+        "DDP_TRANSPORT": "shim",
+        "TRAIN_STEPS": "2",
+        "PER_CHIP_BATCH": "2",
+        "IMAGE_SIZE": "16",
+    }
+    cmd = [sys.executable, "-u", "-m", "kubeflow_tpu.examples.resnet_ddp_worker"]
+    c = Cluster(cpu_nodes=1)
+    install(c.api, c.manager)
+    try:
+        spec = job(
+            "PyTorchJob",
+            "resnet-ddp-shim",
+            {
+                "Master": ReplicaSpec(replicas=1, command=cmd, env=dict(wenv)),
+                "Worker": ReplicaSpec(replicas=3, command=cmd, env=dict(wenv)),
+            },
+        )
+        client = TrainingClient(c)
+        client.create_job(spec)
+        assert client.wait_for_job("PyTorchJob", "resnet-ddp-shim", timeout=600) == tapi.SUCCEEDED
+        logs = "\n".join(client.get_job_logs("PyTorchJob", "resnet-ddp-shim").values())
+        assert "transport=shim" in logs
+        assert "RESNET-DDP-OK" in logs
+        shim_losses = {
+            float(line.split("=")[1]) for line in logs.splitlines() if line.startswith("loss=")
+        }
+        assert len(shim_losses) == 1, f"ranks disagree: {shim_losses}"
+    finally:
+        c.shutdown()
+
+    # single-process reference on the SAME global batch (4 ranks × 2 = batch 8)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DDP_TRANSPORT="shim", RANK="0",
+               WORLD_SIZE="1", TRAIN_STEPS="2", PER_CHIP_BATCH="8", IMAGE_SIZE="16",
+               PYTHONPATH="/root/repo")
+    out = subprocess.run(
+        [sys.executable, "-u", "-m", "kubeflow_tpu.examples.resnet_ddp_worker"],
+        env=env, capture_output=True, text=True, timeout=400,
+    )
+    assert "RESNET-DDP-OK" in out.stdout, out.stderr[-2000:]
+    ref_loss = next(
+        float(line.split("=")[1]) for line in out.stdout.splitlines() if line.startswith("loss=")
+    )
+    # tolerance: batch-norm uses LOCAL batch statistics per rank (batch 2 here
+    # vs 8 in the reference run) — faithful torch-DDP semantics, small drift
+    assert abs(ref_loss - shim_losses.pop()) < 5e-2, (ref_loss, shim_losses)
+
+
+def test_sanitizer_builds():
+    """SURVEY.md §5: the C++ core must build under ASAN and TSAN."""
+    d = os.path.join(os.path.dirname(__file__), "..", "kubeflow_tpu", "transport")
+    for target in ("asan", "tsan"):
+        subprocess.run(["make", target], cwd=d, check=True, capture_output=True)
+    subprocess.run(["make", "clean"], cwd=d, check=True, capture_output=True)
