@@ -25,7 +25,8 @@ from typing import Optional
 
 import numpy as np
 
-from .model import DecoderConfig, decode_step, prefill, write_pages
+from .model import (DecoderConfig, decode_step, prefill, prefill_chunk,
+                    sample_tokens, write_pages)
 from .native import NativeBatcher
 
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
@@ -40,6 +41,10 @@ class EngineConfig:
     eos_id: int = -1           # -1: never stop early
     temperature: float = 0.0   # 0 = greedy
     seed: int = 0
+    # prompts longer than this are prefilled in page-aligned chunks of this
+    # size, one chunk per engine tick, so decode steps for active slots
+    # interleave with a long prefill instead of stalling behind it
+    prefill_chunk: int = 256
 
 
 @dataclasses.dataclass
@@ -71,14 +76,18 @@ class Engine:
                  c.n_kv_heads, c.head_dim)
         self.k_pool = jnp.zeros(shape, jnp.bfloat16)
         self.v_pool = jnp.zeros(shape, jnp.bfloat16)
+        if engine_config.prefill_chunk % engine_config.page_size != 0:
+            raise ValueError("prefill_chunk must be a multiple of page_size")
         self._requests: dict[int, _Pending] = {}
         self._slot_req: dict[int, int] = {}
+        self._prefilling: dict[int, int] = {}  # slot -> next prompt offset
         self._next_id = 0
         self._lock = threading.Lock()
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
-        self._rng = np.random.default_rng(engine_config.seed)
+        self._key = jax.random.PRNGKey(engine_config.seed)
+        self._sample_calls = 0
         self._jax = jax
         self._jnp = jnp
 
@@ -100,13 +109,6 @@ class Engine:
         """Submit a prompt; the Future resolves to a result dict."""
         if not tokens:
             raise ValueError("empty prompt")
-        if len(tokens) > PREFILL_BUCKETS[-1]:
-            # the prefill is bucketed; a longer prompt would overflow the
-            # largest bucket inside the loop thread and kill the engine
-            raise ValueError(
-                f"prompt of {len(tokens)} tokens exceeds the largest prefill "
-                f"bucket ({PREFILL_BUCKETS[-1]})"
-            )
         fut: Future = Future()
         with self._lock:
             rid = self._next_id
@@ -144,24 +146,85 @@ class Engine:
                 return b
         return PREFILL_BUCKETS[-1]
 
-    def _sample(self, logits: np.ndarray) -> np.ndarray:
-        if self.ec.temperature <= 0.0:
-            return np.argmax(logits, axis=-1).astype(np.int32)
-        z = logits / self.ec.temperature
-        z = z - z.max(axis=-1, keepdims=True)
-        p = np.exp(z)
-        p /= p.sum(axis=-1, keepdims=True)
-        return np.array(
-            [self._rng.choice(logits.shape[-1], p=p[i]) for i in range(logits.shape[0])],
-            np.int32,
+    def _next_key(self):
+        self._sample_calls += 1
+        return self._jax.random.fold_in(self._key, self._sample_calls)
+
+    def _sample_one(self, logits) -> int:
+        """Sample the first token from a [1, V] device logits array."""
+        tok = sample_tokens(logits, self._next_key(), self.ec.temperature)
+        return int(np.asarray(tok)[0])
+
+    def _prefill_tick(self, slot: int) -> None:
+        """Advance one slot's prefill by at most one chunk.
+
+        Short prompts (≤ prefill_chunk) run the single-shot bucketed prefill;
+        long ones process one page-aligned chunk per tick so the decode step
+        for already-active slots interleaves — no head-of-line stall.
+        """
+        jnp = self._jnp
+        rid = self._slot_req[slot]
+        pending = self._requests[rid]
+        plen = len(pending.tokens)
+        ps = self.ec.page_size
+        owned = self._pages_for(plen)
+        table_row = self.batcher.page_table()[slot]
+
+        if plen <= self.ec.prefill_chunk:
+            bucket = self._bucket(plen)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :plen] = pending.tokens
+            logits, pk, pv = prefill(
+                self.params, self.config, jnp.asarray(toks),
+                jnp.int32(plen), ps,
+            )
+            # prefill produced bucket/page_size pages; slot owns
+            # ceil(plen/page_size) — scatter only the owned prefix
+            self.k_pool, self.v_pool = write_pages(
+                self.k_pool, self.v_pool,
+                pk[:, :owned], pv[:, :owned], jnp.asarray(table_row[:owned]),
+            )
+            del self._prefilling[slot]
+            first = self._sample_one(logits)
+            pending.first_token_at = time.perf_counter()
+            self._commit(slot, first)
+            return
+
+        off = self._prefilling[slot]
+        C = self.ec.prefill_chunk
+        toks = np.zeros((1, C), np.int32)
+        chunk = pending.tokens[off:off + C]
+        toks[0, :len(chunk)] = chunk
+        first_page = off // ps
+        n_chunk_pages = C // ps
+        # pages past the owned range (final-chunk padding) scatter into the
+        # reserved trash page 0; reads past `length` are masked anyway
+        chunk_ids = np.zeros((n_chunk_pages,), np.int32)
+        real = max(0, min(owned - first_page, n_chunk_pages))
+        chunk_ids[:real] = table_row[first_page:first_page + real]
+        n_hist = first_page + n_chunk_pages
+        hist_ids = np.zeros((n_hist,), np.int32)
+        hreal = min(owned, n_hist)
+        hist_ids[:hreal] = table_row[:hreal]
+        logits, self.k_pool, self.v_pool = prefill_chunk(
+            self.params, self.config, jnp.asarray(toks), jnp.int32(off),
+            jnp.int32(plen), jnp.asarray(chunk_ids), jnp.asarray(hist_ids),
+            self.k_pool, self.v_pool, ps,
         )
+        if off + C >= plen:
+            del self._prefilling[slot]
+            first = self._sample_one(logits)
+            pending.first_token_at = time.perf_counter()
+            self._commit(slot, first)
+        else:
+            self._prefilling[slot] = off + C
 
     def _loop(self) -> None:
         jnp = self._jnp
         while self._running:
             did_work = False
 
-            # --- admission + prefill (C++ decides; Python runs the compute)
+            # --- admission: bookkeeping only (C++ decides; compute is below)
             while True:
                 admitted = self.batcher.admit()
                 if admitted is None:
@@ -174,46 +237,41 @@ class Engine:
                     self.batcher.release(slot)
                     continue
                 self._slot_req[slot] = rid
-                bucket = self._bucket(plen)
-                toks = np.zeros((1, bucket), np.int32)
-                toks[0, :plen] = pending.tokens[:plen]
-                logits, pk, pv = prefill(
-                    self.params, self.config, jnp.asarray(toks),
-                    jnp.int32(plen), self.ec.page_size,
-                )
-                page_ids = self.batcher.page_table()[slot][: self._pages_for(bucket)]
-                # prefill produced bucket/page_size pages; slot owns
-                # ceil(plen/page_size) — scatter only the owned prefix
-                owned = (plen + self.ec.page_size - 1) // self.ec.page_size
-                self.k_pool, self.v_pool = write_pages(
-                    self.k_pool, self.v_pool,
-                    pk[:, :owned], pv[:, :owned], jnp.asarray(page_ids[:owned]),
-                )
-                first = int(np.asarray(logits).argmax(-1)[0]) if self.ec.temperature <= 0 \
-                    else int(self._sample(np.asarray(logits))[0])
-                pending.first_token_at = time.perf_counter()
-                self._commit(slot, first)
+                self._prefilling[slot] = 0
 
-            # --- one decode step over all active slots
+            # --- one prefill chunk per prefilling slot
+            for slot in list(self._prefilling):
+                did_work = True
+                self._prefill_tick(slot)
+
+            # --- one decode step over slots whose prefill is complete
             active = self.batcher.active_mask()
-            if active.any():
+            decode_ready = [
+                s for s in range(self.ec.max_slots)
+                if active[s] and s in self._slot_req and s not in self._prefilling
+            ]
+            if decode_ready:
                 did_work = True
                 tokens = np.zeros((self.ec.max_slots,), np.int32)
-                for slot in range(self.ec.max_slots):
-                    rid = self._slot_req.get(slot)
-                    if active[slot] and rid is not None:
-                        gen = self._requests[rid].generated
-                        tokens[slot] = gen[-1] if gen else 0
+                for slot in decode_ready:
+                    gen = self._requests[self._slot_req[slot]].generated
+                    tokens[slot] = gen[-1] if gen else 0
+                seq_lens = np.array(self.batcher.seq_lens(), np.int32)
+                page_table = np.array(self.batcher.page_table(), np.int32)
+                for slot in self._prefilling:
+                    # mid-prefill slots must not be touched by the decode
+                    # step's KV write: route them to the trash page, len 0
+                    seq_lens[slot] = 0
+                    page_table[slot, :] = 0
                 logits, self.k_pool, self.v_pool = decode_step(
                     self.params, self.config, jnp.asarray(tokens),
-                    jnp.asarray(self.batcher.seq_lens()),
-                    jnp.asarray(self.batcher.page_table()),
+                    jnp.asarray(seq_lens), jnp.asarray(page_table),
                     self.k_pool, self.v_pool,
                 )
-                sampled = self._sample(np.asarray(logits))
-                for slot in range(self.ec.max_slots):
-                    if active[slot] and slot in self._slot_req:
-                        self._commit(slot, int(sampled[slot]))
+                sampled = np.asarray(
+                    sample_tokens(logits, self._next_key(), self.ec.temperature))
+                for slot in decode_ready:
+                    self._commit(slot, int(sampled[slot]))
 
             if not did_work:
                 self._wake.wait(timeout=0.02)

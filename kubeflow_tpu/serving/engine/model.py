@@ -201,6 +201,65 @@ def write_pages(k_pool, v_pool, paged_k, paged_v, page_ids):
     return k_pool.at[:, page_ids].set(paged_k), v_pool.at[:, page_ids].set(paged_v)
 
 
+@functools.partial(jax.jit, static_argnames=("config", "page_size"),
+                   donate_argnames=("k_pool", "v_pool"))
+def prefill_chunk(params, config: DecoderConfig, tokens, start, length,
+                  chunk_page_ids, hist_page_ids, k_pool, v_pool, page_size: int):
+    """Process one page-aligned chunk of a long prompt against the page pool.
+
+    Long prompts are prefilled in fixed-size chunks interleaved with decode
+    steps so a single long prefill never head-of-line-blocks the continuous
+    batcher (the stall Triton-class servers avoid with chunked prefill;
+    SURVEY.md §3.4 hot path).
+
+    tokens: [1, C] int32 chunk (padded past the prompt end); start: [] int32
+    offset of this chunk in the prompt; length: [] int32 total prompt length;
+    chunk_page_ids: [C/page_size] pool pages to scatter this chunk's KV into
+    (unowned tail slots point at the trash page 0); hist_page_ids: [H] pool
+    pages covering positions [0, start+C) — H is static, so each chunk index
+    compiles once and attention is O(start+C), not O(max_pages).
+
+    Returns (logits [1, vocab] at position length-1 — garbage unless this is
+    the final chunk — , k_pool, v_pool).
+    """
+    c = config
+    B, C = tokens.shape
+    H = hist_page_ids.shape[0]
+    T = H * page_size
+    positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]
+    x = params["embed"][tokens]
+    t_range = jnp.arange(T, dtype=jnp.int32)
+    # causal across chunks + clipped to the real prompt
+    mask = (t_range[None, None, :] <= positions[:, :, None]) & (t_range < length)[None, None, :]
+    for l in range(c.n_layers):
+        h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
+        k, v = _kv_proj(params, l, c, h, positions)
+        k_pool = k_pool.at[l, chunk_page_ids].set(
+            k.reshape(C // page_size, page_size, c.n_kv_heads, c.head_dim))
+        v_pool = v_pool.at[l, chunk_page_ids].set(
+            v.reshape(C // page_size, page_size, c.n_kv_heads, c.head_dim))
+        k_cache = k_pool[l, hist_page_ids].reshape(1, T, c.n_kv_heads, c.head_dim)
+        v_cache = v_pool[l, hist_page_ids].reshape(1, T, c.n_kv_heads, c.head_dim)
+        x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
+    x = _rms_norm(x, params["ln_out"], c.norm_eps)
+    last = jnp.clip(length - 1 - start, 0, C - 1)
+    logits = (x[jnp.arange(B), last] @ params["unembed"]).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
+@functools.partial(jax.jit, static_argnames=("temperature",))
+def sample_tokens(logits, key, temperature: float = 0.0):
+    """On-device sampling: [B, V] logits → [B] int32 tokens.
+
+    Greedy at temperature 0, else categorical with per-call key.  Keeping the
+    sample on-device means only B int32s cross the host boundary per decode
+    step instead of the [B, V] logits tensor (V can be 128k for Llama-3).
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
 # -------------------------------------------------------------------- decode
 
 

@@ -63,11 +63,22 @@ def test_native_batcher_rejects_pool_unfittable_prompt():
     b.close()
 
 
-def test_engine_rejects_prompt_over_largest_bucket(params):
-    eng = Engine(params, CFG, EngineConfig(max_slots=2, num_pages=4096, page_size=32, max_pages_per_slot=64))
+def test_chunked_prefill_long_prompt_matches_oracle(params):
+    """A prompt longer than prefill_chunk is prefilled in page-aligned chunks
+    (interleaved with decode); the generation must still equal the oracle,
+    including while a short request decodes concurrently."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        prefill_chunk=32,
+    ))
+    eng.start()
     try:
-        with pytest.raises(ValueError, match="prefill"):
-            eng.generate_async(list(range(1100)), 4)  # > 1024 bucket, fits pages
+        long_prompt = [(i * 7) % (CFG.vocab_size - 1) + 1 for i in range(75)]
+        short_prompt = [5, 7, 9]
+        f_long = eng.generate_async(long_prompt, 5)
+        f_short = eng.generate_async(short_prompt, 5)
+        assert f_long.result(timeout=180)["tokens"] == greedy_oracle(params, long_prompt, 5)
+        assert f_short.result(timeout=180)["tokens"] == greedy_oracle(params, short_prompt, 5)
     finally:
         eng.stop()
 
