@@ -26,7 +26,8 @@ from ..core.events import EventRecorder
 from ..training import api as tapi
 from ..utils.render import deep_map_strings
 from . import api as kapi
-from .metrics import observation
+from .metrics import parse_metrics, parse_tfevent_dir
+from .obslog import ObservationStore
 from .suggest import get_suggester
 
 _PLACEHOLDER = re.compile(r"\$\{trialParameters\.([\w\-]+)\}")
@@ -194,6 +195,9 @@ class ExperimentController:
                                 "primaryContainerName", "main"
                             ),
                             "runSpec": run_spec,
+                            "metricsCollectorSpec": copy.deepcopy(
+                                spec.get("metricsCollectorSpec",
+                                         {"collector": {"kind": "StdOut"}})),
                             **({"earlyStopping": spec["earlyStopping"]}
                                if spec.get("earlyStopping") else {}),
                         },
@@ -250,10 +254,64 @@ class SuggestionController:
 class TrialController:
     kind = "Trial"
 
-    def __init__(self, api: APIServer, log_reader: Callable[[str, str], str]):
+    def __init__(self, api: APIServer, log_reader: Callable[[str, str], str],
+                 store: Optional[ObservationStore] = None):
         self.api = api
         self.log_reader = log_reader
+        # db-manager equivalent: intermediate series persist here (WAL-backed
+        # when kfadm passes a path), not on Trial status / in pod logs
+        self.store = store if store is not None else ObservationStore()
         self.recorder = EventRecorder(api, "katib-trial-controller")
+        # per-(trial, pod) high-water marks: collection parses only NEW log
+        # bytes each reconcile instead of re-parsing from byte 0 (the round-1
+        # workaround the store removes)
+        self._log_offsets: dict[tuple[str, str], int] = {}
+
+    def _metric_names(self, trial: Obj) -> list[str]:
+        return [trial["spec"]["objective"]["objectiveMetricName"]] + list(
+            trial["spec"]["objective"].get("additionalMetricNames", [])
+        )
+
+    def _collect(self, trial: Obj, req: Request, final: bool = False) -> None:
+        """Pull-based metrics collection into the observation store.
+
+        The simulator's analogue of the injected metrics-collector sidecar
+        (SURVEY.md §2a metrics-collectors row): stdout/JSON lines from pod
+        logs, or TFEvent files when the trial carries a TFEvent
+        metricsCollectorSpec.  Incremental: only bytes past the per-pod
+        high-water mark are parsed; a trailing partial line is held back
+        until newline-terminated (unless ``final``).
+        """
+        name = trial["metadata"]["name"]
+        metric_names = self._metric_names(trial)
+        collector = (trial["spec"].get("metricsCollectorSpec") or {})
+        if collector.get("collector", {}).get("kind") == "TFEvent":
+            path = collector.get("source", {}).get("fileSystemPath", {}).get("path", "")
+            for metric, series in parse_tfevent_dir(path, metric_names).items():
+                have = self.store.count(name, metric)
+                for step, value in series[have:]:
+                    self.store.report(name, metric, value, step=step)
+            return
+        pods = self.api.list(
+            "Pod", namespace=req.namespace,
+            label_selector={tapi.LABEL_JOB_NAME: req.name},
+        )
+        for p in pods:
+            pod = p["metadata"]["name"]
+            log = self.log_reader(pod, req.namespace)
+            off = self._log_offsets.get((name, pod), 0)
+            new = log[off:]
+            if not final:
+                cut = new.rfind("\n")
+                if cut < 0:
+                    continue
+                new = new[:cut]
+                self._log_offsets[(name, pod)] = off + cut + 1
+            else:
+                self._log_offsets[(name, pod)] = off + len(new)
+            for metric, values in parse_metrics(new, metric_names).items():
+                for v in values:
+                    self.store.report(name, metric, v)
 
     def reconcile(self, req: Request) -> Optional[Result]:
         trial = self.api.try_get("Trial", req.name, req.namespace)
@@ -288,18 +346,14 @@ class TrialController:
             self.api.update_status(trial)
             return None
         if not has_condition(job_status, tapi.SUCCEEDED):
+            self._collect(trial, req)
             return self._maybe_early_stop(trial, status, req)
 
-        # job done: pull logs from all job pods, parse observation
-        metric_names = [trial["spec"]["objective"]["objectiveMetricName"]] + list(
-            trial["spec"]["objective"].get("additionalMetricNames", [])
-        )
-        pods = self.api.list(
-            "Pod", namespace=req.namespace,
-            label_selector={tapi.LABEL_JOB_NAME: req.name},
-        )
-        log = "\n".join(self.log_reader(p["metadata"]["name"], req.namespace) for p in pods)
-        obs = observation(log, metric_names)
+        # job done: one final collection pass, then build the observation
+        # from the store (the series outlives the pods — db-manager parity)
+        metric_names = self._metric_names(trial)
+        self._collect(trial, req, final=True)
+        obs = self.store.observation(req.name, metric_names)
         have = {m["name"] for m in obs["metrics"]}
         if trial["spec"]["objective"]["objectiveMetricName"] not in have:
             set_condition(status, kapi.FAILED, "True", "MetricsUnavailable",
@@ -318,8 +372,8 @@ class TrialController:
     def _maybe_early_stop(self, trial: Obj, status: dict, req: Request) -> Optional[Result]:
         """medianstop (upstream katib earlystopping): stop a running trial
         whose current objective is worse than the median of completed
-        siblings' final objectives.  Polls pod logs while running — the
-        pull-based analogue of the sidecar's intermediate observations."""
+        siblings' final objectives.  Queries the observation store (reconcile
+        already collected any new log lines into it) — no log re-parsing."""
         es = trial["spec"].get("earlyStopping") or {}
         if es.get("algorithmName") != "medianstop":
             return None
@@ -345,15 +399,10 @@ class TrialController:
         if len(finals) < min_trials:
             return Result(requeue_after=0.3)
 
-        pods = self.api.list(
-            "Pod", namespace=req.namespace,
-            label_selector={tapi.LABEL_JOB_NAME: req.name},
-        )
-        log = "\n".join(self.log_reader(p["metadata"]["name"], req.namespace) for p in pods)
-        obs = observation(log, [metric])
-        current = next((sign * m["latest"] for m in obs["metrics"] if m["name"] == metric), None)
-        if current is None:
+        latest = self.store.latest(req.name, metric)
+        if latest is None:
             return Result(requeue_after=0.3)
+        current = sign * latest
         finals.sort()
         median = finals[len(finals) // 2]
         if current >= median:
@@ -362,7 +411,7 @@ class TrialController:
         # stop: kill the job (pods cascade), keep the partial observation
         run_kind = trial["spec"]["runSpec"].get("kind", "TPUJob")
         self.api.try_delete(run_kind, req.name, req.namespace)
-        status["observation"] = obs
+        status["observation"] = self.store.observation(req.name, self._metric_names(trial))
         set_condition(status, kapi.EARLY_STOPPED, "True", "TrialEarlyStopped",
                       f"{metric}={sign * current} worse than median {sign * median}")
         set_condition(status, kapi.SUCCEEDED, "True", "TrialEarlyStopped", "stopped early")
@@ -373,12 +422,16 @@ class TrialController:
         return None
 
 
-def install(api: APIServer, manager, log_reader: Callable[[str, str], str]):
+def install(api: APIServer, manager, log_reader: Callable[[str, str], str],
+            store: Optional[ObservationStore] = None,
+            store_path: Optional[str] = None):
     """Register Katib CRDs + controllers on a Manager."""
     kapi.register(api)
+    if store is None:
+        store = ObservationStore(store_path)
     exp = ExperimentController(api)
     sug = SuggestionController(api)
-    trial = TrialController(api, log_reader)
+    trial = TrialController(api, log_reader, store)
     manager.add(exp, owns=("Trial", "Suggestion"))
     manager.add(sug, watches=((
         "Trial",

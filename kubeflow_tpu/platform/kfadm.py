@@ -80,7 +80,10 @@ class KfAdm:
         if name == "katib":
             from ..katib.controllers import install as katib_install
 
-            return katib_install(api, manager, self.cluster.logs)
+            return katib_install(
+                api, manager, self.cluster.logs,
+                store_path=os.path.join(self.cluster.workdir, "katib", "obslog.wal"),
+            )
         if name == "serving":
             from ..serving import install as serving_install
 

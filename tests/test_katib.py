@@ -11,7 +11,10 @@ from kubeflow_tpu.katib import api as kapi
 from kubeflow_tpu.katib.api import Parameter, experiment
 from kubeflow_tpu.katib.client import KatibClient
 from kubeflow_tpu.katib.controllers import install as katib_install, render_trial_spec
-from kubeflow_tpu.katib.metrics import observation, parse_metrics
+from kubeflow_tpu.katib.metrics import (TFEventWriter, observation, parse_metrics,
+                                        parse_tfevent_dir)
+from kubeflow_tpu.katib.obslog import ObservationStore
+from kubeflow_tpu.katib.service import KatibService
 from kubeflow_tpu.katib.suggest import algorithm_names, get_suggester
 from kubeflow_tpu.training.frameworks import install as training_install
 
@@ -216,7 +219,7 @@ def _sweep_spec(name, algorithm, max_trials, goal=None):
 def kcluster():
     c = Cluster(cpu_nodes=1)
     training_install(c.api, c.manager)
-    katib_install(c.api, c.manager, c.logs)
+    c.katib = katib_install(c.api, c.manager, c.logs)  # (exp, sug, trial) ctrls
     yield c
     c.shutdown()
 
@@ -265,6 +268,129 @@ def test_grid_exhaustion_ends_experiment(kcluster):
     assert exp["status"]["trialsSucceeded"] == 3  # the full 3-point grid
     reason = [c for c in exp["status"]["conditions"] if c["type"] == kapi.SUCCEEDED][0]["reason"]
     assert reason == "SuggestionEndReached"
+
+
+# -------------------------------------------------- observation-log store
+
+def test_observation_store_roundtrip_and_wal(tmp_path):
+    path = str(tmp_path / "obs.wal")
+    st = ObservationStore(path)
+    for i, v in enumerate([0.5, 0.7, 0.9]):
+        st.report("t1", "accuracy", v, step=i)
+    st.report("t1", "loss", 0.3)
+    st.report("t2", "accuracy", 0.4)
+    assert st.count("t1", "accuracy") == 3
+    assert st.get_log("t1", "accuracy") == [(0, 0.5), (1, 0.7), (2, 0.9)]
+    assert st.get_log("t1", "accuracy", start=2) == [(2, 0.9)]
+    assert st.latest("t1", "accuracy") == 0.9
+    assert st.latest("t1", "nope") is None
+    assert st.trials() == ["t1", "t2"]
+    assert st.metrics("t1") == ["accuracy", "loss"]
+    obs = st.observation("t1", ["accuracy"])
+    assert obs["metrics"][0] == {"name": "accuracy", "latest": 0.9, "min": 0.5, "max": 0.9}
+    st.close()
+
+    # durability: reopen replays the WAL
+    st2 = ObservationStore(path)
+    assert st2.get_log("t1", "accuracy") == [(0, 0.5), (1, 0.7), (2, 0.9)]
+    assert st2.trials() == ["t1", "t2"]
+    st2.close()
+
+    # crash-truncated tail is dropped, prefix survives
+    with open(path, "r+b") as f:
+        f.truncate(max(0, tmp_path.joinpath("obs.wal").stat().st_size - 7))
+    st3 = ObservationStore(path)
+    assert st3.count("t1", "accuracy") >= 2
+    st3.close()
+
+
+def test_tfevent_writer_parser_roundtrip(tmp_path):
+    w = TFEventWriter(str(tmp_path))
+    for step, (acc, loss) in enumerate([(0.6, 0.9), (0.8, 0.5), (0.9, 0.2)]):
+        w.scalar("accuracy", acc, step)
+        w.scalar("loss", loss, step)
+    w.close()
+    out = parse_tfevent_dir(str(tmp_path), ["accuracy", "loss"])
+    assert [s for s, _ in out["accuracy"]] == [0, 1, 2]
+    assert [round(v, 4) for _, v in out["accuracy"]] == [0.6, 0.8, 0.9]
+    assert [round(v, 4) for _, v in out["loss"]] == [0.9, 0.5, 0.2]
+    assert parse_tfevent_dir(str(tmp_path / "missing"), ["accuracy"]) == {"accuracy": []}
+
+
+SERIES_TRIAL_CODE = (
+    "import os\n"
+    "lr = float(os.environ['LR'])\n"
+    "for i, a in enumerate([0.5, 0.7, 0.9]):\n"
+    "    print(f'accuracy={a}', flush=True)\n"
+)
+
+
+def test_observation_series_survive_pod_gc_and_service(kcluster):
+    """Intermediate series land in the store (db-manager parity), survive pod
+    deletion, and the UI data endpoints serve them."""
+    client = KatibClient(kcluster)
+    spec = _sweep_spec("series", "random", max_trials=2)
+    spec["spec"]["trialTemplate"]["trialSpec"]["spec"]["replicaSpecs"]["Worker"]["template"][
+        "spec"]["containers"][0]["command"] = [sys.executable, "-u", "-c", SERIES_TRIAL_CODE]
+    client.create_experiment(spec)
+    assert client.wait_for_experiment("series", timeout=300) == kapi.SUCCEEDED
+
+    store = kcluster.katib[2].store
+    trials = client.list_trials("series")
+    assert len(trials) == 2
+    tname = trials[0]["metadata"]["name"]
+    series = store.get_log(tname, "accuracy")
+    assert [v for _, v in series] == [0.5, 0.7, 0.9]
+
+    # pod GC: delete every trial pod — the series must outlive them
+    for pod in kcluster.api.list("Pod"):
+        kcluster.api.try_delete("Pod", pod["metadata"]["name"], pod["metadata"].get("namespace", "default"))
+    kcluster.settle()
+    assert store.get_log(tname, "accuracy") == series
+
+    svc = KatibService(kcluster.api, store)
+    exps = svc.list_experiments()
+    assert [e["name"] for e in exps] == ["series"]
+    assert exps[0]["status"] == "Succeeded" and exps[0]["trialsSucceeded"] == 2
+    detail = svc.get_experiment("series")
+    assert detail["currentOptimalTrial"] is not None
+    assert len(detail["trials"]) == 2
+    tdetail = svc.get_trial(tname)
+    assert tdetail["status"] == "Succeeded"
+    assert tdetail["observationLog"]["accuracy"] == [
+        {"step": s, "value": v} for s, v in series]
+    assert svc.get_trial("missing") is None
+
+
+def test_tfevent_collector_trial_e2e(kcluster, tmp_path):
+    """A trial whose metrics come from TFEvent files, not stdout (SURVEY.md
+    §2a metrics-collectors row: tfevent-metricscollector)."""
+    logdir = str(tmp_path / "tb")
+    code = (
+        "import os, sys\n"
+        "sys.path.insert(0, os.environ['KFT_ROOT'])\n"
+        "from kubeflow_tpu.katib.metrics import TFEventWriter\n"
+        "w = TFEventWriter(os.environ['LOGDIR'])\n"
+        "for i, a in enumerate([0.55, 0.75]):\n"
+        "    w.scalar('accuracy', a, i)\n"
+        "w.close()\n"
+    )
+    client = KatibClient(kcluster)
+    spec = _sweep_spec("tfev", "random", max_trials=1)
+    container = spec["spec"]["trialTemplate"]["trialSpec"]["spec"]["replicaSpecs"]["Worker"][
+        "template"]["spec"]["containers"][0]
+    container["command"] = [sys.executable, "-u", "-c", code]
+    container["env"] += [{"name": "LOGDIR", "value": logdir},
+                        {"name": "KFT_ROOT", "value": str(__import__("pathlib").Path(__file__).parent.parent)}]
+    spec["spec"]["metricsCollectorSpec"] = {
+        "collector": {"kind": "TFEvent"},
+        "source": {"fileSystemPath": {"path": logdir}},
+    }
+    client.create_experiment(spec)
+    assert client.wait_for_experiment("tfev", timeout=300) == kapi.SUCCEEDED
+    store = kcluster.katib[2].store
+    tname = client.list_trials("tfev")[0]["metadata"]["name"]
+    assert [round(v, 4) for _, v in store.get_log(tname, "accuracy")] == [0.55, 0.75]
 
 
 def test_trial_metrics_unavailable_fails(kcluster):
