@@ -1,0 +1,201 @@
+"""Perf artifacts for the five BASELINE.md configs (VERDICT r1 item 4).
+
+Each sub-bench prints one JSON line and the runner aggregates them into
+``BENCH_CONFIGS_r{N}.json`` at the repo root.  BASELINE.md's table references
+that artifact.  The control-plane benches run on the in-process cluster
+(this box: 1 CPU — platform overhead is the measured quantity); the
+MFU/serving numbers come from bench.py / serving_bench.py on the real chip.
+
+Usage: python benchmarks/baseline_configs.py [mnist|katib|resnet|gemma|all]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _force_cpu():
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def bench_mnist() -> dict:
+    """BASELINE config[0]: TFJob MNIST CNN 1 worker through the reconcile
+    path; samples/s measured inside the worker, E2E wall around the job."""
+    _force_cpu()
+    from kubeflow_tpu.core.cluster import Cluster
+    from kubeflow_tpu.training import api as tapi
+    from kubeflow_tpu.training.api import ReplicaSpec, job
+    from kubeflow_tpu.training.client import TrainingClient
+    from kubeflow_tpu.training.frameworks import install
+
+    c = Cluster(cpu_nodes=1)
+    install(c.api, c.manager)
+    client = TrainingClient(c)
+    t0 = time.perf_counter()
+    client.create_job(job("TFJob", "mnist", {"Worker": ReplicaSpec(
+        replicas=1,
+        command=[sys.executable, "-u", "-m", "kubeflow_tpu.examples.mnist_worker"],
+        env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo",
+             "TRAIN_STEPS": "120", "BATCH_SIZE": "128"},
+    )}))
+    ok = client.wait_for_job("TFJob", "mnist", timeout=600) == tapi.SUCCEEDED
+    wall = time.perf_counter() - t0
+    log = c.logs("mnist-worker-0")
+    sps = 0.0
+    for line in log.splitlines():
+        if line.startswith("samples_per_sec="):
+            sps = float(line.split("=")[1])
+    c.shutdown()
+    return {"config": "tfjob_mnist_cnn_1worker", "ok": ok,
+            "samples_per_sec": sps, "e2e_wall_s": round(wall, 2)}
+
+
+def bench_katib() -> dict:
+    """BASELINE config[2]: Katib LR sweep — trials/hour through the full
+    experiment → suggestion → trial → TPUJob stack (real trial pods)."""
+    _force_cpu()
+    from kubeflow_tpu.core.cluster import Cluster
+    from kubeflow_tpu.katib import api as kapi
+    from kubeflow_tpu.katib.api import Parameter, experiment
+    from kubeflow_tpu.katib.client import KatibClient
+    from kubeflow_tpu.katib.controllers import install as katib_install
+    from kubeflow_tpu.training.frameworks import install as training_install
+
+    code = (
+        "import os\n"
+        "lr = float(os.environ['LR'])\n"
+        "print(f'accuracy={1.0 - (lr - 0.1) ** 2:.6f}')\n"
+    )
+    trial_spec = {
+        "apiVersion": "kubeflow.org/v1", "kind": "TPUJob",
+        "spec": {"replicaSpecs": {"Worker": {
+            "replicas": 1, "restartPolicy": "Never",
+            "template": {"spec": {"containers": [{
+                "name": "main",
+                "command": [sys.executable, "-u", "-c", code],
+                "env": [{"name": "LR", "value": "${trialParameters.lr}"}],
+            }]}},
+        }}},
+    }
+    n_trials = int(os.environ.get("KATIB_BENCH_TRIALS", "12"))
+    c = Cluster(cpu_nodes=1)
+    training_install(c.api, c.manager)
+    katib_install(c.api, c.manager, c.logs)
+    client = KatibClient(c)
+    t0 = time.perf_counter()
+    client.create_experiment(experiment(
+        "sweep", [Parameter("lr", "double", min=0.01, max=1.0)], trial_spec,
+        "accuracy", algorithm="random", max_trials=n_trials, parallel_trials=4,
+    ))
+    ok = client.wait_for_experiment("sweep", timeout=900) == kapi.SUCCEEDED
+    wall = time.perf_counter() - t0
+    exp = client.get_experiment("sweep")
+    done = exp["status"].get("trialsSucceeded", 0)
+    c.shutdown()
+    return {"config": "katib_lr_sweep", "ok": ok, "trials": done,
+            "wall_s": round(wall, 2),
+            "trials_per_hour": round(done / wall * 3600, 1)}
+
+
+def bench_resnet() -> dict:
+    """BASELINE config[1]: PyTorchJob ResNet DDP — samples/s at 1 worker vs
+    4 workers through the C++ transport shim; scaling efficiency.
+
+    NOTE this box has ONE CPU core: 4 workers time-slice it, so per-worker
+    throughput divides by ~4 and 'efficiency' measures platform overhead
+    only, not ICI scaling (no multi-chip hardware this round — BASELINE.md).
+    """
+    _force_cpu()
+    from kubeflow_tpu.core.cluster import Cluster
+    from kubeflow_tpu.training import api as tapi
+    from kubeflow_tpu.training.api import ReplicaSpec, job
+    from kubeflow_tpu.training.client import TrainingClient
+    from kubeflow_tpu.training.frameworks import install
+
+    def run(n_workers: int) -> float:
+        c = Cluster(cpu_nodes=1)
+        install(c.api, c.manager)
+        client = TrainingClient(c)
+        name = f"resnet{n_workers}"
+        env = {"PYTHONPATH": "/root/repo", "TRAIN_STEPS": "8",
+               "PER_CHIP_BATCH": "8", "IMAGE_SIZE": "32", "DDP_TRANSPORT": "shim"}
+        replicas = {"Master": ReplicaSpec(
+            replicas=1,
+            command=[sys.executable, "-u", "-m", "kubeflow_tpu.examples.resnet_ddp_worker"],
+            env=env,
+        )}
+        if n_workers > 1:
+            replicas["Worker"] = ReplicaSpec(
+                replicas=n_workers - 1,
+                command=[sys.executable, "-u", "-m", "kubeflow_tpu.examples.resnet_ddp_worker"],
+                env=env,
+            )
+        client.create_job(job("PyTorchJob", name, replicas))
+        ok = client.wait_for_job("PyTorchJob", name, timeout=900) == tapi.SUCCEEDED
+        sps = 0.0  # every rank prints the same GLOBAL samples/sec; read master's
+        for line in c.logs(f"{name}-master-0").splitlines():
+            if line.startswith("samples_per_sec="):
+                sps = float(line.split("=")[1])
+        c.shutdown()
+        return sps if ok else 0.0
+
+    one = run(1)
+    four = run(4)
+    return {"config": "pytorchjob_resnet_ddp", "samples_per_sec_1w": round(one, 2),
+            "samples_per_sec_4w_total": round(four, 2),
+            "scaling_efficiency_1cpu_box": round(four / (4 * one), 3) if one else 0.0,
+            "note": "1 physical CPU: 4 workers time-slice it; this measures platform+shim overhead, not ICI scaling"}
+
+
+def bench_gemma() -> dict:
+    """BASELINE config[4]: Gemma tune→eval→deploy pipeline E2E wall clock
+    (CI-tiny sizes; the DAG + executor + artifact path is what's measured)."""
+    _force_cpu()
+    from kubeflow_tpu.core.cluster import Cluster
+    from kubeflow_tpu.examples.gemma_pipeline import gemma_pipeline
+    from kubeflow_tpu.pipelines.client import Client
+
+    c = Cluster(cpu_nodes=1)
+    client = Client(c)
+    t0 = time.perf_counter()
+    run = client.create_run_from_pipeline_func(gemma_pipeline, arguments={
+        "vocab_size": 512, "d_model": 64, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 128, "steps": 30, "batch_size": 8, "seq_len": 32,
+    })
+    rec = run.wait(timeout=900)
+    wall = time.perf_counter() - t0
+    c.shutdown()
+    return {"config": "pipelines_gemma_tune_eval_deploy",
+            "ok": rec.get("phase") == "Succeeded", "e2e_wall_s": round(wall, 2)}
+
+
+BENCHES = {"mnist": bench_mnist, "katib": bench_katib,
+           "resnet": bench_resnet, "gemma": bench_gemma}
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    names = list(BENCHES) if which == "all" else [which]
+    results = []
+    for n in names:
+        r = BENCHES[n]()
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if which == "all":
+        out = {"results": results, "host": "1-cpu simulator box"}
+        with open(os.path.join(os.path.dirname(__file__), "..", "BENCH_CONFIGS_r02.json"), "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,12 +7,16 @@ multi-process ``jax.distributed`` rendezvous on localhost rather than a fake.
 
 Supported Pod surface: ``spec.initContainers`` (sequential), the first entry of
 ``spec.containers``, ``env``/``command``/``args``/``workingDir``,
-``restartPolicy`` (Always | OnFailure | Never), deletion → SIGTERM/SIGKILL.
+``restartPolicy`` (Always | OnFailure | Never), deletion → SIGTERM/SIGKILL,
+ConfigMap volumes (rendered as files under a per-pod root, with the k8s
+``$(VAR)`` dependent-env expansion so specs can reference the mount root),
+and ``POD_VOLUME_ROOT`` exported to the process.
 """
 
 from __future__ import annotations
 
 import os
+import re
 import signal
 import subprocess
 import tempfile
@@ -21,6 +25,8 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from .api import APIServer, NotFound, Obj
+
+_ENV_REF = re.compile(r"\$\(([A-Za-z_][A-Za-z0-9_]*)\)")
 
 
 @dataclass
@@ -37,6 +43,7 @@ class _PodRun:
     next_restart_at: float = 0.0
     terminating: bool = False
     kill_at: float = 0.0
+    volume_root: str = ""
 
 
 class LocalProcessKubelet:
@@ -110,6 +117,7 @@ class LocalProcessKubelet:
         run.log_path = os.path.join(self.logdir, f"{run.namespace}_{run.name}.log")
         self._runs[meta["uid"]] = run
         try:
+            self._render_volumes(pod, run)
             self._advance(run)
         except (ValueError, OSError) as e:
             self._set_status(
@@ -139,15 +147,52 @@ class LocalProcessKubelet:
         )
         return run
 
+    def _render_volumes(self, pod: Obj, run: _PodRun) -> None:
+        """Materialize ConfigMap volumes as files under a per-pod root.
+
+        Containers are plain processes here, so absolute ``mountPath``s are
+        re-rooted at ``<workdir>/pods/<uid>``; the process finds them via the
+        exported ``POD_VOLUME_ROOT`` (specs reference it with the k8s
+        ``$(VAR)`` dependent-env syntax, expanded in ``_spawn``).
+        """
+        spec = pod["spec"]
+        volumes = {v["name"]: v for v in spec.get("volumes", []) if "configMap" in v}
+        if not volumes:
+            return
+        run.volume_root = os.path.join(self.workdir, "pods", run.uid)
+        for container in list(spec.get("initContainers", [])) + spec["containers"]:
+            for mount in container.get("volumeMounts", []):
+                vol = volumes.get(mount["name"])
+                if vol is None:
+                    continue
+                cm = self.api.try_get("ConfigMap", vol["configMap"]["name"], run.namespace)
+                if cm is None:
+                    raise ValueError(
+                        f"pod {run.name}: ConfigMap {vol['configMap']['name']!r} not found")
+                target = run.volume_root + os.path.abspath(mount["mountPath"])
+                os.makedirs(target, exist_ok=True)
+                for key, content in (cm.get("data") or {}).items():
+                    with open(os.path.join(target, key), "w") as f:
+                        f.write(content)
+
     def _spawn(self, run: _PodRun, container: dict) -> subprocess.Popen:
         cmd = list(container.get("command", [])) + list(container.get("args", []))
         if not cmd:
             raise ValueError(f"pod {run.name}: container has no command (images are not pullable here)")
         env = dict(os.environ)
         env.update(self.base_env)
+        if run.volume_root:
+            env["POD_VOLUME_ROOT"] = run.volume_root
+        # k8s dependent-env semantics: $(VAR) in a value resolves against the
+        # base env plus PREVIOUSLY-declared container vars only — forward
+        # references stay verbatim, exactly like a real kubelet
         for e in container.get("env", []):
-            if "value" in e:  # valueFrom (fieldRef/secretKeyRef) not resolvable here
-                env[e["name"]] = str(e["value"])
+            if "value" not in e:  # valueFrom (fieldRef/secretKeyRef) not resolvable here
+                continue
+            value = str(e["value"])
+            if "$(" in value:
+                value = _ENV_REF.sub(lambda m: env.get(m.group(1), m.group(0)), value)
+            env[e["name"]] = value
         env.setdefault("POD_NAME", run.name)
         env.setdefault("POD_NAMESPACE", run.namespace)
         log = open(run.log_path, "ab")

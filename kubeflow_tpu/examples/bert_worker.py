@@ -1,0 +1,78 @@
+"""TPUJob/JAXJob BERT training worker with first-class auto-resume.
+
+Runs as the pod command of a TPUJob replica: joins the process group from the
+injected env, builds a (tiny-by-default) BERT MLM Trainer, and — the
+SURVEY.md §5 checkpoint-row contract — when the controller injected
+``CHECKPOINT_DIR`` (TPUJob ``spec.checkpoint.dir``), resumes from the newest
+checkpoint before training, so a gang restart continues from step N instead
+of step 0.  Prints Katib-style ``key=value`` metrics to stdout.
+
+``FAIL_AT_STEP``/``FAIL_MARKER`` simulate a mid-run preemption (exit 137,
+retryable under the ExitCode restart policy) exactly once — used by the
+auto-resume E2E test.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def main() -> None:
+    from kubeflow_tpu.parallel.distributed import initialize
+
+    initialize(local_device_count=int(os.environ.get("LOCAL_DEVICES", "1")))
+
+    import jax
+
+    from kubeflow_tpu.models import bert
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.train.data import synthetic_mlm_batches
+    from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
+
+    config = bert.BertConfig(
+        vocab_size=int(os.environ.get("VOCAB_SIZE", "512")),
+        hidden_size=int(os.environ.get("HIDDEN_SIZE", "64")),
+        num_layers=int(os.environ.get("NUM_LAYERS", "2")),
+        num_heads=int(os.environ.get("NUM_HEADS", "4")),
+        intermediate_size=int(os.environ.get("INTERMEDIATE_SIZE", "128")),
+        max_position=64,
+    )
+    steps = int(os.environ.get("TRAIN_STEPS", "20"))
+    batch_size = int(os.environ.get("BATCH_SIZE", "8"))
+
+    devices = jax.devices()
+    mesh = build_mesh(MeshConfig(fsdp=len(devices)), devices)
+    params = bert.init(jax.random.PRNGKey(0), config)
+
+    def loss_fn(p, b):
+        return bert.mlm_loss(p, config, b["input_ids"], b["labels"], b["attention_mask"])
+
+    trainer = Trainer(
+        loss_fn, params, mesh, bert.SHARDING_RULES,
+        TrainerConfig(
+            learning_rate=1e-3, warmup_steps=2, total_steps=steps + 2,
+            checkpoint_dir=os.environ.get("CHECKPOINT_DIR") or None,
+            checkpoint_every=int(os.environ.get("CHECKPOINT_EVERY", "1000")),
+        ),
+    )
+    # auto-resume: the platform contract for restarted gangs
+    resumed = trainer.restore_latest()
+    print(f"resumed_from={trainer.step_num}" if resumed else "resumed_from=0", flush=True)
+
+    fail_at = int(os.environ.get("FAIL_AT_STEP", "-1"))
+    marker = os.environ.get("FAIL_MARKER", "")
+    data = synthetic_mlm_batches(config.vocab_size, batch_size, seq_len=32)
+    while trainer.step_num < steps:
+        metrics = trainer.train_step(next(data))
+        print(f"step={trainer.step_num} loss={metrics['loss']:.4f}", flush=True)
+        if trainer.step_num == fail_at and marker and not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(137)  # simulated preemption: retryable under ExitCode
+    trainer.save()
+    trainer.block_until_ready()
+    trainer.finalize()
+    print(f"TRAIN-DONE step={trainer.step_num}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -63,6 +63,18 @@ def _default_experiment(obj: Obj) -> None:
     spec.setdefault("maxFailedTrialCount", 3)
     spec.setdefault("algorithm", {}).setdefault("algorithmName", "random")
     spec.setdefault("metricsCollectorSpec", {"collector": {"kind": "StdOut"}})
+    # NAS experiments (upstream nasConfig): expand the cell description into
+    # one categorical parameter per layer — the shape the enas suggester and
+    # the ${trialParameters.*} rendering already understand
+    nas = spec.get("nasConfig")
+    if nas and not spec.get("parameters"):
+        ops = [o.get("operationType", str(i)) for i, o in enumerate(nas.get("operations", []))]
+        layers = int(nas.get("graphConfig", {}).get("numLayers", 1))
+        spec["parameters"] = [
+            {"name": f"layer_{i}_op", "parameterType": "categorical",
+             "feasibleSpace": {"list": ops}}
+            for i in range(layers)
+        ]
 
 
 def register(api: APIServer) -> None:

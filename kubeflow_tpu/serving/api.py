@@ -139,6 +139,23 @@ def register(api: APIServer) -> None:
             validator=validate_runtime,
         )
     )
+    api.register_crd(
+        CRD(
+            group=GROUP,
+            version=RUNTIME_VERSION,
+            kind="TrainedModel",
+            plural="trainedmodels",
+            validator=_validate_trained_model,
+        )
+    )
+
+
+def _validate_trained_model(obj: Obj) -> None:
+    spec = obj.get("spec", {})
+    if not spec.get("inferenceService"):
+        raise Invalid("TrainedModel: spec.inferenceService required")
+    if not spec.get("model", {}).get("storageUri"):
+        raise Invalid("TrainedModel: spec.model.storageUri required")
 
 
 # ------------------------------------------------------------------ builders

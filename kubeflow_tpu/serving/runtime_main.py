@@ -153,6 +153,55 @@ def load_model(loader: str, name: str, model_dir: str) -> Model:
         jitted = jax.jit(apply_fn)
         return _FnModel(name, lambda instances: _np_list(jitted(params, _np(instances))))
 
+    if loader in ("tensorflow", "savedmodel"):
+        # TF-Serving-equivalent SavedModel path (SURVEY.md §2b TF-Serving
+        # row): serve a TF SavedModel's serving_default signature.  When
+        # tf2jax is present the graph is converted and jax.jit-compiled (the
+        # XLA/TPU path); otherwise TF's own runtime executes it (CPU in this
+        # image) — same protocol surface either way.
+        import numpy as np
+        import tensorflow as tf  # baked in (SURVEY.md §7 env notes)
+
+        # standard layout puts saved_model.pb one level down (a version or
+        # model subdirectory) — search recursively
+        sm_pb = None
+        for root, _, files in os.walk(model_dir):
+            if "saved_model.pb" in files:
+                sm_pb = os.path.join(root, "saved_model.pb")
+                break
+        if sm_pb is None:
+            raise FileNotFoundError(f"savedmodel: no saved_model.pb under {model_dir}")
+        loaded = tf.saved_model.load(os.path.dirname(sm_pb))
+        sig = loaded.signatures["serving_default"]
+        out_keys = sorted(sig.structured_outputs)
+        # serving signatures take keyword tensors; single-input models only
+        in_key = sorted(sig.structured_input_signature[1])[0]
+        in_spec = sig.structured_input_signature[1][in_key]
+
+        def _tf_predict(instances):
+            x = tf.constant(np.asarray(instances), dtype=in_spec.dtype)
+            out = sig(**{in_key: x})
+            return _np_list(out[out_keys[0]].numpy())
+
+        try:
+            # optional XLA path: tf2jax.convert returns (fn, params); not in
+            # this image, and conversion can reject captured variables — any
+            # failure falls back to TF's own runtime (same protocol surface)
+            import tf2jax
+
+            import jax
+
+            jax_fn, jax_params = tf2jax.convert(
+                tf.function(lambda x: sig(**{in_key: x})[out_keys[0]]),
+                np.zeros([1] + list(sig.inputs[0].shape)[1:],
+                         sig.inputs[0].dtype.as_numpy_dtype),
+            )
+            jitted = jax.jit(jax_fn)
+            return _FnModel(
+                name, lambda instances: _np_list(jitted(jax_params, _np(instances))[0]))
+        except Exception:
+            return _FnModel(name, _tf_predict)
+
     if loader == "jetstream":
         from .engine.serve import JetStreamModel
 

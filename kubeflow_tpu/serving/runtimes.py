@@ -62,6 +62,13 @@ def default_runtimes() -> list[Obj]:
             ["--loader", "jax"],
             tpu=True,
         ),
+        # TF-Serving-equivalent SavedModel path (SURVEY.md §2b row)
+        _runtime(
+            "kserve-tensorflow",
+            [{"name": "tensorflow", "autoSelect": True},
+             {"name": "savedmodel", "autoSelect": True}],
+            ["--loader", "tensorflow"],
+        ),
         _runtime(
             "kserve-sklearn",
             [{"name": "sklearn", "autoSelect": True}],

@@ -133,6 +133,14 @@ class Trainer:
         if self._ckpt:
             self._ckpt.save(self.step_num, {"params": self.params, "opt_state": self.opt_state})
 
+    def finalize(self) -> None:
+        """Flush in-flight async checkpoint writes — call before a clean
+        process exit, or the interpreter tears down Orbax's background
+        commit threads mid-write (a preemption kill skipping this is fine:
+        resume falls back to the last durable step)."""
+        if self._ckpt:
+            self._ckpt.wait()
+
     def restore_latest(self) -> bool:
         """Resume from the newest checkpoint; returns True if one existed."""
         if not self._ckpt:

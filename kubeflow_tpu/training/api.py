@@ -28,6 +28,8 @@ JOB_KINDS = {
     "TFJob": {"types": ("Chief", "Master", "PS", "Worker", "Evaluator"), "chief": "Chief"},
     "PyTorchJob": {"types": ("Master", "Worker"), "chief": "Master"},
     "MPIJob": {"types": ("Launcher", "Worker"), "chief": "Launcher"},
+    "MXJob": {"types": ("Scheduler", "Server", "Worker"), "chief": "Worker"},
+    "PaddleJob": {"types": ("Master", "Worker"), "chief": "Worker"},
     "XGBoostJob": {"types": ("Master", "Worker"), "chief": "Master"},
 }
 
@@ -63,7 +65,7 @@ def _validate_job(obj: Obj) -> None:
         if "template" not in rspec:
             raise Invalid(f"{kind}: replicaSpecs[{rtype}].template required")
         # single-coordinator replica types (upstream enforces one master)
-        if rtype in ("Master", "Chief", "Launcher") and rspec.get("replicas", 1) > 1:
+        if rtype in ("Master", "Chief", "Launcher", "Scheduler") and rspec.get("replicas", 1) > 1:
             raise Invalid(f"{kind}: replicaSpecs[{rtype}].replicas must be 1")
     run = spec.get("runPolicy", {})
     cpp = run.get("cleanPodPolicy", "None")

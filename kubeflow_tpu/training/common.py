@@ -78,6 +78,7 @@ class JobController:
         job = self._ensure_ports(job, replicas)
         status = job["status"]  # rebind: _ensure_ports returns a fresh copy
         self._ensure_pod_group(job, total)
+        self.prepare(job, replicas)
 
         pods_by_type: dict[str, list[Optional[Obj]]] = {}
         for rtype, rspec in replicas.items():
@@ -181,8 +182,9 @@ class JobController:
         if any_active and not has_condition(status, tapi.RUNNING):
             set_condition(status, tapi.RUNNING, "True", f"{self.kind}Running", "pods running")
             self.recorder.normal(job, "JobRunning", "all pods scheduled")
+        grow = self.maybe_grow(job, status)
         self.api.update_status(job)
-        return None
+        return grow
 
     # ------------------------------------------------------------- terminal
 
@@ -305,6 +307,7 @@ class JobController:
             c["env"] = existing + [
                 {"name": k, "value": str(v)} for k, v in cluster_env.items() if k not in names
             ]
+        self.mutate_pod(pod, job, rtype, index)
         return self.api.create(pod)
 
     def _pod_restart_policy(self, rspec: dict) -> str:
@@ -367,6 +370,19 @@ class JobController:
 
     def num_ports(self, total_replicas: int) -> int:
         return 1  # coordinator only; frameworks with per-task ports override
+
+    def prepare(self, job: Obj, replicas: dict) -> None:
+        """Hook: ensure framework-owned side objects (e.g. the MPIJob
+        hostfile ConfigMap) before any pod is created."""
+
+    def mutate_pod(self, pod: Obj, job: Obj, rtype: str, index: int) -> None:
+        """Hook: framework-specific pod surgery (volumes, mounts) before
+        the pod is POSTed."""
+
+    def maybe_grow(self, job: Obj, status: dict) -> Optional[Result]:
+        """Hook: elastic scale-UP decision, called at the end of a healthy
+        reconcile.  Return a Result to requeue for future growth."""
+        return None
 
     def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
         """Rendezvous env for one replica. Framework-specific."""

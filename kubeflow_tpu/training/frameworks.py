@@ -15,8 +15,10 @@ would emit the headless-Service DNS names created by the common controller.
 from __future__ import annotations
 
 import json
+import time
 
-from ..core.api import APIServer, Obj
+from ..core.api import AlreadyExists, APIServer, Obj, owner_reference
+from ..core.controller import Result
 from ..scheduler.topology import VARIANTS, chips_in
 from .common import JobController
 
@@ -67,6 +69,13 @@ class TPUJobController(JobController):
             # pick these up via parallel.profiling.maybe_trace
             env["TPU_PROFILE_DIR"] = profile.get("dir", "/tmp/tpu-profiles")
             env["TPU_PROFILE_STEPS"] = str(profile.get("steps", 5))
+        ckpt = job["spec"].get("checkpoint") or {}
+        if ckpt.get("dir"):
+            # first-class checkpoint/auto-resume (SURVEY.md §5 checkpoint
+            # row): runners restore_latest() on start when this is set, so a
+            # gang restart resumes from step N instead of step 0
+            env["CHECKPOINT_DIR"] = ckpt["dir"]
+            env["CHECKPOINT_EVERY"] = str(ckpt.get("everySteps", 1000))
         preset = job["spec"].get("parallelism") or {}
         if preset.get("preset"):
             env["TPU_PARALLELISM_PRESET"] = preset["preset"]
@@ -164,33 +173,171 @@ class PyTorchJobController(JobController):
         if current - 1 < floor:
             return False
         status.setdefault("elasticReplicas", {})["Worker"] = current - 1
+        status["lastElasticShrink"] = time.time()
         self.recorder.warning(
             job, "JobScaledDown",
             f"elastic: Worker[{index}] exit {rc}; world {current} -> {current - 1} (min {floor})",
         )
         return True
 
+    def maybe_grow(self, job: Obj, status: dict):
+        """Elastic scale-UP (SURVEY.md §5 failure row: ElasticPolicy + HPA):
+        after a cooldown since the last shrink, re-expand one worker at a
+        time back toward the spec count (capped by maxReplicas) — the
+        simulator's stand-in for HPA-driven growth when capacity returns."""
+        elastic = job["spec"].get("elasticPolicy") or {}
+        shrunk = (status.get("elasticReplicas") or {}).get("Worker")
+        # growth is opt-in (upstream: HPA attached to the elastic job)
+        if not elastic.get("scaleUp") or shrunk is None:
+            return None
+        desired = job["spec"]["replicaSpecs"]["Worker"].get("replicas", 1)
+        ceiling = min(desired, int(elastic.get("maxReplicas", desired)))
+        if shrunk >= ceiling:
+            return None
+        cooldown = float(elastic.get("scaleUpCooldownSeconds", 1.0))
+        since = time.time() - float(status.get("lastElasticShrink", 0))
+        if since < cooldown:
+            return Result(requeue_after=cooldown - since + 0.05)
+        grown = shrunk + 1
+        if grown >= ceiling and ceiling == desired:
+            # fully recovered: drop the override entirely
+            status.pop("elasticReplicas", None)
+        else:
+            # maxReplicas < spec count: the override must PERSIST at the
+            # ceiling or effective_replicas would jump back to the spec count
+            status["elasticReplicas"]["Worker"] = min(grown, ceiling)
+        status["lastElasticShrink"] = time.time()  # pace successive grows
+        self.recorder.normal(
+            job, "JobScaledUp", f"elastic: world {shrunk} -> {grown} (ceiling {ceiling})"
+        )
+        return Result(requeue_after=0.05)
+
 
 class MPIJobController(JobController):
-    """MPIJob: launcher + workers; hostfile-style env for the launcher."""
+    """MPIJob: launcher-runs-mpirun semantics.
+
+    Upstream (SURVEY.md §2a MPIJob row): the controller renders a hostfile
+    ConfigMap mounted into the Launcher pod; the launcher execs ``mpirun``
+    against the Workers; job success is launcher success.  Here the hostfile
+    ConfigMap is a real object the kubelet renders to a file under
+    ``POD_VOLUME_ROOT`` (referenced via k8s ``$(VAR)`` env expansion), and
+    the ip:port dial list for the simulator's transport shim rides MPI_HOSTS.
+    """
 
     kind = "MPIJob"
 
+    HOSTFILE_MOUNT = "/etc/mpi"
+
     def num_ports(self, total: int) -> int:
         return total
+
+    def _hostfile_name(self, job: Obj) -> str:
+        return f"{job['metadata']['name']}-hostfile"
+
+    def prepare(self, job: Obj, replicas: dict) -> None:
+        """Ensure the hostfile ConfigMap (upstream: one per MPIJob)."""
+        name = job["metadata"]["name"]
+        n_workers = replicas.get("Worker", {}).get("replicas", 0)
+        slots = int((job["spec"].get("mpiImplementation") or {}).get("slotsPerWorker", 1)) \
+            if isinstance(job["spec"].get("mpiImplementation"), dict) else \
+            int(job["spec"].get("slotsPerWorker", 1))
+        hostfile = "\n".join(
+            f"{self.pod_name(job, 'Worker', i)} slots={slots}" for i in range(n_workers)
+        )
+        ns = job["metadata"].get("namespace", "default")
+        existing = self.api.try_get("ConfigMap", self._hostfile_name(job), ns)
+        if existing is None:
+            try:
+                self.api.create({
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {
+                        "name": self._hostfile_name(job),
+                        "namespace": ns,
+                        "ownerReferences": [owner_reference(job)],
+                    },
+                    "data": {"hostfile": hostfile},
+                })
+            except AlreadyExists:
+                pass
+        elif existing.get("data", {}).get("hostfile") != hostfile:
+            # worker count changed (scale): re-render, don't serve stale hosts
+            existing["data"] = {"hostfile": hostfile}
+            self.api.update(existing)
+
+    def mutate_pod(self, pod: Obj, job: Obj, rtype: str, index: int) -> None:
+        if rtype != "Launcher":
+            return
+        pod["spec"].setdefault("volumes", []).append(
+            {"name": "mpi-hostfile", "configMap": {"name": self._hostfile_name(job)}}
+        )
+        c = pod["spec"]["containers"][0]
+        c.setdefault("volumeMounts", []).append(
+            {"name": "mpi-hostfile", "mountPath": self.HOSTFILE_MOUNT}
+        )
 
     def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
         ports = self.ports_of(job)
         n_workers = replicas.get("Worker", {}).get("replicas", 0)
         hosts = [f"{_host(job, 'Worker', i)}:{ports[i]}" for i in range(n_workers)]
         env = {
-            "OMPI_MCA_orte_default_hostfile_contents": "\n".join(hosts),
             "MPI_HOSTS": ",".join(hosts),
             "MPI_NUM_WORKERS": str(n_workers),
         }
+        if rtype == "Launcher":
+            # k8s dependent-env expansion: the kubelet substitutes $(...)
+            hostfile = f"$(POD_VOLUME_ROOT){self.HOSTFILE_MOUNT}/hostfile"
+            env["OMPI_MCA_orte_default_hostfile"] = hostfile
+            env["MPI_HOSTFILE"] = hostfile
         if rtype == "Worker":
             env["MPI_WORKER_ID"] = str(index)
             env["MPI_WORKER_PORT"] = str(ports[index])
+        return env
+
+
+class MXJobController(JobController):
+    """MXJob: DMLC parameter-server rendezvous (scheduler/server/worker)."""
+
+    kind = "MXJob"
+
+    def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
+        ports = self.ports_of(job)
+        env = {
+            "DMLC_PS_ROOT_URI": _host(job, "Scheduler", 0),
+            "DMLC_PS_ROOT_PORT": str(ports[0]),
+            "DMLC_NUM_SERVER": str(replicas.get("Server", {}).get("replicas", 0)),
+            "DMLC_NUM_WORKER": str(replicas.get("Worker", {}).get("replicas", 0)),
+            "DMLC_ROLE": rtype.lower(),
+        }
+        if rtype == "Worker":
+            env["DMLC_WORKER_ID"] = str(index)
+        return env
+
+
+class PaddleJobController(JobController):
+    """PaddleJob: collective-mode trainer endpoints rendezvous."""
+
+    kind = "PaddleJob"
+
+    def num_ports(self, total: int) -> int:
+        return total
+
+    def set_cluster_spec(self, job: Obj, rtype: str, index: int, replicas: dict) -> dict[str, str]:
+        ports = self.ports_of(job)
+        has_master = "Master" in replicas
+        n_workers = replicas.get("Worker", {}).get("replicas", 0)
+        endpoints = [f"{_host(job, 'Worker', i)}:{ports[i]}" for i in range(n_workers)]
+        rank = 0 if rtype == "Master" else index
+        env = {
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_TRAINERS_NUM": str(n_workers),
+            "PADDLE_TRAINER_ID": str(rank),
+            "TRAINING_ROLE": "TRAINER",
+        }
+        if rtype == "Worker":
+            env["PADDLE_CURRENT_ENDPOINT"] = endpoints[index]
+        if has_master:
+            env["PADDLE_MASTER"] = f"{_host(job, 'Master', 0)}:{ports[n_workers] if len(ports) > n_workers else ports[0]}"
         return env
 
 
@@ -217,6 +364,8 @@ ALL_CONTROLLERS = (
     TFJobController,
     PyTorchJobController,
     MPIJobController,
+    MXJobController,
+    PaddleJobController,
     XGBoostJobController,
 )
 

@@ -224,6 +224,7 @@ def kcluster():
     c.shutdown()
 
 
+@pytest.mark.slow
 def test_experiment_random_lr_sweep_e2e(kcluster):
     client = KatibClient(kcluster)
     client.create_experiment(_sweep_spec("sweep", "random", max_trials=5))
@@ -244,6 +245,7 @@ def test_experiment_random_lr_sweep_e2e(kcluster):
     assert got == best_seen
 
 
+@pytest.mark.slow
 def test_experiment_goal_early_stop(kcluster):
     client = KatibClient(kcluster)
     # accuracy at lr in [0.01,1.0] is >= 1-(0.9)^2 = 0.19; goal 0.0 met by any trial
@@ -402,3 +404,53 @@ def test_trial_metrics_unavailable_fails(kcluster):
     spec["spec"]["maxFailedTrialCount"] = 1
     client.create_experiment(spec)
     assert client.wait_for_experiment("nometrics", timeout=300) == kapi.FAILED
+
+
+# ------------------------------------------------------------------- NAS
+
+def test_enas_converges_to_good_ops():
+    """ENAS REINFORCE controller: reward = fraction of edges set to 'conv3';
+    after a few rounds the policy must clearly beat uniform-random (0.25)."""
+    exp = experiment(
+        "nas",
+        [Parameter(f"layer_{i}_op", "categorical", list=["conv3", "conv5", "skip", "pool"])
+         for i in range(4)],
+        {"kind": "TPUJob", "spec": {}}, "acc", algorithm="enas",
+        algorithm_settings={"random_state": 0},
+    )
+    trials = []
+    for _ in range(12):
+        for arch in get_suggester("enas").suggest(exp, trials, 3):
+            acc = sum(v == "conv3" for v in arch.values()) / 4
+            trials.append(fake_trial(arch, acc, "acc"))
+    final = get_suggester("enas").suggest(exp, trials, 10)
+    frac = np.mean([sum(v == "conv3" for v in a.values()) / 4 for a in final])
+    assert frac >= 0.6, f"policy fraction {frac} (random would be 0.25)"
+    # determinism: same history → same proposals
+    assert final == get_suggester("enas").suggest(exp, trials, 10)
+
+
+def test_nas_config_expands_to_parameters():
+    """Upstream-style spec.nasConfig expands into categorical edge params."""
+    from kubeflow_tpu.core.api import APIServer
+
+    api = APIServer()
+    kapi.register(api)
+    obj = {
+        "apiVersion": kapi.API_VERSION,
+        "kind": "Experiment",
+        "metadata": {"name": "nascfg"},
+        "spec": {
+            "objective": {"type": "maximize", "objectiveMetricName": "acc"},
+            "algorithm": {"algorithmName": "enas"},
+            "nasConfig": {
+                "graphConfig": {"numLayers": 3},
+                "operations": [{"operationType": "conv3"}, {"operationType": "skip"}],
+            },
+            "trialTemplate": {"trialSpec": {"kind": "TPUJob", "spec": {}}},
+        },
+    }
+    created = api.create(obj)
+    params = created["spec"]["parameters"]
+    assert [p["name"] for p in params] == ["layer_0_op", "layer_1_op", "layer_2_op"]
+    assert params[0]["feasibleSpace"]["list"] == ["conv3", "skip"]

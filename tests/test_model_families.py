@@ -15,6 +15,7 @@ from kubeflow_tpu.models import decoder, mnist, resnet
 # -------------------------------------------------------------------- mnist
 
 
+@pytest.mark.slow
 def test_mnist_cnn_learns():
     config = mnist.MnistConfig()
     params = mnist.init(jax.random.PRNGKey(0), config)
@@ -41,6 +42,7 @@ def test_mnist_cnn_learns():
 # ------------------------------------------------------------------- resnet
 
 
+@pytest.mark.slow
 def test_resnet50_shapes_and_step():
     config = resnet.ResNetConfig(num_classes=10)
     params = resnet.init(jax.random.PRNGKey(0), config)
@@ -59,6 +61,7 @@ def test_resnet50_shapes_and_step():
     assert float(gnorm) > 0
 
 
+@pytest.mark.slow
 def test_resnet_ddp_worker_runs_multiprocess(tmp_path):
     """BASELINE config[1] shape: 2-worker DDP through the PyTorchJob path."""
     from kubeflow_tpu.core.cluster import Cluster

@@ -80,8 +80,8 @@ def test_sharding_rules_cover_bert(tiny_params):
 
 @pytest.mark.parametrize("mesh_cfg", [
     MeshConfig(data=1, fsdp=8, tensor=1),
-    MeshConfig(data=2, fsdp=2, tensor=2),
-    MeshConfig(data=1, fsdp=2, seq=1, tensor=4),
+    pytest.param(MeshConfig(data=2, fsdp=2, tensor=2), marks=pytest.mark.slow),
+    pytest.param(MeshConfig(data=1, fsdp=2, seq=1, tensor=4), marks=pytest.mark.slow),
 ])
 def test_trainer_loss_decreases_on_mesh(mesh_cfg):
     mesh = build_mesh(mesh_cfg, jax.devices()[:8])
@@ -97,6 +97,7 @@ def test_trainer_loss_decreases_on_mesh(mesh_cfg):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_sharded_equals_single_device():
     """Same init, same data: 2x2x2 mesh result == single-device result."""
     params = bert.init(jax.random.PRNGKey(0), TINY)
@@ -117,6 +118,7 @@ def test_sharded_equals_single_device():
     assert abs(results[0] - results[1]) < 1e-2, results
 
 
+@pytest.mark.slow
 def test_checkpoint_save_restore(tmp_path):
     params = bert.init(jax.random.PRNGKey(0), TINY)
     mesh = build_mesh(MeshConfig(data=1, fsdp=2, tensor=1), jax.devices()[:2])

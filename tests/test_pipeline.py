@@ -88,6 +88,7 @@ def test_gpipe_grads_flow():
     )
 
 
+@pytest.mark.slow
 def test_bert_pp_loss_matches_single_stage():
     """VERDICT done bar: pp loss == single-stage loss on the 8-dev mesh."""
     base = dict(vocab_size=256, hidden_size=32, num_layers=4, num_heads=4,

@@ -41,6 +41,7 @@ def test_profile_provisions_namespace_rbac_quota(platform):
     assert cluster.api.try_get("Namespace", "team-ml") is None
 
 
+@pytest.mark.slow
 def test_kfam_bindings_and_namespace_listing(platform):
     cluster, _ = platform
     cluster.api.create(papi.profile("ns-a", "owner@x.com"))
@@ -56,6 +57,7 @@ def test_kfam_bindings_and_namespace_listing(platform):
         kfam.create_binding("missing-ns", "x@x.com")
 
 
+@pytest.mark.slow
 def test_notebook_runs_and_culls(platform):
     cluster, _ = platform
     spawner = Spawner(cluster.api)
@@ -127,6 +129,7 @@ def test_poddefaults_injects_env_and_volumes(platform):
     assert "env" not in pod2["spec"]["containers"][0] or not pod2["spec"]["containers"][0]["env"]
 
 
+@pytest.mark.slow
 def test_dashboard_aggregates(platform):
     cluster, _ = platform
     cluster.api.create(papi.profile("dash-ns", "dash@x.com"))

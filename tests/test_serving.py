@@ -292,3 +292,112 @@ def test_isvc_scale_to_zero_and_activation(scluster):
     t.start()
     assert c.wait_for(lambda: "out" in result, timeout=60), _debug(c, "zero")
     assert result["out"] == {"predictions": [6]}
+
+
+# ------------------------------------------------------------------ agent
+
+def test_request_batcher_coalesces_concurrent_predicts():
+    """KServe agent batcher: N concurrent single predicts coalesce into few
+    batched model calls with order-correct fan-out."""
+    import threading
+
+    from kubeflow_tpu.serving.agent import RequestBatcher
+    from kubeflow_tpu.serving.server import Model
+
+    class Doubler(Model):
+        calls = 0
+
+        def predict(self, payload, headers=None):
+            Doubler.calls += 1
+            return {"predictions": [2 * x for x in payload["instances"]]}
+
+    b = RequestBatcher(Doubler("d"), max_batch_size=4, max_latency=0.05)
+    b.load()
+    results = {}
+
+    def one(i):
+        results[i] = b.predict({"instances": [i]})["predictions"][0]
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {i: 2 * i for i in range(8)}
+    assert Doubler.calls <= 4  # 8 singles coalesced (perfect would be 2)
+    assert b.batches_predicted == Doubler.calls
+
+
+def test_payload_logger_emits_request_and_response():
+    from kubeflow_tpu.serving.agent import PayloadLogger
+    from kubeflow_tpu.serving.server import Model
+
+    class Echo(Model):
+        def predict(self, payload, headers=None):
+            return {"predictions": payload["instances"]}
+
+    records = []
+    m = PayloadLogger(Echo("e"), sink=records.append)
+    m.load()
+    m.predict({"instances": [1, 2]})
+    m.predict({"instances": [3]})
+    assert [r["type"] for r in records] == ["request", "response", "request", "response"]
+    assert records[0]["id"] == records[1]["id"] != records[2]["id"]
+    assert records[1]["payload"] == {"predictions": [1, 2]}
+
+
+def test_model_puller_syncs_trained_models(tmp_path):
+    """Multi-model puller: TrainedModel objects drive download/load/unload."""
+    from kubeflow_tpu.core.api import APIServer
+    from kubeflow_tpu.serving import api as sapi
+    from kubeflow_tpu.serving.agent import ModelPuller
+
+    api = APIServer()
+    sapi.register(api)
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "weights.txt").write_text("v1")
+    loaded, removed = {}, []
+    puller = ModelPuller(api, "llm", str(tmp_path / "repo"),
+                         add_model=lambda n, d: loaded.__setitem__(n, d),
+                         remove_model=removed.append)
+
+    api.create({"apiVersion": "serving.kserve.io/v1alpha1", "kind": "TrainedModel",
+                "metadata": {"name": "m1"},
+                "spec": {"inferenceService": "llm",
+                         "model": {"storageUri": f"file://{src}"}}})
+    api.create({"apiVersion": "serving.kserve.io/v1alpha1", "kind": "TrainedModel",
+                "metadata": {"name": "other"},
+                "spec": {"inferenceService": "not-llm",
+                         "model": {"storageUri": f"file://{src}"}}})
+    assert puller.sync()
+    assert list(loaded) == ["m1"] and "other" not in loaded
+    import os
+    assert os.path.exists(os.path.join(loaded["m1"], "weights.txt"))
+    assert not puller.sync()  # level-triggered: no change, no work
+
+    api.try_delete("TrainedModel", "m1", "default")
+    assert puller.sync()
+    assert removed == ["m1"]
+
+
+@pytest.mark.slow
+def test_savedmodel_loader_serves_tf_signature(tmp_path):
+    """TF-Serving-equivalent path (SURVEY.md §2b): a real SavedModel's
+    serving_default signature served through the shared model server."""
+    import numpy as np
+    import tensorflow as tf
+
+    from kubeflow_tpu.serving.runtime_main import load_model
+
+    class Doubler(tf.Module):
+        @tf.function(input_signature=[tf.TensorSpec([None, 2], tf.float32)])
+        def __call__(self, x):
+            return {"out": 2.0 * x + 1.0}
+
+    sm = tmp_path / "model"
+    tf.saved_model.save(Doubler(), str(sm))
+    m = load_model("tensorflow", "tfm", str(tmp_path))
+    m.load()
+    out = m.predict({"instances": [[1.0, 2.0], [3.0, 4.0]]})
+    np.testing.assert_allclose(out, [[3.0, 5.0], [7.0, 9.0]])

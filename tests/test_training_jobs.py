@@ -131,6 +131,7 @@ def test_tfjob_tf_config(tcluster):
     assert len(set(all_addrs)) == 3
 
 
+@pytest.mark.slow
 def test_pytorchjob_real_gloo_allreduce(tcluster):
     code = (
         "import os, datetime, torch, torch.distributed as dist\n"
@@ -153,6 +154,7 @@ def test_pytorchjob_real_gloo_allreduce(tcluster):
     assert "ALLREDUCE 3.0 world 2" in tcluster.logs("ptj-master-0")
 
 
+@pytest.mark.slow
 def test_exitcode_restart_policy(tcluster, tmp_path):
     """exit 137 (SIGKILL/preemption) is retryable; pod is recreated."""
     marker = str(tmp_path / "marker")
@@ -191,6 +193,7 @@ def test_exitcode_permanent_failure(tcluster):
     assert "exit code 2" in get_condition(j["status"], tapi.FAILED)["message"]
 
 
+@pytest.mark.slow
 def test_backoff_limit(tcluster):
     spec = job(
         "TPUJob",
@@ -205,6 +208,7 @@ def test_backoff_limit(tcluster):
     assert client.get_job("TPUJob", "loop")["status"]["restartCount"] == 1
 
 
+@pytest.mark.slow
 def test_clean_pod_policy_and_ttl(tcluster):
     spec = job(
         "TPUJob",
@@ -291,3 +295,151 @@ def test_pytorchjob_scale_job_clamps(tcluster):
         timeout=30,
     )
     client.delete_job("PyTorchJob", "scaleme")
+
+
+def test_mpijob_launcher_hostfile_configmap(tcluster):
+    """MPIJob launcher semantics (SURVEY.md §2a MPIJob row): a hostfile
+    ConfigMap rendered by the controller, mounted into the Launcher pod and
+    readable at the path OMPI_MCA_orte_default_hostfile points to."""
+    launcher_code = (
+        "import os\n"
+        "path = os.environ['MPI_HOSTFILE']\n"
+        "assert path == os.environ['OMPI_MCA_orte_default_hostfile']\n"
+        "print('HOSTFILE:', open(path).read().replace('\\n', '|'))\n"
+    )
+    spec = job(
+        "MPIJob",
+        "mpi",
+        {
+            "Launcher": ReplicaSpec(replicas=1, command=[sys.executable, "-u", "-c", launcher_code]),
+            "Worker": ReplicaSpec(replicas=2, command=[sys.executable, "-u", "-c", "import time; time.sleep(5)"]),
+        },
+    )
+    spec["spec"].setdefault("runPolicy", {})["cleanPodPolicy"] = "Running"
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("MPIJob", "mpi", timeout=60) == tapi.SUCCEEDED
+    cm = tcluster.api.get("ConfigMap", "mpi-hostfile")
+    assert cm["data"]["hostfile"] == "mpi-worker-0 slots=1\nmpi-worker-1 slots=1"
+    log = tcluster.logs("mpi-launcher-0")
+    assert "HOSTFILE: mpi-worker-0 slots=1|mpi-worker-1 slots=1" in log
+
+
+def test_mxjob_dmlc_env(tcluster):
+    """MXJob: DMLC scheduler/server/worker rendezvous env; success = workers."""
+    show = [sys.executable, "-u", "-c",
+            "import os, json; print(json.dumps({k: v for k, v in os.environ.items() if k.startswith('DMLC_')}))"]
+    spec = job(
+        "MXJob",
+        "mx",
+        {
+            "Scheduler": ReplicaSpec(replicas=1, command=show),
+            "Server": ReplicaSpec(replicas=1, command=show),
+            "Worker": ReplicaSpec(replicas=2, command=show),
+        },
+    )
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("MXJob", "mx", timeout=60) == tapi.SUCCEEDED
+    w1 = json.loads(tcluster.logs("mx-worker-1").strip().splitlines()[-1])
+    assert w1["DMLC_ROLE"] == "worker" and w1["DMLC_WORKER_ID"] == "1"
+    assert w1["DMLC_NUM_WORKER"] == "2" and w1["DMLC_NUM_SERVER"] == "1"
+    s = json.loads(tcluster.logs("mx-scheduler-0").strip().splitlines()[-1])
+    assert s["DMLC_ROLE"] == "scheduler"
+    assert s["DMLC_PS_ROOT_PORT"] == w1["DMLC_PS_ROOT_PORT"]
+
+
+def test_paddlejob_trainer_endpoints(tcluster):
+    """PaddleJob: collective-mode trainer endpoint rendezvous env."""
+    show = [sys.executable, "-u", "-c",
+            "import os, json; print(json.dumps({k: v for k, v in os.environ.items() if k.startswith(('PADDLE_', 'TRAINING_'))}))"]
+    spec = job("PaddleJob", "pd", {"Worker": ReplicaSpec(replicas=2, command=show)})
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("PaddleJob", "pd", timeout=60) == tapi.SUCCEEDED
+    w0 = json.loads(tcluster.logs("pd-worker-0").strip().splitlines()[-1])
+    w1 = json.loads(tcluster.logs("pd-worker-1").strip().splitlines()[-1])
+    eps = w0["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == 2 and w0["PADDLE_TRAINER_ENDPOINTS"] == w1["PADDLE_TRAINER_ENDPOINTS"]
+    assert w0["PADDLE_CURRENT_ENDPOINT"] == eps[0] and w1["PADDLE_CURRENT_ENDPOINT"] == eps[1]
+    assert w0["PADDLE_TRAINER_ID"] == "0" and w1["PADDLE_TRAINER_ID"] == "1"
+    assert w0["TRAINING_ROLE"] == "TRAINER" and w0["PADDLE_TRAINERS_NUM"] == "2"
+
+
+def test_pytorchjob_elastic_scale_up_after_shrink(tcluster, tmp_path):
+    """Elastic scale-UP: after a shrink, growth re-expands toward the spec
+    count once the cooldown passes (opt-in via elasticPolicy.scaleUp)."""
+    worker_code = (
+        "import os, time, sys\n"
+        "marker = os.path.join(os.environ['MARKER_DIR'], 'died-' + os.environ['RANK'])\n"
+        "if os.environ['RANK'] == '2' and not os.path.exists(marker):\n"
+        "    open(marker, 'w').write('x'); sys.exit(1)\n"
+        "time.sleep(6)\n"
+    )
+    spec = job(
+        "PyTorchJob",
+        "growback",
+        {
+            "Master": ReplicaSpec(
+                replicas=1,
+                command=[sys.executable, "-u", "-c", "import time; time.sleep(5); print('MASTER-DONE')"],
+            ),
+            "Worker": ReplicaSpec(
+                replicas=2,
+                command=[sys.executable, "-u", "-c", worker_code],
+                env={"MARKER_DIR": str(tmp_path)},
+            ),
+        },
+    )
+    spec["spec"]["elasticPolicy"] = {
+        "minReplicas": 1, "maxReplicas": 4, "scaleUp": True,
+        "scaleUpCooldownSeconds": 0.5,
+    }
+    client = _client(tcluster)
+    client.create_job(spec)
+    # shrink happens when worker-1 dies, growth restores it after cooldown
+    assert client.wait_for_job("PyTorchJob", "growback", timeout=120) == tapi.SUCCEEDED
+    final = client.get_job("PyTorchJob", "growback")
+    assert "elasticReplicas" not in final["status"], final["status"].get("elasticReplicas")
+    events = [e.get("reason") for e in tcluster.api.list("Event")]
+    assert "JobScaledDown" in events and "JobScaledUp" in events
+
+
+@pytest.mark.slow
+def test_tpujob_auto_resume_from_checkpoint(tcluster, tmp_path):
+    """Auto-resume (SURVEY.md §5): a TPUJob worker preempted mid-run (exit
+    137, retryable) restarts and continues from the newest checkpoint — step
+    continuity, not a step-0 restart."""
+    spec = job(
+        "TPUJob",
+        "resume",
+        {"Worker": ReplicaSpec(
+            replicas=1,
+            restart_policy="ExitCode",
+            command=[sys.executable, "-u", "-m", "kubeflow_tpu.examples.bert_worker"],
+            env={
+                "JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo",
+                "TRAIN_STEPS": "12", "FAIL_AT_STEP": "7",
+                "FAIL_MARKER": str(tmp_path / "died"),
+            },
+        )},
+    )
+    spec["spec"]["checkpoint"] = {"dir": str(tmp_path / "ckpt"), "everySteps": 3}
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("TPUJob", "resume", timeout=240) == tapi.SUCCEEDED
+    j = client.get_job("TPUJob", "resume")
+    assert j["status"]["restartCount"] == 1
+    log = tcluster.logs("resume-worker-0")
+    # first run: fresh start, died at 7 with checkpoints saved at 3 and 6
+    assert "resumed_from=0" in log
+    # second run: resumed from the last DURABLE checkpoint (the step-6 save
+    # is async; a preemption may kill the process before it commits)
+    import re
+    resumes = [int(m) for m in re.findall(r"resumed_from=(\d+)", log)]
+    assert resumes[0] == 0 and resumes[1] in (3, 6), resumes
+    assert "TRAIN-DONE step=12" in log
+    # continuity: the resumed run starts at K+1, never back at step 1
+    resumed_part = log.split(f"resumed_from={resumes[1]}", 1)[1]
+    assert f"step={resumes[1] + 1} " in resumed_part
+    assert "step=1 " not in resumed_part

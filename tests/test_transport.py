@@ -56,7 +56,11 @@ def _worker(rank: int, world: int, port: int, q: mp.Queue) -> None:
         q.put((rank, f"{type(e).__name__}: {e}"))
 
 
-@pytest.mark.parametrize("world", [2, 4, 3])
+@pytest.mark.parametrize("world", [
+    2,
+    pytest.param(4, marks=pytest.mark.slow),
+    pytest.param(3, marks=pytest.mark.slow),
+])
 def test_ring_collectives_multiprocess(world):
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
