@@ -16,6 +16,7 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
@@ -127,7 +128,8 @@ def bench_resnet() -> dict:
         install(c.api, c.manager)
         client = TrainingClient(c)
         name = f"resnet{n_workers}"
-        env = {"PYTHONPATH": "/root/repo", "TRAIN_STEPS": "8",
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {"PYTHONPATH": repo, "JAX_PLATFORMS": "cpu", "TRAIN_STEPS": "8",
                "PER_CHIP_BATCH": "8", "IMAGE_SIZE": "32", "DDP_TRANSPORT": "shim"}
         replicas = {"Master": ReplicaSpec(
             replicas=1,
@@ -165,7 +167,8 @@ def bench_gemma() -> dict:
     from kubeflow_tpu.examples.gemma_pipeline import gemma_pipeline
     from kubeflow_tpu.pipelines.client import Client
 
-    c = Cluster(cpu_nodes=1)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    c = Cluster(cpu_nodes=1, base_env={"PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"})
     client = Client(c)
     t0 = time.perf_counter()
     run = client.create_run_from_pipeline_func(gemma_pipeline, arguments={

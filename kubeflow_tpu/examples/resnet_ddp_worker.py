@@ -20,6 +20,19 @@ import os
 import time
 
 
+def _apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS even under the axon sitecustomize, which pins
+    platforms via jax.config at interpreter start (masking the env var);
+    with the TPU tunnel down that pin kills CPU-only workers."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+
+
 def _map_torch_env() -> None:
     """MASTER_ADDR/RANK/WORLD_SIZE → the JAX coordinator env (torch compat)."""
     env = os.environ
@@ -87,6 +100,7 @@ def main_shim() -> None:
 
 
 def main() -> None:
+    _apply_platform_env()
     if os.environ.get("DDP_TRANSPORT") == "shim":
         main_shim()
         return

@@ -85,15 +85,23 @@ def validate_isvc(obj: Obj) -> None:
         raise Invalid("canaryTrafficPercent must be in [0, 100]")
 
 
-def default_isvc(obj: Obj) -> None:
+def default_isvc(obj: Obj, api: Optional["APIServer"] = None) -> None:
+    """Admission defaulting; with an ``api`` handle (the registered path) the
+    autoscaling defaults come from the inferenceservice-config ConfigMap —
+    upstream's mutating webhook reads the same ConfigMap at admission."""
+    auto = {"defaultMinReplicas": 1, "defaultMaxReplicas": 3, "defaultScaleTarget": 4}
+    if api is not None:
+        from .config import isvc_config
+
+        auto.update(isvc_config(api).get("autoscaling", {}))
     spec = obj.setdefault("spec", {})
     for name in COMPONENTS:
         comp = spec.get(name)
         if comp is None:
             continue
-        comp.setdefault("minReplicas", 1)
-        comp.setdefault("maxReplicas", 3)
-        comp.setdefault("scaleTarget", 4)  # target concurrent requests/replica
+        comp.setdefault("minReplicas", auto["defaultMinReplicas"])
+        comp.setdefault("maxReplicas", auto["defaultMaxReplicas"])
+        comp.setdefault("scaleTarget", auto["defaultScaleTarget"])  # target concurrent requests/replica
         if "model" in comp:
             model = comp["model"]
             fmt = model.get("modelFormat")
@@ -117,7 +125,9 @@ def register(api: APIServer) -> None:
             kind="InferenceService",
             plural="inferenceservices",
             validator=validate_isvc,
-            defaulter=default_isvc,
+            # closure over the apiserver so admission defaulting can read the
+            # inferenceservice-config ConfigMap (upstream webhook behavior)
+            defaulter=lambda obj: default_isvc(obj, api),
         )
     )
     api.register_crd(
@@ -172,9 +182,9 @@ def inference_service(
     transformer: Optional[dict] = None,
     explainer: Optional[dict] = None,
     canary_traffic_percent: Optional[int] = None,
-    min_replicas: int = 1,
-    max_replicas: int = 3,
-    scale_target: int = 4,
+    min_replicas: Optional[int] = 1,
+    max_replicas: Optional[int] = 3,
+    scale_target: Optional[int] = 4,
 ) -> Obj:
     """Typed builder — the Python-SDK analogue of kserve's V1beta1InferenceService."""
     if predictor is None:
@@ -187,9 +197,11 @@ def inference_service(
             model["runtime"] = runtime
         predictor = {"model": model}
     predictor = copy.deepcopy(predictor)
-    predictor.setdefault("minReplicas", min_replicas)
-    predictor.setdefault("maxReplicas", max_replicas)
-    predictor.setdefault("scaleTarget", scale_target)
+    # None = leave it to admission defaulting (inferenceservice-config)
+    for key, value in (("minReplicas", min_replicas), ("maxReplicas", max_replicas),
+                       ("scaleTarget", scale_target)):
+        if value is not None:
+            predictor.setdefault(key, value)
     spec: dict = {"predictor": predictor}
     if transformer is not None:
         spec["transformer"] = copy.deepcopy(transformer)

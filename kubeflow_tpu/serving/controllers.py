@@ -254,7 +254,15 @@ class InferenceServiceReconciler:
         entry = "transformer" if "transformer" in spec else "predictor"
         entry_port = components_status[entry]["proxyPort"]
         status["components"] = components_status
-        status["url"] = f"http://127.0.0.1:{entry_port}"
+        # upstream shape: status.url is the EXTERNAL ingress URL (rendered
+        # from the inferenceservice-config ConfigMap), status.address.url the
+        # in-cluster address the router actually dials
+        from .config import external_url, isvc_config
+
+        status["url"] = external_url(
+            isvc_config(self.api), isvc["metadata"]["name"],
+            isvc["metadata"].get("namespace", "default"))
+        status["address"] = {"url": f"http://127.0.0.1:{entry_port}"}
         set_condition(status, READY, "True" if all_ready else "False", "AllReady" if all_ready else "NotReady")
         self.api.update_status(isvc)
         if not all_ready:
@@ -434,6 +442,19 @@ class InferenceServiceReconciler:
         have = {e["name"] for e in env}
         if comp == "transformer" and predictor_addr and "PREDICTOR_HOST" not in have:
             env.append({"name": "PREDICTOR_HOST", "value": predictor_addr})
+        # KServe-agent features (SURVEY.md §2a agent row): component-level
+        # batcher/logger specs become env the runtime wraps the model with
+        batcher = cspec.get("batcher")
+        if batcher is not None:  # {} = enable with defaults (kserve semantics)
+            env.append({"name": "BATCHER_MAX_BATCH_SIZE",
+                        "value": str(batcher.get("maxBatchSize", 8))})
+            env.append({"name": "BATCHER_MAX_LATENCY_MS",
+                        "value": str(batcher.get("maxLatency", 20))})
+        logger = cspec.get("logger")
+        if logger is not None:
+            env.append({"name": "LOGGER_MODE", "value": logger.get("mode", "all")})
+            env.append({"name": "LOGGER_PATH",
+                        "value": logger.get("url", f"/tmp/{name}-{comp}-payload.jsonl")})
         return {"containers": containers, "initContainers": init}
 
     def _gc_old_revisions(self, isvc: Obj, comp: str, keep: set[str]) -> None:

@@ -225,9 +225,9 @@ class Router:
 
     def _entry_port(self, name: str, namespace: str) -> int:
         isvc = self.api.get("InferenceService", name, namespace)
-        url = isvc.get("status", {}).get("url")
+        url = isvc.get("status", {}).get("address", {}).get("url")
         if not url:
-            raise LookupError(f"InferenceService {name} has no status.url yet")
+            raise LookupError(f"InferenceService {name} has no status.address yet")
         return int(url.rsplit(":", 1)[1])
 
     def _post(self, port: int, path: str, payload: dict, timeout: float = 60.0) -> dict:

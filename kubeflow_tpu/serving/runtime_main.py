@@ -238,6 +238,22 @@ def main(argv: Optional[list[str]] = None) -> int:
     args = p.parse_args(argv)
 
     model = load_model(args.loader, args.model_name, args.model_dir)
+    # KServe-agent wrappers (SURVEY.md §2a agent row), controller-injected:
+    # batcher innermost (coalesces model calls), logger outermost (logs the
+    # caller-shaped request/response)
+    if os.environ.get("BATCHER_MAX_BATCH_SIZE"):
+        from .agent import RequestBatcher
+
+        model = RequestBatcher(
+            model,
+            max_batch_size=int(os.environ["BATCHER_MAX_BATCH_SIZE"]),
+            max_latency=float(os.environ.get("BATCHER_MAX_LATENCY_MS", "20")) / 1000.0,
+        )
+    if os.environ.get("LOGGER_PATH"):
+        from .agent import PayloadLogger
+
+        model = PayloadLogger(model, path=os.environ["LOGGER_PATH"],
+                              log_mode=os.environ.get("LOGGER_MODE", "all"))
     server = ModelServer([model], port=args.port)
     print(f"runtime_main: serving {args.model_name} ({args.loader}) on :{server.port}", flush=True)
     server.start(block=True)

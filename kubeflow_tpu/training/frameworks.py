@@ -24,7 +24,20 @@ from .common import JobController
 
 
 def _host(job: Obj, rtype: str, index: int) -> str:
-    # simulator address; real deployment: f"{job}-{rtype}-{i}.{ns}.svc"
+    """Rendezvous hostname for one replica.
+
+    Default (the simulator, where every pod is a localhost process) is
+    127.0.0.1.  ``spec.network.hostMode: dns`` renders the headless-Service
+    DNS names a real deployment uses — `{job}-{rtype}-{i}.{ns}.svc.{domain}`,
+    matching the per-replica Services the common controller creates
+    (common.py `_ensure_service`), so the Service objects are load-bearing
+    API surface, not cosmetic parity.
+    """
+    net = job["spec"].get("network") or {}
+    if net.get("hostMode") == "dns":
+        ns = job["metadata"].get("namespace", "default")
+        domain = net.get("clusterDomain", "cluster.local")
+        return f"{job['metadata']['name']}-{rtype.lower()}-{index}.{ns}.svc.{domain}"
     return "127.0.0.1"
 
 

@@ -164,3 +164,58 @@ def test_kfadm_full_platform_bringup(cluster):
     # platform pillar actually reconciles
     cluster.api.create(papi.profile("kfadm-ns", "kfadm@x.com"))
     assert cluster.wait_for(lambda: cluster.api.try_get("Namespace", "kfadm-ns") is not None, timeout=10)
+
+
+# ------------------------------------------------------------------- authz
+
+def test_profile_rbac_authorizer_and_authenticated_api(platform):
+    """Authn/z on the API surface (SURVEY.md §1 X-row): profile ownership +
+    KFAM bindings gate every verb through AuthenticatedAPI."""
+    from kubeflow_tpu.core.authz import AuthenticatedAPI, Forbidden, ProfileRBACAuthorizer
+    from kubeflow_tpu.platform.kfam import AccessManagement
+
+    c, _ = platform
+    c.apply({"apiVersion": "kubeflow.org/v1", "kind": "Profile",
+             "metadata": {"name": "team-a"},
+             "spec": {"owner": {"kind": "User", "name": "alice@corp.io"}}})
+    c.settle()
+    kfam = AccessManagement(c.api)
+    kfam.create_binding("team-a", "bob@corp.io", "view")
+
+    authz = ProfileRBACAuthorizer(c.api, cluster_admins=["root@corp.io"])
+    notebook = {
+        "apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "team-a"},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "nb", "command": ["sleep", "1"]}]}}},
+    }
+
+    # owner: full access in the profile namespace
+    alice = AuthenticatedAPI(c.api, "alice@corp.io", authz)
+    alice.create(notebook)
+    assert alice.get("Notebook", "nb", "team-a")["metadata"]["name"] == "nb"
+
+    # viewer: reads yes, writes no
+    bob = AuthenticatedAPI(c.api, "bob@corp.io", authz)
+    assert [n["metadata"]["name"] for n in bob.list("Notebook", "team-a")] == ["nb"]
+    import pytest as _pytest
+    with _pytest.raises(Forbidden):
+        bob.delete("Notebook", "nb", "team-a")
+
+    # stranger: nothing in team-a; Profile listing allowed (namespace picker)
+    eve = AuthenticatedAPI(c.api, "eve@corp.io", authz)
+    with _pytest.raises(Forbidden):
+        eve.list("Notebook", "team-a")
+    assert any(p["metadata"]["name"] == "team-a" for p in eve.list("Profile"))
+    with _pytest.raises(Forbidden):
+        eve.create({"apiVersion": "kubeflow.org/v1", "kind": "Profile",
+                    "metadata": {"name": "eve-land"},
+                    "spec": {"owner": {"kind": "User", "name": "eve@corp.io"}}})
+
+    # cross-namespace list filters to readable namespaces
+    assert [n["metadata"]["name"] for n in bob.list("Notebook", namespace=None)] == ["nb"]
+    assert eve.list("Notebook", namespace=None) == []
+
+    # cluster admin: everywhere, incl. cluster-scoped writes
+    root = AuthenticatedAPI(c.api, "root@corp.io", authz)
+    root.delete("Notebook", "nb", "team-a")

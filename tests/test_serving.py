@@ -162,7 +162,9 @@ def test_isvc_pyfunc_end_to_end(scluster):
                               storage_uri=f"file://{model_dir}", max_replicas=2))
     _wait_ready(c, "double")
     isvc = c.api.get("InferenceService", "double")
-    assert isvc["status"]["url"].startswith("http://127.0.0.1:")
+    # upstream shape: external ingress URL + in-cluster address
+    assert isvc["status"]["url"] == "http://double.default.example.com"
+    assert isvc["status"]["address"]["url"].startswith("http://127.0.0.1:")
     assert isvc["status"]["components"]["predictor"]["latestReadyRevision"]
     out = router.predict("double", {"instances": [1, 2, 3]})
     assert out == {"predictions": [2, 4, 6]}
@@ -401,3 +403,59 @@ def test_savedmodel_loader_serves_tf_signature(tmp_path):
     m.load()
     out = m.predict({"instances": [[1.0, 2.0], [3.0, 4.0]]})
     np.testing.assert_allclose(out, [[3.0, 5.0], [7.0, 9.0]])
+
+
+def test_inferenceservice_config_map_drives_external_url(tmp_path):
+    """inferenceservice-config ConfigMap (SURVEY.md §5 config row): editing
+    the ingress blob retunes the controller without redeploying it."""
+    from kubeflow_tpu.serving.config import external_url, isvc_config
+
+    c = Cluster(cpu_nodes=1)
+    try:
+        install(c.api, c.manager)
+        cfg = isvc_config(c.api)
+        assert cfg["ingress"]["ingressDomain"] == "example.com"
+        c.api.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "inferenceservice-config", "namespace": "kubeflow"},
+            "data": {"ingress": '{"ingressDomain": "ml.corp.io", "urlScheme": "https"}'},
+        })
+        cfg = isvc_config(c.api)
+        assert external_url(cfg, "m", "team1") == "https://m.team1.ml.corp.io"
+        # autoscaling defaults survive a partial override
+        assert cfg["autoscaling"]["defaultMaxReplicas"] == 3
+        # ...and are honored at admission: the defaulter reads the ConfigMap
+        c.api.patch("ConfigMap", "inferenceservice-config",
+                    {"data": {"autoscaling": '{"defaultMaxReplicas": 7}'}}, "kubeflow")
+        obj = c.api.create(inference_service("cfgd", model_format="pyfunc",
+                                             storage_uri="file:///tmp/x",
+                                             max_replicas=None))
+        assert obj["spec"]["predictor"]["maxReplicas"] == 7
+    finally:
+        c.shutdown()
+
+
+def test_isvc_batcher_and_logger_spec(scluster):
+    """Component-level batcher/logger specs flow controller → env → runtime
+    wrappers; payload log lands where spec.predictor.logger.url points."""
+    c, router, tmp_path = scluster
+    model_dir = _write_pyfunc_model(tmp_path, "m2", factor=3)
+    log_path = str(tmp_path / "payload.jsonl")
+    c.apply(inference_service("triple", predictor={
+        "model": {"modelFormat": {"name": "pyfunc"}, "storageUri": f"file://{model_dir}"},
+        "batcher": {"maxBatchSize": 4, "maxLatency": 10},
+        "logger": {"mode": "all", "url": log_path},
+    }))
+    _wait_ready(c, "triple")
+    assert router.predict("triple", {"instances": [2]}) == {"predictions": [6]}
+    assert router.predict("triple", {"instances": [5]}) == {"predictions": [15]}
+
+    def logged():
+        if not os.path.exists(log_path):
+            return False
+        lines = [json.loads(x) for x in open(log_path).read().splitlines()]
+        return len(lines) == 4
+    assert c.wait_for(logged, timeout=10)
+    lines = [json.loads(x) for x in open(log_path).read().splitlines()]
+    assert [x["type"] for x in lines] == ["request", "response", "request", "response"]
+    assert lines[1]["payload"] == {"predictions": [6]}

@@ -443,3 +443,49 @@ def test_tpujob_auto_resume_from_checkpoint(tcluster, tmp_path):
     resumed_part = log.split(f"resumed_from={resumes[1]}", 1)[1]
     assert f"step={resumes[1] + 1} " in resumed_part
     assert "step=1 " not in resumed_part
+
+
+def test_dns_host_mode_renders_headless_service_names(tcluster):
+    """spec.network.hostMode=dns: rendezvous env carries the headless-Service
+    DNS names that the common controller's per-replica Services resolve to —
+    the real-deployment rendering of the simulator's 127.0.0.1."""
+    from kubeflow_tpu.training.frameworks import TFJobController, TPUJobController
+
+    spec = job(
+        "TFJob", "dnsj",
+        {"PS": ReplicaSpec(command=["x"]), "Worker": ReplicaSpec(replicas=2, command=["x"])},
+    )
+    spec["spec"]["network"] = {"hostMode": "dns"}
+    spec["metadata"]["annotations"] = {
+        "training.kubeflow.org/rendezvous-ports": "[5001, 5002, 5003]"}
+    replicas = spec["spec"]["replicaSpecs"]
+    for r in replicas.values():
+        r.setdefault("replicas", 1)
+    ctrl = TFJobController(tcluster.api)
+    env = ctrl.set_cluster_spec(spec, "Worker", 1, replicas)
+    cfg = json.loads(env["TF_CONFIG"])
+    assert cfg["cluster"]["worker"] == [
+        "dnsj-worker-0.default.svc.cluster.local:5002",
+        "dnsj-worker-1.default.svc.cluster.local:5003",
+    ]
+    assert cfg["cluster"]["ps"] == ["dnsj-ps-0.default.svc.cluster.local:5001"]
+
+    tspec = job("TPUJob", "dnst", {"Worker": ReplicaSpec(replicas=2, command=["x"])})
+    tspec["spec"]["network"] = {"hostMode": "dns", "clusterDomain": "corp.local"}
+    tspec["metadata"]["annotations"] = {
+        "training.kubeflow.org/rendezvous-ports": "[6001, 6002]"}
+    tenv = TPUJobController(tcluster.api).set_cluster_spec(
+        tspec, "Worker", 0, tspec["spec"]["replicaSpecs"])
+    assert tenv["JAX_COORDINATOR_ADDRESS"] == "dnst-worker-0.default.svc.corp.local:6001"
+    assert tenv["TPU_WORKER_HOSTNAMES"] == (
+        "dnst-worker-0.default.svc.corp.local,dnst-worker-1.default.svc.corp.local")
+
+    # the names match what _ensure_service creates: run a real (local-mode)
+    # job and check the per-replica Service objects exist with those names
+    rspec = job("TPUJob", "svcj", {"Worker": ReplicaSpec(
+        replicas=2, command=[sys.executable, "-c", "pass"])})
+    client = _client(tcluster)
+    client.create_job(rspec)
+    assert client.wait_for_job("TPUJob", "svcj", timeout=60) == tapi.SUCCEEDED
+    for i in range(2):
+        assert tcluster.api.try_get("Service", f"svcj-worker-{i}") is not None
