@@ -115,8 +115,9 @@ def main() -> None:
 
     trainer, data, flops = _build(chosen, batch_size, seq_len, max_predictions, steps)
     rate = _measure(trainer, data, steps)  # full window on the winner
-    if on_tpu and chosen != fallback:
-        # enforce "never worse than r1": the 3-step probe is noisy, so if the
+    if on_tpu and chosen != fallback and variant == "v5e":
+        # enforce "never worse than r1" (r1 measured on v5e, so the absolute
+        # floor only applies there): the 3-step probe is noisy, so if the
         # winner's full window lost to the r1 rate, re-measure the r1 config
         # and report whichever full window is actually faster
         if batch_size * rate / n_chips < R1_SAMPLES_PER_SEC_PER_CHIP:

@@ -18,6 +18,7 @@ free individually and the queue drains into them mid-flight.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -45,6 +46,10 @@ class EngineConfig:
     # size, one chunk per engine tick, so decode steps for active slots
     # interleave with a long prefill instead of stalling behind it
     prefill_chunk: int = 256
+    # Pallas paged-attention decode path (paged_attention.py); None defers
+    # to the ENGINE_PAGED_KERNEL env var. Off by default until re-validated
+    # on real hardware (the TPU tunnel was down for all of round 2).
+    paged_kernel: Optional[bool] = None
 
 
 @dataclasses.dataclass
@@ -88,6 +93,8 @@ class Engine:
         self._wake = threading.Event()
         self._key = jax.random.PRNGKey(engine_config.seed)
         self._sample_calls = 0
+        self._paged = (engine_config.paged_kernel if engine_config.paged_kernel is not None
+                       else os.environ.get("ENGINE_PAGED_KERNEL") == "1")
         self._jax = jax
         self._jnp = jnp
 
@@ -266,7 +273,7 @@ class Engine:
                 logits, self.k_pool, self.v_pool = decode_step(
                     self.params, self.config, jnp.asarray(tokens),
                     jnp.asarray(seq_lens), jnp.asarray(page_table),
-                    self.k_pool, self.v_pool,
+                    self.k_pool, self.v_pool, paged=self._paged,
                 )
                 sampled = np.asarray(
                     sample_tokens(logits, self._next_key(), self.ec.temperature))

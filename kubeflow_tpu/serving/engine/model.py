@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .paged_attention import paged_decode_attention
+
 
 @dataclasses.dataclass(frozen=True)
 class DecoderConfig:
@@ -131,19 +133,27 @@ def _attn(q, k, v, mask):
     return jnp.einsum("bhst,bthd->bshd", probs.astype(v.dtype), v)
 
 
-def _block(params, l, config, x, k_cache, v_cache, positions, mask):
-    """One transformer block. k_cache/v_cache: [B, T, Hkv, hd] (already incl.
-    this step's k/v at the right positions). Returns block output."""
+def _block_with(params, l, config, x, positions, attend):
+    """One transformer block with a pluggable attention: ``attend(q)`` maps
+    roped queries [B, S, Hq, hd] to attention outputs of the same shape (the
+    hook where the XLA gather path and the Pallas paged kernel diverge)."""
     c = config
     h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
     B, S = x.shape[:2]
     q = (h @ params["wq"][l]).reshape(B, S, c.n_heads, c.head_dim)
     q = _rope(q, positions, c.rope_theta)
-    attn = _attn(q, k_cache, v_cache, mask)
+    attn = attend(q)
     x = x + attn.reshape(B, S, -1) @ params["wo"][l]
     h = _rms_norm(x, params["ln_mlp"][l], c.norm_eps)
     x = x + (jax.nn.silu(h @ params["w1"][l]) * (h @ params["w3"][l])) @ params["w2"][l]
     return x
+
+
+def _block(params, l, config, x, k_cache, v_cache, positions, mask):
+    """One transformer block. k_cache/v_cache: [B, T, Hkv, hd] (already incl.
+    this step's k/v at the right positions). Returns block output."""
+    return _block_with(params, l, config, x, positions,
+                       lambda q: _attn(q, k_cache, v_cache, mask))
 
 
 def _kv_proj(params, l, config, h, positions):
@@ -263,9 +273,10 @@ def sample_tokens(logits, key, temperature: float = 0.0):
 # -------------------------------------------------------------------- decode
 
 
-@functools.partial(jax.jit, static_argnames=("config",), donate_argnames=("k_pool", "v_pool"))
+@functools.partial(jax.jit, static_argnames=("config", "paged"),
+                   donate_argnames=("k_pool", "v_pool"))
 def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
-                k_pool, v_pool):
+                k_pool, v_pool, paged: bool = False):
     """One decode step for ALL slots.
 
     tokens: [B] int32 current token per slot; seq_lens: [B] int32 length
@@ -277,6 +288,10 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
     attention covers positions [0, seq_len).  Inactive slots (seq_len==0) are
     clamped to position 0 and produce garbage logits that the caller ignores
     — static shapes beat recompiles (XLA semantics, system brief).
+
+    ``paged=True`` runs attention as the Pallas paged kernel directly over
+    the pool (paged_attention.py) instead of gathering each slot's pages
+    into a contiguous cache first — removing the per-step KV copy.
     """
     c = config
     B = tokens.shape[0]
@@ -300,10 +315,16 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
         # scatter this step's kv into the pool: one (page, offset) per slot
         k_pool = k_pool.at[l, page_id, offset].set(k_new[:, 0])
         v_pool = v_pool.at[l, page_id, offset].set(v_new[:, 0])
-        # gather each slot's pages -> [B, T, Hkv, hd]
-        k_cache = k_pool[l, page_table].reshape(B, T, c.n_kv_heads, c.head_dim)
-        v_cache = v_pool[l, page_table].reshape(B, T, c.n_kv_heads, c.head_dim)
-        x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
+        if paged:
+            kl, vl = k_pool[l], v_pool[l]
+            attend = lambda q: paged_decode_attention(  # noqa: E731
+                q[:, 0], kl, vl, page_table, seq_lens, page_size)[:, None]
+            x = _block_with(params, l, c, x, positions, attend)
+        else:
+            # gather each slot's pages -> [B, T, Hkv, hd]
+            k_cache = k_pool[l, page_table].reshape(B, T, c.n_kv_heads, c.head_dim)
+            v_cache = v_pool[l, page_table].reshape(B, T, c.n_kv_heads, c.head_dim)
+            x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     logits = (x[:, 0] @ params["unembed"]).astype(jnp.float32)
     return logits, k_pool, v_pool

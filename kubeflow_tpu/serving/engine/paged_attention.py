@@ -1,0 +1,128 @@
+"""Paged attention: Pallas TPU decode kernel over the KV page pool.
+
+Role (SURVEY.md §2b Triton row, §3.4 hot path): the decode-step attention of
+the JetStream-class engine.  The XLA path in model.py gathers each slot's
+pages into a contiguous [B, T, Hkv, hd] cache every step — that gather WRITES
+a full KV copy to HBM before attention reads it back, tripling the memory
+traffic of the step's roofline term.  This kernel instead walks the pool
+pages in place, one page per grid step, with the page ids scalar-prefetched
+(``pltpu.PrefetchScalarGridSpec``) so the data-dependent page lookup happens
+in the BlockSpec index_map, not as an HBM gather.
+
+Design (pallas_guide.md):
+  * grid = (slots, kv_heads, max_pages); the last axis is sequential on TPU,
+    so the online-softmax accumulator lives in VMEM scratch across page
+    steps and the output is written on the final page;
+  * GQA: the q block per (slot, kv head) is the [group, hd] bundle of query
+    heads sharing that KV head;
+  * pages past the slot's length are masked per-position and skipped as
+    whole blocks via ``pl.when`` (no FLOPs for dead pages — the paged
+    analogue of flash attention's causal block skip);
+  * ``interpret=`` auto-selects: compiled on TPU, interpreter on the CPU
+    test mesh, same numerics either way.
+
+Engine integration is env-gated (``ENGINE_PAGED_KERNEL=1``): the XLA gather
+path stays the default until the kernel is re-validated on real hardware
+(the TPU tunnel was down for all of round 2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9
+
+
+def _auto_interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _kernel(page_table_ref, seq_lens_ref,  # scalar-prefetch (SMEM)
+            q_ref, k_ref, v_ref, o_ref,    # blocks
+            acc_ref, m_ref, l_ref,         # VMEM scratch
+            *, page_size, scale):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+    seq_len = seq_lens_ref[b]
+
+    @pl.when(j == 0)
+    def _reset():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # whole pages past the sequence contribute nothing: skip their FLOPs
+    @pl.when(j * page_size < seq_len)
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # [group, hd]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)            # [ps, hd]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                    # [group, ps]
+        pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(pos < seq_len, logits, NEG_INF)
+        m_new = jnp.maximum(m_ref[...], logits.max(axis=1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m_ref[...] - m_new)
+        l_ref[...] = l_ref[...] * corr + p.sum(axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_new
+
+    @pl.when(j == num_pages - 1)
+    def _write():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_decode_attention(q, k_pool, v_pool, page_table, seq_lens,
+                           page_size: int, interpret: bool | None = None):
+    """One decode step of attention directly over the page pool.
+
+    q: [B, Hq, hd] (current token per slot); k_pool/v_pool:
+    [P, page_size, Hkv, hd] (ONE layer's pool); page_table: [B, max_pages]
+    int32; seq_lens: [B] int32 (0 = inactive slot → zeros out).
+    Returns [B, Hq, hd].
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    B, Hq, hd = q.shape
+    Hkv = k_pool.shape[2]
+    group = Hq // Hkv
+    max_pages = page_table.shape[1]
+    scale = hd ** -0.5
+    # [B, Hq, hd] -> [B, Hkv, group, hd]: queries grouped by their KV head
+    qg = q.reshape(B, Hkv, group, hd)
+
+    grid = (B, Hkv, max_pages)
+    out = pl.pallas_call(
+        functools.partial(_kernel, page_size=page_size, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # page_table, seq_lens
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, group, hd), lambda b, h, j, pt, sl: (b, h, 0, 0)),
+                # the data-dependent page lookup: block = pool page pt[b, j]
+                pl.BlockSpec((1, page_size, 1, hd), lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0)),
+                pl.BlockSpec((1, page_size, 1, hd), lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, group, hd), lambda b, h, j, pt, sl: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, hd), jnp.float32),
+                pltpu.VMEM((group, 1), jnp.float32),
+                pltpu.VMEM((group, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, group, hd), q.dtype),
+        interpret=interpret,
+    )(page_table, seq_lens, qg, k_pool, v_pool)
+    return out.reshape(B, Hq, hd)
