@@ -50,6 +50,10 @@ class EngineConfig:
     # to the ENGINE_PAGED_KERNEL env var. Off by default until re-validated
     # on real hardware (the TPU tunnel was down for all of round 2).
     paged_kernel: Optional[bool] = None
+    # tensor-parallel degree (sharding.py): >1 places params + KV pool over a
+    # 1-D GSPMD mesh so Llama-8B-class models span a slice. Uses the XLA
+    # gather attention path (the Pallas kernel is single-device).
+    tensor_parallel: int = 1
 
 
 @dataclasses.dataclass
@@ -81,6 +85,19 @@ class Engine:
                  c.n_kv_heads, c.head_dim)
         self.k_pool = jnp.zeros(shape, jnp.bfloat16)
         self.v_pool = jnp.zeros(shape, jnp.bfloat16)
+        self._paged = (engine_config.paged_kernel if engine_config.paged_kernel is not None
+                       else os.environ.get("ENGINE_PAGED_KERNEL") == "1")
+        if engine_config.tensor_parallel > 1:
+            from .sharding import shard_params, shard_pool, tensor_mesh, validate_config
+
+            if self._paged:  # check the RESOLVED flag: the env gate counts too
+                raise ValueError("paged_kernel and tensor_parallel are exclusive "
+                                 "(the Pallas kernel is single-device)")
+            mesh = tensor_mesh(engine_config.tensor_parallel)
+            validate_config(c, mesh)
+            self.params = shard_params(self.params, mesh)
+            self.k_pool = shard_pool(self.k_pool, mesh)
+            self.v_pool = shard_pool(self.v_pool, mesh)
         if engine_config.prefill_chunk % engine_config.page_size != 0:
             raise ValueError("prefill_chunk must be a multiple of page_size")
         self._requests: dict[int, _Pending] = {}
@@ -93,8 +110,6 @@ class Engine:
         self._wake = threading.Event()
         self._key = jax.random.PRNGKey(engine_config.seed)
         self._sample_calls = 0
-        self._paged = (engine_config.paged_kernel if engine_config.paged_kernel is not None
-                       else os.environ.get("ENGINE_PAGED_KERNEL") == "1")
         self._jax = jax
         self._jnp = jnp
 

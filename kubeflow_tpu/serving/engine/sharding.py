@@ -1,0 +1,83 @@
+"""Tensor-parallel sharding for the serving engine.
+
+Role (SURVEY.md §2c TP row + system brief "long-context and distributed are
+first-class"): Llama-3-8B-class models don't fit one v5e chip in bf16 with a
+KV pool, so the engine must run tensor-parallel across a slice.  The TPU-
+first mechanism is pure GSPMD: place the params and the KV page pool with
+``NamedSharding``s over a 1-D ``tensor`` mesh and let XLA partition the SAME
+jitted ``prefill``/``decode_step`` computations — attention heads and FFN
+columns split across chips, with the all-reduces after ``wo``/``w2`` inserted
+by the compiler (no hand-written collectives, unlike the reference's
+NCCL-backed servers).
+
+Layout (the standard Megatron split, expressed as shardings):
+  * wq/wk/wv: column-parallel  [D, H*hd] → heads on ``tensor``;
+  * wo:       row-parallel     [H*hd, D] → input dim on ``tensor``;
+  * w1/w3:    column-parallel  [D, F] → F on ``tensor``;
+  * w2:       row-parallel     [F, D];
+  * embed/unembed + norms: replicated (vocab matmuls are small per step);
+  * k_pool/v_pool: sharded on the KV-head axis — each chip holds its own
+    heads' pages, so pool HBM also scales with the slice.
+
+``n_kv_heads`` (and ``n_heads``) must divide the tensor size.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .model import DecoderConfig
+
+# param name -> PartitionSpec over the ("tensor",) mesh; leading dim of the
+# layer-stacked weights is the layer axis (replicated)
+PARAM_SPECS = {
+    "embed": P(),
+    "wq": P(None, None, "tensor"),
+    "wk": P(None, None, "tensor"),
+    "wv": P(None, None, "tensor"),
+    "wo": P(None, "tensor", None),
+    "w1": P(None, None, "tensor"),
+    "w3": P(None, None, "tensor"),
+    "w2": P(None, "tensor", None),
+    "ln_attn": P(),
+    "ln_mlp": P(),
+    "ln_out": P(),
+    "unembed": P(),
+}
+
+# pool: [L, P, page_size, Hkv, hd] — KV heads on tensor
+POOL_SPEC = P(None, None, None, "tensor", None)
+
+
+def tensor_mesh(n: int) -> Mesh:
+    """A 1-D tensor-parallel mesh over the first n local devices."""
+    devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(
+            f"tensor_parallel={n} needs {n} devices, have {len(devices)} — "
+            "refusing to silently run at a lower degree")
+    return Mesh(devices[:n], ("tensor",))
+
+
+def validate_config(config: DecoderConfig, mesh: Mesh) -> None:
+    tp = mesh.shape["tensor"]
+    if config.n_kv_heads % tp or config.n_heads % tp:
+        raise ValueError(
+            f"tensor={tp} must divide n_heads={config.n_heads} and "
+            f"n_kv_heads={config.n_kv_heads}")
+    if config.d_ff % tp:
+        raise ValueError(f"tensor={tp} must divide d_ff={config.d_ff}")
+
+
+def shard_params(params: dict, mesh: Mesh) -> dict:
+    """Place engine params tensor-parallel on the mesh."""
+    return {
+        name: jax.device_put(value, NamedSharding(mesh, PARAM_SPECS[name]))
+        for name, value in params.items()
+    }
+
+
+def shard_pool(pool: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place a KV page pool with its head axis split across the mesh."""
+    return jax.device_put(pool, NamedSharding(mesh, POOL_SPEC))

@@ -454,3 +454,16 @@ def test_nas_config_expands_to_parameters():
     params = created["spec"]["parameters"]
     assert [p["name"] for p in params] == ["layer_0_op", "layer_1_op", "layer_2_op"]
     assert params[0]["feasibleSpace"]["list"] == ["conv3", "skip"]
+
+
+def test_obslog_sanitizer_builds():
+    """SURVEY.md §5: the C++ observation-log core builds under ASAN/TSAN."""
+    import os
+    import subprocess
+
+    d = os.path.join(os.path.dirname(__file__), "..", "kubeflow_tpu", "katib")
+    try:
+        for target in ("asan", "tsan"):
+            subprocess.run(["make", target], cwd=d, check=True, capture_output=True)
+    finally:
+        subprocess.run(["make", "clean"], cwd=d, capture_output=True)

@@ -299,3 +299,16 @@ def test_cron_parse_and_next_fire():
         cron.parse("61 * * * *")
     with pytest.raises(ValueError):
         cron.parse("* * * *")
+
+
+def test_metadata_sanitizer_builds():
+    """SURVEY.md §5: the C++ metadata core builds under ASAN/TSAN."""
+    import os
+    import subprocess
+
+    d = os.path.join(os.path.dirname(__file__), "..", "kubeflow_tpu", "pipelines")
+    try:
+        for target in ("asan", "tsan"):
+            subprocess.run(["make", target], cwd=d, check=True, capture_output=True)
+    finally:
+        subprocess.run(["make", "clean"], cwd=d, capture_output=True)
