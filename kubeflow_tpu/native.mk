@@ -1,0 +1,23 @@
+# Shared build rules for the first-party C++ cores (include from a component
+# Makefile after setting NAME and SRC). The Python bindings auto-build on
+# import via utils/native_build.py (content-hashed cache _$(NAME)_<hash>.so);
+# these targets are the manual + sanitizer builds (SURVEY.md §5).
+CXX ?= g++
+CXXFLAGS ?= -O2 -fPIC -shared -std=c++17 -Wall -Wextra -pthread
+
+all: _$(NAME).so
+
+_$(NAME).so: $(SRC)
+	$(CXX) $(CXXFLAGS) $< -o $@
+
+asan: $(SRC)
+	$(CXX) $(CXXFLAGS) -fsanitize=address -g $< -o _$(NAME)_asan.so
+
+tsan: $(SRC)
+	$(CXX) $(CXXFLAGS) -fsanitize=thread -g $< -o _$(NAME)_tsan.so
+
+# precise: never touch the import-time build cache (_$(NAME)_<hash>.so)
+clean:
+	rm -f _$(NAME).so _$(NAME)_asan.so _$(NAME)_tsan.so
+
+.PHONY: all asan tsan clean

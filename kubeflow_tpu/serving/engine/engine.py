@@ -83,21 +83,25 @@ class Engine:
         c = config
         shape = (c.n_layers, engine_config.num_pages, engine_config.page_size,
                  c.n_kv_heads, c.head_dim)
-        self.k_pool = jnp.zeros(shape, jnp.bfloat16)
-        self.v_pool = jnp.zeros(shape, jnp.bfloat16)
         self._paged = (engine_config.paged_kernel if engine_config.paged_kernel is not None
                        else os.environ.get("ENGINE_PAGED_KERNEL") == "1")
         if engine_config.tensor_parallel > 1:
-            from .sharding import shard_params, shard_pool, tensor_mesh, validate_config
+            from .sharding import alloc_pool, shard_params, tensor_mesh, validate_config
 
             if self._paged:  # check the RESOLVED flag: the env gate counts too
                 raise ValueError("paged_kernel and tensor_parallel are exclusive "
                                  "(the Pallas kernel is single-device)")
             mesh = tensor_mesh(engine_config.tensor_parallel)
             validate_config(c, mesh)
+            # pools are allocated sharded-direct and params stream per-leaf to
+            # their shards (pass host/numpy arrays for models that don't fit
+            # one chip — that's the whole point of TP serving)
             self.params = shard_params(self.params, mesh)
-            self.k_pool = shard_pool(self.k_pool, mesh)
-            self.v_pool = shard_pool(self.v_pool, mesh)
+            self.k_pool = alloc_pool(shape, mesh)
+            self.v_pool = alloc_pool(shape, mesh)
+        else:
+            self.k_pool = jnp.zeros(shape, jnp.bfloat16)
+            self.v_pool = jnp.zeros(shape, jnp.bfloat16)
         if engine_config.prefill_chunk % engine_config.page_size != 0:
             raise ValueError("prefill_chunk must be a multiple of page_size")
         self._requests: dict[int, _Pending] = {}

@@ -63,6 +63,7 @@ def test_native_batcher_rejects_pool_unfittable_prompt():
     b.close()
 
 
+@pytest.mark.slow
 def test_chunked_prefill_long_prompt_matches_oracle(params):
     """A prompt longer than prefill_chunk is prefilled in page-aligned chunks
     (interleaved with decode); the generation must still equal the oracle,
@@ -287,6 +288,7 @@ def test_engine_paged_kernel_env_gate(params, monkeypatch):
 
 # -------------------------------------------------------- tensor parallel
 
+@pytest.mark.slow
 def test_tensor_parallel_engine_matches_oracle(params):
     """TP serving (SURVEY.md §2c TP row): params + KV pool sharded over a
     2-device GSPMD mesh; generations must equal the single-device oracle and

@@ -456,6 +456,7 @@ def test_nas_config_expands_to_parameters():
     assert params[0]["feasibleSpace"]["list"] == ["conv3", "skip"]
 
 
+@pytest.mark.slow
 def test_obslog_sanitizer_builds():
     """SURVEY.md §5: the C++ observation-log core builds under ASAN/TSAN."""
     import os

@@ -301,6 +301,7 @@ def test_cron_parse_and_next_fire():
         cron.parse("* * * *")
 
 
+@pytest.mark.slow
 def test_metadata_sanitizer_builds():
     """SURVEY.md §5: the C++ metadata core builds under ASAN/TSAN."""
     import os
