@@ -17,6 +17,7 @@ do worse than reproduce r1.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -96,8 +97,12 @@ def main() -> None:
 
     chosen = None
     best_rate = 0.0
+    probe_deadline = time.monotonic() + float(os.environ.get("BENCH_PROBE_BUDGET_S", "300"))
     if on_tpu:
         for cand in CANDIDATES:
+            if time.monotonic() > probe_deadline:
+                print(f"bench: probe budget exhausted before {cand}", file=sys.stderr)
+                break
             trainer = None
             try:
                 trainer, data, flops = _build(cand, batch_size, seq_len, max_predictions, steps)

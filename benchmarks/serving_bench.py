@@ -40,6 +40,13 @@ def main() -> None:
     p.add_argument("--concurrency", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--max-tokens", type=int, default=64)
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="shard params+KV pool over N chips (sharding.py)")
+    p.add_argument("--long-prompt-frac", type=float, default=0.0,
+                   help="fraction of requests with 4x-length prompts (exercises "
+                        "chunked prefill under concurrent decode)")
+    p.add_argument("--paged-kernel", action="store_true",
+                   help="use the Pallas paged-attention decode path")
     args = p.parse_args()
 
     import jax
@@ -54,19 +61,29 @@ def main() -> None:
     engine = Engine(
         params, config,
         EngineConfig(max_slots=args.concurrency, num_pages=1024, page_size=32,
-                     max_pages_per_slot=(args.prompt_len + args.max_tokens) // 32 + 2),
+                     max_pages_per_slot=(4 * args.prompt_len + args.max_tokens) // 32 + 2,
+                     tensor_parallel=args.tensor_parallel,
+                     paged_kernel=args.paged_kernel or None),
     )
     engine.start()
     rng = np.random.default_rng(0)
 
-    def prompt():
-        return rng.integers(1, config.vocab_size, size=args.prompt_len).tolist()
+    # deterministic long/short interleaving with an exact realized fraction:
+    # request i is long iff the running long-count stays under i*frac
+    n_long = round(args.requests * args.long_prompt_frac)
+    long_idx = set(np.linspace(0, args.requests - 1, n_long, dtype=int).tolist()) if n_long else set()
 
-    # warmup: compile prefill bucket + decode step
+    def prompt(i=None):
+        n = 4 * args.prompt_len if i in long_idx else args.prompt_len
+        return rng.integers(1, config.vocab_size, size=n).tolist()
+
+    # warmup: compile the short AND (if used) long prefill paths + decode step
     engine.generate(prompt(), 4)
+    if long_idx:
+        engine.generate(prompt(next(iter(long_idx))), 4)
 
     t0 = time.perf_counter()
-    futs = [engine.generate_async(prompt(), args.max_tokens) for _ in range(args.requests)]
+    futs = [engine.generate_async(prompt(i), args.max_tokens) for i in range(args.requests)]
     results = [f.result(timeout=1800) for f in futs]
     wall = time.perf_counter() - t0
     engine.stop()
@@ -87,6 +104,10 @@ def main() -> None:
         "prompt_len": args.prompt_len,
         "max_tokens": args.max_tokens,
         "param_count": config.param_count(),
+        "tensor_parallel": args.tensor_parallel,
+        "long_prompt_frac": args.long_prompt_frac,
+        "paged_kernel": engine._paged,
+        "long_requests": len(long_idx),
         "platform": jax.devices()[0].platform,
         "on_tpu": on_tpu,
     }))

@@ -78,11 +78,6 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
     }
 
 
-def shard_pool(pool: jax.Array, mesh: Mesh) -> jax.Array:
-    """Place a KV page pool with its head axis split across the mesh."""
-    return jax.device_put(pool, NamedSharding(mesh, POOL_SPEC))
-
-
 def alloc_pool(shape: tuple, mesh: Mesh, dtype=None) -> jax.Array:
     """Allocate a zeroed pool sharded-direct — no chip ever holds the full
     pool (allocating replicated first would OOM exactly the models TP serves)."""

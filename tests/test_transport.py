@@ -180,6 +180,7 @@ def test_resnet_ddp_through_shim_matches_single_process(tmp_path):
     assert abs(ref_loss - shim_losses.pop()) < 5e-2, (ref_loss, shim_losses)
 
 
+@pytest.mark.slow
 def test_sanitizer_builds():
     """SURVEY.md §5: the C++ core must build under ASAN and TSAN."""
     d = os.path.join(os.path.dirname(__file__), "..", "kubeflow_tpu", "transport")
