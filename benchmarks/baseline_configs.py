@@ -182,8 +182,31 @@ def bench_gemma() -> dict:
             "ok": rec.get("phase") == "Succeeded", "e2e_wall_s": round(wall, 2)}
 
 
+def bench_serving() -> dict:
+    """BASELINE config[3]: serving latency via serving_bench.py. On the CPU
+    box this is a smoke-scale tiny-model run (the real p50 row needs the
+    chip: ``--config 1b`` / ``llama3_8b`` there); recorded with its platform
+    so it can't be mistaken for the chip number."""
+    import subprocess
+
+    on_cpu_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "serving_bench.py"),
+         "--config", "tiny", "--requests", "16", "--concurrency", "4",
+         "--prompt-len", "32", "--max-tokens", "16", "--long-prompt-frac", "0.25"],
+        env=on_cpu_env, capture_output=True, text=True, timeout=900,
+    )
+    line = [x for x in out.stdout.splitlines() if x.startswith("{")]
+    if not line:
+        return {"config": "kserve_serving_latency", "ok": False,
+                "error": out.stderr[-300:]}
+    rec = json.loads(line[-1])
+    return {"config": "kserve_serving_latency", "ok": True, **rec}
+
+
 BENCHES = {"mnist": bench_mnist, "katib": bench_katib,
-           "resnet": bench_resnet, "gemma": bench_gemma}
+           "resnet": bench_resnet, "gemma": bench_gemma,
+           "serving": bench_serving}
 
 
 def main() -> None:

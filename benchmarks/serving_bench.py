@@ -18,7 +18,11 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def configs():
@@ -50,6 +54,15 @@ def main() -> None:
     args = p.parse_args()
 
     import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the axon sitecustomize pins platforms via jax.config at interpreter
+        # start, masking the env var; honor the explicit request (and avoid
+        # minutes-long hangs when the TPU tunnel is down)
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
     import numpy as np
 
     from kubeflow_tpu.serving.engine import Engine, EngineConfig

@@ -237,6 +237,17 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--port", type=int, required=True)
     args = p.parse_args(argv)
 
+    if os.environ.get("JAX_PLATFORMS"):
+        # sandbox sitecustomize pins jax platforms via jax.config at
+        # interpreter start, masking the env var; honor the operator's
+        # explicit platform request before any loader touches jax
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+
     model = load_model(args.loader, args.model_name, args.model_dir)
     # KServe-agent wrappers (SURVEY.md §2a agent row), controller-injected:
     # batcher innermost (coalesces model calls), logger outermost (logs the
