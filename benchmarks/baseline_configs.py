@@ -190,17 +190,24 @@ def bench_serving() -> dict:
     import subprocess
 
     on_cpu_env = dict(os.environ, JAX_PLATFORMS="cpu")
-    out = subprocess.run(
-        [sys.executable, os.path.join(os.path.dirname(__file__), "serving_bench.py"),
-         "--config", "tiny", "--requests", "16", "--concurrency", "4",
-         "--prompt-len", "32", "--max-tokens", "16", "--long-prompt-frac", "0.25"],
-        env=on_cpu_env, capture_output=True, text=True, timeout=900,
-    )
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(__file__), "serving_bench.py"),
+             "--config", "tiny", "--requests", "16", "--concurrency", "4",
+             "--prompt-len", "32", "--max-tokens", "16", "--long-prompt-frac", "0.25"],
+            env=on_cpu_env, capture_output=True, text=True, timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        return {"config": "kserve_serving_latency", "ok": False, "error": "timeout (900s)"}
     line = [x for x in out.stdout.splitlines() if x.startswith("{")]
-    if not line:
+    if out.returncode != 0 or not line:
         return {"config": "kserve_serving_latency", "ok": False,
-                "error": out.stderr[-300:]}
-    rec = json.loads(line[-1])
+                "error": (out.stderr or out.stdout)[-300:]}
+    try:
+        rec = json.loads(line[-1])
+    except ValueError:
+        return {"config": "kserve_serving_latency", "ok": False,
+                "error": f"bad JSON: {line[-1][:200]}"}
     return {"config": "kserve_serving_latency", "ok": True, **rec}
 
 

@@ -55,14 +55,9 @@ def main() -> None:
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # the axon sitecustomize pins platforms via jax.config at interpreter
-        # start, masking the env var; honor the explicit request (and avoid
-        # minutes-long hangs when the TPU tunnel is down)
-        try:
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception:
-            pass
+    from kubeflow_tpu.utils.jax_platform import honor_jax_platforms
+
+    honor_jax_platforms()
     import numpy as np
 
     from kubeflow_tpu.serving.engine import Engine, EngineConfig

@@ -20,19 +20,6 @@ import os
 import time
 
 
-def _apply_platform_env() -> None:
-    """Honor JAX_PLATFORMS even under the axon sitecustomize, which pins
-    platforms via jax.config at interpreter start (masking the env var);
-    with the TPU tunnel down that pin kills CPU-only workers."""
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception:
-            pass
-
-
 def _map_torch_env() -> None:
     """MASTER_ADDR/RANK/WORLD_SIZE → the JAX coordinator env (torch compat)."""
     env = os.environ
@@ -100,7 +87,9 @@ def main_shim() -> None:
 
 
 def main() -> None:
-    _apply_platform_env()
+    from kubeflow_tpu.utils.jax_platform import honor_jax_platforms
+
+    honor_jax_platforms()
     if os.environ.get("DDP_TRANSPORT") == "shim":
         main_shim()
         return

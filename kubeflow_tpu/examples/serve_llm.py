@@ -26,13 +26,9 @@ def main() -> None:
     p.add_argument("--max-tokens", type=int, default=16)
     args = p.parse_args()
 
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
+    from kubeflow_tpu.utils.jax_platform import honor_jax_platforms
 
-        try:
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception:
-            pass
+    honor_jax_platforms()
 
     from kubeflow_tpu.core.cluster import Cluster
     from kubeflow_tpu.serving import install
@@ -50,8 +46,11 @@ def main() -> None:
 
     # the jetstream runtime requests google.com/tpu, so give the simulated
     # cluster a slice (its nodes run pods as local processes)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    pythonpath = repo + (os.pathsep + os.environ["PYTHONPATH"]
+                         if os.environ.get("PYTHONPATH") else "")
     cluster = Cluster(cpu_nodes=1, tpu_slices=(("s0", "v5e", "2x2"),),
-                      base_env={"PYTHONPATH": os.getcwd()})
+                      base_env={"PYTHONPATH": pythonpath})
     router, proxy = install(cluster.api, cluster.manager)
     try:
         cluster.apply(inference_service(

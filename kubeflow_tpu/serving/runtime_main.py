@@ -237,16 +237,12 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--port", type=int, required=True)
     args = p.parse_args(argv)
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # sandbox sitecustomize pins jax platforms via jax.config at
-        # interpreter start, masking the env var; honor the operator's
-        # explicit platform request before any loader touches jax
-        import jax
+    if args.loader in ("jax", "jetstream"):
+        # only jax-backed loaders pay the jax import; sklearn/pyfunc pods
+        # must not grow a jax dependency or its multi-second startup cost
+        from ..utils.jax_platform import honor_jax_platforms
 
-        try:
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception:
-            pass
+        honor_jax_platforms()
 
     model = load_model(args.loader, args.model_name, args.model_dir)
     # KServe-agent wrappers (SURVEY.md §2a agent row), controller-injected:

@@ -1,0 +1,30 @@
+"""One place for the sandbox JAX-platform workaround.
+
+Some sandboxes (the axon TPU tunnel image) pre-set ``jax_platforms`` via
+``jax.config`` in a sitecustomize at interpreter start, which silently masks
+the ``JAX_PLATFORMS`` env var — and when the tunnel is down, the first device
+touch hangs for minutes before dying UNAVAILABLE.  Every entrypoint that must
+honor an operator's explicit platform request (benches, runtime pods, worker
+examples) calls this once before touching devices.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms() -> None:
+    """Re-apply the JAX_PLATFORMS env var over any sitecustomize config pin.
+
+    No-op when the env var is unset; best-effort when backends are already
+    initialized (jax.config raises — the device set is fixed by then).
+    """
+    requested = os.environ.get("JAX_PLATFORMS")
+    if not requested:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", requested)
+    except Exception:
+        pass
