@@ -1,0 +1,125 @@
+"""DARTS trial workload: differentiable architecture search in one trial.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2a suggestion-services row): Katib's
+DARTS support runs the WHOLE differentiable search inside a single trial
+container (`[U:katib/examples/v1beta1/nas/darts-cnn-cifar10]`), with the
+suggestion service only emitting algorithm settings — unlike ENAS, where the
+controller lives in the service (kt/katib/suggest/enas.py).  This worker is
+that trial container, TPU-first: the supernet is one jitted bilevel step
+(weights on train batch, architecture logits on validation batch) — no
+Python-side per-edge loops.
+
+Search space: a chain of ``NUM_LAYERS`` mixed ops, each a softmax-weighted
+combination of {linear, relu-linear, skip, zero}.  Synthetic task: the target
+function is a composition that favors relu-linear early and skip late, so a
+correct search must produce a non-uniform, better-than-random architecture.
+Prints Katib-style metrics (``val_acc=...``) plus the discovered genotype.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def main() -> None:
+    from kubeflow_tpu.utils.jax_platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    num_layers = int(os.environ.get("NUM_LAYERS", "4"))
+    dim = int(os.environ.get("DIM", "16"))
+    steps = int(os.environ.get("SEARCH_STEPS", "150"))
+    seed = int(os.environ.get("SEED", "0"))
+
+    OPS = ("linear", "relu_linear", "skip", "zero")
+
+    key = jax.random.PRNGKey(seed)
+    k_w, k_data, k_tgt = jax.random.split(key, 3)
+
+    # supernet weights: one kernel per (layer, op-with-weights)
+    weights = {
+        "linear": jax.random.normal(k_w, (num_layers, dim, dim)) * 0.3,
+        "relu_linear": jax.random.normal(jax.random.fold_in(k_w, 1), (num_layers, dim, dim)) * 0.3,
+    }
+    alphas = jnp.zeros((num_layers, len(OPS)))  # architecture logits
+
+    # synthetic target: a relu-linear stack — only the relu_linear op can
+    # represent it, so the recoverable genotype is all-relu_linear and any
+    # linear/skip/zero choice measurably hurts the discretized architecture
+    tgt = jax.random.normal(k_tgt, (num_layers, dim, dim)) * 0.3
+
+    def target_fn(x):
+        h = x
+        for l in range(num_layers):
+            h = jax.nn.relu(h @ tgt[l])
+        return h
+
+    def mixed_layer(h, w_lin, w_relu, a, tau):
+        # temperature-annealed mixture (SNAS-style): tau decays toward 0 so
+        # the relaxation sharpens to a discrete choice, closing the classic
+        # DARTS discretization gap
+        p = jax.nn.softmax(a / tau)
+        return (p[0] * (h @ w_lin)
+                + p[1] * jax.nn.relu(h @ w_relu)
+                + p[2] * h
+                + p[3] * jnp.zeros_like(h))
+
+    def forward(weights, alphas, x, tau):
+        h = x
+        for l in range(num_layers):
+            h = mixed_layer(h, weights["linear"][l], weights["relu_linear"][l], alphas[l], tau)
+        return h
+
+    def loss(weights, alphas, x, tau=1.0):
+        return jnp.mean((forward(weights, alphas, x, tau) - target_fn(x)) ** 2)
+
+    w_opt = optax.adam(3e-3)
+    a_opt = optax.adam(3e-2)
+    w_state = w_opt.init(weights)
+    a_state = a_opt.init(alphas)
+
+    @jax.jit
+    def step(weights, alphas, w_state, a_state, k, tau):
+        kt, kv = jax.random.split(k)
+        x_train = jax.random.normal(kt, (64, dim))
+        x_val = jax.random.normal(kv, (64, dim))
+        # bilevel (first-order DARTS): weights on train, alphas on validation
+        wl, w_grads = jax.value_and_grad(loss)(weights, alphas, x_train, tau)
+        w_updates, w_state = w_opt.update(w_grads, w_state)
+        weights = optax.apply_updates(weights, w_updates)
+        vl, a_grads = jax.value_and_grad(loss, argnums=1)(weights, alphas, x_val, tau)
+        a_updates, a_state = a_opt.update(a_grads, a_state)
+        alphas = optax.apply_updates(alphas, a_updates)
+        return weights, alphas, w_state, a_state, wl, vl
+
+    k = jax.random.fold_in(k_data, 0)
+    for i in range(steps):
+        k = jax.random.fold_in(k, i)
+        tau = jnp.maximum(1.0 - i / max(steps - 1, 1), 0.1)  # 1.0 → 0.1 anneal
+        weights, alphas, w_state, a_state, wl, vl = step(
+            weights, alphas, w_state, a_state, k, tau)
+        if (i + 1) % 50 == 0:
+            print(f"step={i + 1} train_loss={float(wl):.5f} val_loss={float(vl):.5f}", flush=True)
+
+    genotype = [OPS[int(i)] for i in jnp.argmax(alphas, axis=1)]
+    # score: 1 / (1 + val loss of the DISCRETIZED architecture)
+    import numpy as np
+
+    hard = jnp.full((num_layers, len(OPS)), -30.0)
+    hard = hard.at[jnp.arange(num_layers), jnp.argmax(alphas, axis=1)].set(30.0)
+    x_test = jax.random.normal(jax.random.PRNGKey(seed + 999), (256, dim))
+    disc_loss = float(loss(weights, hard, x_test))
+    val_acc = 1.0 / (1.0 + disc_loss)
+    print("genotype=" + json.dumps(genotype), flush=True)
+    print(f"val_acc={val_acc:.6f}", flush=True)
+    print(f"discretized_loss={disc_loss:.6f}", flush=True)
+    print("DARTS-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

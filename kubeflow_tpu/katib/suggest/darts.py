@@ -1,0 +1,38 @@
+"""DARTS suggester: one trial carrying the differentiable-search settings.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2a suggestion-services row): unlike
+ENAS (controller lives in the suggestion service — see enas.py), Katib's
+DARTS runs the whole search INSIDE a single trial container; the suggestion
+service emits exactly one suggestion whose parameters are the algorithm
+settings the trial workload consumes (num layers, search steps, seed).  The
+matching trial workload here is ``kubeflow_tpu/examples/darts_worker.py``.
+"""
+
+from __future__ import annotations
+
+from . import register
+from .space import param_specs, sample_one, settings_dict
+
+
+@register("darts")
+class DartsSuggester:
+    def suggest(self, experiment, trials, count):
+        settings = settings_dict(experiment)
+        base = {
+            "num_layers": str(settings.get("num_layers", 4)),
+            "search_steps": str(settings.get("search_steps", 150)),
+        }
+        import numpy as np
+
+        seed0 = int(settings.get("random_state", 0))
+        out = []
+        for i in range(count):
+            arch = dict(base)
+            arch["seed"] = str(seed0 + len(trials) + i)
+            # any declared experiment parameters (e.g. lr) ride along
+            rng = np.random.default_rng(seed0 + len(trials) + i)
+            for p in param_specs(experiment):
+                if p["name"] not in arch:
+                    arch[p["name"]] = sample_one(rng, p)
+            out.append(arch)
+        return out

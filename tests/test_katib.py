@@ -468,3 +468,61 @@ def test_obslog_sanitizer_builds():
             subprocess.run(["make", target], cwd=d, check=True, capture_output=True)
     finally:
         subprocess.run(["make", "clean"], cwd=d, capture_output=True)
+
+
+def test_darts_suggester_emits_search_settings():
+    """DARTS (upstream shape): the service emits one suggestion carrying the
+    search settings; the differentiable search runs inside the trial."""
+    exp = experiment(
+        "nasd", [Parameter("seed", "int", min=0, max=9999)],
+        {"kind": "TPUJob", "spec": {}}, "val_acc", algorithm="darts",
+        algorithm_settings={"num_layers": 4, "search_steps": 250, "random_state": 7},
+    )
+    out = get_suggester("darts").suggest(exp, [], 2)
+    assert len(out) == 2
+    assert out[0]["num_layers"] == "4" and out[0]["search_steps"] == "250"
+    assert out[0]["seed"] != out[1]["seed"]
+    assert out == get_suggester("darts").suggest(exp, [], 2)  # deterministic
+
+
+@pytest.mark.slow
+def test_darts_trial_e2e_recovers_genotype(kcluster):
+    """Full DARTS path: experiment → trial pod running the differentiable
+    search → objective from the discretized architecture; the synthetic
+    task's genotype (all relu_linear) must be recovered."""
+    trial_spec = {
+        "apiVersion": "kubeflow.org/v1", "kind": "TPUJob",
+        "spec": {"replicaSpecs": {"Worker": {
+            "replicas": 1, "restartPolicy": "Never",
+            "template": {"spec": {"containers": [{
+                "name": "main",
+                "command": [sys.executable, "-u", "-m", "kubeflow_tpu.examples.darts_worker"],
+                "env": [
+                    {"name": "JAX_PLATFORMS", "value": "cpu"},
+                    {"name": "PYTHONPATH", "value": "/root/repo"},
+                    {"name": "NUM_LAYERS", "value": "${trialParameters.numLayers}"},
+                    {"name": "SEARCH_STEPS", "value": "${trialParameters.searchSteps}"},
+                    {"name": "SEED", "value": "${trialParameters.seed}"},
+                ],
+            }]}},
+        }}},
+    }
+    spec = experiment(
+        "dartse", [Parameter("seed", "int", min=0, max=9999)], trial_spec,
+        "val_acc", algorithm="darts", max_trials=1,
+        algorithm_settings={"num_layers": "4", "search_steps": "300"},
+        trial_parameters=[
+            {"name": "numLayers", "reference": "num_layers"},
+            {"name": "searchSteps", "reference": "search_steps"},
+            {"name": "seed", "reference": "seed"},
+        ],
+    )
+    client = KatibClient(kcluster)
+    client.create_experiment(spec)
+    assert client.wait_for_experiment("dartse", timeout=600) == kapi.SUCCEEDED
+    optimal = client.get_optimal_trial("dartse")
+    acc = [m for m in optimal["observation"]["metrics"] if m["name"] == "val_acc"][0]["latest"]
+    assert acc > 0.5, acc  # discretized architecture fits the relu target
+    tname = optimal["bestTrialName"]
+    log = kcluster.logs(f"{tname}-worker-0")
+    assert '"relu_linear", "relu_linear", "relu_linear", "relu_linear"' in log
