@@ -108,8 +108,6 @@ def main() -> None:
 
     genotype = [OPS[int(i)] for i in jnp.argmax(alphas, axis=1)]
     # score: 1 / (1 + val loss of the DISCRETIZED architecture)
-    import numpy as np
-
     hard = jnp.full((num_layers, len(OPS)), -30.0)
     hard = hard.at[jnp.arange(num_layers), jnp.argmax(alphas, axis=1)].set(30.0)
     x_test = jax.random.normal(jax.random.PRNGKey(seed + 999), (256, dim))

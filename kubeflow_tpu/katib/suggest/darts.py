@@ -10,6 +10,8 @@ matching trial workload here is ``kubeflow_tpu/examples/darts_worker.py``.
 
 from __future__ import annotations
 
+import numpy as np
+
 from . import register
 from .space import param_specs, sample_one, settings_dict
 
@@ -22,15 +24,13 @@ class DartsSuggester:
             "num_layers": str(settings.get("num_layers", 4)),
             "search_steps": str(settings.get("search_steps", 150)),
         }
-        import numpy as np
-
         seed0 = int(settings.get("random_state", 0))
+        rng = np.random.default_rng(seed0 + len(trials))
         out = []
         for i in range(count):
             arch = dict(base)
             arch["seed"] = str(seed0 + len(trials) + i)
             # any declared experiment parameters (e.g. lr) ride along
-            rng = np.random.default_rng(seed0 + len(trials) + i)
             for p in param_specs(experiment):
                 if p["name"] not in arch:
                     arch[p["name"]] = sample_one(rng, p)
