@@ -9,11 +9,12 @@ that trial container, TPU-first: the supernet is one jitted bilevel step
 (weights on train batch, architecture logits on validation batch) — no
 Python-side per-edge loops.
 
-Search space: a chain of ``NUM_LAYERS`` mixed ops, each a softmax-weighted
-combination of {linear, relu-linear, skip, zero}.  Synthetic task: the target
-function is a composition that favors relu-linear early and skip late, so a
-correct search must produce a non-uniform, better-than-random architecture.
-Prints Katib-style metrics (``val_acc=...``) plus the discovered genotype.
+Search space: a chain of ``NUM_LAYERS`` mixed ops, each a temperature-
+annealed softmax mixture of {linear, relu-linear, skip, zero}.  Synthetic
+task: the target is a relu-linear stack, so only the all-relu_linear
+genotype can represent it — a correct search must recover it and any other
+choice measurably hurts the discretized architecture.  Prints Katib-style
+metrics (``val_acc=...``) plus the discovered genotype.
 """
 
 from __future__ import annotations
