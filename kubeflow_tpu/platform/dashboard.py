@@ -72,6 +72,59 @@ class Dashboard:
             for e in events[:limit]
         ]
 
+    def _used(self, namespace: str) -> dict[str, float]:
+        """Effective requests of live pods (pod_requests handles k8s
+        quantities, the requests-or-limits fallback and init containers)."""
+        from ..scheduler.topology import pod_requests
+
+        used: dict[str, float] = {}
+        for pod in self._safe_list("Pod", namespace):
+            if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            for res, amount in pod_requests(pod).items():
+                used[res] = used.get(res, 0.0) + amount
+        return used
+
+    def quota(self, namespace: str) -> dict:
+        """Profile resource-quota usage: requested (from live pods) vs the
+        hard limits the Profile controller materialized — the dashboard's
+        per-namespace capacity widget, incl. ``google.com/tpu`` chips."""
+        from ..scheduler.topology import parse_quantity
+
+        hard: dict = {}
+        for rq in self._safe_list("ResourceQuota", namespace):
+            for res, amount in (rq.get("spec", {}).get("hard") or {}).items():
+                # multiple quotas: the MOST RESTRICTIVE limit wins (k8s
+                # enforces every quota, so the effective cap is the min)
+                if res not in hard or parse_quantity(amount) < parse_quantity(hard[res]):
+                    hard[res] = amount
+        return {"namespace": namespace, "hard": hard, "used": self._used(namespace)}
+
+    def overview(self, user: str) -> dict:
+        """The landing page: every namespace the user can see with workload
+        counts, running totals and TPU chips in use — one call, the shape
+        the shell UI's namespace cards bind to."""
+        namespaces = self.kfam.namespaces_for(user)
+        cards = []
+        totals = {"workloads": 0, "running": 0, "tpu_chips_requested": 0.0}
+        for ns in namespaces:
+            counts: dict[str, int] = {}
+            running = 0
+            for kind in _WORKLOAD_KINDS:
+                objs = self._safe_list(kind, ns)
+                if objs:
+                    counts[kind] = len(objs)
+                    # notebooks report Ready, jobs report Running — both are
+                    # "actively running" on the landing page
+                    running += sum(_phase_of(o) in ("Running", "Ready") for o in objs)
+            chips = self._used(ns).get("google.com/tpu", 0.0)
+            cards.append({"namespace": ns, "workloads": counts,
+                          "running": running, "tpu_chips_requested": chips})
+            totals["workloads"] += sum(counts.values())
+            totals["running"] += running
+            totals["tpu_chips_requested"] += chips
+        return {"user": user, "namespaces": cards, "totals": totals}
+
 
 def _phase_of(obj: dict) -> str:
     status = obj.get("status", {})
