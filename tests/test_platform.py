@@ -254,3 +254,23 @@ def test_profile_rbac_authorizer_and_authenticated_api(platform):
     # cluster admin: everywhere, incl. cluster-scoped writes
     root = AuthenticatedAPI(c.api, "root@corp.io", authz)
     root.delete("Notebook", "nb", "team-a")
+
+
+def test_dashboard_composes_with_authenticated_api(platform):
+    """The dashboard data layer works over the per-user authz facade, so one
+    construction serves multi-tenant requests with enforcement for free."""
+    from kubeflow_tpu.core.authz import AuthenticatedAPI, ProfileRBACAuthorizer
+    from kubeflow_tpu.platform.dashboard import Dashboard
+
+    c, _ = platform
+    c.apply(papi.profile("own-ns", "owner@x.io", {"cpu": "8", "google.com/tpu": "8"}))
+    c.settle()
+    authz = ProfileRBACAuthorizer(c.api)
+    dash = Dashboard(AuthenticatedAPI(c.api, "owner@x.io", authz))
+    assert dash.summary("own-ns")["namespace"] == "own-ns"
+    assert dash.quota("own-ns")["hard"]  # profile-materialized quota visible
+    # a stranger's dashboard view of the same namespace is empty (every
+    # Forbidden list degrades to zero items), not an error
+    stranger = Dashboard(AuthenticatedAPI(c.api, "eve@x.io", authz))
+    assert all(r["count"] == 0 for r in stranger.summary("own-ns")["resources"].values())
+    assert stranger.quota("own-ns") == {"namespace": "own-ns", "hard": {}, "used": {}}
