@@ -2,7 +2,7 @@
 
 Usage: python benchmarks/mfu_sweep.py BATCH SEQ REMAT POLICY ATTN [STEPS]
   REMAT  = 0|1
-  POLICY = nothing|dots
+  POLICY = nothing|dots|save_qkv|save_attn   (models/bert.py remat policies)
   ATTN   = dense|flash
 
 Prints one JSON line with measured samples/s/chip + MFU, mirroring bench.py's
