@@ -5,162 +5,215 @@ Prints ONE JSON line: samples/sec/chip + MFU for the primary metric
 numbers — "establish" — so vs_baseline is reported against r1's established
 value, 1317.5 samples/s/chip at 46.77% MFU).
 
-Self-tuning (r2): the TPU tunnel was down for the whole build round, so the
-MFU levers (VERDICT r1 #1 — flash attention in the train path, selective
-remat policies) could not be measured interactively.  Instead the bench
-probes each candidate config briefly ON THE CHIP, picks the fastest, then
-takes the full measurement with it.  Any candidate that fails to compile or
-OOMs is skipped; the r1-proven config is always last, so the bench can never
-do worse than reproduce r1.
+Self-tuning, hang-proof (r2): the axon TPU tunnel wedges hard on some
+compiles (a Pallas kernel compile was observed to hang the remote-compile
+helper for >7 minutes and take the whole terminal with it), so every
+candidate config runs in its OWN subprocess (benchmarks/mfu_sweep.py) under
+a hard timeout.  The r1-proven config runs FIRST, locking in a floor; each
+later candidate can only improve the reported number.  A candidate that
+hangs, OOMs, or fails to compile is killed/skipped without poisoning the
+parent process, and the bench always prints a JSON line.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+REPO = os.path.dirname(os.path.abspath(__file__))
+
 R1_SAMPLES_PER_SEC_PER_CHIP = 1317.54  # BENCH_r01.json
 
-# (remat, policy, attention) — ordered by expected MFU, best first.
-#  * flash: Pallas kernel, no [B,H,S,T] tensor in HBM (padding-free batches)
-#  * save_qkv/save_attn: recompute everything except the named projections —
-#    cheaper backward than full recompute, more HBM
-#  * (True, "nothing", "dense") is the r1-proven 46.77% config
-# kept to 4 so the whole probe pass stays well inside the driver's bench
-# window (each candidate costs one compile, ~30-40s on chip)
-CANDIDATES = (
-    (True, "save_attn", "flash"),
-    (True, "nothing", "flash"),
-    (True, "save_attn", "dense"),
-    (True, "nothing", "dense"),
-)
+# (batch_per_chip, remat, policy, attention) — r1-proven floor first, then
+# levers (global batch = batch_per_chip * n_chips, matching r1's accounting):
+#  * save_qkv@1024: keep only the per-layer QKV projections (6.75G HBM),
+#    recompute the rest — cheaper backward than full recompute
+#  * save_attn@512: keep QKV + attention outputs (fits at half batch)
+#  * noremat@256/384: zero recompute — the whole remat tax (~25% of step
+#    FLOPs) comes back if the activations fit
+# flash (Pallas) is gated behind BENCH_TRY_FLASH=1: its compile is what
+# wedges the tunnel's remote-compile helper (observed r2); with the
+# subprocess sandbox it would only cost its own timeout, but a wedged
+# terminal poisons every LATER candidate, so keep it opt-in and last.
+CANDIDATES = [
+    (1024, 1, "nothing", "dense"),   # r1 floor — always first
+    (1024, 1, "save_qkv", "dense"),
+    (512, 1, "save_attn", "dense"),
+    (256, 0, "nothing", "dense"),
+    (384, 0, "nothing", "dense"),
+]
+if os.environ.get("BENCH_TRY_FLASH") == "1":
+    CANDIDATES.append((512, 0, "nothing", "flash"))
+
+PER_CANDIDATE_TIMEOUT_S = float(os.environ.get("BENCH_CANDIDATE_TIMEOUT_S", "300"))
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+STEPS = int(os.environ.get("BENCH_STEPS", "8"))
 
 
-def _build(config_args, batch_size, seq_len, max_predictions, steps):
-    import jax
-
-    from kubeflow_tpu.models import bert
-    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
-    from kubeflow_tpu.train.data import synthetic_mlm_batches
-    from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
-
-    remat, policy, attn = config_args
-    devices = jax.devices()
-    mesh = build_mesh(MeshConfig(data=1, fsdp=len(devices), tensor=1), devices)
-    config = bert.BertConfig(remat=remat, remat_policy=policy, attention=attn)
-    params = bert.init(jax.random.PRNGKey(0), config)
-
-    def loss_fn(p, b):
-        # padding-free pretraining batches: mask=None on every path (the
-        # all-ones mask is a no-op for dense and unsupported by flash)
-        return bert.mlm_loss(p, config, b["input_ids"], b["labels"], None,
-                             max_predictions=max_predictions)
-
-    flops = config.train_flops(batch_size, seq_len, max_predictions)
-    trainer = Trainer(
-        loss_fn, params, mesh, bert.SHARDING_RULES,
-        TrainerConfig(learning_rate=1e-4, warmup_steps=2, total_steps=steps + 8),
-        flops_per_batch=flops,
-    )
-    data = synthetic_mlm_batches(config.vocab_size, batch_size, seq_len)
-    return trainer, data, flops
+def _sweep_env() -> dict:
+    env = dict(os.environ)
+    # keep the sandbox's sitecustomize dir (axon backend registration) AND
+    # make kubeflow_tpu importable from the subprocess
+    parts = [REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+    return env
 
 
-def _measure(trainer, data, steps) -> float:
-    """Steps/sec over an async window fenced by a value fetch."""
-    for _ in range(2):
-        m = trainer.train_step(next(data), sync=False)
-    float(m["loss"])  # fence: a value fetch is a true data dependency
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m = trainer.train_step(next(data), sync=False)
-    float(m["loss"])
-    return steps / (time.perf_counter() - t0)
+def _run(cmd, timeout_s: float, env: dict):
+    """subprocess.run(capture_output=True) that cannot hang past timeout_s:
+    the child gets its own process group, and on timeout the WHOLE group is
+    killed — a wedged grandchild holding the capture pipes would otherwise
+    block communicate() forever after the direct child dies.
+
+    Returns (returncode, stdout, stderr); returncode None on timeout."""
+    import signal
+
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=env, cwd=REPO, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return None, "", ""
+    return proc.returncode, out or "", err or ""
+
+
+def _parse_sweep_output(stdout: str):
+    """Last JSON line with the sweep's result key, or None."""
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "samples_per_sec_per_chip" in rec:
+            return rec
+    return None
+
+
+def _run_candidate(cand, n_chips: int, timeout_s: float):
+    batch, remat, policy, attn = cand
+    cmd = [sys.executable, os.path.join(REPO, "benchmarks", "mfu_sweep.py"),
+           str(batch * n_chips), "128", str(remat), policy, attn, str(STEPS)]
+    rc, out, err = _run(cmd, timeout_s, _sweep_env())
+    if rc is None:
+        print(f"bench: candidate {cand} timed out after {timeout_s:.0f}s",
+              file=sys.stderr)
+        return None
+    if rc != 0:
+        tail = err.strip().splitlines()[-1:] or ["?"]
+        print(f"bench: candidate {cand} failed rc={rc}: {tail[0][:200]}",
+              file=sys.stderr)
+        return None
+    rec = _parse_sweep_output(out)
+    if rec is None:
+        print(f"bench: candidate {cand} produced no JSON line", file=sys.stderr)
+    return rec
+
+
+def _tpu_preflight(timeout_s: float = 120.0) -> int:
+    """Chip count if the TPU answers AT ALL, else 0 — checked before spending
+    candidate budget. Subprocess: a wedged tunnel hangs jax.devices() for
+    minutes."""
+    rc, out, _ = _run(
+        [sys.executable, "-c",
+         "import jax; ds = jax.devices(); "
+         "print(len(ds) if ds[0].platform == 'tpu' else 0)"],
+        timeout_s, _sweep_env())
+    if rc != 0:
+        return 0
+    try:
+        return int(out.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return 0
+
+
+def _cpu_fallback(timeout_s: float) -> dict | None:
+    """No TPU (or every candidate failed): measure a tiny CPU run in a
+    subprocess so the bench still prints a line the driver can record."""
+    env = _sweep_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.join(REPO, "benchmarks", "mfu_sweep.py"),
+           "8", "128", "0", "nothing", "dense", "2"]
+    rc, out, _ = _run(cmd, timeout_s, env)
+    if rc != 0:
+        return None
+    return _parse_sweep_output(out)
 
 
 def main() -> None:
-    import jax
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+    best = None
+    n_chips = _tpu_preflight()
+    if not n_chips:
+        print("bench: TPU preflight failed — skipping chip candidates",
+              file=sys.stderr)
+    floor_ok = False
+    for cand in CANDIDATES if n_chips else []:
+        remaining = deadline - time.monotonic()
+        if remaining <= 30:
+            print(f"bench: budget exhausted before {cand}", file=sys.stderr)
+            break
+        rec = _run_candidate(cand, n_chips, min(PER_CANDIDATE_TIMEOUT_S, remaining))
+        if rec is None:
+            continue
+        floor_ok = floor_ok or cand == CANDIDATES[0]
+        print(f"bench: {cand} -> {rec['samples_per_sec_per_chip']} samples/s/chip"
+              f" (mfu {rec.get('mfu', 0)})", file=sys.stderr)
+        if best is None or rec["samples_per_sec_per_chip"] > best["samples_per_sec_per_chip"]:
+            best = rec
+    # floor guarantee: if the winner landed below r1 but the r1-proven config
+    # never got a measurement (transient failure/timeout), retry it once
+    if (n_chips and best is not None and not floor_ok
+            and best["samples_per_sec_per_chip"] < R1_SAMPLES_PER_SEC_PER_CHIP
+            and deadline - time.monotonic() > 60):
+        rec = _run_candidate(CANDIDATES[0], n_chips,
+                             min(PER_CANDIDATE_TIMEOUT_S, deadline - time.monotonic()))
+        if rec is not None and rec["samples_per_sec_per_chip"] > best["samples_per_sec_per_chip"]:
+            best = rec
+    on_tpu = best is not None
+    if best is None:
+        # the CPU line must still print even with the budget gone, so keep a
+        # floor — but honor remaining budget when there is some
+        best = _cpu_fallback(max(180.0, deadline - time.monotonic()))
+        on_tpu = False
+    if best is None:
+        # zero run, full schema (keep every key BENCH_r01.json consumers read)
+        print(json.dumps({
+            "metric": "bert_base_mlm_samples_per_sec_per_chip", "value": 0.0,
+            "unit": "samples/s/chip", "vs_baseline": 0.0, "mfu": 0.0,
+            "config": {"batch_size": 0, "remat": False,
+                       "remat_policy": "nothing", "attention": "dense"},
+            "batch_size": 0, "seq_len": 128, "n_chips": 0, "platform": "none",
+            "step_time_ms": 0.0,
+            "error": "tpu unreachable and cpu fallback failed",
+        }))
+        return
 
-    from kubeflow_tpu.scheduler.topology import VARIANTS, variant_for_device_kind
-
-    devices = jax.devices()
-    on_tpu = devices[0].platform == "tpu"
-    n_chips = len(devices)
-    variant = variant_for_device_kind(getattr(devices[0], "device_kind", "")) if on_tpu else "v5e"
-
-    seq_len = 128
-    max_predictions = 20  # standard BERT masking budget for seq 128
-    batch_size = 1024 * n_chips if on_tpu else 8
-    steps = 10 if on_tpu else 2
-
-    chosen = None
-    best_rate = 0.0
-    probe_deadline = time.monotonic() + float(os.environ.get("BENCH_PROBE_BUDGET_S", "300"))
-    if on_tpu:
-        for cand in CANDIDATES:
-            if time.monotonic() > probe_deadline:
-                print(f"bench: probe budget exhausted before {cand}", file=sys.stderr)
-                break
-            trainer = None
-            try:
-                trainer, data, flops = _build(cand, batch_size, seq_len, max_predictions, steps)
-                rate = _measure(trainer, data, 3)  # short probe
-            except Exception as e:
-                print(f"bench: candidate {cand} skipped: {type(e).__name__}: {e}",
-                      file=sys.stderr)
-                continue  # failed to compile / OOM: skip this candidate
-            finally:
-                del trainer  # free HBM before the next candidate
-            if rate > best_rate:
-                best_rate, chosen = rate, cand
-    fallback = CANDIDATES[-1] if on_tpu else (False, "nothing", "dense")
-    if chosen is None:
-        chosen = fallback
-
-    trainer, data, flops = _build(chosen, batch_size, seq_len, max_predictions, steps)
-    rate = _measure(trainer, data, steps)  # full window on the winner
-    if on_tpu and chosen != fallback and variant == "v5e":
-        # enforce "never worse than r1" (r1 measured on v5e, so the absolute
-        # floor only applies there): the 3-step probe is noisy, so if the
-        # winner's full window lost to the r1 rate, re-measure the r1 config
-        # and report whichever full window is actually faster
-        if batch_size * rate / n_chips < R1_SAMPLES_PER_SEC_PER_CHIP:
-            del trainer
-            try:
-                fb_trainer, fb_data, fb_flops = _build(
-                    fallback, batch_size, seq_len, max_predictions, steps)
-                fb_rate = _measure(fb_trainer, fb_data, steps)
-                if fb_rate > rate:
-                    chosen, rate, flops = fallback, fb_rate, fb_flops
-                trainer = fb_trainer
-            except Exception as e:
-                print(f"bench: fallback re-measure failed: {e}", file=sys.stderr)
-    dt_per_step = 1.0 / rate
-    samples_per_sec_per_chip = batch_size * rate / n_chips
-    peak = VARIANTS[variant].flops_bf16 if on_tpu else 1.0
-    mfu = (flops * rate) / (n_chips * peak) if on_tpu else 0.0
-
-    remat, policy, attn = chosen
-    print(
-        json.dumps(
-            {
-                "metric": "bert_base_mlm_samples_per_sec_per_chip",
-                "value": round(samples_per_sec_per_chip, 2),
-                "unit": "samples/s/chip",
-                "vs_baseline": round(samples_per_sec_per_chip / R1_SAMPLES_PER_SEC_PER_CHIP, 4)
-                if on_tpu else 1.0,
-                "mfu": round(mfu, 4),
-                "config": {"remat": remat, "remat_policy": policy, "attention": attn},
-                "batch_size": batch_size,
-                "seq_len": seq_len,
-                "n_chips": n_chips,
-                "platform": devices[0].platform,
-                "step_time_ms": round(1000 * dt_per_step, 2),
-            }
-        )
-    )
+    print(json.dumps({
+        "metric": "bert_base_mlm_samples_per_sec_per_chip",
+        "value": best["samples_per_sec_per_chip"],
+        "unit": "samples/s/chip",
+        "vs_baseline": round(best["samples_per_sec_per_chip"] / R1_SAMPLES_PER_SEC_PER_CHIP, 4)
+        if on_tpu else 1.0,
+        "mfu": best.get("mfu", 0.0),
+        "config": {"batch_size": best["batch"], "remat": bool(best["remat"]),
+                   "remat_policy": best["policy"], "attention": best["attn"]},
+        "batch_size": best["batch"],
+        "seq_len": best["seq"],
+        "n_chips": best.get("n_chips", 1),
+        "platform": best.get("platform", "tpu" if on_tpu else "cpu"),
+        "step_time_ms": best["step_time_ms"],
+    }))
 
 
 if __name__ == "__main__":

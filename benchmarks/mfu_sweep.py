@@ -3,7 +3,9 @@
 Usage: python benchmarks/mfu_sweep.py BATCH SEQ REMAT POLICY ATTN [STEPS]
   REMAT  = 0|1
   POLICY = nothing|dots|save_qkv|save_attn   (models/bert.py remat policies)
-  ATTN   = dense|flash
+  ATTN   = dense|dense_mask|flash
+           (dense = padding-free, mask=None — the r1 bench workload;
+            dense_mask = all-ones padding mask through the masked path)
 
 Prints one JSON line with measured samples/s/chip + MFU, mirroring bench.py's
 accounting (fwd+bwd matmul FLOPs, MLM head on 20 predictions at seq 128 /
@@ -18,6 +20,10 @@ import time
 
 
 def main() -> None:
+    from kubeflow_tpu.utils.jax_platform import honor_jax_platforms
+
+    honor_jax_platforms()  # bench.py's CPU fallback sets JAX_PLATFORMS=cpu
+
     import jax
 
     from kubeflow_tpu.models import bert
@@ -44,7 +50,7 @@ def main() -> None:
     max_predictions = max(20 * seq_len // 128, 1)
     params = bert.init(jax.random.PRNGKey(0), config)
 
-    use_mask = attn == "dense"  # dense_nomask / flash skip the padding mask
+    use_mask = attn == "dense_mask"  # dense / flash skip the padding mask
 
     def loss_fn(p, b):
         return bert.mlm_loss(p, config, b["input_ids"], b["labels"],
@@ -75,6 +81,7 @@ def main() -> None:
         "attn": attn, "mfu": round(mfu, 4),
         "samples_per_sec_per_chip": round(batch_size * steps / dt / n_chips, 2),
         "step_time_ms": round(1000 * dt / steps, 2),
+        "n_chips": n_chips, "platform": devices[0].platform,
     }))
 
 
