@@ -180,7 +180,9 @@ def main() -> None:
                              min(PER_CANDIDATE_TIMEOUT_S, deadline - time.monotonic()))
         if rec is not None and rec["samples_per_sec_per_chip"] > best["samples_per_sec_per_chip"]:
             best = rec
-    on_tpu = best is not None
+    # trust the sweep's own report, not "a candidate succeeded": a silent
+    # in-subprocess CPU fallback must not masquerade as a chip measurement
+    on_tpu = best is not None and best.get("platform") == "tpu"
     if best is None:
         # the CPU line must still print even with the budget gone, so keep a
         # floor — but honor remaining budget when there is some
