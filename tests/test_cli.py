@@ -1,0 +1,77 @@
+"""CLI (`python -m kubeflow_tpu`) — the kfctl/kubectl-shaped entry point.
+
+Mirrors SURVEY.md §3.1/§3.2: `apply -f job.yaml` must drive the real
+reconcile path (operator installs, gang scheduling, pod exec, status
+conditions) in one session, like `kfctl apply` + `kubectl apply` do
+upstream.  Run as real subprocesses: the CLI owns its own cluster session.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(*args: str, timeout: float = 180.0):
+    env = dict(os.environ)
+    parts = [REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+def test_cli_apply_tpujob_example_succeeds():
+    proc = _cli("apply", "-f", os.path.join(REPO, "examples", "tpujob.yaml"),
+                "--wait", "--logs", "--apps", "training")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "kfadm: application training: Ready" in out
+    assert "TPUJob/rendezvous-demo" in out and "Succeeded" in out
+    # both workers printed the injected jax.distributed rendezvous env
+    assert "worker 0 of 2 coordinator" in out
+    assert "worker 1 of 2 coordinator" in out
+
+
+def test_cli_apply_failing_pod_exits_nonzero():
+    manifest = """
+apiVersion: kubeflow.org/v1
+kind: TPUJob
+metadata: {name: doomed}
+spec:
+  runPolicy: {backoffLimit: 0}
+  replicaSpecs:
+    Worker:
+      replicas: 1
+      template:
+        spec:
+          containers:
+          - name: main
+            command: [python, -c, "raise SystemExit(3)"]
+"""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+        f.write(manifest)
+        path = f.name
+    try:
+        proc = _cli("apply", "-f", path, "--wait", "--apps", "training")
+    finally:
+        os.unlink(path)
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-1000:]
+    assert "Failed" in proc.stdout
+
+
+def test_cli_components_lists_every_pillar():
+    proc = _cli("components", timeout=60)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    listing = json.loads(proc.stdout)
+    assert set(listing) == {"platform", "training", "katib", "serving", "pipelines"}
+    assert "TPUJob" in listing["training"]
+    assert "InferenceService" in listing["serving"]
