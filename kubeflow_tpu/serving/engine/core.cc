@@ -13,11 +13,23 @@
 // max_pages_per_slot.  Admission is all-or-nothing: a request enters a slot
 // only if its whole prompt fits in free pages (decode growth may still hit
 // OOM; commit_token reports it so the scheduler can preempt).
+//
+// Prefix cache (vLLM/JetStream-style, allocator-level): full prompt pages
+// are refcounted and indexed by a chain hash supplied by the caller
+// (hash(page i) folds in hash(page i-1), so equal hashes mean equal
+// token prefixes at equal positions).  On submit, the longest cached chain
+// prefix is pinned for the request; on admit the slot adopts those pages
+// and allocates only the remainder; on release the slot's full prompt
+// pages are inserted into the cache instead of freed.  Cached pages with no
+// other owner are reclaimed leaf-first by LRU when the free list runs dry,
+// so the cache can never cause an admission failure that an empty cache
+// would not also have had.
 
 #include <cstdint>
 #include <cstring>
 #include <deque>
 #include <mutex>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -26,6 +38,11 @@ struct Request {
   int64_t id;
   int32_t prompt_len;
   int32_t max_new_tokens;
+  // chain hashes of the lookup-eligible full prompt pages; the cache lookup
+  // happens at ADMIT time (pinning at submit could deadlock head-of-line
+  // admission: a pinned page is neither free nor evictable, and the pinning
+  // request may sit behind one that needs those very pages)
+  std::vector<uint64_t> hashes;
 };
 
 struct Slot {
@@ -37,6 +54,13 @@ struct Slot {
   std::vector<int32_t> pages; // page ids owned by this slot
 };
 
+struct CacheEntry {
+  int32_t page;
+  uint64_t parent;     // chain hash of the previous page (0 = root)
+  int32_t children;    // live cache entries whose parent is this hash
+  uint64_t last_use;   // LRU clock
+};
+
 struct Engine {
   std::mutex mu;
   int32_t max_slots;
@@ -45,13 +69,81 @@ struct Engine {
   int32_t max_pages_per_slot;
   std::deque<Request> queue;
   std::vector<Slot> slots;
-  std::vector<int32_t> free_pages; // LIFO free list
+  std::vector<int32_t> free_pages;  // LIFO free list (refcount 0 pages)
+  std::vector<int32_t> refcount;    // per-page owners (slots + pins + cache)
+  std::unordered_map<uint64_t, CacheEntry> cache;  // chain hash -> page
+  uint64_t clock = 0;
+  int64_t cache_hits = 0;       // pages served from cache
+  int64_t cache_misses = 0;     // prompt pages that had to be computed
+  int64_t cache_evictions = 0;  // cached pages reclaimed under pressure
   int64_t total_admitted = 0;
   int64_t total_completed = 0;
 };
 
 int32_t pages_needed(const Engine* e, int32_t tokens) {
   return (tokens + e->page_size - 1) / e->page_size;
+}
+
+// Drop the LRU evictable cache entry (a leaf whose page has no owner but the
+// cache itself).  Returns true if a page was freed.
+bool evict_one(Engine* e) {
+  uint64_t best_hash = 0;
+  uint64_t best_age = UINT64_MAX;
+  for (const auto& it : e->cache) {
+    const CacheEntry& ce = it.second;
+    if (ce.children == 0 && e->refcount[ce.page] == 1 && ce.last_use < best_age) {
+      best_age = ce.last_use;
+      best_hash = it.first;
+    }
+  }
+  if (best_age == UINT64_MAX) return false;
+  CacheEntry ce = e->cache[best_hash];
+  e->cache.erase(best_hash);
+  if (ce.parent != 0) {
+    auto pit = e->cache.find(ce.parent);
+    if (pit != e->cache.end()) pit->second.children--;
+  }
+  e->refcount[ce.page] = 0;
+  e->free_pages.push_back(ce.page);
+  e->cache_evictions++;
+  return true;
+}
+
+// Pop a free page, evicting cache leaves if needed. -1 if truly exhausted.
+int32_t take_page(Engine* e) {
+  if (e->free_pages.empty() && !evict_one(e)) return -1;
+  int32_t p = e->free_pages.back();
+  e->free_pages.pop_back();
+  e->refcount[p] = 1;
+  return p;
+}
+
+void deref_page(Engine* e, int32_t page) {
+  if (--e->refcount[page] == 0) e->free_pages.push_back(page);
+}
+
+// How many cached pages leaf-first eviction could eventually reclaim: an
+// entry is reclaimable iff neither it nor any descendant has an owner other
+// than the cache.  Lets eng_admit decide BEFORE evicting anything, so a
+// request that cannot fit does not wipe the cache on every failed attempt.
+int32_t count_reclaimable(Engine* e) {
+  std::unordered_map<uint64_t, bool> blocked;
+  for (const auto& it : e->cache) {
+    if (e->refcount[it.second.page] > 1) {
+      uint64_t h = it.first;
+      while (h != 0) {
+        if (blocked.count(h)) break;  // ancestors above are already marked
+        blocked[h] = true;
+        auto pit = e->cache.find(h);
+        if (pit == e->cache.end()) break;
+        h = pit->second.parent;
+      }
+    }
+  }
+  int32_t n = 0;
+  for (const auto& it : e->cache)
+    if (!blocked.count(it.first)) n++;
+  return n;
 }
 
 }  // namespace
@@ -75,51 +167,85 @@ Engine* eng_create(int32_t max_slots, int32_t num_pages, int32_t page_size,
   // those writes harmless by construction.  Usable capacity: num_pages - 1.
   e->free_pages.reserve(num_pages - 1);
   for (int32_t p = num_pages - 1; p >= 1; --p) e->free_pages.push_back(p);
+  e->refcount.assign(num_pages, 0);
+  e->refcount[0] = 1;  // the trash page is permanently owned
   return e;
 }
 
 void eng_destroy(Engine* e) { delete e; }
 
-// Enqueue a request. Returns 0, or -1 if the prompt can never fit.
+// Enqueue a request. `hashes` (may be null) are chain hashes for the
+// request's lookup-eligible full prompt pages, consulted at admit time.
+// Returns 0, or -1 if the prompt can never fit.
 int32_t eng_submit(Engine* e, int64_t req_id, int32_t prompt_len,
-                   int32_t max_new_tokens) {
+                   int32_t max_new_tokens, const uint64_t* hashes,
+                   int32_t n_hashes) {
   std::lock_guard<std::mutex> lock(e->mu);
   // Admission is head-of-line: a request that exceeds either the per-slot cap
   // OR the whole page pool would block the queue forever — reject it here.
   if (pages_needed(e, prompt_len + max_new_tokens) > e->max_pages_per_slot ||
       pages_needed(e, prompt_len) >= e->num_pages)  // page 0 is reserved
     return -1;
-  e->queue.push_back({req_id, prompt_len, max_new_tokens});
+  Request r{req_id, prompt_len, max_new_tokens, {}};
+  if (hashes && n_hashes > 0) r.hashes.assign(hashes, hashes + n_hashes);
+  e->queue.push_back(std::move(r));
   return 0;
 }
 
 // Admit the head-of-line request into a free slot if its prompt fits in free
-// pages.  Returns the slot id (prompt pages already allocated) or -1.
+// (or cache-evictable) pages.  Returns the slot id (prompt pages allocated,
+// cache-hit prefix adopted) or -1; *out_cached = adopted page count.
 int32_t eng_admit(Engine* e, int64_t* out_req_id, int32_t* out_prompt_len,
-                  int32_t* out_max_new) {
+                  int32_t* out_max_new, int32_t* out_cached) {
   std::lock_guard<std::mutex> lock(e->mu);
+  if (out_cached) *out_cached = 0;
   if (e->queue.empty()) return -1;
   int32_t slot_id = -1;
   for (int32_t s = 0; s < e->max_slots; ++s)
     if (!e->slots[s].active) { slot_id = s; break; }
   if (slot_id < 0) return -1;
-  const Request& r = e->queue.front();
+  Request& r = e->queue.front();
+  // longest cached chain prefix; take refs so these pages are neither free
+  // nor counted reclaimable below
+  std::vector<int32_t> pages;
+  for (uint64_t h : r.hashes) {
+    auto it = e->cache.find(h);
+    if (it == e->cache.end()) break;
+    it->second.last_use = ++e->clock;
+    e->refcount[it->second.page]++;
+    pages.push_back(it->second.page);
+  }
+  int32_t cached = static_cast<int32_t>(pages.size());
   int32_t need = pages_needed(e, r.prompt_len);
-  if (need > static_cast<int32_t>(e->free_pages.size())) return -1;
+  int32_t need_new = need - cached;
+  if (need_new > static_cast<int32_t>(e->free_pages.size()) + count_reclaimable(e)) {
+    // cannot fit yet: undo the hit refs (pages stay cached) and leave the
+    // request queued — deciding BEFORE evicting keeps a failed attempt from
+    // wiping the evictable cache
+    for (int32_t p : pages) e->refcount[p]--;
+    return -1;
+  }
+  for (int32_t i = 0; i < need_new; ++i) {
+    int32_t p = take_page(e);
+    if (p < 0) {  // unreachable per the check above; fail closed regardless
+      for (int32_t q : pages) deref_page(e, q);
+      return -1;
+    }
+    pages.push_back(p);
+  }
+  e->cache_hits += cached;
+  e->cache_misses += need_new;
   Slot& slot = e->slots[slot_id];
   slot.active = true;
   slot.req_id = r.id;
   slot.seq_len = r.prompt_len;
   slot.generated = 0;
   slot.max_new_tokens = r.max_new_tokens;
-  slot.pages.clear();
-  for (int32_t i = 0; i < need; ++i) {
-    slot.pages.push_back(e->free_pages.back());
-    e->free_pages.pop_back();
-  }
+  slot.pages = std::move(pages);
   *out_req_id = r.id;
   *out_prompt_len = r.prompt_len;
   *out_max_new = r.max_new_tokens;
+  if (out_cached) *out_cached = cached;
   e->queue.pop_front();
   e->total_admitted++;
   return slot_id;
@@ -136,9 +262,9 @@ int32_t eng_commit_token(Engine* e, int32_t slot_id, int32_t is_eos) {
   int32_t need = pages_needed(e, slot.seq_len + 1);
   if (need > static_cast<int32_t>(slot.pages.size())) {
     if (need > e->max_pages_per_slot) return 0;  // hit the per-slot cap: done
-    if (e->free_pages.empty()) return -2;
-    slot.pages.push_back(e->free_pages.back());
-    e->free_pages.pop_back();
+    int32_t p = take_page(e);  // evicts cache leaves before giving up
+    if (p < 0) return -2;
+    slot.pages.push_back(p);
   }
   slot.seq_len++;
   slot.generated++;
@@ -146,17 +272,42 @@ int32_t eng_commit_token(Engine* e, int32_t slot_id, int32_t is_eos) {
   return 1;
 }
 
-void eng_release(Engine* e, int32_t slot_id) {
+// Release a slot. `hashes` (may be null) are chain hashes for the slot's
+// first `n_hashes` full PROMPT pages: any not yet cached are inserted into
+// the prefix cache (the cache takes a ref) instead of going straight back to
+// the free list; everything else just drops the slot's ref.
+void eng_release_cached(Engine* e, int32_t slot_id, const uint64_t* hashes,
+                        int32_t n_hashes) {
   std::lock_guard<std::mutex> lock(e->mu);
   if (slot_id < 0 || slot_id >= e->max_slots) return;
   Slot& slot = e->slots[slot_id];
   if (!slot.active) return;
-  for (int32_t p : slot.pages) e->free_pages.push_back(p);
+  if (hashes) {
+    int32_t n = n_hashes;
+    if (n > static_cast<int32_t>(slot.pages.size()))
+      n = static_cast<int32_t>(slot.pages.size());
+    for (int32_t i = 0; i < n; ++i) {
+      uint64_t h = hashes[i];
+      if (h == 0) break;  // 0 is the root-parent sentinel, never a real hash
+      if (e->cache.count(h)) continue;  // same prefix already cached elsewhere
+      uint64_t parent = (i == 0) ? 0 : hashes[i - 1];
+      auto pit = e->cache.find(parent);
+      if (i > 0 && pit == e->cache.end()) break;  // keep chains contiguous
+      if (pit != e->cache.end()) pit->second.children++;
+      e->refcount[slot.pages[i]]++;  // the cache's ref
+      e->cache[h] = CacheEntry{slot.pages[i], parent, 0, ++e->clock};
+    }
+  }
+  for (int32_t p : slot.pages) deref_page(e, p);
   slot.pages.clear();
   slot.active = false;
   slot.req_id = -1;
   slot.seq_len = 0;
   e->total_completed++;
+}
+
+void eng_release(Engine* e, int32_t slot_id) {
+  eng_release_cached(e, slot_id, nullptr, 0);
 }
 
 // Snapshots for the JAX side (caller provides buffers).
@@ -212,6 +363,16 @@ int32_t eng_num_active(Engine* e) {
   int32_t n = 0;
   for (const Slot& s : e->slots) n += s.active ? 1 : 0;
   return n;
+}
+
+// out[0]=cached pages (== entries), out[1]=page hits, out[2]=page misses,
+// out[3]=evictions.
+void eng_cache_stats(Engine* e, int64_t* out /* 4 */) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  out[0] = static_cast<int64_t>(e->cache.size());
+  out[1] = e->cache_hits;
+  out[2] = e->cache_misses;
+  out[3] = e->cache_evictions;
 }
 
 }  // extern "C"

@@ -64,6 +64,8 @@ class _Pending:
     generated: list = dataclasses.field(default_factory=list)
     submitted_at: float = 0.0
     first_token_at: float = 0.0
+    # chain hashes for the prompt's full pages (prefix-cache identity)
+    page_hashes: "np.ndarray" = None
 
 
 class Engine:
@@ -136,14 +138,20 @@ class Engine:
         if not tokens:
             raise ValueError("empty prompt")
         fut: Future = Future()
+        hashes = self._page_hashes(tokens)
         with self._lock:
             rid = self._next_id
             self._next_id += 1
             self._requests[rid] = _Pending(
                 tokens=list(tokens), max_new_tokens=max_new_tokens,
-                future=fut, submitted_at=time.perf_counter(),
+                future=fut, submitted_at=time.perf_counter(), page_hashes=hashes,
             )
-        if not self.batcher.submit(rid, len(tokens), max_new_tokens):
+        # lookup eligibility stops one page short of the prompt end: prefill
+        # must compute at least the final prompt token to produce the logits
+        # the first sampled token comes from
+        n_lookup = (len(tokens) - 1) // self.ec.page_size
+        if not self.batcher.submit(rid, len(tokens), max_new_tokens,
+                                   hashes[:n_lookup]):
             with self._lock:
                 del self._requests[rid]
             raise ValueError(
@@ -152,6 +160,23 @@ class Engine:
             )
         self._wake.set()
         return fut
+
+    def _page_hashes(self, tokens: list[int]) -> "np.ndarray":
+        """Chain hashes for each FULL prompt page: hash(page i) folds in
+        hash(page i-1), so a match means an identical token prefix at
+        identical positions. 0 is reserved as the no-parent sentinel."""
+        import hashlib
+
+        ps = self.ec.page_size
+        n = len(tokens) // ps
+        out = np.zeros((n,), np.uint64)
+        prev = b""
+        for i in range(n):
+            page = np.asarray(tokens[i * ps:(i + 1) * ps], np.int32).tobytes()
+            digest = hashlib.blake2b(prev + page, digest_size=8).digest()
+            out[i] = max(1, int.from_bytes(digest, "little"))  # 0 = sentinel
+            prev = digest
+        return out
 
     def generate(self, tokens: list[int], max_new_tokens: int = 32, timeout: float = 300.0) -> dict:
         return self.generate_async(tokens, max_new_tokens).result(timeout=timeout)
@@ -162,6 +187,7 @@ class Engine:
             "active_slots": self.batcher.num_active,
             "queue_depth": self.batcher.queue_depth,
             "free_pages": self.batcher.free_pages,
+            **self.batcher.cache_stats(),
         }
 
     # ------------------------------------------------------------------ loop
@@ -196,7 +222,7 @@ class Engine:
         owned = self._pages_for(plen)
         table_row = self.batcher.page_table()[slot]
 
-        if plen <= self.ec.prefill_chunk:
+        if self._prefilling[slot] == 0 and plen <= self.ec.prefill_chunk:
             bucket = self._bucket(plen)
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :plen] = pending.tokens
@@ -256,14 +282,16 @@ class Engine:
                 if admitted is None:
                     break
                 did_work = True
-                slot, rid, plen, _ = admitted
+                slot, rid, plen, _, cached = admitted
                 with self._lock:
                     pending = self._requests.get(rid)
                 if pending is None:  # cancelled
                     self.batcher.release(slot)
                     continue
                 self._slot_req[slot] = rid
-                self._prefilling[slot] = 0
+                # cache-hit pages already hold the prefix KV: prefill resumes
+                # at the first uncovered position
+                self._prefilling[slot] = cached * self.ec.page_size
 
             # --- one prefill chunk per prefilling slot
             for slot in list(self._prefilling):
@@ -321,7 +349,8 @@ class Engine:
     def _finish(self, slot: int, rid: int, truncated: bool) -> None:
         pending = self._requests.pop(rid)
         self._slot_req.pop(slot, None)
-        self.batcher.release(slot)
+        # hand the prompt's full pages to the prefix cache on the way out
+        self.batcher.release(slot, pending.page_hashes)
         now = time.perf_counter()
         pending.future.set_result(
             {

@@ -31,13 +31,18 @@ def load_library() -> ctypes.CDLL:
             lib.eng_create.restype = p
             lib.eng_create.argtypes = [i32, i32, i32, i32]
             lib.eng_destroy.argtypes = [p]
+            u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
             lib.eng_submit.restype = i32
-            lib.eng_submit.argtypes = [p, i64, i32, i32]
+            lib.eng_submit.argtypes = [p, i64, i32, i32, ctypes.c_void_p, i32]
             lib.eng_admit.restype = i32
-            lib.eng_admit.argtypes = [p, ctypes.POINTER(i64), ctypes.POINTER(i32), ctypes.POINTER(i32)]
+            lib.eng_admit.argtypes = [p, ctypes.POINTER(i64), ctypes.POINTER(i32),
+                                      ctypes.POINTER(i32), ctypes.POINTER(i32)]
             lib.eng_commit_token.restype = i32
             lib.eng_commit_token.argtypes = [p, i32, i32]
             lib.eng_release.argtypes = [p, i32]
+            lib.eng_release_cached.argtypes = [p, i32, u64p, i32]
+            lib.eng_cache_stats.argtypes = [p, i64p]
             lib.eng_page_table.argtypes = [p, ip]
             lib.eng_seq_lens.argtypes = [p, ip]
             lib.eng_active_mask.argtypes = [p, ip]
@@ -70,25 +75,48 @@ class NativeBatcher:
             self.lib.eng_destroy(self._e)
             self._e = None
 
-    def submit(self, req_id: int, prompt_len: int, max_new_tokens: int) -> bool:
-        return self.lib.eng_submit(self._e, req_id, prompt_len, max_new_tokens) == 0
+    def submit(self, req_id: int, prompt_len: int, max_new_tokens: int,
+               prefix_hashes=None) -> bool:
+        """Queue a request; False if it can never fit. ``prefix_hashes``:
+        uint64 chain hashes for the lookup-eligible full prompt pages (see
+        Engine._page_hashes) — the prefix-cache lookup happens at admit."""
+        if prefix_hashes is not None and len(prefix_hashes):
+            h = np.ascontiguousarray(prefix_hashes, dtype=np.uint64)
+            rc = self.lib.eng_submit(self._e, req_id, prompt_len, max_new_tokens,
+                                     h.ctypes.data, len(h))
+        else:
+            rc = self.lib.eng_submit(self._e, req_id, prompt_len, max_new_tokens,
+                                     None, 0)
+        return rc == 0
 
     def admit(self):
-        """-> (slot, req_id, prompt_len, max_new_tokens) or None."""
+        """-> (slot, req_id, prompt_len, max_new_tokens, cached_pages) or None."""
         rid = ctypes.c_int64()
         plen = ctypes.c_int32()
         mnew = ctypes.c_int32()
-        slot = self.lib.eng_admit(self._e, ctypes.byref(rid), ctypes.byref(plen), ctypes.byref(mnew))
+        cached = ctypes.c_int32()
+        slot = self.lib.eng_admit(self._e, ctypes.byref(rid), ctypes.byref(plen),
+                                  ctypes.byref(mnew), ctypes.byref(cached))
         if slot < 0:
             return None
-        return slot, rid.value, plen.value, mnew.value
+        return slot, rid.value, plen.value, mnew.value, cached.value
 
     def commit_token(self, slot: int, is_eos: bool) -> int:
         """1=continue, 0=finished, -2=page pool exhausted."""
         return self.lib.eng_commit_token(self._e, slot, 1 if is_eos else 0)
 
-    def release(self, slot: int) -> None:
-        self.lib.eng_release(self._e, slot)
+    def release(self, slot: int, prefix_hashes=None) -> None:
+        """Free the slot; with ``prefix_hashes`` (uint64, one per full PROMPT
+        page) the covered pages enter the prefix cache instead."""
+        h = np.ascontiguousarray(prefix_hashes if prefix_hashes is not None else [],
+                                 dtype=np.uint64)
+        self.lib.eng_release_cached(self._e, slot, h, len(h))
+
+    def cache_stats(self) -> dict:
+        out = np.zeros((4,), np.int64)
+        self.lib.eng_cache_stats(self._e, out)
+        return {"cached_pages": int(out[0]), "page_hits": int(out[1]),
+                "page_misses": int(out[2]), "evictions": int(out[3])}
 
     def page_table(self) -> np.ndarray:
         out = np.zeros((self.max_slots, self.max_pages_per_slot), np.int32)

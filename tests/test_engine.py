@@ -38,7 +38,8 @@ def test_native_batcher_lifecycle():
     assert b.free_pages == 8
     assert b.submit(1, 6, 4)        # needs 2 pages for prompt
     assert not b.submit(2, 20, 4)   # 24 tokens > 4 pages/slot cap: rejected
-    slot, rid, plen, mnew = b.admit()
+    slot, rid, plen, mnew, cached = b.admit()
+    assert cached == 0
     assert (rid, plen, mnew) == (1, 6, 4) and b.free_pages == 6
     assert b.seq_lens()[slot] == 6
     assert 0 not in set(b.page_table()[slot][:2])  # trash page never allocated
@@ -320,3 +321,159 @@ def test_tensor_parallel_rejects_indivisible_heads(params):
     with pytest.raises(ValueError, match="divide"):
         Engine(params, CFG, EngineConfig(max_slots=2, num_pages=32, page_size=8,
                                          max_pages_per_slot=8, tensor_parallel=3))
+
+
+# ---------------------------------------------------------- prefix cache
+
+def _drain(eng):
+    """Wait until the engine loop has no in-flight work."""
+    import time
+    for _ in range(200):
+        if not eng._requests and eng.batcher.num_active == 0:
+            return
+        time.sleep(0.02)
+    raise TimeoutError("engine did not drain")
+
+
+def test_prefix_cache_reuses_pages_and_matches_oracle(params):
+    """vLLM/JetStream-style automatic prefix caching: a finished prompt's
+    full pages stay in the pool; a second request sharing the prefix adopts
+    them (page hits > 0) and must still generate the oracle-exact tokens."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        prefill_chunk=16,
+    ))
+    eng.start()
+    try:
+        prompt = [(i * 5) % (CFG.vocab_size - 1) + 1 for i in range(40)]
+        first = eng.generate(prompt, 4, timeout=180)
+        _drain(eng)
+        stats = eng.stats
+        # 40 tokens / 8 per page = 5 full pages now cached
+        assert stats["cached_pages"] == 5
+        assert stats["page_hits"] == 0
+
+        # identical prompt: lookup eligibility is (40-1)//8 = 4 pages
+        second = eng.generate(prompt, 4, timeout=180)
+        assert second["tokens"] == first["tokens"] == greedy_oracle(params, prompt, 4)
+        assert eng.stats["page_hits"] == 4
+
+        # shared-prefix extension: same first 40 tokens + a new tail
+        extended = prompt + [3, 1, 4, 1, 5]
+        third = eng.generate(extended, 4, timeout=180)
+        assert third["tokens"] == greedy_oracle(params, extended, 4)
+        assert eng.stats["page_hits"] == 9  # +5: every full page of `prompt`
+    finally:
+        eng.stop()
+
+
+def test_prefix_cache_concurrent_shared_prefix(params):
+    """Two in-flight requests sharing cached prefix pages must not corrupt
+    each other (shared pages are read-only by construction)."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=4, num_pages=64, page_size=8, max_pages_per_slot=16,
+        prefill_chunk=16,
+    ))
+    eng.start()
+    try:
+        base = [(i * 11) % (CFG.vocab_size - 1) + 1 for i in range(24)]
+        eng.generate(base, 2, timeout=180)  # seed the cache
+        _drain(eng)
+        exts = [base + [7, 7], base + [9, 9, 9], base]
+        futs = [eng.generate_async(p, 4) for p in exts]
+        for p, f in zip(exts, futs):
+            assert f.result(timeout=180)["tokens"] == greedy_oracle(params, p, 4), p
+        assert eng.stats["page_hits"] > 0
+    finally:
+        eng.stop()
+
+
+def _greedy_tie_aware_check(params, prompt, generated):
+    """Assert every generated token is a max-logit token given the engine's
+    own prefix: bf16 logits can tie exactly, and argmax tie-break order is
+    allowed to differ between the paged path and the full-forward oracle."""
+    toks = list(prompt)
+    for tok in generated:
+        logits = np.asarray(M.forward_full(params, CFG, jnp.asarray([toks], jnp.int32)))[0, -1]
+        assert logits[tok] == logits.max(), (toks, tok)
+        toks.append(tok)
+
+
+def test_prefix_cache_evicts_under_pressure(params):
+    """Cached pages must never cause admissions to fail: distinct prompts
+    that together exceed the pool evict stale cache entries (leaf-first LRU)
+    and every request still completes."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=1, num_pages=9, page_size=8, max_pages_per_slot=8,
+        prefill_chunk=16,
+    ))
+    eng.start()
+    try:
+        for seed in range(5):
+            prompt = [(seed * 31 + i * 3) % (CFG.vocab_size - 1) + 1 for i in range(24)]
+            out = eng.generate(prompt, 3, timeout=180)
+            _greedy_tie_aware_check(params, prompt, out["tokens"])
+        stats = eng.stats
+        assert stats["evictions"] > 0
+        # pool invariant: free + cached + trash == num_pages
+        assert stats["free_pages"] + stats["cached_pages"] == 9 - 1
+    finally:
+        eng.stop()
+
+
+def test_native_batcher_prefix_pin_and_adopt():
+    """Core-level: release-with-hashes caches pages; a later submit pins the
+    chain prefix and admit adopts it without allocating those pages."""
+    b = NativeBatcher(max_slots=2, num_pages=9, page_size=4, max_pages_per_slot=8)
+    hashes = np.array([11, 22, 33], np.uint64)  # 3 full prompt pages
+    assert b.submit(1, 12, 1, hashes[:2])
+    s = b.admit()
+    assert s is not None and s[4] == 0  # nothing cached yet
+    pages_before = list(b.page_table()[s[0]][:3])
+    assert b.commit_token(s[0], True) == 0
+    b.release(s[0], hashes)
+    assert b.cache_stats()["cached_pages"] == 3
+    assert b.free_pages == 8 - 3
+
+    # same 12-token prompt: 2 of 3 pages are lookup-eligible, both hit
+    assert b.submit(2, 12, 1, hashes[:2])
+    s2 = b.admit()
+    assert s2 is not None and s2[4] == 2
+    assert list(b.page_table()[s2[0]][:2]) == pages_before[:2]
+    assert b.commit_token(s2[0], True) == 0
+    b.release(s2[0], hashes)
+    # the same chain re-released: no duplicate entries, refs balanced
+    assert b.cache_stats()["cached_pages"] == 3
+    assert b.free_pages == 8 - 3
+    b.close()
+
+
+def test_native_batcher_queued_cache_sharer_cannot_deadlock_admission():
+    """Regression (r2 review): a queued request whose prefix is cached must
+    not block an earlier request that needs those pages.  Lookup happens at
+    admit (not submit), so the cache stays evictable and head-of-line
+    admission always makes progress; eviction is leaf-first, so the
+    surviving prefix is still useful to the sharer."""
+    b = NativeBatcher(max_slots=1, num_pages=9, page_size=4, max_pages_per_slot=8)
+    ha = np.array([1, 2, 3, 4, 5, 6], np.uint64)
+    assert b.submit(1, 24, 1, ha[:5])  # A: 6 pages
+    sa = b.admit()
+    assert b.commit_token(sa[0], True) == 0
+    b.release(sa[0], ha)               # A's 6 pages now cached; free = 2
+    assert b.cache_stats()["cached_pages"] == 6 and b.free_pages == 2
+
+    assert b.submit(2, 16, 1)          # B: needs 4 fresh pages (head of line)
+    assert b.submit(3, 24, 1, ha[:5])  # C: shares A's prefix, queued behind B
+    sb = b.admit()                     # must evict 2 cached leaves for B
+    assert sb is not None and sb[1] == 2
+    assert b.cache_stats()["evictions"] == 2
+    # B's one generated token grows into a 5th page: free list is empty, so a
+    # third cache leaf is evicted on the commit path
+    assert b.commit_token(sb[0], True) == 0
+    b.release(sb[0])
+    assert b.cache_stats()["evictions"] == 3
+
+    sc = b.admit()                     # C: the surviving 3-page prefix hits
+    assert sc is not None and sc[1] == 3 and sc[4] == 3
+    b.release(sc[0])
+    b.close()
