@@ -51,6 +51,10 @@ def main() -> None:
                         "chunked prefill under concurrent decode)")
     p.add_argument("--paged-kernel", action="store_true",
                    help="use the Pallas paged-attention decode path")
+    p.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                   help="fraction of each prompt that is a common system-prompt "
+                        "prefix shared by every request (exercises the engine's "
+                        "automatic prefix cache; TTFT should drop once warm)")
     args = p.parse_args()
 
     import jax
@@ -81,9 +85,15 @@ def main() -> None:
     n_long = round(args.requests * args.long_prompt_frac)
     long_idx = set(np.linspace(0, args.requests - 1, n_long, dtype=int).tolist()) if n_long else set()
 
+    # the shared prefix mimics a fixed system prompt: identical tokens at
+    # identical positions across requests, so the prefix cache can serve its
+    # full pages after the first request computes them
+    shared = rng.integers(1, config.vocab_size,
+                          size=int(args.prompt_len * args.shared_prefix_frac)).tolist()
+
     def prompt(i=None):
         n = 4 * args.prompt_len if i in long_idx else args.prompt_len
-        return rng.integers(1, config.vocab_size, size=n).tolist()
+        return shared + rng.integers(1, config.vocab_size, size=n - len(shared)).tolist()
 
     # warmup: compile the short AND (if used) long prefill paths + decode step
     engine.generate(prompt(), 4)
@@ -94,6 +104,7 @@ def main() -> None:
     futs = [engine.generate_async(prompt(i), args.max_tokens) for i in range(args.requests)]
     results = [f.result(timeout=1800) for f in futs]
     wall = time.perf_counter() - t0
+    final_stats = engine.stats  # before stop(): close() frees the C core
     engine.stop()
 
     lat = np.array([r["latency_s"] for r in results])
@@ -116,6 +127,8 @@ def main() -> None:
         "long_prompt_frac": args.long_prompt_frac,
         "paged_kernel": engine._paged,
         "long_requests": len(long_idx),
+        "shared_prefix_frac": args.shared_prefix_frac,
+        "prefix_cache": final_stats,
         "platform": jax.devices()[0].platform,
         "on_tpu": on_tpu,
     }))

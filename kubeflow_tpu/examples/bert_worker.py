@@ -61,6 +61,11 @@ def main() -> None:
 
     fail_at = int(os.environ.get("FAIL_AT_STEP", "-1"))
     marker = os.environ.get("FAIL_MARKER", "")
+    # FAIL_RANK: only this process index simulates the preemption (a gang
+    # shares one env block, so the gang-restart E2E kills exactly one worker)
+    fail_rank = int(os.environ.get("FAIL_RANK", "-1"))
+    if fail_rank >= 0 and int(os.environ.get("JAX_PROCESS_ID", "0")) != fail_rank:
+        fail_at = -1
     data = synthetic_mlm_batches(config.vocab_size, batch_size, seq_len=32)
     while trainer.step_num < steps:
         metrics = trainer.train_step(next(data))

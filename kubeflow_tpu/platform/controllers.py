@@ -107,9 +107,13 @@ class ProfileController:
             )
 
         status = dict(prof.get("status", {}))
-        set_condition(status, papi.READY, "True", "ProfileReady", f"namespace {ns_name} provisioned")
-        prof["status"] = status
-        self.api.update_status(prof)
+        if set_condition(status, papi.READY, "True", "ProfileReady",
+                         f"namespace {ns_name} provisioned"):
+            # only write on a real transition: an unconditional status write
+            # bumps resourceVersion, which re-triggers this controller's own
+            # watch — a self-sustaining reconcile storm (r2 settle() stalls)
+            prof["status"] = status
+            self.api.update_status(prof)
         return None
 
     def _ensure(self, obj: Obj) -> None:
@@ -165,11 +169,10 @@ class StatefulSetReconciler:
         while self.api.try_delete("Pod", f"{req.name}-{i}", req.namespace):
             i += 1
 
-        status = dict(sts.get("status", {}))
-        status["replicas"] = desired
-        status["readyReplicas"] = ready
-        sts["status"] = status
-        self.api.update_status(sts)
+        old = sts.get("status") or {}
+        if old.get("replicas") != desired or old.get("readyReplicas") != ready:
+            sts["status"] = {**old, "replicas": desired, "readyReplicas": ready}
+            self.api.update_status(sts)
         return None
 
 
@@ -219,14 +222,17 @@ class NotebookController:
         pod = self.api.try_get("Pod", f"{req.name}-0", req.namespace)
         running = pod is not None and pod.get("status", {}).get("phase") == "Running"
         status = dict(nb.get("status", {}))
-        set_condition(status, papi.READY, "True" if running else "False",
-                      "NotebookRunning" if running else "NotebookPending",
-                      f"pod {req.name}-0 {'running' if running else 'not running'}")
-        set_condition(status, papi.CULLED, "True" if culled else "False",
-                      "Culled" if culled else "Active",
-                      "idle-culled to zero" if culled else "notebook active")
-        nb["status"] = status
-        self.api.update_status(nb)
+        ready_changed = set_condition(
+            status, papi.READY, "True" if running else "False",
+            "NotebookRunning" if running else "NotebookPending",
+            f"pod {req.name}-0 {'running' if running else 'not running'}")
+        culled_changed = set_condition(
+            status, papi.CULLED, "True" if culled else "False",
+            "Culled" if culled else "Active",
+            "idle-culled to zero" if culled else "notebook active")
+        if ready_changed or culled_changed:  # guard: see ProfileController
+            nb["status"] = status
+            self.api.update_status(nb)
         return None
 
 

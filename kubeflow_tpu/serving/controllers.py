@@ -56,6 +56,14 @@ def _hash(obj) -> str:
     return hashlib.md5(json.dumps(obj, sort_keys=True).encode()).hexdigest()[:8]
 
 
+def _poll_backoff(attempts: dict, key, cap: float) -> float:
+    """Capped exponential not-ready poll delay: 0.1 → 0.2 → … → cap.
+    The counter is clamped so the exponent cannot overflow float range on a
+    permanently not-ready object."""
+    n = attempts[key] = min(attempts.get(key, 0) + 1, 64)
+    return min(0.1 * (2 ** min(n - 1, 8)), cap)
+
+
 def probe_http(port: int, path: str, timeout: float = 0.25) -> bool:
     try:
         with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
@@ -91,10 +99,12 @@ class DeploymentReconciler:
     def __init__(self, api: APIServer):
         self.api = api
         self.recorder = EventRecorder(api, "deployment-controller")
+        self._attempts: dict = {}  # (ns, name) -> not-ready poll count
 
     def reconcile(self, req: Request) -> Optional[Result]:
         deploy = self.api.try_get("Deployment", req.name, req.namespace)
         if deploy is None:
+            self._attempts.pop((req.namespace, req.name), None)
             return None
         spec = deploy["spec"]
         desired = int(spec.get("replicas", 1))
@@ -150,8 +160,13 @@ class DeploymentReconciler:
         if fresh.get("status") != status:
             fresh["status"] = status
             self.api.update_status(fresh)
+        key = (req.namespace, req.name)
         if ready < desired:
-            return Result(requeue_after=0.1)
+            # probe polling with capped backoff: a pod that never turns ready
+            # must not pin the manager at 10 Hz (1s cap — probes are the only
+            # readiness signal, so stay reasonably fresh)
+            return Result(requeue_after=_poll_backoff(self._attempts, key, 1.0))
+        self._attempts.pop(key, None)
         return None
 
     def _create_pod(self, deploy: Obj, name: str, template: dict, thash: str) -> None:
@@ -202,15 +217,22 @@ class InferenceServiceReconciler:
     def __init__(self, api: APIServer):
         self.api = api
         self.recorder = EventRecorder(api, "inferenceservice-controller")
+        self._attempts: dict = {}  # (ns, name) -> not-ready poll count
 
     # ------------------------------------------------------------- reconcile
 
     def reconcile(self, req: Request) -> Optional[Result]:
         isvc = self.api.try_get("InferenceService", req.name, req.namespace)
         if isvc is None:
+            self._attempts.pop((req.namespace, req.name), None)
             return None
         spec = isvc["spec"]
         status = isvc.setdefault("status", {})
+        # non-condition status fields, for the change guard at the end
+        # (condition changes are tracked via set_condition's return value;
+        # their lastUpdateTime churns every call and must not count)
+        old_fields = {k: copy.deepcopy(v) for k, v in status.items() if k != "conditions"}
+        cond_changed = False
         canary = spec.get("canaryTrafficPercent")
         annotations = isvc["metadata"].setdefault("annotations", {})
         promoted_raw = annotations.get(PROMOTED_SPEC_ANNOTATION)
@@ -247,7 +269,7 @@ class InferenceServiceReconciler:
                 # old revisions are torn down only once latest serves (no-downtime)
                 self._gc_old_revisions(isvc, comp, keep={r for r, _, _ in revisions})
             ctype = {"predictor": sapi.PREDICTOR_READY, "transformer": sapi.TRANSFORMER_READY, "explainer": sapi.EXPLAINER_READY}[comp]
-            set_condition(status, ctype, "True" if comp_ready else "False", "ComponentReady" if comp_ready else "ComponentNotReady")
+            cond_changed |= set_condition(status, ctype, "True" if comp_ready else "False", "ComponentReady" if comp_ready else "ComponentNotReady")
             all_ready = all_ready and comp_ready
             components_status[comp] = info
 
@@ -263,10 +285,19 @@ class InferenceServiceReconciler:
             isvc_config(self.api), isvc["metadata"]["name"],
             isvc["metadata"].get("namespace", "default"))
         status["address"] = {"url": f"http://127.0.0.1:{entry_port}"}
-        set_condition(status, READY, "True" if all_ready else "False", "AllReady" if all_ready else "NotReady")
-        self.api.update_status(isvc)
+        cond_changed |= set_condition(status, READY, "True" if all_ready else "False", "AllReady" if all_ready else "NotReady")
+        new_fields = {k: v for k, v in status.items() if k != "conditions"}
+        if cond_changed or new_fields != old_fields:
+            # write only on a real change: an unconditional write retriggers
+            # this controller's own watch — a self-sustaining reconcile storm
+            self.api.update_status(isvc)
+        key = (req.namespace, req.name)
         if not all_ready:
-            return Result(requeue_after=0.1)
+            # poll with capped exponential backoff: a never-ready service must
+            # not pin the manager at 10 Hz forever (deployment/pod watch
+            # events still requeue immediately on real transitions)
+            return Result(requeue_after=_poll_backoff(self._attempts, key, 5.0))
+        self._attempts.pop(key, None)
         return None
 
     # -------------------------------------------------------------- revisions

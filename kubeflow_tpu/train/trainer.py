@@ -76,7 +76,16 @@ class Trainer:
         self.params = jax.jit(
             lambda p: p, out_shardings=tree_shardings(params, mesh, rules)
         )(params)
-        self.opt_state = jax.jit(self.optimizer.init)(self.params)
+        # optimizer state gets EXPLICIT shardings: m/v paths embed the param
+        # paths so the same rules resolve them, scalars (count, …) fall to the
+        # replicated default.  Without this, jit(init) leaves scalars as
+        # uncommitted single-device arrays — fine until a checkpoint restore
+        # commits them per-process, which wedges the multi-process step with
+        # "incompatible devices" on gang resume.
+        opt_shape = jax.eval_shape(self.optimizer.init, self.params)
+        self.opt_state = jax.jit(
+            self.optimizer.init, out_shardings=tree_shardings(opt_shape, mesh, rules)
+        )(self.params)
         self._batch_sharding = batch_sharding(mesh)
 
         # NOTE: activation remat is a MODEL-level choice (e.g. BertConfig.remat

@@ -49,6 +49,13 @@ RETRYABLE_EXIT_MIN = 128  # signal-terminated / preempted → retryable
 
 class JobController:
     kind: str = "TPUJob"
+    # slice-level failure domain (SURVEY.md §5): retryable failure of ANY
+    # replica restarts the whole gang (one backoff count). True for the
+    # jax.distributed kinds — survivors of a partial failure are wedged in
+    # collectives and rendezvous needs every process to rejoin. Framework
+    # kinds with per-rank recovery semantics (TF PS, torch elastic) keep
+    # per-pod restarts.
+    gang_restart: bool = False
 
     def __init__(self, api: APIServer):
         self.api = api
@@ -111,7 +118,12 @@ class JobController:
                         return Result(requeue_after=0.05)
                     failure_msg = f"{rtype}[{i}] failed with exit code {rc} (permanent)"
                     break
-                if self._restarts(status) + len(retryable_failures) >= backoff_limit:
+                # gang mode: the current cycle's failures all collapse into ONE
+                # restart that hasn't been charged yet, so only PAST restarts
+                # count against the budget (backoffLimit=N allows N restarts,
+                # matching the per-pod accounting)
+                pending = 0 if self.gang_restart else len(retryable_failures)
+                if self._restarts(status) + pending >= backoff_limit:
                     failure_msg = f"{rtype}[{i}] exceeded backoffLimit ({backoff_limit})"
                     break
                 retryable_failures.append((rtype, i, pod, rc))
@@ -119,16 +131,37 @@ class JobController:
                 break
 
         restarted = False
-        if failure_msg is None:
-            for rtype, i, pod, rc in retryable_failures:
-                self.api.try_delete("Pod", pod["metadata"]["name"], req.namespace)
-                pods_by_type[rtype][i] = None
+        if failure_msg is None and retryable_failures:
+            if self.gang_restart:
+                # slice-level failure domain (SURVEY.md §5): one worker down
+                # restarts the WHOLE gang — survivors are blocked in XLA
+                # collectives and a fresh jax.distributed rendezvous needs
+                # every process to rejoin; workers resume from the newest
+                # checkpoint (spec.checkpoint), so this costs steps-since-
+                # save, not the run. One gang restart = one backoff count.
+                rtype0, i0, _, rc0 = retryable_failures[0]
+                for rtype, rspec in replicas.items():
+                    for i, pod in enumerate(pods_by_type[rtype]):
+                        if pod is not None:
+                            self.api.try_delete("Pod", pod["metadata"]["name"], req.namespace)
+                            pods_by_type[rtype][i] = None
                 status["restartCount"] = self._restarts(status) + 1
                 restarted = True
                 JOBS_RESTARTED.inc(kind=self.kind)
                 self.recorder.warning(
-                    job, "JobRestarting", f"{rtype}[{i}] exit {rc}: retryable, recreating"
+                    job, "SliceRestarting",
+                    f"{rtype0}[{i0}] exit {rc0}: retryable, restarting the whole gang"
                 )
+            else:
+                for rtype, i, pod, rc in retryable_failures:
+                    self.api.try_delete("Pod", pod["metadata"]["name"], req.namespace)
+                    pods_by_type[rtype][i] = None
+                    status["restartCount"] = self._restarts(status) + 1
+                    restarted = True
+                    JOBS_RESTARTED.inc(kind=self.kind)
+                    self.recorder.warning(
+                        job, "JobRestarting", f"{rtype}[{i}] exit {rc}: retryable, recreating"
+                    )
 
         if failure_msg:
             set_condition(status, tapi.FAILED, "True", "JobFailed", failure_msg)

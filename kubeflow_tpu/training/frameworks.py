@@ -45,6 +45,7 @@ class TPUJobController(JobController):
     """TPUJob/JAXJob: jax.distributed over ICI, megascale over DCN."""
 
     kind = "TPUJob"
+    gang_restart = True  # one chip down = whole-slice restart (SURVEY.md §5)
 
     def num_ports(self, total: int) -> int:
         return 2  # [jax coordinator, megascale coordinator]

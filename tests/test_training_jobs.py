@@ -445,6 +445,44 @@ def test_tpujob_auto_resume_from_checkpoint(tcluster, tmp_path):
     assert "step=1 " not in resumed_part
 
 
+@pytest.mark.slow
+def test_tpujob_gang_restart_on_single_worker_failure(tcluster, tmp_path):
+    """Slice-level failure domain (SURVEY.md §5): one worker of a 2-worker
+    jax.distributed gang preempted mid-run restarts the WHOLE gang (the
+    survivor is wedged in collectives), both workers re-rendezvous, resume
+    from the newest checkpoint, and the job completes — one backoff count."""
+    spec = job(
+        "TPUJob",
+        "gangres",
+        {"Worker": ReplicaSpec(
+            replicas=2,
+            restart_policy="ExitCode",
+            command=[sys.executable, "-u", "-m", "kubeflow_tpu.examples.bert_worker"],
+            env={
+                "JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo",
+                "TRAIN_STEPS": "10", "FAIL_AT_STEP": "5", "FAIL_RANK": "1",
+                "FAIL_MARKER": str(tmp_path / "died"),
+            },
+        )},
+    )
+    spec["spec"]["checkpoint"] = {"dir": str(tmp_path / "ckpt"), "everySteps": 2}
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("TPUJob", "gangres", timeout=300) == tapi.SUCCEEDED
+    j = client.get_job("TPUJob", "gangres")
+    assert j["status"]["restartCount"] == 1  # one gang restart, not per-pod
+    events = [e.get("reason") for e in tcluster.api.list("Event")]
+    assert "SliceRestarting" in events
+    # BOTH workers ran twice: fresh (resumed_from=0) then resumed from a
+    # durable checkpoint — the healthy worker restarted too
+    import re
+    for w in (0, 1):
+        log = tcluster.logs(f"gangres-worker-{w}")
+        resumes = [int(m) for m in re.findall(r"resumed_from=(\d+)", log)]
+        assert len(resumes) == 2 and resumes[0] == 0 and resumes[1] > 0, (w, resumes)
+        assert "TRAIN-DONE step=10" in log
+
+
 def test_dns_host_mode_renders_headless_service_names(tcluster):
     """spec.network.hostMode=dns: rendezvous env carries the headless-Service
     DNS names that the common controller's per-replica Services resolve to —
