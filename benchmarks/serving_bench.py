@@ -51,6 +51,8 @@ def main() -> None:
                         "chunked prefill under concurrent decode)")
     p.add_argument("--paged-kernel", action="store_true",
                    help="use the Pallas paged-attention decode path")
+    p.add_argument("--kv-quant", default=None, choices=[None, "int8"],
+                   help="int8 KV-cache quantization (~2x servable context)")
     p.add_argument("--shared-prefix-frac", type=float, default=0.0,
                    help="fraction of each prompt that is a common system-prompt "
                         "prefix shared by every request (exercises the engine's "
@@ -75,7 +77,8 @@ def main() -> None:
         EngineConfig(max_slots=args.concurrency, num_pages=1024, page_size=32,
                      max_pages_per_slot=(4 * args.prompt_len + args.max_tokens) // 32 + 2,
                      tensor_parallel=args.tensor_parallel,
-                     paged_kernel=args.paged_kernel or None),
+                     paged_kernel=args.paged_kernel or None,
+                     kv_quant=args.kv_quant),
     )
     engine.start()
     rng = np.random.default_rng(0)
@@ -126,6 +129,7 @@ def main() -> None:
         "tensor_parallel": args.tensor_parallel,
         "long_prompt_frac": args.long_prompt_frac,
         "paged_kernel": engine._paged,
+        "kv_quant": engine._kv_quant,
         "long_requests": len(long_idx),
         "shared_prefix_frac": args.shared_prefix_frac,
         "prefix_cache": final_stats,

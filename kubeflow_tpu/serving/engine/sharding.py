@@ -78,9 +78,21 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
     }
 
 
-def alloc_pool(shape: tuple, mesh: Mesh, dtype=None) -> jax.Array:
+def alloc_pool(shape: tuple, mesh: Mesh, dtype=None, quant=None):
     """Allocate a zeroed pool sharded-direct — no chip ever holds the full
-    pool (allocating replicated first would OOM exactly the models TP serves)."""
+    pool (allocating replicated first would OOM exactly the models TP serves).
+    With ``quant='int8'`` returns the {"q", "s"} pool pytree (model.py):
+    values shard like the bf16 pool; the per-(token,head) scales end in a
+    singleton dim, so the same kv-head-axis spec applies."""
+    from .model import make_kv_pool
+
+    if quant is not None:
+        # one source of truth for the quantized-pool pytree (model.py);
+        # every leaf shards on the kv-head axis (scales end in a singleton
+        # dim, so POOL_SPEC applies unchanged)
+        structure = jax.eval_shape(lambda: make_kv_pool(shape, quant))
+        shardings = jax.tree.map(lambda _: NamedSharding(mesh, POOL_SPEC), structure)
+        return jax.jit(lambda: make_kv_pool(shape, quant), out_shardings=shardings)()
     import jax.numpy as jnp
 
     dtype = dtype or jnp.bfloat16

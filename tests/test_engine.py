@@ -477,3 +477,60 @@ def test_native_batcher_queued_cache_sharer_cannot_deadlock_admission():
     assert sc is not None and sc[1] == 3 and sc[4] == 3
     b.release(sc[0])
     b.close()
+
+
+# ------------------------------------------------------------ int8 KV cache
+
+def test_int8_kv_pool_decode_logits_close_to_bf16(params):
+    """Quantized pools must track the bf16 paged path closely: same prompt
+    prefilled + decoded through both pool representations, logits compared."""
+    page_size = 8
+    toks = np.array([[5, 7, 9, 11, 2, 4, 6, 8, 10, 3, 1, 12]], np.int32)
+    plen = 8
+    logits_ref = None
+    for quant in (None, "int8"):
+        k_pool = M.make_kv_pool((CFG.n_layers, 16, page_size, CFG.n_kv_heads, CFG.head_dim), quant)
+        v_pool = M.make_kv_pool((CFG.n_layers, 16, page_size, CFG.n_kv_heads, CFG.head_dim), quant)
+        _, pk, pv = M.prefill(params, CFG, jnp.asarray(toks[:, :plen]), jnp.int32(plen), page_size)
+        k_pool, v_pool = M.write_pages(k_pool, v_pool, pk, pv, jnp.asarray([3, 5], jnp.int32))
+        pt = np.zeros((2, 4), np.int32)
+        pt[1, :2] = [3, 5]
+        tok = np.zeros((2,), np.int32)
+        tok[1] = toks[0, plen]
+        lens = np.zeros((2,), np.int32)
+        lens[1] = plen + 1
+        logits, k_pool, v_pool = M.decode_step(
+            params, CFG, jnp.asarray(tok), jnp.asarray(lens), jnp.asarray(pt), k_pool, v_pool)
+        if quant is None:
+            logits_ref = np.asarray(logits)[1]
+        else:
+            np.testing.assert_allclose(np.asarray(logits)[1], logits_ref, atol=0.15, rtol=0.05)
+
+
+def test_engine_int8_kv_quant_generates_near_greedy(params):
+    """E2E with kv_quant='int8': every generated token must be within a small
+    logit margin of the full-precision oracle's argmax at each step (exact
+    equality is not promised — int8 noise may flip near-ties)."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        prefill_chunk=16, kv_quant="int8",
+    ))
+    assert isinstance(eng.k_pool, dict) and eng.k_pool["q"].dtype == jnp.int8
+    eng.start()
+    try:
+        for prompt in ([5, 7, 9, 11], [(i * 5) % (CFG.vocab_size - 1) + 1 for i in range(40)]):
+            out = eng.generate(prompt, 4, timeout=180)
+            toks = list(prompt)
+            for tok in out["tokens"]:
+                logits = np.asarray(M.forward_full(params, CFG, jnp.asarray([toks], jnp.int32)))[0, -1]
+                assert logits.max() - logits[tok] <= 0.35, (toks, tok, float(logits.max() - logits[tok]))
+                toks.append(tok)
+    finally:
+        eng.stop()
+
+
+def test_engine_kv_quant_paged_kernel_exclusive(params):
+    with pytest.raises(ValueError, match="exclusive"):
+        Engine(params, CFG, EngineConfig(max_slots=2, num_pages=32, page_size=8,
+                                         max_pages_per_slot=8, kv_quant="int8",
+                                         paged_kernel=True))
