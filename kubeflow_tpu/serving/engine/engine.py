@@ -19,10 +19,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import Optional
+from typing import Iterator, Optional
 
 import numpy as np
 
@@ -71,6 +72,9 @@ class _Pending:
     first_token_at: float = 0.0
     # chain hashes for the prompt's full pages (prefix-cache identity)
     page_hashes: "np.ndarray" = None
+    # streaming: every committed token is also pushed here, then a final
+    # (None, result) sentinel (generate_stream consumes it)
+    stream: "queue.Queue" = None
 
 
 class Engine:
@@ -145,8 +149,12 @@ class Engine:
             self._thread.join(timeout=10)
         self.batcher.close()
 
-    def generate_async(self, tokens: list[int], max_new_tokens: int = 32) -> Future:
-        """Submit a prompt; the Future resolves to a result dict."""
+    def generate_async(self, tokens: list[int], max_new_tokens: int = 32,
+                       stream: Optional["queue.Queue"] = None) -> Future:
+        """Submit a prompt; the Future resolves to a result dict.
+
+        ``stream``: optional queue that receives each token id as it is
+        committed, then a final ``(None, result)`` sentinel."""
         if not tokens:
             raise ValueError("empty prompt")
         fut: Future = Future()
@@ -157,6 +165,7 @@ class Engine:
             self._requests[rid] = _Pending(
                 tokens=list(tokens), max_new_tokens=max_new_tokens,
                 future=fut, submitted_at=time.perf_counter(), page_hashes=hashes,
+                stream=stream,
             )
         # lookup eligibility stops one page short of the prompt end: prefill
         # must compute at least the final prompt token to produce the logits
@@ -192,6 +201,34 @@ class Engine:
 
     def generate(self, tokens: list[int], max_new_tokens: int = 32, timeout: float = 300.0) -> dict:
         return self.generate_async(tokens, max_new_tokens).result(timeout=timeout)
+
+    def generate_stream(self, tokens: list[int], max_new_tokens: int = 32,
+                        timeout: float = 300.0) -> Iterator:
+        """Yield token ids as they are committed, then a final result dict.
+
+        The last item yielded is the same dict ``generate`` returns (so
+        callers get ttft/latency/truncated without a second call).  The
+        prompt is submitted NOW (plain method returning a generator), so the
+        request runs even if the caller delays iteration; an abandoned
+        iterator costs at most max_new_tokens queued ints.  A stall past
+        ``timeout`` raises TimeoutError."""
+        q: queue.Queue = queue.Queue()
+        self.generate_async(tokens, max_new_tokens, stream=q)
+        deadline = time.monotonic() + timeout
+
+        def _iter():
+            while True:
+                try:
+                    item = q.get(timeout=max(0.0, deadline - time.monotonic()))
+                except queue.Empty:
+                    raise TimeoutError(
+                        f"generation stalled past {timeout}s") from None
+                if isinstance(item, tuple) and item[0] is None:
+                    yield item[1]  # final result dict
+                    return
+                yield item
+
+        return _iter()
 
     @property
     def stats(self) -> dict:
@@ -350,6 +387,8 @@ class Engine:
         rid = self._slot_req[slot]
         pending = self._requests[rid]
         pending.generated.append(token)
+        if pending.stream is not None:
+            pending.stream.put(token)
         is_eos = token == self.ec.eos_id
         rc = self.batcher.commit_token(slot, is_eos)
         if rc == 1:
@@ -364,12 +403,13 @@ class Engine:
         # hand the prompt's full pages to the prefix cache on the way out
         self.batcher.release(slot, pending.page_hashes)
         now = time.perf_counter()
-        pending.future.set_result(
-            {
-                "tokens": pending.generated,
-                "num_tokens": len(pending.generated),
-                "truncated": truncated,
-                "ttft_s": pending.first_token_at - pending.submitted_at,
-                "latency_s": now - pending.submitted_at,
-            }
-        )
+        result = {
+            "tokens": pending.generated,
+            "num_tokens": len(pending.generated),
+            "truncated": truncated,
+            "ttft_s": pending.first_token_at - pending.submitted_at,
+            "latency_s": now - pending.submitted_at,
+        }
+        pending.future.set_result(result)
+        if pending.stream is not None:
+            pending.stream.put((None, result))

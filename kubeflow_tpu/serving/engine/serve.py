@@ -92,6 +92,51 @@ class JetStreamModel(Model):
         self.engine.start()
         self.ready = True
 
+    def _parse_generate(self, payload: Any) -> tuple[list[int], int]:
+        prompt = payload.get("text_input", "") if isinstance(payload, dict) else str(payload)
+        max_tokens = int((payload.get("parameters") or {}).get("max_tokens", 32)) \
+            if isinstance(payload, dict) else 32
+        return self.tokenizer.encode(prompt) or [0], max_tokens
+
+    def generate(self, payload: Any, headers: Optional[dict] = None) -> Any:
+        """V2 generate extension (unary): {"text_input": str, "parameters":
+        {"max_tokens": N}} -> {"text_output": str, ...}."""
+        ids, max_tokens = self._parse_generate(payload)
+        r = self.engine.generate(ids, max_tokens)
+        return {"text_output": self.tokenizer.decode(r["tokens"]),
+                "token_ids": r["tokens"], "tokens": r["num_tokens"],
+                "ttft_s": round(r["ttft_s"], 4), "latency_s": round(r["latency_s"], 4)}
+
+    def generate_stream(self, payload: Any, headers: Optional[dict] = None):
+        """V2 generate_stream: yields {"text_output": piece} per token, then
+        a final record with the run stats.
+
+        Pieces come from decoding the WHOLE generated-id prefix and emitting
+        the delta, holding back trailing replacement chars (a multi-byte
+        UTF-8 char split across byte tokens decodes to U+FFFD until its tail
+        arrives) — so the concatenated stream equals the unary text_output.
+        """
+        ids, max_tokens = self._parse_generate(payload)
+        out_ids: list[int] = []
+        emitted = 0
+        for item in self.engine.generate_stream(ids, max_tokens):
+            if isinstance(item, dict):
+                full = self.tokenizer.decode(out_ids)
+                if len(full) > emitted:  # flush held-back tail
+                    yield {"text_output": full[emitted:]}
+                yield {"text_output": "", "done": True, "tokens": item["num_tokens"],
+                       "ttft_s": round(item["ttft_s"], 4),
+                       "latency_s": round(item["latency_s"], 4)}
+                return
+            out_ids.append(item)
+            full = self.tokenizer.decode(out_ids)
+            stable = len(full)
+            while stable > emitted and full[stable - 1] == "�" and len(full) - stable < 3:
+                stable -= 1  # ≤3 trailing bytes may be an incomplete UTF-8 seq
+            if stable > emitted:
+                yield {"text_output": full[emitted:stable]}
+                emitted = stable
+
     def predict(self, payload: Any, headers: Optional[dict] = None) -> Any:
         instances = payload.get("instances", []) if isinstance(payload, dict) else payload
         futures = []

@@ -189,6 +189,12 @@ class ModelServer:
             elif path.startswith("/v2/models/") and path.endswith("/infer"):
                 name = path[len("/v2/models/"):-len("/infer")]
                 self._v2(h, name)
+            elif path.startswith("/v2/models/") and path.endswith("/generate_stream"):
+                name = path[len("/v2/models/"):-len("/generate_stream")]
+                self._generate(h, name, stream=True)
+            elif path.startswith("/v2/models/") and path.endswith("/generate"):
+                name = path[len("/v2/models/"):-len("/generate")]
+                self._generate(h, name, stream=False)
             else:
                 h._send(404, {"error": f"no route {path}"})
         except Exception as e:  # noqa: BLE001 — server must answer
@@ -213,6 +219,48 @@ class ModelServer:
         else:
             key = "explanations" if verb == "explain" else "predictions"
             h._send(200, {key: result})
+
+    def _generate(self, h, name: str, stream: bool) -> None:
+        """V2 generate extension (the KServe/OIP LLM surface): unary
+        ``/generate`` returns one JSON body; ``/generate_stream`` answers
+        Server-Sent Events (`data: {...}` per token, read-until-close)."""
+        m = self.models.get(name)
+        if m is None:
+            h._send(404, {"error": f"model {name} not found"})
+            return
+        verb = getattr(m, "generate_stream" if stream else "generate", None)
+        if verb is None:
+            h._send(400, {"error": f"model {name} does not support generate"})
+            return
+        body = h._body()
+        headers = dict(h.headers.items())
+        if not stream:
+            out = verb(body, headers)
+            out = dict(out) if isinstance(out, dict) else {"text_output": out}
+            out.setdefault("model_name", name)
+            h._send(200, out)
+            return
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("Connection", "close")  # stream length unknown: SSE
+        h.end_headers()
+        # headers are out: errors must stay INSIDE the event stream — letting
+        # them reach _handle_post's catch-all would write a second HTTP
+        # response into the SSE body (and a client disconnect would raise
+        # again from that very write)
+        try:
+            for event in verb(body, headers):
+                h.wfile.write(b"data: " + json.dumps(event).encode() + b"\n\n")
+                h.wfile.flush()
+        except OSError:
+            pass  # client went away mid-stream
+        except Exception as e:  # noqa: BLE001 — surface as a final event
+            try:
+                h.wfile.write(b"data: " + json.dumps(
+                    {"error": f"{type(e).__name__}: {e}", "done": True}).encode() + b"\n\n")
+            except OSError:
+                pass
 
     def _v2(self, h, name: str) -> None:
         m = self.models.get(name)

@@ -534,3 +534,51 @@ def test_engine_kv_quant_paged_kernel_exclusive(params):
         Engine(params, CFG, EngineConfig(max_slots=2, num_pages=32, page_size=8,
                                          max_pages_per_slot=8, kv_quant="int8",
                                          paged_kernel=True))
+
+
+# ------------------------------------------------------------- streaming
+
+def test_engine_generate_stream_yields_tokens_incrementally(params, engine):
+    """generate_stream yields each token as committed, then the result dict;
+    the streamed ids must equal the unary result and the greedy oracle."""
+    prompt = [5, 7, 9, 11]
+    items = list(engine.generate_stream(prompt, 6, timeout=180))
+    *tokens, final = items
+    assert isinstance(final, dict) and final["num_tokens"] == 6
+    assert tokens == final["tokens"] == greedy_oracle(params, prompt, 6)
+
+
+def test_model_server_generate_and_sse_stream(params):
+    """KServe/OIP LLM surface: unary /v2/models/x/generate and SSE
+    /v2/models/x/generate_stream against a live HTTP server."""
+    import urllib.request
+
+    from kubeflow_tpu.serving.server import ModelServer
+
+    eng = Engine(params, CFG, EngineConfig(max_slots=2, num_pages=64,
+                                           page_size=8, max_pages_per_slot=16))
+    m = JetStreamModel("llm", engine=eng)
+    srv = ModelServer([m])
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/v2/models/llm"
+        body = json.dumps({"text_input": "ab", "parameters": {"max_tokens": 5}}).encode()
+
+        req = urllib.request.Request(base + "/generate", data=body,
+                                     headers={"Content-Type": "application/json"})
+        unary = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert unary["model_name"] == "llm" and unary["tokens"] == 5
+
+        req = urllib.request.Request(base + "/generate_stream", data=body,
+                                     headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=120)
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        events = [json.loads(line[len(b"data: "):])
+                  for line in resp.read().split(b"\n\n") if line.startswith(b"data: ")]
+        pieces = [e["text_output"] for e in events if not e.get("done")]
+        assert len(pieces) == 5
+        assert "".join(pieces) == unary["text_output"]
+        assert events[-1].get("done") and events[-1]["tokens"] == 5
+    finally:
+        srv.stop()
+        eng.stop()
