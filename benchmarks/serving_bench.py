@@ -53,6 +53,8 @@ def main() -> None:
                    help="use the Pallas paged-attention decode path")
     p.add_argument("--kv-quant", default=None, choices=[None, "int8"],
                    help="int8 KV-cache quantization (~2x servable context)")
+    p.add_argument("--speculative", default=None, choices=[None, "prompt_lookup"],
+                   help="prompt-lookup speculative decoding (lossless greedy)")
     p.add_argument("--shared-prefix-frac", type=float, default=0.0,
                    help="fraction of each prompt that is a common system-prompt "
                         "prefix shared by every request (exercises the engine's "
@@ -78,7 +80,7 @@ def main() -> None:
                      max_pages_per_slot=(4 * args.prompt_len + args.max_tokens) // 32 + 2,
                      tensor_parallel=args.tensor_parallel,
                      paged_kernel=args.paged_kernel or None,
-                     kv_quant=args.kv_quant),
+                     kv_quant=args.kv_quant, speculative=args.speculative),
     )
     engine.start()
     rng = np.random.default_rng(0)
@@ -130,6 +132,7 @@ def main() -> None:
         "long_prompt_frac": args.long_prompt_frac,
         "paged_kernel": engine._paged,
         "kv_quant": engine._kv_quant,
+        "speculative": engine._spec,
         "long_requests": len(long_idx),
         "shared_prefix_frac": args.shared_prefix_frac,
         "prefix_cache": final_stats,

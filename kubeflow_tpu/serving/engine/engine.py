@@ -27,8 +27,8 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-from .model import (DecoderConfig, decode_step, prefill, prefill_chunk,
-                    sample_tokens, write_pages)
+from .model import (DecoderConfig, decode_step, decode_step_k, prefill,
+                    prefill_chunk, sample_tokens, write_pages)
 from .native import NativeBatcher
 
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
@@ -60,6 +60,15 @@ class EngineConfig:
     # defers to the ENGINE_KV_QUANT env var. Exclusive with paged_kernel
     # (the Pallas kernel reads the raw bf16 pool).
     kv_quant: Optional[str] = None
+    # speculative decoding: "prompt_lookup" drafts the continuation of the
+    # last n-gram's previous occurrence in the context and verifies up to
+    # spec_max_draft tokens in ONE decode pass (lossless under greedy —
+    # accepted tokens are exactly what argmax would have produced). None
+    # defers to ENGINE_SPECULATIVE. Requires temperature 0; exclusive with
+    # paged_kernel (the verify step uses the gather path).
+    speculative: Optional[str] = None
+    spec_max_draft: int = 4
+    spec_ngram: int = 2
 
 
 @dataclasses.dataclass
@@ -101,6 +110,16 @@ class Engine:
         if self._paged and self._kv_quant:
             raise ValueError("paged_kernel and kv_quant are exclusive "
                              "(the Pallas kernel reads the raw bf16 pool)")
+        self._spec = (engine_config.speculative if engine_config.speculative is not None
+                      else os.environ.get("ENGINE_SPECULATIVE") or None)
+        if self._spec is not None and self._spec != "prompt_lookup":
+            raise ValueError(f"unsupported speculative mode {self._spec!r}")
+        if self._spec and self._paged:
+            raise ValueError("speculative and paged_kernel are exclusive "
+                             "(the verify step uses the gather path)")
+        if self._spec and engine_config.temperature > 0:
+            raise ValueError("speculative decoding requires temperature 0 "
+                             "(greedy acceptance is what makes it lossless)")
         from .model import make_kv_pool
 
         if engine_config.tensor_parallel > 1:
@@ -132,6 +151,8 @@ class Engine:
         self._wake = threading.Event()
         self._key = jax.random.PRNGKey(engine_config.seed)
         self._sample_calls = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._jax = jax
         self._jnp = jnp
 
@@ -210,16 +231,16 @@ class Engine:
         callers get ttft/latency/truncated without a second call).  The
         prompt is submitted NOW (plain method returning a generator), so the
         request runs even if the caller delays iteration; an abandoned
-        iterator costs at most max_new_tokens queued ints.  A stall past
-        ``timeout`` raises TimeoutError."""
+        iterator costs at most max_new_tokens queued ints.  ``timeout``
+        bounds the wait for EACH next token (a stall), not the whole
+        generation — a healthy long run streams for as long as it needs."""
         q: queue.Queue = queue.Queue()
         self.generate_async(tokens, max_new_tokens, stream=q)
-        deadline = time.monotonic() + timeout
 
         def _iter():
             while True:
                 try:
-                    item = q.get(timeout=max(0.0, deadline - time.monotonic()))
+                    item = q.get(timeout=timeout)
                 except queue.Empty:
                     raise TimeoutError(
                         f"generation stalled past {timeout}s") from None
@@ -236,6 +257,8 @@ class Engine:
             "active_slots": self.batcher.num_active,
             "queue_depth": self.batcher.queue_depth,
             "free_pages": self.batcher.free_pages,
+            "spec_proposed": self._spec_proposed,
+            "spec_accepted": self._spec_accepted,
             **self.batcher.cache_stats(),
         }
 
@@ -355,10 +378,6 @@ class Engine:
             ]
             if decode_ready:
                 did_work = True
-                tokens = np.zeros((self.ec.max_slots,), np.int32)
-                for slot in decode_ready:
-                    gen = self._requests[self._slot_req[slot]].generated
-                    tokens[slot] = gen[-1] if gen else 0
                 seq_lens = np.array(self.batcher.seq_lens(), np.int32)
                 page_table = np.array(self.batcher.page_table(), np.int32)
                 for slot in self._prefilling:
@@ -366,24 +385,103 @@ class Engine:
                     # step's KV write: route them to the trash page, len 0
                     seq_lens[slot] = 0
                     page_table[slot, :] = 0
-                logits, self.k_pool, self.v_pool = decode_step(
-                    self.params, self.config, jnp.asarray(tokens),
-                    jnp.asarray(seq_lens), jnp.asarray(page_table),
-                    self.k_pool, self.v_pool, paged=self._paged,
-                )
-                sampled = np.asarray(
-                    sample_tokens(logits, self._next_key(), self.ec.temperature))
-                for slot in decode_ready:
-                    self._commit(slot, int(sampled[slot]))
+                drafts = {slot: self._draft_for(slot, seq_lens[slot])
+                          for slot in decode_ready} if self._spec else {}
+                if any(drafts.values()):
+                    self._decode_tick_speculative(decode_ready, drafts,
+                                                  seq_lens, page_table)
+                else:
+                    self._decode_tick_single(decode_ready, seq_lens, page_table)
 
             if not did_work:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
 
+    def _decode_tick_single(self, decode_ready, seq_lens, page_table) -> None:
+        jnp = self._jnp
+        tokens = np.zeros((self.ec.max_slots,), np.int32)
+        for slot in decode_ready:
+            gen = self._requests[self._slot_req[slot]].generated
+            tokens[slot] = gen[-1] if gen else 0
+        logits, self.k_pool, self.v_pool = decode_step(
+            self.params, self.config, jnp.asarray(tokens),
+            jnp.asarray(seq_lens), jnp.asarray(page_table),
+            self.k_pool, self.v_pool, paged=self._paged,
+        )
+        sampled = np.asarray(
+            sample_tokens(logits, self._next_key(), self.ec.temperature))
+        for slot in decode_ready:
+            self._commit(slot, int(sampled[slot]))
+
+    # ------------------------------------------------------- speculative
+
+    def _draft_for(self, slot: int, seq_len: int) -> list[int]:
+        """Prompt-lookup draft: continuation of the most recent earlier
+        occurrence of the context's final n-gram, clamped so every draft
+        position stays inside the slot's currently-owned pages."""
+        if seq_len == 0:
+            return []
+        ps = self.ec.page_size
+        room = -seq_len % ps  # tokens left in the last owned page
+        pending = self._requests[self._slot_req[slot]]
+        limit = min(self.ec.spec_max_draft, room,
+                    pending.max_new_tokens - len(pending.generated) - 1)
+        if limit <= 0:
+            return []
+        ctx = pending.tokens + pending.generated
+        n = self.ec.spec_ngram
+        if len(ctx) <= n:
+            return []
+        pat = ctx[-n:]
+        for i in range(len(ctx) - n - 1, -1, -1):
+            if ctx[i:i + n] == pat:
+                return ctx[i + n:i + n + limit]
+        return []
+
+    def _decode_tick_speculative(self, decode_ready, drafts, seq_lens,
+                                 page_table) -> None:
+        """One verify pass over [last token + drafts] for every ready slot;
+        commit the longest draft prefix matching greedy argmax plus the one
+        bonus token the final logit row yields (lossless vs token-by-token).
+        Rejected draft KV stays masked and is overwritten by the next tick's
+        row-0 write before anything reads it."""
+        jnp = self._jnp
+        K = 1 + self.ec.spec_max_draft
+        tokens = np.zeros((self.ec.max_slots, K), np.int32)
+        for slot in decode_ready:
+            gen = self._requests[self._slot_req[slot]].generated
+            tokens[slot, 0] = gen[-1] if gen else 0
+            d = drafts.get(slot) or []
+            tokens[slot, 1:1 + len(d)] = d
+        logits, self.k_pool, self.v_pool = decode_step_k(
+            self.params, self.config, jnp.asarray(tokens),
+            jnp.asarray(seq_lens), jnp.asarray(page_table),
+            self.k_pool, self.v_pool,
+        )
+        B, _, V = logits.shape
+        sampled = np.asarray(sample_tokens(
+            logits.reshape(B * K, V), self._next_key(), self.ec.temperature,
+        )).reshape(B, K)
+        for slot in decode_ready:
+            d = drafts.get(slot) or []
+            self._spec_proposed += len(d)
+            for j in range(len(d) + 1):
+                tok = int(sampled[slot, j])
+                rc = self._commit(slot, tok)
+                if rc != 1:
+                    break  # finished / truncated: slot already released
+                # logits[j+1] is only valid if the input at that row (the
+                # j-th draft token) matches what greedy actually produced
+                if j >= len(d) or d[j] != tok:
+                    break
+                self._spec_accepted += 1
+
     def _pages_for(self, tokens: int) -> int:
         return (tokens + self.ec.page_size - 1) // self.ec.page_size
 
-    def _commit(self, slot: int, token: int) -> None:
+    def _commit(self, slot: int, token: int) -> int:
+        """Record one generated token; returns the batcher rc (1 = keep
+        decoding; anything else means the slot was finished+released)."""
         rid = self._slot_req[slot]
         pending = self._requests[rid]
         pending.generated.append(token)
@@ -392,10 +490,11 @@ class Engine:
         is_eos = token == self.ec.eos_id
         rc = self.batcher.commit_token(slot, is_eos)
         if rc == 1:
-            return
+            return rc
         # finished (0) or page-pool OOM (-2): either way the slot frees; OOM
         # truncates the generation rather than deadlocking the pool
         self._finish(slot, rid, truncated=(rc == -2))
+        return rc
 
     def _finish(self, slot: int, rid: int, truncated: bool) -> None:
         pending = self._requests.pop(rid)

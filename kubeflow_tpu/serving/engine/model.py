@@ -387,6 +387,67 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
     return logits, k_pool, v_pool
 
 
+@functools.partial(jax.jit, static_argnames=("config",),
+                   donate_argnames=("k_pool", "v_pool"))
+def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
+                  k_pool, v_pool):
+    """Speculative verify step: process 1 committed + (K-1) draft tokens per
+    slot in ONE pass.
+
+    tokens: [B, K] int32 — tokens[b, 0] is the slot's last committed token
+    (position seq_lens[b]-1); tokens[b, 1:] are draft tokens at the following
+    positions. seq_lens counts ONLY committed tokens. Returns
+    (logits [B, K, vocab], k_pool, v_pool): logits[b, j] predicts the token
+    at position seq_lens[b]+j — the caller accepts the longest draft prefix
+    that matches argmax (greedy speculative decoding is lossless).
+
+    KV for every draft position is written to the pool; rejected positions
+    hold garbage that stays masked (reads clip at the committed seq_len) and
+    is overwritten when a real token reaches that position. The caller must
+    ensure draft positions stay within the slot's OWNED pages (the engine
+    clamps draft length to the current page's remaining room).
+
+    Inactive slots (seq_len==0) clamp to position 0 and produce garbage
+    logits the caller ignores — static shapes beat recompiles.
+    """
+    c = config
+    B, K = tokens.shape
+    page_size = pool_page_size(k_pool)
+    max_pages = page_table.shape[1]
+    T = max_pages * page_size
+    pos0 = jnp.maximum(seq_lens - 1, 0)
+    positions = pos0[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]  # [B, K]
+
+    x = params["embed"][tokens]  # [B, K, D]
+    t_range = jnp.arange(T, dtype=jnp.int32)
+    # causal over history + this chunk's own tokens (their KV is written
+    # below before attention reads the gathered cache)
+    mask = t_range[None, None, :] <= positions[:, :, None]  # [B, K, T]
+
+    page_of = positions // page_size                     # [B, K]
+    # padding rows near slot capacity can index past the table; route them to
+    # the trash page 0 explicitly (take_along_axis CLIPS under jit, which
+    # would alias the slot's last owned page and corrupt committed KV)
+    in_range = page_of < max_pages
+    page_ids = jnp.where(
+        in_range,
+        jnp.take_along_axis(page_table, jnp.minimum(page_of, max_pages - 1), axis=1),
+        0)                                               # [B, K]
+    offsets = positions % page_size
+
+    for l in range(c.n_layers):
+        h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
+        k_new, v_new = _kv_proj(params, l, c, h, positions)  # [B,K,Hkv,hd]
+        k_pool = pool_set(k_pool, (l, page_ids, offsets), k_new)
+        v_pool = pool_set(v_pool, (l, page_ids, offsets), v_new)
+        k_cache = pool_get(k_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
+        v_cache = pool_get(v_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
+        x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
+    x = _rms_norm(x, params["ln_out"], c.norm_eps)
+    logits = (x @ params["unembed"]).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
 # ----------------------------------------------------------------- reference
 
 

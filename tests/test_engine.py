@@ -582,3 +582,102 @@ def test_model_server_generate_and_sse_stream(params):
     finally:
         srv.stop()
         eng.stop()
+
+
+# ------------------------------------------------------- speculative decode
+
+def test_speculative_prompt_lookup_is_lossless(params):
+    """Prompt-lookup speculative decoding must produce EXACTLY the greedy
+    oracle (acceptance only keeps tokens argmax would have produced), and a
+    repetitive prompt must actually get drafts accepted."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        speculative="prompt_lookup", spec_max_draft=4, spec_ngram=1,
+    ))
+    eng.start()
+    try:
+        # a prompt containing EVERY vocab token: whatever the model
+        # generates, the unigram lookup finds an earlier occurrence, so
+        # drafts are proposed on every decode tick (with this random tiny
+        # model the drafts are usually wrong — losslessness is the point)
+        all_vocab = list(range(CFG.vocab_size))
+        periodic = [7, 3, 9, 5] * 6
+        for prompt in (all_vocab, periodic, [5, 7, 9]):
+            out = eng.generate(prompt, 8, timeout=180)
+            assert out["tokens"] == greedy_oracle(params, prompt, 8), prompt
+        stats = eng.stats
+        assert stats["spec_proposed"] > 0
+    finally:
+        eng.stop()
+
+
+def test_speculative_with_int8_kv_and_prefix_cache(params):
+    """Speculative decoding composes with int8 KV quantization and the
+    prefix cache; generations stay within the quantization logit margin."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        speculative="prompt_lookup", kv_quant="int8",
+    ))
+    eng.start()
+    try:
+        prompt = [7, 3, 9, 5] * 8
+        first = eng.generate(prompt, 6, timeout=180)
+        second = eng.generate(prompt, 6, timeout=180)  # prefix-cache hit path
+        assert first["tokens"] == second["tokens"]
+        for r in (first, second):
+            toks = list(prompt)
+            for tok in r["tokens"]:
+                logits = np.asarray(M.forward_full(params, CFG, jnp.asarray([toks], jnp.int32)))[0, -1]
+                assert logits.max() - logits[tok] <= 0.35, (toks, tok)
+                toks.append(tok)
+        assert eng.stats["page_hits"] > 0
+    finally:
+        eng.stop()
+
+
+def test_speculative_rejects_nonzero_temperature(params):
+    with pytest.raises(ValueError, match="temperature"):
+        Engine(params, CFG, EngineConfig(max_slots=2, num_pages=32, page_size=8,
+                                         max_pages_per_slot=8, temperature=0.7,
+                                         speculative="prompt_lookup"))
+
+
+def test_speculative_accepts_drafts_and_stays_lossless(params):
+    """When the context's tail IS the model's own continuation (prompt =
+    base + oracle(base)), the n-gram drafts match greedy and get ACCEPTED —
+    multi-token commits per verify pass — and output still equals the
+    oracle exactly."""
+    base = [(i * 7) % CFG.vocab_size for i in range(12)]
+    cont = greedy_oracle(params, base, 24)
+    prompt = base + cont[:16]
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=1, num_pages=64, page_size=8, max_pages_per_slot=16,
+        speculative="prompt_lookup", spec_ngram=2,
+    ))
+    eng.start()
+    try:
+        out = eng.generate(prompt, 8, timeout=180)
+        assert out["tokens"] == greedy_oracle(params, prompt, 8)
+        assert eng.stats["spec_accepted"] > 0
+    finally:
+        eng.stop()
+
+
+def test_speculative_lossless_at_slot_capacity_edge(params):
+    """Regression (r2 review): near slot capacity the verify step's PADDING
+    rows index past the page table; they must route to the trash page, not
+    clip onto the slot's last owned page (which would corrupt committed KV).
+    prompt+max_new fills the slot to exactly T = max_pages*page_size."""
+    base = [(i * 7) % CFG.vocab_size for i in range(12)]
+    cont = greedy_oracle(params, base, 12)
+    prompt = base + cont  # 24 tokens; + 8 generated == 32 == 4 pages * 8
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=4,
+        speculative="prompt_lookup", spec_ngram=1, spec_max_draft=4,
+    ))
+    eng.start()
+    try:
+        out = eng.generate(prompt, 8, timeout=180)
+        assert out["tokens"] == greedy_oracle(params, prompt, 8)
+    finally:
+        eng.stop()
