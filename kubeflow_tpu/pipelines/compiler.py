@@ -174,6 +174,32 @@ class Compiler:
         if len(set(names)) != len(names):
             raise CompileError(f"duplicate task names after expansion: {sorted(names)}")
 
+        # ExitHandler wiring: every task inside an exit group becomes a
+        # dependency of that group's cleanup task, which is flagged so the
+        # workflow runs it on ANY terminal dep phase (not just success)
+        exit_deps: dict = {}  # exit Task -> set of guarded task names
+        for t in tasks:
+            for g in t.group_path:
+                if g.kind == "exit":
+                    if t is g.exit_task:
+                        raise CompileError(
+                            f"exit task {t.name!r} cannot be created inside its "
+                            "own ExitHandler block")
+                    exit_deps.setdefault(g.exit_task, set()).add(t.name)
+        for et in exit_deps:
+            if et not in tasks:
+                raise CompileError(
+                    f"exit task {et.name!r} is not part of this pipeline")
+            # the cleanup runs even when producers FAILED, so a TaskOutput
+            # input could be unresolvable at execution time — forbid them
+            # (upstream likewise restricts exit-handler inputs)
+            for pname, value in et.inputs.items():
+                if isinstance(value, TaskOutput):
+                    raise CompileError(
+                        f"exit task {et.name!r} input {pname!r} references a task "
+                        "output; exit handlers run after failures too, so they "
+                        "may only take constants or pipeline parameters")
+
         components: dict = {}
         executors: dict = {}
         dag: dict = {}
@@ -230,6 +256,8 @@ class Compiler:
                     conditions.append(_expr_ir(g.condition))
                     for rt in g.condition.referenced_tasks():
                         deps.add(rt.name)
+            if t in exit_deps:
+                deps |= exit_deps[t]
             node: dict = {
                 "componentRef": comp_key,
                 "displayName": t.display_name,
@@ -237,6 +265,8 @@ class Compiler:
                 "inputs": {"parameters": params_ir, "artifacts": artifacts_ir},
                 "cachingOptions": {"enableCache": t.enable_caching},
             }
+            if t in exit_deps:
+                node["isExitHandler"] = True
             if conditions:
                 node["conditions"] = conditions
             if t.retries:

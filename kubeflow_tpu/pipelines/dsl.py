@@ -186,12 +186,13 @@ class ConditionExpr:
 
 @dataclass
 class _Group:
-    kind: str  # "root" | "condition" | "loop"
+    kind: str  # "root" | "condition" | "loop" | "exit"
     group_id: int
     condition: Optional[ConditionExpr] = None
     items: Optional[Union[list, TaskOutput]] = None
     loop_item: Optional[LoopItem] = None
     tasks: list["Task"] = field(default_factory=list)
+    exit_task: Optional["Task"] = None  # kind == "exit": the cleanup task
 
 
 class Condition:
@@ -210,6 +211,30 @@ class Condition:
 
     def __exit__(self, *exc):
         _require_context("dsl.Condition").pop_group()
+        return False
+
+
+class ExitHandler:
+    """``with dsl.ExitHandler(cleanup_task):`` — the cleanup task runs once
+    every task in the block reaches ANY terminal state, success or failure
+    (upstream ``[U:pipelines/sdk/python/kfp/dsl]`` ExitHandler semantics; the
+    workflow stays Running until the cleanup finishes, then reports the
+    block's real outcome).  ``cleanup_task`` must be created BEFORE the
+    ``with`` block and take only regular inputs."""
+
+    def __init__(self, exit_task: "Task"):
+        if not isinstance(exit_task, Task):
+            raise TypeError("dsl.ExitHandler takes the cleanup Task "
+                            "(create it before the with block)")
+        self.exit_task = exit_task
+
+    def __enter__(self):
+        ctx = _require_context("dsl.ExitHandler")
+        ctx.push_group(_Group("exit", ctx.next_group_id(), exit_task=self.exit_task))
+        return self
+
+    def __exit__(self, *exc):
+        _require_context("dsl.ExitHandler").pop_group()
         return False
 
 

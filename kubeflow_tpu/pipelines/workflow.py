@@ -139,11 +139,16 @@ class WorkflowController:
                     continue
                 # Pending: gate on dependencies
                 dep_phases = [nodes.get(d, {}).get("phase", papi.PENDING) for d in dag[tname].get("dependentTasks", [])]
-                if any(p in (papi.FAILED, papi.SKIPPED, papi.OMITTED) for p in dep_phases):
+                if dag[tname].get("isExitHandler"):
+                    # ExitHandler cleanup: runs once every guarded task is
+                    # terminal in ANY phase — failures must not omit it
+                    if not all(p in papi.NODE_TERMINAL for p in dep_phases):
+                        continue
+                elif any(p in (papi.FAILED, papi.SKIPPED, papi.OMITTED) for p in dep_phases):
                     node["phase"] = papi.OMITTED
                     pass_progressed = True
                     continue
-                if not all(p == papi.SUCCEEDED for p in dep_phases):
+                elif not all(p == papi.SUCCEEDED for p in dep_phases):
                     continue
                 if self._drive(wf, tname, dag[tname], node, args, ir):
                     pass_progressed = True
@@ -179,14 +184,14 @@ class WorkflowController:
 
     def _aggregate(self, nodes: dict, dag: dict) -> str:
         phases = [nodes.get(t, {}).get("phase", papi.PENDING) for t in dag]
-        if any(p == papi.FAILED for p in phases):
-            # a failed node can never unblock the rest; finish once nothing runs
-            if not any(p == papi.RUNNING for p in phases):
-                return papi.FAILED
+        # terminal only once EVERY node is terminal: a failure OMITs its
+        # dependents within the same fixpoint, but ExitHandler cleanups still
+        # have to run (and finish) before the workflow's phase settles
+        if not all(p in papi.NODE_TERMINAL for p in phases):
             return papi.RUNNING
-        if all(p in (papi.SUCCEEDED, papi.SKIPPED, papi.OMITTED) for p in phases):
-            return papi.SUCCEEDED
-        return papi.RUNNING
+        if any(p == papi.FAILED for p in phases):
+            return papi.FAILED
+        return papi.SUCCEEDED
 
     # ---------------------------------------------------------------- driver
 

@@ -8,8 +8,10 @@ from __future__ import annotations
 
 from ..core.api import APIServer
 from . import api as serving_api
+from . import graph as graph_api
 from .autoscaler import ConcurrencyAutoscaler
 from .controllers import DeploymentReconciler, InferenceServiceReconciler
+from .graph import InferenceGraphReconciler
 from .router import Router, ServiceProxy
 from .runtimes import install_default_runtimes
 
@@ -17,10 +19,12 @@ from .runtimes import install_default_runtimes
 def install(api: APIServer, manager, runtimes: bool = True):
     """Register serving CRDs + controllers. Returns (router, service_proxy)."""
     serving_api.register(api)
+    graph_api.register(api)
     if runtimes:
         install_default_runtimes(api)
     manager.add(DeploymentReconciler(api), owns=("Pod",))
     manager.add(InferenceServiceReconciler(api), owns=("Deployment",))
+    manager.add(InferenceGraphReconciler(api))
     autoscaler = ConcurrencyAutoscaler(api)
     manager.add_ticker(autoscaler.sync)
     proxy = ServiceProxy(api)

@@ -313,3 +313,100 @@ def test_metadata_sanitizer_builds():
             subprocess.run(["make", target], cwd=d, check=True, capture_output=True)
     finally:
         subprocess.run(["make", "clean"], cwd=d, capture_output=True)
+
+
+# --------------------------------------------------------------- ExitHandler
+
+
+def test_exit_handler_runs_on_failure_and_success(cluster, tmp_path):
+    """dsl.ExitHandler: the cleanup task runs whether the guarded block
+    succeeds or fails; a failure still fails the workflow AFTER cleanup."""
+    marker = tmp_path / "cleaned"
+
+    @dsl.component
+    def cleanup(path: str) -> str:
+        open(path, "a").write("cleaned\n")
+        return path
+
+    @dsl.component
+    def work(ok: bool) -> int:
+        if not ok:
+            raise RuntimeError("exploded")
+        return 1
+
+    @dsl.pipeline(name="exit-fail")
+    def exit_fail(path: str = ""):
+        exit_task = cleanup(path=path).set_caching_options(False)
+        with dsl.ExitHandler(exit_task):
+            work(ok=False).set_caching_options(False)
+
+    @dsl.pipeline(name="exit-ok")
+    def exit_ok(path: str = ""):
+        exit_task = cleanup(path=path).set_caching_options(False)
+        with dsl.ExitHandler(exit_task):
+            work(ok=True).set_caching_options(False)
+
+    client = Client(cluster)
+    rec = client.create_run_from_pipeline_func(
+        exit_fail, arguments={"path": str(marker)}).wait(timeout=90)
+    assert rec["phase"] == papi.FAILED                       # block failed
+    assert rec["nodes"]["work"]["phase"] == papi.FAILED
+    assert rec["nodes"]["cleanup"]["phase"] == papi.SUCCEEDED  # cleanup ran
+    assert marker.read_text() == "cleaned\n"
+
+    rec = client.create_run_from_pipeline_func(
+        exit_ok, arguments={"path": str(marker)}).wait(timeout=90)
+    assert rec["phase"] == papi.SUCCEEDED
+    assert rec["nodes"]["cleanup"]["phase"] == papi.SUCCEEDED
+    assert marker.read_text() == "cleaned\ncleaned\n"
+
+
+def test_exit_handler_ir_marks_cleanup_task():
+    """Compiled IR: the cleanup node is flagged isExitHandler and depends on
+    every task of its guarded block (that flag is what flips the workflow's
+    dep gate from all-SUCCEEDED to all-TERMINAL)."""
+    from kubeflow_tpu.pipelines.compiler import Compiler
+
+    @dsl.component
+    def noop() -> int:
+        return 0
+
+    @dsl.component
+    def tidy() -> int:
+        return 1
+
+    @dsl.pipeline(name="exit-ir")
+    def exit_ir():
+        cleanup = tidy()
+        with dsl.ExitHandler(cleanup):
+            a = noop()
+            noop().after(a).set_display_name("noop-2")
+
+    ir = Compiler().compile(exit_ir)
+    node = ir["root"]["dag"]["tasks"]["tidy"]
+    assert node["isExitHandler"] is True
+    assert set(node["dependentTasks"]) == {"noop", "noop-2"}
+
+
+def test_exit_handler_rejects_task_output_inputs():
+    """An exit handler runs after failures, so wiring a task output into it
+    could be unresolvable at cleanup time — compile error, not runtime hang."""
+    from kubeflow_tpu.pipelines.compiler import CompileError, Compiler
+
+    @dsl.component
+    def produce() -> int:
+        return 1
+
+    @dsl.component
+    def cleanup(x: int) -> int:
+        return x
+
+    @dsl.pipeline(name="bad-exit-input")
+    def bad_exit_input():
+        p = produce()
+        exit_task = cleanup(x=p.output)
+        with dsl.ExitHandler(exit_task):
+            produce().set_display_name("guarded")
+
+    with pytest.raises(CompileError, match="constants or pipeline parameters"):
+        Compiler().compile(bad_exit_input)

@@ -459,3 +459,128 @@ def test_isvc_batcher_and_logger_spec(scluster):
     lines = [json.loads(x) for x in open(log_path).read().splitlines()]
     assert [x["type"] for x in lines] == ["request", "response", "request", "response"]
     assert lines[1]["payload"] == {"predictions": [6]}
+
+
+# ------------------------------------------------------------ InferenceGraph
+
+
+def _graph_cluster(scluster, factors):
+    """Stand up one pyfunc ISVC per (name, factor) and wait Ready. The
+    models are chain-aware (accept a previous step's V1 response as input),
+    the shape upstream sequence-graph predictors are written in."""
+    c, router, tmp_path = scluster
+    from kubeflow_tpu.serving.api import inference_service
+
+    for name, factor in factors:
+        d = tmp_path / name
+        d.mkdir(exist_ok=True)
+        (d / "model.py").write_text(
+            "def predict(instances):\n"
+            "    if isinstance(instances, dict) and 'predictions' in instances:\n"
+            "        instances = instances['predictions']\n"
+            f"    return [x * {factor} for x in instances]\n")
+        c.apply(inference_service(name, model_format="pyfunc",
+                                  storage_uri=f"file://{d}"))
+    for name, _ in factors:
+        _wait_ready(c, name)
+    return c, router
+
+
+def test_inference_graph_sequence_switch_ensemble(scluster):
+    """InferenceGraph (KServe v1alpha1 parity): Sequence pipes responses,
+    Switch routes on a payload condition, Ensemble fans out and merges,
+    nodes compose via nodeName, and the controller reports Ready."""
+    from kubeflow_tpu.serving.graph import GraphRouter, inference_graph
+
+    c, router = _graph_cluster(scluster, [("dbl", 2), ("trp", 3)])
+    c.apply(inference_graph("g", {
+        "root": {"routerType": "Switch", "steps": [
+            {"condition": "mode == \"chain\"", "nodeName": "chain"},
+            {"condition": "mode == \"both\"", "nodeName": "fan"},
+            {"serviceName": "dbl"},                      # default branch
+        ]},
+        "chain": {"routerType": "Sequence", "steps": [
+            {"serviceName": "dbl"},
+            {"serviceName": "trp"},                      # gets dbl's response
+        ]},
+        "fan": {"routerType": "Ensemble", "steps": [
+            {"serviceName": "dbl", "name": "doubled"},
+            {"serviceName": "trp", "name": "tripled"},
+        ]},
+    }))
+
+    def graph_ready():
+        g = c.api.try_get("InferenceGraph", "g")
+        st = (g or {}).get("status", {})
+        return any(x["type"] == "Ready" and x["status"] == "True"
+                   for x in st.get("conditions", []))
+    assert c.wait_for(graph_ready, timeout=60)
+
+    gr = GraphRouter(c.api, router)
+    # Sequence: dbl then trp -> x * 6 (trp consumes dbl's {"predictions": ...}?
+    # pyfunc's predict receives instances; the sequence passes the previous
+    # RESPONSE body, so trp multiplies the predictions list)
+    out = gr.predict("g", {"mode": "chain", "instances": [1, 2]})
+    assert out == {"predictions": [6, 12]}
+    # Ensemble: both responses keyed by step name
+    out = gr.predict("g", {"mode": "both", "instances": [2]})
+    assert out == {"doubled": {"predictions": [4]}, "tripled": {"predictions": [6]}}
+    # Switch default branch
+    out = gr.predict("g", {"mode": "plain", "instances": [5]})
+    assert out == {"predictions": [10]}
+
+
+def test_inference_graph_splitter_and_validation(scluster):
+    from kubeflow_tpu.serving.graph import GraphRouter, inference_graph
+
+    c, router = _graph_cluster(scluster, [("a2", 2), ("a3", 3)])
+    c.apply(inference_graph("split", {
+        "root": {"routerType": "Splitter", "steps": [
+            {"serviceName": "a2", "weight": 80},
+            {"serviceName": "a3", "weight": 20},
+        ]},
+    }))
+    gr = GraphRouter(c.api, router, seed=7)
+    picks = {2: 0, 3: 0}
+    for _ in range(30):
+        out = gr.predict("split", {"instances": [1]})
+        picks[out["predictions"][0]] += 1
+    assert picks[2] > picks[3] > 0  # weighted, both sides exercised
+
+    from kubeflow_tpu.core.api import Invalid
+    import pytest as _pytest
+    with _pytest.raises(Invalid, match="root"):
+        c.api.create(inference_graph("bad", {"other": {
+            "routerType": "Sequence", "steps": [{"serviceName": "a2"}]}}))
+    with _pytest.raises(Invalid, match="weight"):
+        c.api.create(inference_graph("bad2", {"root": {
+            "routerType": "Splitter", "steps": [{"serviceName": "a2"}]}}))
+
+
+def test_inference_graph_cycle_rejected_and_ready_degrades(scluster):
+    from kubeflow_tpu.core.api import Invalid
+    from kubeflow_tpu.serving.graph import inference_graph
+    import pytest as _pytest
+
+    c, router = _graph_cluster(scluster, [("solo", 2)])
+    with _pytest.raises(Invalid, match="cycle"):
+        c.api.create(inference_graph("loopy", {
+            "root": {"routerType": "Sequence", "steps": [{"nodeName": "a"}]},
+            "a": {"routerType": "Sequence", "steps": [{"nodeName": "root"}]},
+        }))
+
+    c.apply(inference_graph("watchful", {
+        "root": {"routerType": "Sequence", "steps": [{"serviceName": "solo"}]},
+    }))
+
+    def graph_ready(want):
+        def check():
+            g = c.api.try_get("InferenceGraph", "watchful")
+            st = (g or {}).get("status", {})
+            return any(x["type"] == "Ready" and x["status"] == want
+                       for x in st.get("conditions", []))
+        return check
+    assert c.wait_for(graph_ready("True"), timeout=60)
+    # backend goes away -> Ready must DEGRADE (periodic re-check)
+    c.api.try_delete("InferenceService", "solo", "default")
+    assert c.wait_for(graph_ready("False"), timeout=30)
