@@ -199,6 +199,16 @@ class Compiler:
                         f"exit task {et.name!r} input {pname!r} references a task "
                         "output; exit handlers run after failures too, so they "
                         "may only take constants or pipeline parameters")
+            # same hazard through an enclosing dsl.Condition: an expression
+            # over a failed task's output would be unresolvable at cleanup
+            for g in et.group_path:
+                if g.kind == "condition" and g.condition is not None \
+                        and g.condition.referenced_tasks():
+                    raise CompileError(
+                        f"exit task {et.name!r} sits inside a dsl.Condition that "
+                        "references a task output; exit handlers run after "
+                        "failures, so such a condition may be unresolvable — "
+                        "gate on pipeline parameters only")
 
         components: dict = {}
         executors: dict = {}

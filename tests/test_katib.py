@@ -526,3 +526,46 @@ def test_darts_trial_e2e_recovers_genotype(kcluster):
     tname = optimal["bestTrialName"]
     log = kcluster.logs(f"{tname}-worker-0")
     assert '"relu_linear", "relu_linear", "relu_linear", "relu_linear"' in log
+
+
+# ---------------------------------------------------------------------- sobol
+
+
+def test_sobol_stratification_and_bounds():
+    """Sobol's defining property in base 2: for every dimension, the first
+    2^k points (after the origin) land one-per-bin in a 2^k partition of
+    [0,1) — far stronger balance than random search provides."""
+    from kubeflow_tpu.katib.suggest.sobol import sobol_points
+    import numpy as np
+
+    from kubeflow_tpu.katib.suggest.sobol import MAX_DIMS
+
+    dims = MAX_DIMS  # cover every table entry, incl. the last dimensions
+    shift = np.zeros(dims, dtype=np.int64)
+    for k in (3, 4, 6):
+        n = 2 ** k
+        pts = sobol_points(1, n, dims, shift)  # skip the origin like suggest()
+        assert pts.shape == (n, dims) and (pts >= 0).all() and (pts < 1).all()
+        full = sobol_points(0, n, dims, shift)
+        assert (full >= 0).all() and (full < 1).all()
+        for d in range(dims):
+            # aligned block 0..2^k-1 hits every 2^k bin exactly once
+            fbins = np.floor(full[:, d] * n).astype(int)
+            assert sorted(fbins.tolist()) == list(range(n)), (d, k)
+
+
+def test_sobol_suggester_resumes_and_respects_space():
+    exp = make_exp_obj("sobol", settings={"random_state": "5"})
+    sug = get_suggester("sobol")
+    first = sug.suggest(exp, [], 4)
+    assert len(first) == 4
+    for a in first:
+        assert 0.01 <= a["lr"] <= 1.0
+        assert 8 <= a["units"] <= 64 and isinstance(a["units"], int)
+        assert a["opt"] in ("sgd", "adam")
+    # resuming after N trials continues the sequence, not restarts it
+    fake = [fake_trial(a, 0.5) for a in first]
+    second = sug.suggest(exp, fake, 4)
+    assert all(s != f for s, f in zip(second, first))
+    # deterministic for a given state + trial count
+    assert sug.suggest(exp, fake, 4) == second

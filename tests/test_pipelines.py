@@ -410,3 +410,26 @@ def test_exit_handler_rejects_task_output_inputs():
 
     with pytest.raises(CompileError, match="constants or pipeline parameters"):
         Compiler().compile(bad_exit_input)
+
+
+def test_exit_handler_rejects_task_output_condition():
+    from kubeflow_tpu.pipelines.compiler import CompileError, Compiler
+
+    @dsl.component
+    def produce() -> int:
+        return 1
+
+    @dsl.component
+    def tidy2() -> int:
+        return 0
+
+    @dsl.pipeline(name="bad-exit-cond")
+    def bad_exit_cond():
+        p = produce()
+        with dsl.Condition(p.output > 0):
+            exit_task = tidy2()
+            with dsl.ExitHandler(exit_task):
+                produce().set_display_name("guarded")
+
+    with pytest.raises(CompileError, match="dsl.Condition"):
+        Compiler().compile(bad_exit_cond)
