@@ -34,7 +34,7 @@ def register(name: str):
 
 
 def get_suggester(name: str) -> Suggester:
-    from . import bayesian, cmaes, darts, enas, grid, hyperband, random_search, sobol, tpe  # noqa: F401
+    from . import bayesian, cmaes, darts, enas, grid, hyperband, pbt, random_search, sobol, tpe  # noqa: F401
 
     if name not in _REGISTRY:
         raise KeyError(f"unknown algorithm {name!r}; have {sorted(_REGISTRY)}")
@@ -42,6 +42,6 @@ def get_suggester(name: str) -> Suggester:
 
 
 def algorithm_names() -> list[str]:
-    from . import bayesian, cmaes, darts, enas, grid, hyperband, random_search, sobol, tpe  # noqa: F401
+    from . import bayesian, cmaes, darts, enas, grid, hyperband, pbt, random_search, sobol, tpe  # noqa: F401
 
     return sorted(_REGISTRY)

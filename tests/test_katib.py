@@ -569,3 +569,33 @@ def test_sobol_suggester_resumes_and_respects_space():
     assert all(s != f for s, f in zip(second, first))
     # deterministic for a given state + trial count
     assert sug.suggest(exp, fake, 4) == second
+
+
+# ------------------------------------------------------------------------ pbt
+
+
+def test_pbt_population_improves_over_generations():
+    """Exploit/explore: over a few generations on a known objective
+    (accuracy = 1-(lr-0.3)^2), the population's best and mean must improve
+    on the random first generation, and children must stay in bounds."""
+    exp = make_exp_obj("pbt", settings={"random_state": "3"})
+    sug = get_suggester("pbt")
+
+    def score(a):
+        return 1.0 - (a["lr"] - 0.3) ** 2
+
+    trials = []
+    gen_best = []
+    gen_mean = []
+    for _ in range(4):
+        batch = sug.suggest(exp, trials, 8)
+        for a in batch:
+            assert 0.01 <= a["lr"] <= 1.0
+            assert 8 <= a["units"] <= 64
+            assert a["opt"] in ("sgd", "adam")
+        trials += [fake_trial(a, score(a)) for a in batch]
+        gen_best.append(max(score(a) for a in batch))
+        gen_mean.append(sum(score(a) for a in batch) / len(batch))
+    assert gen_best[-1] >= gen_best[0]
+    assert gen_mean[-1] > gen_mean[0]  # the POPULATION improves, not one child
+    assert gen_best[-1] > 0.95  # converged near lr = 0.3
