@@ -681,3 +681,35 @@ def test_speculative_lossless_at_slot_capacity_edge(params):
         assert out["tokens"] == greedy_oracle(params, prompt, 8)
     finally:
         eng.stop()
+
+
+# --------------------------------------------------------- sanitizer stress
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sanitizer", ["thread", "address"])
+def test_core_concurrent_stress_under_sanitizers(sanitizer, tmp_path):
+    """The `go test -race` stand-in (SURVEY.md §5): the C++ core's full API
+    hammered from racing submitter/decoder/snapshot threads, compiled with
+    TSAN/ASAN. Any report fails the test even if the binary exits 0."""
+    import os
+    import subprocess
+
+    eng_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                           "kubeflow_tpu", "serving", "engine")
+    binary = tmp_path / f"stress_{sanitizer}"
+    build = subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17", "-pthread", f"-fsanitize={sanitizer}",
+         os.path.join(eng_dir, "core.cc"), os.path.join(eng_dir, "stress_main.cc"),
+         "-o", str(binary)],
+        capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr[-2000:]
+    env = dict(os.environ)
+    env["TSAN_OPTIONS"] = "halt_on_error=1"
+    env["ASAN_OPTIONS"] = "detect_leaks=1"
+    run = subprocess.run([str(binary)], capture_output=True, text=True,
+                         timeout=400, env=env)
+    report = run.stdout + run.stderr
+    assert run.returncode == 0, report[-3000:]
+    assert "stress OK" in run.stdout
+    assert "WARNING: ThreadSanitizer" not in report
+    assert "ERROR: AddressSanitizer" not in report and "LeakSanitizer" not in report
