@@ -3,8 +3,8 @@
 // lane (SURVEY.md §5 — the reference has no first-party C++; ours must prove
 // its locking under TSAN/ASAN, not just pass single-threaded unit tests).
 //
-// Build + run (tests/test_engine.py::test_core_concurrent_stress_under_tsan):
-//   g++ -O1 -g -std=c++17 -pthread -fsanitize=thread core.cc stress_main.cc
+// Build + run (tests/test_engine.py::test_core_concurrent_stress_under_sanitizers):
+//   make stress-tsan  (Makefile in this directory)
 //
 // Scenario: submitter threads race the decode thread across the full API —
 // submit (with prefix hashes) / admit / commit / release-with-cache /

@@ -696,13 +696,12 @@ def test_core_concurrent_stress_under_sanitizers(sanitizer, tmp_path):
 
     eng_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                            "kubeflow_tpu", "serving", "engine")
-    binary = tmp_path / f"stress_{sanitizer}"
-    build = subprocess.run(
-        ["g++", "-O1", "-g", "-std=c++17", "-pthread", f"-fsanitize={sanitizer}",
-         os.path.join(eng_dir, "core.cc"), os.path.join(eng_dir, "stress_main.cc"),
-         "-o", str(binary)],
-        capture_output=True, text=True, timeout=180)
+    target = {"thread": "stress-tsan", "address": "stress-asan"}[sanitizer]
+    # build through the Makefile target so the flags have one source of truth
+    build = subprocess.run(["make", "-C", eng_dir, target],
+                           capture_output=True, text=True, timeout=180)
     assert build.returncode == 0, build.stderr[-2000:]
+    binary = os.path.join(eng_dir, target.replace("-", "_"))
     env = dict(os.environ)
     env["TSAN_OPTIONS"] = "halt_on_error=1"
     env["ASAN_OPTIONS"] = "detect_leaks=1"
