@@ -367,6 +367,7 @@ def test_prefix_cache_reuses_pages_and_matches_oracle(params):
         eng.stop()
 
 
+@pytest.mark.slow
 def test_prefix_cache_concurrent_shared_prefix(params):
     """Two in-flight requests sharing cached prefix pages must not corrupt
     each other (shared pages are read-only by construction)."""
@@ -586,6 +587,7 @@ def test_model_server_generate_and_sse_stream(params):
 
 # ------------------------------------------------------- speculative decode
 
+@pytest.mark.slow
 def test_speculative_prompt_lookup_is_lossless(params):
     """Prompt-lookup speculative decoding must produce EXACTLY the greedy
     oracle (acceptance only keeps tokens argmax would have produced), and a
@@ -642,6 +644,7 @@ def test_speculative_rejects_nonzero_temperature(params):
                                          speculative="prompt_lookup"))
 
 
+@pytest.mark.slow
 def test_speculative_accepts_drafts_and_stays_lossless(params):
     """When the context's tail IS the model's own continuation (prompt =
     base + oracle(base)), the n-gram drafts match greedy and get ACCEPTED —
