@@ -84,6 +84,23 @@ class _Pending:
     # streaming: every committed token is also pushed here, then a final
     # (None, result) sentinel (generate_stream consumes it)
     stream: "queue.Queue" = None
+    # set by Engine.cancel(); the loop finishes the request at its next tick
+    cancelled: bool = False
+
+
+class _StreamHandle:
+    """Iterator over streamed tokens + the request's ``future`` (the handle
+    ``Engine.cancel`` takes when a streaming client disconnects)."""
+
+    def __init__(self, it, future: Future):
+        self._it = it
+        self.future = future
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._it)
 
 
 class Engine:
@@ -223,19 +240,52 @@ class Engine:
     def generate(self, tokens: list[int], max_new_tokens: int = 32, timeout: float = 300.0) -> dict:
         return self.generate_async(tokens, max_new_tokens).result(timeout=timeout)
 
+    def cancel(self, future: Future) -> bool:
+        """Cancel the request behind a generate_async future (client went
+        away). A request still waiting in the queue resolves IMMEDIATELY
+        (``cancelled: True``, no tokens); one already in a slot is finished
+        by the engine loop at its next tick, keeping whatever tokens were
+        committed, and its slot/pages free right after. Returns False if the
+        request already finished."""
+        with self._lock:
+            hit = None
+            for rid, pending in self._requests.items():
+                if pending.future is future:
+                    hit = (rid, pending)
+                    break
+            if hit is None:
+                return False
+            rid, pending = hit
+            pending.cancelled = True
+            if rid not in self._slot_req.values():
+                # still queued: resolve now — no slot will free it for us.
+                # (the C++ queue entry is reaped at admission: pending gone
+                # -> the slot is released untouched)
+                self._requests.pop(rid)
+                result = {"tokens": [], "num_tokens": 0, "truncated": False,
+                          "cancelled": True, "ttft_s": 0.0,
+                          "latency_s": time.perf_counter() - pending.submitted_at}
+                pending.future.set_result(result)
+                if pending.stream is not None:
+                    pending.stream.put((None, result))
+                return True
+        self._wake.set()
+        return True
+
     def generate_stream(self, tokens: list[int], max_new_tokens: int = 32,
                         timeout: float = 300.0) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
 
         The last item yielded is the same dict ``generate`` returns (so
         callers get ttft/latency/truncated without a second call).  The
-        prompt is submitted NOW (plain method returning a generator), so the
-        request runs even if the caller delays iteration; an abandoned
-        iterator costs at most max_new_tokens queued ints.  ``timeout``
+        prompt is submitted NOW (plain method returning an iterator), so the
+        request runs even if the caller delays iteration.  ``timeout``
         bounds the wait for EACH next token (a stall), not the whole
-        generation — a healthy long run streams for as long as it needs."""
+        generation — a healthy long run streams for as long as it needs.
+        The returned iterator exposes ``.future`` so a disconnected client
+        can be reaped via ``Engine.cancel(stream.future)``."""
         q: queue.Queue = queue.Queue()
-        self.generate_async(tokens, max_new_tokens, stream=q)
+        fut = self.generate_async(tokens, max_new_tokens, stream=q)
 
         def _iter():
             while True:
@@ -249,7 +299,7 @@ class Engine:
                     return
                 yield item
 
-        return _iter()
+        return _StreamHandle(_iter(), fut)
 
     @property
     def stats(self) -> dict:
@@ -355,12 +405,21 @@ class Engine:
                     break
                 did_work = True
                 slot, rid, plen, _, cached = admitted
+                # fetch + slot assignment are one atomic step vs cancel():
+                # once _slot_req holds rid, cancel defers to this loop; a
+                # queued cancel that popped the request first lands in the
+                # pending-None branch
                 with self._lock:
                     pending = self._requests.get(rid)
-                if pending is None:  # cancelled
+                    if pending is not None:
+                        self._slot_req[slot] = rid
+                if pending is None:
                     self.batcher.release(slot)
                     continue
-                self._slot_req[slot] = rid
+                if pending.cancelled:  # cancelled between submit and admit
+                    self._finish(slot, rid, truncated=False,
+                                 cancelled=True, cache_ok=False)
+                    continue
                 # cache-hit pages already hold the prefix KV: prefill resumes
                 # at the first uncovered position
                 self._prefilling[slot] = cached * self.ec.page_size
@@ -368,6 +427,13 @@ class Engine:
             # --- one prefill chunk per prefilling slot
             for slot in list(self._prefilling):
                 did_work = True
+                if self._requests[self._slot_req[slot]].cancelled:
+                    # mid-prefill cancel: pool pages are partially written —
+                    # free them WITHOUT caching
+                    del self._prefilling[slot]
+                    self._finish(slot, self._slot_req[slot], truncated=False,
+                                 cancelled=True, cache_ok=False)
+                    continue
                 self._prefill_tick(slot)
 
             # --- one decode step over slots whose prefill is complete
@@ -376,6 +442,13 @@ class Engine:
                 s for s in range(self.ec.max_slots)
                 if active[s] and s in self._slot_req and s not in self._prefilling
             ]
+            for slot in list(decode_ready):
+                if self._requests[self._slot_req[slot]].cancelled:
+                    did_work = True
+                    decode_ready.remove(slot)
+                    # prompt KV is complete: its pages are safe to cache
+                    self._finish(slot, self._slot_req[slot], truncated=False,
+                                 cancelled=True)
             if decode_ready:
                 did_work = True
                 seq_lens = np.array(self.batcher.seq_lens(), np.int32)
@@ -496,17 +569,23 @@ class Engine:
         self._finish(slot, rid, truncated=(rc == -2))
         return rc
 
-    def _finish(self, slot: int, rid: int, truncated: bool) -> None:
-        pending = self._requests.pop(rid)
-        self._slot_req.pop(slot, None)
-        # hand the prompt's full pages to the prefix cache on the way out
-        self.batcher.release(slot, pending.page_hashes)
+    def _finish(self, slot: int, rid: int, truncated: bool,
+                cancelled: bool = False, cache_ok: bool = True) -> None:
+        with self._lock:  # cancel() iterates _requests under this lock
+            pending = self._requests.pop(rid)
+            self._slot_req.pop(slot, None)
+        # hand the prompt's full pages to the prefix cache on the way out —
+        # unless the prefill never finished (cancel mid-prefill): those pages
+        # hold garbage and must not be served to other requests
+        self.batcher.release(slot, pending.page_hashes if cache_ok else None)
         now = time.perf_counter()
         result = {
             "tokens": pending.generated,
             "num_tokens": len(pending.generated),
             "truncated": truncated,
-            "ttft_s": pending.first_token_at - pending.submitted_at,
+            "cancelled": cancelled,
+            "ttft_s": (pending.first_token_at - pending.submitted_at
+                       if pending.first_token_at else 0.0),
             "latency_s": now - pending.submitted_at,
         }
         pending.future.set_result(result)

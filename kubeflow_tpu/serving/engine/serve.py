@@ -119,23 +119,30 @@ class JetStreamModel(Model):
         ids, max_tokens = self._parse_generate(payload)
         out_ids: list[int] = []
         emitted = 0
-        for item in self.engine.generate_stream(ids, max_tokens):
-            if isinstance(item, dict):
+        stream = self.engine.generate_stream(ids, max_tokens)
+        try:
+            for item in stream:
+                if isinstance(item, dict):
+                    full = self.tokenizer.decode(out_ids)
+                    if len(full) > emitted:  # flush held-back tail
+                        yield {"text_output": full[emitted:]}
+                    yield {"text_output": "", "done": True, "tokens": item["num_tokens"],
+                           "ttft_s": round(item["ttft_s"], 4),
+                           "latency_s": round(item["latency_s"], 4)}
+                    return
+                out_ids.append(item)
                 full = self.tokenizer.decode(out_ids)
-                if len(full) > emitted:  # flush held-back tail
-                    yield {"text_output": full[emitted:]}
-                yield {"text_output": "", "done": True, "tokens": item["num_tokens"],
-                       "ttft_s": round(item["ttft_s"], 4),
-                       "latency_s": round(item["latency_s"], 4)}
-                return
-            out_ids.append(item)
-            full = self.tokenizer.decode(out_ids)
-            stable = len(full)
-            while stable > emitted and full[stable - 1] == "�" and len(full) - stable < 3:
-                stable -= 1  # ≤3 trailing bytes may be an incomplete UTF-8 seq
-            if stable > emitted:
-                yield {"text_output": full[emitted:stable]}
-                emitted = stable
+                stable = len(full)
+                while stable > emitted and full[stable - 1] == "�" and len(full) - stable < 3:
+                    stable -= 1  # ≤3 trailing bytes may be an incomplete UTF-8 seq
+                if stable > emitted:
+                    yield {"text_output": full[emitted:stable]}
+                    emitted = stable
+        finally:
+            # disconnected client (GeneratorExit) or any early close: free the
+            # slot instead of generating to the token budget for nobody —
+            # a no-op when the request already finished
+            self.engine.cancel(stream.future)
 
     def predict(self, payload: Any, headers: Optional[dict] = None) -> Any:
         instances = payload.get("instances", []) if isinstance(payload, dict) else payload

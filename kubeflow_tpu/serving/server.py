@@ -249,8 +249,9 @@ class ModelServer:
         # them reach _handle_post's catch-all would write a second HTTP
         # response into the SSE body (and a client disconnect would raise
         # again from that very write)
+        gen = verb(body, headers)
         try:
-            for event in verb(body, headers):
+            for event in gen:
                 h.wfile.write(b"data: " + json.dumps(event).encode() + b"\n\n")
                 h.wfile.flush()
         except OSError:
@@ -261,6 +262,9 @@ class ModelServer:
                     {"error": f"{type(e).__name__}: {e}", "done": True}).encode() + b"\n\n")
             except OSError:
                 pass
+        finally:
+            if hasattr(gen, "close"):
+                gen.close()  # deterministic GeneratorExit → engine cancel
 
     def _v2(self, h, name: str) -> None:
         m = self.models.get(name)
