@@ -93,7 +93,9 @@ def test_resnet_ddp_worker_runs_multiprocess(tmp_path):
         )
         client = TrainingClient(c)
         client.create_job(spec)
-        assert client.wait_for_job("PyTorchJob", "resnet-ddp", timeout=300) == tapi.SUCCEEDED
+        # 174s alone on this 1-CPU box; the full-suite run time-slices 2 jax
+        # procs against other tests, so give it real headroom
+        assert client.wait_for_job("PyTorchJob", "resnet-ddp", timeout=600) == tapi.SUCCEEDED
         logs = "\n".join(client.get_job_logs("PyTorchJob", "resnet-ddp").values())
         assert "RESNET-DDP-OK" in logs
         assert "world size=2 global devices=2" in logs

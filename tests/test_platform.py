@@ -144,6 +144,12 @@ def test_dashboard_aggregates(platform):
     acts = dash.activity("dash-ns")
     assert isinstance(acts, list)
 
+    # the notebook pod must be culled first (r2: settle() stopped burning its
+    # 30s timeout, so the 0.6s idle culler no longer races ahead of us here —
+    # an un-culled notebook pod would add its own requests to the quota)
+    assert cluster.wait_for(
+        lambda: cluster.api.list("Pod", namespace="dash-ns") == [], timeout=30)
+
     # quota widget: a live (Pending counts, k8s semantics) pod with k8s
     # quantity strings and a limits-only TPU request must all parse
     cluster.api.create({
