@@ -48,24 +48,23 @@ class EngineConfig:
     # interleave with a long prefill instead of stalling behind it
     prefill_chunk: int = 256
     # Pallas paged-attention decode path (paged_attention.py); None defers
-    # to the ENGINE_PAGED_KERNEL env var. Off by default until re-validated
-    # on real hardware (the TPU tunnel was down for all of round 2).
+    # to the ENGINE_PAGED_KERNEL env var. Composes with kv_quant (in-kernel
+    # dequant), tensor_parallel (shard_map over the tensor mesh) and
+    # speculative (multi-query verify kernel). Off by default until
+    # re-validated on real hardware (the TPU tunnel was down for round 2).
     paged_kernel: Optional[bool] = None
     # tensor-parallel degree (sharding.py): >1 places params + KV pool over a
-    # 1-D GSPMD mesh so Llama-8B-class models span a slice. Uses the XLA
-    # gather attention path (the Pallas kernel is single-device).
+    # 1-D GSPMD mesh so Llama-8B-class models span a slice.
     tensor_parallel: int = 1
     # KV-cache quantization: "int8" stores pool entries as int8 + per-token
     # scales (~52% of the bf16 bytes — near-double servable context); None
-    # defers to the ENGINE_KV_QUANT env var. Exclusive with paged_kernel
-    # (the Pallas kernel reads the raw bf16 pool).
+    # defers to the ENGINE_KV_QUANT env var.
     kv_quant: Optional[str] = None
     # speculative decoding: "prompt_lookup" drafts the continuation of the
     # last n-gram's previous occurrence in the context and verifies up to
     # spec_max_draft tokens in ONE decode pass (lossless under greedy —
     # accepted tokens are exactly what argmax would have produced). None
-    # defers to ENGINE_SPECULATIVE. Requires temperature 0; exclusive with
-    # paged_kernel (the verify step uses the gather path).
+    # defers to ENGINE_SPECULATIVE. Requires temperature 0.
     speculative: Optional[str] = None
     spec_max_draft: int = 4
     spec_ngram: int = 2
@@ -124,29 +123,22 @@ class Engine:
                        else os.environ.get("ENGINE_PAGED_KERNEL") == "1")
         self._kv_quant = (engine_config.kv_quant if engine_config.kv_quant is not None
                           else os.environ.get("ENGINE_KV_QUANT") or None)
-        if self._paged and self._kv_quant:
-            raise ValueError("paged_kernel and kv_quant are exclusive "
-                             "(the Pallas kernel reads the raw bf16 pool)")
         self._spec = (engine_config.speculative if engine_config.speculative is not None
                       else os.environ.get("ENGINE_SPECULATIVE") or None)
         if self._spec is not None and self._spec != "prompt_lookup":
             raise ValueError(f"unsupported speculative mode {self._spec!r}")
-        if self._spec and self._paged:
-            raise ValueError("speculative and paged_kernel are exclusive "
-                             "(the verify step uses the gather path)")
         if self._spec and engine_config.temperature > 0:
             raise ValueError("speculative decoding requires temperature 0 "
                              "(greedy acceptance is what makes it lossless)")
         from .model import make_kv_pool
 
+        self._mesh = None
         if engine_config.tensor_parallel > 1:
             from .sharding import alloc_pool, shard_params, tensor_mesh, validate_config
 
-            if self._paged:  # check the RESOLVED flag: the env gate counts too
-                raise ValueError("paged_kernel and tensor_parallel are exclusive "
-                                 "(the Pallas kernel is single-device)")
             mesh = tensor_mesh(engine_config.tensor_parallel)
             validate_config(c, mesh)
+            self._mesh = mesh
             # pools are allocated sharded-direct and params stream per-leaf to
             # their shards (pass host/numpy arrays for models that don't fit
             # one chip — that's the whole point of TP serving)
@@ -479,7 +471,7 @@ class Engine:
         logits, self.k_pool, self.v_pool = decode_step(
             self.params, self.config, jnp.asarray(tokens),
             jnp.asarray(seq_lens), jnp.asarray(page_table),
-            self.k_pool, self.v_pool, paged=self._paged,
+            self.k_pool, self.v_pool, paged=self._paged, mesh=self._mesh,
         )
         sampled = np.asarray(
             sample_tokens(logits, self._next_key(), self.ec.temperature))
@@ -529,7 +521,7 @@ class Engine:
         logits, self.k_pool, self.v_pool = decode_step_k(
             self.params, self.config, jnp.asarray(tokens),
             jnp.asarray(seq_lens), jnp.asarray(page_table),
-            self.k_pool, self.v_pool,
+            self.k_pool, self.v_pool, paged=self._paged, mesh=self._mesh,
         )
         B, _, V = logits.shape
         sampled = np.asarray(sample_tokens(

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .paged_attention import paged_decode_attention
+from .paged_attention import paged_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -216,6 +216,14 @@ def pool_get(pool, idx):
     return pool[idx]
 
 
+def pool_layer(pool, l):
+    """One layer's slice of a pool, preserving the quantized pytree shape
+    (the form paged_attention consumes)."""
+    if isinstance(pool, dict):
+        return {"q": pool["q"][l], "s": pool["s"][l]}
+    return pool[l]
+
+
 # ------------------------------------------------------------------- prefill
 
 
@@ -325,10 +333,10 @@ def sample_tokens(logits, key, temperature: float = 0.0):
 # -------------------------------------------------------------------- decode
 
 
-@functools.partial(jax.jit, static_argnames=("config", "paged"),
+@functools.partial(jax.jit, static_argnames=("config", "paged", "mesh"),
                    donate_argnames=("k_pool", "v_pool"))
 def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
-                k_pool, v_pool, paged: bool = False):
+                k_pool, v_pool, paged: bool = False, mesh=None):
     """One decode step for ALL slots.
 
     tokens: [B] int32 current token per slot; seq_lens: [B] int32 length
@@ -343,11 +351,10 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
 
     ``paged=True`` runs attention as the Pallas paged kernel directly over
     the pool (paged_attention.py) instead of gathering each slot's pages
-    into a contiguous cache first — removing the per-step KV copy.
+    into a contiguous cache first — removing the per-step KV copy.  The
+    kernel reads int8 pools natively and runs per-shard under ``mesh``
+    (the engine's tensor mesh), so paged composes with kv_quant and TP.
     """
-    if paged and isinstance(k_pool, dict):
-        raise ValueError("paged=True requires a raw bf16 pool: the Pallas "
-                         "kernel does not read quantized {'q','s'} pools")
     c = config
     B = tokens.shape[0]
     page_size = pool_page_size(k_pool)
@@ -371,11 +378,9 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
         k_pool = pool_set(k_pool, (l, page_id, offset), k_new[:, 0])
         v_pool = pool_set(v_pool, (l, page_id, offset), v_new[:, 0])
         if paged:
-            # the Pallas kernel reads the raw bf16 pool (engine forbids
-            # combining paged=True with kv quantization)
-            kl, vl = k_pool[l], v_pool[l]
-            attend = lambda q: paged_decode_attention(  # noqa: E731
-                q[:, 0], kl, vl, page_table, seq_lens, page_size)[:, None]
+            kl, vl = pool_layer(k_pool, l), pool_layer(v_pool, l)
+            attend = lambda q: paged_attention(  # noqa: E731
+                q, kl, vl, page_table, seq_lens, page_size, mesh=mesh)
             x = _block_with(params, l, c, x, positions, attend)
         else:
             # gather each slot's pages -> [B, T, Hkv, hd]
@@ -387,10 +392,10 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
     return logits, k_pool, v_pool
 
 
-@functools.partial(jax.jit, static_argnames=("config",),
+@functools.partial(jax.jit, static_argnames=("config", "paged", "mesh"),
                    donate_argnames=("k_pool", "v_pool"))
 def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
-                  k_pool, v_pool):
+                  k_pool, v_pool, paged: bool = False, mesh=None):
     """Speculative verify step: process 1 committed + (K-1) draft tokens per
     slot in ONE pass.
 
@@ -409,6 +414,11 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
 
     Inactive slots (seq_len==0) clamp to position 0 and produce garbage
     logits the caller ignores — static shapes beat recompiles.
+
+    ``paged=True`` verifies through the Pallas kernel (paged_attention.py):
+    each query row's causal horizon is offset by its draft index in-kernel,
+    so speculative decoding composes with paged attention (and, via the
+    kernel's int8/shard_map support, with kv_quant and TP).
     """
     c = config
     B, K = tokens.shape
@@ -440,9 +450,15 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
         k_new, v_new = _kv_proj(params, l, c, h, positions)  # [B,K,Hkv,hd]
         k_pool = pool_set(k_pool, (l, page_ids, offsets), k_new)
         v_pool = pool_set(v_pool, (l, page_ids, offsets), v_new)
-        k_cache = pool_get(k_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
-        v_cache = pool_get(v_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
-        x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
+        if paged:
+            kl, vl = pool_layer(k_pool, l), pool_layer(v_pool, l)
+            attend = lambda q: paged_attention(  # noqa: E731
+                q, kl, vl, page_table, seq_lens, page_size, mesh=mesh)
+            x = _block_with(params, l, c, x, positions, attend)
+        else:
+            k_cache = pool_get(k_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
+            v_cache = pool_get(v_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
+            x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     logits = (x @ params["unembed"]).astype(jnp.float32)
     return logits, k_pool, v_pool

@@ -13,11 +13,20 @@ Design (pallas_guide.md):
   * grid = (slots, kv_heads, max_pages); the last axis is sequential on TPU,
     so the online-softmax accumulator lives in VMEM scratch across page
     steps and the output is written on the final page;
-  * GQA: the q block per (slot, kv head) is the [group, hd] bundle of query
-    heads sharing that KV head;
-  * pages past the slot's length are masked per-position and skipped as
+  * GQA: the q block per (slot, kv head) is the [K*group, hd] bundle of the
+    query heads sharing that KV head — K > 1 is the speculative-verify case
+    (1 committed + K-1 draft tokens in one pass), with each query row's
+    causal horizon offset by its draft index;
+  * pages past every query's horizon are masked per-position and skipped as
     whole blocks via ``pl.when`` (no FLOPs for dead pages — the paged
     analogue of flash attention's causal block skip);
+  * int8 KV pools ({"q": int8, "s": bf16 scales} — model.py) dequantize
+    inside the kernel: the pool stays int8 in HBM, so the bandwidth win of
+    quantization COMPOSES with the no-gather win of paging;
+  * tensor parallelism wraps the same kernel in ``shard_map`` over the
+    engine's 1-D ``tensor`` mesh (sharding.py): attention is per-KV-head
+    independent, so each chip runs the kernel on its own heads' pages with
+    zero collectives;
   * ``interpret=`` auto-selects: compiled on TPU, interpreter on the CPU
     test mesh, same numerics either way.
 
@@ -32,6 +41,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -43,9 +53,12 @@ def _auto_interpret() -> bool:
 
 
 def _kernel(page_table_ref, seq_lens_ref,  # scalar-prefetch (SMEM)
-            q_ref, k_ref, v_ref, o_ref,    # blocks
-            acc_ref, m_ref, l_ref,         # VMEM scratch
-            *, page_size, scale):
+            *refs, page_size, scale, group, num_q, quantized):
+    """refs: q, k, v, [k_scale, v_scale,] o, acc, m, l."""
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
     j = pl.program_id(2)
     num_pages = pl.num_programs(2)
@@ -57,17 +70,23 @@ def _kernel(page_table_ref, seq_lens_ref,  # scalar-prefetch (SMEM)
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # whole pages past the sequence contribute nothing: skip their FLOPs
-    @pl.when(j * page_size < seq_len)
+    # pages past EVERY query's horizon contribute nothing: skip their FLOPs.
+    # Query row r (of K*group) has draft index r//group and sees positions
+    # < seq_len + r//group, so the furthest horizon is seq_len + num_q - 1.
+    @pl.when(j * page_size < seq_len + (num_q - 1))
     def _page():
-        q = q_ref[0, 0].astype(jnp.float32) * scale          # [group, hd]
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # [K*group, hd]
         k = k_ref[0, :, 0, :].astype(jnp.float32)            # [ps, hd]
         v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, :, 0, :].astype(jnp.float32)   # [ps, 1] bcast
+            v = v * vs_ref[0, :, 0, :].astype(jnp.float32)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )                                                    # [group, ps]
+        )                                                    # [K*group, ps]
         pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-        logits = jnp.where(pos < seq_len, logits, NEG_INF)
+        qi = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0) // group
+        logits = jnp.where(pos < seq_len + qi, logits, NEG_INF)
         m_new = jnp.maximum(m_ref[...], logits.max(axis=1, keepdims=True))
         p = jnp.exp(logits - m_new)
         corr = jnp.exp(m_ref[...] - m_new)
@@ -83,46 +102,108 @@ def _kernel(page_table_ref, seq_lens_ref,  # scalar-prefetch (SMEM)
         o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
+def _call_kernel(q, k_pool, v_pool, page_table, seq_lens,
+                 page_size: int, interpret: bool):
+    """Single-device kernel invocation.  q: [B, K, Hq, hd]; pools: one
+    layer's pool, bf16 [P, ps, Hkv, hd] or {"q": int8, "s": bf16 scales};
+    returns [B, K, Hq, hd]."""
+    B, K, Hq, hd = q.shape
+    quantized = isinstance(k_pool, dict)
+    Hkv = (k_pool["q"] if quantized else k_pool).shape[2]
+    group = Hq // Hkv
+    max_pages = page_table.shape[1]
+    scale = hd ** -0.5
+    # [B, K, Hq, hd] -> [B, Hkv, K*group, hd]: rows ordered draft-major so
+    # row r is (draft r//group, group member r%group) of kv head h
+    qg = (q.reshape(B, K, Hkv, group, hd)
+           .transpose(0, 2, 1, 3, 4)
+           .reshape(B, Hkv, K * group, hd))
+
+    grid = (B, Hkv, max_pages)
+    rows = K * group
+    kv_specs = [
+        pl.BlockSpec((1, page_size, 1, hd), lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0)),
+        pl.BlockSpec((1, page_size, 1, hd), lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0)),
+    ]
+    inputs = [qg]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, page_size, 1, 1),
+                                  lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0))
+        in_specs = ([pl.BlockSpec((1, 1, rows, hd), lambda b, h, j, pt, sl: (b, h, 0, 0))]
+                    + kv_specs + [scale_spec, scale_spec])
+        inputs += [k_pool["q"], v_pool["q"], k_pool["s"], v_pool["s"]]
+    else:
+        in_specs = ([pl.BlockSpec((1, 1, rows, hd), lambda b, h, j, pt, sl: (b, h, 0, 0))]
+                    + kv_specs)
+        inputs += [k_pool, v_pool]
+    out = pl.pallas_call(
+        functools.partial(_kernel, page_size=page_size, scale=scale,
+                          group=group, num_q=K, quantized=quantized),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # page_table, seq_lens
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, rows, hd), lambda b, h, j, pt, sl: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, hd), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rows, hd), q.dtype),
+        interpret=interpret,
+    )(page_table, seq_lens, *inputs)
+    return (out.reshape(B, Hkv, K, group, hd)
+               .transpose(0, 2, 1, 3, 4)
+               .reshape(B, K, Hq, hd))
+
+
+# head-axis specs for the shard_map TP wrapper: attention is independent per
+# KV head, so q/pools/out shard on their head axes and nothing communicates
+_Q_SPEC = P(None, None, "tensor", None)
+_POOL_SPEC = P(None, None, "tensor", None)
+
+
+def paged_attention(q, k_pool, v_pool, page_table, seq_lens, page_size: int,
+                    mesh: Mesh | None = None, interpret: bool | None = None):
+    """Attention for K query tokens per slot directly over the page pool.
+
+    q: [B, K, Hq, hd] — query K=0 is the slot's current committed token and
+    rows 1..K-1 are draft tokens at the following positions (speculative
+    verify); K=1 is the plain decode step.  seq_lens: [B] int32 counting
+    committed tokens INCLUDING query 0's position (query row r sees
+    positions < seq_lens + r).  k_pool/v_pool: ONE layer's pool —
+    [P, page_size, Hkv, hd] bf16 or the int8 {"q", "s"} pytree (model.py).
+    page_table: [B, max_pages] int32.  ``mesh``: a 1-D ``tensor`` mesh runs
+    the kernel per-shard via shard_map (heads independent, no collectives).
+    Returns [B, K, Hq, hd].
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    call = functools.partial(_call_kernel, page_size=page_size,
+                             interpret=interpret)
+    if mesh is None:
+        return call(q, k_pool, v_pool, page_table, seq_lens)
+    pool_spec = ({"q": _POOL_SPEC, "s": _POOL_SPEC}
+                 if isinstance(k_pool, dict) else _POOL_SPEC)
+    shard = jax.shard_map(
+        call, mesh=mesh,
+        in_specs=(_Q_SPEC, pool_spec, pool_spec, P(), P()),
+        out_specs=_Q_SPEC,
+        check_vma=False,
+    )
+    return shard(q, k_pool, v_pool, page_table, seq_lens)
+
+
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
 def paged_decode_attention(q, k_pool, v_pool, page_table, seq_lens,
                            page_size: int, interpret: bool | None = None):
-    """One decode step of attention directly over the page pool.
+    """One decode step of attention over the page pool (K=1 wrapper).
 
     q: [B, Hq, hd] (current token per slot); k_pool/v_pool:
     [P, page_size, Hkv, hd] (ONE layer's pool); page_table: [B, max_pages]
     int32; seq_lens: [B] int32 (0 = inactive slot → zeros out).
     Returns [B, Hq, hd].
     """
-    if interpret is None:
-        interpret = _auto_interpret()
-    B, Hq, hd = q.shape
-    Hkv = k_pool.shape[2]
-    group = Hq // Hkv
-    max_pages = page_table.shape[1]
-    scale = hd ** -0.5
-    # [B, Hq, hd] -> [B, Hkv, group, hd]: queries grouped by their KV head
-    qg = q.reshape(B, Hkv, group, hd)
-
-    grid = (B, Hkv, max_pages)
-    out = pl.pallas_call(
-        functools.partial(_kernel, page_size=page_size, scale=scale),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,  # page_table, seq_lens
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, group, hd), lambda b, h, j, pt, sl: (b, h, 0, 0)),
-                # the data-dependent page lookup: block = pool page pt[b, j]
-                pl.BlockSpec((1, page_size, 1, hd), lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0)),
-                pl.BlockSpec((1, page_size, 1, hd), lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, 1, group, hd), lambda b, h, j, pt, sl: (b, h, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((group, hd), jnp.float32),
-                pltpu.VMEM((group, 1), jnp.float32),
-                pltpu.VMEM((group, 1), jnp.float32),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, group, hd), q.dtype),
-        interpret=interpret,
-    )(page_table, seq_lens, qg, k_pool, v_pool)
-    return out.reshape(B, Hq, hd)
+    return paged_attention(q[:, None], k_pool, v_pool, page_table, seq_lens,
+                           page_size, interpret=interpret)[:, 0]

@@ -530,11 +530,196 @@ def test_engine_int8_kv_quant_generates_near_greedy(params):
         eng.stop()
 
 
-def test_engine_kv_quant_paged_kernel_exclusive(params):
-    with pytest.raises(ValueError, match="exclusive"):
-        Engine(params, CFG, EngineConfig(max_slots=2, num_pages=32, page_size=8,
-                                         max_pages_per_slot=8, kv_quant="int8",
-                                         paged_kernel=True))
+# ------------------------------------------------- feature composition
+#
+# VERDICT r2 #3: the four headline engine features must COMPOSE — a
+# production JetStream-class config runs paged attention + TP + int8 KV +
+# prefix cache (+ speculative) simultaneously.  The kernel-level tests run
+# in interpret mode (cheap, exact); the E2E combos drive the full engine.
+
+
+def test_paged_kernel_multi_query_matches_reference():
+    """The K-query kernel (speculative verify): each query row's causal
+    horizon is offset by its draft index — compare against a dense masked
+    softmax per (slot, query, head)."""
+    from kubeflow_tpu.serving.engine.paged_attention import paged_attention
+
+    rng = np.random.default_rng(2)
+    B, K, Hq, Hkv, hd, ps, P, max_pages = 2, 3, 4, 2, 16, 8, 12, 3
+    q = jnp.asarray(rng.standard_normal((B, K, Hq, hd)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((P, ps, Hkv, hd)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((P, ps, Hkv, hd)), jnp.float32)
+    page_table = jnp.asarray([[3, 5, 7], [1, 2, 0]], jnp.int32)
+    seq_lens = jnp.asarray([18, 6], jnp.int32)  # draft rows extend past these
+
+    out = np.asarray(paged_attention(q, k_pool, v_pool, page_table,
+                                     seq_lens, ps, interpret=True))
+    group = Hq // Hkv
+    T = max_pages * ps
+    for b in range(B):
+        kc = np.asarray(k_pool)[np.asarray(page_table)[b]].reshape(T, Hkv, hd)
+        vc = np.asarray(v_pool)[np.asarray(page_table)[b]].reshape(T, Hkv, hd)
+        for j in range(K):
+            horizon = int(seq_lens[b]) + j  # row j sees positions < len+j
+            m = np.arange(T) < horizon
+            for h in range(Hq):
+                kv_h = h // group
+                logits = np.asarray(q)[b, j, h] @ kc[:, kv_h].T / np.sqrt(hd)
+                e = np.exp(logits[m] - logits[m].max())
+                ref = (e / e.sum()) @ vc[m, kv_h]
+                np.testing.assert_allclose(out[b, j, h], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_paged_kernel_int8_pool_matches_dequant_reference():
+    """The kernel dequantizes {'q','s'} pools in place: result must equal the
+    same computation over the host-dequantized pool."""
+    from kubeflow_tpu.serving.engine.paged_attention import paged_decode_attention
+
+    rng = np.random.default_rng(3)
+    B, Hq, Hkv, hd, ps, P = 2, 4, 2, 16, 8, 10
+    q = jnp.asarray(rng.standard_normal((B, Hq, hd)), jnp.float32)
+    kq = jnp.asarray(rng.integers(-127, 128, (P, ps, Hkv, hd)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (P, ps, Hkv, hd)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.01, 0.1, (P, ps, Hkv, 1)), jnp.bfloat16)
+    vs = jnp.asarray(rng.uniform(0.01, 0.1, (P, ps, Hkv, 1)), jnp.bfloat16)
+    page_table = jnp.asarray([[3, 5], [1, 0]], jnp.int32)
+    seq_lens = jnp.asarray([13, 8], jnp.int32)
+
+    out = np.asarray(paged_decode_attention(
+        q, {"q": kq, "s": ks}, {"q": vq, "s": vs}, page_table, seq_lens, ps,
+        interpret=True))
+    k_deq = (kq.astype(jnp.float32) * ks.astype(jnp.float32))
+    v_deq = (vq.astype(jnp.float32) * vs.astype(jnp.float32))
+    ref = np.asarray(paged_decode_attention(
+        q, k_deq, v_deq, page_table, seq_lens, ps, interpret=True))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_decode_step_paged_int8_matches_gather_int8(params):
+    """decode_step(paged=True) over a quantized pool == the XLA gather path
+    over the SAME quantized pool (both dequantize identically)."""
+    page_size = 8
+    shape = (CFG.n_layers, 16, page_size, CFG.n_kv_heads, CFG.head_dim)
+    toks8 = np.array([[5, 7, 9, 11, 2, 4, 6, 8]], np.int32)
+    pools = []
+    for _ in range(2):  # two identical quantized pools (decode_step donates)
+        k_pool = M.make_kv_pool(shape, "int8")
+        v_pool = M.make_kv_pool(shape, "int8")
+        _, pk, pv = M.prefill(params, CFG, jnp.asarray(toks8), jnp.int32(8), page_size)
+        k_pool, v_pool = M.write_pages(k_pool, v_pool, pk, pv, jnp.asarray([3], jnp.int32))
+        pools.append((k_pool, v_pool))
+    pt = jnp.asarray([[3, 0, 0, 0], [0, 0, 0, 0]], jnp.int32)
+    lens = jnp.asarray([8, 0], jnp.int32)
+    tok = jnp.asarray([10, 0], jnp.int32)
+    lg, _, _ = M.decode_step(params, CFG, tok, lens, pt, *pools[0])
+    lp, _, _ = M.decode_step(params, CFG, tok, lens, pt, *pools[1], paged=True)
+    np.testing.assert_allclose(np.asarray(lg)[0], np.asarray(lp)[0], rtol=2e-2, atol=2e-2)
+
+
+def test_decode_step_k_paged_matches_gather(params):
+    """Speculative verify through the Pallas kernel == the gather path on
+    identical pool state (bf16)."""
+    page_size = 8
+    shape = (CFG.n_layers, 16, page_size, CFG.n_kv_heads, CFG.head_dim)
+    rng = np.random.default_rng(4)
+    k0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    v0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    k1, v1 = jnp.array(k0), jnp.array(v0)
+    pt = jnp.asarray([[3, 5, 0, 0], [7, 0, 0, 0]], jnp.int32)
+    lens = jnp.asarray([11, 4], jnp.int32)
+    toks = jnp.asarray([[42, 17, 9], [7, 3, 0]], jnp.int32)
+    lg, _, _ = M.decode_step_k(params, CFG, toks, lens, pt, k0, v0)
+    lp, _, _ = M.decode_step_k(params, CFG, toks, lens, pt, k1, v1, paged=True)
+    # the gather path multiplies softmax probs in bf16 (_attn casts); the
+    # kernel keeps the f32 accumulator — tolerance covers that gap
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(lp), rtol=4e-2, atol=4e-2)
+
+
+@pytest.mark.slow
+def test_engine_paged_with_int8_kv_matches_near_greedy(params):
+    """E2E paged kernel × int8 KV: generated tokens within the int8 logit
+    margin of the full-precision oracle (same tolerance as the int8 test)."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        prefill_chunk=16, kv_quant="int8", paged_kernel=True,
+    ))
+    assert isinstance(eng.k_pool, dict)
+    eng.start()
+    try:
+        for prompt in ([5, 7, 9, 11], [(i * 5) % (CFG.vocab_size - 1) + 1 for i in range(20)]):
+            out = eng.generate(prompt, 4, timeout=180)
+            toks = list(prompt)
+            for tok in out["tokens"]:
+                logits = np.asarray(M.forward_full(params, CFG, jnp.asarray([toks], jnp.int32)))[0, -1]
+                assert logits.max() - logits[tok] <= 0.35, (toks, tok)
+                toks.append(tok)
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_engine_paged_with_tensor_parallel_matches_oracle(params):
+    """E2E paged kernel × TP=2: the kernel runs per-shard under shard_map
+    (heads independent); generations equal the single-device greedy oracle."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        tensor_parallel=2, paged_kernel=True, prefill_chunk=32,
+    ))
+    eng.start()
+    try:
+        prompts = [[5, 7, 9, 11], [(i * 7) % 97 + 1 for i in range(20)]]
+        futs = [eng.generate_async(p, 5) for p in prompts]
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=180)["tokens"] == greedy_oracle(params, p, 5), p
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_engine_speculative_with_paged_kernel_lossless(params):
+    """E2E speculative × paged kernel: the multi-query verify runs through
+    the Pallas kernel and stays lossless vs the greedy oracle, with drafts
+    actually accepted."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        paged_kernel=True, speculative="prompt_lookup", spec_max_draft=4,
+    ))
+    eng.start()
+    try:
+        # repetitive prompt → the n-gram draft fires and accepts
+        prompt = [3, 4, 5, 3, 4, 5, 3, 4]
+        out = eng.generate(prompt, 8, timeout=180)
+        assert out["tokens"] == greedy_oracle(params, prompt, 8)
+        assert eng.stats["spec_proposed"] > 0
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_engine_production_config_paged_tp_int8_prefix_cache(params):
+    """The production JetStream-class config: paged kernel + TP=2 + int8 KV
+    + prefix cache, all at once.  Tokens stay within the int8 margin of the
+    oracle and the second shared-prefix request hits the cache."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        tensor_parallel=2, kv_quant="int8", paged_kernel=True,
+        prefill_chunk=16,
+    ))
+    eng.start()
+    try:
+        prefix = [(i * 5) % (CFG.vocab_size - 1) + 1 for i in range(16)]
+        out1 = eng.generate(prefix + [7], 4, timeout=180)
+        hits0 = eng.stats["page_hits"]
+        out2 = eng.generate(prefix + [9], 4, timeout=180)
+        assert eng.stats["page_hits"] > hits0  # shared prefix adopted
+        for prompt, out in ((prefix + [7], out1), (prefix + [9], out2)):
+            toks = list(prompt)
+            for tok in out["tokens"]:
+                logits = np.asarray(M.forward_full(params, CFG, jnp.asarray([toks], jnp.int32)))[0, -1]
+                assert logits.max() - logits[tok] <= 0.35, (toks, tok)
+                toks.append(tok)
+    finally:
+        eng.stop()
 
 
 # ------------------------------------------------------------- streaming
