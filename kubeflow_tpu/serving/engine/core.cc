@@ -28,6 +28,7 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <unordered_map>
 #include <vector>
@@ -59,6 +60,13 @@ struct CacheEntry {
   uint64_t parent;     // chain hash of the previous page (0 = root)
   int32_t children;    // live cache entries whose parent is this hash
   uint64_t last_use;   // LRU clock
+  // Incremental eviction accounting (replaces the per-admit O(cache) rescan):
+  // blockers = (this page has a non-cache owner ? 1 : 0) + number of DIRECT
+  // children that are themselves blocked.  blockers == 0 iff leaf-first
+  // eviction could eventually reclaim this entry; maintained on every
+  // ref/deref/insert/erase transition by add_blocker/remove_blocker.
+  int32_t blockers;
+  uint64_t filed;      // key this entry holds in Engine::evictable (0 = none)
 };
 
 struct Engine {
@@ -72,6 +80,11 @@ struct Engine {
   std::vector<int32_t> free_pages;  // LIFO free list (refcount 0 pages)
   std::vector<int32_t> refcount;    // per-page owners (slots + pins + cache)
   std::unordered_map<uint64_t, CacheEntry> cache;  // chain hash -> page
+  std::vector<uint64_t> page_hash;  // page id -> cache hash (0 = not cached)
+  // evictable leaves ordered by LRU clock (last_use is unique per touch), so
+  // evict_one is O(log n) and the admit-time reclaimable count is O(1)
+  std::map<uint64_t, uint64_t> evictable;  // last_use -> chain hash
+  int64_t blocked_count = 0;        // cache entries with blockers > 0
   uint64_t clock = 0;
   int64_t cache_hits = 0;       // pages served from cache
   int64_t cache_misses = 0;     // prompt pages that had to be computed
@@ -84,25 +97,81 @@ int32_t pages_needed(const Engine* e, int32_t tokens) {
   return (tokens + e->page_size - 1) / e->page_size;
 }
 
-// Drop the LRU evictable cache entry (a leaf whose page has no owner but the
-// cache itself).  Returns true if a page was freed.
-bool evict_one(Engine* e) {
-  uint64_t best_hash = 0;
-  uint64_t best_age = UINT64_MAX;
-  for (const auto& it : e->cache) {
-    const CacheEntry& ce = it.second;
-    if (ce.children == 0 && e->refcount[ce.page] == 1 && ce.last_use < best_age) {
-      best_age = ce.last_use;
-      best_hash = it.first;
+// Sync one entry's membership in the evictable-leaf LRU index after any
+// mutation of its children/blockers/last_use.
+void update_evictable(Engine* e, uint64_t h, CacheEntry& ce) {
+  bool eligible = ce.children == 0 && ce.blockers == 0;
+  if (eligible) {
+    if (ce.filed != ce.last_use) {
+      if (ce.filed) e->evictable.erase(ce.filed);
+      e->evictable[ce.last_use] = h;
+      ce.filed = ce.last_use;
     }
+  } else if (ce.filed) {
+    e->evictable.erase(ce.filed);
+    ce.filed = 0;
   }
-  if (best_age == UINT64_MAX) return false;
-  CacheEntry ce = e->cache[best_hash];
-  e->cache.erase(best_hash);
+}
+
+// An entry became blocked-from-below (own page gained a non-cache owner, or
+// a direct child flipped to blocked): bump blockers up the chain, stopping
+// at the first ancestor that was already blocked.
+void add_blocker(Engine* e, uint64_t h) {
+  while (h != 0) {
+    auto it = e->cache.find(h);
+    if (it == e->cache.end()) return;
+    CacheEntry& ce = it->second;
+    ce.blockers++;
+    update_evictable(e, h, ce);
+    if (ce.blockers > 1) return;  // already blocked: ancestors already count it
+    e->blocked_count++;
+    h = ce.parent;
+  }
+}
+
+void remove_blocker(Engine* e, uint64_t h) {
+  while (h != 0) {
+    auto it = e->cache.find(h);
+    if (it == e->cache.end()) return;
+    CacheEntry& ce = it->second;
+    ce.blockers--;
+    update_evictable(e, h, ce);
+    if (ce.blockers > 0) return;  // still blocked: ancestors keep counting it
+    e->blocked_count--;
+    h = ce.parent;
+  }
+}
+
+// All refcount transitions of potentially-cached pages go through these two
+// so the blocker accounting can never drift from the refcounts.
+void ref_page(Engine* e, int32_t page) {
+  if (++e->refcount[page] == 2 && e->page_hash[page] != 0)
+    add_blocker(e, e->page_hash[page]);  // first non-cache owner appeared
+}
+
+void deref_page(Engine* e, int32_t page) {
+  if (--e->refcount[page] == 1 && e->page_hash[page] != 0)
+    remove_blocker(e, e->page_hash[page]);  // only the cache's ref remains
+  if (e->refcount[page] == 0) e->free_pages.push_back(page);
+}
+
+// Drop the LRU evictable cache entry (a leaf whose page has no owner but the
+// cache itself).  Returns true if a page was freed.  O(log cache).
+bool evict_one(Engine* e) {
+  if (e->evictable.empty()) return false;
+  auto it = e->evictable.begin();
+  uint64_t h = it->second;
+  CacheEntry ce = e->cache[h];
+  e->evictable.erase(it);
+  e->cache.erase(h);
   if (ce.parent != 0) {
     auto pit = e->cache.find(ce.parent);
-    if (pit != e->cache.end()) pit->second.children--;
+    if (pit != e->cache.end()) {
+      pit->second.children--;
+      update_evictable(e, ce.parent, pit->second);
+    }
   }
+  e->page_hash[ce.page] = 0;
   e->refcount[ce.page] = 0;
   e->free_pages.push_back(ce.page);
   e->cache_evictions++;
@@ -118,15 +187,16 @@ int32_t take_page(Engine* e) {
   return p;
 }
 
-void deref_page(Engine* e, int32_t page) {
-  if (--e->refcount[page] == 0) e->free_pages.push_back(page);
-}
-
 // How many cached pages leaf-first eviction could eventually reclaim: an
 // entry is reclaimable iff neither it nor any descendant has an owner other
-// than the cache.  Lets eng_admit decide BEFORE evicting anything, so a
-// request that cannot fit does not wipe the cache on every failed attempt.
+// than the cache.  O(1) via the incremental blocker accounting; the O(cache)
+// recompute survives as eng_reclaimable_slow for invariant checks.
 int32_t count_reclaimable(Engine* e) {
+  return static_cast<int32_t>(e->cache.size()) -
+         static_cast<int32_t>(e->blocked_count);
+}
+
+int32_t count_reclaimable_slow(Engine* e) {
   std::unordered_map<uint64_t, bool> blocked;
   for (const auto& it : e->cache) {
     if (e->refcount[it.second.page] > 1) {
@@ -169,6 +239,7 @@ Engine* eng_create(int32_t max_slots, int32_t num_pages, int32_t page_size,
   for (int32_t p = num_pages - 1; p >= 1; --p) e->free_pages.push_back(p);
   e->refcount.assign(num_pages, 0);
   e->refcount[0] = 1;  // the trash page is permanently owned
+  e->page_hash.assign(num_pages, 0);
   return e;
 }
 
@@ -212,7 +283,11 @@ int32_t eng_admit(Engine* e, int64_t* out_req_id, int32_t* out_prompt_len,
     auto it = e->cache.find(h);
     if (it == e->cache.end()) break;
     it->second.last_use = ++e->clock;
-    e->refcount[it->second.page]++;
+    // ref_page makes the entry blocked (refcount >= 2), which unfiles it
+    // from the evictable index; the LRU touch lands when the last external
+    // ref drops (deref_page -> remove_blocker -> update_evictable refiles
+    // under the new last_use)
+    ref_page(e, it->second.page);
     pages.push_back(it->second.page);
   }
   int32_t cached = static_cast<int32_t>(pages.size());
@@ -222,7 +297,7 @@ int32_t eng_admit(Engine* e, int64_t* out_req_id, int32_t* out_prompt_len,
     // cannot fit yet: undo the hit refs (pages stay cached) and leave the
     // request queued — deciding BEFORE evicting keeps a failed attempt from
     // wiping the evictable cache
-    for (int32_t p : pages) e->refcount[p]--;
+    for (int32_t p : pages) deref_page(e, p);
     return -1;
   }
   for (int32_t i = 0; i < need_new; ++i) {
@@ -254,8 +329,13 @@ int32_t eng_admit(Engine* e, int64_t* out_req_id, int32_t* out_prompt_len,
 // Record one generated token for a slot, growing its KV by one position.
 // Returns 1 = keep decoding, 0 = request finished (eos or budget),
 // -2 = page pool exhausted (caller should preempt/release), -1 = bad slot.
-int32_t eng_commit_token(Engine* e, int32_t slot_id, int32_t is_eos) {
+// *out_new_page (may be null) reports the page id allocated by this commit,
+// or -1 — the caller can mirror the page table incrementally instead of
+// re-snapshotting max_slots x max_pages ints from C every tick.
+int32_t eng_commit_token_ex(Engine* e, int32_t slot_id, int32_t is_eos,
+                            int32_t* out_new_page) {
   std::lock_guard<std::mutex> lock(e->mu);
+  if (out_new_page) *out_new_page = -1;
   if (slot_id < 0 || slot_id >= e->max_slots) return -1;
   Slot& slot = e->slots[slot_id];
   if (!slot.active) return -1;
@@ -265,11 +345,16 @@ int32_t eng_commit_token(Engine* e, int32_t slot_id, int32_t is_eos) {
     int32_t p = take_page(e);  // evicts cache leaves before giving up
     if (p < 0) return -2;
     slot.pages.push_back(p);
+    if (out_new_page) *out_new_page = p;
   }
   slot.seq_len++;
   slot.generated++;
   if (is_eos || slot.generated >= slot.max_new_tokens) return 0;
   return 1;
+}
+
+int32_t eng_commit_token(Engine* e, int32_t slot_id, int32_t is_eos) {
+  return eng_commit_token_ex(e, slot_id, is_eos, nullptr);
 }
 
 // Release a slot. `hashes` (may be null) are chain hashes for the slot's
@@ -293,9 +378,18 @@ void eng_release_cached(Engine* e, int32_t slot_id, const uint64_t* hashes,
       uint64_t parent = (i == 0) ? 0 : hashes[i - 1];
       auto pit = e->cache.find(parent);
       if (i > 0 && pit == e->cache.end()) break;  // keep chains contiguous
-      if (pit != e->cache.end()) pit->second.children++;
-      e->refcount[slot.pages[i]]++;  // the cache's ref
-      e->cache[h] = CacheEntry{slot.pages[i], parent, 0, ++e->clock};
+      if (pit != e->cache.end()) {
+        pit->second.children++;
+        update_evictable(e, parent, pit->second);
+      }
+      int32_t pg = slot.pages[i];
+      e->refcount[pg]++;  // the cache's ref, on top of the slot's
+      e->cache[h] = CacheEntry{pg, parent, 0, ++e->clock, 0, 0};
+      e->page_hash[pg] = h;
+      // the slot still holds its ref (refcount >= 2), so the new entry
+      // starts blocked; the deref loop below unblocks it once only the
+      // cache owns the page
+      add_blocker(e, h);
     }
   }
   for (int32_t p : slot.pages) deref_page(e, p);
@@ -322,6 +416,32 @@ void eng_page_table(Engine* e, int32_t* out /* max_slots*max_pages_per_slot */) 
               : 0;  // trash page: safe to write AND gather; masked by seq_lens
     }
   }
+}
+
+// One slot's page-table row (max_pages_per_slot ints, trash-page padded) —
+// fetched once at admission; commits then grow the caller's mirror via
+// eng_commit_token_ex's out_new_page.
+void eng_slot_pages(Engine* e, int32_t slot_id, int32_t* out) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  for (int32_t i = 0; i < e->max_pages_per_slot; ++i) out[i] = 0;
+  if (slot_id < 0 || slot_id >= e->max_slots) return;
+  const Slot& slot = e->slots[slot_id];
+  if (!slot.active) return;
+  for (size_t i = 0; i < slot.pages.size(); ++i)
+    out[i] = slot.pages[i];
+}
+
+// Reclaimable-page counts: the O(1) incremental counter the allocator uses,
+// and the O(cache) recompute — exposed so tests (and the sanitizer stress
+// driver) can assert they never drift.
+int32_t eng_reclaimable(Engine* e) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  return count_reclaimable(e);
+}
+
+int32_t eng_reclaimable_slow(Engine* e) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  return count_reclaimable_slow(e);
 }
 
 void eng_seq_lens(Engine* e, int32_t* out /* max_slots */) {

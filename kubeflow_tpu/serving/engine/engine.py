@@ -85,6 +85,13 @@ class _Pending:
     stream: "queue.Queue" = None
     # set by Engine.cancel(); the loop finishes the request at its next tick
     cancelled: bool = False
+    # committed context (prompt + generated, one list — no per-tick concat)
+    # plus the incrementally-built n-gram index for prompt-lookup drafting:
+    # maps n-gram -> most recent start strictly before the final n-gram, so
+    # each position is indexed once per request instead of rescanned per tick
+    context: list = None
+    ngram_index: dict = dataclasses.field(default_factory=dict)
+    ngram_p: int = 0
 
 
 class _StreamHandle:
@@ -153,6 +160,16 @@ class Engine:
         self._requests: dict[int, _Pending] = {}
         self._slot_req: dict[int, int] = {}
         self._prefilling: dict[int, int] = {}  # slot -> next prompt offset
+        # Host-side mirrors of the C++ slot state, grown incrementally
+        # (slot_pages row at admission + commit_token_ex page grants) so the
+        # decode loop never re-snapshots max_slots x max_pages from C per
+        # tick.  Invariant: rows/lens are LIVE only for decode-ready slots —
+        # they stay zero (trash page, len 0) while a slot is prefilling, so
+        # the decode step's unconditional KV write cannot touch its pages.
+        self._pt_host = np.zeros(
+            (engine_config.max_slots, engine_config.max_pages_per_slot), np.int32)
+        self._len_host = np.zeros((engine_config.max_slots,), np.int32)
+        self._prefill_rows: dict[int, "np.ndarray"] = {}  # slot -> page row
         self._next_id = 0
         self._lock = threading.Lock()
         self._running = False
@@ -195,7 +212,7 @@ class Engine:
             self._requests[rid] = _Pending(
                 tokens=list(tokens), max_new_tokens=max_new_tokens,
                 future=fut, submitted_at=time.perf_counter(), page_hashes=hashes,
-                stream=stream,
+                stream=stream, context=list(tokens),
             )
         # lookup eligibility stops one page short of the prompt end: prefill
         # must compute at least the final prompt token to produce the logits
@@ -239,6 +256,7 @@ class Engine:
         by the engine loop at its next tick, keeping whatever tokens were
         committed, and its slot/pages free right after. Returns False if the
         request already finished."""
+        queued_result = None
         with self._lock:
             hit = None
             for rid, pending in self._requests.items():
@@ -254,13 +272,17 @@ class Engine:
                 # (the C++ queue entry is reaped at admission: pending gone
                 # -> the slot is released untouched)
                 self._requests.pop(rid)
-                result = {"tokens": [], "num_tokens": 0, "truncated": False,
-                          "cancelled": True, "ttft_s": 0.0,
-                          "latency_s": time.perf_counter() - pending.submitted_at}
-                pending.future.set_result(result)
-                if pending.stream is not None:
-                    pending.stream.put((None, result))
-                return True
+                queued_result = {
+                    "tokens": [], "num_tokens": 0, "truncated": False,
+                    "cancelled": True, "ttft_s": 0.0,
+                    "latency_s": time.perf_counter() - pending.submitted_at}
+        if queued_result is not None:
+            # resolve OUTSIDE the lock (same split _finish uses): a Future
+            # done-callback may re-enter the engine and take _lock
+            pending.future.set_result(queued_result)
+            if pending.stream is not None:
+                pending.stream.put((None, queued_result))
+            return True
         self._wake.set()
         return True
 
@@ -334,7 +356,7 @@ class Engine:
         plen = len(pending.tokens)
         ps = self.ec.page_size
         owned = self._pages_for(plen)
-        table_row = self.batcher.page_table()[slot]
+        table_row = self._prefill_rows[slot]  # fetched once at admission
 
         if self._prefilling[slot] == 0 and plen <= self.ec.prefill_chunk:
             bucket = self._bucket(plen)
@@ -353,6 +375,7 @@ class Engine:
             del self._prefilling[slot]
             first = self._sample_one(logits)
             pending.first_token_at = time.perf_counter()
+            self._activate_decode(slot, plen, owned, table_row)
             self._commit(slot, first)
             return
 
@@ -381,6 +404,7 @@ class Engine:
             del self._prefilling[slot]
             first = self._sample_one(logits)
             pending.first_token_at = time.perf_counter()
+            self._activate_decode(slot, plen, owned, table_row)
             self._commit(slot, first)
         else:
             self._prefilling[slot] = off + C
@@ -415,6 +439,7 @@ class Engine:
                 # cache-hit pages already hold the prefix KV: prefill resumes
                 # at the first uncovered position
                 self._prefilling[slot] = cached * self.ec.page_size
+                self._prefill_rows[slot] = self.batcher.slot_pages(slot)
 
             # --- one prefill chunk per prefilling slot
             for slot in list(self._prefilling):
@@ -429,10 +454,10 @@ class Engine:
                 self._prefill_tick(slot)
 
             # --- one decode step over slots whose prefill is complete
-            active = self.batcher.active_mask()
+            # (_slot_req membership == slot active; no C snapshot needed)
             decode_ready = [
-                s for s in range(self.ec.max_slots)
-                if active[s] and s in self._slot_req and s not in self._prefilling
+                s for s in self._slot_req
+                if s not in self._prefilling
             ]
             for slot in list(decode_ready):
                 if self._requests[self._slot_req[slot]].cancelled:
@@ -443,13 +468,10 @@ class Engine:
                                  cancelled=True)
             if decode_ready:
                 did_work = True
-                seq_lens = np.array(self.batcher.seq_lens(), np.int32)
-                page_table = np.array(self.batcher.page_table(), np.int32)
-                for slot in self._prefilling:
-                    # mid-prefill slots must not be touched by the decode
-                    # step's KV write: route them to the trash page, len 0
-                    seq_lens[slot] = 0
-                    page_table[slot, :] = 0
+                # host mirrors ARE the decode view: mid-prefill slots hold
+                # len 0 / trash rows by construction (_activate_decode)
+                seq_lens = self._len_host
+                page_table = self._pt_host
                 drafts = {slot: self._draft_for(slot, seq_lens[slot])
                           for slot in decode_ready} if self._spec else {}
                 if any(drafts.values()):
@@ -483,7 +505,11 @@ class Engine:
     def _draft_for(self, slot: int, seq_len: int) -> list[int]:
         """Prompt-lookup draft: continuation of the most recent earlier
         occurrence of the context's final n-gram, clamped so every draft
-        position stays inside the slot's currently-owned pages."""
+        position stays inside the slot's currently-owned pages.
+
+        The n-gram index is built incrementally (each committed position is
+        indexed exactly once per request), so a tick costs O(new tokens),
+        not an O(context) backward scan — the long-context host-loop fix."""
         if seq_len == 0:
             return []
         ps = self.ec.page_size
@@ -493,15 +519,23 @@ class Engine:
                     pending.max_new_tokens - len(pending.generated) - 1)
         if limit <= 0:
             return []
-        ctx = pending.tokens + pending.generated
+        ctx = pending.context
         n = self.ec.spec_ngram
         if len(ctx) <= n:
             return []
-        pat = ctx[-n:]
-        for i in range(len(ctx) - n - 1, -1, -1):
-            if ctx[i:i + n] == pat:
-                return ctx[i + n:i + n + limit]
-        return []
+        # index n-grams with starts STRICTLY before the final one, so the
+        # lookup yields the most recent EARLIER occurrence (later writes win)
+        idx = pending.ngram_index
+        p = pending.ngram_p
+        last = len(ctx) - n
+        while p < last:
+            idx[tuple(ctx[p:p + n])] = p
+            p += 1
+        pending.ngram_p = p
+        i = idx.get(tuple(ctx[-n:]))
+        if i is None:
+            return []
+        return ctx[i + n:i + n + limit]
 
     def _decode_tick_speculative(self, decode_ready, drafts, seq_lens,
                                  page_table) -> None:
@@ -544,17 +578,33 @@ class Engine:
     def _pages_for(self, tokens: int) -> int:
         return (tokens + self.ec.page_size - 1) // self.ec.page_size
 
+    def _activate_decode(self, slot: int, plen: int, owned: int, row) -> None:
+        """Prefill finished: install the slot's page row + length into the
+        host mirrors, making it visible to the decode step (rows are zero —
+        trash page — until this point so decode KV writes can't touch a
+        mid-prefill slot)."""
+        self._pt_host[slot, :owned] = row[:owned]
+        self._len_host[slot] = plen
+        self._prefill_rows.pop(slot, None)
+
     def _commit(self, slot: int, token: int) -> int:
         """Record one generated token; returns the batcher rc (1 = keep
         decoding; anything else means the slot was finished+released)."""
         rid = self._slot_req[slot]
         pending = self._requests[rid]
         pending.generated.append(token)
+        pending.context.append(token)
         if pending.stream is not None:
             pending.stream.put(token)
         is_eos = token == self.ec.eos_id
-        rc = self.batcher.commit_token(slot, is_eos)
+        rc, new_page = self.batcher.commit_token_ex(slot, is_eos)
         if rc == 1:
+            # mirror the growth (finished slots are zeroed in _finish, so
+            # only the keep-decoding path needs it)
+            self._len_host[slot] += 1
+            if new_page >= 0:
+                idx = self._pages_for(int(self._len_host[slot])) - 1
+                self._pt_host[slot, idx] = new_page
             return rc
         # finished (0) or page-pool OOM (-2): either way the slot frees; OOM
         # truncates the generation rather than deadlocking the pool
@@ -566,6 +616,9 @@ class Engine:
         with self._lock:  # cancel() iterates _requests under this lock
             pending = self._requests.pop(rid)
             self._slot_req.pop(slot, None)
+        self._pt_host[slot, :] = 0
+        self._len_host[slot] = 0
+        self._prefill_rows.pop(slot, None)
         # hand the prompt's full pages to the prefix cache on the way out —
         # unless the prefill never finished (cancel mid-prefill): those pages
         # hold garbage and must not be served to other requests

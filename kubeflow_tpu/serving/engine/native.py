@@ -40,6 +40,13 @@ def load_library() -> ctypes.CDLL:
                                       ctypes.POINTER(i32), ctypes.POINTER(i32)]
             lib.eng_commit_token.restype = i32
             lib.eng_commit_token.argtypes = [p, i32, i32]
+            lib.eng_commit_token_ex.restype = i32
+            lib.eng_commit_token_ex.argtypes = [p, i32, i32, ctypes.POINTER(i32)]
+            lib.eng_slot_pages.argtypes = [p, i32, ip]
+            lib.eng_reclaimable.restype = i32
+            lib.eng_reclaimable.argtypes = [p]
+            lib.eng_reclaimable_slow.restype = i32
+            lib.eng_reclaimable_slow.argtypes = [p]
             lib.eng_release.argtypes = [p, i32]
             lib.eng_release_cached.argtypes = [p, i32, u64p, i32]
             lib.eng_cache_stats.argtypes = [p, i64p]
@@ -75,6 +82,13 @@ class NativeBatcher:
             self.lib.eng_destroy(self._e)
             self._e = None
 
+    def _handle(self):
+        """The live engine pointer; a clean Python error after close() —
+        passing NULL into the C core would segfault instead."""
+        if not self._e:
+            raise RuntimeError("batcher closed")
+        return self._e
+
     def submit(self, req_id: int, prompt_len: int, max_new_tokens: int,
                prefix_hashes=None) -> bool:
         """Queue a request; False if it can never fit. ``prefix_hashes``:
@@ -82,11 +96,11 @@ class NativeBatcher:
         Engine._page_hashes) — the prefix-cache lookup happens at admit."""
         if prefix_hashes is not None and len(prefix_hashes):
             h = np.ascontiguousarray(prefix_hashes, dtype=np.uint64)
-            rc = self.lib.eng_submit(self._e, req_id, prompt_len, max_new_tokens,
-                                     h.ctypes.data, len(h))
+            rc = self.lib.eng_submit(self._handle(), req_id, prompt_len,
+                                     max_new_tokens, h.ctypes.data, len(h))
         else:
-            rc = self.lib.eng_submit(self._e, req_id, prompt_len, max_new_tokens,
-                                     None, 0)
+            rc = self.lib.eng_submit(self._handle(), req_id, prompt_len,
+                                     max_new_tokens, None, 0)
         return rc == 0
 
     def admit(self):
@@ -95,7 +109,7 @@ class NativeBatcher:
         plen = ctypes.c_int32()
         mnew = ctypes.c_int32()
         cached = ctypes.c_int32()
-        slot = self.lib.eng_admit(self._e, ctypes.byref(rid), ctypes.byref(plen),
+        slot = self.lib.eng_admit(self._handle(), ctypes.byref(rid), ctypes.byref(plen),
                                   ctypes.byref(mnew), ctypes.byref(cached))
         if slot < 0:
             return None
@@ -103,53 +117,74 @@ class NativeBatcher:
 
     def commit_token(self, slot: int, is_eos: bool) -> int:
         """1=continue, 0=finished, -2=page pool exhausted."""
-        return self.lib.eng_commit_token(self._e, slot, 1 if is_eos else 0)
+        return self.lib.eng_commit_token(self._handle(), slot, 1 if is_eos else 0)
+
+    def commit_token_ex(self, slot: int, is_eos: bool) -> tuple:
+        """-> (rc, new_page_id or -1): rc as commit_token; new_page_id lets
+        the caller grow a host-side page-table mirror incrementally."""
+        new_page = ctypes.c_int32(-1)
+        rc = self.lib.eng_commit_token_ex(self._handle(), slot,
+                                          1 if is_eos else 0,
+                                          ctypes.byref(new_page))
+        return rc, new_page.value
 
     def release(self, slot: int, prefix_hashes=None) -> None:
         """Free the slot; with ``prefix_hashes`` (uint64, one per full PROMPT
         page) the covered pages enter the prefix cache instead."""
         h = np.ascontiguousarray(prefix_hashes if prefix_hashes is not None else [],
                                  dtype=np.uint64)
-        self.lib.eng_release_cached(self._e, slot, h, len(h))
+        self.lib.eng_release_cached(self._handle(), slot, h, len(h))
 
     def cache_stats(self) -> dict:
         out = np.zeros((4,), np.int64)
-        self.lib.eng_cache_stats(self._e, out)
+        self.lib.eng_cache_stats(self._handle(), out)
         return {"cached_pages": int(out[0]), "page_hits": int(out[1]),
                 "page_misses": int(out[2]), "evictions": int(out[3])}
 
     def page_table(self) -> np.ndarray:
         out = np.zeros((self.max_slots, self.max_pages_per_slot), np.int32)
-        self.lib.eng_page_table(self._e, out.reshape(-1))
+        self.lib.eng_page_table(self._handle(), out.reshape(-1))
+        return out
+
+    def slot_pages(self, slot: int) -> np.ndarray:
+        """One slot's page-table row (fetched at admission; see commit_token_ex)."""
+        out = np.zeros((self.max_pages_per_slot,), np.int32)
+        self.lib.eng_slot_pages(self._handle(), slot, out)
         return out
 
     def seq_lens(self) -> np.ndarray:
         out = np.zeros((self.max_slots,), np.int32)
-        self.lib.eng_seq_lens(self._e, out)
+        self.lib.eng_seq_lens(self._handle(), out)
         return out
 
     def active_mask(self) -> np.ndarray:
         out = np.zeros((self.max_slots,), np.int32)
-        self.lib.eng_active_mask(self._e, out)
+        self.lib.eng_active_mask(self._handle(), out)
         return out
 
     def slot_req(self, slot: int) -> int:
-        return self.lib.eng_slot_req(self._e, slot)
+        return self.lib.eng_slot_req(self._handle(), slot)
 
     def slot_seq_len(self, slot: int) -> int:
-        return self.lib.eng_slot_seq_len(self._e, slot)
+        return self.lib.eng_slot_seq_len(self._handle(), slot)
+
+    def reclaimable(self) -> int:
+        return self.lib.eng_reclaimable(self._handle())
+
+    def reclaimable_slow(self) -> int:
+        return self.lib.eng_reclaimable_slow(self._handle())
 
     @property
     def free_pages(self) -> int:
-        return self.lib.eng_num_free_pages(self._e)
+        return self.lib.eng_num_free_pages(self._handle())
 
     @property
     def queue_depth(self) -> int:
-        return self.lib.eng_queue_depth(self._e)
+        return self.lib.eng_queue_depth(self._handle())
 
     @property
     def num_active(self) -> int:
-        return self.lib.eng_num_active(self._e)
+        return self.lib.eng_num_active(self._handle())
 
     def __del__(self):  # pragma: no cover - defensive
         try:

@@ -33,6 +33,8 @@ int32_t eng_num_free_pages(Engine*);
 int32_t eng_queue_depth(Engine*);
 int32_t eng_num_active(Engine*);
 void eng_cache_stats(Engine*, int64_t*);
+int32_t eng_reclaimable(Engine*);
+int32_t eng_reclaimable_slow(Engine*);
 }
 
 namespace {
@@ -106,6 +108,20 @@ static void snapshotter(Engine* e) {
   }
 }
 
+// The incremental reclaimable counter must never drift from the O(cache)
+// recompute.  Checked single-threaded (after the drain) — the two calls take
+// the lock separately, so comparing them mid-race would be meaningless.
+static bool reclaimable_consistent(Engine* e) {
+  int32_t fast = eng_reclaimable(e);
+  int32_t slow = eng_reclaimable_slow(e);
+  if (fast != slow) {
+    std::fprintf(stderr, "reclaimable drift: incremental %d vs recompute %d\n",
+                 fast, slow);
+    return false;
+  }
+  return true;
+}
+
 int main() {
   Engine* e = eng_create(kSlots, kPages, kPageSize, kMaxPagesPerSlot);
   if (!e) {
@@ -127,7 +143,9 @@ int main() {
   dec.join();
   snap.join();
   int64_t got = completed.load();
+  bool consistent = reclaimable_consistent(e);
   eng_destroy(e);
+  if (!consistent) return 1;
   if (got != want) {
     std::fprintf(stderr, "stress: completed %lld of %lld\n",
                  static_cast<long long>(got), static_cast<long long>(want));

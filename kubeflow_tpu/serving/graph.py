@@ -33,6 +33,11 @@ from ..core.events import EventRecorder
 GROUP = "serving.kserve.io"
 VERSION = "v1alpha1"
 ROUTER_TYPES = ("Sequence", "Switch", "Ensemble", "Splitter")
+# Max nodeName nesting depth accepted at admission.  GraphRouter executes
+# graphs recursively (~2 Python frames per hop), so the validator must bound
+# depth well under the interpreter's recursion limit — a deeper graph would
+# validate fine and then RecursionError on every predict().
+MAX_GRAPH_DEPTH = 128
 
 
 def _validate(obj: Obj) -> None:
@@ -53,23 +58,47 @@ def _validate(obj: Obj) -> None:
                 raise Invalid(f"node {name!r} step {i}: unknown nodeName {step['nodeName']!r}")
             if rt == "Splitter" and not isinstance(step.get("weight"), (int, float)):
                 raise Invalid(f"node {name!r} step {i}: Splitter steps need a numeric weight")
-    # node references must be acyclic — a stored cycle would turn every
-    # predict() into a RecursionError
+    # node references must be acyclic AND depth-bounded — a stored cycle (or
+    # a chain deeper than the recursive executor can walk) would turn every
+    # predict() into a RecursionError.  Iterative DFS with an explicit stack:
+    # the validator itself can never RecursionError, and both pathologies
+    # come back as a clean Invalid at admission.
     state: dict = {}  # name -> 1 visiting, 2 done
+    height: dict = {}  # name -> longest nodeName chain rooted at it
 
-    def visit(name: str) -> None:
-        if state.get(name) == 2:
-            return
-        if state.get(name) == 1:
-            raise Invalid(f"InferenceGraph: cycle through node {name!r}")
-        state[name] = 1
+    def child_nodes(name: str):
         for step in nodes[name].get("steps") or []:
             if step.get("nodeName"):
-                visit(step["nodeName"])
-        state[name] = 2
+                yield step["nodeName"]
 
-    for name in nodes:
-        visit(name)
+    for root in nodes:
+        if state.get(root) == 2:
+            continue
+        # frame: [name, child iterator, max child height seen]
+        stack = [[root, child_nodes(root), 0]]
+        state[root] = 1
+        while stack:
+            frame = stack[-1]
+            child = next(frame[1], None)
+            if child is None:  # post-order: all children resolved
+                h = 1 + frame[2]
+                if h > MAX_GRAPH_DEPTH:
+                    raise Invalid(
+                        f"InferenceGraph: node chain deeper than "
+                        f"{MAX_GRAPH_DEPTH} (at node {frame[0]!r})")
+                height[frame[0]] = h
+                state[frame[0]] = 2
+                stack.pop()
+                if stack:
+                    stack[-1][2] = max(stack[-1][2], h)
+                continue
+            if state.get(child) == 1:
+                raise Invalid(f"InferenceGraph: cycle through node {child!r}")
+            if state.get(child) == 2:
+                frame[2] = max(frame[2], height[child])
+                continue
+            state[child] = 1
+            stack.append([child, child_nodes(child), 0])
 
 
 def register(api: APIServer) -> None:

@@ -54,6 +54,87 @@ def test_native_batcher_lifecycle():
     b.close()
 
 
+def test_native_batcher_raises_after_close():
+    """Accessors on a closed batcher must raise a clean Python error, not
+    pass NULL into the C core (segfault)."""
+    b = NativeBatcher(max_slots=1, num_pages=8, page_size=4, max_pages_per_slot=4)
+    b.close()
+    for call in (lambda: b.cache_stats(), lambda: b.page_table(),
+                 lambda: b.free_pages, lambda: b.num_active,
+                 lambda: b.seq_lens(), lambda: b.submit(1, 4, 2)):
+        with pytest.raises(RuntimeError, match="closed"):
+            call()
+    b.close()  # idempotent
+
+
+def test_cancel_queued_with_reentrant_done_callback(params):
+    """A Future done-callback that re-enters the engine (stats takes no lock
+    but cancel-era code resolved under _lock) must not deadlock cancel()."""
+    eng = Engine(params, CFG, EngineConfig(max_slots=1, num_pages=32,
+                                           page_size=8, max_pages_per_slot=8))
+    # engine NOT started: the request stays queued, exercising the
+    # resolve-immediately path in cancel()
+    fut = eng.generate_async([5, 7, 9], 4)
+    seen = []
+    fut.add_done_callback(lambda f: seen.append(eng.cancel(fut)))  # re-enters _lock
+    assert eng.cancel(fut)
+    assert fut.result(timeout=5)["cancelled"]
+    assert seen == [False]  # the re-entrant cancel found the request gone
+    eng.batcher.close()
+
+
+def test_native_batcher_commit_token_ex_reports_page_grants():
+    """commit_token_ex reports each newly-allocated page so callers can
+    mirror the page table incrementally; the mirror must equal the full
+    snapshot at every step."""
+    b = NativeBatcher(max_slots=1, num_pages=16, page_size=4, max_pages_per_slot=8)
+    assert b.submit(1, 6, 10)
+    slot, *_ = b.admit()
+    mirror = b.slot_pages(slot).copy()
+    np.testing.assert_array_equal(mirror, b.page_table()[slot])
+    seq = 6
+    while True:
+        rc, new_page = b.commit_token_ex(slot, False)
+        if rc != 1:
+            break
+        seq += 1
+        if new_page >= 0:
+            mirror[(seq + 3) // 4 - 1] = new_page
+        np.testing.assert_array_equal(mirror, b.page_table()[slot])
+        assert b.seq_lens()[slot] == seq
+    b.close()
+
+
+def test_native_batcher_reclaimable_counter_matches_recompute():
+    """The incremental reclaimable counter (admission's O(1) check) must
+    track the O(cache) recompute through cache churn: insert, adopt, evict."""
+    b = NativeBatcher(max_slots=2, num_pages=8, page_size=4, max_pages_per_slot=6)
+
+    def check():
+        assert b.reclaimable() == b.reclaimable_slow()
+
+    h = np.arange(1, 4, dtype=np.uint64) * 1000  # 3-page chain
+    assert b.submit(1, 12, 2, h[:2])
+    slot, *_ = b.admit()
+    check()
+    b.release(slot, h)      # 3 pages cached, no external owner
+    check()
+    assert b.submit(2, 12, 2, h[:2])   # adopts 2 cached pages
+    slot2, _, _, _, cached = b.admit()
+    assert cached == 2
+    check()                  # adopted pages block themselves + ancestors
+    b.release(slot2, h)
+    check()
+    # pressure: 7 usable pages, 3 cached -> a 6-page prompt forces evictions
+    assert b.submit(3, 21, 2)
+    slot3, *_ = b.admit()
+    assert b.cache_stats()["evictions"] > 0
+    check()
+    b.release(slot3)
+    check()
+    b.close()
+
+
 def test_native_batcher_rejects_pool_unfittable_prompt():
     # per-slot cap (64) would admit it, but the whole pool has 31 usable
     # pages: queueing it would block head-of-line admission forever

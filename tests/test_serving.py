@@ -557,6 +557,36 @@ def test_inference_graph_splitter_and_validation(scluster):
             "routerType": "Splitter", "steps": [{"serviceName": "a2"}]}}))
 
 
+def test_inference_graph_deep_chain_rejected_without_recursion():
+    """The validator is an iterative DFS: a nodeName chain deeper than the
+    recursive EXECUTOR could serve must come back as a clean Invalid (never
+    a RecursionError from the validator), a chain at the cap validates, and
+    a deep cycle is still reported as a cycle."""
+    import sys
+
+    from kubeflow_tpu.core.api import Invalid
+    from kubeflow_tpu.serving.graph import MAX_GRAPH_DEPTH, _validate, inference_graph
+
+    def chain(depth):
+        nodes = {"root": {"routerType": "Sequence", "steps": [{"nodeName": "n0"}]}}
+        for i in range(depth):
+            nxt = ([{"nodeName": f"n{i + 1}"}] if i + 1 < depth
+                   else [{"serviceName": "leaf"}])
+            nodes[f"n{i}"] = {"routerType": "Sequence", "steps": nxt}
+        return nodes
+
+    _validate(inference_graph("ok", chain(MAX_GRAPH_DEPTH - 1)))
+
+    deep = sys.getrecursionlimit() * 3  # would RecursionError a recursive DFS
+    with pytest.raises(Invalid, match="deeper"):
+        _validate(inference_graph("deep", chain(deep)))
+
+    nodes = chain(8)
+    nodes["n7"]["steps"] = [{"nodeName": "n0"}]  # close the loop
+    with pytest.raises(Invalid, match="cycle"):
+        _validate(inference_graph("loopy", nodes))
+
+
 def test_inference_graph_cycle_rejected_and_ready_degrades(scluster):
     from kubeflow_tpu.core.api import Invalid
     from kubeflow_tpu.serving.graph import inference_graph
