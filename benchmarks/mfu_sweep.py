@@ -3,9 +3,10 @@
 Usage: python benchmarks/mfu_sweep.py BATCH SEQ REMAT POLICY ATTN [STEPS]
   REMAT  = 0|1
   POLICY = nothing|dots|save_qkv|save_attn   (models/bert.py remat policies)
-  ATTN   = dense|dense_mask|flash
+  ATTN   = dense|dense_mask|flash|flash_mask
            (dense = padding-free, mask=None — the r1 bench workload;
-            dense_mask = all-ones padding mask through the masked path)
+            *_mask = padding mask through the path — flash masks padded
+            keys in-kernel, so variable-length batches are measurable)
 
 Prints one JSON line with measured samples/s/chip + MFU, mirroring bench.py's
 accounting (fwd+bwd matmul FLOPs, MLM head on 20 predictions at seq 128 /
@@ -37,6 +38,8 @@ def main() -> None:
     remat = bool(int(sys.argv[3]))
     policy = sys.argv[4]
     attn = sys.argv[5]
+    if attn not in ("dense", "dense_mask", "flash", "flash_mask"):
+        sys.exit(f"unknown ATTN {attn!r}: dense|dense_mask|flash|flash_mask")
     steps = int(sys.argv[6]) if len(sys.argv) > 6 else 10
 
     devices = jax.devices()
@@ -46,11 +49,13 @@ def main() -> None:
     mesh = build_mesh(MeshConfig(data=1, fsdp=n_chips, tensor=1), devices)
 
     config = bert.BertConfig(remat=remat, remat_policy=policy,
-                             attention="flash" if attn == "flash" else "dense")
+                             attention="flash" if attn.startswith("flash") else "dense")
     max_predictions = max(20 * seq_len // 128, 1)
     params = bert.init(jax.random.PRNGKey(0), config)
 
-    use_mask = attn == "dense_mask"  # dense / flash skip the padding mask
+    # *_mask = run the padding mask through the path (flash masks padded
+    # keys in-kernel); bare dense/flash = the padding-free r1 workload
+    use_mask = attn in ("dense_mask", "flash_mask")
 
     def loss_fn(p, b):
         return bert.mlm_loss(p, config, b["input_ids"], b["labels"],

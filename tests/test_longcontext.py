@@ -1,6 +1,8 @@
 """Long-context layer: flash kernel, ring attention, Ulysses, MoE — all
 checked against dense references, sharded cases on the 8-device CPU mesh."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -64,6 +66,81 @@ def test_flash_rejects_indivisible_blocks(qkv):
     q, k, v = qkv
     with pytest.raises(ValueError, match="divide"):
         flash_attention(q, k, v, block_q=48, block_k=48)
+
+
+def test_flash_with_padding_mask_matches_dense(qkv):
+    """Key-side padding mask in-kernel (VERDICT r2 #5): flash+mask must equal
+    dense+mask on every REAL query row of a variable-length batch."""
+    from kubeflow_tpu.ops.attention import padding_mask
+
+    q, k, v = qkv
+    lengths = [37, 64]  # one padded sequence (crosses a 16-block boundary), one full
+    am = np.zeros((B, S), np.int32)
+    for i, n in enumerate(lengths):
+        am[i, :n] = 1
+    am = jnp.asarray(am)
+    ref = multihead_attention(q, k, v, mask=padding_mask(am))
+    out = flash_attention(q, k, v, block_q=16, block_k=16, kv_mask=am)
+    for i, n in enumerate(lengths):  # padded query rows are garbage in both
+        np.testing.assert_allclose(np.asarray(out)[i, :n], np.asarray(ref)[i, :n],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_flash_with_padding_mask_grads_match_dense(qkv):
+    """Gradients through the masked flash VJP == dense+mask gradients on the
+    contributing (real-position) entries."""
+    from kubeflow_tpu.ops.attention import padding_mask
+
+    q, k, v = qkv
+    am = np.zeros((B, S), np.int32)
+    am[0, :37] = 1
+    am[1, :] = 1
+    am = jnp.asarray(am)
+    # weight the loss by the mask so padded-query garbage can't leak into it
+    w = am.astype(jnp.float32)[:, :, None, None]
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, block_q=16, block_k=16, kv_mask=am)
+        return jnp.sum((o * w) ** 2)
+
+    def loss_ref(q, k, v):
+        o = multihead_attention(q, k, v, mask=padding_mask(am))
+        return jnp.sum((o * w) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_bert_flash_with_mask_matches_dense_loss():
+    """End-to-end: BertConfig(attention='flash') accepts a real padding mask
+    and the MLM loss + grads track the dense path."""
+    from kubeflow_tpu.models import bert
+
+    cfg_d = bert.BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                            num_heads=2, intermediate_size=64,
+                            max_position=64, attention="dense")
+    cfg_f = dataclasses.replace(cfg_d, attention="flash")
+    params = bert.init(jax.random.PRNGKey(1), cfg_d)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, 128, (2, 64)), jnp.int32)
+    labels = jnp.asarray(rng.integers(1, 128, (2, 64)), jnp.int32)
+    am = np.zeros((2, 64), np.int32)
+    am[0, :40] = 1
+    am[1, :] = 1
+    am = jnp.asarray(am)
+
+    def loss(cfg):
+        def f(p):
+            return bert.mlm_loss(p, cfg, ids, labels, am, max_predictions=10)
+        return jax.value_and_grad(f)(params)
+
+    ld, gd = loss(cfg_d)
+    lf, gf = loss(cfg_f)
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-4)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3), gf, gd)
 
 
 # -------------------------------------------------------------------- ring
