@@ -170,6 +170,10 @@ class APIServer:
         self._objects: dict[str, dict[tuple, Obj]] = {}
         self._watchers: dict[str, list[Watcher]] = {}
         self._mutating_webhooks: dict[str, list[Callable[[Obj], None]]] = {}
+        # resources installed components want released at cluster shutdown
+        # (e.g. the Katib db-manager's listening socket) — Cluster.shutdown
+        # runs these; installers register via add_teardown
+        self._teardowns: list[Callable[[], None]] = []
         self._rv = 0
         self.register_crd(CRD(group="", version="v1", kind="Namespace", plural="namespaces", namespaced=False))
         self.register_crd(CRD(group="", version="v1", kind="Pod", plural="pods"))
@@ -197,6 +201,23 @@ class APIServer:
             return self._crds[kind]
         except KeyError:
             raise NotFound(f"no resource type registered for kind {kind!r}")
+
+    def add_teardown(self, fn: Callable[[], None]) -> None:
+        """Register a cleanup hook run by Cluster.shutdown (idempotence is
+        the hook's responsibility)."""
+        with self._lock:
+            self._teardowns.append(fn)
+
+    def run_teardowns(self) -> None:
+        with self._lock:
+            hooks, self._teardowns = list(self._teardowns), []
+        for fn in reversed(hooks):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — teardown must not mask teardown
+                import traceback
+
+                traceback.print_exc()
 
     def register_mutating_webhook(self, kind: str, fn: Callable[[Obj], None]) -> None:
         """Admission-webhook equivalent: fn mutates the object at create time

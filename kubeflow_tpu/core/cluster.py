@@ -76,3 +76,4 @@ class Cluster:
     def shutdown(self) -> None:
         for kubelet in self.kubelets.values():
             kubelet.shutdown()
+        self.api.run_teardowns()

@@ -38,6 +38,11 @@ class _PodRun:
     current: Optional[subprocess.Popen] = None
     in_init: bool = False
     main_container: Optional[dict] = None
+    # containers[1:] run as sidecars: spawned with the main container, killed
+    # (SIGTERM first, so they can flush) when the main terminates — the k8s
+    # semantics Katib's injected metrics collector relies on
+    sidecar_containers: list[dict] = field(default_factory=list)
+    sidecars: list[subprocess.Popen] = field(default_factory=list)
     log_path: str = ""
     restart_count: int = 0
     next_restart_at: float = 0.0
@@ -113,6 +118,7 @@ class LocalProcessKubelet:
             uid=meta["uid"],
             init_remaining=list(spec.get("initContainers", [])),
             main_container=spec["containers"][0],
+            sidecar_containers=list(spec["containers"][1:]),
         )
         run.log_path = os.path.join(self.logdir, f"{run.namespace}_{run.name}.log")
         self._runs[meta["uid"]] = run
@@ -175,7 +181,8 @@ class LocalProcessKubelet:
                     with open(os.path.join(target, key), "w") as f:
                         f.write(content)
 
-    def _spawn(self, run: _PodRun, container: dict) -> subprocess.Popen:
+    def _spawn(self, run: _PodRun, container: dict,
+               log_suffix: str = "") -> subprocess.Popen:
         cmd = list(container.get("command", [])) + list(container.get("args", []))
         if not cmd:
             raise ValueError(f"pod {run.name}: container has no command (images are not pullable here)")
@@ -183,6 +190,13 @@ class LocalProcessKubelet:
         env.update(self.base_env)
         if run.volume_root:
             env["POD_VOLUME_ROOT"] = run.volume_root
+        # sidecars (e.g. the Katib metrics collector) tail the main
+        # container's log through this; their own output goes to a
+        # per-container file so it cannot pollute the parsed stream.
+        # POD_STOP_FILE appears when the pod is shutting down — the
+        # race-free companion to the SIGTERM sidecars also receive.
+        env["POD_LOG_PATH"] = run.log_path
+        env["POD_STOP_FILE"] = run.log_path + ".stop"
         # k8s dependent-env semantics: $(VAR) in a value resolves against the
         # base env plus PREVIOUSLY-declared container vars only — forward
         # references stay verbatim, exactly like a real kubelet
@@ -195,7 +209,9 @@ class LocalProcessKubelet:
             env[e["name"]] = value
         env.setdefault("POD_NAME", run.name)
         env.setdefault("POD_NAMESPACE", run.namespace)
-        log = open(run.log_path, "ab")
+        log_path = (run.log_path if not log_suffix
+                    else f"{run.log_path}.{log_suffix}")
+        log = open(log_path, "ab")
         return subprocess.Popen(
             cmd,
             env=env,
@@ -206,13 +222,76 @@ class LocalProcessKubelet:
         )
 
     def _advance(self, run: _PodRun) -> None:
-        """Start the next container (init chain, then main)."""
+        """Start the next container (init chain, then main + sidecars)."""
         if run.init_remaining:
             run.in_init = True
             run.current = self._spawn(run, run.init_remaining.pop(0))
         else:
             run.in_init = False
             run.current = self._spawn(run, run.main_container)
+            if run.sidecar_containers and not run.sidecars:
+                # sidecars start alongside the FIRST main start and survive
+                # main crash-restarts (upstream pod semantics)
+                try:
+                    for c in run.sidecar_containers:
+                        run.sidecars.append(
+                            self._spawn(run, c, log_suffix=c.get("name", "sidecar")))
+                except (ValueError, OSError):
+                    # a bad sidecar spec must not leak the already-started
+                    # main process (or earlier sidecars): the StartError
+                    # handlers up-stack only mark the pod Failed
+                    try:
+                        os.killpg(run.current.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+                    run.current = None
+                    self._stop_sidecars(run, grace=0.0)
+                    raise
+
+    def _stop_sidecars(self, run: _PodRun, grace: float) -> None:
+        """Stop sidecars so they can flush, then the pod may go terminal.
+
+        Shutdown signal ordering matters: SIGTERM delivered while a sidecar
+        interpreter is still starting up (main exited fast) kills it before
+        any handler is installed — flushing nothing.  So the stop FILE at
+        ``POD_STOP_FILE`` is the primary signal (a polling sidecar of any
+        age sees it); SIGTERM goes out only halfway into the grace window,
+        by which point a live sidecar has long installed its handler; at
+        the deadline stragglers are SIGKILLed."""
+        if not run.sidecars:
+            return
+        try:
+            with open(run.log_path + ".stop", "w"):
+                pass
+        except OSError:
+            pass
+        deadline = time.monotonic() + grace
+        sigterm_at = time.monotonic() + grace / 2
+        sigtermed = grace <= 0
+        if sigtermed:
+            self._signal_sidecars(run, signal.SIGTERM)
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in run.sidecars):
+                break
+            if not sigtermed and time.monotonic() >= sigterm_at:
+                self._signal_sidecars(run, signal.SIGTERM)
+                sigtermed = True
+            time.sleep(0.02)
+        for p in run.sidecars:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        run.sidecars.clear()
+
+    def _signal_sidecars(self, run: _PodRun, sig: int) -> None:
+        for p in run.sidecars:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, sig)
+                except ProcessLookupError:
+                    pass
 
     # ------------------------------------------------------------------ poll
 
@@ -226,6 +305,7 @@ class LocalProcessKubelet:
                         pass
                 return False
             run.current = None
+            self._stop_sidecars(run, grace=0.5)
             self._runs.pop(run.uid, None)
             return True
 
@@ -278,6 +358,9 @@ class LocalProcessKubelet:
             )
             return True
 
+        # sidecars flush BEFORE the pod goes terminal: a watcher that sees
+        # Succeeded can rely on sidecar-pushed state (metrics) being complete
+        self._stop_sidecars(run, grace=5.0)
         self._set_status(run, self._terminated_status(pod, "Succeeded" if rc == 0 else "Failed", rc))
         run.current = None
         self._runs.pop(run.uid, None)
@@ -309,6 +392,8 @@ class LocalProcessKubelet:
                 run.current = None
         else:
             run.current = None
+        if run.current is None:
+            self._stop_sidecars(run, grace=min(grace, 0.5))
 
     def _set_status(self, run: _PodRun, status: dict) -> None:
         try:
@@ -338,4 +423,5 @@ class LocalProcessKubelet:
                         os.killpg(run.current.pid, signal.SIGKILL)
                     except ProcessLookupError:
                         pass
+            self._stop_sidecars(run, grace=0.2)
         self._runs.clear()

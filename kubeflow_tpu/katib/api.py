@@ -122,10 +122,13 @@ def experiment(
     max_failed: int = 3,
     trial_parameters: Optional[list[dict]] = None,
     namespace: str = "default",
+    metrics_collector: Optional[dict] = None,
 ) -> Obj:
     objective = {"type": objective_type, "objectiveMetricName": objective_metric}
     if goal is not None:
         objective["goal"] = goal
+    spec_extra = (
+        {"metricsCollectorSpec": metrics_collector} if metrics_collector else {})
     return {
         "apiVersion": API_VERSION,
         "kind": "Experiment",
@@ -148,5 +151,6 @@ def experiment(
                 or [{"name": p.name, "reference": p.name} for p in parameters],
                 "trialSpec": trial_spec,
             },
+            **spec_extra,
         },
     }

@@ -7,16 +7,21 @@ metrics; the suggestion controller serves parameter assignments.
 
 Deviations from upstream, by design of the simulator:
   * suggestion algorithms run in-process at reconcile time instead of in a
-    per-algorithm gRPC service pod (same request/response contract);
-  * metrics are pulled from kubelet logs at trial completion instead of
-    pushed by an injected sidecar (same StdOut parse rules + observation
-    schema) — see metrics.py.
+    per-algorithm gRPC service pod (same request/response contract).
+
+Metrics collection supports BOTH upstream shapes: the default pull path
+(trial controller reads kubelet logs at reconcile — see metrics.py) and the
+upstream sidecar architecture (``metricsCollectorSpec.collector.kind:
+"Push"`` — a pod webhook injects collector_main.py as a sidecar container
+that tails the log and pushes to the db-manager HTTP service, dbmanager.py).
 """
 
 from __future__ import annotations
 
 import copy
+import os
 import re
+import sys
 from typing import Callable, Optional
 
 from ..core.api import AlreadyExists, APIServer, Obj, owner_reference
@@ -285,6 +290,11 @@ class TrialController:
         name = trial["metadata"]["name"]
         metric_names = self._metric_names(trial)
         collector = (trial["spec"].get("metricsCollectorSpec") or {})
+        if collector.get("collector", {}).get("kind") == "Push":
+            # the injected sidecar owns reporting (push architecture); the
+            # kubelet guarantees its final flush lands before the pod goes
+            # terminal, so there is nothing to pull here
+            return
         if collector.get("collector", {}).get("kind") == "TFEvent":
             path = collector.get("source", {}).get("fileSystemPath", {}).get("path", "")
             for metric, series in parse_tfevent_dir(path, metric_names).items():
@@ -422,6 +432,62 @@ class TrialController:
         return None
 
 
+def _register_push_collector_webhook(api: APIServer, store: ObservationStore) -> None:
+    """The Katib pod webhook (upstream ``[U:katib/pkg/webhook/v1beta1/pod/]``):
+    mutate trial-job pods whose Trial asks for ``collector.kind: "Push"`` by
+    appending the metrics-collector sidecar container.  The db-manager HTTP
+    service starts lazily on the first injection."""
+    if getattr(api, "_katib_push_webhook", False):
+        return
+    api._katib_push_webhook = True
+    state: dict = {"server": None}
+
+    def _close() -> None:
+        if state["server"] is not None:
+            state["server"].close()
+            state["server"] = None
+
+    api.add_teardown(_close)
+
+    def _db_address() -> str:
+        if state["server"] is None:
+            from .dbmanager import DBManagerServer
+
+            state["server"] = DBManagerServer(store)
+        return state["server"].address
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    def inject(pod: Obj) -> None:
+        labels = pod.get("metadata", {}).get("labels", {})
+        jname = labels.get(tapi.LABEL_JOB_NAME)
+        if not jname:
+            return
+        trial = api.try_get("Trial", jname, pod["metadata"].get("namespace", "default"))
+        if trial is None:
+            return
+        spec = trial.get("spec", {})
+        collector = (spec.get("metricsCollectorSpec") or {}).get("collector", {})
+        if collector.get("kind") != "Push":
+            return
+        metric_names = [spec["objective"]["objectiveMetricName"]] + list(
+            spec["objective"].get("additionalMetricNames", []))
+        pod["spec"]["containers"].append({
+            "name": "metrics-collector",
+            "command": [sys.executable, "-u", "-m",
+                        "kubeflow_tpu.katib.collector_main"],
+            "env": [
+                {"name": "PYTHONPATH",
+                 "value": repo_root + os.pathsep + "$(PYTHONPATH)"},
+                {"name": "KATIB_DB_MANAGER", "value": _db_address()},
+                {"name": "KATIB_TRIAL", "value": jname},
+                {"name": "KATIB_METRICS", "value": ",".join(metric_names)},
+            ],
+        })
+
+    api.register_mutating_webhook("Pod", inject)
+
+
 def install(api: APIServer, manager, log_reader: Callable[[str, str], str],
             store: Optional[ObservationStore] = None,
             store_path: Optional[str] = None):
@@ -429,6 +495,7 @@ def install(api: APIServer, manager, log_reader: Callable[[str, str], str],
     kapi.register(api)
     if store is None:
         store = ObservationStore(store_path)
+    _register_push_collector_webhook(api, store)
     exp = ExperimentController(api)
     sug = SuggestionController(api)
     trial = TrialController(api, log_reader, store)

@@ -15,6 +15,7 @@ from . import api as papi
 from .artifacts import ObjectStore
 from .dsl import Pipeline
 from .metadata import MetadataStore
+from .persistence import PersistenceAgent
 from .service import PipelineService
 from .schedule import ScheduledWorkflowController
 from .workflow import WorkflowController
@@ -42,7 +43,9 @@ def install(api, manager, workdir: str, metadata_path: Optional[str] = None):
     manager.add(wf, owns=("Pod",))
     manager.add(ScheduledWorkflowController(api), owns=("Workflow",))
     service = PipelineService(api, metadata, store)
-    manager.add_ticker(service.sync_runs)
+    # the persistence agent is its own Workflow watcher (upstream informer →
+    # ReportWorkflow architecture), not a service-internal poll ticker
+    manager.add(PersistenceAgent(api, service))
     api._kfp_service = service
     return service
 

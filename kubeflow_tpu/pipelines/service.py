@@ -1,11 +1,12 @@
-"""PipelineService: the KFP API-server equivalent, + the persistence agent.
+"""PipelineService: the KFP API-server equivalent.
 
 Upstream analogue (UNVERIFIED, SURVEY.md §2/§3.5): the KFP API server keeps
 pipelines / experiments / runs in MySQL, submits Argo Workflows, and a
-persistence agent reports Workflow state back.  Here the records persist in
-the native metadata store (contexts — the "MySQL is native, SQLite-equiv
-acceptable" rule of SURVEY §2b), runs are Workflow CRs, and ``sync_runs`` is
-the persistence-agent ticker folding final workflow state into the run record.
+separate persistence agent reports Workflow state back via ReportWorkflow.
+Here the records persist in the native metadata store (contexts — the
+"MySQL is native, SQLite-equiv acceptable" rule of SURVEY §2b), runs are
+Workflow CRs, ``report_workflow`` is the ReportWorkflow RPC stand-in, and
+the watch-driven agent lives in pipelines/persistence.py.
 """
 
 from __future__ import annotations
@@ -137,23 +138,24 @@ class PipelineService:
             out.append({"run": c.name, **c.properties})
         return sorted(out, key=lambda r: r.get("createdAt", 0))
 
-    # ------------------------------------------- persistence agent equivalent
+    # --------------------------------------------------- ReportWorkflow RPC
 
-    def sync_runs(self) -> bool:
-        """Fold terminal Workflow state into run records (ticker)."""
-        changed = False
-        for c in self._contexts(RUN_CTX):
-            props = dict(c.properties)
-            if props.get("phase") in papi.WORKFLOW_TERMINAL:
-                continue
-            wf = self.api.try_get("Workflow", c.name, props.get("namespace", "default"))
-            if wf is None:
-                continue
-            phase = wf.get("status", {}).get("phase")
-            if phase and phase != props.get("phase"):
-                props["phase"] = phase
-                if phase in papi.WORKFLOW_TERMINAL:
-                    props["finishedAt"] = wf["status"].get("finishedAt")
-                self.metadata.put_context(RUN_CTX, c.name, props)
-                changed = True
-        return changed
+    def report_workflow(self, wf: dict) -> bool:
+        """Fold one Workflow's state into its run record — the stand-in for
+        upstream's ReportWorkflow RPC, called by the persistence agent
+        (pipelines/persistence.py) on every watched Workflow change."""
+        run_id = wf.get("metadata", {}).get("name")
+        ctx = self.metadata.get_context_by_name(RUN_CTX, run_id)
+        if ctx is None:
+            return False  # a Workflow not created through create_run
+        props = dict(ctx.properties)
+        if props.get("phase") in papi.WORKFLOW_TERMINAL:
+            return False
+        phase = wf.get("status", {}).get("phase")
+        if not phase or phase == props.get("phase"):
+            return False
+        props["phase"] = phase
+        if phase in papi.WORKFLOW_TERMINAL:
+            props["finishedAt"] = wf["status"].get("finishedAt")
+        self.metadata.put_context(RUN_CTX, run_id, props)
+        return True

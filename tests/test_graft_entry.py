@@ -1,0 +1,55 @@
+"""Driver entry points: the multi-chip dryruns must keep compiling+running.
+
+The 16-device composed run (VERDICT r2 #9) exercises stages, seq, expert and
+tensor ALL >1 in one jitted training step — subprocesses because the test
+session's backend is pinned to 8 CPU devices at import."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_dryrun(n: int) -> str:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "JAX_NUM_CPU_DEVICES": str(n),
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={n}",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py"), str(n)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_16_devices_composes_four_axes():
+    out = _run_dryrun(16)
+    assert "dryrun_multichip OK" in out
+    assert "dryrun_composed OK" in out
+    # four non-trivial parallel axes in the composed step
+    assert "'stages': 2" in out and "'seq': 2" in out
+    assert "'expert': 2" in out and "'tensor': 2" in out
+
+
+def test_composed_mesh_factors_cover_axes():
+    sys.path.insert(0, REPO)
+    from __graft_entry__ import _composed_mesh_factors
+
+    f16 = _composed_mesh_factors(16)
+    assert [f16[a] for a in ("stages", "seq", "expert", "tensor")] == [2, 2, 2, 2]
+    f8 = _composed_mesh_factors(8)
+    assert [f8[a] for a in ("stages", "seq", "expert")] == [2, 2, 2]
+    for n in (1, 2, 4, 8, 16, 32, 6, 12):
+        f = _composed_mesh_factors(n)
+        prod = 1
+        for v in f.values():
+            prod *= v
+        assert prod == n, (n, f)

@@ -42,6 +42,35 @@ def test_pod_runs_to_success_and_logs(cluster):
     assert "hello from pod" in cluster.logs("hello")
 
 
+def test_sidecar_container_flushes_before_pod_terminal(cluster):
+    """containers[1:] run as sidecars: started with the main container,
+    SIGTERMed after it exits, with the pod only going terminal once the
+    sidecar's shutdown work (here: copying the main log) finished — the
+    contract the Katib push metrics collector relies on."""
+    import os
+    import tempfile
+
+    marker = os.path.join(tempfile.mkdtemp(), "sidecar-out.txt")
+    sidecar_code = (
+        "import os, signal, time\n"
+        "stop = {'now': False}\n"
+        "signal.signal(signal.SIGTERM, lambda *_: stop.update(now=True))\n"
+        "while not stop['now'] and not os.path.exists(os.environ['POD_STOP_FILE']):\n"
+        "    time.sleep(0.05)\n"
+        f"open({marker!r}, 'w').write(open(os.environ['POD_LOG_PATH']).read())\n"
+    )
+    pod = py_pod("with-sidecar", "print('main says metric=1.0')")
+    pod["spec"]["containers"].append({
+        "name": "tail",
+        "command": [sys.executable, "-u", "-c", sidecar_code],
+    })
+    cluster.api.create(pod)
+    assert cluster.wait_for(lambda: phase(cluster, "with-sidecar") == "Succeeded", timeout=30)
+    # phase flipped terminal only after the sidecar's SIGTERM handler ran
+    with open(marker) as f:
+        assert "main says metric=1.0" in f.read()
+
+
 def test_pod_failure_exit_code_recorded(cluster):
     cluster.api.create(py_pod("boom", "import sys; sys.exit(3)"))
     assert cluster.wait_for(lambda: phase(cluster, "boom") == "Failed", timeout=30)

@@ -209,6 +209,23 @@ def test_pipeline_e2e_artifacts_condition_caching(tpu_cluster):
     assert not rec3["nodes"]["make-data"].get("cached")
 
 
+def test_persistence_agent_reports_run_record(tpu_cluster):
+    """The watch-driven persistence agent (pipelines/persistence.py) must
+    fold terminal Workflow state into the run RECORD — list_runs reads only
+    context properties, so a terminal phase there proves the agent fired
+    (the r2 poll ticker is no longer registered)."""
+    cluster = tpu_cluster
+    client = Client(cluster)
+    assert all(getattr(t, "__qualname__", "") != "PipelineService.sync_runs"
+               for t in cluster.manager.tickers)
+    run = client.create_run_from_pipeline_func(train_and_deploy, arguments={"rows": 20})
+    rec = run.wait(timeout=90)
+    assert rec["phase"] == papi.SUCCEEDED
+    records = {r["run"]: r for r in client.service.list_runs()}
+    assert records[run.run_id]["phase"] == papi.SUCCEEDED
+    assert records[run.run_id].get("finishedAt")
+
+
 def test_pipeline_condition_false_skips(tpu_cluster):
     cluster = tpu_cluster
     client = Client(cluster)

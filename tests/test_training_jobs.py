@@ -3,6 +3,7 @@ full reconcile path (SURVEY.md §4: go beyond upstream CI — actually run
 distributed workloads as local processes)."""
 
 import json
+import shutil
 import sys
 
 import pytest
@@ -295,6 +296,38 @@ def test_pytorchjob_scale_job_clamps(tcluster):
         timeout=30,
     )
     client.delete_job("PyTorchJob", "scaleme")
+
+
+@pytest.mark.skipif(shutil.which("mpirun") is None,
+                    reason="mpirun not in this image (modeled path covered "
+                           "by test_mpijob_launcher_hostfile_configmap)")
+def test_mpijob_launcher_runs_real_mpirun(tcluster):
+    """VERDICT r2 #8: when a real MPI runtime exists, the Launcher pod must
+    be able to exec `mpirun` and spawn ranks (local slots — the pod 'hosts'
+    in the hostfile are not ssh-able on this box)."""
+    launcher_code = (
+        "import os, subprocess, sys\n"
+        "out = subprocess.run(['mpirun', '--allow-run-as-root', '--oversubscribe',\n"
+        "                      '-np', '2', '--host', 'localhost:2', sys.executable, '-c',\n"
+        "                      'import os; print(\"MPIRANK\", os.environ.get(\"OMPI_COMM_WORLD_RANK\", \"?\"))'],\n"
+        "                     capture_output=True, text=True, timeout=60)\n"
+        "sys.stdout.write(out.stdout + out.stderr)\n"
+        "sys.exit(out.returncode)\n"
+    )
+    spec = job(
+        "MPIJob",
+        "mpireal",
+        {
+            "Launcher": ReplicaSpec(replicas=1, command=[sys.executable, "-u", "-c", launcher_code]),
+            "Worker": ReplicaSpec(replicas=1, command=[sys.executable, "-u", "-c", "import time; time.sleep(5)"]),
+        },
+    )
+    spec["spec"].setdefault("runPolicy", {})["cleanPodPolicy"] = "Running"
+    client = _client(tcluster)
+    client.create_job(spec)
+    assert client.wait_for_job("MPIJob", "mpireal", timeout=90) == tapi.SUCCEEDED
+    log = tcluster.logs("mpireal-launcher-0")
+    assert log.count("MPIRANK") == 2, log
 
 
 def test_mpijob_launcher_hostfile_configmap(tcluster):
