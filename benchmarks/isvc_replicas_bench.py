@@ -1,0 +1,135 @@
+"""Multi-replica serving bench THROUGH the ISVC path (VERDICT r2 #7).
+
+Stands up a llama-format InferenceService with N engine replicas behind the
+service proxy (engine-aware least-loaded routing + prefix affinity), fires a
+closed-loop concurrent generate load at it, and prints ONE JSON line with
+throughput + latency percentiles.  Compare `--replicas 1` vs `--replicas 2`
+on multi-chip hardware; on the 1-CPU simulator box the replicas time-slice
+one core, so the interesting signal there is the routing spread, not the
+wall-clock win.
+
+Usage: python benchmarks/isvc_replicas_bench.py [--replicas 2]
+       [--requests 48] [--concurrency 16] [--max-tokens 16] [--config tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONFIGS = {
+    "tiny": {"vocab_size": 2048, "d_model": 256, "n_layers": 4,
+             "n_heads": 8, "n_kv_heads": 4, "d_ff": 688},
+    "micro": {"vocab_size": 64, "d_model": 32, "n_layers": 1,
+              "n_heads": 2, "n_kv_heads": 1, "d_ff": 64},
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--requests", type=int, default=48)
+    p.add_argument("--concurrency", type=int, default=16)
+    p.add_argument("--max-tokens", type=int, default=16)
+    p.add_argument("--config", default="tiny", choices=sorted(CONFIGS))
+    args = p.parse_args()
+
+    from kubeflow_tpu.core.cluster import Cluster
+    from kubeflow_tpu.serving import install
+    from kubeflow_tpu.serving.api import inference_service
+
+    workdir = tempfile.mkdtemp(prefix="isvc-bench-")
+    model_dir = os.path.join(workdir, "llm")
+    os.makedirs(model_dir)
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump(CONFIGS[args.config], f)
+    with open(os.path.join(model_dir, "engine.json"), "w") as f:
+        json.dump({"max_slots": 4, "num_pages": 256, "page_size": 16}, f)
+
+    c = Cluster(cpu_nodes=1, tpu_slices=(("s0", "v5e", "2x2"),),
+                base_env={"PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__)))})
+    router, proxy = install(c.api, c.manager)
+    try:
+        c.apply(inference_service("bench", model_format="llama",
+                                  storage_uri=f"file://{model_dir}",
+                                  min_replicas=args.replicas,
+                                  max_replicas=args.replicas))
+
+        def ready():
+            isvc = c.api.try_get("InferenceService", "bench")
+            st = (isvc or {}).get("status", {})
+            return any(x["type"] == "Ready" and x["status"] == "True"
+                       for x in st.get("conditions", []))
+        assert c.wait_for(ready, timeout=300), "ISVC never became ready"
+        from kubeflow_tpu.serving.controllers import pod_is_ready
+
+        def all_ready():
+            pods = [p for p in c.api.list("Pod")
+                    if p["metadata"]["labels"].get("serving.kubeflow.org/inferenceservice") == "bench"]
+            return len([q for q in pods if pod_is_ready(q)]) == args.replicas
+        assert c.wait_for(all_ready, timeout=120), "replicas never all ready"
+
+        isvc = c.api.get("InferenceService", "bench")
+        port = int(isvc["status"]["address"]["url"].rsplit(":", 1)[1])
+
+        def generate(i: int) -> dict:
+            body = json.dumps({
+                "text_input": f"request {i} " + "lorem ipsum " * 8,
+                "parameters": {"max_tokens": args.max_tokens},
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v2/models/bench/generate",
+                data=body, headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=600) as r:
+                out = json.loads(r.read())
+            out["wall_s"] = time.perf_counter() - t0
+            return out
+
+        # warmup (compile both replicas' prefill/decode)
+        with concurrent.futures.ThreadPoolExecutor(args.replicas * 2) as ex:
+            list(ex.map(generate, range(args.replicas * 2)))
+
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
+            outs = list(ex.map(generate, range(args.requests)))
+        wall = time.perf_counter() - t0
+
+        lat = sorted(o["wall_s"] for o in outs)
+        toks = sum(o["tokens"] for o in outs)
+        from kubeflow_tpu.serving.autoscaler import scrape_metrics
+        from kubeflow_tpu.serving.controllers import pod_port
+        pods = [p for p in c.api.list("Pod")
+                if p["metadata"]["labels"].get("serving.kubeflow.org/inferenceservice") == "bench"]
+        per_replica = {
+            p["metadata"]["name"]: (scrape_metrics(pod_port(p), timeout=1.0) or {}).get("request_count", 0)
+            for p in pods}
+        print(json.dumps({
+            "metric": "isvc_generate_tokens_per_sec",
+            "value": round(toks / wall, 2),
+            "unit": "tokens/s",
+            "vs_baseline": 1.0,
+            "replicas": args.replicas,
+            "requests": args.requests,
+            "concurrency": args.concurrency,
+            "p50_latency_s": round(statistics.median(lat), 3),
+            "p99_latency_s": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+            "per_replica_requests": per_replica,
+            "platform": "cpu" if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") else "unknown",
+        }))
+    finally:
+        proxy.shutdown()
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    main()

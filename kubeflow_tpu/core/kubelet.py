@@ -39,10 +39,16 @@ class _PodRun:
     in_init: bool = False
     main_container: Optional[dict] = None
     # containers[1:] run as sidecars: spawned with the main container, killed
-    # (SIGTERM first, so they can flush) when the main terminates — the k8s
-    # semantics Katib's injected metrics collector relies on
+    # (stop file + SIGTERM, so they can flush) when the main terminates — the
+    # k8s semantics Katib's injected metrics collector relies on
     sidecar_containers: list[dict] = field(default_factory=list)
     sidecars: list[subprocess.Popen] = field(default_factory=list)
+    # main exited; waiting (non-blocking, across sync ticks) for sidecars to
+    # flush before the pod goes terminal
+    draining: bool = False
+    drain_rc: int = 0
+    drain_sigterm_at: float = 0.0
+    drain_deadline: float = 0.0
     log_path: str = ""
     restart_count: int = 0
     next_restart_at: float = 0.0
@@ -121,6 +127,13 @@ class LocalProcessKubelet:
             sidecar_containers=list(spec["containers"][1:]),
         )
         run.log_path = os.path.join(self.logdir, f"{run.namespace}_{run.name}.log")
+        try:
+            # a recreated same-named pod must not see the previous
+            # incarnation's stop signal (sidecars would flush-and-exit at
+            # startup) — nor its log tail
+            os.unlink(run.log_path + ".stop")
+        except OSError:
+            pass
         self._runs[meta["uid"]] = run
         try:
             self._render_volumes(pod, run)
@@ -309,6 +322,9 @@ class LocalProcessKubelet:
             self._runs.pop(run.uid, None)
             return True
 
+        if run.draining:
+            return self._poll_drain(pod, run)
+
         if run.current is None:
             # waiting out a crash-restart backoff
             if time.monotonic() >= run.next_restart_at:
@@ -359,10 +375,47 @@ class LocalProcessKubelet:
             return True
 
         # sidecars flush BEFORE the pod goes terminal: a watcher that sees
-        # Succeeded can rely on sidecar-pushed state (metrics) being complete
-        self._stop_sidecars(run, grace=5.0)
-        self._set_status(run, self._terminated_status(pod, "Succeeded" if rc == 0 else "Failed", rc))
+        # Succeeded can rely on sidecar-pushed state (metrics) being
+        # complete.  The wait is NON-blocking — draining is polled across
+        # sync ticks so a slow sidecar never stalls the whole manager.
         run.current = None
+        if run.sidecars:
+            self._begin_drain(run, rc)
+            return self._poll_drain(pod, run)
+        self._set_status(run, self._terminated_status(pod, "Succeeded" if rc == 0 else "Failed", rc))
+        self._runs.pop(run.uid, None)
+        return True
+
+    _DRAIN_GRACE = 8.0
+
+    def _begin_drain(self, run: _PodRun, rc: int) -> None:
+        run.draining = True
+        run.drain_rc = rc
+        now = time.monotonic()
+        run.drain_sigterm_at = now + self._DRAIN_GRACE / 2
+        run.drain_deadline = now + self._DRAIN_GRACE
+        try:
+            with open(run.log_path + ".stop", "w"):
+                pass
+        except OSError:
+            pass
+
+    def _poll_drain(self, pod: Obj, run: _PodRun) -> bool:
+        now = time.monotonic()
+        alive = [p for p in run.sidecars if p.poll() is None]
+        if alive:
+            # stop-file first; SIGTERM only mid-grace (a sidecar signalled
+            # during interpreter startup dies handler-less, flushing nothing)
+            if now >= run.drain_deadline:
+                self._signal_sidecars(run, signal.SIGKILL)
+            elif now >= run.drain_sigterm_at:
+                self._signal_sidecars(run, signal.SIGTERM)
+            if now < run.drain_deadline:
+                return False
+        run.sidecars.clear()
+        run.draining = False
+        rc = run.drain_rc
+        self._set_status(run, self._terminated_status(pod, "Succeeded" if rc == 0 else "Failed", rc))
         self._runs.pop(run.uid, None)
         return True
 

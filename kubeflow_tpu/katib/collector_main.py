@@ -32,7 +32,9 @@ def _push(addr: str, trial: str, metric: str, value: float) -> None:
         f"http://{addr}/report", data=body,
         headers={"Content-Type": "application/json"}, method="POST",
     )
-    urllib.request.urlopen(req, timeout=5).read()
+    # timeout well under the kubelet's drain grace: the final flush must get
+    # retry attempts in before the pod is force-killed
+    urllib.request.urlopen(req, timeout=2).read()
 
 
 def main() -> int:
@@ -46,22 +48,24 @@ def main() -> int:
 
     offset = 0
 
-    def drain(final: bool) -> None:
+    def drain(final: bool) -> bool:
+        """One tail-parse-push pass; returns False if any push failed (the
+        offset is then NOT advanced, so the next pass retries)."""
         nonlocal offset
         try:
             with open(log_path, "rb") as f:
                 f.seek(offset)
                 chunk = f.read()
         except OSError:
-            return
+            return True
         if not chunk:
-            return
+            return True
         if not final:
             # hold back a trailing partial line until newline-terminated
             # (byte-level cut so the offset stays exact under any encoding)
             cut = chunk.rfind(b"\n")
             if cut < 0:
-                return
+                return True
             chunk = chunk[:cut + 1]
         text = chunk.decode(errors="replace")
         # at-least-once: advance the offset only after EVERY push in the
@@ -78,6 +82,7 @@ def main() -> int:
                     ok = False
         if ok:
             offset += len(chunk)
+        return ok
 
     def stopping() -> bool:
         # SIGTERM can land before the handler above is installed (interpreter
@@ -88,7 +93,13 @@ def main() -> int:
     while not stopping():
         drain(final=False)
         time.sleep(0.2)
-    drain(final=True)  # the pre-terminal flush the kubelet waits for
+    # the pre-terminal flush the kubelet's drain window waits for: retry a
+    # failed pass a couple of times — one transient push failure must not
+    # cost the trial its only objective line
+    for _ in range(3):
+        if drain(final=True):
+            break
+        time.sleep(0.2)
     print("collector: final flush done", flush=True)
     return 0
 

@@ -80,6 +80,7 @@ class ConcurrencyAutoscaler:
         selector = (deploy["spec"].get("selector") or {}).get("matchLabels") or {}
         pods = self.api.list("Pod", namespace=ns, label_selector=selector)
         inflight = 0.0
+        engine_load = 0.0
         ready = 0
         unscraped = 0
         last_traffic = self._last_traffic.get(uid, 0.0)
@@ -97,6 +98,11 @@ class ConcurrencyAutoscaler:
                 unscraped += 1
                 continue
             inflight += m.get("inflight_requests", 0.0)
+            # engine replicas (VERDICT r2 #7): queued + active generation
+            # requests are the true demand — one HTTP predict can carry many
+            # prompts, so HTTP inflight alone under-reports engine backlog
+            engine_load += (m.get("engine_queue_depth", 0.0)
+                            + m.get("engine_active_slots", 0.0))
             last_traffic = max(last_traffic, m.get("last_request_timestamp", 0.0))
         self._last_traffic[uid] = last_traffic
 
@@ -104,7 +110,8 @@ class ConcurrencyAutoscaler:
             return False  # activation is the router's job
 
         now = time.time()
-        desired = math.ceil(inflight / target) if inflight > 0 else 0
+        effective = max(inflight, engine_load)
+        desired = math.ceil(effective / target) if effective > 0 else 0
         desired = max(desired, min_r, 0)
         desired = min(desired, max_r)
 

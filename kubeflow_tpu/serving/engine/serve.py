@@ -92,6 +92,23 @@ class JetStreamModel(Model):
         self.engine.start()
         self.ready = True
 
+    def extra_metrics(self) -> dict:
+        """Per-replica engine state for the router's least-loaded pick and
+        the autoscaler's backlog signal (SURVEY.md §3.4 production QPS)."""
+        if self.engine is None:
+            return {}
+        try:
+            s = self.engine.stats
+        except RuntimeError:  # engine stopped
+            return {}
+        return {
+            "engine_active_slots": s["active_slots"],
+            "engine_queue_depth": s["queue_depth"],
+            "engine_free_pages": s["free_pages"],
+            "engine_cached_pages": s["cached_pages"],
+            "engine_page_hits": s["page_hits"],
+        }
+
     def _parse_generate(self, payload: Any) -> tuple[list[int], int]:
         prompt = payload.get("text_input", "") if isinstance(payload, dict) else str(payload)
         max_tokens = int((payload.get("parameters") or {}).get("max_tokens", 32)) \

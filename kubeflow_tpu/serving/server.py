@@ -48,6 +48,13 @@ class Model:
     def explain(self, payload: Any, headers: Optional[dict] = None) -> Any:
         raise NotImplementedError(f"model {self.name} has no explainer")
 
+    def extra_metrics(self) -> dict:
+        """Numeric gauges merged into the server's /metrics output — engine
+        models report queue/slot/cache state here so the router can route
+        least-loaded and the autoscaler can see backlog (not just HTTP
+        inflight)."""
+        return {}
+
     def __call__(self, payload: Any, headers: Optional[dict] = None, verb: str = "predict") -> Any:
         x = self.preprocess(payload, headers)
         y = self.explain(x, headers) if verb == "explain" else self.predict(x, headers)
@@ -147,7 +154,14 @@ class ModelServer:
     def _handle_get(self, h) -> None:
         path = h.path.split("?")[0].rstrip("/")
         if path == "/metrics":
-            h._send(200, self.metrics.render(), content_type="text/plain")
+            text = self.metrics.render()
+            extra: dict = {}
+            for m in self.models.values():
+                for k, v in m.extra_metrics().items():
+                    extra[k] = extra.get(k, 0.0) + float(v)
+            for k in sorted(extra):
+                text += f"# TYPE {k} gauge\n{k} {extra[k]}\n"
+            h._send(200, text, content_type="text/plain")
         elif path in ("", "/", "/healthz", "/v2/health/live"):
             h._send(200, {"status": "alive"})
         elif path == "/v2/health/ready":
