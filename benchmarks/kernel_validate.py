@@ -1,0 +1,189 @@
+"""Staged on-chip validation of the Pallas kernels (VERDICT r2 #2).
+
+The r2 tunnel wedge ("a tiny flash-attention kernel hung >7 min and every
+later device touch hung too") was never root-caused: tunnel bug vs kernel
+bug.  This harness bisects it — each stage is ONE device-touching step, run
+as ``python benchmarks/kernel_validate.py STAGE`` so the caller (or
+``--all`` mode, which forks a killable subprocess per stage) can attribute
+a hang to an exact compile.
+
+Stages, smallest first:
+  trivial     1-block elementwise pallas kernel (Mosaic compile path at all)
+  flash1      flash forward, single block (bh=1, s=128, d=64)
+  flash_bert  flash fwd+bwd at the BERT bench shape vs dense reference
+  flash_mask  masked flash fwd+bwd vs masked dense
+  paged       paged-attention decode kernel vs gather reference
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STAGES = ["trivial", "flash1", "flash_bert", "flash_mask", "paged"]
+
+
+def _stage_trivial():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1.0
+
+    x = jnp.ones((8, 128), jnp.float32)
+    out = pl.pallas_call(
+        kern, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+    assert float(out[0, 0]) == 2.0
+    return {"ok": True}
+
+
+def _stage_flash1():
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.ops.flash_attention import flash_attention
+
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (1, 128, 1, 64), jnp.bfloat16)
+    out = flash_attention(q, q, q, interpret=False)
+    out.block_until_ready()
+    return {"ok": True, "shape": list(out.shape)}
+
+
+def _flash_vs_dense(masked: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.ops.attention import multihead_attention, padding_mask
+    from kubeflow_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, d = 8, 128, 12, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+    if masked:
+        lengths = jax.random.randint(ks[3], (b,), 32, s + 1)
+        mask = (jnp.arange(s)[None, :] < lengths[:, None]).astype(jnp.float32)
+    else:
+        mask = None
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, interpret=False, kv_mask=mask).sum()
+
+    def f_dense(q, k, v):
+        m = None if mask is None else padding_mask(mask)
+        return multihead_attention(q, k, v, mask=m).sum()
+
+    t0 = time.perf_counter()
+    lf, gf = jax.jit(jax.value_and_grad(f_flash, argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(gf)
+    compile_s = time.perf_counter() - t0
+    ld, gd = jax.jit(jax.value_and_grad(f_dense, argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(gd)
+    err = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(gf, gd))
+    lerr = abs(float(lf) - float(ld)) / max(abs(float(ld)), 1e-9)
+    assert lerr < 2e-3, f"loss mismatch {lerr}"
+    assert err < 2e-2, f"grad mismatch {err}"
+    return {"ok": True, "grad_err": round(err, 5), "loss_relerr": round(lerr, 7),
+            "compile_s": round(compile_s, 1)}
+
+
+def _stage_paged():
+    """Mirror tests/test_engine.py::test_paged_attention_kernel_matches_reference
+    but with interpret=False — the compiled Mosaic kernel on the chip."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.serving.engine.paged_attention import paged_decode_attention
+
+    rng = np.random.default_rng(0)
+    B, Hq, Hkv, hd, ps, NP, max_pages = 3, 4, 2, 16, 8, 12, 3
+    q = jnp.asarray(rng.standard_normal((B, Hq, hd)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((NP, ps, Hkv, hd)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((NP, ps, Hkv, hd)), jnp.float32)
+    page_table = jnp.asarray([[3, 5, 7], [1, 2, 0], [0, 0, 0]], jnp.int32)
+    seq_lens = jnp.asarray([20, 9, 0], jnp.int32)
+    out = np.asarray(paged_decode_attention(q, k_pool, v_pool, page_table,
+                                            seq_lens, ps, interpret=False))
+    group = Hq // Hkv
+    T = max_pages * ps
+    worst = 0.0
+    for b in range(B):
+        kc = np.asarray(k_pool)[np.asarray(page_table)[b]].reshape(T, Hkv, hd)
+        vc = np.asarray(v_pool)[np.asarray(page_table)[b]].reshape(T, Hkv, hd)
+        for h in range(Hq):
+            kv_h = h // group
+            logits = np.asarray(q)[b, h] @ kc[:, kv_h].T / np.sqrt(hd)
+            m = np.arange(T) < int(seq_lens[b])
+            if not m.any():
+                ref = np.zeros(hd)
+            else:
+                e = np.exp(logits[m] - logits[m].max())
+                ref = (e / e.sum()) @ vc[m, kv_h]
+            worst = max(worst, float(np.abs(out[b, h] - ref).max()))
+    assert worst < 2e-3, f"paged mismatch {worst}"
+    return {"ok": True, "err": round(worst, 6)}
+
+
+def run_stage(name: str) -> dict:
+    import jax
+    fn = {"trivial": _stage_trivial, "flash1": _stage_flash1,
+          "flash_bert": functools.partial(_flash_vs_dense, False),
+          "flash_mask": functools.partial(_flash_vs_dense, True),
+          "paged": _stage_paged}[name]
+    t0 = time.perf_counter()
+    rec = fn()
+    rec.update(stage=name, wall_s=round(time.perf_counter() - t0, 1),
+               platform=jax.devices()[0].platform)
+    return rec
+
+
+def main() -> None:
+    if sys.argv[1:] and sys.argv[1] != "--all":
+        print(json.dumps(run_stage(sys.argv[1])))
+        return
+    # --all: one killable subprocess per stage; a hang burns only its timeout
+    timeout_s = float(os.environ.get("KV_STAGE_TIMEOUT_S", "420"))
+    env = dict(os.environ)
+    parts = [REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+    results = []
+    for stage in STAGES:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), stage],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, start_new_session=True)
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+            if proc.returncode == 0:
+                results.append(json.loads(out.strip().splitlines()[-1]))
+            else:
+                tail = (err or "").strip().splitlines()[-1:] or ["?"]
+                results.append({"stage": stage, "ok": False, "error": tail[0][:300]})
+        except subprocess.TimeoutExpired:
+            import signal
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.communicate()
+            results.append({"stage": stage, "ok": False,
+                            "error": f"timeout after {timeout_s:.0f}s"})
+        print(json.dumps(results[-1]), flush=True)
+        if not results[-1].get("ok"):
+            # later stages share the tunnel a hang may have wedged — stop so
+            # the failure attribution stays exact
+            break
+    print(json.dumps({"stages": results,
+                      "all_ok": all(r.get("ok") for r in results)}))
+
+
+if __name__ == "__main__":
+    main()

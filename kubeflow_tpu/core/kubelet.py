@@ -50,6 +50,12 @@ class _PodRun:
     drain_sigterm_at: float = 0.0
     drain_deadline: float = 0.0
     log_path: str = ""
+    # uid-scoped stop-file path: the log path is NAME-scoped (user-visible,
+    # stable across recreates) but the stop signal must die with the run — a
+    # reaped old incarnation's _stop_sidecars would otherwise re-create a
+    # name-scoped stop file AFTER the recreated pod started, and the new
+    # pod's sidecars would flush-and-exit at startup
+    stop_path: str = ""
     restart_count: int = 0
     next_restart_at: float = 0.0
     terminating: bool = False
@@ -127,13 +133,17 @@ class LocalProcessKubelet:
             sidecar_containers=list(spec["containers"][1:]),
         )
         run.log_path = os.path.join(self.logdir, f"{run.namespace}_{run.name}.log")
-        try:
-            # a recreated same-named pod must not see the previous
-            # incarnation's stop signal (sidecars would flush-and-exit at
-            # startup) — nor its log tail
-            os.unlink(run.log_path + ".stop")
-        except OSError:
-            pass
+        run.stop_path = run.log_path + f".{run.uid}.stop"
+        # a recreated same-named pod must not see the previous incarnation's
+        # log tail (a fresh metrics collector starts at offset 0 and would
+        # re-push the old run's objective values into the new trial); stale
+        # stop files are uid-scoped litter from reaped runs
+        import glob as _glob
+        for stale in [run.log_path] + _glob.glob(run.log_path + ".*.stop"):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
         self._runs[meta["uid"]] = run
         try:
             self._render_volumes(pod, run)
@@ -209,7 +219,7 @@ class LocalProcessKubelet:
         # POD_STOP_FILE appears when the pod is shutting down — the
         # race-free companion to the SIGTERM sidecars also receive.
         env["POD_LOG_PATH"] = run.log_path
-        env["POD_STOP_FILE"] = run.log_path + ".stop"
+        env["POD_STOP_FILE"] = run.stop_path
         # k8s dependent-env semantics: $(VAR) in a value resolves against the
         # base env plus PREVIOUSLY-declared container vars only — forward
         # references stay verbatim, exactly like a real kubelet
@@ -274,7 +284,7 @@ class LocalProcessKubelet:
         if not run.sidecars:
             return
         try:
-            with open(run.log_path + ".stop", "w"):
+            with open(run.stop_path, "w"):
                 pass
         except OSError:
             pass
@@ -395,7 +405,7 @@ class LocalProcessKubelet:
         run.drain_sigterm_at = now + self._DRAIN_GRACE / 2
         run.drain_deadline = now + self._DRAIN_GRACE
         try:
-            with open(run.log_path + ".stop", "w"):
+            with open(run.stop_path, "w"):
                 pass
         except OSError:
             pass

@@ -52,8 +52,17 @@ class _ProxyState:
         # engine-aware routing: port -> (scraped_at, load) with a short TTL,
         # plus in-flight deltas so back-to-back requests don't pile onto the
         # replica whose scrape is momentarily stale
-        self.loads: dict[int, tuple[float, float]] = {}
+        # port -> (scraped_at, load | None): None = negative cache (replica
+        # unreachable at scraped_at) so back-to-back requests don't re-eat
+        # the scrape timeout inline until the TTL expires
+        self.loads: dict[int, tuple[float, Optional[float]]] = {}
         self.pending: dict[int, int] = {}
+        # ports some thread is currently scraping OUTSIDE the lock — other
+        # threads must not block on (or duplicate) that network call
+        self.refreshing: set[int] = set()
+        # backends expose no engine gauges (non-engine runtime): cached so
+        # plain round-robin services don't pay per-request scrape sweeps
+        self.engineless_until = 0.0
         self.lock = threading.Lock()
 
 
@@ -177,43 +186,73 @@ class ServiceProxy:
     # of the minimum, where the shared-prefix KV cache beats perfect
     # balance.
     _LOAD_TTL = 0.25
+    _ENGINELESS_TTL = 2.0
     _AFFINITY_SLACK = 1.0
 
     def _pick_engine_aware(self, state: _ProxyState, ports: list[int],
                            body: Optional[bytes]) -> Optional[int]:
         from .autoscaler import scrape_metrics
 
-        # single-flight refresh: concurrent handlers serialize on the state
-        # lock so an expired TTL triggers ONE scrape sweep, not one per
-        # thread; replicas whose scrape fails are excluded for this pick
-        # (mid-compile/overloaded — exactly who shouldn't get the request)
-        # rather than discarding the sweep.  A replica set with no engine
-        # gauges at all falls back to plain round-robin.
-        loads: dict[int, float] = {}
-        engineless = False
+        # Scrapes are blocking HTTP calls, so they must happen OUTSIDE the
+        # state lock — with one replica unresponsive (mid-compile), a scrape
+        # under the lock would stall every concurrent handler thread behind
+        # it.  Single-flight per port: a thread claims expired ports via
+        # state.refreshing, scrapes them unlocked, and writes results back;
+        # other threads use the last-known load (even if past TTL) instead
+        # of waiting.  Replicas whose scrape fails are excluded for this
+        # pick (overloaded — exactly who shouldn't get the request); a
+        # replica set with no engine gauges at all falls back to round-robin.
+        claimed: list[int] = []
         with state.lock:
             now = time.monotonic()
+            if now < state.engineless_until:
+                return None  # known non-engine backends: plain round-robin
             for port in ports:
                 ts_load = state.loads.get(port)
-                if ts_load is not None and now - ts_load[0] < self._LOAD_TTL:
-                    loads[port] = ts_load[1] + state.pending.get(port, 0)
-                    continue
-                m = scrape_metrics(port, timeout=0.1)
-                if m is None:
-                    continue  # unreachable right now: skip this replica
-                if "engine_queue_depth" not in m:
-                    engineless = True
-                    break
-                load = m["engine_queue_depth"] + m.get("engine_active_slots", 0.0)
-                state.loads[port] = (now, load)
-                state.pending[port] = 0
-                loads[port] = load
-            if engineless or not loads:
-                return None  # round-robin fallback
+                if ((ts_load is None or now - ts_load[0] >= self._LOAD_TTL)
+                        and port not in state.refreshing):
+                    state.refreshing.add(port)
+                    claimed.append(port)
+        scraped: dict[int, Optional[dict]] = {}
+        engineless = False
+        try:
+            for port in claimed:
+                scraped[port] = scrape_metrics(port, timeout=0.1)
+        finally:
+            # claimed ports MUST leave `refreshing` even on an unexpected
+            # scrape exception, or they would never be scraped again
+            with state.lock:
+                now = time.monotonic()
+                for port in claimed:
+                    state.refreshing.discard(port)
+                    m = scraped.get(port)
+                    if m is None:
+                        # negative cache: unreachable replicas are excluded
+                        # from picks but NOT re-scraped until the TTL lapses
+                        state.loads[port] = (now, None)
+                        continue
+                    if "engine_queue_depth" not in m:
+                        engineless = True
+                        continue
+                    load = (m["engine_queue_depth"]
+                            + m.get("engine_active_slots", 0.0))
+                    state.loads[port] = (now, load)
+                    state.pending[port] = 0
+                if engineless:
+                    state.engineless_until = now + self._ENGINELESS_TTL
+        if engineless:
+            return None  # round-robin fallback
+        with state.lock:
+            loads = {p: state.loads[p][1] + state.pending.get(p, 0)
+                     for p in ports
+                     if p in state.loads and state.loads[p][1] is not None}
+            if not loads:
+                return None
             candidates = sorted(loads)
             best = min(candidates, key=lambda p: (loads[p], p))
             affinity = self._affinity_port(candidates, body)
-            if affinity is not None and loads[affinity] <= loads[best] + self._AFFINITY_SLACK:
+            if (affinity is not None
+                    and loads[affinity] <= loads[best] + self._AFFINITY_SLACK):
                 best = affinity
             state.pending[best] = state.pending.get(best, 0) + 1
             return best
