@@ -20,11 +20,12 @@ from __future__ import annotations
 import functools
 import json
 import os
-import subprocess
 import sys
 import time
+from typing import Optional
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 STAGES = ["trivial", "flash1", "flash_bert", "flash_mask", "paged"]
 
 
@@ -145,37 +146,41 @@ def run_stage(name: str) -> dict:
     return rec
 
 
+def _last_json_line(out: str) -> Optional[dict]:
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
 def main() -> None:
     if sys.argv[1:] and sys.argv[1] != "--all":
         print(json.dumps(run_stage(sys.argv[1])))
         return
-    # --all: one killable subprocess per stage; a hang burns only its timeout
+    # --all: one killable subprocess per stage via bench.py's process-group
+    # sandbox; a hang burns only its own timeout
+    from bench import _run, _sweep_env
+
     timeout_s = float(os.environ.get("KV_STAGE_TIMEOUT_S", "420"))
-    env = dict(os.environ)
-    parts = [REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
-    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
     results = []
     for stage in STAGES:
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), stage],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, start_new_session=True)
-        try:
-            out, err = proc.communicate(timeout=timeout_s)
-            if proc.returncode == 0:
-                results.append(json.loads(out.strip().splitlines()[-1]))
-            else:
-                tail = (err or "").strip().splitlines()[-1:] or ["?"]
-                results.append({"stage": stage, "ok": False, "error": tail[0][:300]})
-        except subprocess.TimeoutExpired:
-            import signal
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                proc.kill()
-            proc.communicate()
+        rc, out, err = _run([sys.executable, os.path.abspath(__file__), stage],
+                            timeout_s, _sweep_env())
+        if rc is None:
             results.append({"stage": stage, "ok": False,
                             "error": f"timeout after {timeout_s:.0f}s"})
+        elif rc == 0:
+            # libtpu banners etc. may trail the JSON — scan backwards for
+            # the last parseable line rather than trusting [-1]
+            rec = _last_json_line(out)
+            results.append(rec if rec is not None else
+                           {"stage": stage, "ok": False,
+                            "error": "no JSON line in stage stdout"})
+        else:
+            tail = (err or "").strip().splitlines()[-1:] or ["?"]
+            results.append({"stage": stage, "ok": False, "error": tail[0][:300]})
         print(json.dumps(results[-1]), flush=True)
         if not results[-1].get("ok"):
             # later stages share the tunnel a hang may have wedged — stop so

@@ -202,7 +202,7 @@ class ServiceProxy:
         # of waiting.  Replicas whose scrape fails are excluded for this
         # pick (overloaded — exactly who shouldn't get the request); a
         # replica set with no engine gauges at all falls back to round-robin.
-        claimed: list[int] = []
+        claimed: dict[int, int] = {}  # port -> pending count at claim time
         with state.lock:
             now = time.monotonic()
             if now < state.engineless_until:
@@ -212,7 +212,7 @@ class ServiceProxy:
                 if ((ts_load is None or now - ts_load[0] >= self._LOAD_TTL)
                         and port not in state.refreshing):
                     state.refreshing.add(port)
-                    claimed.append(port)
+                    claimed[port] = state.pending.get(port, 0)
         scraped: dict[int, Optional[dict]] = {}
         engineless = False
         try:
@@ -237,7 +237,12 @@ class ServiceProxy:
                     load = (m["engine_queue_depth"]
                             + m.get("engine_active_slots", 0.0))
                     state.loads[port] = (now, load)
-                    state.pending[port] = 0
+                    # subtract the snapshot, don't zero: picks that landed on
+                    # this port WHILE the scrape ran are in neither the
+                    # scraped gauges nor (after a reset) pending — zeroing
+                    # would undercount the burst and pile more onto it
+                    state.pending[port] = max(
+                        0, state.pending.get(port, 0) - claimed[port])
                 if engineless:
                     state.engineless_until = now + self._ENGINELESS_TTL
         if engineless:

@@ -16,10 +16,22 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_NUM_CPU_DEVICES"] = "8"
 
+# persistent XLA compile cache: the fast lane is compile-dominated (measured
+# 562s cold vs ~1/3 of that warm on this 1-CPU box — VERDICT r1 #10's <300s
+# budget is unreachable without it).  Repo-local dir, gitignored; subprocess
+# pods inherit it via the env var and share the same cache.
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
 
 import pytest  # noqa: E402
 

@@ -56,8 +56,8 @@ def test_flash_grads_match_dense(qkv):
     def loss_ref(q, k, v):
         return jnp.sum(multihead_attention(q, k, v, causal=True) ** 2)
 
-    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
-    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
 
@@ -107,8 +107,8 @@ def test_flash_with_padding_mask_grads_match_dense(qkv):
         o = multihead_attention(q, k, v, mask=padding_mask(am))
         return jnp.sum((o * w) ** 2)
 
-    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
-    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
 
@@ -134,7 +134,9 @@ def test_bert_flash_with_mask_matches_dense_loss():
     def loss(cfg):
         def f(p):
             return bert.mlm_loss(p, cfg, ids, labels, am, max_predictions=10)
-        return jax.value_and_grad(f)(params)
+        # jit so the interpret-mode pallas kernel traces ONCE (eager would
+        # re-interpret per op) and the persistent compile cache holds it
+        return jax.jit(jax.value_and_grad(f))(params)
 
     ld, gd = loss(cfg_d)
     lf, gf = loss(cfg_f)
@@ -168,7 +170,7 @@ def test_ring_attention_grads_flow(qkv, seq_mesh):
         return jnp.sum(multihead_attention(a, b, c, causal=True) ** 2)
 
     g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(qs, ks, vs)
-    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
     for a, b in zip(g, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
 
@@ -238,6 +240,6 @@ def test_moe_grads_flow():
         out, aux = moe_ffn(p, x, cfg, shard=False)
         return jnp.sum(out ** 2) + 0.01 * aux["load_balance_loss"]
 
-    g = jax.grad(loss)(params)
+    g = jax.jit(jax.grad(loss))(params)
     assert float(jnp.abs(g["router"]).sum()) > 0
     assert float(jnp.abs(g["wi"].astype(jnp.float32)).sum()) > 0

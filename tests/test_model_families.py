@@ -138,8 +138,14 @@ def test_decoder_presets():
 # --------------------------------------------------------- gemma pipeline e2e
 
 
+@pytest.mark.slow
 def test_gemma_pipeline_e2e(cluster):
-    """BASELINE config[4] at CI scale: finetune -> eval -> gated deploy."""
+    """BASELINE config[4] at CI scale: finetune -> eval -> gated deploy.
+
+    Slow lane: ~17s even cache-warm (three real pipeline-step pods).  The
+    fast lane keeps the same machinery covered via the tiny-pipeline E2Es in
+    test_pipelines.py and decoder-training coverage in this file; the bench
+    harness (benchmarks/baseline_configs.py gemma) exercises this exact DAG."""
     from kubeflow_tpu.examples.gemma_pipeline import gemma_pipeline
     from kubeflow_tpu.pipelines import api as papi
     from kubeflow_tpu.pipelines.client import Client

@@ -142,3 +142,33 @@ def test_checkpoint_save_restore(tmp_path):
     assert abs(ref - got) < 1e-6
     t1._ckpt.close()
     t2._ckpt.close()
+
+
+@pytest.mark.parametrize("policy", ["nothing", "dots", "save_qkv", "save_attn", "save_mlp"])
+def test_remat_policies_match_no_remat(policy):
+    """Every remat policy is a pure memory/FLOPs trade: loss AND grads must
+    equal the remat=False graph bit-for-bit-ish.  Guards the bench levers
+    (benchmarks/mfu_sweep.py POLICY axis) — a policy that silently changed
+    numerics would 'win' the MFU sweep with a wrong model."""
+    cfg_plain = TINY
+    cfg_remat = bert.BertConfig(
+        vocab_size=TINY.vocab_size, hidden_size=TINY.hidden_size,
+        num_layers=TINY.num_layers, num_heads=TINY.num_heads,
+        intermediate_size=TINY.intermediate_size, max_position=TINY.max_position,
+        remat=True, remat_policy=policy,
+    )
+    params = bert.init(jax.random.PRNGKey(3), cfg_plain)
+    ids = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, TINY.vocab_size)
+    labels = ids.at[:, ::3].set(-100)
+
+    def loss_with(cfg):
+        def f(p):
+            return bert.mlm_loss(p, cfg, ids, labels)
+        return jax.jit(jax.value_and_grad(f))(params)
+
+    l0, g0 = loss_with(cfg_plain)
+    l1, g1 = loss_with(cfg_remat)
+    assert abs(float(l0) - float(l1)) < 1e-5
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=1e-4)

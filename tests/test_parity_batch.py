@@ -109,7 +109,7 @@ def test_medianstop_early_stops_bad_trials(kcluster):
         objective_metric="accuracy",
         objective_type="maximize",
         algorithm="grid",
-        max_trials=6,
+        max_trials=4,  # 2 baselines for the median + 2 early-stop candidates
         parallel_trials=2,
     )
     spec["spec"]["earlyStopping"] = {
