@@ -91,16 +91,23 @@ def _run(cmd, timeout_s: float, env: dict):
     return proc.returncode, out or "", err or ""
 
 
-def _parse_sweep_output(stdout: str):
-    """Last JSON line with the sweep's result key, or None."""
+def last_json_line(stdout: str, require_key: str | None = None):
+    """Last parseable JSON stdout line (banner-tolerant), optionally
+    required to carry ``require_key``.  Shared by kernel_validate and
+    chip_opportunist — keep the one copy here."""
     for line in reversed((stdout or "").strip().splitlines()):
         try:
             rec = json.loads(line)
         except ValueError:
             continue
-        if "samples_per_sec_per_chip" in rec:
+        if require_key is None or require_key in rec:
             return rec
     return None
+
+
+def _parse_sweep_output(stdout: str):
+    """Last JSON line with the sweep's result key, or None."""
+    return last_json_line(stdout, "samples_per_sec_per_chip")
 
 
 def _run_candidate(cand, n_chips: int, timeout_s: float):

@@ -26,7 +26,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from bench import _run, _sweep_env, _tpu_preflight  # noqa: E402  (same harness)
+from bench import _run, _sweep_env, _tpu_preflight, last_json_line  # noqa: E402  (same harness)
 
 PROBE_EVERY_S = float(os.environ.get("CHIP_PROBE_EVERY_S", "600"))
 MAX_ATTEMPTS = 3
@@ -47,6 +47,13 @@ JOBS = [
                          os.path.join(REPO, "benchmarks", "serving_bench.py"),
                          "--config", "1b", "--kv-quant", "int8",
                          "--requests", "64", "--concurrency", "8"], 1500),
+    # biggest-model-that-fits (VERDICT r2 #4): int8 weights halve 8B params
+    # to ~8GB, leaving HBM for the int8 KV pool on one 16GB v5e
+    ("serving_8b_int8w", [sys.executable,
+                          os.path.join(REPO, "benchmarks", "serving_bench.py"),
+                          "--config", "llama3_8b", "--weight-quant", "int8",
+                          "--kv-quant", "int8", "--requests", "24",
+                          "--concurrency", "4", "--max-tokens", "32"], 2400),
 ]
 
 
@@ -73,7 +80,6 @@ def _record(name: str, rec: dict) -> None:
 
 def drain_queue(state: dict) -> bool:
     """Run every still-pending job; True if all jobs are done."""
-    all_done = True
     for name, cmd, timeout_s in JOBS:
         st = state.get(name, {})
         if st.get("done"):
@@ -93,23 +99,15 @@ def drain_queue(state: dict) -> bool:
         wall = round(time.monotonic() - t0, 1)
         if rc == 0:
             st["done"] = True
-            # keep the last JSON-looking stdout line as the payload
-            payload = {}
-            for line in reversed((out or "").strip().splitlines()):
-                try:
-                    payload = json.loads(line)
-                    break
-                except ValueError:
-                    continue
-            _record(name, {"ok": True, "wall_s": wall, "result": payload})
+            _record(name, {"ok": True, "wall_s": wall,
+                           "result": last_json_line(out) or {}})
         else:
             tail = (err or "").strip().splitlines()[-1:] or ["?"]
             _record(name, {"ok": False, "wall_s": wall,
                            "rc": rc, "error": tail[0][:300],
                            "timeout": rc is None})
-            all_done = False
         _save_state(state)
-    return all_done and all(state.get(n, {}).get("done") for n, _, _ in JOBS)
+    return all(state.get(n, {}).get("done") for n, _, _ in JOBS)
 
 
 def main() -> None:

@@ -22,7 +22,7 @@ import json
 import os
 import sys
 import time
-from typing import Optional
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -146,22 +146,13 @@ def run_stage(name: str) -> dict:
     return rec
 
 
-def _last_json_line(out: str) -> Optional[dict]:
-    for line in reversed((out or "").strip().splitlines()):
-        try:
-            return json.loads(line)
-        except ValueError:
-            continue
-    return None
-
-
 def main() -> None:
     if sys.argv[1:] and sys.argv[1] != "--all":
         print(json.dumps(run_stage(sys.argv[1])))
         return
     # --all: one killable subprocess per stage via bench.py's process-group
     # sandbox; a hang burns only its own timeout
-    from bench import _run, _sweep_env
+    from bench import _run, _sweep_env, last_json_line
 
     timeout_s = float(os.environ.get("KV_STAGE_TIMEOUT_S", "420"))
     results = []
@@ -174,7 +165,7 @@ def main() -> None:
         elif rc == 0:
             # libtpu banners etc. may trail the JSON — scan backwards for
             # the last parseable line rather than trusting [-1]
-            rec = _last_json_line(out)
+            rec = last_json_line(out)
             results.append(rec if rec is not None else
                            {"stage": stage, "ok": False,
                             "error": "no JSON line in stage stdout"})

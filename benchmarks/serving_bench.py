@@ -53,6 +53,9 @@ def main() -> None:
                    help="use the Pallas paged-attention decode path")
     p.add_argument("--kv-quant", default=None, choices=[None, "int8"],
                    help="int8 KV-cache quantization (~2x servable context)")
+    p.add_argument("--weight-quant", default=None, choices=[None, "int8"],
+                   help="int8 weight-only quantization (halves param HBM — "
+                        "the 8B-on-one-v5e setting)")
     p.add_argument("--speculative", default=None, choices=[None, "prompt_lookup"],
                    help="prompt-lookup speculative decoding (lossless greedy)")
     p.add_argument("--shared-prefix-frac", type=float, default=0.0,
@@ -73,14 +76,22 @@ def main() -> None:
 
     config = configs()[args.config]
     on_tpu = jax.devices()[0].platform == "tpu"
-    params = init(jax.random.PRNGKey(0), config)
+    if args.weight_quant == "int8":
+        # init straight to int8 on the host — llama3-8b's dense bf16 init
+        # (16GB + f32 transients) would OOM the chip before quantization
+        from kubeflow_tpu.serving.engine.model import init_int8
+
+        params = init_int8(jax.random.PRNGKey(0), config)
+    else:
+        params = init(jax.random.PRNGKey(0), config)
     engine = Engine(
         params, config,
         EngineConfig(max_slots=args.concurrency, num_pages=1024, page_size=32,
                      max_pages_per_slot=(4 * args.prompt_len + args.max_tokens) // 32 + 2,
                      tensor_parallel=args.tensor_parallel,
                      paged_kernel=args.paged_kernel or None,
-                     kv_quant=args.kv_quant, speculative=args.speculative),
+                     kv_quant=args.kv_quant, weight_quant=args.weight_quant,
+                     speculative=args.speculative),
     )
     engine.start()
     rng = np.random.default_rng(0)
@@ -132,6 +143,7 @@ def main() -> None:
         "long_prompt_frac": args.long_prompt_frac,
         "paged_kernel": engine._paged,
         "kv_quant": engine._kv_quant,
+        "weight_quant": engine._weight_quant,
         "speculative": engine._spec,
         "long_requests": len(long_idx),
         "shared_prefix_frac": args.shared_prefix_frac,

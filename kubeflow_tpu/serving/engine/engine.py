@@ -60,6 +60,10 @@ class EngineConfig:
     # scales (~52% of the bf16 bytes — near-double servable context); None
     # defers to the ENGINE_KV_QUANT env var.
     kv_quant: Optional[str] = None
+    # weight-only quantization: "int8" halves at-rest param HBM (per-output
+    # scales, dequant fused into each matmul) — Llama-8B-class weights fit a
+    # single 16GB v5e next to the KV pool.  None defers to ENGINE_WEIGHT_QUANT.
+    weight_quant: Optional[str] = None
     # speculative decoding: "prompt_lookup" drafts the continuation of the
     # last n-gram's previous occurrence in the context and verifies up to
     # spec_max_draft tokens in ONE decode pass (lossless under greedy —
@@ -130,6 +134,21 @@ class Engine:
                        else os.environ.get("ENGINE_PAGED_KERNEL") == "1")
         self._kv_quant = (engine_config.kv_quant if engine_config.kv_quant is not None
                           else os.environ.get("ENGINE_KV_QUANT") or None)
+        wq = (engine_config.weight_quant if engine_config.weight_quant is not None
+              else os.environ.get("ENGINE_WEIGHT_QUANT") or None)
+        if wq not in (None, "int8"):
+            raise ValueError(f"unsupported weight_quant {wq!r}")
+        if wq == "int8":
+            from .model import quantize_weights_int8
+
+            # host-side, chunked (numpy leaves out) — the dense model never
+            # hits the accelerator; quantize BEFORE TP sharding so each chip
+            # receives int8 shards.  Single-chip placement happens below once
+            # (TP placement is shard_params' job).
+            self.params = quantize_weights_int8(self.params)
+            if engine_config.tensor_parallel <= 1:
+                self.params = jax.device_put(self.params)
+        self._weight_quant = wq
         self._spec = (engine_config.speculative if engine_config.speculative is not None
                       else os.environ.get("ENGINE_SPECULATIVE") or None)
         if self._spec is not None and self._spec != "prompt_lookup":

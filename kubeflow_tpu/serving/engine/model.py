@@ -105,6 +105,116 @@ def load_params(model_dir: str, config: DecoderConfig):
     return init(jax.random.PRNGKey(0), config)
 
 
+# ------------------------------------------------- int8 weight quantization
+#
+# Weight-only int8: each matmul weight becomes {"q": int8, "s": bf16 scales}
+# with one scale per OUTPUT channel (the contraction axis is reduced over, so
+# per-output scaling keeps the matmul exact up to int8 rounding).  At-rest
+# HBM halves — the lever that fits Llama-3-8B-class weights (16GB bf16) on
+# one 16GB v5e next to a KV pool.  Dequant (`q.astype(bf16) * s`) happens
+# inside jit at each use; XLA fuses the convert+scale into the consumer
+# matmul's operand read, so no dense bf16 copy of a weight ever lands in HBM.
+
+_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3", "embed", "unembed")
+
+
+def _quant_cols_f32(blk: "np.ndarray"):
+    """Quantize one f32 column block host-side: per-output-channel scales
+    (axis -2 is the contraction/row axis in this layout)."""
+    import ml_dtypes
+
+    s = np.maximum(np.abs(blk).max(axis=-2, keepdims=True), 1e-8) / 127.0
+    q = np.clip(np.round(blk / s), -127, 127).astype(np.int8)
+    return q, s.astype(ml_dtypes.bfloat16)
+
+
+def quantize_weights_int8(params: dict, col_chunk: int = 2048) -> dict:
+    """Matmul/embedding weights → {"q": int8, "s": bf16} (norms stay dense).
+
+    Runs HOST-side in numpy, chunked over output columns (scales are
+    per-output-channel, so column blocks quantize independently): the peak
+    transient is one f32 block, never a dense f32 copy of the model — a
+    16GB llama3-8b quantizes without ever existing in bf16 on the device.
+    Leaves come back numpy-backed; the engine device_puts (or TP-shards)
+    them, which is the FIRST time the int8 tree touches an accelerator."""
+    out = {}
+    for name, w in params.items():
+        if name not in _QUANT_KEYS or isinstance(w, dict):
+            out[name] = w
+            continue
+        wn = np.asarray(w)
+        qs = []
+        for lo in range(0, wn.shape[-1], col_chunk):
+            qs.append(_quant_cols_f32(
+                wn[..., lo:lo + col_chunk].astype(np.float32)))
+        out[name] = {"q": np.concatenate([a for a, _ in qs], axis=-1),
+                     "s": np.concatenate([b for _, b in qs], axis=-1)}
+    return out
+
+
+def init_int8(key: jax.Array, config: DecoderConfig) -> dict:
+    """Random-init DIRECTLY into int8 weights, one layer/column-block at a
+    time on the host CPU — the dense bf16 model never exists anywhere
+    (llama3-8b would need ~16GB device HBM + ~8GB f32 transients via
+    ``init`` + ``quantize_weights_int8``; the serving bench uses this to
+    start the 8B-on-one-v5e config cold).  RNG layout differs from ``init``
+    (per-layer keys), which random-weight benches don't care about."""
+    import ml_dtypes
+
+    c = config
+    hd = c.head_dim
+    n = c.n_layers
+    keys = jax.random.split(key, 8)
+    cpu = jax.devices("cpu")[0]
+
+    def gen(k, shape, fan_in):
+        with jax.default_device(cpu):
+            return np.asarray(jax.random.normal(k, shape, jnp.float32)
+                              ) / np.sqrt(fan_in)
+
+    def q2(k, shape, fan_in):
+        q, s = _quant_cols_f32(gen(k, shape, fan_in))
+        return {"q": q, "s": s}
+
+    def q3(k, in_dim, out_dim, fan_in):
+        parts = [_quant_cols_f32(gen(kl, (in_dim, out_dim), fan_in))
+                 for kl in jax.random.split(k, n)]
+        return {"q": np.stack([a for a, _ in parts]),
+                "s": np.stack([b for _, b in parts])}
+
+    bf16 = ml_dtypes.bfloat16
+    return {
+        "embed": q2(keys[0], (c.vocab_size, c.d_model), 1.0),
+        "wq": q3(keys[1], c.d_model, c.n_heads * hd, c.d_model),
+        "wk": q3(keys[2], c.d_model, c.n_kv_heads * hd, c.d_model),
+        "wv": q3(keys[3], c.d_model, c.n_kv_heads * hd, c.d_model),
+        "wo": q3(keys[4], c.n_heads * hd, c.d_model, c.n_heads * hd),
+        "w1": q3(keys[5], c.d_model, c.d_ff, c.d_model),
+        "w3": q3(keys[6], c.d_model, c.d_ff, c.d_model),
+        "w2": q3(keys[7], c.d_ff, c.d_model, c.d_ff),
+        "ln_attn": np.ones((n, c.d_model), bf16),
+        "ln_mlp": np.ones((n, c.d_model), bf16),
+        "ln_out": np.ones((c.d_model,), bf16),
+        "unembed": q2(keys[0], (c.d_model, c.vocab_size), c.d_model),
+    }
+
+
+def _w(p, l=None):
+    """Weight leaf → bf16 dense slice (dequantizing {"q","s"} on the fly)."""
+    if isinstance(p, dict):
+        q, s = (p["q"], p["s"]) if l is None else (p["q"][l], p["s"][l])
+        return q.astype(jnp.bfloat16) * s
+    return p if l is None else p[l]
+
+
+def _embed_rows(p, tokens):
+    """Embedding gather that dequantizes AFTER the row gather — dequantizing
+    the whole [V, D] table first would materialize it dense."""
+    if isinstance(p, dict):
+        return p["q"][tokens].astype(jnp.bfloat16) * p["s"][0]
+    return p[tokens]
+
+
 def _rms_norm(x, scale, eps):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale
@@ -140,12 +250,12 @@ def _block_with(params, l, config, x, positions, attend):
     c = config
     h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
     B, S = x.shape[:2]
-    q = (h @ params["wq"][l]).reshape(B, S, c.n_heads, c.head_dim)
+    q = (h @ _w(params["wq"], l)).reshape(B, S, c.n_heads, c.head_dim)
     q = _rope(q, positions, c.rope_theta)
     attn = attend(q)
-    x = x + attn.reshape(B, S, -1) @ params["wo"][l]
+    x = x + attn.reshape(B, S, -1) @ _w(params["wo"], l)
     h = _rms_norm(x, params["ln_mlp"][l], c.norm_eps)
-    x = x + (jax.nn.silu(h @ params["w1"][l]) * (h @ params["w3"][l])) @ params["w2"][l]
+    x = x + (jax.nn.silu(h @ _w(params["w1"], l)) * (h @ _w(params["w3"], l))) @ _w(params["w2"], l)
     return x
 
 
@@ -159,8 +269,8 @@ def _block(params, l, config, x, k_cache, v_cache, positions, mask):
 def _kv_proj(params, l, config, h, positions):
     c = config
     B, S = h.shape[:2]
-    k = (h @ params["wk"][l]).reshape(B, S, c.n_kv_heads, c.head_dim)
-    v = (h @ params["wv"][l]).reshape(B, S, c.n_kv_heads, c.head_dim)
+    k = (h @ _w(params["wk"], l)).reshape(B, S, c.n_kv_heads, c.head_dim)
+    v = (h @ _w(params["wv"], l)).reshape(B, S, c.n_kv_heads, c.head_dim)
     k = _rope(k, positions, c.rope_theta)
     return k, v
 
@@ -239,7 +349,7 @@ def prefill(params, config: DecoderConfig, tokens, length, page_size: int):
     c = config
     B, S = tokens.shape
     positions = jnp.arange(S, dtype=jnp.int32)[None, :]
-    x = params["embed"][tokens]
+    x = _embed_rows(params["embed"], tokens)
     causal = jnp.tril(jnp.ones((S, S), bool))[None]
     valid = (positions < length)[:, None, :]
     mask = causal & valid
@@ -253,7 +363,7 @@ def prefill(params, config: DecoderConfig, tokens, length, page_size: int):
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     # logits at the last REAL token (length-1)
     last = x[jnp.arange(B), length - 1]
-    logits = (last @ params["unembed"]).astype(jnp.float32)
+    logits = (last @ _w(params["unembed"])).astype(jnp.float32)
     n_pages = S // page_size
     paged_k = jnp.stack(ks).reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)[:, 0]
     paged_v = jnp.stack(vs).reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)[:, 0]
@@ -297,7 +407,7 @@ def prefill_chunk(params, config: DecoderConfig, tokens, start, length,
     H = hist_page_ids.shape[0]
     T = H * page_size
     positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]
-    x = params["embed"][tokens]
+    x = _embed_rows(params["embed"], tokens)
     t_range = jnp.arange(T, dtype=jnp.int32)
     # causal across chunks + clipped to the real prompt
     mask = (t_range[None, None, :] <= positions[:, :, None]) & (t_range < length)[None, None, :]
@@ -313,7 +423,7 @@ def prefill_chunk(params, config: DecoderConfig, tokens, start, length,
         x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     last = jnp.clip(length - 1 - start, 0, C - 1)
-    logits = (x[jnp.arange(B), last] @ params["unembed"]).astype(jnp.float32)
+    logits = (x[jnp.arange(B), last] @ _w(params["unembed"])).astype(jnp.float32)
     return logits, k_pool, v_pool
 
 
@@ -363,7 +473,7 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
     pos = jnp.maximum(seq_lens - 1, 0)  # current token's position
     positions = pos[:, None]
 
-    x = params["embed"][tokens][:, None, :]  # [B, 1, D]
+    x = _embed_rows(params["embed"], tokens)[:, None, :]  # [B, 1, D]
     t_range = jnp.arange(T, dtype=jnp.int32)
     mask = (t_range[None, :] < seq_lens[:, None])[:, None, :]  # [B, 1, T]
 
@@ -388,7 +498,7 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
             v_cache = pool_get(v_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
             x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
-    logits = (x[:, 0] @ params["unembed"]).astype(jnp.float32)
+    logits = (x[:, 0] @ _w(params["unembed"])).astype(jnp.float32)
     return logits, k_pool, v_pool
 
 
@@ -428,7 +538,7 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
     pos0 = jnp.maximum(seq_lens - 1, 0)
     positions = pos0[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]  # [B, K]
 
-    x = params["embed"][tokens]  # [B, K, D]
+    x = _embed_rows(params["embed"], tokens)  # [B, K, D]
     t_range = jnp.arange(T, dtype=jnp.int32)
     # causal over history + this chunk's own tokens (their KV is written
     # below before attention reads the gathered cache)
@@ -460,7 +570,7 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
             v_cache = pool_get(v_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
             x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
-    logits = (x @ params["unembed"]).astype(jnp.float32)
+    logits = (x @ _w(params["unembed"])).astype(jnp.float32)
     return logits, k_pool, v_pool
 
 
@@ -473,11 +583,11 @@ def forward_full(params, config: DecoderConfig, tokens):
     c = config
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-    x = params["embed"][tokens]
+    x = _embed_rows(params["embed"], tokens)
     mask = jnp.tril(jnp.ones((S, S), bool))[None].repeat(B, 0)
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
         k, v = _kv_proj(params, l, c, h, positions)
         x = _block(params, l, c, x, k, v, positions, mask)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
-    return (x @ params["unembed"]).astype(jnp.float32)
+    return (x @ _w(params["unembed"])).astype(jnp.float32)

@@ -70,12 +70,29 @@ def validate_config(config: DecoderConfig, mesh: Mesh) -> None:
         raise ValueError(f"tensor={tp} must divide d_ff={config.d_ff}")
 
 
+def _scale_spec(spec: P, s_shape: tuple) -> P:
+    """Sharding for an int8 weight's scale tensor: same as the weight's spec
+    except axes where the scale keeps a singleton (the contraction axis) go
+    unsharded — a dim of 1 can't split over the mesh."""
+    return P(*(None if s_shape[i] == 1 else ax for i, ax in enumerate(spec)))
+
+
 def shard_params(params: dict, mesh: Mesh) -> dict:
-    """Place engine params tensor-parallel on the mesh."""
-    return {
-        name: jax.device_put(value, NamedSharding(mesh, PARAM_SPECS[name]))
-        for name, value in params.items()
-    }
+    """Place engine params tensor-parallel on the mesh.  int8-quantized
+    weights ({"q", "s"} leaves from model.quantize_weights_int8) shard q by
+    the weight's spec and s by the singleton-adjusted spec."""
+    out = {}
+    for name, value in params.items():
+        spec = PARAM_SPECS[name]
+        if isinstance(value, dict):
+            out[name] = {
+                "q": jax.device_put(value["q"], NamedSharding(mesh, spec)),
+                "s": jax.device_put(value["s"], NamedSharding(
+                    mesh, _scale_spec(spec, value["s"].shape))),
+            }
+        else:
+            out[name] = jax.device_put(value, NamedSharding(mesh, spec))
+    return out
 
 
 def alloc_pool(shape: tuple, mesh: Mesh, dtype=None, quant=None):

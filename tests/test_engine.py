@@ -1052,3 +1052,66 @@ def test_stream_disconnect_cancels_request(params):
         assert out["tokens"] == greedy_oracle(params, [1, 2], 3)
     finally:
         eng.stop()
+
+
+# ------------------------------------------------- int8 weight quantization
+
+
+def test_weight_quant_int8_logits_close(params):
+    """Weight-only int8 (per-output-channel scales): full-vocab logits must
+    track bf16 within quantization noise on a forward pass."""
+    qp = M.quantize_weights_int8(params)
+    assert qp["wq"]["q"].dtype == jnp.int8 and qp["ln_attn"].dtype != jnp.int8
+    toks = jnp.asarray([[5, 7, 9, 11, 13]], jnp.int32)
+    ref = np.asarray(M.forward_full(params, CFG, toks))
+    got = np.asarray(M.forward_full(qp, CFG, toks))
+    # logits are O(1) for this init; int8 per-channel noise stays well inside
+    denom = max(1.0, float(np.abs(ref).max()))
+    assert np.abs(got - ref).max() / denom < 0.08, np.abs(got - ref).max()
+
+
+def test_weight_quant_int8_halves_param_bytes(params):
+    before = sum(x.nbytes for x in jax.tree.leaves(params))
+    qp = M.quantize_weights_int8(params)
+    after = sum(x.nbytes for x in jax.tree.leaves(qp))
+    # int8 payload + bf16 scales ≈ half the bf16 bytes (scales are ~1/d_model)
+    assert after < 0.6 * before, (before, after)
+
+
+def test_engine_weight_quant_generates_near_greedy(params):
+    """E2E with weight_quant='int8': generated tokens stay within a small
+    logit margin of the full-precision oracle (int8 may flip near-ties)."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        prefill_chunk=16, weight_quant="int8",
+    ))
+    assert isinstance(eng.params["w1"], dict)
+    eng.start()
+    try:
+        prompt = [5, 7, 9, 11]
+        out = eng.generate(prompt, 4, timeout=180)
+        toks = list(prompt)
+        for tok in out["tokens"]:
+            logits = np.asarray(M.forward_full(params, CFG, jnp.asarray([toks], jnp.int32)))[0, -1]
+            assert logits.max() - logits[tok] <= 0.5, (toks, tok)
+            toks.append(tok)
+    finally:
+        eng.stop()
+
+
+def test_engine_weight_quant_with_tp_and_int8_kv(params):
+    """Composition: weight_quant x tensor_parallel x kv_quant in one engine —
+    quantized shards place on the mesh (scale singletons unsharded) and the
+    engine still generates coherently."""
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        prefill_chunk=16, weight_quant="int8", kv_quant="int8",
+        tensor_parallel=2,
+    ))
+    eng.start()
+    try:
+        out = eng.generate([3, 1, 4, 1, 5], 4, timeout=240)
+        assert len(out["tokens"]) == 4
+        assert all(0 <= t < CFG.vocab_size for t in out["tokens"])
+    finally:
+        eng.stop()
