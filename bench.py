@@ -100,6 +100,8 @@ def last_json_line(stdout: str, require_key: str | None = None):
             rec = json.loads(line)
         except ValueError:
             continue
+        if not isinstance(rec, dict):
+            continue  # a bare JSON number/list is not a result record
         if require_key is None or require_key in rec:
             return rec
     return None

@@ -1,0 +1,290 @@
+"""Central-dashboard web shell: server-rendered HTML over the data layer.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2a): the centraldashboard shell +
+katib-ui.  Upstream ships a Node/Polymer SPA; pixels are out of scope
+(SURVEY.md §7), but the SHELL capability — a browser hitting one port and
+seeing namespaces, workloads, quota and experiment results, gated by the
+same RBAC as the API — is platform surface, so this serves it as plain
+server-rendered HTML from the existing data layers (`Dashboard`,
+`KatibService`) with zero frontend toolchain.
+
+Identity arrives in the ``kubeflow-userid`` header, exactly where upstream's
+Istio ingress puts it; every page authorizes through ProfileRBACAuthorizer,
+so a stranger's request 403s rather than rendering an empty shell.
+"""
+
+from __future__ import annotations
+
+import html
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import unquote, urlparse
+
+from ..core.api import APIServer, Invalid
+from ..core.authz import Forbidden, ProfileRBACAuthorizer
+from .dashboard import Dashboard
+from .spawner import Spawner
+
+USER_HEADER = "kubeflow-userid"
+
+_STYLE = """
+body{font-family:sans-serif;margin:2em;color:#202124}
+h1,h2{font-weight:500} a{color:#1a73e8;text-decoration:none}
+table{border-collapse:collapse;margin:1em 0}
+td,th{border:1px solid #dadce0;padding:.4em .8em;text-align:left}
+th{background:#f1f3f4} .phase-Running,.phase-Ready{color:#188038}
+.phase-Failed{color:#d93025} .phase-Succeeded{color:#5f6368}
+.card{display:inline-block;border:1px solid #dadce0;border-radius:8px;
+padding:1em;margin:.5em;vertical-align:top}
+"""
+
+
+def _page(title: str, body: str) -> bytes:
+    return (f"<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title><style>{_STYLE}</style>"
+            f"</head><body><h1>{html.escape(title)}</h1>{body}"
+            f"</body></html>").encode()
+
+
+def _esc(v) -> str:
+    return html.escape(str(v))
+
+
+def _phase_cell(phase: str) -> str:
+    return f"<td class='phase-{_esc(phase)}'>{_esc(phase)}</td>"
+
+
+def _sparkline(values: list[float], width: int = 240, height: int = 48) -> str:
+    """Inline SVG polyline of a metric series (katib trial observations)."""
+    if len(values) < 2:
+        return ""
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    pts = " ".join(
+        f"{i * width / (len(values) - 1):.1f},"
+        f"{height - (v - lo) / span * (height - 4) - 2:.1f}"
+        for i, v in enumerate(values))
+    return (f"<svg width='{width}' height='{height}'>"
+            f"<polyline points='{pts}' fill='none' stroke='#1a73e8' "
+            f"stroke-width='1.5'/></svg>")
+
+
+class DashboardWebUI:
+    """One-port HTML shell: ``/`` overview, ``/ns/<ns>`` detail,
+    ``/ns/<ns>/experiments/<name>`` katib results."""
+
+    def __init__(self, api: APIServer, katib_service=None, port: int = 0,
+                 cluster_admins=(), spawner: Optional[Spawner] = None):
+        self.dashboard = Dashboard(api)
+        self.authorizer = ProfileRBACAuthorizer(api, cluster_admins)
+        self.katib = katib_service
+        self.spawner = spawner
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                user = self.headers.get(USER_HEADER, "anonymous")
+                path = urlparse(self.path).path
+                try:
+                    out = outer._route(path, user)
+                except Forbidden as e:
+                    self._send(403, _page("Forbidden", f"<p>{_esc(e)}</p>"))
+                    return
+                if out is None:
+                    self._send(404, _page("Not found", f"<p>{_esc(path)}</p>"))
+                else:
+                    self._send(200, out)
+
+            def do_POST(self):
+                user = self.headers.get(USER_HEADER, "anonymous")
+                path = urlparse(self.path).path
+                from urllib.parse import parse_qs
+
+                parts = [unquote(p) for p in path.strip("/").split("/")]
+                if not (len(parts) == 3 and parts[0] == "ns"
+                        and parts[2] == "spawn" and outer.spawner is not None):
+                    self._send(404, _page("Not found", f"<p>{_esc(path)}</p>"))
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    form = {k: v[0] for k, v in
+                            parse_qs(self.rfile.read(n).decode()).items()}
+                    outer._spawn(user, parts[1], form)
+                except Forbidden as e:
+                    self._send(403, _page("Forbidden", f"<p>{_esc(e)}</p>"))
+                    return
+                except (KeyError, ValueError, Invalid) as e:
+                    # KeyError = required form field missing; a dead handler
+                    # thread (empty reply) is never the right answer to bad
+                    # form data
+                    self._send(400, _page("Invalid", f"<p>{_esc(e)}</p>"))
+                    return
+                # POST-redirect-GET back to the namespace page
+                self.send_response(303)
+                self.send_header("Location", f"/ns/{parts[1]}")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def _send(self, code: int, payload: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+
+    def _authz(self, user: str, verb: str, kind: str, ns: str) -> None:
+        if not self.authorizer.authorize(user, verb, kind, ns):
+            raise Forbidden(f"user {user!r} cannot {verb} {kind} in {ns!r}")
+
+    # ------------------------------------------------------------- routing
+
+    def _route(self, path: str, user: str) -> Optional[bytes]:
+        if path == "/healthz":
+            return b"ok"
+        if path == "/":
+            return self._overview(user)
+        parts = [unquote(p) for p in path.strip("/").split("/")]
+        if len(parts) == 2 and parts[0] == "ns":
+            return self._namespace(user, parts[1])
+        if (len(parts) == 3 and parts[0] == "ns" and parts[2] == "spawn"
+                and self.spawner is not None):
+            return self._spawn_form(user, parts[1])
+        if (len(parts) == 4 and parts[0] == "ns" and parts[2] == "experiments"
+                and self.katib is not None):
+            return self._experiment(user, parts[1], parts[3])
+        return None
+
+    # --------------------------------------------------------------- pages
+
+    def _overview(self, user: str) -> bytes:
+        ov = self.dashboard.overview(user)
+        cards = []
+        for card in ov["namespaces"]:
+            ns = card["namespace"]
+            rows = "".join(
+                f"<tr><td>{_esc(k)}</td><td>{v}</td></tr>"
+                for k, v in sorted(card["workloads"].items()))
+            cards.append(
+                f"<div class='card'><h2><a href='/ns/{_esc(ns)}'>{_esc(ns)}"
+                f"</a></h2><table>{rows}</table>"
+                f"<p>{card['running']} running · "
+                f"{card['tpu_chips_requested']:.0f} TPU chips</p></div>")
+        t = ov["totals"]
+        body = (f"<p>Signed in as <b>{_esc(user)}</b> — "
+                f"{t['workloads']} workloads, {t['running']} running, "
+                f"{t['tpu_chips_requested']:.0f} TPU chips requested</p>"
+                + "".join(cards))
+        return _page("Kubeflow-TPU", body)
+
+    def _namespace(self, user: str, ns: str) -> bytes:
+        self._authz(user, "list", "Pod", ns)
+        summary = self.dashboard.summary(ns)
+        quota = self.dashboard.quota(ns)
+        activity = self.dashboard.activity(ns)
+        sections = []
+        for kind, info in summary["resources"].items():
+            rows = "".join(
+                "<tr><td>" + (
+                    f"<a href='/ns/{_esc(ns)}/experiments/{_esc(i['name'])}'>"
+                    f"{_esc(i['name'])}</a>" if kind == "Experiment"
+                    and self.katib is not None else _esc(i["name"]))
+                + f"</td>{_phase_cell(i['phase'])}</tr>"
+                for i in info["items"])
+            sections.append(f"<h2>{_esc(kind)} ({info['count']})</h2>"
+                            f"<table><tr><th>name</th><th>phase</th></tr>"
+                            f"{rows}</table>")
+        qrows = "".join(
+            f"<tr><td>{_esc(res)}</td><td>{quota['used'].get(res, 0.0):g}</td>"
+            f"<td>{_esc(hard)}</td></tr>"
+            for res, hard in sorted(quota["hard"].items()))
+        if qrows:
+            sections.append("<h2>Quota</h2><table><tr><th>resource</th>"
+                            f"<th>used</th><th>hard</th></tr>{qrows}</table>")
+        arows = "".join(
+            f"<tr><td>{_esc(e['type'])}</td><td>{_esc(e['object'])}</td>"
+            f"<td>{_esc(e['reason'])}</td><td>{_esc(e['message'])}</td></tr>"
+            for e in activity)
+        if arows:
+            sections.append("<h2>Recent activity</h2><table><tr><th>type</th>"
+                            "<th>object</th><th>reason</th><th>message</th>"
+                            f"</tr>{arows}</table>")
+        return _page(f"Namespace {ns}", "".join(sections))
+
+    def _spawn_form(self, user: str, ns: str) -> bytes:
+        """The jupyter-web-app form: options straight from the spawner
+        config — the accelerator dropdown is TPU chips, never a GPU count."""
+        self._authz(user, "create", "Notebook", ns)
+        opts = self.spawner.options()
+
+        def select(field, values, default=None):
+            choices = "".join(
+                f"<option{' selected' if str(v) == str(default) else ''}>"
+                f"{_esc(v)}</option>" for v in values)
+            return (f"<label>{_esc(field)} "
+                    f"<select name='{_esc(field)}'>{choices}</select></label> ")
+
+        body = (f"<form method='post' action='/ns/{_esc(ns)}/spawn'>"
+                "<label>name <input name='name' required></label> "
+                + select("image", opts["images"], opts["defaultImage"])
+                + select("cpu", opts["cpu"], "1")
+                + select("memory", opts["memory"], "2Gi")
+                + select("tpu_chips", opts["tpuChips"], 0)
+                + "<button type='submit'>Launch</button></form>")
+        return _page(f"New notebook in {ns}", body)
+
+    def _spawn(self, user: str, ns: str, form: dict) -> None:
+        self._authz(user, "create", "Notebook", ns)
+        self.spawner.spawn(
+            form["name"], ns, image=form.get("image") or None,
+            cpu=form.get("cpu", "1"), memory=form.get("memory", "2Gi"),
+            tpu_chips=int(form.get("tpu_chips", 0)))
+
+    def _experiment(self, user: str, ns: str, name: str) -> Optional[bytes]:
+        self._authz(user, "list", "Experiment", ns)
+        exp = self.katib.get_experiment(name, namespace=ns)
+        if exp is None:
+            return None
+        objective = exp["spec"].get("objective", {})
+        metric = objective.get("objectiveMetricName", "")
+        rows = []
+        for t in exp["trials"]:
+            assignments = ", ".join(
+                f"{_esc(a['name'])}={_esc(a['value'])}"
+                for a in t.get("parameterAssignments", []))
+            series = [rec["value"] for rec in
+                      self.katib.get_observation_log(t["name"], metric)
+                      ] if metric else []
+            best = t.get("observation", {}).get("metrics") or []
+            best_txt = ", ".join(
+                f"{_esc(m.get('name'))}={_esc(m.get('latest'))}" for m in best)
+            rows.append(
+                f"<tr><td>{_esc(t['name'])}</td>{_phase_cell(t['status'])}"
+                f"<td>{assignments}</td><td>{best_txt}</td>"
+                f"<td>{_sparkline(series)}</td></tr>")
+        optimal = exp.get("currentOptimalTrial") or {}
+        opt_txt = (f" · best: <b>{_esc(optimal.get('bestTrialName', ''))}</b>"
+                   if optimal.get("bestTrialName") else "")
+        body = (f"<p>status: <b>{_esc(exp['status'])}</b> · objective: "
+                f"{_esc(objective.get('type', ''))} <b>{_esc(metric)}</b> · "
+                f"{len(exp['trials'])} trials{opt_txt}</p>"
+                "<table><tr><th>trial</th><th>phase</th><th>parameters</th>"
+                f"<th>observation</th><th>{_esc(metric)}</th></tr>"
+                + "".join(rows) + "</table>")
+        return _page(f"Experiment {name}", body)

@@ -280,3 +280,142 @@ def test_dashboard_composes_with_authenticated_api(platform):
     stranger = Dashboard(AuthenticatedAPI(c.api, "eve@x.io", authz))
     assert all(r["count"] == 0 for r in stranger.summary("own-ns")["resources"].values())
     assert stranger.quota("own-ns") == {"namespace": "own-ns", "hard": {}, "used": {}}
+
+
+# ------------------------------------------------------------- web shell
+
+
+def test_webui_serves_overview_namespace_and_403(platform):
+    """The HTML shell (webui.py): / renders the user's namespace cards,
+    /ns/<ns> renders workloads+quota, and a stranger 403s — the upstream
+    centraldashboard capability (SURVEY §2a) behind the kubeflow-userid
+    header, RBAC-enforced server-side."""
+    import urllib.error
+    import urllib.request
+
+    from kubeflow_tpu.platform.webui import DashboardWebUI
+
+    c, _ = platform
+    c.apply(papi.profile("web-ns", "web@x.io", {"cpu": "8", "google.com/tpu": "8"}))
+    c.settle(quiet=0.3)
+    spawner = Spawner(c.api)
+    spawner.spawn("nb-web", "web-ns")
+    c.settle(quiet=0.3)
+
+    ui = DashboardWebUI(c.api)
+    try:
+        def get(path, user):
+            req = urllib.request.Request(ui.url + path,
+                                         headers={"kubeflow-userid": user})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.read().decode()
+
+        home = get("/", "web@x.io")
+        assert "web-ns" in home and "Signed in as" in home
+        page = get("/ns/web-ns", "web@x.io")
+        assert "nb-web" in page and "Notebook" in page
+        assert "google.com/tpu" in page  # quota table renders
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/ns/web-ns", "eve@x.io")
+        assert e.value.code == 403
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/ns/nonexistent/bogus/x", "web@x.io")
+        assert e.value.code == 404
+    finally:
+        ui.shutdown()
+
+
+def test_webui_experiment_page_renders_trials(platform):
+    """Katib results through the shell: trial table with parameters,
+    observations, and the metric sparkline SVG."""
+    import urllib.request
+
+    from kubeflow_tpu.katib.obslog import ObservationStore
+    from kubeflow_tpu.katib.service import KatibService
+    from kubeflow_tpu.platform.webui import DashboardWebUI
+
+    c, _ = platform
+    from kubeflow_tpu.katib import api as _kapi
+    _kapi.register(c.api)
+    c.apply(papi.profile("kat-ns", "kat@x.io"))
+    c.settle(quiet=0.3)
+    # a finished experiment's objects, written directly (controller E2Es own
+    # the real path; the shell test only needs render-able state)
+    c.api.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Experiment",
+        "metadata": {"name": "sweep", "namespace": "kat-ns"},
+        "spec": {"algorithm": {"algorithmName": "grid"},
+                 "parameters": [{"name": "lr", "parameterType": "double",
+                                 "feasibleSpace": {"min": "0.01", "max": "1.0"}}],
+                 "objective": {"type": "maximize",
+                               "objectiveMetricName": "accuracy"},
+                 "trialTemplate": {"trialSpec": {
+                     "apiVersion": "v1", "kind": "Pod",
+                     "spec": {"containers": [{"name": "main"}]}}}},
+    })
+    from kubeflow_tpu.katib import api as kapi
+    c.api.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Trial",
+        "metadata": {"name": "sweep-t0", "namespace": "kat-ns",
+                     "labels": {kapi.LABEL_EXPERIMENT: "sweep"}},
+        "spec": {"parameterAssignments": [{"name": "lr", "value": "0.1"}]},
+        "status": {"observation": {"metrics": [
+            {"name": "accuracy", "latest": 0.9}]}},
+    })
+    store = ObservationStore()
+    for step, v in enumerate([0.2, 0.5, 0.8, 0.9]):
+        store.report("sweep-t0", "accuracy", step, v)
+    ui = DashboardWebUI(c.api, katib_service=KatibService(c.api, store))
+    try:
+        req = urllib.request.Request(ui.url + "/ns/kat-ns/experiments/sweep",
+                                     headers={"kubeflow-userid": "kat@x.io"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            page = r.read().decode()
+        assert "sweep-t0" in page and "lr=0.1" in page
+        assert "accuracy" in page and "<svg" in page  # sparkline rendered
+    finally:
+        ui.shutdown()
+        store.close()
+
+
+def test_webui_spawner_form_launches_notebook(platform):
+    """The jupyter-web-app capability through the shell: GET renders the
+    TPU-chip form from spawner config; POST creates the Notebook (RBAC'd)
+    and redirects back to the namespace page."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from kubeflow_tpu.platform.webui import DashboardWebUI
+
+    c, _ = platform
+    c.apply(papi.profile("spawn-ns", "spawn@x.io", {"cpu": "8", "google.com/tpu": "8"}))
+    c.settle(quiet=0.3)
+    ui = DashboardWebUI(c.api, spawner=Spawner(c.api))
+    try:
+        req = urllib.request.Request(ui.url + "/ns/spawn-ns/spawn",
+                                     headers={"kubeflow-userid": "spawn@x.io"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            form = r.read().decode()
+        assert "tpu_chips" in form and "jupyter-tpu:v5e" in form
+
+        data = urllib.parse.urlencode({
+            "name": "nb-form", "image": "jupyter-tpu:v5e",
+            "cpu": "1", "memory": "2Gi", "tpu_chips": "4"}).encode()
+        req = urllib.request.Request(ui.url + "/ns/spawn-ns/spawn", data=data,
+                                     headers={"kubeflow-userid": "spawn@x.io"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert "nb-form" in r.read().decode()  # redirected ns page
+        nb = c.api.get("Notebook", "nb-form", "spawn-ns")
+        res = nb["spec"]["template"]["spec"]["containers"][0]["resources"]
+        assert res["limits"]["google.com/tpu"] == 4
+
+        # a stranger's POST is rejected before any object is created
+        req = urllib.request.Request(ui.url + "/ns/spawn-ns/spawn", data=data,
+                                     headers={"kubeflow-userid": "eve@x.io"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 403
+    finally:
+        ui.shutdown()
