@@ -42,10 +42,20 @@ class ProfileRBACAuthorizer:
 
     def roles_for(self, user: str, namespace: str) -> set[str]:
         roles = set()
+        # try_get already returns None for a missing object or unregistered
+        # kind, so no guard is needed there
         prof = self.api.try_get("Profile", namespace)
         if prof is not None and prof["spec"].get("owner", {}).get("name") == user:
             roles.add("admin")
-        for b in self.api.list("RoleBinding", namespace=namespace):
+        # a partially-installed platform (kfadm subsets) may not register the
+        # RoleBinding CRD at all — api.list raises bare KeyError for an
+        # unregistered kind; that means "no grants", not an authorizer crash
+        # (cluster_admins still pass in authorize())
+        try:
+            bindings = self.api.list("RoleBinding", namespace=namespace)
+        except KeyError:
+            bindings = []
+        for b in bindings:
             labels = b["metadata"].get("labels", {})
             if labels.get("user") == user and labels.get("role") in _ROLE_VERBS:
                 roles.add(labels["role"])

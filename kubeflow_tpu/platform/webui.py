@@ -70,16 +70,81 @@ def _sparkline(values: list[float], width: int = 240, height: int = 48) -> str:
             f"stroke-width='1.5'/></svg>")
 
 
+_PHASE_FILL = {"Succeeded": "#e6f4ea", "Failed": "#fce8e6",
+               "Running": "#e8f0fe", "Skipped": "#f1f3f4"}
+
+
+def _dag_svg(tasks: dict, nodes: dict) -> str:
+    """Layered DAG render of a pipeline run: tasks in topological columns
+    (depth = longest dependency chain), edges as lines, fill by phase —
+    the run-graph view the KFP frontend is known for, in one SVG."""
+    if not tasks:
+        return ""
+    # iterative longest-chain layering: a thousand-task linear pipeline must
+    # not blow the recursion limit mid-request
+    depth: dict[str, int] = {}
+    for root in tasks:
+        stack = [root]
+        while stack:
+            t = stack[-1]
+            if t in depth:
+                stack.pop()
+                continue
+            deps = [x for x in tasks.get(t, {}).get("dependentTasks", [])
+                    if x in tasks and x not in depth and x not in stack]
+            if deps:
+                stack.extend(deps)
+                continue
+            done = [x for x in tasks.get(t, {}).get("dependentTasks", [])
+                    if x in depth]
+            depth[t] = 1 + max((depth[x] for x in done), default=-1)
+            stack.pop()
+    cols: dict[int, list[str]] = {}
+    for t in sorted(tasks):
+        cols.setdefault(depth[t], []).append(t)
+    bw, bh, gx, gy, pad = 150, 36, 60, 18, 10
+    pos = {}
+    for ci in sorted(cols):
+        for ri, t in enumerate(cols[ci]):
+            pos[t] = (pad + ci * (bw + gx), pad + ri * (bh + gy))
+    width = pad * 2 + (max(cols) + 1) * bw + max(cols) * gx
+    height = pad * 2 + max(len(v) for v in cols.values()) * (bh + gy) - gy
+    parts = [f"<svg width='{width}' height='{height}' "
+             f"style='border:1px solid #dadce0;border-radius:8px'>"]
+    for t, spec in tasks.items():
+        x1, y1 = pos[t]
+        for dep in spec.get("dependentTasks", []):
+            if dep not in pos:
+                continue
+            x0, y0 = pos[dep]
+            parts.append(
+                f"<line x1='{x0 + bw}' y1='{y0 + bh // 2}' x2='{x1}' "
+                f"y2='{y1 + bh // 2}' stroke='#5f6368' stroke-width='1.2'/>")
+    for t, (x, y) in pos.items():
+        phase = nodes.get(t, {}).get("phase", "Pending")
+        fill = _PHASE_FILL.get(phase, "#fff")
+        parts.append(
+            f"<g><rect x='{x}' y='{y}' width='{bw}' height='{bh}' rx='6' "
+            f"fill='{fill}' stroke='#5f6368'/>"
+            f"<text x='{x + bw / 2}' y='{y + bh / 2 + 4}' "
+            f"text-anchor='middle' font-size='12'>{_esc(t)}</text></g>")
+    parts.append("</svg>")
+    return "".join(parts)
+
+
 class DashboardWebUI:
     """One-port HTML shell: ``/`` overview, ``/ns/<ns>`` detail,
     ``/ns/<ns>/experiments/<name>`` katib results."""
 
     def __init__(self, api: APIServer, katib_service=None, port: int = 0,
-                 cluster_admins=(), spawner: Optional[Spawner] = None):
+                 cluster_admins=(), spawner: Optional[Spawner] = None,
+                 pipeline_service=None):
+        self.api = api
         self.dashboard = Dashboard(api)
         self.authorizer = ProfileRBACAuthorizer(api, cluster_admins)
         self.katib = katib_service
         self.spawner = spawner
+        self.pipelines = pipeline_service
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -93,6 +158,10 @@ class DashboardWebUI:
                     out = outer._route(path, user)
                 except Forbidden as e:
                     self._send(403, _page("Forbidden", f"<p>{_esc(e)}</p>"))
+                    return
+                except Exception as e:  # a dead handler thread (empty
+                    # reply) is never the right answer to a render bug
+                    self._send(500, _page("Error", f"<p>{_esc(e)}</p>"))
                     return
                 if out is None:
                     self._send(404, _page("Not found", f"<p>{_esc(path)}</p>"))
@@ -123,9 +192,13 @@ class DashboardWebUI:
                     # form data
                     self._send(400, _page("Invalid", f"<p>{_esc(e)}</p>"))
                     return
-                # POST-redirect-GET back to the namespace page
+                # POST-redirect-GET back to the namespace page; re-quote the
+                # decoded segment — echoing it raw would let %0d%0a split the
+                # response (CRLF header injection)
+                from urllib.parse import quote
+
                 self.send_response(303)
-                self.send_header("Location", f"/ns/{parts[1]}")
+                self.send_header("Location", f"/ns/{quote(parts[1], safe='')}")
                 self.send_header("Content-Length", "0")
                 self.end_headers()
 
@@ -169,6 +242,11 @@ class DashboardWebUI:
         if (len(parts) == 4 and parts[0] == "ns" and parts[2] == "experiments"
                 and self.katib is not None):
             return self._experiment(user, parts[1], parts[3])
+        if path == "/pipelines" and self.pipelines is not None:
+            return self._pipelines(user)
+        if (len(parts) == 2 and parts[0] == "runs"
+                and self.pipelines is not None):
+            return self._run(user, parts[1])
         return None
 
     # --------------------------------------------------------------- pages
@@ -187,10 +265,12 @@ class DashboardWebUI:
                 f"<p>{card['running']} running · "
                 f"{card['tpu_chips_requested']:.0f} TPU chips</p></div>")
         t = ov["totals"]
+        nav = ("<p><a href='/pipelines'>Pipelines</a></p>"
+               if self.pipelines is not None else "")
         body = (f"<p>Signed in as <b>{_esc(user)}</b> — "
                 f"{t['workloads']} workloads, {t['running']} running, "
                 f"{t['tpu_chips_requested']:.0f} TPU chips requested</p>"
-                + "".join(cards))
+                + nav + "".join(cards))
         return _page("Kubeflow-TPU", body)
 
     def _namespace(self, user: str, ns: str) -> bytes:
@@ -255,6 +335,67 @@ class DashboardWebUI:
             form["name"], ns, image=form.get("image") or None,
             cpu=form.get("cpu", "1"), memory=form.get("memory", "2Gi"),
             tpu_chips=int(form.get("tpu_chips", 0)))
+
+    # ------------------------------------------------------ pipelines (KFP)
+
+    def _pipelines(self, user: str) -> bytes:
+        """Pipelines landing: uploaded pipelines + runs the user may see
+        (runs are namespaced; rows the user can't list are filtered, as the
+        upstream frontend does via the API server's authz)."""
+        plist = "".join(f"<li>{_esc(p)}</li>"
+                        for p in self.pipelines.list_pipelines())
+        rows = []
+        allowed: dict[str, bool] = {}  # one RBAC resolution per namespace
+        for r in reversed(self.pipelines.list_runs()):
+            ns = r.get("namespace", "default")
+            if ns not in allowed:
+                allowed[ns] = self.authorizer.authorize(
+                    user, "list", "Workflow", ns)
+            if not allowed[ns]:
+                continue
+            rows.append(
+                f"<tr><td><a href='/runs/{_esc(r['run'])}'>{_esc(r['run'])}"
+                f"</a></td><td>{_esc(r.get('pipeline', ''))}</td>"
+                f"<td>{_esc(r.get('experiment', ''))}</td>"
+                f"{_phase_cell(r.get('phase', 'Pending'))}</tr>")
+        body = (f"<h2>Pipelines</h2><ul>{plist or '<li>none uploaded</li>'}</ul>"
+                "<h2>Runs</h2><table><tr><th>run</th><th>pipeline</th>"
+                "<th>experiment</th><th>phase</th></tr>"
+                + "".join(rows) + "</table>")
+        return _page("Pipelines", body)
+
+    def _run(self, user: str, run_id: str) -> Optional[bytes]:
+        try:
+            rec = self.pipelines.get_run(run_id)
+        except KeyError:
+            return None
+        ns = rec.get("namespace", "default")
+        self._authz(user, "list", "Workflow", ns)
+        # ONE Workflow snapshot for phase, nodes AND spec tasks — get_run's
+        # internal fetch is a different deepcopy, and two snapshots of a
+        # live run can disagree between the header and the graph
+        wf = self.api.try_get("Workflow", run_id, ns)
+        tasks = ((wf or {}).get("spec", {}).get("pipelineSpec", {})
+                 .get("root", {}).get("dag", {}).get("tasks", {}))
+        nodes = (wf or {}).get("status", {}).get("nodes",
+                                                 rec.get("nodes", {}))
+        if wf is not None:
+            rec["phase"] = wf.get("status", {}).get("phase", rec.get("phase"))
+        args = ", ".join(f"{_esc(k)}={_esc(v)}"
+                         for k, v in (rec.get("arguments") or {}).items())
+        body = (f"<p>pipeline: <b>{_esc(rec.get('pipeline', ''))}</b> · "
+                f"phase: <b>{_esc(rec.get('phase', 'Pending'))}</b>"
+                + (f" · arguments: {args}" if args else "") + "</p>"
+                + _dag_svg(tasks, nodes))
+        rows = "".join(
+            f"<tr><td>{_esc(t)}</td>"
+            f"{_phase_cell(nodes.get(t, {}).get('phase', 'Pending'))}"
+            f"<td>{nodes.get(t, {}).get('retries', 0)}</td>"
+            f"<td>{_esc(nodes.get(t, {}).get('message', ''))}</td></tr>"
+            for t in sorted(tasks))
+        body += ("<h2>Tasks</h2><table><tr><th>task</th><th>phase</th>"
+                 f"<th>retries</th><th>message</th></tr>{rows}</table>")
+        return _page(f"Run {run_id}", body)
 
     def _experiment(self, user: str, ns: str, name: str) -> Optional[bytes]:
         self._authz(user, "list", "Experiment", ns)

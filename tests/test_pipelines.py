@@ -450,3 +450,53 @@ def test_exit_handler_rejects_task_output_condition():
 
     with pytest.raises(CompileError, match="dsl.Condition"):
         Compiler().compile(bad_exit_cond)
+
+
+# ------------------------------------------------------------- web frontend
+
+
+def test_webui_pipelines_and_run_graph(tpu_cluster):
+    """The KFP frontend capability through the dashboard shell: /pipelines
+    lists runs, /runs/<id> renders the layered DAG SVG with per-task phases
+    — and namespace RBAC filters what each user sees."""
+    import urllib.error
+    import urllib.request
+
+    from kubeflow_tpu.platform.webui import DashboardWebUI
+
+    cluster = tpu_cluster
+    client = Client(cluster)
+    run = client.create_run_from_pipeline_func(train_and_deploy,
+                                               arguments={"rows": 25})
+    rec = run.wait(timeout=90)
+    assert rec["phase"] == papi.SUCCEEDED
+
+    ui = DashboardWebUI(cluster.api, pipeline_service=client.service,
+                        cluster_admins=("admin@x.io",))
+    try:
+        def get(path, user):
+            req = urllib.request.Request(ui.url + path,
+                                         headers={"kubeflow-userid": user})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.read().decode()
+
+        listing = get("/pipelines", "admin@x.io")
+        assert run.run_id in listing and "train-and-deploy" in listing
+
+        page = get(f"/runs/{run.run_id}", "admin@x.io")
+        assert "<svg" in page                      # DAG rendered
+        assert "make-data" in page and "deploy" in page
+        assert "phase-Succeeded" in page           # phases colored
+        # the graph encodes dependencies: an edge line per dependentTask
+        assert page.count("<line") >= 2
+
+        # a user with no namespace grants sees no runs and cannot open one
+        assert run.run_id not in get("/pipelines", "nobody@x.io")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(f"/runs/{run.run_id}", "nobody@x.io")
+        assert e.value.code == 403
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/runs/ghost-run", "admin@x.io")
+        assert e.value.code == 404
+    finally:
+        ui.shutdown()
