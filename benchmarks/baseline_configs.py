@@ -216,6 +216,25 @@ BENCHES = {"mnist": bench_mnist, "katib": bench_katib,
            "serving": bench_serving}
 
 
+def artifact_path(repo_root: str | None = None) -> str:
+    """Next free ``BENCH_CONFIGS_r{N}.json`` (or ``$BENCH_ROUND`` if set):
+    a new round's run must never clobber a previous round's committed
+    artifact — r3 discovered the hardcoded name doing exactly that."""
+    import glob
+    import re
+
+    root = repo_root or os.path.join(os.path.dirname(__file__), "..")
+    rnd = os.environ.get("BENCH_ROUND")
+    if rnd is None:
+        # 1 + highest existing N (NOT first gap — artifact sets can be
+        # sparse, e.g. r01 retired but r02/r03 committed)
+        taken = [int(m.group(1)) for f in
+                 glob.glob(os.path.join(root, "BENCH_CONFIGS_r*.json"))
+                 if (m := re.search(r"_r(\d+)\.json$", f))]
+        rnd = f"{max(taken, default=0) + 1:02d}"
+    return os.path.join(root, f"BENCH_CONFIGS_r{rnd}.json")
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     names = list(BENCHES) if which == "all" else [which]
@@ -226,7 +245,7 @@ def main() -> None:
         results.append(r)
     if which == "all":
         out = {"results": results, "host": "1-cpu simulator box"}
-        with open(os.path.join(os.path.dirname(__file__), "..", "BENCH_CONFIGS_r02.json"), "w") as f:
+        with open(artifact_path(), "w") as f:
             json.dump(out, f, indent=1)
 
 
