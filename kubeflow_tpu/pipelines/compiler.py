@@ -90,11 +90,13 @@ def _clone_task(t: Task, suffix: str, gid: int, item: Any, clone_map: dict) -> T
 
 
 def _expand_loops(tasks: list[Task]) -> list[Task]:
-    """Expand the first (outermost) loop group found; recurse until none left."""
+    """Expand the first (outermost) STATIC loop group found; recurse until
+    none left.  Dynamic groups (items_from) are left in place — the workflow
+    controller expands them at runtime."""
     loop: Optional[_Group] = None
     for t in tasks:
         for g in t.group_path:
-            if g.kind == "loop":
+            if g.kind == "loop" and g.items_from is None:
                 loop = g if loop is None or g.group_id < loop.group_id else loop
                 break  # outermost in this task's path
     if loop is None:
@@ -143,7 +145,7 @@ def _expand_loops(tasks: list[Task]) -> list[Task]:
 # -------------------------------------------------------------- IR emission
 
 
-def _param_ref(value: Any) -> dict:
+def _param_ref(value: Any, dynamic_gids: frozenset = frozenset()) -> dict:
     if isinstance(value, PipelineParam):
         return {"componentInputParameter": value.name}
     if isinstance(value, TaskOutput):
@@ -152,15 +154,22 @@ def _param_ref(value: Any) -> dict:
         return {
             "taskOutputParameter": {"producerTask": value.task.name, "outputParameterKey": value.name}
         }
-    if isinstance(value, (LoopItem, LoopItemField)):
+    if isinstance(value, LoopItem):
+        if value.group_id in dynamic_gids:
+            return {"loopItem": {"groupId": value.group_id}}
+        raise CompileError("loop item escaped expansion (used outside its ParallelFor?)")
+    if isinstance(value, LoopItemField):
+        if value.group_id in dynamic_gids:
+            return {"loopItem": {"groupId": value.group_id, "field": value.key}}
         raise CompileError("loop item escaped expansion (used outside its ParallelFor?)")
     return {"constant": value}
 
 
-def _expr_ir(e: Any) -> Any:
+def _expr_ir(e: Any, dynamic_gids: frozenset = frozenset()) -> Any:
     if isinstance(e, ConditionExpr):
-        return {"op": e.op, "left": _expr_ir(e.left), "right": _expr_ir(e.right)}
-    return _param_ref(e)
+        return {"op": e.op, "left": _expr_ir(e.left, dynamic_gids),
+                "right": _expr_ir(e.right, dynamic_gids)}
+    return _param_ref(e, dynamic_gids)
 
 
 class Compiler:
@@ -173,6 +182,73 @@ class Compiler:
         names = [t.name for t in tasks]
         if len(set(names)) != len(names):
             raise CompileError(f"duplicate task names after expansion: {sorted(names)}")
+
+        # dynamic ParallelFor groups survive expansion: validate structure
+        # and collect their gids for loopItem IR markers
+        dyn_groups: dict[int, _Group] = {}
+        for t in tasks:
+            dyn_in_path = [g for g in t.group_path
+                           if g.kind == "loop" and g.items_from is not None]
+            for g in dyn_in_path:
+                dyn_groups[g.group_id] = g
+            if len(dyn_in_path) > 1:
+                raise CompileError(
+                    f"task {t.name!r}: dynamic ParallelFors cannot nest "
+                    "inside each other (one runtime iterator per task)")
+        import re as _re
+
+        for g in dyn_groups.values():
+            inside_ids = {id(t) for t in tasks
+                          if any(x is g for x in t.group_path)}
+            if id(g.items_from.task) in inside_ids:
+                raise CompileError(
+                    f"dynamic ParallelFor iterates the output of "
+                    f"{g.items_from.task.name!r}, which is inside the loop")
+            for g2 in dyn_groups.values():
+                if g2 is not g and any(x is g2 for x in
+                                       g.items_from.task.group_path):
+                    raise CompileError(
+                        f"dynamic ParallelFor iterates the output of "
+                        f"{g.items_from.task.name!r}, which is inside another "
+                        "dynamic ParallelFor; fan-in is not supported")
+            if g.items_from.task.name not in names:
+                # e.g. the producer sat inside an enclosing STATIC loop and
+                # was cloned away — the runtime reference would dangle
+                raise CompileError(
+                    f"dynamic ParallelFor source {g.items_from.task.name!r} "
+                    "does not survive loop expansion (was it defined inside "
+                    "an enclosing ParallelFor?)")
+        for t in tasks:
+            if not any(g.kind == "loop" and g.items_from is not None
+                       for g in t.group_path):
+                continue
+            # runtime children are named {task}-it{K}: a REAL task with that
+            # literal name would alias the child's status-node entry
+            pat = _re.compile(_re.escape(t.name) + r"-it\d+$")
+            clash = [n for n in names if n != t.name and pat.fullmatch(n)]
+            if clash:
+                raise CompileError(
+                    f"task name {clash[0]!r} collides with runtime children "
+                    f"of the dynamic ParallelFor task {t.name!r}")
+            for t in tasks:
+                if id(t) in inside_ids:
+                    continue
+                # DATA fan-in (outputs/conditions) is ambiguous — which
+                # iteration? — and rejected, matching the static expansion.
+                # Plain .after() CONTROL deps are fine: the loop's virtual
+                # node aggregates its children, so the dependent gates on
+                # "all iterations terminal".
+                refs = [v.task.name for v in t.inputs.values()
+                        if isinstance(v, TaskOutput) and id(v.task) in inside_ids]
+                for gp in t.group_path:
+                    if gp.kind == "condition" and gp.condition is not None:
+                        refs += [rt.name for rt in gp.condition.referenced_tasks()
+                                 if id(rt) in inside_ids]
+                if refs:
+                    raise CompileError(
+                        f"task {t.name!r} references {refs[0]!r} inside a "
+                        "dynamic ParallelFor from outside the loop; fan-in "
+                        "is not supported")
 
         # ExitHandler wiring: every task inside an exit group becomes a
         # dependency of that group's cleanup task, which is flagged so the
@@ -240,6 +316,12 @@ class Compiler:
                     }
                 }
             deps = {d.name for d in t.dependencies}
+            # loopItem markers are legal only for dynamic groups THIS task
+            # sits in — an item that escaped its with-block must fail the
+            # compile exactly like the static path does
+            task_dyn_gids = frozenset(
+                g.group_id for g in t.group_path
+                if g.kind == "loop" and g.items_from is not None)
             params_ir: dict = {}
             artifacts_ir: dict = {}
             for pname, value in sorted(t.inputs.items()):
@@ -257,15 +339,22 @@ class Compiler:
                     }
                     deps.add(value.task.name)
                 else:
-                    params_ir[pname] = _param_ref(value)
+                    params_ir[pname] = _param_ref(value, task_dyn_gids)
                     if isinstance(value, TaskOutput):
                         deps.add(value.task.name)
             conditions = []
             for g in t.group_path:
                 if g.kind == "condition" and g.condition is not None:
-                    conditions.append(_expr_ir(g.condition))
+                    conditions.append(_expr_ir(g.condition, task_dyn_gids))
                     for rt in g.condition.referenced_tasks():
                         deps.add(rt.name)
+            iterator = None
+            for g in t.group_path:
+                if g.kind == "loop" and g.items_from is not None:
+                    iterator = {"producerTask": g.items_from.task.name,
+                                "outputParameterKey": g.items_from.name,
+                                "groupId": g.group_id}
+                    deps.add(g.items_from.task.name)
             if t in exit_deps:
                 deps |= exit_deps[t]
             node: dict = {
@@ -279,6 +368,13 @@ class Compiler:
                 node["isExitHandler"] = True
             if conditions:
                 node["conditions"] = conditions
+            if iterator is not None:
+                if t in exit_deps:
+                    raise CompileError(
+                        f"exit task {t.name!r} cannot sit inside a dynamic "
+                        "ParallelFor (cleanup must run once, after the whole "
+                        "fan-out — place the ExitHandler outside the loop)")
+                node["iterator"] = iterator
             if t.retries:
                 node["retries"] = t.retries
             if t.resources:

@@ -193,6 +193,11 @@ class _Group:
     loop_item: Optional[LoopItem] = None
     tasks: list["Task"] = field(default_factory=list)
     exit_task: Optional["Task"] = None  # kind == "exit": the cleanup task
+    # dynamic ParallelFor: fan out at RUNTIME over a producer task's list
+    # output (upstream KFP v2 `dsl.ParallelFor(task.output)`); the compiler
+    # emits an `iterator` marker instead of cloning, and the workflow
+    # controller expands when the producer completes
+    items_from: Optional[TaskOutput] = None
 
 
 class Condition:
@@ -239,20 +244,31 @@ class ExitHandler:
 
 
 class ParallelFor:
-    """``with dsl.ParallelFor([...]) as item:`` — static fan-out (cloned per item)."""
+    """``with dsl.ParallelFor(items) as item:`` — fan-out per item.
+
+    A static list expands at compile time (cloned tasks); a task output
+    (``dsl.ParallelFor(t.output)``) expands at RUNTIME once the producer
+    finishes — the output must be a JSON list."""
 
     def __init__(self, items: Union[list, tuple, TaskOutput]):
+        self.items: Optional[list] = None
+        self.items_from: Optional[TaskOutput] = None
         if isinstance(items, TaskOutput):
-            raise NotImplementedError(
-                "dynamic ParallelFor over a task output is not supported; "
-                "pass a static list (fan-out is expanded at compile time)"
-            )
-        self.items = list(items)
+            if items.is_artifact:
+                raise TypeError(
+                    "dynamic ParallelFor iterates a parameter output (a JSON "
+                    "list), not an artifact — return the list from the "
+                    "component instead"
+                )
+            self.items_from = items
+        else:
+            self.items = list(items)
 
     def __enter__(self) -> LoopItem:
         ctx = _require_context("dsl.ParallelFor")
         gid = ctx.next_group_id()
-        g = _Group("loop", gid, items=self.items, loop_item=LoopItem(gid))
+        g = _Group("loop", gid, items=self.items, loop_item=LoopItem(gid),
+                   items_from=self.items_from)
         ctx.push_group(g)
         return g.loop_item
 

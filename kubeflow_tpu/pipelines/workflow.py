@@ -72,6 +72,42 @@ def _eval_condition(expr: dict, args: dict, nodes: dict) -> bool:
     return bool(_OPS[expr["op"]](left, right))
 
 
+def _instantiate_iteration(tspec: dict, dag: dict, gid: int, k: int,
+                           item: Any) -> dict:
+    """One dynamic-ParallelFor child's concrete task spec: ``loopItem``
+    markers become constants, and intra-group references retarget the
+    same-index sibling (``dep`` → ``dep-itK``), mirroring the static
+    expansion's clone_map semantics."""
+
+    def same_group(name: str) -> bool:
+        return dag.get(name, {}).get("iterator", {}).get("groupId") == gid
+
+    def subst(v: Any) -> Any:
+        if isinstance(v, dict):
+            if "loopItem" in v and v["loopItem"].get("groupId") == gid:
+                field = v["loopItem"].get("field")
+                if field is None:
+                    return {"constant": item}
+                if not isinstance(item, dict) or field not in item:
+                    raise ValueError(
+                        f"ParallelFor item {item!r} has no field {field!r}")
+                return {"constant": item[field]}
+            out = {kk: subst(vv) for kk, vv in v.items()}
+            for key in ("producerTask",):
+                if key in out and isinstance(out[key], str) and same_group(out[key]):
+                    out[key] = f"{out[key]}-it{k}"
+            return out
+        if isinstance(v, list):
+            return [subst(x) for x in v]
+        return v
+
+    cspec = subst({kk: vv for kk, vv in tspec.items() if kk != "iterator"})
+    cspec["dependentTasks"] = [
+        f"{d}-it{k}" if same_group(d) else d
+        for d in tspec.get("dependentTasks", [])]
+    return cspec
+
+
 class WorkflowController:
     kind = "Workflow"
 
@@ -133,6 +169,12 @@ class WorkflowController:
                 node = nodes.setdefault(tname, {"phase": papi.PENDING, "retries": 0})
                 if node["phase"] in papi.NODE_TERMINAL:
                     continue
+                if "iterator" in dag[tname]:
+                    # dynamic ParallelFor: this entry is a VIRTUAL node that
+                    # expands into children once its producer finishes
+                    if self._drive_iterator(wf, tname, dag[tname], node, args, ir, dag):
+                        pass_progressed = True
+                    continue
                 if node["phase"] == papi.RUNNING:
                     if self._check_pod(wf, tname, dag[tname], node, args):
                         pass_progressed = True
@@ -192,6 +234,90 @@ class WorkflowController:
         if any(p == papi.FAILED for p in phases):
             return papi.FAILED
         return papi.SUCCEEDED
+
+    # ------------------------------------------------- dynamic ParallelFor
+
+    def _drive_iterator(self, wf: Obj, tname: str, tspec: dict, node: dict,
+                        args: dict, ir: dict, dag: dict) -> bool:
+        """Runtime fan-out (dsl.ParallelFor(task.output)): once the producer
+        succeeds, read its JSON-list output and drive one child node per
+        item through the normal driver (conditions, caching, retries all
+        apply per child).  The virtual node's phase aggregates the children,
+        so downstream dependents gate on it like any other task."""
+        nodes = wf["status"]["nodes"]
+        it = tspec["iterator"]
+        if node["phase"] == papi.PENDING:
+            dep_phases = [nodes.get(d, {}).get("phase", papi.PENDING)
+                          for d in tspec.get("dependentTasks", [])]
+            if any(p in (papi.FAILED, papi.SKIPPED, papi.OMITTED)
+                   for p in dep_phases):
+                node["phase"] = papi.OMITTED
+                return True
+            if not all(p == papi.SUCCEEDED for p in dep_phases):
+                return False
+            raw = nodes.get(it["producerTask"], {}).get(
+                "outputParameters", {}).get(it["outputParameterKey"])
+            items = raw
+            if isinstance(items, str):
+                try:
+                    items = json.loads(items)
+                except ValueError:
+                    items = None
+            if not isinstance(items, list):
+                node.update(phase=papi.FAILED,
+                            message=f"ParallelFor source "
+                                    f"{it['producerTask']}.{it['outputParameterKey']} "
+                                    f"is not a JSON list: {raw!r}")
+                self.recorder.warning(wf, "IteratorInvalid", node["message"])
+                return True
+            node["items"] = items
+            node["phase"] = papi.SUCCEEDED if not items else papi.RUNNING
+            return True
+        if node["phase"] != papi.RUNNING:
+            return False
+        progressed = False
+        child_phases = []
+        for k, item in enumerate(node.get("items", [])):
+            cname = f"{tname}-it{k}"
+            child = nodes.setdefault(cname, {"phase": papi.PENDING, "retries": 0})
+            if child["phase"] in papi.NODE_TERMINAL:
+                child_phases.append(child["phase"])
+                continue
+            # instantiate ONCE and persist on the child: the substitution is
+            # fully determined by (tspec, k, item), re-deriving it per
+            # fixpoint pass would be pure per-tick overhead — and the
+            # persisted spec survives a controller restart mid-run
+            cspec = child.get("spec")
+            if cspec is None:
+                try:
+                    cspec = _instantiate_iteration(tspec, dag, it["groupId"], k, item)
+                except ValueError as e:  # e.g. item missing a referenced field
+                    child.update(phase=papi.FAILED, message=str(e))
+                    self.recorder.warning(wf, "IteratorItemInvalid", str(e))
+                    child_phases.append(child["phase"])
+                    progressed = True
+                    continue
+                child["spec"] = cspec
+            if child["phase"] == papi.RUNNING:
+                if self._check_pod(wf, cname, cspec, child, args):
+                    progressed = True
+            else:
+                dep_phases = [nodes.get(d, {}).get("phase", papi.PENDING)
+                              for d in cspec.get("dependentTasks", [])]
+                if any(p in (papi.FAILED, papi.SKIPPED, papi.OMITTED)
+                       for p in dep_phases):
+                    child["phase"] = papi.OMITTED
+                    progressed = True
+                elif all(p == papi.SUCCEEDED for p in dep_phases):
+                    if self._drive(wf, cname, cspec, child, args, ir):
+                        progressed = True
+            child_phases.append(child["phase"])
+        if child_phases and all(p in papi.NODE_TERMINAL for p in child_phases):
+            node["phase"] = (papi.FAILED
+                             if any(p == papi.FAILED for p in child_phases)
+                             else papi.SUCCEEDED)
+            progressed = True
+        return progressed
 
     # ---------------------------------------------------------------- driver
 

@@ -500,3 +500,148 @@ def test_webui_pipelines_and_run_graph(tpu_cluster):
         assert e.value.code == 404
     finally:
         ui.shutdown()
+
+
+# ------------------------------------------------- dynamic ParallelFor
+
+
+@dsl.component
+def list_shards(n: int) -> list:
+    return [f"shard-{i}" for i in range(n)]
+
+
+@dsl.component
+def process_shard(shard: str) -> str:
+    return shard.upper()
+
+
+@dsl.component
+def summarize() -> str:
+    return "done"
+
+
+@dsl.pipeline(name="dynamic-fanout")
+def dynamic_fanout(n: int = 3):
+    shards = list_shards(n=n)
+    with dsl.ParallelFor(shards.output) as shard:
+        p = process_shard(shard=shard)
+    # control-flow barrier on the whole fan-out (the loop's virtual node)
+    summarize().after(p)
+
+
+def test_dynamic_parallelfor_compiles_iterator_ir():
+    ir = Compiler().compile(dynamic_fanout)
+    tasks = ir["root"]["dag"]["tasks"]
+    it = tasks["process-shard"]["iterator"]
+    assert it["producerTask"] == "list-shards"
+    assert it["outputParameterKey"] == "Output"
+    assert tasks["process-shard"]["inputs"]["parameters"]["shard"] == {
+        "loopItem": {"groupId": it["groupId"]}}
+    assert "list-shards" in tasks["process-shard"]["dependentTasks"]
+
+
+def test_dynamic_parallelfor_runtime_fanout(tpu_cluster):
+    """The loop width comes from the RUNTIME list (n=4 → 4 children), each
+    child sees its item, and the virtual loop node aggregates to Succeeded."""
+    cluster = tpu_cluster
+    client = Client(cluster)
+    run = client.create_run_from_pipeline_func(dynamic_fanout,
+                                               arguments={"n": 4})
+    rec = run.wait(timeout=120)
+    assert rec["phase"] == papi.SUCCEEDED, rec
+    nodes = rec["nodes"]
+    assert nodes["process-shard"]["phase"] == papi.SUCCEEDED  # virtual node
+    assert nodes["process-shard"]["items"] == [f"shard-{i}" for i in range(4)]
+    for i in range(4):
+        child = nodes[f"process-shard-it{i}"]
+        assert child["phase"] == papi.SUCCEEDED
+        assert child["outputParameters"]["Output"] == f"SHARD-{i}".upper()
+    assert f"process-shard-it4" not in nodes
+
+
+def test_dynamic_parallelfor_empty_list_succeeds(tpu_cluster):
+    cluster = tpu_cluster
+    client = Client(cluster)
+    run = client.create_run_from_pipeline_func(dynamic_fanout,
+                                               arguments={"n": 0})
+    rec = run.wait(timeout=60)
+    assert rec["phase"] == papi.SUCCEEDED
+    assert rec["nodes"]["process-shard"]["phase"] == papi.SUCCEEDED
+    assert rec["nodes"]["process-shard"]["items"] == []
+
+
+def test_dynamic_parallelfor_rejects_fanin_and_nesting():
+    @dsl.pipeline(name="bad-fanin")
+    def bad_fanin():
+        shards = list_shards(n=2)
+        with dsl.ParallelFor(shards.output) as shard:
+            p = process_shard(shard=shard)
+        process_shard(shard=p.output)  # DATA fan-in: which iteration?
+
+    with pytest.raises(CompileError, match="fan-in"):
+        Compiler().compile(bad_fanin)
+
+    # LEGAL: dynamic inside a static loop with an OUTSIDE producer — each
+    # static clone fans out over the same runtime list
+    @dsl.pipeline(name="static-x-dynamic")
+    def static_x_dynamic():
+        shards = list_shards(n=2)
+        with dsl.ParallelFor(["a", "b"]):
+            with dsl.ParallelFor(shards.output) as shard:
+                process_shard(shard=shard)
+
+    ir = Compiler().compile(static_x_dynamic)
+    tasks = ir["root"]["dag"]["tasks"]
+    assert "iterator" in tasks["process-shard-it0"]
+    assert "iterator" in tasks["process-shard-it1"]
+
+    # BROKEN: the dynamic source itself sits inside the enclosing static
+    # loop, so its name is cloned away — must be a compile error
+    @dsl.pipeline(name="bad-cloned-source")
+    def bad_cloned_source():
+        with dsl.ParallelFor([1, 2]) as n:
+            shards = list_shards(n=n)
+            with dsl.ParallelFor(shards.output) as shard:
+                process_shard(shard=shard)
+
+    with pytest.raises(CompileError, match="ParallelFor"):
+        Compiler().compile(bad_cloned_source)
+
+
+def test_dynamic_parallelfor_rejects_escaped_item_and_exit_handler():
+    # a loop item used OUTSIDE its with-block must fail the compile, exactly
+    # like the static path
+    @dsl.pipeline(name="escaped")
+    def escaped():
+        shards = list_shards(n=2)
+        with dsl.ParallelFor(shards.output) as shard:
+            process_shard(shard=shard)
+        process_shard(shard=shard)  # escaped reference
+
+    with pytest.raises(CompileError, match="escaped"):
+        Compiler().compile(escaped)
+
+    # cleanup must run once after the whole fan-out — an ExitHandler INSIDE
+    # the loop is rejected, not silently mis-scheduled
+    @dsl.pipeline(name="exit-in-loop")
+    def exit_in_loop():
+        shards = list_shards(n=2)
+        with dsl.ParallelFor(shards.output) as shard:
+            cleanup = summarize()
+            with dsl.ExitHandler(cleanup):
+                process_shard(shard=shard)
+
+    with pytest.raises(CompileError, match="exit task"):
+        Compiler().compile(exit_in_loop)
+
+    # iterating the output of a task inside ANOTHER dynamic loop is fan-in
+    @dsl.pipeline(name="chained-dynamic")
+    def chained_dynamic():
+        shards = list_shards(n=2)
+        with dsl.ParallelFor(shards.output) as shard:
+            inner = list_shards(n=2)
+        with dsl.ParallelFor(inner.output) as x:
+            process_shard(shard=x)
+
+    with pytest.raises(CompileError, match="fan-in|inside another"):
+        Compiler().compile(chained_dynamic)
