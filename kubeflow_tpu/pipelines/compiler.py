@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 from . import dsl
 from .dsl import (
+    Collected,
     ConditionExpr,
     LoopItem,
     LoopItemField,
@@ -154,6 +155,11 @@ def _param_ref(value: Any, dynamic_gids: frozenset = frozenset()) -> dict:
         return {
             "taskOutputParameter": {"producerTask": value.task.name, "outputParameterKey": value.name}
         }
+    if isinstance(value, Collected):
+        return {"collectedOutput": {
+            "producerTask": value.source.task.name,
+            "outputParameterKey": value.source.name,
+        }}
     if isinstance(value, LoopItem):
         if value.group_id in dynamic_gids:
             return {"loopItem": {"groupId": value.group_id}}
@@ -218,6 +224,24 @@ class Compiler:
                     f"dynamic ParallelFor source {g.items_from.task.name!r} "
                     "does not survive loop expansion (was it defined inside "
                     "an enclosing ParallelFor?)")
+            for t in tasks:
+                if id(t) in inside_ids:
+                    continue
+                # DATA fan-in (outputs/conditions) is ambiguous — which
+                # iteration? — and rejected, matching the static expansion;
+                # dsl.Collected is the sanctioned fan-in and plain .after()
+                # CONTROL deps gate on the loop's virtual node.
+                refs = [v.task.name for v in t.inputs.values()
+                        if isinstance(v, TaskOutput) and id(v.task) in inside_ids]
+                for gp in t.group_path:
+                    if gp.kind == "condition" and gp.condition is not None:
+                        refs += [rt.name for rt in gp.condition.referenced_tasks()
+                                 if id(rt) in inside_ids]
+                if refs:
+                    raise CompileError(
+                        f"task {t.name!r} references {refs[0]!r} inside a "
+                        "dynamic ParallelFor from outside the loop; fan-in "
+                        "is not supported (dsl.Collected collects outputs)")
         for t in tasks:
             if not any(g.kind == "loop" and g.items_from is not None
                        for g in t.group_path):
@@ -230,25 +254,49 @@ class Compiler:
                 raise CompileError(
                     f"task name {clash[0]!r} collides with runtime children "
                     f"of the dynamic ParallelFor task {t.name!r}")
-            for t in tasks:
-                if id(t) in inside_ids:
-                    continue
-                # DATA fan-in (outputs/conditions) is ambiguous — which
-                # iteration? — and rejected, matching the static expansion.
-                # Plain .after() CONTROL deps are fine: the loop's virtual
-                # node aggregates its children, so the dependent gates on
-                # "all iterations terminal".
-                refs = [v.task.name for v in t.inputs.values()
-                        if isinstance(v, TaskOutput) and id(v.task) in inside_ids]
-                for gp in t.group_path:
-                    if gp.kind == "condition" and gp.condition is not None:
-                        refs += [rt.name for rt in gp.condition.referenced_tasks()
-                                 if id(rt) in inside_ids]
-                if refs:
+        def _exprs_contain_collected(e) -> bool:
+            if isinstance(e, Collected):
+                return True
+            if isinstance(e, ConditionExpr):
+                return (_exprs_contain_collected(e.left)
+                        or _exprs_contain_collected(e.right))
+            return False
+
+        for t in tasks:
+            for g in t.group_path:
+                if (g.kind == "condition" and g.condition is not None
+                        and _exprs_contain_collected(g.condition)):
+                    # referenced_tasks() doesn't see through Collected, so
+                    # the condition would evaluate BEFORE the loop expands
+                    # (against an empty list) — reject rather than misfire
                     raise CompileError(
-                        f"task {t.name!r} references {refs[0]!r} inside a "
-                        "dynamic ParallelFor from outside the loop; fan-in "
-                        "is not supported")
+                        f"task {t.name!r}: dsl.Collected cannot be used in a "
+                        "dsl.Condition — collect into a task input and gate "
+                        "on that task's output instead")
+            for pname, value in t.inputs.items():
+                if not isinstance(value, Collected):
+                    continue
+                src = value.source.task
+                src_dyn = [g for g in src.group_path
+                           if g.kind == "loop" and g.items_from is not None]
+                if not src_dyn:
+                    raise CompileError(
+                        f"task {t.name!r} input {pname!r}: dsl.Collected "
+                        f"source {src.name!r} is not inside a dynamic "
+                        "ParallelFor — use the output directly")
+                if any(g is src_dyn[-1] for g in t.group_path):
+                    raise CompileError(
+                        f"task {t.name!r} input {pname!r}: dsl.Collected "
+                        "must be consumed OUTSIDE the loop it collects "
+                        "(inside it, use the loop item / task output)")
+                if src.name not in names:
+                    # cloned away by an enclosing static loop: the emitted
+                    # producerTask would dangle and the run would hang
+                    raise CompileError(
+                        f"task {t.name!r} input {pname!r}: dsl.Collected "
+                        f"source {src.name!r} does not survive loop "
+                        "expansion (is it inside an enclosing static "
+                        "ParallelFor?)")
 
         # ExitHandler wiring: every task inside an exit group becomes a
         # dependency of that group's cleanup task, which is flagged so the
@@ -270,7 +318,7 @@ class Compiler:
             # input could be unresolvable at execution time — forbid them
             # (upstream likewise restricts exit-handler inputs)
             for pname, value in et.inputs.items():
-                if isinstance(value, TaskOutput):
+                if isinstance(value, (TaskOutput, Collected)):
                     raise CompileError(
                         f"exit task {et.name!r} input {pname!r} references a task "
                         "output; exit handlers run after failures too, so they "
@@ -342,6 +390,10 @@ class Compiler:
                     params_ir[pname] = _param_ref(value, task_dyn_gids)
                     if isinstance(value, TaskOutput):
                         deps.add(value.task.name)
+                    elif isinstance(value, Collected):
+                        # gate on the loop's VIRTUAL node: all iterations
+                        # terminal before the collection resolves
+                        deps.add(value.source.task.name)
             conditions = []
             for g in t.group_path:
                 if g.kind == "condition" and g.condition is not None:

@@ -169,6 +169,26 @@ class TaskOutput(_Comparable):
     type: str = "String"
 
 
+@dataclass(eq=False)
+class Collected(_Comparable):
+    """Fan-in over a dynamic ParallelFor: ``dsl.Collected(task.output)``
+    consumed OUTSIDE the loop resolves at runtime to the list of every
+    iteration's output, in item order (upstream KFP v2 ``dsl.Collected``).
+    Parameter outputs only — collect an artifact by returning its path/
+    content as a parameter."""
+
+    source: TaskOutput
+
+    def __post_init__(self):
+        if not isinstance(self.source, TaskOutput):
+            raise TypeError("dsl.Collected takes a task output "
+                            "(e.g. Collected(task.output))")
+        if self.source.is_artifact:
+            raise TypeError(
+                "dsl.Collected collects parameter outputs; return the "
+                "artifact's content (or URI) as a parameter to collect it")
+
+
 class ConditionExpr:
     """A binary comparison over references/constants, evaluated by the driver."""
 

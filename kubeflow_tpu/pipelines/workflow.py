@@ -53,6 +53,25 @@ def _resolve_ref(ref: dict, args: dict, nodes: dict) -> Any:
                 f"parameter {src['outputParameterKey']!r}"
             )
         return outs[src["outputParameterKey"]]
+    if "collectedOutput" in ref:
+        # dsl.Collected fan-in: the per-iteration outputs of a dynamic
+        # ParallelFor, in item order.  The consumer depends on the loop's
+        # virtual node, so every child is terminal here; iterations a
+        # Condition skipped contribute nothing (upstream semantics).
+        src = ref["collectedOutput"]
+        virtual = nodes.get(src["producerTask"], {})
+        out = []
+        for k in range(len(virtual.get("items", []))):
+            child = nodes.get(f"{src['producerTask']}-it{k}", {})
+            outs = child.get("outputParameters")
+            if outs is None:
+                continue  # skipped/omitted iteration
+            if src["outputParameterKey"] not in outs:
+                raise KeyError(
+                    f"iteration {k} of {src['producerTask']!r} produced no "
+                    f"output parameter {src['outputParameterKey']!r}")
+            out.append(outs[src["outputParameterKey"]])
+        return out
     raise ValueError(f"unresolvable reference: {ref!r}")
 
 

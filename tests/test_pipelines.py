@@ -645,3 +645,78 @@ def test_dynamic_parallelfor_rejects_escaped_item_and_exit_handler():
 
     with pytest.raises(CompileError, match="fan-in|inside another"):
         Compiler().compile(chained_dynamic)
+
+
+# ----------------------------------------------------------- dsl.Collected
+
+
+@dsl.component
+def merge(values: list) -> str:
+    return "|".join(values)
+
+
+@dsl.pipeline(name="collect-fanin")
+def collect_fanin(n: int = 3):
+    shards = list_shards(n=n)
+    with dsl.ParallelFor(shards.output) as shard:
+        w = process_shard(shard=shard)
+    merge(values=dsl.Collected(w.output))
+
+
+def test_collected_fans_in_iteration_outputs(tpu_cluster):
+    """dsl.Collected: the consumer sees every iteration's output as one
+    list, in item order, and only runs after the whole fan-out."""
+    cluster = tpu_cluster
+    client = Client(cluster)
+    run = client.create_run_from_pipeline_func(collect_fanin,
+                                               arguments={"n": 3})
+    rec = run.wait(timeout=120)
+    assert rec["phase"] == papi.SUCCEEDED, rec
+    merged = rec["nodes"]["merge"]
+    assert merged["phase"] == papi.SUCCEEDED
+    assert merged["inputParameters"]["values"] == [
+        "SHARD-0", "SHARD-1", "SHARD-2"]
+
+
+def test_collected_compile_guards():
+    @dsl.pipeline(name="collect-outside")
+    def collect_outside():
+        s = summarize()
+        merge(values=dsl.Collected(s.output))
+
+    with pytest.raises(CompileError, match="not inside a dynamic"):
+        Compiler().compile(collect_outside)
+
+    @dsl.pipeline(name="collect-inside")
+    def collect_inside():
+        shards = list_shards(n=2)
+        with dsl.ParallelFor(shards.output) as shard:
+            w = process_shard(shard=shard)
+            merge(values=dsl.Collected(w.output))
+
+    with pytest.raises(CompileError, match="OUTSIDE"):
+        Compiler().compile(collect_inside)
+
+
+def test_collected_rejects_condition_and_cloned_source():
+    @dsl.pipeline(name="collect-in-cond")
+    def collect_in_cond():
+        shards = list_shards(n=2)
+        with dsl.ParallelFor(shards.output) as shard:
+            w = process_shard(shard=shard)
+        with dsl.Condition(dsl.Collected(w.output) != []):
+            summarize()
+
+    with pytest.raises(CompileError, match="Condition"):
+        Compiler().compile(collect_in_cond)
+
+    @dsl.pipeline(name="collect-cloned")
+    def collect_cloned():
+        shards = list_shards(n=2)
+        with dsl.ParallelFor(["a", "b"]):
+            with dsl.ParallelFor(shards.output) as shard:
+                w = process_shard(shard=shard)
+        merge(values=dsl.Collected(w.output))
+
+    with pytest.raises(CompileError, match="survive"):
+        Compiler().compile(collect_cloned)
