@@ -11,6 +11,8 @@ from __future__ import annotations
 import json
 import os
 import subprocess
+
+import pytest
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -27,6 +29,8 @@ def _cli(*args: str, timeout: float = 180.0):
     )
 
 
+# slow lane: ~9s (CLI subprocess + pod); the CLI e2e path stays covered by the failing-pod test
+@pytest.mark.slow
 def test_cli_apply_tpujob_example_succeeds():
     proc = _cli("apply", "-f", os.path.join(REPO, "examples", "tpujob.yaml"),
                 "--wait", "--logs", "--apps", "training")

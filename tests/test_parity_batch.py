@@ -86,6 +86,8 @@ SLOW_BAD_TRIAL = (
 )
 
 
+# slow lane: ~15s E2E; early-stop condition handling keeps fast coverage via the obslog store tests
+@pytest.mark.slow
 def test_medianstop_early_stops_bad_trials(kcluster):
     trial_spec = {
         "apiVersion": "kubeflow.org/v1",

@@ -415,6 +415,8 @@ def test_paddlejob_trainer_endpoints(tcluster):
     assert w0["TRAINING_ROLE"] == "TRAINER" and w0["PADDLE_TRAINERS_NUM"] == "2"
 
 
+# slow lane: ~14s E2E; the shrink path keeps fast coverage via test_pytorchjob_elastic_shrinks
+@pytest.mark.slow
 def test_pytorchjob_elastic_scale_up_after_shrink(tcluster, tmp_path):
     """Elastic scale-UP: after a shrink, growth re-expands toward the spec
     count once the cooldown passes (opt-in via elasticPolicy.scaleUp)."""
