@@ -122,6 +122,7 @@ class JetStreamModel(Model):
         r = self.engine.generate(ids, max_tokens)
         return {"text_output": self.tokenizer.decode(r["tokens"]),
                 "token_ids": r["tokens"], "tokens": r["num_tokens"],
+                "prompt_tokens": len(ids), "max_tokens": max_tokens,
                 "ttft_s": round(r["ttft_s"], 4), "latency_s": round(r["latency_s"], 4)}
 
     def generate_stream(self, payload: Any, headers: Optional[dict] = None):
@@ -144,6 +145,7 @@ class JetStreamModel(Model):
                     if len(full) > emitted:  # flush held-back tail
                         yield {"text_output": full[emitted:]}
                     yield {"text_output": "", "done": True, "tokens": item["num_tokens"],
+                           "prompt_tokens": len(ids), "max_tokens": max_tokens,
                            "ttft_s": round(item["ttft_s"], 4),
                            "latency_s": round(item["latency_s"], 4)}
                     return

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import threading
+import uuid
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
@@ -173,6 +174,10 @@ class ModelServer:
             h._send(200, {"name": "kubeflow-tpu-server", "extensions": []})
         elif path == "/v2/models":
             h._send(200, {"models": sorted(self.models)})
+        elif path == "/openai/v1/models":
+            h._send(200, {"object": "list", "data": [
+                {"id": n, "object": "model", "owned_by": "kubeflow-tpu"}
+                for n in sorted(self.models)]})
         elif path.startswith("/v1/models/"):
             name = path[len("/v1/models/"):]
             m = self.models.get(name)
@@ -209,6 +214,10 @@ class ModelServer:
             elif path.startswith("/v2/models/") and path.endswith("/generate"):
                 name = path[len("/v2/models/"):-len("/generate")]
                 self._generate(h, name, stream=False)
+            elif path == "/openai/v1/completions":
+                self._openai(h, chat=False)
+            elif path == "/openai/v1/chat/completions":
+                self._openai(h, chat=True)
             else:
                 h._send(404, {"error": f"no route {path}"})
         except Exception as e:  # noqa: BLE001 — server must answer
@@ -254,31 +263,163 @@ class ModelServer:
             out.setdefault("model_name", name)
             h._send(200, out)
             return
+        gen = verb(body, headers)
+        self._sse_write(
+            h, gen,
+            (b"data: " + json.dumps(e).encode() + b"\n\n" for e in gen),
+            lambda e: b"data: " + json.dumps(
+                {"error": f"{type(e).__name__}: {e}", "done": True}
+            ).encode() + b"\n\n")
+
+    @staticmethod
+    def _sse_write(h, gen, lines, error_line) -> None:
+        """SSE mechanics shared by /generate_stream and the OpenAI surface.
+
+        Once headers are out, errors must stay INSIDE the event stream —
+        letting them reach _handle_post's catch-all would write a second
+        HTTP response into the SSE body (and a client disconnect would
+        raise again from that very write).  ``gen`` is closed in all cases
+        for a deterministic GeneratorExit → engine cancel."""
         h.send_response(200)
         h.send_header("Content-Type", "text/event-stream")
         h.send_header("Cache-Control", "no-cache")
         h.send_header("Connection", "close")  # stream length unknown: SSE
         h.end_headers()
-        # headers are out: errors must stay INSIDE the event stream — letting
-        # them reach _handle_post's catch-all would write a second HTTP
-        # response into the SSE body (and a client disconnect would raise
-        # again from that very write)
-        gen = verb(body, headers)
         try:
-            for event in gen:
-                h.wfile.write(b"data: " + json.dumps(event).encode() + b"\n\n")
+            for line in lines:
+                h.wfile.write(line)
                 h.wfile.flush()
         except OSError:
             pass  # client went away mid-stream
         except Exception as e:  # noqa: BLE001 — surface as a final event
             try:
-                h.wfile.write(b"data: " + json.dumps(
-                    {"error": f"{type(e).__name__}: {e}", "done": True}).encode() + b"\n\n")
+                h.wfile.write(error_line(e))
             except OSError:
                 pass
         finally:
             if hasattr(gen, "close"):
-                gen.close()  # deterministic GeneratorExit → engine cancel
+                gen.close()
+
+    # ------------------------------------------------ OpenAI compatibility
+
+    def _openai(self, h, chat: bool) -> None:
+        """OpenAI-compatible completions surface (the KServe huggingface
+        runtime exposes the same paths for LLM clients): ``/openai/v1/
+        completions`` and ``/chat/completions``, unary or ``stream: true``
+        SSE chunks ending with ``data: [DONE]``.  Chat messages render
+        through a minimal role-tagged template."""
+        body = h._body() or {}
+        name = body.get("model")
+        if name is None and len(self.models) == 1:
+            name = next(iter(self.models))
+        m = self.models.get(name)
+        if m is None or getattr(m, "generate", None) is None:
+            h._send(404, {"error": {
+                "message": f"model {name!r} not found or not generative",
+                "type": "invalid_request_error"}})
+            return
+        def bad_request(msg: str) -> None:
+            h._send(400, {"error": {"message": msg,
+                          "type": "invalid_request_error"}})
+
+        if chat:
+            msgs = body.get("messages")
+            if not isinstance(msgs, list) or not msgs:
+                bad_request("messages required")
+                return
+            parts = []
+            for mm in msgs:
+                content = mm.get("content", "")
+                if isinstance(content, list):
+                    # OpenAI content-parts form: flatten the text parts
+                    # (the official SDKs emit this for multimodal requests)
+                    content = "".join(p.get("text", "") for p in content
+                                      if isinstance(p, dict)
+                                      and p.get("type") == "text")
+                elif not isinstance(content, str):
+                    bad_request(f"message content must be a string or "
+                                f"content-part list, got {type(content).__name__}")
+                    return
+                parts.append(f"<|{mm.get('role', 'user')}|>{content}\n")
+            prompt = "".join(parts) + "<|assistant|>"
+        else:
+            prompt = body.get("prompt")
+            if not isinstance(prompt, str):
+                bad_request("prompt required")
+                return
+        max_tokens = body.get("max_tokens")
+        if max_tokens is None:
+            max_tokens = 16  # OpenAI's documented default; null means unset
+        if not isinstance(max_tokens, int) or max_tokens < 1:
+            bad_request(f"max_tokens must be a positive integer, "
+                        f"got {max_tokens!r}")
+            return
+        payload = {"text_input": prompt,
+                   "parameters": {"max_tokens": max_tokens}}
+        headers = dict(h.headers.items())
+        oid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:24]}"
+        obj = "chat.completion" if chat else "text_completion"
+        if not body.get("stream"):
+            out = m.generate(payload, headers)
+            finish = ("length" if out.get("tokens", 0) >= out.get("max_tokens", 0)
+                      else "stop")
+            choice = ({"index": 0, "message": {"role": "assistant",
+                                               "content": out["text_output"]},
+                       "finish_reason": finish} if chat else
+                      {"index": 0, "text": out["text_output"],
+                       "finish_reason": finish})
+            h._send(200, {
+                "id": oid, "object": obj, "created": int(time.time()),
+                "model": name, "choices": [choice],
+                "usage": {"prompt_tokens": out.get("prompt_tokens", 0),
+                          "completion_tokens": out.get("tokens", 0),
+                          "total_tokens": out.get("prompt_tokens", 0)
+                          + out.get("tokens", 0)},
+            })
+            return
+        if getattr(m, "generate_stream", None) is None:
+            h._send(400, {"error": {"message": "streaming unsupported",
+                          "type": "invalid_request_error"}})
+            return
+        chunk_obj = "chat.completion.chunk" if chat else "text_completion"
+
+        def chunk(piece: str, finish=None, delta_extra=None) -> dict:
+            if chat:
+                delta = dict(delta_extra or {})
+                if piece:
+                    delta["content"] = piece
+                c = {"index": 0, "delta": delta, "finish_reason": finish}
+            else:
+                c = {"index": 0, "text": piece, "finish_reason": finish}
+            return {"id": oid, "object": chunk_obj,
+                    "created": int(time.time()), "model": name,
+                    "choices": [c]}
+
+        gen = m.generate_stream(payload, headers)
+
+        def lines():
+            first = True
+            for event in gen:
+                if event.get("done"):
+                    finish = ("length" if event.get("tokens", 0)
+                              >= event.get("max_tokens", 0) else "stop")
+                    yield (b"data: " + json.dumps(chunk("", finish)).encode()
+                           + b"\n\n")
+                    break
+                # the stream contract's first chat chunk carries the role —
+                # strict parsers key message assembly off delta.role
+                extra = {"role": "assistant"} if chat and first else None
+                first = False
+                yield (b"data: " + json.dumps(
+                    chunk(event["text_output"], delta_extra=extra)).encode()
+                    + b"\n\n")
+            yield b"data: [DONE]\n\n"
+
+        self._sse_write(
+            h, gen, lines(),
+            lambda e: b"data: " + json.dumps(
+                {"error": {"message": f"{type(e).__name__}: {e}"}}
+            ).encode() + b"\n\ndata: [DONE]\n\n")
 
     def _v2(self, h, name: str) -> None:
         m = self.models.get(name)

@@ -1115,3 +1115,87 @@ def test_engine_weight_quant_with_tp_and_int8_kv(params):
         assert all(0 <= t < CFG.vocab_size for t in out["tokens"])
     finally:
         eng.stop()
+
+
+def test_model_server_openai_compat(params):
+    """OpenAI-compatible surface (the KServe huggingface-runtime paths):
+    /openai/v1/models, /completions (unary + SSE with [DONE]), and
+    /chat/completions; usage token accounting filled in."""
+    import urllib.request
+
+    from kubeflow_tpu.serving.server import ModelServer
+
+    eng = Engine(params, CFG, EngineConfig(max_slots=2, num_pages=64,
+                                           page_size=8, max_pages_per_slot=16))
+    m = JetStreamModel("llm", engine=eng)
+    srv = ModelServer([m])
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/openai/v1"
+        models = json.loads(urllib.request.urlopen(base + "/models", timeout=30).read())
+        assert models["data"][0]["id"] == "llm"
+
+        def post(path, payload):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            return urllib.request.urlopen(req, timeout=120)
+
+        # unary completions; model omitted = the single served model
+        out = json.loads(post("/completions",
+                              {"prompt": "ab", "max_tokens": 4}).read())
+        assert out["object"] == "text_completion" and out["model"] == "llm"
+        assert out["choices"][0]["finish_reason"] == "length"
+        assert out["usage"]["completion_tokens"] == 4
+        assert out["usage"]["total_tokens"] == out["usage"]["prompt_tokens"] + 4
+
+        # chat: role-tagged template, assistant message back
+        chat = json.loads(post("/chat/completions", {
+            "model": "llm", "max_tokens": 3,
+            "messages": [{"role": "system", "content": "be brief"},
+                         {"role": "user", "content":
+                          [{"type": "text", "text": "hi"}]}]}).read())
+        assert chat["object"] == "chat.completion"
+        assert chat["choices"][0]["message"]["role"] == "assistant"
+        assert isinstance(chat["choices"][0]["message"]["content"], str)
+
+        # chat streaming: first chunk's delta carries the assistant role
+        resp = post("/chat/completions", {
+            "model": "llm", "max_tokens": 3, "stream": True,
+            "messages": [{"role": "user", "content": "hi"}]})
+        raw = [line[len(b"data: "):] for line in resp.read().split(b"\n\n")
+               if line.startswith(b"data: ")]
+        assert raw[-1] == b"[DONE]"
+        first = json.loads(raw[0])
+        assert first["choices"][0]["delta"]["role"] == "assistant"
+
+        # OpenAI nullable max_tokens and bad values -> envelope errors
+        out2 = json.loads(post("/completions",
+                               {"prompt": "ab", "max_tokens": None}).read())
+        assert out2["usage"]["completion_tokens"] <= 16
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post("/completions", {"prompt": "ab", "max_tokens": "abc"})
+        assert e.value.code == 400
+
+        # streaming: delta chunks then [DONE]; concatenation == unary text
+        resp = post("/completions", {"prompt": "ab", "max_tokens": 4,
+                                     "stream": True})
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        raw = [line[len(b"data: "):] for line in resp.read().split(b"\n\n")
+               if line.startswith(b"data: ")]
+        assert raw[-1] == b"[DONE]"
+        chunks = [json.loads(x) for x in raw[:-1]]
+        text = "".join(c["choices"][0]["text"] for c in chunks)
+        assert text == out["choices"][0]["text"]
+        assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+        # errors follow the OpenAI error envelope
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post("/completions", {"model": "ghost", "prompt": "x"})
+        assert e.value.code == 404
+        assert "invalid_request_error" in e.value.read().decode()
+    finally:
+        srv.stop()
+        eng.stop()
