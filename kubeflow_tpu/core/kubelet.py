@@ -134,14 +134,21 @@ class LocalProcessKubelet:
         )
         run.log_path = os.path.join(self.logdir, f"{run.namespace}_{run.name}.log")
         run.stop_path = run.log_path + f".{run.uid}.stop"
-        # a recreated same-named pod must not see the previous incarnation's
-        # log tail (a fresh metrics collector starts at offset 0 and would
-        # re-push the old run's objective values into the new trial); stale
-        # stop files are uid-scoped litter from reaped runs
+        # stale stop files are uid-scoped litter from reaped runs; the LOG is
+        # truncated only for SIDECAR-bearing pods — a freshly injected
+        # metrics collector starts at offset 0 and would re-push the previous
+        # incarnation's objective values into the new trial.  Sidecar-less
+        # pods keep the name-scoped accumulate behavior: gang-restarted
+        # TPUJob workers append across incarnations, which is how the
+        # resume-continuity tests (and operators reading logs) observe that
+        # a restart actually resumed from the checkpoint.
         import glob as _glob
-        for stale in [run.log_path] + _glob.glob(run.log_path + ".*.stop"):
+        stale = list(_glob.glob(run.log_path + ".*.stop"))
+        if run.sidecar_containers:
+            stale.append(run.log_path)
+        for path in stale:
             try:
-                os.unlink(stale)
+                os.unlink(path)
             except OSError:
                 pass
         self._runs[meta["uid"]] = run
