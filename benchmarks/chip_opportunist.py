@@ -35,8 +35,14 @@ RESULTS = os.path.join(REPO, "CHIP_RESULTS.jsonl")
 
 SWEEP = [sys.executable, os.path.join(REPO, "benchmarks", "mfu_sweep.py")]
 JOBS = [
-    # (name, cmd, timeout_s)
+    # (name, cmd, timeout_s[, env_extra])
     ("mfu_save_mlp_256", SWEEP + ["256", "128", "1", "save_mlp", "dense", "8"], 540),
+    ("mfu_save_attn_768", SWEEP + ["768", "128", "1", "save_attn", "dense", "8"], 540),
+    # XLA cost-model attribution for the best-known config (remat tax +
+    # bytes/step); MFU_COST re-lowers, so it gets its own generous timeout
+    ("mfu_cost_save_attn_512",
+     SWEEP + ["512", "128", "1", "save_attn", "dense", "4"], 900,
+     {"MFU_COST": "1"}),
     ("kernel_validate", [sys.executable,
                          os.path.join(REPO, "benchmarks", "kernel_validate.py"),
                          "--all"], 1800),
@@ -80,7 +86,8 @@ def _record(name: str, rec: dict) -> None:
 
 def drain_queue(state: dict) -> bool:
     """Run every still-pending job; True if all jobs are done."""
-    for name, cmd, timeout_s in JOBS:
+    for name, cmd, timeout_s, *rest in JOBS:
+        env_extra = rest[0] if rest else None
         st = state.get(name, {})
         if st.get("done"):
             continue
@@ -95,7 +102,10 @@ def drain_queue(state: dict) -> bool:
         state[name] = st
         _save_state(state)
         t0 = time.monotonic()
-        rc, out, err = _run(cmd, timeout_s, _sweep_env())
+        env = _sweep_env()
+        if env_extra:
+            env.update(env_extra)
+        rc, out, err = _run(cmd, timeout_s, env)
         wall = round(time.monotonic() - t0, 1)
         if rc == 0:
             st["done"] = True
@@ -107,7 +117,7 @@ def drain_queue(state: dict) -> bool:
                            "rc": rc, "error": tail[0][:300],
                            "timeout": rc is None})
         _save_state(state)
-    return all(state.get(n, {}).get("done") for n, _, _ in JOBS)
+    return all(state.get(n, {}).get("done") for n, *_ in JOBS)
 
 
 def main() -> None:
@@ -120,9 +130,9 @@ def main() -> None:
         exhausted = all(
             state.get(n, {}).get("done")
             or state.get(n, {}).get("attempts", 0) >= MAX_ATTEMPTS
-            for n, _, _ in JOBS)
+            for n, *_ in JOBS)
         if exhausted:
-            done = [n for n, _, _ in JOBS if state.get(n, {}).get("done")]
+            done = [n for n, *_ in JOBS if state.get(n, {}).get("done")]
             print(f"opportunist: queue exhausted ({len(done)}/{len(JOBS)} "
                   f"succeeded) — exiting", flush=True)
             return

@@ -18,6 +18,7 @@ seq*0.15 otherwise).  Run each config in its own process so HBM starts clean.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -81,6 +82,22 @@ def main() -> None:
     float(m["loss"])
     print(f"sweep: compiled+warm in {time.perf_counter() - t_c:.1f}s",
           file=sys.stderr, flush=True)
+    if os.environ.get("MFU_COST") == "1":
+        # profiler-free attribution (the tunnel wedges trace capture): XLA's
+        # own cost model for the compiled step — total flops vs our counted
+        # useful flops exposes the remat tax; bytes accessed / step time vs
+        # ~819GB/s HBM shows whether the step is bandwidth-bound.  Opt-in:
+        # lower().compile() may recompile, which the tunnel makes expensive.
+        cost = trainer.compiled_cost_analysis(next(data))
+        if cost:
+            xla_flops = cost.get("flops", 0.0)
+            print(f"sweep: xla_cost flops={xla_flops:.3e} "
+                  f"(counted useful {flops_per_batch:.3e}, "
+                  f"ratio {xla_flops / max(flops_per_batch, 1):.2f}) "
+                  f"bytes={cost.get('bytes accessed', 0.0):.3e}",
+                  file=sys.stderr, flush=True)
+        else:
+            print("sweep: cost analysis unavailable", file=sys.stderr)
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -104,7 +121,6 @@ def main() -> None:
         # appended here and bench.py falls back to the round's best REAL
         # measurement instead of a CPU non-measurement when the tunnel is
         # down at bench time
-        import os
         rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         cache = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "BENCH_CHIP_CACHE.jsonl")

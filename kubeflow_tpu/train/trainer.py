@@ -110,6 +110,24 @@ class Trainer:
     def put_batch(self, batch: Any) -> Any:
         return jax.device_put(batch, self._batch_sharding)
 
+    def compiled_cost_analysis(self, batch: Any) -> dict:
+        """XLA's cost model for the compiled step — {"flops",
+        "bytes accessed", ...} or {} when unavailable.  Profiler-free MFU
+        attribution: XLA's flop count vs the counted useful flops exposes
+        the remat tax; bytes/step-time vs HBM bandwidth spots
+        bandwidth-bound steps.  NOTE: goes through lower().compile(), which
+        may recompile if the backend doesn't cache — callers on the flaky
+        TPU tunnel should treat this as an opt-in diagnostic."""
+        try:
+            compiled = self._step.lower(self.params, self.opt_state,
+                                        batch).compile()
+            a = compiled.cost_analysis()
+            if isinstance(a, list):
+                a = a[0] if a else {}
+            return dict(a or {})
+        except Exception:  # noqa: BLE001 — diagnostics never break training
+            return {}
+
     def train_step(self, batch: Any, sync: bool = True) -> dict:
         """One optimizer step.
 
