@@ -48,7 +48,37 @@ CANDIDATES = [
     (384, 1, "save_mlp", "dense"),
     (1024, 1, "save_qkv", "dense"),
 ]
-if os.environ.get("BENCH_TRY_FLASH") == "1":
+_FLASH_VALIDATED = os.path.join(REPO, "kubeflow_tpu", "ops",
+                                "FLASH_CHIP_VALIDATED")
+
+
+def _flash_validated() -> bool:
+    """Marker present AND its kernel_sha still matches flash_attention.py —
+    an edited kernel must re-validate before bench promotes it first (the
+    hash check is what keeps a stale marker from re-opening the r2
+    window-poisoning risk)."""
+    import hashlib
+
+    try:
+        with open(_FLASH_VALIDATED) as f:
+            marker = json.load(f)
+        src = os.path.join(REPO, "kubeflow_tpu", "ops", "flash_attention.py")
+        with open(src, "rb") as f:
+            return marker.get("kernel_sha") == hashlib.sha256(f.read()).hexdigest()
+    except (OSError, ValueError):
+        return False
+
+
+if _flash_validated():
+    # flash goes FIRST once kernel_validate has passed all stages on a real
+    # chip (it writes the marker): it is the only lever with plausible
+    # headroom past 0.476, and the wedge risk the r2 gate guarded against
+    # is exactly what the validation run retired
+    CANDIDATES.insert(0, (512, 0, "nothing", "flash"))
+    CANDIDATES.insert(1, (512, 1, "save_attn", "flash"))
+elif os.environ.get("BENCH_TRY_FLASH") == "1":
+    # manual override without chip validation: keep flash LAST so a wedge
+    # only poisons candidates that already ran (r2 behavior)
     CANDIDATES.append((512, 0, "nothing", "flash"))
 
 PER_CANDIDATE_TIMEOUT_S = float(os.environ.get("BENCH_CANDIDATE_TIMEOUT_S", "300"))
@@ -184,6 +214,28 @@ def _chip_cache_best() -> dict | None:
     return best
 
 
+def _chip_queue_summary() -> dict:
+    """Queue state for the BENCH artifact (VERDICT r3 #6): when the headline
+    is a cache replay, the artifact must say on its own whether the tunnel
+    never came back or came back and the watcher chose what to run — r3's
+    story took archaeology across three files to reconstruct."""
+    from benchmarks.chip_opportunist import JOBS, STATE  # lazy: no cycle
+
+    try:
+        with open(STATE) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        state = None
+    jobs = []
+    for job in JOBS:
+        st = (state or {}).get(job["name"], {})
+        jobs.append({"name": job["name"], "done": bool(st.get("done")),
+                     "attempts": st.get("attempts", 0)})
+    return {"state_file_present": state is not None,
+            "done": sum(j["done"] for j in jobs),
+            "total": len(jobs), "jobs": jobs}
+
+
 def _cpu_fallback(timeout_s: float) -> dict | None:
     """No TPU (or every candidate failed): measure a tiny CPU run in a
     subprocess so the bench still prints a line the driver can record."""
@@ -246,8 +298,10 @@ def main() -> None:
         best = _cpu_fallback(max(180.0, deadline - time.monotonic()))
         on_tpu = False
     if best is None:
-        # zero run, full schema (keep every key BENCH_r01.json consumers read)
-        print(json.dumps({
+        # zero run, full schema (keep every key BENCH_r01.json consumers
+        # read) — the chip_queue block matters MOST here: this is exactly
+        # the tunnel-never-came-back round the summary exists to explain
+        rec = {
             "metric": "bert_base_mlm_samples_per_sec_per_chip", "value": 0.0,
             "unit": "samples/s/chip", "vs_baseline": 0.0, "mfu": 0.0,
             "config": {"batch_size": 0, "remat": False,
@@ -255,7 +309,12 @@ def main() -> None:
             "batch_size": 0, "seq_len": 128, "n_chips": 0, "platform": "none",
             "step_time_ms": 0.0,
             "error": "tpu unreachable and cpu fallback failed",
-        }))
+        }
+        try:
+            rec["chip_queue"] = _chip_queue_summary()
+        except Exception as e:
+            rec["chip_queue"] = {"error": str(e)[:200]}
+        print(json.dumps(rec))
         return
 
     out = {
@@ -276,6 +335,10 @@ def main() -> None:
     if cached:
         out["cached_measurement"] = True
         out["measured_at"] = best.get("measured_at", "")
+    try:
+        out["chip_queue"] = _chip_queue_summary()
+    except Exception as e:  # the summary must never sink the bench line
+        out["chip_queue"] = {"error": str(e)[:200]}
     print(json.dumps(out))
 
 

@@ -1,0 +1,193 @@
+"""Composed-engine chip validation (VERDICT r3 #4).
+
+One cheap on-chip run of the full production composition — paged Pallas
+kernel + int8 KV + int8 weights + prefix cache + speculative (TP=1 on one
+chip) — at tiny scale, oracle-compared against the XLA gather path, BEFORE
+any big serving bench spends the window.  A Mosaic/layout surprise in any
+one feature then costs ~2 min of tunnel time instead of eating a 25-minute
+bench mid-run.
+
+Upstream analogue (UNVERIFIED, SURVEY.md §2b "Triton Inference Server"
+row): serving stacks gate new attention backends behind an accuracy
+harness before enabling them in production configs.
+
+Stages (``--all`` runs each in a killable subprocess, smallest first):
+  decode_composed  ONE decode_step through the compiled paged kernel over an
+                   int8 pool vs the gather path on an identical pool
+  e2e_composed     tiny Engine with every feature on vs the identical engine
+                   minus the paged kernel; tokens must match exactly, or each
+                   divergent token must sit within the int8 logit margin of
+                   the gather engine's own distribution
+
+On TPU success of BOTH stages, writes the ``PAGED_CHIP_VALIDATED`` marker
+next to the engine package — which flips ``EngineConfig.paged_kernel``'s
+default to on for TPU backends (engine.py resolves it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+STAGES = ["decode_composed", "e2e_composed"]
+MARKER = os.path.join(REPO, "kubeflow_tpu", "serving", "engine",
+                      "PAGED_CHIP_VALIDATED")
+
+
+def _tiny_config():
+    from kubeflow_tpu.serving.engine.model import DecoderConfig
+
+    return DecoderConfig(vocab_size=101, d_model=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, d_ff=128)
+
+
+def _stage_decode_composed():
+    """Mirror tests/test_engine.py::test_decode_step_paged_int8_matches_gather
+    with the kernel actually compiled (the chip decides interpret=False)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import model as M
+
+    cfg = _tiny_config()
+    params = M.init_int8(jax.random.PRNGKey(0), cfg)
+    page_size = 8
+    shape = (cfg.n_layers, 16, page_size, cfg.n_kv_heads, cfg.head_dim)
+    toks8 = jnp.asarray([[5, 7, 9, 11, 2, 4, 6, 8]], jnp.int32)
+    pools = []
+    for _ in range(2):  # decode_step donates its pool — need two copies
+        k_pool = M.make_kv_pool(shape, "int8")
+        v_pool = M.make_kv_pool(shape, "int8")
+        _, pk, pv = M.prefill(params, cfg, toks8, jnp.int32(8), page_size)
+        k_pool, v_pool = M.write_pages(k_pool, v_pool, pk, pv,
+                                       jnp.asarray([3], jnp.int32))
+        pools.append((k_pool, v_pool))
+    pt = jnp.asarray([[3, 0, 0, 0], [0, 0, 0, 0]], jnp.int32)
+    lens = jnp.asarray([8, 0], jnp.int32)
+    tok = jnp.asarray([10, 0], jnp.int32)
+    lg, _, _ = M.decode_step(params, cfg, tok, lens, pt, *pools[0])
+    lp, _, _ = M.decode_step(params, cfg, tok, lens, pt, *pools[1], paged=True)
+    err = float(jnp.max(jnp.abs(jnp.asarray(lg)[0] - jnp.asarray(lp)[0])))
+    scale = float(jnp.max(jnp.abs(jnp.asarray(lg)[0]))) or 1.0
+    assert err / scale < 2e-2 or err < 2e-2, f"paged-vs-gather logits {err}"
+    return {"ok": True, "logit_err": round(err, 5),
+            "same_argmax": bool(int(np.argmax(np.asarray(lg)[0]))
+                                == int(np.argmax(np.asarray(lp)[0])))}
+
+
+def _run_engine(params, cfg, paged: bool, prompts, max_new: int):
+    from kubeflow_tpu.serving.engine import Engine, EngineConfig
+
+    eng = Engine(params, cfg, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        prefill_chunk=16, kv_quant="int8", paged_kernel=paged,
+        speculative="prompt_lookup", spec_max_draft=4,
+    ))
+    eng.start()
+    try:
+        return [eng.generate(p, max_new, timeout=300)["tokens"]
+                for p in prompts]
+    finally:
+        eng.stop()
+
+
+def _stage_e2e_composed():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import model as M
+
+    cfg = _tiny_config()
+    params = M.init_int8(jax.random.PRNGKey(0), cfg)
+    v = cfg.vocab_size - 1
+    base = [(i * 5) % v + 1 for i in range(24)]
+    # prompt 3 repeats prompt 1's pages -> exercises the prefix cache; the
+    # repeated tail n-grams feed prompt-lookup drafting
+    prompts = [base, [3, 1, 4, 1, 5, 9, 2, 6] + base[:8], list(base)]
+    max_new = 8
+    got_gather = _run_engine(params, cfg, False, prompts, max_new)
+    got_paged = _run_engine(params, cfg, True, prompts, max_new)
+    mismatches = 0
+    for p, tg, tp in zip(prompts, got_gather, got_paged):
+        if tg == tp:
+            continue
+        # int8 matmuls + f32-vs-bf16 attention accumulators can flip near-tie
+        # argmaxes; each divergent token must still be within the int8 logit
+        # margin of the gather path's own distribution over the SAME context
+        ctx = list(p)
+        for a, b in zip(tg, tp):
+            if a != b:
+                mismatches += 1
+                logits = np.asarray(M.forward_full(
+                    params, cfg, jnp.asarray([ctx], jnp.int32)))[0, -1]
+                margin = float(logits.max() - logits[b])
+                assert margin <= 0.35, (ctx[:8], a, b, margin)
+                break  # contexts diverge past here — stop comparing this pair
+            ctx.append(a)
+    return {"ok": True, "requests": len(prompts),
+            "token_mismatches": mismatches,
+            "exact": mismatches == 0}
+
+
+def run_stage(name: str) -> dict:
+    from kubeflow_tpu.utils.jax_platform import honor_jax_platforms
+
+    honor_jax_platforms()  # sitecustomize pins axon; CPU debugging needs cpu
+    import jax
+
+    fn = {"decode_composed": _stage_decode_composed,
+          "e2e_composed": _stage_e2e_composed}[name]
+    t0 = time.perf_counter()
+    rec = fn()
+    rec.update(stage=name, wall_s=round(time.perf_counter() - t0, 1),
+               platform=jax.devices()[0].platform)
+    return rec
+
+
+def main() -> None:
+    if sys.argv[1:] and sys.argv[1] != "--all":
+        print(json.dumps(run_stage(sys.argv[1])))
+        return
+    from bench import _run, _sweep_env, last_json_line
+
+    timeout_s = float(os.environ.get("ECC_STAGE_TIMEOUT_S", "420"))
+    results = []
+    for stage in STAGES:
+        rc, out, err = _run([sys.executable, os.path.abspath(__file__), stage],
+                            timeout_s, _sweep_env())
+        if rc is None:
+            results.append({"stage": stage, "ok": False,
+                            "error": f"timeout after {timeout_s:.0f}s"})
+        elif rc == 0:
+            rec = last_json_line(out)
+            results.append(rec if rec is not None else
+                           {"stage": stage, "ok": False,
+                            "error": "no JSON line in stage stdout"})
+        else:
+            tail = (err or "").strip().splitlines()[-1:] or ["?"]
+            results.append({"stage": stage, "ok": False, "error": tail[0][:300]})
+        print(json.dumps(results[-1]), flush=True)
+        if not results[-1].get("ok"):
+            break
+    all_ok = all(r.get("ok") for r in results) and len(results) == len(STAGES)
+    on_tpu = all(r.get("platform") == "tpu" for r in results)
+    if all_ok and on_tpu:
+        from kubeflow_tpu.serving.engine.engine import paged_kernel_sha
+
+        with open(MARKER, "w") as f:
+            json.dump({"validated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                     time.gmtime()),
+                       "kernel_sha": paged_kernel_sha(),
+                       "stages": results}, f, indent=1)
+        print(json.dumps({"marker_written": MARKER}), flush=True)
+    print(json.dumps({"stages": results, "all_ok": all_ok, "on_tpu": on_tpu}))
+
+
+if __name__ == "__main__":
+    main()

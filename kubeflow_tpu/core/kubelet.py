@@ -143,7 +143,15 @@ class LocalProcessKubelet:
         # resume-continuity tests (and operators reading logs) observe that
         # a restart actually resumed from the checkpoint.
         import glob as _glob
-        stale = list(_glob.glob(run.log_path + ".*.stop"))
+        # only unlink stop files of runs that are GONE from self._runs: a
+        # previous same-named incarnation still draining needs its stop file
+        # for the race-free sidecar stop signal (else its sidecars only exit
+        # via the SIGTERM/kill escalation)
+        stale = []
+        for path in _glob.glob(run.log_path + ".*.stop"):
+            uid = path[len(run.log_path) + 1:-len(".stop")]
+            if uid not in self._runs:
+                stale.append(path)
         if run.sidecar_containers:
             stale.append(run.log_path)
         for path in stale:

@@ -91,6 +91,19 @@ def _eval_condition(expr: dict, args: dict, nodes: dict) -> bool:
     return bool(_OPS[expr["op"]](left, right))
 
 
+def _refs_loop_item(v: Any, gid: int) -> bool:
+    """True if ``v`` (a condition/operand tree) contains a ``loopItem``
+    marker for iterator group ``gid`` — i.e. it can only be evaluated on an
+    instantiated child, never on the virtual fan-out node."""
+    if isinstance(v, dict):
+        if v.get("loopItem", {}).get("groupId") == gid:
+            return True
+        return any(_refs_loop_item(x, gid) for x in v.values())
+    if isinstance(v, list):
+        return any(_refs_loop_item(x, gid) for x in v)
+    return False
+
+
 def _instantiate_iteration(tspec: dict, dag: dict, gid: int, k: int,
                            item: Any) -> dict:
     """One dynamic-ParallelFor child's concrete task spec: ``loopItem``
@@ -274,6 +287,18 @@ class WorkflowController:
                 return True
             if not all(p == papi.SUCCEEDED for p in dep_phases):
                 return False
+            # the virtual node's own conditions gate expansion, mirroring
+            # _drive: a dynamic ParallelFor nested in a false dsl.Condition
+            # must SKIP (and OMIT its dependents) exactly like a static
+            # loop.  Conditions that reference THIS group's loop item are
+            # per-child — they evaluate after _instantiate_iteration
+            # substitutes the item, not here
+            for cond in tspec.get("conditions", []):
+                if _refs_loop_item(cond, it["groupId"]):
+                    continue
+                if not _eval_condition(cond, args, nodes):
+                    node["phase"] = papi.SKIPPED
+                    return True
             raw = nodes.get(it["producerTask"], {}).get(
                 "outputParameters", {}).get(it["outputParameterKey"])
             items = raw
@@ -332,9 +357,17 @@ class WorkflowController:
                         progressed = True
             child_phases.append(child["phase"])
         if child_phases and all(p in papi.NODE_TERMINAL for p in child_phases):
-            node["phase"] = (papi.FAILED
-                             if any(p == papi.FAILED for p in child_phases)
-                             else papi.SUCCEEDED)
+            if any(p == papi.FAILED for p in child_phases):
+                node["phase"] = papi.FAILED
+            elif any(p in (papi.SKIPPED, papi.OMITTED) for p in child_phases):
+                # static-loop parity: a static expansion attaches dependents
+                # to EVERY clone, and one SKIPPED/OMITTED dep OMITs them —
+                # so ANY skipped child must gate dependents of the virtual
+                # node the same way (a Collected consumer of a partial
+                # fan-out would otherwise read missing outputs)
+                node["phase"] = papi.SKIPPED
+            else:
+                node["phase"] = papi.SUCCEEDED
             progressed = True
         return progressed
 

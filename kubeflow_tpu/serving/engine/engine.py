@@ -33,6 +33,43 @@ from .native import NativeBatcher
 
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
 
+# Written by benchmarks/engine_chip_check.py after the full composed config
+# (paged × int8-KV × int8-weights × speculative × prefix-cache) passes its
+# oracle comparison ON A REAL TPU.  Its presence flips paged_kernel's
+# default to on — but only for TPU backends (CPU runs keep the gather path;
+# the Pallas interpreter is a correctness tool, not a fast path), and only
+# while the kernel source still hashes to what was validated: an edit to
+# paged_attention.py voids the marker rather than riding a stale pass.
+_PAGED_VALIDATED_MARKER = os.path.join(os.path.dirname(__file__),
+                                       "PAGED_CHIP_VALIDATED")
+
+
+def paged_kernel_sha() -> str:
+    """Identity of the kernel source a validation marker vouches for."""
+    import hashlib
+
+    path = os.path.join(os.path.dirname(__file__), "paged_attention.py")
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _paged_kernel_default() -> bool:
+    env = os.environ.get("ENGINE_PAGED_KERNEL")
+    if env is not None:
+        return env == "1"
+    try:
+        import json as _json
+
+        with open(_PAGED_VALIDATED_MARKER) as f:
+            marker = _json.load(f)
+        if marker.get("kernel_sha") != paged_kernel_sha():
+            return False
+    except (OSError, ValueError):
+        return False
+    import jax
+
+    return jax.default_backend() == "tpu"
+
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
@@ -48,10 +85,11 @@ class EngineConfig:
     # interleave with a long prefill instead of stalling behind it
     prefill_chunk: int = 256
     # Pallas paged-attention decode path (paged_attention.py); None defers
-    # to the ENGINE_PAGED_KERNEL env var. Composes with kv_quant (in-kernel
-    # dequant), tensor_parallel (shard_map over the tensor mesh) and
-    # speculative (multi-query verify kernel). Off by default until
-    # re-validated on real hardware (the TPU tunnel was down for round 2).
+    # to ENGINE_PAGED_KERNEL, then to the PAGED_CHIP_VALIDATED marker that
+    # benchmarks/engine_chip_check.py writes once the composed config passes
+    # its oracle check on a real TPU (default-on for TPU backends from then
+    # on). Composes with kv_quant (in-kernel dequant), tensor_parallel
+    # (shard_map over the tensor mesh) and speculative (multi-query verify).
     paged_kernel: Optional[bool] = None
     # tensor-parallel degree (sharding.py): >1 places params + KV pool over a
     # 1-D GSPMD mesh so Llama-8B-class models span a slice.
@@ -131,7 +169,7 @@ class Engine:
         shape = (c.n_layers, engine_config.num_pages, engine_config.page_size,
                  c.n_kv_heads, c.head_dim)
         self._paged = (engine_config.paged_kernel if engine_config.paged_kernel is not None
-                       else os.environ.get("ENGINE_PAGED_KERNEL") == "1")
+                       else _paged_kernel_default())
         self._kv_quant = (engine_config.kv_quant if engine_config.kv_quant is not None
                           else os.environ.get("ENGINE_KV_QUANT") or None)
         wq = (engine_config.weight_quant if engine_config.weight_quant is not None

@@ -118,12 +118,18 @@ def load_params(model_dir: str, config: DecoderConfig):
 _QUANT_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3", "embed", "unembed")
 
 
-def _quant_cols_f32(blk: "np.ndarray"):
-    """Quantize one f32 column block host-side: per-output-channel scales
-    (axis -2 is the contraction/row axis in this layout)."""
+def _quant_f32(blk: "np.ndarray", axis: int = -2):
+    """Quantize one f32 block host-side, scales reduced over ``axis``.
+
+    axis=-2 (default): per-output-channel — matmul weights, where the
+    contraction/row axis is reduced over so the matmul stays exact up to
+    int8 rounding.  axis=-1: per-ROW — the embedding table, where lookups
+    gather whole rows and each token's row carries its own scale (a single
+    outlier row must not degrade every other token's precision, which a
+    vocab-shared per-column scale would)."""
     import ml_dtypes
 
-    s = np.maximum(np.abs(blk).max(axis=-2, keepdims=True), 1e-8) / 127.0
+    s = np.maximum(np.abs(blk).max(axis=axis, keepdims=True), 1e-8) / 127.0
     q = np.clip(np.round(blk / s), -127, 127).astype(np.int8)
     return q, s.astype(ml_dtypes.bfloat16)
 
@@ -144,8 +150,15 @@ def quantize_weights_int8(params: dict, col_chunk: int = 2048) -> dict:
             continue
         wn = np.asarray(w)
         qs = []
+        if name == "embed":  # per-row: chunk over vocab rows instead
+            for lo in range(0, wn.shape[0], col_chunk):
+                qs.append(_quant_f32(
+                    wn[lo:lo + col_chunk].astype(np.float32), axis=-1))
+            out[name] = {"q": np.concatenate([a for a, _ in qs], axis=0),
+                         "s": np.concatenate([b for _, b in qs], axis=0)}
+            continue
         for lo in range(0, wn.shape[-1], col_chunk):
-            qs.append(_quant_cols_f32(
+            qs.append(_quant_f32(
                 wn[..., lo:lo + col_chunk].astype(np.float32)))
         out[name] = {"q": np.concatenate([a for a, _ in qs], axis=-1),
                      "s": np.concatenate([b for _, b in qs], axis=-1)}
@@ -164,7 +177,7 @@ def init_int8(key: jax.Array, config: DecoderConfig) -> dict:
     c = config
     hd = c.head_dim
     n = c.n_layers
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 9)
     cpu = jax.devices("cpu")[0]
 
     def gen(k, shape, fan_in):
@@ -172,19 +185,20 @@ def init_int8(key: jax.Array, config: DecoderConfig) -> dict:
             return np.asarray(jax.random.normal(k, shape, jnp.float32)
                               ) / np.sqrt(fan_in)
 
-    def q2(k, shape, fan_in):
-        q, s = _quant_cols_f32(gen(k, shape, fan_in))
+    def q2(k, shape, fan_in, rows=False):
+        q, s = _quant_f32(gen(k, shape, fan_in),
+                          axis=-1 if rows else -2)
         return {"q": q, "s": s}
 
     def q3(k, in_dim, out_dim, fan_in):
-        parts = [_quant_cols_f32(gen(kl, (in_dim, out_dim), fan_in))
+        parts = [_quant_f32(gen(kl, (in_dim, out_dim), fan_in))
                  for kl in jax.random.split(k, n)]
         return {"q": np.stack([a for a, _ in parts]),
                 "s": np.stack([b for _, b in parts])}
 
     bf16 = ml_dtypes.bfloat16
     return {
-        "embed": q2(keys[0], (c.vocab_size, c.d_model), 1.0),
+        "embed": q2(keys[0], (c.vocab_size, c.d_model), 1.0, rows=True),
         "wq": q3(keys[1], c.d_model, c.n_heads * hd, c.d_model),
         "wk": q3(keys[2], c.d_model, c.n_kv_heads * hd, c.d_model),
         "wv": q3(keys[3], c.d_model, c.n_kv_heads * hd, c.d_model),
@@ -195,7 +209,7 @@ def init_int8(key: jax.Array, config: DecoderConfig) -> dict:
         "ln_attn": np.ones((n, c.d_model), bf16),
         "ln_mlp": np.ones((n, c.d_model), bf16),
         "ln_out": np.ones((c.d_model,), bf16),
-        "unembed": q2(keys[0], (c.d_model, c.vocab_size), c.d_model),
+        "unembed": q2(keys[8], (c.d_model, c.vocab_size), c.d_model),
     }
 
 
@@ -209,9 +223,10 @@ def _w(p, l=None):
 
 def _embed_rows(p, tokens):
     """Embedding gather that dequantizes AFTER the row gather — dequantizing
-    the whole [V, D] table first would materialize it dense."""
+    the whole [V, D] table first would materialize it dense.  Scales are
+    per-row ([V, 1]), gathered alongside the rows."""
     if isinstance(p, dict):
-        return p["q"][tokens].astype(jnp.bfloat16) * p["s"][0]
+        return p["q"][tokens].astype(jnp.bfloat16) * p["s"][tokens]
     return p[tokens]
 
 

@@ -361,8 +361,10 @@ class ModelServer:
         obj = "chat.completion" if chat else "text_completion"
         if not body.get("stream"):
             out = m.generate(payload, headers)
-            finish = ("length" if out.get("tokens", 0) >= out.get("max_tokens", 0)
-                      else "stop")
+            # only engine-backed models report tokens/max_tokens; without
+            # both keys 0>=0 would mislabel every response "length"
+            finish = ("length" if "tokens" in out and "max_tokens" in out
+                      and out["tokens"] >= out["max_tokens"] else "stop")
             choice = ({"index": 0, "message": {"role": "assistant",
                                                "content": out["text_output"]},
                        "finish_reason": finish} if chat else
@@ -401,8 +403,10 @@ class ModelServer:
             first = True
             for event in gen:
                 if event.get("done"):
-                    finish = ("length" if event.get("tokens", 0)
-                              >= event.get("max_tokens", 0) else "stop")
+                    finish = ("length" if "tokens" in event
+                              and "max_tokens" in event
+                              and event["tokens"] >= event["max_tokens"]
+                              else "stop")
                     yield (b"data: " + json.dumps(chunk("", finish)).encode()
                            + b"\n\n")
                     break

@@ -1199,3 +1199,55 @@ def test_model_server_openai_compat(params):
     finally:
         srv.stop()
         eng.stop()
+
+
+def test_embed_quant_per_row_scales_isolate_outlier_rows():
+    """ADVICE r3: the embedding table quantizes with PER-ROW scales — one
+    outlier row must not degrade every other token's embedding (per-column
+    scales shared across the vocab would)."""
+    rng = np.random.default_rng(0)
+    V, D = 64, 32
+    table = rng.standard_normal((V, D)).astype(np.float32)
+    table[7] *= 1000.0  # single outlier row
+    q = M.quantize_weights_int8({"embed": table})["embed"]
+    assert q["s"].shape == (V, 1)
+    rows = M._embed_rows({"q": jnp.asarray(q["q"]), "s": jnp.asarray(q["s"])},
+                         jnp.asarray([3, 7]))
+    err_normal = float(np.abs(np.asarray(rows[0], np.float32) - table[3]).max())
+    err_outlier = float(np.abs(np.asarray(rows[1], np.float32) - table[7]).max())
+    # per-row: each row keeps int8 precision relative to ITS OWN max; a
+    # vocab-shared scale from the outlier would put err_normal near 4.0
+    assert err_normal < 0.05, err_normal
+    assert err_outlier < 50.0, err_outlier
+
+
+def test_validation_markers_void_on_kernel_source_change(tmp_path, monkeypatch):
+    """Chip-validation markers carry a sha of the kernel source they vouch
+    for; an edited kernel voids the marker instead of riding a stale pass
+    (code-review r4)."""
+    import hashlib
+    import os
+
+    import bench
+    from kubeflow_tpu.serving.engine import engine as E
+
+    # flash marker: right sha -> promoted, wrong sha / no marker -> not
+    marker = tmp_path / "FLASH_CHIP_VALIDATED"
+    monkeypatch.setattr(bench, "_FLASH_VALIDATED", str(marker))
+    assert not bench._flash_validated()
+    src = os.path.join(os.path.dirname(E.__file__), "..", "..", "ops",
+                       "flash_attention.py")
+    good = hashlib.sha256(open(src, "rb").read()).hexdigest()
+    marker.write_text(json.dumps({"kernel_sha": good}))
+    assert bench._flash_validated()
+    marker.write_text(json.dumps({"kernel_sha": "stale"}))
+    assert not bench._flash_validated()
+
+    # paged marker: wrong sha -> default stays off even with marker present
+    pmarker = tmp_path / "PAGED_CHIP_VALIDATED"
+    monkeypatch.setattr(E, "_PAGED_VALIDATED_MARKER", str(pmarker))
+    monkeypatch.delenv("ENGINE_PAGED_KERNEL", raising=False)
+    pmarker.write_text(json.dumps({"kernel_sha": "stale"}))
+    assert E._paged_kernel_default() is False
+    monkeypatch.setenv("ENGINE_PAGED_KERNEL", "1")
+    assert E._paged_kernel_default() is True  # env override beats marker

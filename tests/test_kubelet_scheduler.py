@@ -166,3 +166,46 @@ def test_gang_does_not_bind_partial():
         assert pg["status"]["phase"] == "Pending"
     finally:
         c.shutdown()
+
+
+def test_create_pod_keeps_stop_file_of_draining_incarnation(cluster):
+    """A same-named replacement pod must not unlink the stop file of a
+    previous incarnation that is STILL draining (uid present in _runs) —
+    its sidecars rely on the stop file for the race-free exit signal;
+    only truly orphaned uids are litter (ADVICE r3)."""
+    import os
+    import sys as _sys
+
+    wait_code = (
+        "import os, time\n"
+        "while not os.path.exists(os.environ['POD_STOP_FILE']):\n"
+        "    time.sleep(0.05)\n"
+    )
+    cluster.api.create(py_pod("dup", wait_code))
+    assert cluster.wait_for(lambda: phase(cluster, "dup") == "Running", timeout=30)
+    uid1 = cluster.api.get("Pod", "dup")["metadata"]["uid"]
+    kubelet = next(k for k in cluster.kubelets.values() if uid1 in k._runs)
+    run1 = kubelet._runs[uid1]
+    # old incarnation mid-drain: its stop file is live on disk
+    open(run1.stop_path, "w").close()
+    # plus a genuinely orphaned stop file from a long-reaped run
+    orphan = run1.log_path + ".deadbeef.stop"
+    open(orphan, "w").close()
+    # a same-named replacement starts while uid1 is still draining
+    pod2 = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "dup", "namespace": "default", "uid": "uid-2"},
+        "spec": {"restartPolicy": "Never", "containers": [
+            {"name": "main",
+             "command": [_sys.executable, "-u", "-c", "print('v2')"],
+             "resources": {}}]},
+    }
+    run2 = kubelet._start(pod2)
+    try:
+        assert os.path.exists(run1.stop_path), \
+            "live incarnation's stop file was unlinked by the replacement"
+        assert not os.path.exists(orphan), "orphaned stop file not cleaned"
+    finally:
+        kubelet._terminate(run2, grace=0.5)
+        kubelet._runs.pop("uid-2", None)
+        os.unlink(run1.stop_path)

@@ -720,3 +720,64 @@ def test_collected_rejects_condition_and_cloned_source():
 
     with pytest.raises(CompileError, match="survive"):
         Compiler().compile(collect_cloned)
+
+
+def test_dynamic_parallelfor_in_false_condition_skips(tpu_cluster):
+    """ADVICE r3 (medium): a dynamic ParallelFor nested in a false
+    dsl.Condition must SKIP its virtual node and OMIT downstream
+    dependents — exactly like the static-loop expansion of the same
+    pipeline — not aggregate zero expanded children to SUCCEEDED."""
+
+    @dsl.component
+    def gate(x: int) -> int:
+        return x
+
+    @dsl.pipeline(name="dyn-in-cond")
+    def dyn_in_cond(n: int = 2, go: int = 0):
+        g = gate(x=go)
+        shards = list_shards(n=n)
+        with dsl.Condition(g.output > 0):
+            with dsl.ParallelFor(shards.output) as shard:
+                p = process_shard(shard=shard)
+        summarize().after(p)
+
+    client = Client(tpu_cluster)
+    rec = client.create_run_from_pipeline_func(
+        dyn_in_cond, arguments={"go": 0}).wait(timeout=120)
+    assert rec["phase"] == papi.SUCCEEDED, rec
+    nodes = rec["nodes"]
+    assert nodes["process-shard"]["phase"] == papi.SKIPPED
+    assert "process-shard-it0" not in nodes  # never expanded
+    assert nodes["summarize"]["phase"] == papi.OMITTED
+
+    rec = client.create_run_from_pipeline_func(
+        dyn_in_cond, arguments={"go": 1}).wait(timeout=120)
+    assert rec["phase"] == papi.SUCCEEDED, rec
+    nodes = rec["nodes"]
+    assert nodes["process-shard"]["phase"] == papi.SUCCEEDED
+    assert nodes["process-shard-it0"]["phase"] == papi.SUCCEEDED
+    assert nodes["summarize"]["phase"] == papi.SUCCEEDED
+
+
+def test_dynamic_parallelfor_partial_skip_gates_dependents(tpu_cluster):
+    """Mixed SKIPPED/SUCCEEDED children: the static expansion attaches
+    dependents to every clone, so ONE skipped clone OMITs them — the
+    dynamic virtual node must gate identically (code-review r4)."""
+
+    @dsl.pipeline(name="dyn-partial-skip")
+    def dyn_partial_skip(n: int = 2):
+        shards = list_shards(n=n)
+        with dsl.ParallelFor(shards.output) as shard:
+            with dsl.Condition(shard == "shard-1"):
+                p = process_shard(shard=shard)
+        summarize().after(p)
+
+    client = Client(tpu_cluster)
+    rec = client.create_run_from_pipeline_func(
+        dyn_partial_skip, arguments={"n": 2}).wait(timeout=120)
+    assert rec["phase"] == papi.SUCCEEDED, rec
+    nodes = rec["nodes"]
+    assert nodes["process-shard-it0"]["phase"] == papi.SKIPPED
+    assert nodes["process-shard-it1"]["phase"] == papi.SUCCEEDED
+    assert nodes["process-shard"]["phase"] == papi.SKIPPED  # virtual node
+    assert nodes["summarize"]["phase"] == papi.OMITTED

@@ -687,3 +687,38 @@ def test_inference_graph_cycle_rejected_and_ready_degrades(scluster):
     # backend goes away -> Ready must DEGRADE (periodic re-check)
     c.api.try_delete("InferenceService", "solo", "default")
     assert c.wait_for(graph_ready("False"), timeout=30)
+
+
+def test_openai_finish_reason_defaults_to_stop_for_plain_generators():
+    """ADVICE r3: a generative model that doesn't report tokens/max_tokens
+    (any non-engine Model with a generate()) must get finish_reason 'stop',
+    not 'length' from the vacuous 0 >= 0 comparison — unary and streaming."""
+
+    class Plain(Model):
+        def generate(self, payload, headers=None):
+            return {"text_output": "hi there"}
+
+        def generate_stream(self, payload, headers=None):
+            yield {"text_output": "hi "}
+            yield {"text_output": "there"}
+            yield {"done": True}
+
+    server = ModelServer([Plain("p")], port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}/openai/v1"
+    try:
+        _, out = _post(f"{base}/completions", {"prompt": "x", "max_tokens": 4})
+        assert out["choices"][0]["finish_reason"] == "stop"
+        req = urllib.request.Request(
+            f"{base}/completions",
+            data=json.dumps({"prompt": "x", "max_tokens": 4,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            raw = [l[len(b"data: "):] for l in r.read().split(b"\n\n")
+                   if l.startswith(b"data: ")]
+        assert raw[-1] == b"[DONE]"
+        done = json.loads(raw[-2])
+        assert done["choices"][0]["finish_reason"] == "stop"
+    finally:
+        server.stop()
