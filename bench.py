@@ -57,16 +57,11 @@ def _flash_validated() -> bool:
     an edited kernel must re-validate before bench promotes it first (the
     hash check is what keeps a stale marker from re-opening the r2
     window-poisoning risk)."""
-    import hashlib
+    from kubeflow_tpu.utils.chipmarker import marker_valid
 
-    try:
-        with open(_FLASH_VALIDATED) as f:
-            marker = json.load(f)
-        src = os.path.join(REPO, "kubeflow_tpu", "ops", "flash_attention.py")
-        with open(src, "rb") as f:
-            return marker.get("kernel_sha") == hashlib.sha256(f.read()).hexdigest()
-    except (OSError, ValueError):
-        return False
+    return marker_valid(
+        _FLASH_VALIDATED,
+        os.path.join(REPO, "kubeflow_tpu", "ops", "flash_attention.py"))
 
 
 if _flash_validated():

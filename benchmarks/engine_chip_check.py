@@ -178,13 +178,10 @@ def main() -> None:
     all_ok = all(r.get("ok") for r in results) and len(results) == len(STAGES)
     on_tpu = all(r.get("platform") == "tpu" for r in results)
     if all_ok and on_tpu:
-        from kubeflow_tpu.serving.engine.engine import paged_kernel_sha
+        from kubeflow_tpu.serving.engine.engine import _PAGED_KERNEL_SRC
+        from kubeflow_tpu.utils.chipmarker import write_marker
 
-        with open(MARKER, "w") as f:
-            json.dump({"validated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                                     time.gmtime()),
-                       "kernel_sha": paged_kernel_sha(),
-                       "stages": results}, f, indent=1)
+        write_marker(MARKER, _PAGED_KERNEL_SRC, {"stages": results})
         print(json.dumps({"marker_written": MARKER}), flush=True)
     print(json.dumps({"stages": results, "all_ok": all_ok, "on_tpu": on_tpu}))
 

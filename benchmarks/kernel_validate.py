@@ -32,14 +32,7 @@ STAGES = ["trivial", "flash1", "flash_bert", "flash_mask", "paged"]
 # stays as a manual override).  Carries a sha of the kernel source so a
 # later flash_attention.py edit voids the validation instead of riding it.
 FLASH_MARKER = os.path.join(REPO, "kubeflow_tpu", "ops", "FLASH_CHIP_VALIDATED")
-
-
-def flash_kernel_sha() -> str:
-    import hashlib
-
-    path = os.path.join(REPO, "kubeflow_tpu", "ops", "flash_attention.py")
-    with open(path, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()
+FLASH_SRC = os.path.join(REPO, "kubeflow_tpu", "ops", "flash_attention.py")
 
 
 def _stage_trivial():
@@ -193,11 +186,9 @@ def main() -> None:
     all_ok = (all(r.get("ok") for r in results)
               and len(results) == len(STAGES))
     if all_ok and all(r.get("platform") == "tpu" for r in results):
-        with open(FLASH_MARKER, "w") as f:
-            json.dump({"validated_at": time.strftime(
-                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                "kernel_sha": flash_kernel_sha(), "stages": results}, f,
-                indent=1)
+        from kubeflow_tpu.utils.chipmarker import write_marker
+
+        write_marker(FLASH_MARKER, FLASH_SRC, {"stages": results})
         print(json.dumps({"marker_written": FLASH_MARKER}), flush=True)
     print(json.dumps({"stages": results, "all_ok": all_ok}))
 

@@ -1,0 +1,140 @@
+"""Transport shim microbench: allreduce latency/bandwidth vs message size.
+
+VERDICT r3 #7: the DDP row's efficiency trend on this box is attributable
+only if the pure shim cost (no model, no JAX) is measured at width.  This
+drives the C++ ring transport (transport_core.cc) with W local processes
+over 127.0.0.1 for W in {4, 8, 16} and a sweep of message sizes, reporting
+per-size p50 latency, algorithm bandwidth (bytes/s through allreduce) and
+bus bandwidth (algbw x 2(W-1)/W — the ring's wire traffic).
+
+On a 1-core box the W processes time-slice, so absolute numbers measure
+the shim + loopback stack, not ICI — the point is the TREND vs W and size
+(a flat-ish busbw curve means the ring pipelines; a collapse at small
+sizes is per-message overhead).
+
+Usage: python benchmarks/transport_bench.py [--worlds 4,8,16]
+       [--sizes 4096,65536,1048576,8388608] [--iters 20]
+Writes BENCH_TRANSPORT.json at the repo root and prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def worker() -> None:
+    import numpy as np
+
+    from kubeflow_tpu.transport.transport import RingTransport
+
+    rank = int(os.environ["TB_RANK"])
+    world = int(os.environ["TB_WORLD"])
+    port = int(os.environ["TB_PORT"])
+    sizes = [int(s) for s in os.environ["TB_SIZES"].split(",")]
+    iters = int(os.environ["TB_ITERS"])
+    out = []
+    with RingTransport(rank, world, base_port=port) as tr:
+        for size in sizes:
+            n = max(1, size // 4)  # float32 elements
+            x = np.empty(n, np.float32)
+            for _ in range(3):  # warmup
+                x[:] = float(rank + 1)
+                tr.allreduce(x)
+            times = []
+            expect = world * (world + 1) / 2.0
+            for _ in range(iters):
+                x[:] = float(rank + 1)  # allreduce reduces in place
+                tr.barrier()
+                t0 = time.perf_counter()
+                y = tr.allreduce(x)
+                times.append(time.perf_counter() - t0)
+                assert abs(float(y[0]) - expect) < 1e-3, (y[0], expect)
+            times.sort()
+            p50 = times[len(times) // 2]
+            out.append({"bytes": n * 4, "p50_ms": round(p50 * 1e3, 3),
+                        "algbw_MBps": round(n * 4 / p50 / 1e6, 1),
+                        "busbw_MBps": round(n * 4 / p50 / 1e6
+                                            * 2 * (world - 1) / world, 1)})
+    if rank == 0:
+        print(json.dumps({"world": world, "rows": out}), flush=True)
+
+
+def run_world(world: int, sizes: list, iters: int, port: int) -> dict | None:
+    env = dict(os.environ,
+               TB_WORLD=str(world), TB_PORT=str(port),
+               TB_SIZES=",".join(map(str, sizes)), TB_ITERS=str(iters),
+               PYTHONPATH=os.pathsep.join(
+                   [REPO] + os.environ.get("PYTHONPATH", "").split(os.pathsep)))
+    procs = []
+    for rank in range(world):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=dict(env, TB_RANK=str(rank)),
+            stdout=subprocess.PIPE if rank == 0 else subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, text=True))
+    try:
+        out, _ = procs[0].communicate(timeout=600)
+        for p in procs[1:]:
+            p.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        return None
+    if procs[0].returncode != 0:
+        return None
+    for line in reversed(out.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def main() -> None:
+    if "--worker" in sys.argv:
+        worker()
+        return
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worlds", default="4,8,16")
+    ap.add_argument("--sizes", default="4096,65536,1048576,8388608")
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",")]
+    results = []
+    for i, world in enumerate(int(w) for w in args.worlds.split(",")):
+        rec = run_world(world, sizes, args.iters, port=24800 + i * 64)
+        if rec is None:
+            rec = {"world": world, "error": "failed or timed out"}
+        results.append(rec)
+        print(f"transport_bench: world={world} -> "
+              f"{json.dumps(rec)[:240]}", file=sys.stderr)
+    artifact = {
+        "metric": "transport_allreduce_busbw_MBps",
+        "host": "1-core simulator box (processes time-slice; trend only)",
+        "iters": args.iters,
+        "results": results,
+        "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(os.path.join(REPO, "BENCH_TRANSPORT.json"), "w") as f:
+        json.dump(artifact, f, indent=1)
+    # headline: biggest-message busbw at the widest world that succeeded
+    head = next((r for r in reversed(results) if "rows" in r), None)
+    print(json.dumps({
+        "metric": "transport_allreduce_busbw_MBps",
+        "value": head["rows"][-1]["busbw_MBps"] if head else 0.0,
+        "unit": "MB/s",
+        "world": head["world"] if head else 0,
+        "bytes": head["rows"][-1]["bytes"] if head else 0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

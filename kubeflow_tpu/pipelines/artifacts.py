@@ -77,5 +77,15 @@ class ObjectStore:
         with open(src, "rb") as f:
             return f.read()
 
+    def get_head(self, uri: str, n: int) -> tuple:
+        """First ``n`` bytes + the object's total size — preview without
+        pulling a multi-GB artifact into memory (webui run pages)."""
+        src = self._path(uri)
+        if not os.path.isfile(src):
+            raise FileNotFoundError(f"object not found (or not a file): {uri}")
+        size = os.path.getsize(src)
+        with open(src, "rb") as f:
+            return f.read(n), size
+
     def exists(self, uri: str) -> bool:
         return os.path.exists(self._path(uri))

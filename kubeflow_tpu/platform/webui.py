@@ -138,13 +138,20 @@ class DashboardWebUI:
 
     def __init__(self, api: APIServer, katib_service=None, port: int = 0,
                  cluster_admins=(), spawner: Optional[Spawner] = None,
-                 pipeline_service=None):
+                 pipeline_service=None, cull_idle_seconds: float = None):
+        from .controllers import DEFAULT_CULL_IDLE_SECONDS
+
         self.api = api
         self.dashboard = Dashboard(api)
         self.authorizer = ProfileRBACAuthorizer(api, cluster_admins)
         self.katib = katib_service
         self.spawner = spawner
         self.pipelines = pipeline_service
+        # for the namespace page's cull-countdown column; pass the culler's
+        # actual threshold when it differs from the default
+        self.cull_idle_seconds = (DEFAULT_CULL_IDLE_SECONDS
+                                  if cull_idle_seconds is None
+                                  else cull_idle_seconds)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -153,9 +160,13 @@ class DashboardWebUI:
 
             def do_GET(self):
                 user = self.headers.get(USER_HEADER, "anonymous")
-                path = urlparse(self.path).path
+                parsed = urlparse(self.path)
+                path = parsed.path
+                from urllib.parse import parse_qs
+
+                query = parse_qs(parsed.query)
                 try:
-                    out = outer._route(path, user)
+                    out = outer._route(path, user, query)
                 except Forbidden as e:
                     self._send(403, _page("Forbidden", f"<p>{_esc(e)}</p>"))
                     return
@@ -228,7 +239,8 @@ class DashboardWebUI:
 
     # ------------------------------------------------------------- routing
 
-    def _route(self, path: str, user: str) -> Optional[bytes]:
+    def _route(self, path: str, user: str,
+               query: Optional[dict] = None) -> Optional[bytes]:
         if path == "/healthz":
             return b"ok"
         if path == "/":
@@ -244,6 +256,8 @@ class DashboardWebUI:
             return self._experiment(user, parts[1], parts[3])
         if path == "/pipelines" and self.pipelines is not None:
             return self._pipelines(user)
+        if path == "/compare" and self.pipelines is not None:
+            return self._compare(user, (query or {}).get("runs", []))
         if (len(parts) == 2 and parts[0] == "runs"
                 and self.pipelines is not None):
             return self._run(user, parts[1])
@@ -280,6 +294,9 @@ class DashboardWebUI:
         activity = self.dashboard.activity(ns)
         sections = []
         for kind, info in summary["resources"].items():
+            if kind == "Notebook":
+                sections.append(self._notebook_section(ns, info))
+                continue
             rows = "".join(
                 "<tr><td>" + (
                     f"<a href='/ns/{_esc(ns)}/experiments/{_esc(i['name'])}'>"
@@ -306,6 +323,38 @@ class DashboardWebUI:
                             "<th>object</th><th>reason</th><th>message</th>"
                             f"</tr>{arows}</table>")
         return _page(f"Namespace {ns}", "".join(sections))
+
+    def _notebook_section(self, ns: str, info: dict) -> str:
+        """Notebook rows with the culling status column upstream's
+        jupyter-web-app shows: last-activity age and time-to-cull, or the
+        culled state (SURVEY §2a Jupyter row; the activity signal is the
+        last-activity annotation the NotebookCuller reads)."""
+        import time as _time
+
+        from . import api as papi_plat
+
+        by_name = {nb["metadata"]["name"]: nb
+                   for nb in self.api.list("Notebook", namespace=ns)}
+        rows = []
+        for i in info["items"]:
+            nb = by_name.get(i["name"], {})
+            ann = nb.get("metadata", {}).get("annotations", {})
+            if ann.get(papi_plat.CULLED_ANNOTATION) == "true":
+                status = "<i>culled (idle)</i>"
+            else:
+                last = float(ann.get(
+                    papi_plat.LAST_ACTIVITY_ANNOTATION,
+                    nb.get("metadata", {}).get("creationTimestamp", 0)))
+                idle = max(0.0, _time.time() - last)
+                left = self.cull_idle_seconds - idle
+                status = (f"active {idle:.0f}s ago · culls in {left:.0f}s"
+                          if left > 0 else
+                          f"active {idle:.0f}s ago · cull pending")
+            rows.append(f"<tr><td>{_esc(i['name'])}</td>"
+                        f"{_phase_cell(i['phase'])}<td>{status}</td></tr>")
+        return (f"<h2>Notebook ({info['count']})</h2>"
+                "<table><tr><th>name</th><th>phase</th><th>activity</th></tr>"
+                f"{''.join(rows)}</table>")
 
     def _spawn_form(self, user: str, ns: str) -> bytes:
         """The jupyter-web-app form: options straight from the spawner
@@ -354,15 +403,119 @@ class DashboardWebUI:
             if not allowed[ns]:
                 continue
             rows.append(
-                f"<tr><td><a href='/runs/{_esc(r['run'])}'>{_esc(r['run'])}"
+                f"<tr><td><input type='checkbox' name='runs' "
+                f"value='{_esc(r['run'])}'></td>"
+                f"<td><a href='/runs/{_esc(r['run'])}'>{_esc(r['run'])}"
                 f"</a></td><td>{_esc(r.get('pipeline', ''))}</td>"
                 f"<td>{_esc(r.get('experiment', ''))}</td>"
                 f"{_phase_cell(r.get('phase', 'Pending'))}</tr>")
         body = (f"<h2>Pipelines</h2><ul>{plist or '<li>none uploaded</li>'}</ul>"
-                "<h2>Runs</h2><table><tr><th>run</th><th>pipeline</th>"
+                "<h2>Runs</h2><form method='get' action='/compare'>"
+                "<table><tr><th></th><th>run</th><th>pipeline</th>"
                 "<th>experiment</th><th>phase</th></tr>"
-                + "".join(rows) + "</table>")
+                + "".join(rows) + "</table>"
+                "<button type='submit'>Compare selected</button></form>")
         return _page("Pipelines", body)
+
+    # ------------------------------------------------------- run artifacts
+
+    @staticmethod
+    def _metrics_of(nodes: dict) -> dict:
+        """{'task/metric': value} from every system.Metrics output artifact
+        — the ONE walker both the run page and /compare render from."""
+        out = {}
+        for tname, node in (nodes or {}).items():
+            for art in (node.get("outputArtifacts") or {}).values():
+                if art.get("type") != "system.Metrics":
+                    continue
+                for k, v in (art.get("metadata") or {}).items():
+                    out[f"{tname}/{k}"] = v
+        return out
+
+    def _run_artifacts(self, nodes: dict) -> str:
+        """Artifact section of a run page: every task's output artifacts
+        with type + metadata, Metrics metadata rendered as a metric table,
+        and a short inline preview of small text artifacts — the viewing
+        capability of upstream's artifact pane (SURVEY §2a KFP frontend)."""
+        store = getattr(self.pipelines, "store", None)
+        arows = []
+        mrows = [
+            f"<tr><td>{_esc(k.split('/', 1)[0])}</td>"
+            f"<td>{_esc(k.split('/', 1)[1])}</td><td>{_esc(v)}</td></tr>"
+            for k, v in sorted(self._metrics_of(nodes).items())]
+        for tname in sorted(nodes):
+            for aname, art in sorted(
+                    (nodes[tname].get("outputArtifacts") or {}).items()):
+                meta = art.get("metadata") or {}
+                preview = ""
+                if store is not None and art.get("uri"):
+                    try:
+                        # bounded read: never pull a multi-GB artifact into
+                        # the webui process for a page render
+                        head, size = store.get_head(art["uri"], 1024)
+                        preview = (f"<pre>{_esc(head.decode('utf-8', 'replace'))}"
+                                   f"</pre>" if size <= 4096
+                                   else f"<i>{size} bytes</i>")
+                    except (OSError, ValueError):
+                        pass  # directory artifact / not yet written
+                meta_txt = ", ".join(f"{_esc(k)}={_esc(v)}"
+                                     for k, v in sorted(meta.items()))
+                arows.append(
+                    f"<tr><td>{_esc(tname)}</td><td>{_esc(aname)}</td>"
+                    f"<td>{_esc(art.get('type', ''))}</td>"
+                    f"<td>{_esc(art.get('uri', ''))}</td>"
+                    f"<td>{meta_txt}</td><td>{preview}</td></tr>")
+        out = ""
+        if mrows:
+            out += ("<h2>Metrics</h2><table><tr><th>task</th><th>metric</th>"
+                    f"<th>value</th></tr>{''.join(mrows)}</table>")
+        if arows:
+            out += ("<h2>Artifacts</h2><table><tr><th>task</th><th>artifact"
+                    "</th><th>type</th><th>uri</th><th>metadata</th>"
+                    f"<th>preview</th></tr>{''.join(arows)}</table>")
+        return out
+
+    def _compare(self, user: str, run_ids: list) -> Optional[bytes]:
+        """Side-by-side run comparison: phases, arguments, and every
+        Metrics-artifact scalar — upstream's 'Compare runs' view."""
+        run_ids = [r for r in run_ids if r][:8]  # bound the fan-out
+        if len(run_ids) < 2:
+            return _page("Compare runs",
+                         "<p>select at least two runs on "
+                         "<a href='/pipelines'>the runs page</a></p>")
+        recs = {}
+        for rid in run_ids:
+            try:
+                rec = self.pipelines.get_run(rid)
+            except KeyError:
+                return None
+            self._authz(user, "list", "Workflow",
+                        rec.get("namespace", "default"))
+            recs[rid] = rec
+        head = "".join(f"<th>{_esc(r)}</th>" for r in run_ids)
+        rows = [
+            "<tr><td>pipeline</td>" + "".join(
+                f"<td>{_esc(recs[r].get('pipeline', ''))}</td>"
+                for r in run_ids) + "</tr>",
+            "<tr><td>phase</td>" + "".join(
+                _phase_cell(recs[r].get("phase", "Pending"))
+                for r in run_ids) + "</tr>",
+        ]
+        argkeys = sorted({k for r in run_ids
+                          for k in (recs[r].get("arguments") or {})})
+        for k in argkeys:
+            rows.append(f"<tr><td>arg {_esc(k)}</td>" + "".join(
+                f"<td>{_esc((recs[r].get('arguments') or {}).get(k, ''))}</td>"
+                for r in run_ids) + "</tr>")
+        metrics = {rid: self._metrics_of(rec.get("nodes"))
+                   for rid, rec in recs.items()}
+        for k in sorted({k for v in metrics.values() for k in v}):
+            rows.append(f"<tr><td>{_esc(k)}</td>" + "".join(
+                f"<td>{_esc(metrics[r].get(k, ''))}</td>"
+                for r in run_ids) + "</tr>")
+        body = (f"<table><tr><th></th>{head}</tr>{''.join(rows)}</table>"
+                "<p><a href='/pipelines'>back to runs</a></p>")
+        return _page("Compare runs", body)
 
     def _run(self, user: str, run_id: str) -> Optional[bytes]:
         try:
@@ -395,6 +548,7 @@ class DashboardWebUI:
             for t in sorted(tasks))
         body += ("<h2>Tasks</h2><table><tr><th>task</th><th>phase</th>"
                  f"<th>retries</th><th>message</th></tr>{rows}</table>")
+        body += self._run_artifacts(nodes)
         return _page(f"Run {run_id}", body)
 
     def _experiment(self, user: str, ns: str, name: str) -> Optional[bytes]:

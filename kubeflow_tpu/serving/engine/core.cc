@@ -357,6 +357,27 @@ int32_t eng_commit_token(Engine* e, int32_t slot_id, int32_t is_eos) {
   return eng_commit_token_ex(e, slot_id, is_eos, nullptr);
 }
 
+// Pre-allocate one more KV page for an active slot.  Speculative drafting
+// needs every draft row's KV position inside OWNED pages, so near a page
+// boundary the drafter reserves the next page before proposing past it
+// (otherwise drafts clamp to the room left and boundary ticks degrade to
+// single-token decode).  Returns the page id, -1 for a no-op (bad/inactive
+// slot or per-slot cap), -2 when the pool is exhausted.  A later commit
+// that crosses into the reserved page finds pages.size() already
+// sufficient and allocates nothing, so the two paths compose.
+int32_t eng_reserve_page(Engine* e, int32_t slot_id) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  if (slot_id < 0 || slot_id >= e->max_slots) return -1;
+  Slot& slot = e->slots[slot_id];
+  if (!slot.active) return -1;
+  if (static_cast<int32_t>(slot.pages.size()) >= e->max_pages_per_slot)
+    return -1;
+  int32_t p = take_page(e);
+  if (p < 0) return -2;
+  slot.pages.push_back(p);
+  return p;
+}
+
 // Release a slot. `hashes` (may be null) are chain hashes for the slot's
 // first `n_hashes` full PROMPT pages: any not yet cached are inserted into
 // the prefix cache (the cache takes a ref) instead of going straight back to

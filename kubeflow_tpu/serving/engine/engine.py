@@ -44,27 +44,17 @@ _PAGED_VALIDATED_MARKER = os.path.join(os.path.dirname(__file__),
                                        "PAGED_CHIP_VALIDATED")
 
 
-def paged_kernel_sha() -> str:
-    """Identity of the kernel source a validation marker vouches for."""
-    import hashlib
-
-    path = os.path.join(os.path.dirname(__file__), "paged_attention.py")
-    with open(path, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()
+_PAGED_KERNEL_SRC = os.path.join(os.path.dirname(__file__),
+                                 "paged_attention.py")
 
 
 def _paged_kernel_default() -> bool:
     env = os.environ.get("ENGINE_PAGED_KERNEL")
     if env is not None:
         return env == "1"
-    try:
-        import json as _json
+    from ...utils.chipmarker import marker_valid
 
-        with open(_PAGED_VALIDATED_MARKER) as f:
-            marker = _json.load(f)
-        if marker.get("kernel_sha") != paged_kernel_sha():
-            return False
-    except (OSError, ValueError):
+    if not marker_valid(_PAGED_VALIDATED_MARKER, _PAGED_KERNEL_SRC):
         return False
     import jax
 
@@ -570,10 +560,24 @@ class Engine:
         if seq_len == 0:
             return []
         ps = self.ec.page_size
-        room = -seq_len % ps  # tokens left in the last owned page
+        # draft row j writes KV at position seq_len-1+j, which must land in
+        # an OWNED page; count room against owned pages (reservations
+        # included), not just the pages the committed length implies
+        owned = int(np.count_nonzero(self._pt_host[slot]))
+        room = owned * ps - seq_len
         pending = self._requests[self._slot_req[slot]]
-        limit = min(self.ec.spec_max_draft, room,
-                    pending.max_new_tokens - len(pending.generated) - 1)
+        budget = pending.max_new_tokens - len(pending.generated) - 1
+        if (room < min(self.ec.spec_max_draft, budget)
+                and self.batcher.free_pages > self.ec.max_slots):
+            # near the boundary with drafts still wanted: reserve the next
+            # page ahead of the draft so boundary ticks keep their
+            # acceptance rate (the slack gate keeps reservations from
+            # starving another slot's commit into OOM-truncation)
+            p = self.batcher.reserve_page(slot)
+            if p >= 0:
+                self._pt_host[slot, owned] = p
+                room += ps
+        limit = min(self.ec.spec_max_draft, room, budget)
         if limit <= 0:
             return []
         ctx = pending.context

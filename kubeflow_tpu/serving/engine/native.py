@@ -42,6 +42,8 @@ def load_library() -> ctypes.CDLL:
             lib.eng_commit_token.argtypes = [p, i32, i32]
             lib.eng_commit_token_ex.restype = i32
             lib.eng_commit_token_ex.argtypes = [p, i32, i32, ctypes.POINTER(i32)]
+            lib.eng_reserve_page.restype = i32
+            lib.eng_reserve_page.argtypes = [p, i32]
             lib.eng_slot_pages.argtypes = [p, i32, ip]
             lib.eng_reclaimable.restype = i32
             lib.eng_reclaimable.argtypes = [p]
@@ -127,6 +129,11 @@ class NativeBatcher:
                                           1 if is_eos else 0,
                                           ctypes.byref(new_page))
         return rc, new_page.value
+
+    def reserve_page(self, slot: int) -> int:
+        """Pre-allocate one page for an active slot (speculative drafts
+        across a page boundary). Returns page id, -1 no-op, -2 pool empty."""
+        return load_library().eng_reserve_page(self._handle(), slot)
 
     def release(self, slot: int, prefix_hashes=None) -> None:
         """Free the slot; with ``prefix_hashes`` (uint64, one per full PROMPT

@@ -1,0 +1,42 @@
+"""Chip-validation markers, sha-bound to the kernel source they vouch for.
+
+One place for the invariant shared by the flash and paged markers: a marker
+written after an on-TPU validation pass carries ``kernel_sha`` =
+sha256(kernel source at validation time), and is TRUSTED only while the
+source still hashes to that value — an edited kernel voids the validation
+instead of riding it (the stale-marker risk is exactly what re-opened the
+r2 tunnel-wedge exposure).  Writers: benchmarks/kernel_validate.py,
+benchmarks/engine_chip_check.py.  Readers: bench.py (flash candidate
+promotion), serving/engine/engine.py (paged_kernel default).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+
+def source_sha(src_path: str) -> str:
+    with open(src_path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def write_marker(marker_path: str, src_path: str, extra: dict | None = None) -> None:
+    rec = {"validated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "kernel_sha": source_sha(src_path)}
+    if extra:
+        rec.update(extra)
+    with open(marker_path, "w") as f:
+        json.dump(rec, f, indent=1)
+
+
+def marker_valid(marker_path: str, src_path: str) -> bool:
+    """Marker present AND its kernel_sha matches the current source."""
+    try:
+        with open(marker_path) as f:
+            marker = json.load(f)
+        return marker.get("kernel_sha") == source_sha(src_path)
+    except (OSError, ValueError):
+        return False

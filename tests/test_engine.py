@@ -1251,3 +1251,64 @@ def test_validation_markers_void_on_kernel_source_change(tmp_path, monkeypatch):
     assert E._paged_kernel_default() is False
     monkeypatch.setenv("ENGINE_PAGED_KERNEL", "1")
     assert E._paged_kernel_default() is True  # env override beats marker
+
+
+def test_reserve_page_composes_with_commit_and_release():
+    """eng_reserve_page (speculative boundary drafting, VERDICT r3 weak #6):
+    a reserved page means the commit that crosses into it allocates nothing;
+    the per-slot cap and pool exhaustion return -1/-2; release frees it."""
+    b = NativeBatcher(max_slots=2, num_pages=9, page_size=4, max_pages_per_slot=3)
+    assert b.submit(1, 4, 8)           # exactly one page of prompt
+    slot, *_ = b.admit()
+    free0 = b.free_pages
+    p = b.reserve_page(slot)
+    assert p >= 1 and b.free_pages == free0 - 1
+    # commits 5..8 fill the reserved page: no allocation reported
+    for _ in range(4):
+        rc, new_page = b.commit_token_ex(slot, False)
+        assert rc == 1 and new_page == -1
+    # commit 9 crosses into a third page: allocated normally
+    rc, new_page = b.commit_token_ex(slot, False)
+    assert rc == 1 and new_page >= 1
+    # per-slot cap (3 pages owned): further reservation refused
+    assert b.reserve_page(slot) == -1
+    assert b.reserve_page(99) == -1    # bad slot
+    b.release(slot)
+    assert b.free_pages == free0 + 1   # prompt page + reserved + grown freed
+
+
+def test_speculative_drafts_cross_page_boundaries(params):
+    """Page-ahead reservation (VERDICT r3 weak #6): at a page boundary the
+    drafter reserves the next page and proposes a full draft instead of
+    clamping to zero — exercised against the REAL batcher, no jit."""
+    from concurrent.futures import Future
+
+    from kubeflow_tpu.serving.engine.engine import _Pending
+
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, num_pages=16, page_size=4, max_pages_per_slot=8,
+        speculative="prompt_lookup", spec_max_draft=4,
+    ))
+    ctx = [3, 4, 5, 3, 4, 5, 3, 4]          # len 8 = exactly 2 pages
+    assert eng.batcher.submit(7, len(ctx), 20)
+    slot, rid, *_ = eng.batcher.admit()
+    eng._slot_req[slot] = rid
+    pending = _Pending(tokens=list(ctx), max_new_tokens=20, future=Future())
+    pending.context = list(ctx)
+    eng._requests[rid] = pending
+    eng._pt_host[slot, :2] = eng.batcher.slot_pages(slot)[:2]
+    eng._len_host[slot] = len(ctx)
+
+    draft = eng._draft_for(slot, len(ctx))
+    # final 2-gram (3,4) last occurred at 3 -> continuation [5,3,4]
+    assert draft == [5, 3, 4], draft
+    # the boundary was crossed by reserving the next page, mirrored locally
+    assert int(np.count_nonzero(eng._pt_host[slot])) == 3
+    # the reservation composes with commit: 4 commits fill it silently
+    for _ in range(4):
+        rc, new_page = eng.batcher.commit_token_ex(slot, False)
+        assert rc == 1 and new_page == -1
+    rc, new_page = eng.batcher.commit_token_ex(slot, False)
+    assert rc == 1 and new_page >= 1       # next page allocated normally
+    eng.batcher.release(slot)
+    eng.stop()
