@@ -62,6 +62,9 @@ def main() -> None:
                    help="fraction of each prompt that is a common system-prompt "
                         "prefix shared by every request (exercises the engine's "
                         "automatic prefix cache; TTFT should drop once warm)")
+    p.add_argument("--qps", type=float, default=0.0,
+                   help="open-loop arrival rate (BASELINE protocol: 'p50 at "
+                        "fixed QPS after warmup'); 0 = closed-loop burst")
     args = p.parse_args()
 
     import jax
@@ -117,7 +120,16 @@ def main() -> None:
         engine.generate(prompt(next(iter(long_idx))), 4)
 
     t0 = time.perf_counter()
-    futs = [engine.generate_async(prompt(i), args.max_tokens) for i in range(args.requests)]
+    futs = []
+    for i in range(args.requests):
+        if args.qps > 0:
+            # fixed-QPS open loop: latency includes queueing behind the
+            # engine's actual capacity, the way a real client sees it
+            target = t0 + i / args.qps
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+        futs.append(engine.generate_async(prompt(i), args.max_tokens))
     results = [f.result(timeout=1800) for f in futs]
     wall = time.perf_counter() - t0
     final_stats = engine.stats  # before stop(): close() frees the C core
@@ -148,8 +160,13 @@ def main() -> None:
         "long_requests": len(long_idx),
         "shared_prefix_frac": args.shared_prefix_frac,
         "prefix_cache": final_stats,
+        "qps": args.qps,
         "platform": jax.devices()[0].platform,
         "on_tpu": on_tpu,
+        # BASELINE protocol is >=1k requests at fixed QPS after warmup; a
+        # shorter run is a smoke and the artifact must say so on its own
+        "protocol_note": (None if args.requests >= 1000 and args.qps > 0
+                          else "smoke: <1k requests or closed-loop burst"),
     }))
 
 

@@ -451,8 +451,10 @@ class DashboardWebUI:
                 if store is not None and art.get("uri"):
                     try:
                         # bounded read: never pull a multi-GB artifact into
-                        # the webui process for a page render
-                        head, size = store.get_head(art["uri"], 1024)
+                        # the webui process for a page render; 4096 is also
+                        # the display threshold, so a rendered preview is
+                        # never silently truncated
+                        head, size = store.get_head(art["uri"], 4096)
                         preview = (f"<pre>{_esc(head.decode('utf-8', 'replace'))}"
                                    f"</pre>" if size <= 4096
                                    else f"<i>{size} bytes</i>")

@@ -201,8 +201,8 @@ def test_transport_bench_harness_measures_a_world():
                      "benchmarks", "transport_bench.py"))
     tb = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(tb)
-    rec = tb.run_world(4, [4096, 65536], iters=5, port=26110)
+    rec = tb.run_world(4, [4096], iters=3, port=26110)
     assert rec is not None and rec["world"] == 4
-    assert [r["bytes"] for r in rec["rows"]] == [4096, 65536]
+    assert [r["bytes"] for r in rec["rows"]] == [4096]
     for r in rec["rows"]:
         assert r["p50_ms"] > 0 and r["busbw_MBps"] > 0
