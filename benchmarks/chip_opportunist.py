@@ -81,18 +81,25 @@ JOBS = [
              os.path.join(REPO, "benchmarks", "engine_chip_check.py"), "--all"],
      "timeout": 900, "first_timeout": 600,
      "first_env": {"ECC_STAGE_TIMEOUT_S": "280"}},
-    # 5. on-chip serving p50 at real size (BASELINE row 4); picks up
+    # 5. save_mlp@256 — NOT micro-tuning: the r4 CPU cost-model pass
+    #    (BASELINE.md r4 note) shows save_mlp carries ~0% recompute tax
+    #    (XLA flops ≈ noremat) at 27% fewer bytes than noremat, and it has
+    #    never run on chip (noremat@256 OOM'd; save_mlp should fit)
+    {"name": "mfu_save_mlp_256",
+     "cmd": SWEEP + ["256", "128", "1", "save_mlp", "dense", "8"],
+     "timeout": 540, "first_timeout": 240},
+    # 6. on-chip serving p50 at real size (BASELINE row 4); picks up
     #    --paged-kernel automatically once #4 has validated it
     {"name": "serving_1b_int8",
      "cmd": _serving_cmd("1b", ["--kv-quant", "int8", "--requests", "64",
                                 "--concurrency", "8"]),
      "timeout": 1500, "first_timeout": 900},
-    # 6. cost-model attribution of the best dense config (remat tax +
+    # 7. cost-model attribution of the best dense config (remat tax +
     #    bytes/step); MFU_COST re-lowers, so a generous timeout
     {"name": "mfu_cost_save_attn_512",
      "cmd": SWEEP + ["512", "128", "1", "save_attn", "dense", "4"],
      "timeout": 900, "first_timeout": 420, "env": {"MFU_COST": "1"}},
-    # 7. biggest-model-that-fits: int8 weights halve 8B params to ~8GB,
+    # 8. biggest-model-that-fits: int8 weights halve 8B params to ~8GB,
     #    leaving HBM for the int8 KV pool on one 16GB v5e
     {"name": "serving_8b_int8w",
      "cmd": _serving_cmd("llama3_8b",
@@ -100,10 +107,7 @@ JOBS = [
                           "--requests", "24", "--concurrency", "4",
                           "--max-tokens", "32"]),
      "timeout": 2400, "first_timeout": 1200},
-    # 8+. dense remat micro-tuning — LAST (two rounds bought +1.8% total)
-    {"name": "mfu_save_mlp_256",
-     "cmd": SWEEP + ["256", "128", "1", "save_mlp", "dense", "8"],
-     "timeout": 540, "first_timeout": 240},
+    # 9+. dense remat micro-tuning — LAST (two rounds bought +1.8% total)
     {"name": "mfu_save_attn_768",
      "cmd": SWEEP + ["768", "128", "1", "save_attn", "dense", "8"],
      "timeout": 540, "first_timeout": 240},

@@ -306,6 +306,13 @@ class TrialController:
             "Pod", namespace=req.namespace,
             label_selector={tapi.LABEL_JOB_NAME: req.name},
         )
+        if trial["spec"]["runSpec"].get("kind", "TPUJob") == "Pod":
+            # bare-Pod trial: the workload IS one pod named after the trial
+            # — no job-name label to select on.  Gated on the runSpec kind,
+            # never on "no labeled pods found": a job trial with no pods yet
+            # must not read an unrelated same-named pod's logs as metrics
+            solo = self.api.try_get("Pod", req.name, req.namespace)
+            pods = [solo] if solo is not None else []
         for p in pods:
             pod = p["metadata"]["name"]
             log = self.log_reader(pod, req.namespace)
@@ -349,13 +356,21 @@ class TrialController:
             return None
 
         job_status = job.get("status", {})
-        if has_condition(job_status, tapi.FAILED):
+        if kind == "Pod":
+            # bare-Pod trials (upstream's plain batch-job/pod trialTemplate):
+            # completion is the pod phase — pods have no job conditions
+            job_failed = job_status.get("phase") == "Failed"
+            job_succeeded = job_status.get("phase") == "Succeeded"
+        else:
+            job_failed = has_condition(job_status, tapi.FAILED)
+            job_succeeded = has_condition(job_status, tapi.SUCCEEDED)
+        if job_failed:
             set_condition(status, kapi.FAILED, "True", "TrialFailed", "job failed")
             set_condition(status, kapi.RUNNING, "False", "TrialFailed", "")
             self.recorder.warning(trial, "TrialFailed", "underlying job failed")
             self.api.update_status(trial)
             return None
-        if not has_condition(job_status, tapi.SUCCEEDED):
+        if not job_succeeded:
             self._collect(trial, req)
             return self._maybe_early_stop(trial, status, req)
 
@@ -507,5 +522,8 @@ def install(api: APIServer, manager, log_reader: Callable[[str, str], str],
             obj["metadata"].get("namespace", "default"),
         ) if obj["metadata"].get("labels", {}).get(kapi.LABEL_EXPERIMENT) else None,
     ),))
-    manager.add(trial, owns=tuple(tapi.JOB_KINDS))
+    # "Pod" covers bare-Pod trials (runSpec kind Pod): the pod carries the
+    # trial's ownerReference, so its phase flips requeue the trial the same
+    # way a training job's condition flips do
+    manager.add(trial, owns=tuple(tapi.JOB_KINDS) + ("Pod",))
     return exp, sug, trial

@@ -185,31 +185,47 @@ class DashboardWebUI:
                 from urllib.parse import parse_qs
 
                 parts = [unquote(p) for p in path.strip("/").split("/")]
-                if not (len(parts) == 3 and parts[0] == "ns"
-                        and parts[2] == "spawn" and outer.spawner is not None):
+                is_spawn = (len(parts) == 3 and parts[0] == "ns"
+                            and parts[2] == "spawn"
+                            and outer.spawner is not None)
+                is_exp = (len(parts) == 4 and parts[0] == "ns"
+                          and parts[2] == "experiments" and parts[3] == "new"
+                          and outer.katib is not None)
+                if not (is_spawn or is_exp):
                     self._send(404, _page("Not found", f"<p>{_esc(path)}</p>"))
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     form = {k: v[0] for k, v in
                             parse_qs(self.rfile.read(n).decode()).items()}
-                    outer._spawn(user, parts[1], form)
+                    if is_spawn:
+                        outer._spawn(user, parts[1], form)
+                    else:
+                        outer._create_experiment(user, parts[1], form)
                 except Forbidden as e:
                     self._send(403, _page("Forbidden", f"<p>{_esc(e)}</p>"))
                     return
-                except (KeyError, ValueError, Invalid) as e:
-                    # KeyError = required form field missing; a dead handler
-                    # thread (empty reply) is never the right answer to bad
-                    # form data
+                except (KeyError, ValueError, Invalid, TypeError,
+                        AttributeError) as e:
+                    # KeyError = required form field missing; TypeError/
+                    # AttributeError = valid JSON of the wrong shape. A dead
+                    # handler thread (empty reply) is never the right answer
+                    # to bad form data
                     self._send(400, _page("Invalid", f"<p>{_esc(e)}</p>"))
                     return
-                # POST-redirect-GET back to the namespace page; re-quote the
-                # decoded segment — echoing it raw would let %0d%0a split the
-                # response (CRLF header injection)
+                except Exception as e:  # render bugs -> 500, like do_GET
+                    self._send(500, _page("Error", f"<p>{_esc(e)}</p>"))
+                    return
+                # POST-redirect-GET; re-quote the decoded segments — echoing
+                # them raw would let %0d%0a split the response (CRLF header
+                # injection)
                 from urllib.parse import quote
 
+                loc = f"/ns/{quote(parts[1], safe='')}"
+                if is_exp:
+                    loc += f"/experiments/{quote(form.get('name', ''), safe='')}"
                 self.send_response(303)
-                self.send_header("Location", f"/ns/{quote(parts[1], safe='')}")
+                self.send_header("Location", loc)
                 self.send_header("Content-Length", "0")
                 self.end_headers()
 
@@ -253,6 +269,8 @@ class DashboardWebUI:
             return self._spawn_form(user, parts[1])
         if (len(parts) == 4 and parts[0] == "ns" and parts[2] == "experiments"
                 and self.katib is not None):
+            if parts[3] == "new":
+                return self._experiment_form(user, parts[1])
             return self._experiment(user, parts[1], parts[3])
         if path == "/pipelines" and self.pipelines is not None:
             return self._pipelines(user)
@@ -304,7 +322,10 @@ class DashboardWebUI:
                     and self.katib is not None else _esc(i["name"]))
                 + f"</td>{_phase_cell(i['phase'])}</tr>"
                 for i in info["items"])
-            sections.append(f"<h2>{_esc(kind)} ({info['count']})</h2>"
+            new_link = (f" <a href='/ns/{_esc(ns)}/experiments/new'>new</a>"
+                        if kind == "Experiment" and self.katib is not None
+                        else "")
+            sections.append(f"<h2>{_esc(kind)} ({info['count']}){new_link}</h2>"
                             f"<table><tr><th>name</th><th>phase</th></tr>"
                             f"{rows}</table>")
         qrows = "".join(
@@ -552,6 +573,76 @@ class DashboardWebUI:
                  f"<th>retries</th><th>message</th></tr>{rows}</table>")
         body += self._run_artifacts(nodes)
         return _page(f"Run {run_id}", body)
+
+    _DEFAULT_PARAMS = ('[{"name": "lr", "parameterType": "double", '
+                       '"feasibleSpace": {"min": 0.01, "max": 1.0}}]')
+    # restartPolicy Never matters: the kubelet default (Always) would
+    # restart the trial pod forever and the trial would never complete
+    _DEFAULT_TRIAL = ('{"apiVersion": "v1", "kind": "Pod", "spec": '
+                      '{"restartPolicy": "Never", "containers": '
+                      '[{"name": "main", "command": ["python3", "-c", '
+                      '"print(\'metric=${trialParameters.lr}\')"]}]}}')
+
+    def _experiment_form(self, user: str, ns: str) -> bytes:
+        """The katib-ui submit flow: a form that builds an Experiment CR —
+        algorithm dropdown straight from the suggester registry, parameters
+        and trial spec as JSON (upstream's YAML-paste equivalent)."""
+        self._authz(user, "create", "Experiment", ns)
+        from ..katib.suggest import algorithm_names
+
+        algos = "".join(f"<option>{_esc(a)}</option>"
+                        for a in algorithm_names())
+        body = (
+            f"<form method='post' action='/ns/{_esc(ns)}/experiments/new'>"
+            "<p><label>name <input name='name' required></label> "
+            "<label>objective metric <input name='metric' required></label> "
+            "<label>type <select name='type'><option>maximize</option>"
+            "<option>minimize</option></select></label> "
+            "<label>goal <input name='goal' placeholder='optional'></label></p>"
+            f"<p><label>algorithm <select name='algorithm'>{algos}</select>"
+            "</label> <label>max trials "
+            "<input name='max_trials' value='10' size='4'></label> "
+            "<label>parallel <input name='parallel_trials' value='3' "
+            "size='4'></label></p>"
+            "<p><label>parameters (JSON list)<br>"
+            f"<textarea name='parameters' rows='4' cols='80'>"
+            f"{_esc(self._DEFAULT_PARAMS)}</textarea></label></p>"
+            "<p><label>trial spec (JSON, ${trialParameters.x} placeholders)"
+            f"<br><textarea name='trial_spec' rows='6' cols='80'>"
+            f"{_esc(self._DEFAULT_TRIAL)}</textarea></label></p>"
+            "<button type='submit'>Create experiment</button></form>")
+        return _page(f"New experiment in {ns}", body)
+
+    def _create_experiment(self, user: str, ns: str, form: dict) -> None:
+        import json as _json
+
+        self._authz(user, "create", "Experiment", ns)
+        from ..katib.api import Parameter, experiment
+
+        if form["name"] == "new":
+            # /experiments/new is the form route — an experiment with that
+            # name would render the blank form instead of its own results
+            raise ValueError("'new' is a reserved experiment name")
+        raw_params = _json.loads(form["parameters"])
+        if not isinstance(raw_params, list):
+            raise ValueError("parameters must be a JSON list")
+        params = [Parameter(p["name"], p["parameterType"],
+                            min=p.get("feasibleSpace", {}).get("min"),
+                            max=p.get("feasibleSpace", {}).get("max"),
+                            step=p.get("feasibleSpace", {}).get("step"),
+                            list=p.get("feasibleSpace", {}).get("list"))
+                  for p in raw_params]
+        goal = form.get("goal", "").strip()
+        exp = experiment(
+            form["name"], params, _json.loads(form["trial_spec"]),
+            objective_metric=form["metric"],
+            objective_type=form.get("type", "maximize"),
+            goal=float(goal) if goal else None,
+            algorithm=form.get("algorithm", "random"),
+            max_trials=int(form.get("max_trials", 10)),
+            parallel_trials=int(form.get("parallel_trials", 3)),
+            namespace=ns)
+        self.api.create(exp)
 
     def _experiment(self, user: str, ns: str, name: str) -> Optional[bytes]:
         self._authz(user, "list", "Experiment", ns)

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from kubeflow_tpu.core.cluster import Cluster
+from kubeflow_tpu.core.conditions import has_condition
 from kubeflow_tpu.katib import api as kapi
 from kubeflow_tpu.katib.api import Parameter, experiment
 from kubeflow_tpu.katib.client import KatibClient
@@ -633,3 +634,35 @@ def test_pbt_population_improves_over_generations():
     assert gen_best[-1] >= gen_best[0]
     assert gen_mean[-1] > gen_mean[0]  # the POPULATION improves, not one child
     assert gen_best[-1] > 0.95  # converged near lr = 0.3
+
+
+def test_bare_pod_trial_experiment_succeeds(kcluster):
+    """Bare-Pod trialTemplate (upstream's plain batch-job/pod trial): the
+    pod IS the workload — completion tracked by pod phase, metrics read
+    from the pod's own log, experiment reaches Succeeded with an optimal
+    trial (katib-ui webui form's default trial spec uses this shape)."""
+    import sys as _sys
+
+    c = kcluster
+    exp = experiment(
+        "podtrial",
+        [Parameter("lr", "double", min=0.1, max=0.9)],
+        {"apiVersion": "v1", "kind": "Pod",
+         "spec": {"restartPolicy": "Never", "containers": [{
+             "name": "main",
+             "command": [_sys.executable, "-u", "-c",
+                         "print('accuracy=${trialParameters.lr}')"]}]}},
+        objective_metric="accuracy", algorithm="random",
+        max_trials=3, parallel_trials=2)
+    c.api.create(exp)
+    assert c.wait_for(
+        lambda: has_condition(
+            (c.api.try_get("Experiment", "podtrial") or {}).get("status", {}),
+            kapi.SUCCEEDED),
+        timeout=90)
+    st = c.api.get("Experiment", "podtrial")["status"]
+    opt = st["currentOptimalTrial"]
+    assert opt["bestTrialName"]
+    lr = float(opt["parameterAssignments"][0]["value"])
+    # the objective was truly read from the pod log: it IS the lr value
+    assert abs(float(opt["observation"]["metrics"][0]["latest"]) - lr) < 1e-9

@@ -456,3 +456,100 @@ def test_webui_namespace_shows_cull_status(platform):
         assert "culled (idle)" in page
     finally:
         ui.shutdown()
+
+
+def test_webui_experiment_create_form(platform):
+    """The katib-ui submit capability through the shell: GET renders the
+    algorithm dropdown from the suggester registry; POST builds and creates
+    the Experiment CR (RBAC'd) and redirects to its page."""
+    import json as _json
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from kubeflow_tpu.katib import api as _kapi
+    from kubeflow_tpu.katib.obslog import ObservationStore
+    from kubeflow_tpu.katib.service import KatibService
+    from kubeflow_tpu.platform.webui import DashboardWebUI
+
+    c, _ = platform
+    _kapi.register(c.api)
+    c.apply(papi.profile("form-ns", "form@x.io"))
+    c.settle(quiet=0.3)
+    store = ObservationStore(":memory:")
+    ui = DashboardWebUI(c.api, katib_service=KatibService(c.api, store))
+    try:
+        req = urllib.request.Request(ui.url + "/ns/form-ns/experiments/new",
+                                     headers={"kubeflow-userid": "form@x.io"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            page = r.read().decode()
+        assert "algorithm" in page and "bayesian" in page and "tpe" in page
+
+        data = urllib.parse.urlencode({
+            "name": "web-sweep", "metric": "accuracy", "type": "maximize",
+            "goal": "0.95", "algorithm": "random", "max_trials": "4",
+            "parallel_trials": "2",
+            "parameters": _json.dumps([
+                {"name": "lr", "parameterType": "double",
+                 "feasibleSpace": {"min": 0.1, "max": 0.9}}]),
+            "trial_spec": _json.dumps({
+                "apiVersion": "v1", "kind": "Pod", "spec": {"containers": [
+                    {"name": "main", "command": ["echo",
+                                                 "${trialParameters.lr}"]}]}}),
+        }).encode()
+        req = urllib.request.Request(ui.url + "/ns/form-ns/experiments/new",
+                                     data=data,
+                                     headers={"kubeflow-userid": "form@x.io"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert "/experiments/web-sweep" in r.url  # redirected to detail
+        exp = c.api.get("Experiment", "web-sweep", "form-ns")
+        assert exp["spec"]["objective"]["goal"] == 0.95
+        assert exp["spec"]["maxTrialCount"] == 4
+        assert exp["spec"]["parameters"][0]["feasibleSpace"]["max"] == 0.9
+
+        # bad JSON in the form -> 400, nothing created
+        bad = urllib.parse.urlencode({
+            "name": "bad", "metric": "m", "parameters": "not json",
+            "trial_spec": "{}"}).encode()
+        req = urllib.request.Request(ui.url + "/ns/form-ns/experiments/new",
+                                     data=bad,
+                                     headers={"kubeflow-userid": "form@x.io"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+        assert c.api.try_get("Experiment", "bad", "form-ns") is None
+
+        # wrong-SHAPE JSON (valid JSON, list of non-objects) -> 400 too
+        shape = urllib.parse.urlencode({
+            "name": "shape", "metric": "m", "parameters": "[1]",
+            "trial_spec": "{}"}).encode()
+        req = urllib.request.Request(ui.url + "/ns/form-ns/experiments/new",
+                                     data=shape,
+                                     headers={"kubeflow-userid": "form@x.io"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+
+        # the reserved form-route name is rejected
+        reserved = urllib.parse.urlencode({
+            "name": "new", "metric": "m",
+            "parameters": DashboardWebUI._DEFAULT_PARAMS,
+            "trial_spec": DashboardWebUI._DEFAULT_TRIAL}).encode()
+        req = urllib.request.Request(ui.url + "/ns/form-ns/experiments/new",
+                                     data=reserved,
+                                     headers={"kubeflow-userid": "form@x.io"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+        assert c.api.try_get("Experiment", "new", "form-ns") is None
+
+        # stranger: 403 on both GET and POST
+        for method_data in (None, data):
+            req = urllib.request.Request(
+                ui.url + "/ns/form-ns/experiments/new", data=method_data,
+                headers={"kubeflow-userid": "eve@x.io"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 403
+    finally:
+        ui.shutdown()
