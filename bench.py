@@ -65,16 +65,18 @@ def _flash_validated() -> bool:
 
 
 if _flash_validated():
-    # flash goes FIRST once kernel_validate has passed all stages on a real
-    # chip (it writes the marker): it is the only lever with plausible
+    # flash goes FIRST once kernel_validate has passed the flash stages on a
+    # real chip (it writes the marker): it is the only lever with plausible
     # headroom past 0.476, and the wedge risk the r2 gate guarded against
-    # is exactly what the validation run retired
-    CANDIDATES.insert(0, (512, 0, "nothing", "flash"))
+    # is exactly what the validation run retired.  Both remat'd — the r4
+    # window showed no-remat@512 dies OOM-class in ~55s.
+    CANDIDATES.insert(0, (512, 1, "save_mlp", "flash"))
     CANDIDATES.insert(1, (512, 1, "save_attn", "flash"))
 elif os.environ.get("BENCH_TRY_FLASH") == "1":
     # manual override without chip validation: keep flash LAST so a wedge
-    # only poisons candidates that already ran (r2 behavior)
-    CANDIDATES.append((512, 0, "nothing", "flash"))
+    # only poisons candidates that already ran (r2 behavior); remat'd — the
+    # no-remat 512 config dies OOM-class (r4 window)
+    CANDIDATES.append((512, 1, "save_mlp", "flash"))
 
 PER_CANDIDATE_TIMEOUT_S = float(os.environ.get("BENCH_CANDIDATE_TIMEOUT_S", "300"))
 TOTAL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
@@ -130,6 +132,34 @@ def last_json_line(stdout: str, require_key: str | None = None):
         if require_key is None or require_key in rec:
             return rec
     return None
+
+
+_NOISE = ("For simplicity, JAX has removed its internal frames",
+          "Set JAX_TRACEBACK_FILTERING=off",
+          "--------------------")
+
+
+def error_tail(err: str, max_lines: int = 5, max_chars: int = 600) -> str:
+    """Attributable failure summary from a subprocess's stderr: the actual
+    exception line first if one is recognizable, then the last few
+    non-noise lines.  The r3 window's failures were recorded as JAX's
+    traceback-filtering NOTICE (the literal last stderr line) — every real
+    error was lost; this keeps enough context to act on.  Shared by
+    kernel_validate / engine_chip_check / chip_opportunist."""
+    lines = [ln.strip() for ln in (err or "").strip().splitlines()
+             if ln.strip() and not any(n in ln for n in _NOISE)]
+    if not lines:
+        return "?"
+    import re
+    # [\w.]+ so dotted names match too — jaxlib.xla_extension.XlaRuntimeError
+    # is the most common chip failure class
+    exc = next((ln for ln in reversed(lines)
+                if re.match(r"[\w.]+(Error|Exception|Interrupt)\b", ln)
+                or "RESOURCE_EXHAUSTED" in ln or "INTERNAL:" in ln), None)
+    tail = lines[-max_lines:]
+    if exc and exc not in tail:
+        tail = [exc] + tail[-(max_lines - 1):]
+    return " | ".join(tail)[:max_chars]
 
 
 def _parse_sweep_output(stdout: str):

@@ -26,7 +26,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from bench import _run, _sweep_env, _tpu_preflight, last_json_line  # noqa: E402  (same harness)
+from bench import _run, _sweep_env, _tpu_preflight, error_tail, last_json_line  # noqa: E402  (same harness)
 
 PROBE_EVERY_S = float(os.environ.get("CHIP_PROBE_EVERY_S", "600"))
 MAX_ATTEMPTS = 3
@@ -67,9 +67,12 @@ JOBS = [
              os.path.join(REPO, "benchmarks", "kernel_validate.py"), "--all"],
      "timeout": 1800, "first_timeout": 750,
      "first_env": {"KV_STAGE_TIMEOUT_S": "140"}},
-    # 2-3. flash MFU — the only lever with plausible headroom to 0.55+
-    {"name": "mfu_flash_512",
-     "cmd": SWEEP + ["512", "128", "0", "nothing", "flash", "8"],
+    # 2-3. flash MFU — the only lever with plausible headroom to 0.55+.
+    # Both remat'd: the r4 window's no-remat flash@512 died in ~55s
+    # (OOM-class, same as dense noremat@256 in r3); save_mlp carries ~0%
+    # recompute tax per the r4 cost-model pass (BASELINE.md).
+    {"name": "mfu_flash_save_mlp_512",
+     "cmd": SWEEP + ["512", "128", "1", "save_mlp", "flash", "8"],
      "timeout": 540, "first_timeout": 240},
     {"name": "mfu_flash_save_attn_512",
      "cmd": SWEEP + ["512", "128", "1", "save_attn", "flash", "8"],
@@ -175,9 +178,11 @@ def drain_queue(state: dict) -> bool:
             _record(name, {"ok": True, "wall_s": wall,
                            "result": last_json_line(out) or {}})
         else:
-            tail = (err or "").strip().splitlines()[-1:] or ["?"]
+            # keep the child's LAST stdout JSON too: the staged harnesses
+            # emit the real per-stage error there and exit non-zero
             _record(name, {"ok": False, "wall_s": wall,
-                           "rc": rc, "error": tail[0][:300],
+                           "rc": rc, "error": error_tail(err),
+                           "last_stdout": last_json_line(out) or {},
                            "timeout": rc is None})
         _save_state(state)
     return all(state.get(j["name"], {}).get("done") for j in JOBS)

@@ -39,10 +39,14 @@ MARKER = os.path.join(REPO, "kubeflow_tpu", "serving", "engine",
 
 
 def _tiny_config():
+    """Tiny in params, TPU-tile-legal in shape: head_dim=128 (the production
+    llama3_8b head size — Mosaic's lane tile) and page_size=16; the r4 chip
+    window showed sub-tile toy shapes (hd=16, ps=8) fail where shipping
+    shapes compile."""
     from kubeflow_tpu.serving.engine.model import DecoderConfig
 
-    return DecoderConfig(vocab_size=101, d_model=64, n_layers=2, n_heads=4,
-                         n_kv_heads=2, d_ff=128)
+    return DecoderConfig(vocab_size=101, d_model=512, n_layers=2, n_heads=4,
+                         n_kv_heads=2, d_ff=256)
 
 
 def _stage_decode_composed():
@@ -56,19 +60,20 @@ def _stage_decode_composed():
 
     cfg = _tiny_config()
     params = M.init_int8(jax.random.PRNGKey(0), cfg)
-    page_size = 8
+    page_size = 16
     shape = (cfg.n_layers, 16, page_size, cfg.n_kv_heads, cfg.head_dim)
-    toks8 = jnp.asarray([[5, 7, 9, 11, 2, 4, 6, 8]], jnp.int32)
+    toks16 = jnp.asarray([[5, 7, 9, 11, 2, 4, 6, 8,
+                           13, 3, 1, 12, 10, 14, 15, 16]], jnp.int32)
     pools = []
     for _ in range(2):  # decode_step donates its pool — need two copies
         k_pool = M.make_kv_pool(shape, "int8")
         v_pool = M.make_kv_pool(shape, "int8")
-        _, pk, pv = M.prefill(params, cfg, toks8, jnp.int32(8), page_size)
+        _, pk, pv = M.prefill(params, cfg, toks16, jnp.int32(16), page_size)
         k_pool, v_pool = M.write_pages(k_pool, v_pool, pk, pv,
                                        jnp.asarray([3], jnp.int32))
         pools.append((k_pool, v_pool))
     pt = jnp.asarray([[3, 0, 0, 0], [0, 0, 0, 0]], jnp.int32)
-    lens = jnp.asarray([8, 0], jnp.int32)
+    lens = jnp.asarray([16, 0], jnp.int32)
     tok = jnp.asarray([10, 0], jnp.int32)
     lg, _, _ = M.decode_step(params, cfg, tok, lens, pt, *pools[0])
     lp, _, _ = M.decode_step(params, cfg, tok, lens, pt, *pools[1], paged=True)
@@ -84,7 +89,7 @@ def _run_engine(params, cfg, paged: bool, prompts, max_new: int):
     from kubeflow_tpu.serving.engine import Engine, EngineConfig
 
     eng = Engine(params, cfg, EngineConfig(
-        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        max_slots=2, num_pages=64, page_size=16, max_pages_per_slot=16,
         prefill_chunk=16, kv_quant="int8", paged_kernel=paged,
         speculative="prompt_lookup", spec_max_draft=4,
     ))
@@ -154,7 +159,7 @@ def main() -> None:
     if sys.argv[1:] and sys.argv[1] != "--all":
         print(json.dumps(run_stage(sys.argv[1])))
         return
-    from bench import _run, _sweep_env, last_json_line
+    from bench import _run, _sweep_env, error_tail, last_json_line
 
     timeout_s = float(os.environ.get("ECC_STAGE_TIMEOUT_S", "420"))
     results = []
@@ -170,8 +175,8 @@ def main() -> None:
                            {"stage": stage, "ok": False,
                             "error": "no JSON line in stage stdout"})
         else:
-            tail = (err or "").strip().splitlines()[-1:] or ["?"]
-            results.append({"stage": stage, "ok": False, "error": tail[0][:300]})
+            results.append({"stage": stage, "ok": False,
+                            "error": error_tail(err)})
         print(json.dumps(results[-1]), flush=True)
         if not results[-1].get("ok"):
             break
@@ -184,6 +189,10 @@ def main() -> None:
         write_marker(MARKER, _PAGED_KERNEL_SRC, {"stages": results})
         print(json.dumps({"marker_written": MARKER}), flush=True)
     print(json.dumps({"stages": results, "all_ok": all_ok, "on_tpu": on_tpu}))
+    if not (all_ok and on_tpu):
+        # the queue must see failure and retry next window — including a
+        # green CPU run, which writes no marker and so achieved nothing
+        sys.exit(1)
 
 
 if __name__ == "__main__":
