@@ -26,7 +26,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from bench import _run, _sweep_env, _tpu_preflight, error_tail, last_json_line  # noqa: E402  (same harness)
+from bench import (_run, _sweep_env, _tpu_preflight, bench_active, chip_lock,  # noqa: E402  (same harness)
+                   error_tail, last_json_line)
 
 PROBE_EVERY_S = float(os.environ.get("CHIP_PROBE_EVERY_S", "600"))
 MAX_ATTEMPTS = 3
@@ -150,28 +151,40 @@ def drain_queue(state: dict) -> bool:
             continue
         if st.get("attempts", 0) >= MAX_ATTEMPTS:
             continue
+        # the driver's end-of-round bench owns the chip — stand down
+        # immediately (its artifact matters more than the queue)
+        if bench_active():
+            print("opportunist: BENCH_ACTIVE — standing down", flush=True)
+            return False
         # re-preflight between jobs: a wedged job usually wedges the tunnel
         # for everything after it — stop draining rather than burn timeouts
         if not _tpu_preflight(120):
             print("opportunist: tunnel gone mid-drain, pausing", flush=True)
             return False
-        attempt = st.get("attempts", 0)
-        st["attempts"] = attempt + 1
-        state[name] = st
-        _save_state(state)
-        cmd = job["cmd"]() if callable(job["cmd"]) else job["cmd"]
-        # attempt 0 runs tight (outer cap + tight per-stage env) so a wedge
-        # burns minutes, not the window; retries get the full budget and the
-        # harness's own default stage timeouts
-        timeout_s = (job.get("first_timeout") or job["timeout"]) \
-            if attempt == 0 else job["timeout"]
-        t0 = time.monotonic()
-        env = _sweep_env()
-        if job.get("env"):
-            env.update(job["env"])
-        if attempt == 0 and job.get("first_env"):
-            env.update(job["first_env"])
-        rc, out, err = _run(cmd, timeout_s, env)
+        # hold the chip flock for the job's duration so a concurrent bench
+        # run waits instead of compiling into the same tunnel (wedge risk);
+        # attempts count only once the job actually starts
+        with chip_lock(wait_s=0) as owned:
+            if not owned:
+                print("opportunist: chip lock held elsewhere, pausing", flush=True)
+                return False
+            attempt = st.get("attempts", 0)
+            st["attempts"] = attempt + 1
+            state[name] = st
+            _save_state(state)
+            cmd = job["cmd"]() if callable(job["cmd"]) else job["cmd"]
+            # attempt 0 runs tight (outer cap + tight per-stage env) so a
+            # wedge burns minutes, not the window; retries get the full
+            # budget and the harness's own default stage timeouts
+            timeout_s = (job.get("first_timeout") or job["timeout"]) \
+                if attempt == 0 else job["timeout"]
+            t0 = time.monotonic()
+            env = _sweep_env()
+            if job.get("env"):
+                env.update(job["env"])
+            if attempt == 0 and job.get("first_env"):
+                env.update(job["first_env"])
+            rc, out, err = _run(cmd, timeout_s, env)
         wall = round(time.monotonic() - t0, 1)
         if rc == 0:
             st["done"] = True
@@ -204,7 +217,11 @@ def main() -> None:
             print(f"opportunist: queue exhausted ({len(done)}/{len(JOBS)} "
                   f"succeeded) — exiting", flush=True)
             return
-        if _tpu_preflight(120):
+        if bench_active():
+            # the driver's bench owns the chip: no probes either (a probe is
+            # a tunnel touch and the 1-core box is time-sliced)
+            print("opportunist: BENCH_ACTIVE — idle", flush=True)
+        elif _tpu_preflight(120):
             print("opportunist: tunnel ALIVE — draining queue", flush=True)
             if drain_queue(state):
                 print("opportunist: all jobs done, exiting", flush=True)
