@@ -65,19 +65,26 @@ def _flash_validated() -> bool:
         os.path.join(REPO, "kubeflow_tpu", "ops", "flash_attention.py"))
 
 
-if _flash_validated():
-    # flash goes FIRST once kernel_validate has passed the flash stages on a
-    # real chip (it writes the marker): it is the only lever with plausible
-    # headroom past 0.476, and the wedge risk the r2 gate guarded against
-    # is exactly what the validation run retired.  Both remat'd — the r4
-    # window showed no-remat@512 dies OOM-class in ~55s.
-    CANDIDATES.insert(0, (512, 1, "save_mlp", "flash"))
-    CANDIDATES.insert(1, (512, 1, "save_attn", "flash"))
-elif os.environ.get("BENCH_TRY_FLASH") == "1":
-    # manual override without chip validation: keep flash LAST so a wedge
-    # only poisons candidates that already ran (r2 behavior); remat'd — the
-    # no-remat 512 config dies OOM-class (r4 window)
-    CANDIDATES.append((512, 1, "save_mlp", "flash"))
+def build_candidates() -> list:
+    """Candidate list with flash promotion resolved NOW — called inside
+    main() after the chip-lock wait, because the watcher job the bench just
+    waited on is often kernel_validate, i.e. the writer of the very marker
+    that decides promotion.  An import-time decision would miss it."""
+    cands = list(CANDIDATES)
+    if _flash_validated():
+        # flash goes FIRST once kernel_validate has passed the flash stages
+        # on a real chip (it writes the marker): it is the only lever with
+        # plausible headroom past 0.476, and the wedge risk the r2 gate
+        # guarded against is exactly what the validation run retired.  Both
+        # remat'd — the r4 window showed no-remat@512 dies OOM-class in ~55s.
+        cands.insert(0, (512, 1, "save_mlp", "flash"))
+        cands.insert(1, (512, 1, "save_attn", "flash"))
+    elif os.environ.get("BENCH_TRY_FLASH") == "1":
+        # manual override without chip validation: keep flash LAST so a wedge
+        # only poisons candidates that already ran (r2 behavior); remat'd —
+        # the no-remat 512 config dies OOM-class (r4 window)
+        cands.append((512, 1, "save_mlp", "flash"))
+    return cands
 
 PER_CANDIDATE_TIMEOUT_S = float(os.environ.get("BENCH_CANDIDATE_TIMEOUT_S", "300"))
 TOTAL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
@@ -201,7 +208,14 @@ def chip_lock(wait_s: float = 0.0):
     with a warning — the end-of-round artifact must still be attempted)."""
     import fcntl
 
-    f = open(CHIP_LOCK, "w")
+    try:
+        f = open(CHIP_LOCK, "w")
+    except OSError:
+        # lock file unwritable (read-only checkout, disk full): yield None —
+        # distinct from False ("held elsewhere") so callers can proceed
+        # unlocked instead of treating a broken fs as permanent contention
+        yield None
+        return
     deadline = time.monotonic() + wait_s
     acquired = False
     while True:
@@ -324,14 +338,24 @@ def main() -> None:
     # own the chip for the artifact run: flag first (the watcher stops
     # starting new jobs and probes), then wait for its in-flight job to
     # release the flock.  The default wait covers the watcher's LONGEST
-    # job hold (2400s serving bench) — the watcher cannot yield mid-job,
-    # so a shorter wait would make unlocked contention (the r2-r4 wedge
-    # signature) the common case, not the edge case.
-    with open(BENCH_ACTIVE, "w") as f:
-        f.write(str(os.getpid()))
+    # job hold (120s pre-job preflight + 2400s serving bench + kill
+    # cleanup) — the watcher cannot yield mid-job, so a shorter wait would
+    # make unlocked contention (the r2-r4 wedge signature) the common
+    # case, not the edge case.
     try:
-        with chip_lock(wait_s=float(os.environ.get("BENCH_LOCK_WAIT_S", "2500"))) as owned:
-            if not owned:
+        with open(BENCH_ACTIVE, "w") as f:
+            f.write(str(os.getpid()))
+    except OSError as e:
+        # flag is best-effort coordination — never let it kill the artifact
+        # run ("the bench always prints a JSON line")
+        print(f"bench: could not write BENCH_ACTIVE ({e}) — continuing",
+              file=sys.stderr)
+    try:
+        with chip_lock(wait_s=float(os.environ.get("BENCH_LOCK_WAIT_S", "2700"))) as owned:
+            if owned is None:
+                print("bench: chip.lock unwritable — proceeding unlocked",
+                      file=sys.stderr)
+            elif not owned:
                 print("bench: proceeding WITHOUT the chip lock (watcher job "
                       "still running past the wait budget) — contention risk",
                       file=sys.stderr)
@@ -343,14 +367,23 @@ def main() -> None:
                 print("bench: TPU preflight failed — skipping chip candidates",
                       file=sys.stderr)
             floor_ok = False
-            for cand in CANDIDATES if n_chips else []:
+            # resolved after the lock wait: the watcher job we may have just
+            # waited on can be kernel_validate, the flash-marker writer
+            candidates = build_candidates()
+            for cand in candidates if n_chips else []:
                 remaining = deadline - time.monotonic()
                 if remaining <= 30:
                     print(f"bench: budget exhausted before {cand}", file=sys.stderr)
                     break
                 # refresh the flag so the watcher's staleness window only
-                # fires for genuinely crashed benches, not long sweeps
-                os.utime(BENCH_ACTIVE, None)
+                # fires for genuinely crashed benches, not long sweeps; a
+                # rewrite both bumps mtime and recreates a flag another
+                # bench's cleanup unlinked — best-effort either way
+                try:
+                    with open(BENCH_ACTIVE, "w") as f:
+                        f.write(str(os.getpid()))
+                except OSError:
+                    pass
                 rec = _run_candidate(cand, n_chips, min(PER_CANDIDATE_TIMEOUT_S, remaining))
                 if rec is None:
                     continue

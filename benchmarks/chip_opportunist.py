@@ -156,17 +156,21 @@ def drain_queue(state: dict) -> bool:
         if bench_active():
             print("opportunist: BENCH_ACTIVE — standing down", flush=True)
             return False
-        # re-preflight between jobs: a wedged job usually wedges the tunnel
-        # for everything after it — stop draining rather than burn timeouts
-        if not _tpu_preflight(120):
-            print("opportunist: tunnel gone mid-drain, pausing", flush=True)
-            return False
-        # hold the chip flock for the job's duration so a concurrent bench
-        # run waits instead of compiling into the same tunnel (wedge risk);
-        # attempts count only once the job actually starts
+        # hold the chip flock for the preflight AND the job: the probe is a
+        # tunnel touch too, and probing outside the lock left a ≤120s TOCTOU
+        # window where a just-started bench and the probe shared the tunnel
+        # (the r2-r4 two-writers wedge signature).  None = lock file
+        # unwritable on this fs — proceed unlocked like bench does;
+        # attempts count only once the job actually starts.
         with chip_lock(wait_s=0) as owned:
-            if not owned:
+            if owned is False:
                 print("opportunist: chip lock held elsewhere, pausing", flush=True)
+                return False
+            # re-preflight between jobs: a wedged job usually wedges the
+            # tunnel for everything after it — stop draining rather than
+            # burn timeouts
+            if not _tpu_preflight(120):
+                print("opportunist: tunnel gone mid-drain, pausing", flush=True)
                 return False
             attempt = st.get("attempts", 0)
             st["attempts"] = attempt + 1
@@ -221,14 +225,21 @@ def main() -> None:
             # the driver's bench owns the chip: no probes either (a probe is
             # a tunnel touch and the 1-core box is time-sliced)
             print("opportunist: BENCH_ACTIVE — idle", flush=True)
-        elif _tpu_preflight(120):
-            print("opportunist: tunnel ALIVE — draining queue", flush=True)
-            if drain_queue(state):
-                print("opportunist: all jobs done, exiting", flush=True)
-                return
         else:
-            print(f"opportunist: tunnel down at "
-                  f"{time.strftime('%H:%M:%S')}", flush=True)
+            # probe under the flock too: a bench starting mid-probe would
+            # otherwise share the tunnel with it for up to 120s (TOCTOU)
+            with chip_lock(wait_s=0) as owned:
+                alive = False if owned is False else _tpu_preflight(120)
+            if owned is False:
+                print("opportunist: chip lock held elsewhere — idle", flush=True)
+            elif alive:
+                print("opportunist: tunnel ALIVE — draining queue", flush=True)
+                if drain_queue(state):
+                    print("opportunist: all jobs done, exiting", flush=True)
+                    return
+            else:
+                print(f"opportunist: tunnel down at "
+                      f"{time.strftime('%H:%M:%S')}", flush=True)
         if args.once:
             return
         time.sleep(PROBE_EVERY_S)

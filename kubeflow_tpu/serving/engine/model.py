@@ -75,7 +75,7 @@ def init(key: jax.Array, config: DecoderConfig, dtype=jnp.bfloat16) -> dict:
     c = config
     hd = c.head_dim
     n = c.n_layers
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 9)
 
     def w(k, *shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(dtype)
@@ -92,7 +92,7 @@ def init(key: jax.Array, config: DecoderConfig, dtype=jnp.bfloat16) -> dict:
         "ln_attn": jnp.ones((n, c.d_model), dtype),
         "ln_mlp": jnp.ones((n, c.d_model), dtype),
         "ln_out": jnp.ones((c.d_model,), dtype),
-        "unembed": w(keys[0], c.d_model, c.vocab_size, fan_in=c.d_model),
+        "unembed": w(keys[8], c.d_model, c.vocab_size, fan_in=c.d_model),
     }
 
 
