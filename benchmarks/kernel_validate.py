@@ -173,8 +173,12 @@ def main() -> None:
     stages = STAGES
     flash_already = marker_valid(FLASH_MARKER, FLASH_SRC)
     if flash_already:
-        stages = ["paged"]
-        print(json.dumps({"skipping": STAGES[:4],
+        # keep the ~9s `trivial` stage as a tunnel-liveness canary: without
+        # it the first device touch is the paged compile, and a wedged
+        # tunnel would be mis-charged to the paged kernel — the exact
+        # ambiguity this staged harness exists to bisect
+        stages = ["trivial", "paged"]
+        print(json.dumps({"skipping": STAGES[1:4],
                           "reason": "valid FLASH_CHIP_VALIDATED marker"}),
               flush=True)
     results = []

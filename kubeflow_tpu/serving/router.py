@@ -119,11 +119,43 @@ class ServiceProxy:
                 req = urllib.request.Request(url, data=body, method=self.command, headers=fwd_headers)
                 try:
                     with urllib.request.urlopen(req, timeout=60) as r:
-                        self._reply(r.status, r.read(), r.headers.get("Content-Type"))
+                        ctype = r.headers.get("Content-Type") or ""
+                        if ctype.startswith("text/event-stream"):
+                            # SSE passthrough: relay chunks as they arrive
+                            # (buffering r.read() would hold every token
+                            # until the generation finished — the ingress
+                            # must not defeat streaming)
+                            self._stream(r, ctype)
+                        else:
+                            self._reply(r.status, r.read(), ctype or None)
                 except urllib.error.HTTPError as e:
                     self._reply(e.code, e.read(), e.headers.get("Content-Type"))
                 except Exception as e:  # noqa: BLE001
                     self._reply(502, json.dumps({"error": f"backend: {e}"}).encode())
+
+            def _stream(self, r, ctype: str) -> None:
+                # nothing may bubble out of here: once any response byte is
+                # on the wire, _forward's catch-all would write a SECOND
+                # HTTP response into the body (same invariant as the model
+                # server's _sse_write) — so even the header writes live
+                # inside the try (a client can hang up before them too)
+                try:
+                    self.send_response(r.status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    while True:
+                        chunk = r.read1(65536)  # whatever the backend flushed
+                        if not chunk:
+                            break
+                        self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except Exception:  # noqa: BLE001 — incl. IncompleteRead
+                    # backend died or client hung up mid-stream: the framing
+                    # is already broken — close the connection, never re-reply
+                    self.close_connection = True
 
             def _reply(self, code: int, data: bytes, ctype: Optional[str] = "application/json"):
                 self.send_response(code)
@@ -272,7 +304,22 @@ class ServiceProxy:
             payload = json.loads(body)
         except ValueError:
             return None
-        prompt = payload.get("text_input") if isinstance(payload, dict) else None
+        if not isinstance(payload, dict):
+            return None
+        prompt = payload.get("text_input")  # V1-generate style
+        if not isinstance(prompt, str):
+            prompt = payload.get("prompt")  # OpenAI completions
+        if not isinstance(prompt, str):
+            # OpenAI chat: the leading (usually system) message is the shared
+            # prefix — exactly what prefix-cache affinity exists for
+            msgs = payload.get("messages")
+            if isinstance(msgs, list) and msgs and isinstance(msgs[0], dict):
+                content = msgs[0].get("content")
+                if isinstance(content, list):  # multi-part content blocks
+                    content = "".join(
+                        p["text"] for p in content
+                        if isinstance(p, dict) and isinstance(p.get("text"), str))
+                prompt = content if isinstance(content, str) else None
         if not isinstance(prompt, str) or not prompt:
             return None
         import hashlib
@@ -370,3 +417,59 @@ class Router:
     def explain(self, name: str, payload: dict, namespace: str = "default") -> dict:
         port = self._entry_port(name, namespace)
         return self._post(port, f"/v1/models/{name}:explain", payload)
+
+    # ------------------------------------------------- OpenAI-compat surface
+    # The model server speaks /openai/v1/* (server.py); these entries make it
+    # reachable the way upstream users reach it — through the ingress, by
+    # InferenceService name, with canary/activator/engine-aware routing
+    # applying.  stream=True returns a generator of parsed SSE events
+    # (excluding the [DONE] sentinel) that yields as chunks arrive — the
+    # proxy relays event-stream responses unbuffered.
+
+    def openai_completions(self, name: str, payload: dict,
+                           namespace: str = "default"):
+        return self._openai(name, "completions", payload, namespace)
+
+    def openai_chat(self, name: str, payload: dict, namespace: str = "default"):
+        return self._openai(name, "chat/completions", payload, namespace)
+
+    def openai_models(self, name: str, namespace: str = "default") -> dict:
+        port = self._entry_port(name, namespace)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/openai/v1/models", timeout=60) as r:
+            return json.loads(r.read())
+
+    def _openai(self, name: str, path: str, payload: dict, namespace: str):
+        port = self._entry_port(name, namespace)
+        if not payload.get("stream"):
+            return self._post(port, f"/openai/v1/{path}", payload, timeout=120.0)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/openai/v1/{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+
+        def events():
+            with urllib.request.urlopen(req, timeout=120) as r:
+                buf = b""
+                while True:
+                    chunk = r.read1(65536)
+                    if not chunk:
+                        # the SSE stream is close-delimited: EOF before the
+                        # [DONE] sentinel means the backend died mid-
+                        # generation — surface it, a truncated stream must
+                        # not look like a clean completion
+                        raise ConnectionError(
+                            f"SSE stream from {name} ended without [DONE]")
+                    buf += chunk
+                    while b"\n\n" in buf:
+                        event, buf = buf.split(b"\n\n", 1)
+                        for line in event.splitlines():
+                            if not line.startswith(b"data:"):
+                                continue
+                            data = line[5:].strip()
+                            if data == b"[DONE]":
+                                return
+                            yield json.loads(data)
+
+        return events()

@@ -722,3 +722,69 @@ def test_openai_finish_reason_defaults_to_stop_for_plain_generators():
         assert done["choices"][0]["finish_reason"] == "stop"
     finally:
         server.stop()
+
+
+def test_openai_through_ingress_unary_and_streaming(tmp_path):
+    """The OpenAI surface must be reachable the way upstream users reach it
+    — through the ingress by InferenceService name (canary/activator/
+    engine-aware routing apply), with SSE streaming relayed unbuffered by
+    the proxy rather than held until generation finishes."""
+    c = Cluster(cpu_nodes=1, tpu_slices=(("s0", "v5e", "2x2"),),
+                base_env={"PYTHONPATH": os.getcwd(), "JAX_PLATFORMS": "cpu"})
+    router, proxy = install(c.api, c.manager)
+    try:
+        d = tmp_path / "llm"
+        d.mkdir()
+        (d / "config.json").write_text(json.dumps(
+            {"vocab_size": 64, "d_model": 32, "n_layers": 1, "n_heads": 2,
+             "n_kv_heads": 1, "d_ff": 64}))
+        (d / "engine.json").write_text(json.dumps(
+            {"max_slots": 2, "num_pages": 32, "page_size": 8}))
+        c.apply(inference_service("llm", model_format="llama",
+                                  storage_uri=f"file://{d}"))
+        _wait_ready(c, "llm", timeout=120)
+
+        models = router.openai_models("llm")
+        assert [m["id"] for m in models["data"]] == ["llm"]
+
+        out = router.openai_completions("llm", {"prompt": "ab", "max_tokens": 3})
+        assert out["object"] == "text_completion"
+        assert out["usage"]["completion_tokens"] == 3
+
+        # streamed chat THROUGH the proxy: one delta event per token plus
+        # the role-carrying first chunk and the finish event
+        events = list(router.openai_chat("llm", {
+            "model": "llm", "max_tokens": 3, "stream": True,
+            "messages": [{"role": "user", "content": "hi"}]}))
+        assert len(events) >= 3
+        assert events[0]["choices"][0]["delta"].get("role") == "assistant"
+        assert events[-1]["choices"][0]["finish_reason"] == "length"
+        assert all(e["object"] == "chat.completion.chunk" for e in events)
+    finally:
+        proxy.shutdown()
+        c.shutdown()
+
+
+def test_prefix_affinity_covers_openai_payloads():
+    """Shared system prompts are the prefix-cache affinity case: the proxy
+    must extract the prefix from OpenAI completions and chat payloads, not
+    just the V1-generate text_input field."""
+    from kubeflow_tpu.serving.router import ServiceProxy
+
+    ports = [9001, 9002, 9003]
+    pick = ServiceProxy._affinity_port
+
+    base = pick(ports, json.dumps({"text_input": "you are a helpful bot"}).encode())
+    assert base in ports
+    # same prefix text through every payload shape -> same replica
+    assert pick(ports, json.dumps(
+        {"prompt": "you are a helpful bot"}).encode()) == base
+    assert pick(ports, json.dumps(
+        {"messages": [{"role": "system", "content": "you are a helpful bot"},
+                      {"role": "user", "content": "hi"}]}).encode()) == base
+    assert pick(ports, json.dumps(
+        {"messages": [{"role": "system", "content": [
+            {"type": "text", "text": "you are a helpful bot"}]}]}).encode()) == base
+    # no extractable prefix -> no affinity (falls back to load/round-robin)
+    assert pick(ports, json.dumps({"messages": []}).encode()) is None
+    assert pick(ports, json.dumps({"max_tokens": 4}).encode()) is None
