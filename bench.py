@@ -263,6 +263,47 @@ def _tpu_preflight(timeout_s: float = 120.0) -> int:
         return 0
 
 
+def _chip_cache_best_mfu() -> dict | None:
+    """The round's best on-chip measurement by MFU (any seq/config) — the
+    north-star gate is an MFU number, and the seq-512 queue candidates can
+    beat the seq-128 headline's MFU while losing on samples/s (each sample
+    is ~4.3x the FLOPs).  Reported as a labeled sidebar, never as the
+    headline (vs_baseline comparability is defined at the r1 workload)."""
+    best = None
+    for rec in _chip_cache_records():
+        if best is None or rec.get("mfu", 0) > best.get("mfu", 0):
+            best = rec
+    return best
+
+
+def _chip_cache_records():
+    """Fresh on-chip records from BENCH_CHIP_CACHE.jsonl (shared filter:
+    TPU platform + within BENCH_CACHE_MAX_AGE_H)."""
+    path = os.path.join(REPO, "BENCH_CHIP_CACHE.jsonl")
+    max_age_s = float(os.environ.get("BENCH_CACHE_MAX_AGE_H", "20")) * 3600
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("platform") != "tpu":
+            continue
+        try:
+            import calendar
+            age = time.time() - calendar.timegm(time.strptime(
+                rec.get("measured_at", ""), "%Y-%m-%dT%H:%M:%SZ"))
+        except ValueError:
+            continue  # unparseable timestamp = unknown age = reject
+        if age > max_age_s:
+            continue
+        yield rec
+
+
 def _chip_cache_best() -> dict | None:
     """Best on-chip measurement recorded by mfu_sweep this round
     (BENCH_CHIP_CACHE.jsonl) — the honest fallback when the tunnel is down
@@ -270,31 +311,15 @@ def _chip_cache_best() -> dict | None:
     BENCH_CACHE_MAX_AGE_H (default 20h, under one round's wall clock) are
     ignored so a stale line from a previous round's code state can never
     masquerade as the current round's number."""
-    path = os.path.join(REPO, "BENCH_CHIP_CACHE.jsonl")
-    max_age_s = float(os.environ.get("BENCH_CACHE_MAX_AGE_H", "20")) * 3600
     best = None
-    try:
-        with open(path) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if rec.get("platform") != "tpu":
-                    continue
-                try:
-                    import calendar
-                    age = time.time() - calendar.timegm(time.strptime(
-                        rec.get("measured_at", ""), "%Y-%m-%dT%H:%M:%SZ"))
-                except ValueError:
-                    continue  # unparseable timestamp = unknown age = reject
-                if age > max_age_s:
-                    continue
-                if (best is None or rec["samples_per_sec_per_chip"]
-                        > best["samples_per_sec_per_chip"]):
-                    best = rec
-    except OSError:
-        return None
+    for rec in _chip_cache_records():
+        # only the r1 workload shape competes for the headline: a seq-512
+        # record's samples/s is not comparable to the r1 baseline
+        if rec.get("seq", 128) != 128:
+            continue
+        if (best is None or rec["samples_per_sec_per_chip"]
+                > best["samples_per_sec_per_chip"]):
+            best = rec
     return best
 
 
@@ -463,6 +488,21 @@ def main() -> None:
     if cached:
         out["cached_measurement"] = True
         out["measured_at"] = best.get("measured_at", "")
+    try:
+        # north-star sidebar: the round's best on-chip MFU across ALL
+        # measured configs (seq-512 candidates can beat the r1-workload
+        # headline on MFU while losing on samples/s)
+        mfu_best = _chip_cache_best_mfu()
+        if mfu_best is not None and mfu_best.get("mfu", 0) > out["mfu"]:
+            out["best_mfu"] = {
+                "mfu": mfu_best["mfu"],
+                "batch_size": mfu_best["batch"], "seq_len": mfu_best["seq"],
+                "remat_policy": mfu_best["policy"], "attention": mfu_best["attn"],
+                "samples_per_sec_per_chip": mfu_best["samples_per_sec_per_chip"],
+                "measured_at": mfu_best.get("measured_at", ""),
+            }
+    except Exception as e:
+        out["best_mfu"] = {"error": str(e)[:200]}
     try:
         out["chip_queue"] = _chip_queue_summary()
     except Exception as e:  # the summary must never sink the bench line

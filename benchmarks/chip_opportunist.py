@@ -98,7 +98,18 @@ JOBS = [
      "cmd": _serving_cmd("1b", ["--kv-quant", "int8", "--requests", "64",
                                 "--concurrency", "8"]),
      "timeout": 1500, "first_timeout": 900},
-    # 7. cost-model attribution of the best dense config (remat tax +
+    # 7a-b. seq-512 (BERT phase-2 shape, same 65k tokens/step as 512@128):
+    #    the attention-FLOPs fraction quadruples, which is where flash's
+    #    skip-the-S² materialization actually pays — the most plausible
+    #    route to the 0.55 gate if flash@seq128 lands short; dense
+    #    comparator second for attribution
+    {"name": "mfu_flash_seq512",
+     "cmd": SWEEP + ["128", "512", "1", "save_mlp", "flash", "8"],
+     "timeout": 540, "first_timeout": 240},
+    {"name": "mfu_dense_seq512",
+     "cmd": SWEEP + ["128", "512", "1", "save_mlp", "dense", "8"],
+     "timeout": 540, "first_timeout": 240},
+    # 8. cost-model attribution of the best dense config (remat tax +
     #    bytes/step); MFU_COST re-lowers, so a generous timeout
     {"name": "mfu_cost_save_attn_512",
      "cmd": SWEEP + ["512", "128", "1", "save_attn", "dense", "4"],

@@ -118,7 +118,12 @@ class ServiceProxy:
                 fwd_headers.setdefault("Content-Type", "application/json")
                 req = urllib.request.Request(url, data=body, method=self.command, headers=fwd_headers)
                 try:
-                    with urllib.request.urlopen(req, timeout=60) as r:
+                    # relay timeout = per-read backend silence, NOT total
+                    # request time; it must exceed any client-side budget
+                    # (Router sets 120s for LLM generation) or the ingress
+                    # 502s slow-but-alive generations its clients were
+                    # still willing to wait for
+                    with urllib.request.urlopen(req, timeout=300) as r:
                         ctype = r.headers.get("Content-Type") or ""
                         if ctype.startswith("text/event-stream"):
                             # SSE passthrough: relay chunks as they arrive
