@@ -61,7 +61,7 @@ def _stage_decode_composed():
     cfg = _tiny_config()
     params = M.init_int8(jax.random.PRNGKey(0), cfg)
     page_size = 16
-    shape = (cfg.n_layers, 16, page_size, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, 16, cfg.n_kv_heads, page_size, cfg.head_dim)
     toks16 = jnp.asarray([[5, 7, 9, 11, 2, 4, 6, 8,
                            13, 3, 1, 12, 10, 14, 15, 16]], jnp.int32)
     pools = []

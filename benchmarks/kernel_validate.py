@@ -118,8 +118,8 @@ def _stage_paged():
     rng = np.random.default_rng(0)
     B, Hq, Hkv, hd, ps, NP, max_pages = 3, 4, 2, 128, 16, 12, 3
     q = jnp.asarray(rng.standard_normal((B, Hq, hd)), jnp.float32)
-    k_pool = jnp.asarray(rng.standard_normal((NP, ps, Hkv, hd)), jnp.float32)
-    v_pool = jnp.asarray(rng.standard_normal((NP, ps, Hkv, hd)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((NP, Hkv, ps, hd)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((NP, Hkv, ps, hd)), jnp.float32)
     page_table = jnp.asarray([[3, 5, 7], [1, 2, 0], [0, 0, 0]], jnp.int32)
     seq_lens = jnp.asarray([20, 9, 0], jnp.int32)
     out = np.asarray(paged_decode_attention(q, k_pool, v_pool, page_table,
@@ -128,8 +128,8 @@ def _stage_paged():
     T = max_pages * ps
     worst = 0.0
     for b in range(B):
-        kc = np.asarray(k_pool)[np.asarray(page_table)[b]].reshape(T, Hkv, hd)
-        vc = np.asarray(v_pool)[np.asarray(page_table)[b]].reshape(T, Hkv, hd)
+        kc = np.asarray(k_pool)[np.asarray(page_table)[b]].transpose(0, 2, 1, 3).reshape(T, Hkv, hd)
+        vc = np.asarray(v_pool)[np.asarray(page_table)[b]].transpose(0, 2, 1, 3).reshape(T, Hkv, hd)
         for h in range(Hq):
             kv_h = h // group
             logits = np.asarray(q)[b, h] @ kc[:, kv_h].T / np.sqrt(hd)

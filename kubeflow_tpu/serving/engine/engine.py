@@ -156,8 +156,8 @@ class Engine:
             engine_config.page_size, engine_config.max_pages_per_slot,
         )
         c = config
-        shape = (c.n_layers, engine_config.num_pages, engine_config.page_size,
-                 c.n_kv_heads, c.head_dim)
+        shape = (c.n_layers, engine_config.num_pages, c.n_kv_heads,
+                 engine_config.page_size, c.head_dim)
         self._paged = (engine_config.paged_kernel if engine_config.paged_kernel is not None
                        else _paged_kernel_default())
         self._kv_quant = (engine_config.kv_quant if engine_config.kv_quant is not None

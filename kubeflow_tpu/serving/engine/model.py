@@ -4,7 +4,9 @@ TPU-first design notes (not a port of any CUDA server):
   * all shapes static under jit — prompt lengths bucketed, decode batch is
     always the full slot set with a mask (inactive slots compute garbage that
     is never read; far cheaper than recompiles);
-  * KV lives in a page pool ``[layers, num_pages, page_size, kv_heads, hd]``;
+  * KV lives in a page pool ``[layers, num_pages, kv_heads, page_size, hd]``
+    (head BEFORE token-in-page: the paged Pallas kernel reads one head's
+    page as a contiguous Mosaic-legal ``[page_size, hd]`` tile);
     the page table gathers per-slot pages — the JAX analogue of paged
     attention, with the page bookkeeping in the C++ core (native.py);
   * weights bf16 (MXU native), attention math f32 accumulations via
@@ -292,9 +294,13 @@ def _kv_proj(params, l, config, h, positions):
 
 # ---------------------------------------------------------------- KV pools
 #
-# A pool is either a bare bf16 array [L, P, page_size, Hkv, hd] or, with
+# A pool is either a bare bf16 array [L, P, Hkv, page_size, hd] or, with
 # int8 KV-cache quantization, a pytree {"q": int8 same-shape, "s": bf16
-# per-(token,head) scales [L, P, page_size, Hkv, 1]}.  int8+scale costs
+# per-(head,token) scales [L, P, Hkv, page_size, 1]}.  The kv-head axis
+# sits BEFORE the token-in-page axis so the paged kernel's per-(page,head)
+# block is the trailing [page_size, hd] — divisible-by-(8,128) Mosaic
+# tiles; head-last layouts put a singleton between sublanes and lanes,
+# which Mosaic rejects (caught by the AOT legality tests).  int8+scale costs
 # (hd+2)/(2*hd) of the bf16 bytes (~52% at hd=64) — nearly double the
 # servable context per chip, the KV-capacity lever TPU LLM servers lean on.
 # jit treats the dict as a pytree, so every entry point below works on both
@@ -312,7 +318,7 @@ def make_kv_pool(shape, quant: Optional[str] = None):
 
 
 def pool_page_size(pool) -> int:
-    return (pool["q"] if isinstance(pool, dict) else pool).shape[2]
+    return (pool["q"] if isinstance(pool, dict) else pool).shape[3]
 
 
 def _quantize_kv(x):
@@ -358,7 +364,7 @@ def prefill(params, config: DecoderConfig, tokens, length, page_size: int):
 
     tokens: [1, S] int32 (padded); length: [] int32 actual prompt length.
     Returns (logits_last [1, vocab], paged_k, paged_v) where paged_k/v are
-    [layers, S/page_size, page_size, Hkv, hd] — ready to scatter into the
+    [layers, S/page_size, Hkv, page_size, hd] — ready to scatter into the
     global page pool at the slot's page ids.
     """
     c = config
@@ -380,8 +386,12 @@ def prefill(params, config: DecoderConfig, tokens, length, page_size: int):
     last = x[jnp.arange(B), length - 1]
     logits = (last @ _w(params["unembed"])).astype(jnp.float32)
     n_pages = S // page_size
-    paged_k = jnp.stack(ks).reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)[:, 0]
-    paged_v = jnp.stack(vs).reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)[:, 0]
+    paged_k = (jnp.stack(ks)
+               .reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)[:, 0]
+               .transpose(0, 1, 3, 2, 4))  # -> [L, n_pages, Hkv, ps, hd]
+    paged_v = (jnp.stack(vs)
+               .reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)[:, 0]
+               .transpose(0, 1, 3, 2, 4))
     return logits, paged_k, paged_v
 
 
@@ -389,7 +399,7 @@ def prefill(params, config: DecoderConfig, tokens, length, page_size: int):
 def write_pages(k_pool, v_pool, paged_k, paged_v, page_ids):
     """Scatter a prompt's paged KV into the global pools at page_ids.
 
-    k_pool/v_pool: [layers, num_pages, page_size, Hkv, hd] (donated).
+    k_pool/v_pool: [layers, num_pages, Hkv, page_size, hd] (donated).
     page_ids: [n_pages] int32.
     """
     idx = (slice(None), page_ids)
@@ -430,11 +440,16 @@ def prefill_chunk(params, config: DecoderConfig, tokens, start, length,
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
         k, v = _kv_proj(params, l, c, h, positions)
         k_pool = pool_set(k_pool, (l, chunk_page_ids),
-                          k.reshape(C // page_size, page_size, c.n_kv_heads, c.head_dim))
+                          k.reshape(C // page_size, page_size, c.n_kv_heads, c.head_dim)
+                           .transpose(0, 2, 1, 3))  # [n, Hkv, ps, hd]
         v_pool = pool_set(v_pool, (l, chunk_page_ids),
-                          v.reshape(C // page_size, page_size, c.n_kv_heads, c.head_dim))
-        k_cache = pool_get(k_pool, (l, hist_page_ids)).reshape(1, T, c.n_kv_heads, c.head_dim)
-        v_cache = pool_get(v_pool, (l, hist_page_ids)).reshape(1, T, c.n_kv_heads, c.head_dim)
+                          v.reshape(C // page_size, page_size, c.n_kv_heads, c.head_dim)
+                           .transpose(0, 2, 1, 3))
+        # gather [H, Hkv, ps, hd] -> [1, T, Hkv, hd] (token-major cache)
+        k_cache = (pool_get(k_pool, (l, hist_page_ids))
+                   .transpose(0, 2, 1, 3).reshape(1, T, c.n_kv_heads, c.head_dim))
+        v_cache = (pool_get(v_pool, (l, hist_page_ids))
+                   .transpose(0, 2, 1, 3).reshape(1, T, c.n_kv_heads, c.head_dim))
         x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     last = jnp.clip(length - 1 - start, 0, C - 1)
@@ -466,7 +481,7 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
 
     tokens: [B] int32 current token per slot; seq_lens: [B] int32 length
     INCLUDING the current token; page_table: [B, max_pages] int32;
-    k_pool/v_pool: [L, P, page_size, Hkv, hd] (donated, updated in place).
+    k_pool/v_pool: [L, P, Hkv, page_size, hd] (donated, updated in place).
     Returns (logits [B, vocab], k_pool, v_pool).
 
     The current token's KV is written into its page slot BEFORE attention, so
@@ -499,18 +514,22 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
         k_new, v_new = _kv_proj(params, l, c, h, positions)  # [B,1,Hkv,hd]
-        # scatter this step's kv into the pool: one (page, offset) per slot
-        k_pool = pool_set(k_pool, (l, page_id, offset), k_new[:, 0])
-        v_pool = pool_set(v_pool, (l, page_id, offset), v_new[:, 0])
+        # scatter this step's kv into the pool: one (page, head, offset) per
+        # slot — the basic slice between the advanced indices puts the
+        # broadcast [B] axis first, matching k_new[:, 0]'s [B, Hkv, hd]
+        k_pool = pool_set(k_pool, (l, page_id, slice(None), offset), k_new[:, 0])
+        v_pool = pool_set(v_pool, (l, page_id, slice(None), offset), v_new[:, 0])
         if paged:
             kl, vl = pool_layer(k_pool, l), pool_layer(v_pool, l)
             attend = lambda q: paged_attention(  # noqa: E731
                 q, kl, vl, page_table, seq_lens, page_size, mesh=mesh)
             x = _block_with(params, l, c, x, positions, attend)
         else:
-            # gather each slot's pages -> [B, T, Hkv, hd]
-            k_cache = pool_get(k_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
-            v_cache = pool_get(v_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
+            # gather each slot's pages [B, MP, Hkv, ps, hd] -> [B, T, Hkv, hd]
+            k_cache = (pool_get(k_pool, (l, page_table))
+                       .transpose(0, 1, 3, 2, 4).reshape(B, T, c.n_kv_heads, c.head_dim))
+            v_cache = (pool_get(v_pool, (l, page_table))
+                       .transpose(0, 1, 3, 2, 4).reshape(B, T, c.n_kv_heads, c.head_dim))
             x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     logits = (x[:, 0] @ _w(params["unembed"])).astype(jnp.float32)
@@ -573,16 +592,20 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
         k_new, v_new = _kv_proj(params, l, c, h, positions)  # [B,K,Hkv,hd]
-        k_pool = pool_set(k_pool, (l, page_ids, offsets), k_new)
-        v_pool = pool_set(v_pool, (l, page_ids, offsets), v_new)
+        # advanced [B,K] ids/offsets around the head slice: broadcast [B,K]
+        # axes lead, giving [B, K, Hkv, hd] — matching k_new
+        k_pool = pool_set(k_pool, (l, page_ids, slice(None), offsets), k_new)
+        v_pool = pool_set(v_pool, (l, page_ids, slice(None), offsets), v_new)
         if paged:
             kl, vl = pool_layer(k_pool, l), pool_layer(v_pool, l)
             attend = lambda q: paged_attention(  # noqa: E731
                 q, kl, vl, page_table, seq_lens, page_size, mesh=mesh)
             x = _block_with(params, l, c, x, positions, attend)
         else:
-            k_cache = pool_get(k_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
-            v_cache = pool_get(v_pool, (l, page_table)).reshape(B, T, c.n_kv_heads, c.head_dim)
+            k_cache = (pool_get(k_pool, (l, page_table))
+                       .transpose(0, 1, 3, 2, 4).reshape(B, T, c.n_kv_heads, c.head_dim))
+            v_cache = (pool_get(v_pool, (l, page_table))
+                       .transpose(0, 1, 3, 2, 4).reshape(B, T, c.n_kv_heads, c.head_dim))
             x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     logits = (x @ _w(params["unembed"])).astype(jnp.float32)

@@ -63,6 +63,11 @@ def _kernel(page_table_ref, seq_lens_ref,  # scalar-prefetch (SMEM)
     j = pl.program_id(2)
     num_pages = pl.num_programs(2)
     seq_len = seq_lens_ref[b]
+    # kv blocks are [1, 1, ps, hd] — one (page, head)'s contiguous tile; the
+    # pool layout keeps the head axis BEFORE the token-in-page axis exactly
+    # so this block's trailing dims are (ps, hd): divisible-by-(8,128)
+    # Mosaic tiles (head-last made the trailing dims (1, hd), which Mosaic
+    # rejects unless the block spans every head)
 
     @pl.when(j == 0)
     def _reset():
@@ -76,11 +81,11 @@ def _kernel(page_table_ref, seq_lens_ref,  # scalar-prefetch (SMEM)
     @pl.when(j * page_size < seq_len + (num_q - 1))
     def _page():
         q = q_ref[0, 0].astype(jnp.float32) * scale          # [K*group, hd]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)            # [ps, hd]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)                  # [ps, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
         if quantized:
-            k = k * ks_ref[0, :, 0, :].astype(jnp.float32)   # [ps, 1] bcast
-            v = v * vs_ref[0, :, 0, :].astype(jnp.float32)
+            k = k * ks_ref[0, 0].astype(jnp.float32)         # [ps, 1] bcast
+            v = v * vs_ref[0, 0].astype(jnp.float32)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )                                                    # [K*group, ps]
@@ -105,11 +110,11 @@ def _kernel(page_table_ref, seq_lens_ref,  # scalar-prefetch (SMEM)
 def _call_kernel(q, k_pool, v_pool, page_table, seq_lens,
                  page_size: int, interpret: bool):
     """Single-device kernel invocation.  q: [B, K, Hq, hd]; pools: one
-    layer's pool, bf16 [P, ps, Hkv, hd] or {"q": int8, "s": bf16 scales};
+    layer's pool, bf16 [P, Hkv, ps, hd] or {"q": int8, "s": bf16 scales};
     returns [B, K, Hq, hd]."""
     B, K, Hq, hd = q.shape
     quantized = isinstance(k_pool, dict)
-    Hkv = (k_pool["q"] if quantized else k_pool).shape[2]
+    Hkv = (k_pool["q"] if quantized else k_pool).shape[1]
     group = Hq // Hkv
     max_pages = page_table.shape[1]
     scale = hd ** -0.5
@@ -121,14 +126,15 @@ def _call_kernel(q, k_pool, v_pool, page_table, seq_lens,
 
     grid = (B, Hkv, max_pages)
     rows = K * group
+    # (page, head) block = trailing [ps, hd] — Mosaic-legal (8,128) tiles
     kv_specs = [
-        pl.BlockSpec((1, page_size, 1, hd), lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0)),
-        pl.BlockSpec((1, page_size, 1, hd), lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0)),
+        pl.BlockSpec((1, 1, page_size, hd), lambda b, h, j, pt, sl: (pt[b, j], h, 0, 0)),
+        pl.BlockSpec((1, 1, page_size, hd), lambda b, h, j, pt, sl: (pt[b, j], h, 0, 0)),
     ]
     inputs = [qg]
     if quantized:
-        scale_spec = pl.BlockSpec((1, page_size, 1, 1),
-                                  lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0))
+        scale_spec = pl.BlockSpec((1, 1, page_size, 1),
+                                  lambda b, h, j, pt, sl: (pt[b, j], h, 0, 0))
         in_specs = ([pl.BlockSpec((1, 1, rows, hd), lambda b, h, j, pt, sl: (b, h, 0, 0))]
                     + kv_specs + [scale_spec, scale_spec])
         inputs += [k_pool["q"], v_pool["q"], k_pool["s"], v_pool["s"]]
@@ -160,8 +166,8 @@ def _call_kernel(q, k_pool, v_pool, page_table, seq_lens,
 
 # head-axis specs for the shard_map TP wrapper: attention is independent per
 # KV head, so q/pools/out shard on their head axes and nothing communicates
-_Q_SPEC = P(None, None, "tensor", None)
-_POOL_SPEC = P(None, None, "tensor", None)
+_Q_SPEC = P(None, None, "tensor", None)      # q: [B, K, Hq, hd]
+_POOL_SPEC = P(None, "tensor", None, None)   # pool: [P, Hkv, ps, hd]
 
 
 def paged_attention(q, k_pool, v_pool, page_table, seq_lens, page_size: int,
@@ -173,10 +179,10 @@ def paged_attention(q, k_pool, v_pool, page_table, seq_lens, page_size: int,
     verify); K=1 is the plain decode step.  seq_lens: [B] int32 counting
     committed tokens INCLUDING query 0's position (query row r sees
     positions < seq_lens + r).  k_pool/v_pool: ONE layer's pool —
-    [P, page_size, Hkv, hd] bf16 or the int8 {"q", "s"} pytree (model.py).
+    [P, Hkv, page_size, hd] bf16 or the int8 {"q", "s"} pytree (model.py).
     page_table: [B, max_pages] int32.  ``mesh``: a 1-D ``tensor`` mesh runs
     the kernel per-shard via shard_map (heads independent, no collectives).
-    Returns [B, K, Hq, hd].
+    Returns [B, K, Hq, hd].  Pools are [P, Hkv, page_size, hd] (ONE layer).
     """
     if interpret is None:
         interpret = _auto_interpret()
@@ -201,7 +207,7 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, seq_lens,
     """One decode step of attention over the page pool (K=1 wrapper).
 
     q: [B, Hq, hd] (current token per slot); k_pool/v_pool:
-    [P, page_size, Hkv, hd] (ONE layer's pool); page_table: [B, max_pages]
+    [P, Hkv, page_size, hd] (ONE layer's pool); page_table: [B, max_pages]
     int32; seq_lens: [B] int32 (0 = inactive slot → zeros out).
     Returns [B, Hq, hd].
     """

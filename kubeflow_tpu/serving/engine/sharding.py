@@ -46,8 +46,8 @@ PARAM_SPECS = {
     "unembed": P(),
 }
 
-# pool: [L, P, page_size, Hkv, hd] — KV heads on tensor
-POOL_SPEC = P(None, None, None, "tensor", None)
+# pool: [L, P, Hkv, page_size, hd] — KV heads on tensor
+POOL_SPEC = P(None, None, "tensor", None, None)
 
 
 def tensor_mesh(n: int) -> Mesh:

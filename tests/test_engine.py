@@ -183,7 +183,7 @@ def test_native_batcher_gang_admission_waits_for_pages():
 
 def test_paged_decode_matches_full_forward(params):
     page_size = 8
-    k_pool = jnp.zeros((CFG.n_layers, 16, page_size, CFG.n_kv_heads, CFG.head_dim), jnp.bfloat16)
+    k_pool = jnp.zeros((CFG.n_layers, 16, CFG.n_kv_heads, page_size, CFG.head_dim), jnp.bfloat16)
     v_pool = jnp.zeros_like(k_pool)
     toks = np.array([[5, 7, 9, 11, 2, 4, 6, 8, 10, 3, 1, 12]], np.int32)
     full = np.asarray(M.forward_full(params, CFG, jnp.asarray(toks)))
@@ -308,20 +308,21 @@ def test_paged_attention_kernel_matches_reference():
     rng = np.random.default_rng(0)
     B, Hq, Hkv, hd, ps, P, max_pages = 3, 4, 2, 16, 8, 12, 3
     q = jnp.asarray(rng.standard_normal((B, Hq, hd)), jnp.float32)
-    k_pool = jnp.asarray(rng.standard_normal((P, ps, Hkv, hd)), jnp.float32)
-    v_pool = jnp.asarray(rng.standard_normal((P, ps, Hkv, hd)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((P, Hkv, ps, hd)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((P, Hkv, ps, hd)), jnp.float32)
     page_table = jnp.asarray([[3, 5, 7], [1, 2, 0], [0, 0, 0]], jnp.int32)
     seq_lens = jnp.asarray([20, 9, 0], jnp.int32)  # partial pages; slot 2 idle
 
     out = np.asarray(paged_decode_attention(q, k_pool, v_pool, page_table,
                                             seq_lens, ps, interpret=True))
 
-    # reference: gather + dense masked softmax per slot
+    # reference: gather + dense masked softmax per slot ([P,Hkv,ps,hd] pool
+    # gathers to [MP,Hkv,ps,hd]; token-major cache needs the transpose)
     group = Hq // Hkv
     T = max_pages * ps
     for b in range(B):
-        kc = np.asarray(k_pool)[np.asarray(page_table)[b]].reshape(T, Hkv, hd)
-        vc = np.asarray(v_pool)[np.asarray(page_table)[b]].reshape(T, Hkv, hd)
+        kc = np.asarray(k_pool)[np.asarray(page_table)[b]].transpose(0, 2, 1, 3).reshape(T, Hkv, hd)
+        vc = np.asarray(v_pool)[np.asarray(page_table)[b]].transpose(0, 2, 1, 3).reshape(T, Hkv, hd)
         for h in range(Hq):
             kv_h = h // group
             logits = np.asarray(q)[b, h] @ kc[:, kv_h].T / np.sqrt(hd)
@@ -338,7 +339,7 @@ def test_decode_step_paged_matches_gather(params):
     """decode_step(paged=True) produces the same logits as the XLA gather
     path on identical pool state."""
     page_size = 8
-    shape = (CFG.n_layers, 16, page_size, CFG.n_kv_heads, CFG.head_dim)
+    shape = (CFG.n_layers, 16, CFG.n_kv_heads, page_size, CFG.head_dim)
     rng = np.random.default_rng(1)
     k0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
     v0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
@@ -386,7 +387,7 @@ def test_tensor_parallel_engine_matches_oracle(params):
     assert isinstance(w1.sharding, NamedSharding)
     assert w1.sharding.shard_shape(w1.shape)[2] == CFG.d_ff // 2
     kp = eng.k_pool
-    assert kp.sharding.shard_shape(kp.shape)[3] == CFG.n_kv_heads // 2
+    assert kp.sharding.shard_shape(kp.shape)[2] == CFG.n_kv_heads // 2
 
     eng.start()
     try:
@@ -571,8 +572,8 @@ def test_int8_kv_pool_decode_logits_close_to_bf16(params):
     plen = 8
     logits_ref = None
     for quant in (None, "int8"):
-        k_pool = M.make_kv_pool((CFG.n_layers, 16, page_size, CFG.n_kv_heads, CFG.head_dim), quant)
-        v_pool = M.make_kv_pool((CFG.n_layers, 16, page_size, CFG.n_kv_heads, CFG.head_dim), quant)
+        k_pool = M.make_kv_pool((CFG.n_layers, 16, CFG.n_kv_heads, page_size, CFG.head_dim), quant)
+        v_pool = M.make_kv_pool((CFG.n_layers, 16, CFG.n_kv_heads, page_size, CFG.head_dim), quant)
         _, pk, pv = M.prefill(params, CFG, jnp.asarray(toks[:, :plen]), jnp.int32(plen), page_size)
         k_pool, v_pool = M.write_pages(k_pool, v_pool, pk, pv, jnp.asarray([3, 5], jnp.int32))
         pt = np.zeros((2, 4), np.int32)
@@ -628,8 +629,8 @@ def test_paged_kernel_multi_query_matches_reference():
     rng = np.random.default_rng(2)
     B, K, Hq, Hkv, hd, ps, P, max_pages = 2, 3, 4, 2, 16, 8, 12, 3
     q = jnp.asarray(rng.standard_normal((B, K, Hq, hd)), jnp.float32)
-    k_pool = jnp.asarray(rng.standard_normal((P, ps, Hkv, hd)), jnp.float32)
-    v_pool = jnp.asarray(rng.standard_normal((P, ps, Hkv, hd)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((P, Hkv, ps, hd)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((P, Hkv, ps, hd)), jnp.float32)
     page_table = jnp.asarray([[3, 5, 7], [1, 2, 0]], jnp.int32)
     seq_lens = jnp.asarray([18, 6], jnp.int32)  # draft rows extend past these
 
@@ -638,8 +639,8 @@ def test_paged_kernel_multi_query_matches_reference():
     group = Hq // Hkv
     T = max_pages * ps
     for b in range(B):
-        kc = np.asarray(k_pool)[np.asarray(page_table)[b]].reshape(T, Hkv, hd)
-        vc = np.asarray(v_pool)[np.asarray(page_table)[b]].reshape(T, Hkv, hd)
+        kc = np.asarray(k_pool)[np.asarray(page_table)[b]].transpose(0, 2, 1, 3).reshape(T, Hkv, hd)
+        vc = np.asarray(v_pool)[np.asarray(page_table)[b]].transpose(0, 2, 1, 3).reshape(T, Hkv, hd)
         for j in range(K):
             horizon = int(seq_lens[b]) + j  # row j sees positions < len+j
             m = np.arange(T) < horizon
@@ -659,10 +660,10 @@ def test_paged_kernel_int8_pool_matches_dequant_reference():
     rng = np.random.default_rng(3)
     B, Hq, Hkv, hd, ps, P = 2, 4, 2, 16, 8, 10
     q = jnp.asarray(rng.standard_normal((B, Hq, hd)), jnp.float32)
-    kq = jnp.asarray(rng.integers(-127, 128, (P, ps, Hkv, hd)), jnp.int8)
-    vq = jnp.asarray(rng.integers(-127, 128, (P, ps, Hkv, hd)), jnp.int8)
-    ks = jnp.asarray(rng.uniform(0.01, 0.1, (P, ps, Hkv, 1)), jnp.bfloat16)
-    vs = jnp.asarray(rng.uniform(0.01, 0.1, (P, ps, Hkv, 1)), jnp.bfloat16)
+    kq = jnp.asarray(rng.integers(-127, 128, (P, Hkv, ps, hd)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (P, Hkv, ps, hd)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.01, 0.1, (P, Hkv, ps, 1)), jnp.bfloat16)
+    vs = jnp.asarray(rng.uniform(0.01, 0.1, (P, Hkv, ps, 1)), jnp.bfloat16)
     page_table = jnp.asarray([[3, 5], [1, 0]], jnp.int32)
     seq_lens = jnp.asarray([13, 8], jnp.int32)
 
@@ -680,7 +681,7 @@ def test_decode_step_paged_int8_matches_gather_int8(params):
     """decode_step(paged=True) over a quantized pool == the XLA gather path
     over the SAME quantized pool (both dequantize identically)."""
     page_size = 8
-    shape = (CFG.n_layers, 16, page_size, CFG.n_kv_heads, CFG.head_dim)
+    shape = (CFG.n_layers, 16, CFG.n_kv_heads, page_size, CFG.head_dim)
     toks8 = np.array([[5, 7, 9, 11, 2, 4, 6, 8]], np.int32)
     pools = []
     for _ in range(2):  # two identical quantized pools (decode_step donates)
@@ -701,7 +702,7 @@ def test_decode_step_k_paged_matches_gather(params):
     """Speculative verify through the Pallas kernel == the gather path on
     identical pool state (bf16)."""
     page_size = 8
-    shape = (CFG.n_layers, 16, page_size, CFG.n_kv_heads, CFG.head_dim)
+    shape = (CFG.n_layers, 16, CFG.n_kv_heads, page_size, CFG.head_dim)
     rng = np.random.default_rng(4)
     k0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
     v0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)

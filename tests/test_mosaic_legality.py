@@ -1,0 +1,86 @@
+"""AOT Mosaic-legality checks for every Pallas kernel, no TPU required.
+
+``jax.export`` with ``platforms=["tpu"]`` runs the Pallas→Mosaic lowering
+(where block-shape legality is enforced: the last two block dims must be
+divisible by (8, 128) or equal the array dims) on any host.  The r4 chip
+window burned an attempt discovering exactly such an error at runtime —
+the paged kernel's head-last pool layout put a singleton between the
+sublane and lane dims (fixed by the [P, Hkv, ps, hd] layout).  These
+tests make that class of failure a CPU test failure instead of a spent
+tunnel window.
+
+Limits: Mosaic's own backend compilation (register allocation, VMEM
+budgeting) still only happens on a real TPU backend — this catches
+lowering/legality errors, not resource exhaustion.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+
+def _export_tpu(fn, *args):
+    """Lower fn(*args) for the TPU platform; raises on Mosaic illegality."""
+    return jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+# ------------------------------------------------------------------ flash
+
+
+@pytest.mark.parametrize("masked", [False, True])
+@pytest.mark.parametrize("b,s,h,d", [(8, 128, 12, 64),   # BERT bench shape
+                                     (2, 512, 4, 64),    # seq-512 candidate
+                                     (1, 128, 1, 128)])  # hd=128 row
+def test_flash_attention_lowers_for_tpu(masked, b, s, h, d):
+    from kubeflow_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.zeros((b, s, h, d), jnp.bfloat16)
+    mask = jnp.ones((b, s), jnp.float32) if masked else None
+
+    def fn(q, k, v):
+        return flash_attention(q, k, v, interpret=False, kv_mask=mask)
+
+    _export_tpu(fn, q, q, q)
+
+
+def test_flash_attention_backward_lowers_for_tpu():
+    from kubeflow_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.zeros((2, 128, 4, 64), jnp.float32)
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, interpret=False).sum()
+
+    _export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+
+
+# ------------------------------------------------------------------ paged
+
+
+def _paged_args(B, K, Hq, Hkv, hd, ps, NP, MP, quant):
+    rngless = jnp.zeros  # shapes are what matters; values irrelevant
+    q = rngless((B, K, Hq, hd), jnp.float32)
+    if quant:
+        pool = {"q": rngless((NP, Hkv, ps, hd), jnp.int8),
+                "s": rngless((NP, Hkv, ps, 1), jnp.bfloat16)}
+    else:
+        pool = rngless((NP, Hkv, ps, hd), jnp.float32)
+    pt = rngless((B, MP), jnp.int32)
+    sl = rngless((B,), jnp.int32)
+    return q, pool, pt, sl
+
+
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("K", [1, 3])
+@pytest.mark.parametrize("hd,ps", [(128, 16),  # llama3_8b production shape
+                                   (16, 8)])   # CPU-test toy shape
+def test_paged_attention_lowers_for_tpu(quant, K, hd, ps):
+    from kubeflow_tpu.serving.engine.paged_attention import paged_attention
+
+    q, pool, pt, sl = _paged_args(2, K, 4, 2, hd, ps, 10, 3, quant)
+    fn = functools.partial(paged_attention, page_size=ps, interpret=False)
+    _export_tpu(fn, q, pool, pool, pt, sl)
