@@ -84,3 +84,38 @@ def test_paged_attention_lowers_for_tpu(quant, K, hd, ps):
     q, pool, pt, sl = _paged_args(2, K, 4, 2, hd, ps, 10, 3, quant)
     fn = functools.partial(paged_attention, page_size=ps, interpret=False)
     _export_tpu(fn, q, pool, pool, pt, sl)
+
+
+# -------------------------------------------------------------- train step
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy,attn,seq", [
+    ("save_mlp", "flash", 128),   # chip queue's primary flash MFU config
+    ("save_mlp", "flash", 512),   # seq-512 candidate
+])
+def test_bert_train_step_with_flash_lowers_for_tpu(policy, attn, seq):
+    """The full fwd+bwd+optax step the MFU queue jobs run: flash's custom
+    VJP must survive jax.checkpoint's named-save policies under the TPU
+    lowering, not just the bare kernel (a composition failure here would
+    burn a chip-window attempt the kernel-only tests can't prevent)."""
+    from kubeflow_tpu.models import bert
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.train.data import synthetic_mlm_batches
+    from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
+
+    cfg = bert.BertConfig(remat=True, remat_policy=policy, attention=attn)
+    params = bert.init(jax.random.PRNGKey(0), cfg)
+    mesh = build_mesh(MeshConfig(data=1, fsdp=1, tensor=1), jax.devices()[:1])
+    mp = max(20 * seq // 128, 1)
+
+    def loss_fn(p, b):
+        return bert.mlm_loss(p, cfg, b["input_ids"], b["labels"], None,
+                             max_predictions=mp)
+
+    tr = Trainer(loss_fn, params, mesh, bert.SHARDING_RULES,
+                 TrainerConfig(learning_rate=1e-4, warmup_steps=2,
+                               total_steps=8))
+    batch = next(synthetic_mlm_batches(cfg.vocab_size, 8, seq))
+    jax.export.export(tr._step, platforms=["tpu"])(tr.params, tr.opt_state,
+                                                   batch)
