@@ -1,0 +1,226 @@
+"""HuggingFace checkpoint → engine params converter (Llama-family).
+
+Role (SURVEY.md §2a KServe storage-initializer row, §0 benchmark configs):
+upstream users serve `hf://meta-llama/Meta-Llama-3-8B` through KServe's
+huggingfaceserver; a user switching to this framework holds the same
+safetensors checkpoints.  This module maps them onto the JetStream-class
+engine's param dict (model.py: wq/wk/wv/wo, w1/w2/w3, ln_*, embed/unembed)
+so `InferenceService` + `storage_uri` pointing at an HF checkout "just
+serves" — serve.py auto-converts on load when it finds an HF-format
+config.json without engine params.
+
+Scope: Llama-architecture models (llama / llama2 / llama3 / mistral —
+RMSNorm + RoPE + SwiGLU + optional GQA).  The engine's decoder
+(model._block_with) IS this architecture, so conversion is a pure weight
+relayout: HF stores projections as [out, in] torch tensors; the engine
+right-multiplies, so every projection transposes, and per-layer tensors
+stack into one [L, ...] array (jit-friendly: one HBM buffer per name).
+Architectures with different block math (gemma's +1 norms, phi's partial
+rotary) are rejected loudly rather than converted wrong.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+
+import numpy as np
+
+_LLAMA_TYPES = {"llama", "mistral"}
+
+
+def is_hf_config(raw: dict) -> bool:
+    """True if a config.json dict is a transformers config (not ours).
+    HF configs always carry model_type/architectures; ours never do."""
+    return "model_type" in raw or "architectures" in raw
+
+
+def hf_dir_needs_conversion(model_dir: str) -> bool:
+    """An HF checkout (HF config.json, no engine params.npz yet)."""
+    cfg = os.path.join(model_dir, "config.json")
+    if os.path.exists(os.path.join(model_dir, "params.npz")):
+        return False
+    if not os.path.exists(cfg):
+        return False
+    with open(cfg) as f:
+        try:
+            raw = json.load(f)
+        except ValueError:
+            return False
+    return is_hf_config(raw)
+
+
+def _map_config(raw: dict) -> dict:
+    mt = raw.get("model_type", "")
+    if mt not in _LLAMA_TYPES:
+        raise ValueError(
+            f"unsupported model_type {mt!r}: the engine decoder implements "
+            f"the Llama block (RMSNorm+RoPE+SwiGLU); supported: "
+            f"{sorted(_LLAMA_TYPES)}.  Models with different block math "
+            "(gemma, phi, ...) must not be silently mis-converted.")
+    if raw.get("rope_scaling"):
+        # llama-3.1+ long-context scaling changes the RoPE frequencies; the
+        # engine applies plain theta-RoPE, so converting would produce
+        # numerically wrong generations with no error — reject loudly
+        raise ValueError(
+            f"rope_scaling={raw['rope_scaling']!r} is not implemented in "
+            "the engine's RoPE; refusing to convert to silently-wrong "
+            "frequencies (base Llama-3 / Llama-2 / Mistral configs work)")
+    implied_hd = raw["hidden_size"] // raw["num_attention_heads"]
+    explicit_hd = raw.get("head_dim") or implied_hd  # None = derive
+    if explicit_hd != implied_hd:
+        # e.g. Mistral-Nemo: head_dim=128 with hidden 5120 / 32 heads = 160
+        raise ValueError(
+            f"explicit head_dim={explicit_hd} != hidden_size/"
+            f"num_attention_heads={implied_hd}; the engine derives head_dim "
+            "from the quotient, so this checkpoint cannot be mapped")
+    return {
+        "vocab_size": raw["vocab_size"],
+        "d_model": raw["hidden_size"],
+        "n_layers": raw["num_hidden_layers"],
+        "n_heads": raw["num_attention_heads"],
+        "n_kv_heads": raw.get("num_key_value_heads",
+                              raw["num_attention_heads"]),
+        "d_ff": raw["intermediate_size"],
+        "rope_theta": float(raw.get("rope_theta", 10000.0)),
+        "norm_eps": float(raw.get("rms_norm_eps", 1e-5)),
+    }
+
+
+class _LazyTensors:
+    """name -> numpy array, materialized one tensor at a time.
+
+    Eagerly loading every shard costs a full extra model copy in host RAM
+    next to the stacked output (8B ≈ +16-32GB) — instead keep safetensors
+    handles open and read each tensor when the mapper asks for it.  The
+    torch-bin fallback has no lazy API; it loads eagerly (legacy path)."""
+
+    def __init__(self, src_dir: str):
+        import glob
+
+        self._by_name: dict = {}     # name -> (safe_open handle) or ndarray
+        self._handles: list = []
+        shards = sorted(glob.glob(os.path.join(src_dir, "*.safetensors")))
+        if shards:
+            from safetensors import safe_open
+
+            for shard in shards:
+                f = safe_open(shard, framework="np")
+                self._handles.append(f)
+                for name in f.keys():
+                    self._by_name[name] = f
+            return
+        bins = sorted(glob.glob(os.path.join(src_dir, "pytorch_model*.bin")))
+        if not bins:
+            raise FileNotFoundError(
+                f"no *.safetensors or pytorch_model*.bin in {src_dir}")
+        import torch
+
+        for b in bins:
+            sd = torch.load(b, map_location="cpu", weights_only=True)
+            for name, t in sd.items():
+                self._by_name[name] = t.float().numpy()
+
+    def pop(self, name):
+        src = self._by_name.pop(name)
+        if isinstance(src, np.ndarray):
+            return src
+        return src.get_tensor(name)
+
+    def __contains__(self, name) -> bool:
+        return name in self._by_name
+
+    def remaining(self) -> list:
+        return sorted(self._by_name)
+
+
+_PER_LAYER = {
+    # engine name -> (HF suffix, transpose)
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "w1": ("mlp.gate_proj.weight", True),
+    "w3": ("mlp.up_proj.weight", True),
+    "w2": ("mlp.down_proj.weight", True),
+    "ln_attn": ("input_layernorm.weight", False),
+    "ln_mlp": ("post_attention_layernorm.weight", False),
+}
+
+
+def convert_hf_checkpoint(src_dir: str, out_dir: str,
+                          dtype: str = "bfloat16") -> dict:
+    """Convert an HF Llama-family checkout into ``out_dir`` (config.json +
+    params.npz in the engine's format).  Returns the engine config dict.
+
+    ``dtype``: storage dtype for params.npz — "bfloat16" (default; stored
+    as float16, whose 10-bit mantissa strictly covers bf16's 7 — numpy's
+    npz loader can't round-trip ml_dtypes.bfloat16) or "float32" (parity
+    testing).  load_params casts to bf16 on load either way."""
+    with open(os.path.join(src_dir, "config.json")) as f:
+        raw = json.load(f)
+    cfg = _map_config(raw)
+    store = np.float32 if dtype == "float32" else np.float16
+
+    tensors = _LazyTensors(src_dir)
+
+    def grab(name, transpose=False):
+        """One tensor, downcast to the storage dtype immediately — only one
+        fp32 tensor is ever alive, keeping peak RAM ~1x model size."""
+        t = np.asarray(tensors.pop(name), np.float32)
+        t = (t.T if transpose else t).astype(store)
+        if not np.isfinite(t).all():
+            # fp16 storage has a narrower exponent range than bf16: an
+            # outlier weight > 65504 becomes inf here and NaN logits at
+            # serve time — fail at conversion, where it is attributable
+            raise ValueError(f"{name} has non-finite values after casting "
+                             f"to {np.dtype(store).name} (outlier weight "
+                             "beyond the storage dtype's range)")
+        return t
+
+    out = {"embed": grab("model.embed_tokens.weight")}
+    for ours, (suffix, transpose) in _PER_LAYER.items():
+        out[ours] = np.stack([
+            grab(f"model.layers.{l}.{suffix}", transpose)
+            for l in range(cfg["n_layers"])])
+        gc.collect()
+    out["ln_out"] = grab("model.norm.weight")
+    if "lm_head.weight" in tensors:
+        out["unembed"] = grab("lm_head.weight", transpose=True)
+    else:  # tied embeddings (llama3.2-1b style, and most tiny test configs)
+        out["unembed"] = out["embed"].T.copy()
+    leftovers = [n for n in tensors.remaining() if "rotary_emb" not in n]
+    if leftovers:
+        raise ValueError(f"unmapped checkpoint tensors: {leftovers[:8]} — "
+                         "refusing to drop weights silently")
+
+    # params FIRST, config LAST: config.json is what flips
+    # hf_dir_needs_conversion off, so a mid-write crash (disk full) must
+    # leave the dir still recognized as unconverted — config-first would
+    # make a later load fall back to RANDOM params and serve garbage
+    os.makedirs(out_dir, exist_ok=True)
+    tmp = os.path.join(out_dir, "params.npz.tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **out)
+    os.replace(tmp, os.path.join(out_dir, "params.npz"))  # atomic: no partials
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(cfg, f, indent=1)
+    return cfg
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) not in (2, 3):
+        print("usage: python -m kubeflow_tpu.serving.engine.hf_convert "
+              "SRC_HF_DIR OUT_DIR [float32|bfloat16]", file=sys.stderr)
+        return 2
+    cfg = convert_hf_checkpoint(argv[0], argv[1],
+                                argv[2] if len(argv) > 2 else "bfloat16")
+    print(json.dumps(cfg))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

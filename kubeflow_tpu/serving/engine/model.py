@@ -57,6 +57,16 @@ class DecoderConfig:
             return None
         with open(path) as f:
             raw = json.load(f)
+        from .hf_convert import is_hf_config
+
+        if is_hf_config(raw):
+            # a transformers config: vocab_size matches our field name but
+            # hidden_size/num_hidden_layers don't, so silently filtering
+            # would produce a config with DEFAULT dims and garbage serving
+            raise ValueError(
+                f"{path} is a HuggingFace config — convert the checkpoint "
+                "first (kubeflow_tpu.serving.engine.hf_convert; the "
+                "JetStream runtime auto-converts on load)")
         fields = {f.name for f in dataclasses.fields(DecoderConfig)}
         return DecoderConfig(**{k: v for k, v in raw.items() if k in fields})
 

@@ -77,6 +77,15 @@ class JetStreamModel(Model):
 
     def load(self) -> None:
         if self.engine is None:
+            from .hf_convert import convert_hf_checkpoint, hf_dir_needs_conversion
+
+            if self.model_dir and hf_dir_needs_conversion(self.model_dir):
+                # storage_uri pointed at a raw HuggingFace checkout (what a
+                # user of the reference platform's huggingfaceserver has):
+                # convert the safetensors weights into engine params in
+                # place, next to the originals (model_dir is the pod-local
+                # storage-initializer copy, so this never mutates the source)
+                convert_hf_checkpoint(self.model_dir, self.model_dir)
             config = DecoderConfig.from_dir(self.model_dir) or DecoderConfig()
             params = load_params(self.model_dir, config)
             ec = EngineConfig()

@@ -1,0 +1,135 @@
+"""HF checkpoint → engine conversion: numeric parity with transformers.
+
+The migration story for the reference's huggingfaceserver users: point an
+InferenceService at an HF Llama checkout and the JetStream runtime serves
+it.  These tests pin the weight mapping against transformers' own forward
+pass — the one oracle that can catch a transposed projection or a wrong
+RoPE convention.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+transformers = pytest.importorskip("transformers")
+
+
+def _tiny_hf_llama(tmp_path, tie=False):
+    import torch
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=tie,
+        attention_bias=False, mlp_bias=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    src = tmp_path / ("hf_tied" if tie else "hf")
+    model.save_pretrained(src)  # safetensors by default
+    return model, str(src)
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_converted_logits_match_transformers(tmp_path, tie):
+    import torch
+
+    from kubeflow_tpu.serving.engine import model as M
+    from kubeflow_tpu.serving.engine.hf_convert import convert_hf_checkpoint
+
+    hf, src = _tiny_hf_llama(tmp_path, tie=tie)
+    out = tmp_path / "engine"
+    cfg_dict = convert_hf_checkpoint(src, str(out), dtype="float32")
+    assert cfg_dict["n_kv_heads"] == 2 and cfg_dict["d_model"] == 64
+
+    config = M.DecoderConfig.from_dir(str(out))
+    params = {k: jnp.asarray(v, jnp.float32)
+              for k, v in np.load(out / "params.npz").items()}
+
+    toks = np.array([[5, 17, 99, 3, 42, 7]], np.int64)
+    with __import__("torch").no_grad():
+        ref = hf(torch.from_numpy(toks)).logits.numpy()  # [1, S, V]
+    got = np.asarray(M.forward_full(params, config, jnp.asarray(toks, jnp.int32)))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_rejects_non_llama_architectures(tmp_path):
+    from kubeflow_tpu.serving.engine.hf_convert import convert_hf_checkpoint
+
+    d = tmp_path / "gemma"
+    d.mkdir()
+    (d / "config.json").write_text(json.dumps(
+        {"model_type": "gemma", "vocab_size": 10, "hidden_size": 8}))
+    with pytest.raises(ValueError, match="gemma"):
+        convert_hf_checkpoint(str(d), str(tmp_path / "out"))
+
+
+def test_rejects_rope_scaling_and_mismatched_head_dim(tmp_path):
+    """Llama-3.1+ rope_scaling and Mistral-Nemo-style explicit head_dim
+    change the math the engine runs — converting would serve numerically
+    wrong generations with no error, so both must raise."""
+    from kubeflow_tpu.serving.engine.hf_convert import convert_hf_checkpoint
+
+    base = {"model_type": "llama", "vocab_size": 64, "hidden_size": 32,
+            "num_hidden_layers": 1, "num_attention_heads": 4,
+            "intermediate_size": 64}
+    d1 = tmp_path / "scaled"
+    d1.mkdir()
+    (d1 / "config.json").write_text(json.dumps(
+        dict(base, rope_scaling={"rope_type": "llama3", "factor": 8.0})))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        convert_hf_checkpoint(str(d1), str(tmp_path / "o1"))
+
+    d2 = tmp_path / "nemo"
+    d2.mkdir()
+    (d2 / "config.json").write_text(json.dumps(dict(base, head_dim=16)))
+    with pytest.raises(ValueError, match="head_dim"):
+        convert_hf_checkpoint(str(d2), str(tmp_path / "o2"))
+
+
+def test_from_dir_refuses_raw_hf_config(tmp_path):
+    """A raw HF config silently filtered through DecoderConfig would serve
+    with DEFAULT dims — it must raise instead."""
+    from kubeflow_tpu.serving.engine.model import DecoderConfig
+
+    (tmp_path / "config.json").write_text(json.dumps(
+        {"model_type": "llama", "vocab_size": 128, "hidden_size": 64}))
+    with pytest.raises(ValueError, match="HuggingFace"):
+        DecoderConfig.from_dir(str(tmp_path))
+
+
+@pytest.mark.slow
+def test_isvc_serves_raw_hf_checkout_end_to_end(tmp_path):
+    """Full platform path on an unconverted HF checkout: ISVC -> storage
+    init -> JetStream runtime auto-converts -> generation completes."""
+    import os
+
+    from kubeflow_tpu.core.cluster import Cluster
+    from kubeflow_tpu.serving import install
+    from kubeflow_tpu.serving.api import inference_service
+
+    _, src = _tiny_hf_llama(tmp_path)
+    with open(os.path.join(src, "engine.json"), "w") as f:
+        json.dump({"max_slots": 2, "num_pages": 32, "page_size": 8}, f)
+
+    c = Cluster(cpu_nodes=1, tpu_slices=(("s0", "v5e", "2x2"),),
+                base_env={"PYTHONPATH": os.getcwd(), "JAX_PLATFORMS": "cpu"})
+    router, proxy = install(c.api, c.manager)
+    try:
+        c.apply(inference_service("hfllm", model_format="llama",
+                                  storage_uri=f"file://{src}"))
+
+        def ready():
+            st = (c.api.try_get("InferenceService", "hfllm") or {}).get("status", {})
+            return any(cond["type"] == "Ready" and cond["status"] == "True"
+                       for cond in st.get("conditions", []))
+        assert c.wait_for(ready, timeout=180), "ISVC on HF checkout never Ready"
+        out = router.predict("hfllm", {"instances": [
+            {"prompt": "hi", "max_tokens": 4}]})
+        assert out["predictions"][0]["tokens"] == 4
+    finally:
+        proxy.shutdown()
+        c.shutdown()
