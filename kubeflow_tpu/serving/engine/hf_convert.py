@@ -38,10 +38,13 @@ def is_hf_config(raw: dict) -> bool:
 
 
 def hf_dir_needs_conversion(model_dir: str) -> bool:
-    """An HF checkout (HF config.json, no engine params.npz yet)."""
+    """True while config.json is still HF-format.  config.json is the ONE
+    gate — it is written LAST (atomically) by convert_hf_checkpoint, so a
+    crash anywhere mid-conversion leaves it HF-format and conversion
+    simply re-runs on the next load.  (Keying on params.npz existence
+    would wedge a dir whose crash landed between the two writes: convert
+    skipped, from_dir raising, forever.)"""
     cfg = os.path.join(model_dir, "config.json")
-    if os.path.exists(os.path.join(model_dir, "params.npz")):
-        return False
     if not os.path.exists(cfg):
         return False
     with open(cfg) as f:
@@ -159,6 +162,8 @@ def convert_hf_checkpoint(src_dir: str, out_dir: str,
     as float16, whose 10-bit mantissa strictly covers bf16's 7 — numpy's
     npz loader can't round-trip ml_dtypes.bfloat16) or "float32" (parity
     testing).  load_params casts to bf16 on load either way."""
+    if dtype not in ("bfloat16", "float32"):
+        raise ValueError(f"dtype must be 'bfloat16' or 'float32', got {dtype!r}")
     with open(os.path.join(src_dir, "config.json")) as f:
         raw = json.load(f)
     cfg = _map_config(raw)
@@ -196,17 +201,20 @@ def convert_hf_checkpoint(src_dir: str, out_dir: str,
         raise ValueError(f"unmapped checkpoint tensors: {leftovers[:8]} — "
                          "refusing to drop weights silently")
 
-    # params FIRST, config LAST: config.json is what flips
-    # hf_dir_needs_conversion off, so a mid-write crash (disk full) must
-    # leave the dir still recognized as unconverted — config-first would
-    # make a later load fall back to RANDOM params and serve garbage
+    # params FIRST, config LAST, both atomic: config.json is the one gate
+    # hf_dir_needs_conversion reads, so a crash anywhere before the final
+    # replace leaves the dir still recognized as unconverted and the next
+    # load re-runs conversion.  (Config-first would make a later load fall
+    # back to RANDOM params and serve garbage.)
     os.makedirs(out_dir, exist_ok=True)
     tmp = os.path.join(out_dir, "params.npz.tmp")
     with open(tmp, "wb") as f:
         np.savez(f, **out)
-    os.replace(tmp, os.path.join(out_dir, "params.npz"))  # atomic: no partials
-    with open(os.path.join(out_dir, "config.json"), "w") as f:
+    os.replace(tmp, os.path.join(out_dir, "params.npz"))
+    tmp_cfg = os.path.join(out_dir, "config.json.tmp")
+    with open(tmp_cfg, "w") as f:
         json.dump(cfg, f, indent=1)
+    os.replace(tmp_cfg, os.path.join(out_dir, "config.json"))
     return cfg
 
 

@@ -6,9 +6,12 @@ Plugs the continuous-batching engine into the V1/V2 model server
     {"instances": [{"prompt": "...", "max_tokens": 32} | "plain string", ...]}
     -> {"predictions": [{"text": ..., "tokens": N, "latency_s": ...}, ...]}
 
-Tokenization: ``tokenizer.json`` (a {token: id} vocab with greedy longest-
-match) if the model dir has one, else byte-level (ids 0..255) — serving
-infrastructure must not depend on network tokenizer downloads (zero egress).
+Tokenization: ``tokenizer.json`` if the model dir has one — the HF
+tokenizers-library format (detected by its {"model": {"type": ...}}
+shape; loaded offline via ``tokenizers``) or our flat {token: id} vocab
+with greedy longest-match — else byte-level (ids 0..255).  Serving
+infrastructure must not depend on network tokenizer downloads (zero
+egress).
 """
 
 from __future__ import annotations
@@ -58,11 +61,34 @@ class VocabTokenizer:
         return "".join(self.inv.get(i, "") for i in ids)
 
 
+class HFTokenizer:
+    """A HuggingFace ``tokenizers``-format tokenizer.json (what real Llama
+    checkouts ship), loaded with the lightweight ``tokenizers`` library
+    directly — no transformers/torch import at pod start.  Token ids must
+    match the converted weights' vocabulary; running this file through
+    VocabTokenizer's flat {token: id} reading would encode garbage ids."""
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer
+
+        self._tok = Tokenizer.from_file(path)
+        self.vocab_size = self._tok.get_vocab_size()
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text).ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
 def load_tokenizer(model_dir: str):
     path = os.path.join(model_dir, "tokenizer.json")
     if model_dir and os.path.exists(path):
         with open(path) as f:
-            return VocabTokenizer(json.load(f))
+            raw = json.load(f)
+        if isinstance(raw.get("model"), dict) and "type" in raw["model"]:
+            return HFTokenizer(path)  # tokenizers-library format
+        return VocabTokenizer(raw)  # our flat {token: id} vocab
     return ByteTokenizer()
 
 

@@ -101,6 +101,32 @@ def test_from_dir_refuses_raw_hf_config(tmp_path):
         DecoderConfig.from_dir(str(tmp_path))
 
 
+def test_load_tokenizer_detects_hf_tokenizers_format(tmp_path):
+    """A real checkout's tokenizer.json is the HF tokenizers-library format
+    — ids must come from the checkpoint's own vocabulary, not from reading
+    the file as a flat {token: id} dict."""
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    from kubeflow_tpu.serving.engine.serve import (HFTokenizer,
+                                                   VocabTokenizer,
+                                                   load_tokenizer)
+
+    tok = Tokenizer(models.WordLevel(
+        {"hello": 7, "world": 3, "[UNK]": 0}, unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.save(str(tmp_path / "tokenizer.json"))
+
+    loaded = load_tokenizer(str(tmp_path))
+    assert isinstance(loaded, HFTokenizer)
+    assert loaded.encode("hello world") == [7, 3]
+    assert loaded.decode([7, 3]).strip() == "hello world"
+
+    flat = tmp_path / "flat"
+    flat.mkdir()
+    (flat / "tokenizer.json").write_text(json.dumps({"hi": 1, "yo": 2}))
+    assert isinstance(load_tokenizer(str(flat)), VocabTokenizer)
+
+
 @pytest.mark.slow
 def test_isvc_serves_raw_hf_checkout_end_to_end(tmp_path):
     """Full platform path on an unconverted HF checkout: ISVC -> storage
