@@ -68,10 +68,10 @@ class HFTokenizer:
     match the converted weights' vocabulary; running this file through
     VocabTokenizer's flat {token: id} reading would encode garbage ids."""
 
-    def __init__(self, path: str):
+    def __init__(self, data: str):
         from tokenizers import Tokenizer
 
-        self._tok = Tokenizer.from_file(path)
+        self._tok = Tokenizer.from_str(data)
         self.vocab_size = self._tok.get_vocab_size()
 
     def encode(self, text: str) -> list[int]:
@@ -85,9 +85,10 @@ def load_tokenizer(model_dir: str):
     path = os.path.join(model_dir, "tokenizer.json")
     if model_dir and os.path.exists(path):
         with open(path) as f:
-            raw = json.load(f)
+            data = f.read()  # read once: sniff + construct from the string
+        raw = json.loads(data)
         if isinstance(raw.get("model"), dict) and "type" in raw["model"]:
-            return HFTokenizer(path)  # tokenizers-library format
+            return HFTokenizer(data)  # tokenizers-library format
         return VocabTokenizer(raw)  # our flat {token: id} vocab
     return ByteTokenizer()
 

@@ -33,6 +33,7 @@ def _tiny_hf_llama(tmp_path, tie=False):
     return model, str(src)
 
 
+@pytest.mark.slow  # fast lane must stay under its 5-min budget (r1 #10)
 @pytest.mark.parametrize("tie", [False, True])
 def test_converted_logits_match_transformers(tmp_path, tie):
     import torch

@@ -260,6 +260,7 @@ def test_experiment_goal_early_stop(kcluster):
     assert reason == "GoalReached"
 
 
+@pytest.mark.slow  # fast lane must stay under its 5-min budget (r1 #10)
 def test_grid_exhaustion_ends_experiment(kcluster):
     """A grid smaller than maxTrialCount must end with SuggestionEndReached,
     not hang (the experiment used to stay Running forever)."""
@@ -636,6 +637,7 @@ def test_pbt_population_improves_over_generations():
     assert gen_best[-1] > 0.95  # converged near lr = 0.3
 
 
+@pytest.mark.slow  # fast lane must stay under its 5-min budget (r1 #10)
 def test_bare_pod_trial_experiment_succeeds(kcluster):
     """Bare-Pod trialTemplate (upstream's plain batch-job/pod trial): the
     pod IS the workload — completion tracked by pod phase, metrics read

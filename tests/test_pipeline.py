@@ -115,6 +115,7 @@ def test_bert_pp_loss_matches_single_stage():
     assert np.isfinite(gnorm) and gnorm > 0
 
 
+@pytest.mark.slow  # fast lane must stay under its 5-min budget (r1 #10)
 def test_moe_transformer_composed_mesh_matches_unsharded():
     """stages×seq×expert in ONE step: loss on the composed 8-dev mesh equals
     the unsharded single-stage reference (same math, different layout)."""

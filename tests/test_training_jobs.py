@@ -27,6 +27,7 @@ def _client(c):
     return TrainingClient(c)
 
 
+@pytest.mark.slow  # fast lane must stay under its 5-min budget (r1 #10)
 def test_tpujob_distributed_psum_and_train(tcluster):
     """TPUJob with 2 workers → real jax.distributed rendezvous + psum."""
     spec = job(

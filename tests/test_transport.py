@@ -189,6 +189,7 @@ def test_sanitizer_builds():
     subprocess.run(["make", "clean"], cwd=d, check=True, capture_output=True)
 
 
+@pytest.mark.slow  # fast lane must stay under its 5-min budget (r1 #10)
 def test_transport_bench_harness_measures_a_world():
     """The shim microbench (VERDICT r3 #7) produces rows with sane
     latency/bandwidth numbers for one small world."""
