@@ -225,6 +225,11 @@ def artifact_path(repo_root: str | None = None) -> str:
 
     root = repo_root or os.path.join(os.path.dirname(__file__), "..")
     rnd = os.environ.get("BENCH_ROUND")
+    if rnd is not None:
+        # accept "4", "04", "r4" — and never crash at write time (this
+        # runs AFTER many minutes of benches); fall back to the literal
+        digits = rnd.lstrip("rR")
+        rnd = f"{int(digits):02d}" if digits.isdigit() else rnd
     if rnd is None:
         # 1 + highest existing N (NOT first gap — artifact sets can be
         # sparse, e.g. r01 retired but r02/r03 committed)

@@ -86,6 +86,31 @@ def test_paged_attention_lowers_for_tpu(quant, K, hd, ps):
     _export_tpu(fn, q, pool, pool, pt, sl)
 
 
+@pytest.mark.slow  # ~10s/variant: full-model exports live in the slow lane
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_engine_decode_steps_paged_lower_for_tpu(quant):
+    """The composed jits engine_chip_check runs on chip: decode_step and
+    the speculative decode_step_k with paged=True over bf16/int8 pools —
+    pool scatter + pool_layer + the Pallas kernel in one program."""
+    from kubeflow_tpu.serving.engine import model as M
+
+    cfg = M.DecoderConfig(vocab_size=128, d_model=256, n_layers=1,
+                          n_heads=8, n_kv_heads=2, d_ff=512)
+    params = M.init(jax.random.PRNGKey(0), cfg)
+    shape = (cfg.n_layers, 16, cfg.n_kv_heads, 8, cfg.head_dim)
+    kp, vp = M.make_kv_pool(shape, quant), M.make_kv_pool(shape, quant)
+    toks = jnp.zeros((2,), jnp.int32)
+    lens = jnp.zeros((2,), jnp.int32)
+    pt = jnp.zeros((2, 4), jnp.int32)
+
+    step = functools.partial(M.decode_step.__wrapped__, params, cfg,
+                             paged=True, mesh=None)
+    _export_tpu(step, toks, lens, pt, kp, vp)
+    stepk = functools.partial(M.decode_step_k.__wrapped__, params, cfg,
+                              paged=True, mesh=None)
+    _export_tpu(stepk, jnp.zeros((2, 3), jnp.int32), lens, pt, kp, vp)
+
+
 # -------------------------------------------------------------- train step
 
 
