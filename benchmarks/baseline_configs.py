@@ -229,7 +229,7 @@ def artifact_path(repo_root: str | None = None) -> str:
         # accept "4", "04", "r4" — and never crash at write time (this
         # runs AFTER many minutes of benches); fall back to the literal
         digits = rnd.lstrip("rR")
-        rnd = f"{int(digits):02d}" if digits.isdigit() else rnd
+        rnd = f"{int(digits):02d}" if digits.isdecimal() else rnd
     if rnd is None:
         # 1 + highest existing N (NOT first gap — artifact sets can be
         # sparse, e.g. r01 retired but r02/r03 committed)
