@@ -117,6 +117,8 @@ class _Pending:
     stream: "queue.Queue" = None
     # set by Engine.cancel(); the loop finishes the request at its next tick
     cancelled: bool = False
+    # adapter id (0 = base model) — resolved from the name at submit time
+    adapter_id: int = 0
     # committed context (prompt + generated, one list — no per-tick concat)
     # plus the incrementally-built n-gram index for prompt-lookup drafting:
     # maps n-gram -> most recent start strictly before the final n-gram, so
@@ -144,13 +146,21 @@ class _StreamHandle:
 class Engine:
     """Continuous-batching generation engine over one jit'd model."""
 
-    def __init__(self, params, config: DecoderConfig, engine_config: EngineConfig = EngineConfig()):
+    def __init__(self, params, config: DecoderConfig, engine_config: EngineConfig = EngineConfig(),
+                 lora=None):
         import jax
         import jax.numpy as jnp
 
         self.params = params
         self.config = config
         self.ec = engine_config
+        # multi-LoRA: ``lora`` = (stacked adapter pytree, {name: id}) from
+        # lora.load_adapters — id 0 is the reserved zero adapter, so the
+        # per-slot id table below makes every decode row pick its own
+        # adapter with no branching (lora.py module docstring)
+        self._lora = lora[0] if lora else None
+        self.adapters = dict(lora[1]) if lora else {}
+        self._aid_host = np.zeros((engine_config.max_slots,), np.int32)
         self.batcher = NativeBatcher(
             engine_config.max_slots, engine_config.num_pages,
             engine_config.page_size, engine_config.max_pages_per_slot,
@@ -244,22 +254,31 @@ class Engine:
         self.batcher.close()
 
     def generate_async(self, tokens: list[int], max_new_tokens: int = 32,
-                       stream: Optional["queue.Queue"] = None) -> Future:
+                       stream: Optional["queue.Queue"] = None,
+                       adapter: Optional[str] = None) -> Future:
         """Submit a prompt; the Future resolves to a result dict.
 
         ``stream``: optional queue that receives each token id as it is
-        committed, then a final ``(None, result)`` sentinel."""
+        committed, then a final ``(None, result)`` sentinel.  ``adapter``:
+        name of a loaded LoRA adapter to decode this request with (None =
+        base model; unknown names raise)."""
         if not tokens:
             raise ValueError("empty prompt")
+        aid = 0
+        if adapter is not None:
+            if adapter not in self.adapters:
+                raise ValueError(f"unknown adapter {adapter!r} "
+                                 f"(loaded: {sorted(self.adapters)})")
+            aid = self.adapters[adapter]
         fut: Future = Future()
-        hashes = self._page_hashes(tokens)
+        hashes = self._page_hashes(tokens, aid)
         with self._lock:
             rid = self._next_id
             self._next_id += 1
             self._requests[rid] = _Pending(
                 tokens=list(tokens), max_new_tokens=max_new_tokens,
                 future=fut, submitted_at=time.perf_counter(), page_hashes=hashes,
-                stream=stream, context=list(tokens),
+                stream=stream, context=list(tokens), adapter_id=aid,
             )
         # lookup eligibility stops one page short of the prompt end: prefill
         # must compute at least the final prompt token to produce the logits
@@ -276,16 +295,20 @@ class Engine:
         self._wake.set()
         return fut
 
-    def _page_hashes(self, tokens: list[int]) -> "np.ndarray":
+    def _page_hashes(self, tokens: list[int], adapter_id: int = 0) -> "np.ndarray":
         """Chain hashes for each FULL prompt page: hash(page i) folds in
         hash(page i-1), so a match means an identical token prefix at
-        identical positions. 0 is reserved as the no-parent sentinel."""
+        identical positions. 0 is reserved as the no-parent sentinel.
+
+        The adapter id seeds the chain: a LoRA adapter changes the KV a
+        prompt produces, so identical prompts under different adapters must
+        NEVER share prefix-cache pages."""
         import hashlib
 
         ps = self.ec.page_size
         n = len(tokens) // ps
         out = np.zeros((n,), np.uint64)
-        prev = b""
+        prev = adapter_id.to_bytes(4, "little") if adapter_id else b""
         for i in range(n):
             page = np.asarray(tokens[i * ps:(i + 1) * ps], np.int32).tobytes()
             digest = hashlib.blake2b(prev + page, digest_size=8).digest()
@@ -293,8 +316,10 @@ class Engine:
             prev = digest
         return out
 
-    def generate(self, tokens: list[int], max_new_tokens: int = 32, timeout: float = 300.0) -> dict:
-        return self.generate_async(tokens, max_new_tokens).result(timeout=timeout)
+    def generate(self, tokens: list[int], max_new_tokens: int = 32, timeout: float = 300.0,
+                 adapter: Optional[str] = None) -> dict:
+        return self.generate_async(tokens, max_new_tokens,
+                                   adapter=adapter).result(timeout=timeout)
 
     def cancel(self, future: Future) -> bool:
         """Cancel the request behind a generate_async future (client went
@@ -334,7 +359,8 @@ class Engine:
         return True
 
     def generate_stream(self, tokens: list[int], max_new_tokens: int = 32,
-                        timeout: float = 300.0) -> Iterator:
+                        timeout: float = 300.0,
+                        adapter: Optional[str] = None) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
 
         The last item yielded is the same dict ``generate`` returns (so
@@ -346,7 +372,8 @@ class Engine:
         The returned iterator exposes ``.future`` so a disconnected client
         can be reaped via ``Engine.cancel(stream.future)``."""
         q: queue.Queue = queue.Queue()
-        fut = self.generate_async(tokens, max_new_tokens, stream=q)
+        fut = self.generate_async(tokens, max_new_tokens, stream=q,
+                                  adapter=adapter)
 
         def _iter():
             while True:
@@ -412,6 +439,9 @@ class Engine:
             logits, pk, pv = prefill(
                 self.params, self.config, jnp.asarray(toks),
                 jnp.int32(plen), ps,
+                lora_params=self._lora,
+                adapter_ids=(jnp.asarray([pending.adapter_id], jnp.int32)
+                             if self._lora is not None else None),
             )
             # prefill produced bucket/page_size pages; slot owns
             # ceil(plen/page_size) — scatter only the owned prefix
@@ -446,6 +476,9 @@ class Engine:
             self.params, self.config, jnp.asarray(toks), jnp.int32(off),
             jnp.int32(plen), jnp.asarray(chunk_ids), jnp.asarray(hist_ids),
             self.k_pool, self.v_pool, ps,
+            lora_params=self._lora,
+            adapter_ids=(jnp.asarray([pending.adapter_id], jnp.int32)
+                         if self._lora is not None else None),
         )
         if off + C >= plen:
             del self._prefilling[slot]
@@ -476,6 +509,7 @@ class Engine:
                     pending = self._requests.get(rid)
                     if pending is not None:
                         self._slot_req[slot] = rid
+                        self._aid_host[slot] = pending.adapter_id
                 if pending is None:
                     self.batcher.release(slot)
                     continue
@@ -541,6 +575,9 @@ class Engine:
             self.params, self.config, jnp.asarray(tokens),
             jnp.asarray(seq_lens), jnp.asarray(page_table),
             self.k_pool, self.v_pool, paged=self._paged, mesh=self._mesh,
+            lora_params=self._lora,
+            adapter_ids=(jnp.asarray(self._aid_host)
+                         if self._lora is not None else None),
         )
         sampled = np.asarray(
             sample_tokens(logits, self._next_key(), self.ec.temperature))
@@ -617,6 +654,9 @@ class Engine:
             self.params, self.config, jnp.asarray(tokens),
             jnp.asarray(seq_lens), jnp.asarray(page_table),
             self.k_pool, self.v_pool, paged=self._paged, mesh=self._mesh,
+            lora_params=self._lora,
+            adapter_ids=(jnp.asarray(self._aid_host)
+                         if self._lora is not None else None),
         )
         B, _, V = logits.shape
         sampled = np.asarray(sample_tokens(
@@ -679,6 +719,7 @@ class Engine:
             self._slot_req.pop(slot, None)
         self._pt_host[slot, :] = 0
         self._len_host[slot] = 0
+        self._aid_host[slot] = 0  # released slots decode as the zero adapter
         self._prefill_rows.pop(slot, None)
         # hand the prompt's full pages to the prefix cache on the way out —
         # unless the prefill never finished (cancel mid-prefill): those pages

@@ -270,34 +270,54 @@ def _attn(q, k, v, mask):
     return jnp.einsum("bhst,bthd->bshd", probs.astype(v.dtype), v)
 
 
-def _block_with(params, l, config, x, positions, attend):
+def _proj(params, l, name, h, lora):
+    """h @ W[name][l], plus the per-row LoRA delta when an adapter table is
+    live: ``lora`` = (adapters, adapter_ids) where adapters[name] holds
+    stacked {"A": [n_adapters, L, in, r], "B": [n_adapters, L, r, out]}
+    (adapter 0 is all-zeros = "no adapter", so inactive rows cost two tiny
+    matmuls instead of a branch — static shapes beat recompiles) and
+    adapter_ids is [B] int32 selecting each batch row's adapter."""
+    y = h @ _w(params[name], l)
+    if lora is not None and name in lora[0]:
+        ad, aids = lora[0][name], lora[1]
+        A = ad["A"][aids, l]   # [B, in, r] — tiny gather, r is 8-64
+        Bm = ad["B"][aids, l]  # [B, r, out]
+        delta = jnp.einsum("bsr,bro->bso",
+                           jnp.einsum("bsd,bdr->bsr", h, A), Bm)
+        y = y + delta.astype(y.dtype)
+    return y
+
+
+def _block_with(params, l, config, x, positions, attend, lora=None):
     """One transformer block with a pluggable attention: ``attend(q)`` maps
     roped queries [B, S, Hq, hd] to attention outputs of the same shape (the
     hook where the XLA gather path and the Pallas paged kernel diverge)."""
     c = config
     h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
     B, S = x.shape[:2]
-    q = (h @ _w(params["wq"], l)).reshape(B, S, c.n_heads, c.head_dim)
+    q = _proj(params, l, "wq", h, lora).reshape(B, S, c.n_heads, c.head_dim)
     q = _rope(q, positions, c.rope_theta)
     attn = attend(q)
-    x = x + attn.reshape(B, S, -1) @ _w(params["wo"], l)
+    x = x + _proj(params, l, "wo", attn.reshape(B, S, -1), lora)
     h = _rms_norm(x, params["ln_mlp"][l], c.norm_eps)
-    x = x + (jax.nn.silu(h @ _w(params["w1"], l)) * (h @ _w(params["w3"], l))) @ _w(params["w2"], l)
+    x = x + _proj(params, l, "w2",
+                  jax.nn.silu(_proj(params, l, "w1", h, lora))
+                  * _proj(params, l, "w3", h, lora), lora)
     return x
 
 
-def _block(params, l, config, x, k_cache, v_cache, positions, mask):
+def _block(params, l, config, x, k_cache, v_cache, positions, mask, lora=None):
     """One transformer block. k_cache/v_cache: [B, T, Hkv, hd] (already incl.
     this step's k/v at the right positions). Returns block output."""
     return _block_with(params, l, config, x, positions,
-                       lambda q: _attn(q, k_cache, v_cache, mask))
+                       lambda q: _attn(q, k_cache, v_cache, mask), lora=lora)
 
 
-def _kv_proj(params, l, config, h, positions):
+def _kv_proj(params, l, config, h, positions, lora=None):
     c = config
     B, S = h.shape[:2]
-    k = (h @ _w(params["wk"], l)).reshape(B, S, c.n_kv_heads, c.head_dim)
-    v = (h @ _w(params["wv"], l)).reshape(B, S, c.n_kv_heads, c.head_dim)
+    k = _proj(params, l, "wk", h, lora).reshape(B, S, c.n_kv_heads, c.head_dim)
+    v = _proj(params, l, "wv", h, lora).reshape(B, S, c.n_kv_heads, c.head_dim)
     k = _rope(k, positions, c.rope_theta)
     return k, v
 
@@ -369,7 +389,8 @@ def pool_layer(pool, l):
 
 
 @functools.partial(jax.jit, static_argnames=("config", "page_size"))
-def prefill(params, config: DecoderConfig, tokens, length, page_size: int):
+def prefill(params, config: DecoderConfig, tokens, length, page_size: int,
+            lora_params=None, adapter_ids=None):
     """Process one prompt (batch of 1, padded to a bucket).
 
     tokens: [1, S] int32 (padded); length: [] int32 actual prompt length.
@@ -379,6 +400,7 @@ def prefill(params, config: DecoderConfig, tokens, length, page_size: int):
     """
     c = config
     B, S = tokens.shape
+    lora = None if lora_params is None else (lora_params, adapter_ids)
     positions = jnp.arange(S, dtype=jnp.int32)[None, :]
     x = _embed_rows(params["embed"], tokens)
     causal = jnp.tril(jnp.ones((S, S), bool))[None]
@@ -387,10 +409,10 @@ def prefill(params, config: DecoderConfig, tokens, length, page_size: int):
     ks, vs = [], []
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
-        k, v = _kv_proj(params, l, c, h, positions)
+        k, v = _kv_proj(params, l, c, h, positions, lora=lora)
         ks.append(k)
         vs.append(v)
-        x = _block(params, l, c, x, k, v, positions, mask)
+        x = _block(params, l, c, x, k, v, positions, mask, lora=lora)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     # logits at the last REAL token (length-1)
     last = x[jnp.arange(B), length - 1]
@@ -419,7 +441,8 @@ def write_pages(k_pool, v_pool, paged_k, paged_v, page_ids):
 @functools.partial(jax.jit, static_argnames=("config", "page_size"),
                    donate_argnames=("k_pool", "v_pool"))
 def prefill_chunk(params, config: DecoderConfig, tokens, start, length,
-                  chunk_page_ids, hist_page_ids, k_pool, v_pool, page_size: int):
+                  chunk_page_ids, hist_page_ids, k_pool, v_pool, page_size: int,
+                  lora_params=None, adapter_ids=None):
     """Process one page-aligned chunk of a long prompt against the page pool.
 
     Long prompts are prefilled in fixed-size chunks interleaved with decode
@@ -439,6 +462,7 @@ def prefill_chunk(params, config: DecoderConfig, tokens, start, length,
     """
     c = config
     B, C = tokens.shape
+    lora = None if lora_params is None else (lora_params, adapter_ids)
     H = hist_page_ids.shape[0]
     T = H * page_size
     positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]
@@ -448,7 +472,7 @@ def prefill_chunk(params, config: DecoderConfig, tokens, start, length,
     mask = (t_range[None, None, :] <= positions[:, :, None]) & (t_range < length)[None, None, :]
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
-        k, v = _kv_proj(params, l, c, h, positions)
+        k, v = _kv_proj(params, l, c, h, positions, lora=lora)
         k_pool = pool_set(k_pool, (l, chunk_page_ids),
                           k.reshape(C // page_size, page_size, c.n_kv_heads, c.head_dim)
                            .transpose(0, 2, 1, 3))  # [n, Hkv, ps, hd]
@@ -460,7 +484,8 @@ def prefill_chunk(params, config: DecoderConfig, tokens, start, length,
                    .transpose(0, 2, 1, 3).reshape(1, T, c.n_kv_heads, c.head_dim))
         v_cache = (pool_get(v_pool, (l, hist_page_ids))
                    .transpose(0, 2, 1, 3).reshape(1, T, c.n_kv_heads, c.head_dim))
-        x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
+        x = _block(params, l, c, x, k_cache, v_cache, positions, mask,
+                   lora=lora)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     last = jnp.clip(length - 1 - start, 0, C - 1)
     logits = (x[jnp.arange(B), last] @ _w(params["unembed"])).astype(jnp.float32)
@@ -486,7 +511,8 @@ def sample_tokens(logits, key, temperature: float = 0.0):
 @functools.partial(jax.jit, static_argnames=("config", "paged", "mesh"),
                    donate_argnames=("k_pool", "v_pool"))
 def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
-                k_pool, v_pool, paged: bool = False, mesh=None):
+                k_pool, v_pool, paged: bool = False, mesh=None,
+                lora_params=None, adapter_ids=None):
     """One decode step for ALL slots.
 
     tokens: [B] int32 current token per slot; seq_lens: [B] int32 length
@@ -507,6 +533,7 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
     """
     c = config
     B = tokens.shape[0]
+    lora = None if lora_params is None else (lora_params, adapter_ids)
     page_size = pool_page_size(k_pool)
     max_pages = page_table.shape[1]
     T = max_pages * page_size
@@ -523,7 +550,7 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
 
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
-        k_new, v_new = _kv_proj(params, l, c, h, positions)  # [B,1,Hkv,hd]
+        k_new, v_new = _kv_proj(params, l, c, h, positions, lora=lora)
         # scatter this step's kv into the pool: one (page, head, offset) per
         # slot — the basic slice between the advanced indices puts the
         # broadcast [B] axis first, matching k_new[:, 0]'s [B, Hkv, hd]
@@ -533,14 +560,15 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
             kl, vl = pool_layer(k_pool, l), pool_layer(v_pool, l)
             attend = lambda q: paged_attention(  # noqa: E731
                 q, kl, vl, page_table, seq_lens, page_size, mesh=mesh)
-            x = _block_with(params, l, c, x, positions, attend)
+            x = _block_with(params, l, c, x, positions, attend, lora=lora)
         else:
             # gather each slot's pages [B, MP, Hkv, ps, hd] -> [B, T, Hkv, hd]
             k_cache = (pool_get(k_pool, (l, page_table))
                        .transpose(0, 1, 3, 2, 4).reshape(B, T, c.n_kv_heads, c.head_dim))
             v_cache = (pool_get(v_pool, (l, page_table))
                        .transpose(0, 1, 3, 2, 4).reshape(B, T, c.n_kv_heads, c.head_dim))
-            x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
+            x = _block(params, l, c, x, k_cache, v_cache, positions, mask,
+                       lora=lora)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     logits = (x[:, 0] @ _w(params["unembed"])).astype(jnp.float32)
     return logits, k_pool, v_pool
@@ -549,7 +577,8 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
 @functools.partial(jax.jit, static_argnames=("config", "paged", "mesh"),
                    donate_argnames=("k_pool", "v_pool"))
 def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
-                  k_pool, v_pool, paged: bool = False, mesh=None):
+                  k_pool, v_pool, paged: bool = False, mesh=None,
+                  lora_params=None, adapter_ids=None):
     """Speculative verify step: process 1 committed + (K-1) draft tokens per
     slot in ONE pass.
 
@@ -576,6 +605,7 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
     """
     c = config
     B, K = tokens.shape
+    lora = None if lora_params is None else (lora_params, adapter_ids)
     page_size = pool_page_size(k_pool)
     max_pages = page_table.shape[1]
     T = max_pages * page_size
@@ -601,7 +631,7 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
 
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
-        k_new, v_new = _kv_proj(params, l, c, h, positions)  # [B,K,Hkv,hd]
+        k_new, v_new = _kv_proj(params, l, c, h, positions, lora=lora)  # [B,K,Hkv,hd]
         # advanced [B,K] ids/offsets around the head slice: broadcast [B,K]
         # axes lead, giving [B, K, Hkv, hd] — matching k_new
         k_pool = pool_set(k_pool, (l, page_ids, slice(None), offsets), k_new)
@@ -610,13 +640,14 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
             kl, vl = pool_layer(k_pool, l), pool_layer(v_pool, l)
             attend = lambda q: paged_attention(  # noqa: E731
                 q, kl, vl, page_table, seq_lens, page_size, mesh=mesh)
-            x = _block_with(params, l, c, x, positions, attend)
+            x = _block_with(params, l, c, x, positions, attend, lora=lora)
         else:
             k_cache = (pool_get(k_pool, (l, page_table))
                        .transpose(0, 1, 3, 2, 4).reshape(B, T, c.n_kv_heads, c.head_dim))
             v_cache = (pool_get(v_pool, (l, page_table))
                        .transpose(0, 1, 3, 2, 4).reshape(B, T, c.n_kv_heads, c.head_dim))
-            x = _block(params, l, c, x, k_cache, v_cache, positions, mask)
+            x = _block(params, l, c, x, k_cache, v_cache, positions, mask,
+                       lora=lora)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     logits = (x @ _w(params["unembed"])).astype(jnp.float32)
     return logits, k_pool, v_pool
@@ -626,16 +657,18 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
 
 
 @functools.partial(jax.jit, static_argnames=("config",))
-def forward_full(params, config: DecoderConfig, tokens):
+def forward_full(params, config: DecoderConfig, tokens,
+                 lora_params=None, adapter_ids=None):
     """Plain full-sequence forward (correctness oracle for the paged path)."""
     c = config
     B, S = tokens.shape
+    lora = None if lora_params is None else (lora_params, adapter_ids)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     x = _embed_rows(params["embed"], tokens)
     mask = jnp.tril(jnp.ones((S, S), bool))[None].repeat(B, 0)
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
-        k, v = _kv_proj(params, l, c, h, positions)
-        x = _block(params, l, c, x, k, v, positions, mask)
+        k, v = _kv_proj(params, l, c, h, positions, lora=lora)
+        x = _block(params, l, c, x, k, v, positions, mask, lora=lora)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     return (x @ _w(params["unembed"])).astype(jnp.float32)

@@ -115,6 +115,10 @@ class JetStreamModel(Model):
                 convert_hf_checkpoint(self.model_dir, self.model_dir)
             config = DecoderConfig.from_dir(self.model_dir) or DecoderConfig()
             params = load_params(self.model_dir, config)
+            from .lora import load_adapters
+
+            lora_params, adapter_ids = load_adapters(self.model_dir, config)
+            lora = (lora_params, adapter_ids) if lora_params is not None else None
             ec = EngineConfig()
             path = os.path.join(self.model_dir, "engine.json")
             if self.model_dir and os.path.exists(path):
@@ -124,9 +128,15 @@ class JetStreamModel(Model):
 
                 fields = {f.name for f in dataclasses.fields(EngineConfig)}
                 ec = EngineConfig(**{k: v for k, v in raw.items() if k in fields})
-            self.engine = Engine(params, config, ec)
+            self.engine = Engine(params, config, ec, lora=lora)
         self.engine.start()
         self.ready = True
+
+    @property
+    def adapters(self) -> dict:
+        """Loaded LoRA adapter names (served as their own OpenAI model
+        ids; vLLM-style multi-LoRA)."""
+        return self.engine.adapters if self.engine is not None else {}
 
     def extra_metrics(self) -> dict:
         """Per-replica engine state for the router's least-loaded pick and
@@ -145,17 +155,18 @@ class JetStreamModel(Model):
             "engine_page_hits": s["page_hits"],
         }
 
-    def _parse_generate(self, payload: Any) -> tuple[list[int], int]:
+    def _parse_generate(self, payload: Any):
         prompt = payload.get("text_input", "") if isinstance(payload, dict) else str(payload)
-        max_tokens = int((payload.get("parameters") or {}).get("max_tokens", 32)) \
-            if isinstance(payload, dict) else 32
-        return self.tokenizer.encode(prompt) or [0], max_tokens
+        params = (payload.get("parameters") or {}) if isinstance(payload, dict) else {}
+        max_tokens = int(params.get("max_tokens", 32))
+        return (self.tokenizer.encode(prompt) or [0], max_tokens,
+                params.get("adapter"))
 
     def generate(self, payload: Any, headers: Optional[dict] = None) -> Any:
         """V2 generate extension (unary): {"text_input": str, "parameters":
         {"max_tokens": N}} -> {"text_output": str, ...}."""
-        ids, max_tokens = self._parse_generate(payload)
-        r = self.engine.generate(ids, max_tokens)
+        ids, max_tokens, adapter = self._parse_generate(payload)
+        r = self.engine.generate(ids, max_tokens, adapter=adapter)
         return {"text_output": self.tokenizer.decode(r["tokens"]),
                 "token_ids": r["tokens"], "tokens": r["num_tokens"],
                 "prompt_tokens": len(ids), "max_tokens": max_tokens,
@@ -170,10 +181,10 @@ class JetStreamModel(Model):
         UTF-8 char split across byte tokens decodes to U+FFFD until its tail
         arrives) — so the concatenated stream equals the unary text_output.
         """
-        ids, max_tokens = self._parse_generate(payload)
+        ids, max_tokens, adapter = self._parse_generate(payload)
         out_ids: list[int] = []
         emitted = 0
-        stream = self.engine.generate_stream(ids, max_tokens)
+        stream = self.engine.generate_stream(ids, max_tokens, adapter=adapter)
         try:
             for item in stream:
                 if isinstance(item, dict):
@@ -201,15 +212,26 @@ class JetStreamModel(Model):
 
     def predict(self, payload: Any, headers: Optional[dict] = None) -> Any:
         instances = payload.get("instances", []) if isinstance(payload, dict) else payload
+        # validate every adapter name BEFORE submitting anything: a bad name
+        # mid-loop would 500 the whole request while already-submitted
+        # generations burn slots with nobody reading their futures
+        for inst in instances:
+            ad = inst.get("adapter") if isinstance(inst, dict) else None
+            if ad is not None and ad not in self.adapters:
+                raise ValueError(f"unknown adapter {ad!r} "
+                                 f"(loaded: {sorted(self.adapters)})")
         futures = []
         for inst in instances:
             if isinstance(inst, str):
                 prompt, max_tokens = inst, 32
+                adapter = None
             else:
                 prompt = inst.get("prompt", "")
                 max_tokens = int(inst.get("max_tokens", 32))
+                adapter = inst.get("adapter")
             ids = self.tokenizer.encode(prompt) or [0]
-            futures.append(self.engine.generate_async(ids, max_tokens))
+            futures.append(self.engine.generate_async(ids, max_tokens,
+                                                      adapter=adapter))
         out = []
         for fut in futures:
             r = fut.result(timeout=300)

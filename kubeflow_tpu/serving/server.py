@@ -175,9 +175,15 @@ class ModelServer:
         elif path == "/v2/models":
             h._send(200, {"models": sorted(self.models)})
         elif path == "/openai/v1/models":
-            h._send(200, {"object": "list", "data": [
-                {"id": n, "object": "model", "owned_by": "kubeflow-tpu"}
-                for n in sorted(self.models)]})
+            data = [{"id": n, "object": "model", "owned_by": "kubeflow-tpu"}
+                    for n in sorted(self.models)]
+            for n in sorted(self.models):
+                # vLLM-style multi-LoRA: each loaded adapter is served as
+                # its own model id, rooted at its base model
+                for ad in sorted(getattr(self.models[n], "adapters", {}) or {}):
+                    data.append({"id": ad, "object": "model",
+                                 "owned_by": "kubeflow-tpu", "root": n})
+            h._send(200, {"object": "list", "data": data})
         elif path.startswith("/v1/models/"):
             name = path[len("/v1/models/"):]
             m = self.models.get(name)
@@ -313,6 +319,19 @@ class ModelServer:
         if name is None and len(self.models) == 1:
             name = next(iter(self.models))
         m = self.models.get(name)
+        adapter = None
+        if m is None and name:
+            # multi-LoRA: an adapter id is addressable as its own model —
+            # bare ("my-adapter") or qualified ("base:my-adapter")
+            base, _, ad = name.partition(":")
+            cand = self.models.get(base)
+            if cand is not None and ad in (getattr(cand, "adapters", {}) or {}):
+                m, adapter = cand, ad
+            else:
+                for cand in self.models.values():
+                    if name in (getattr(cand, "adapters", {}) or {}):
+                        m, adapter = cand, name
+                        break
         if m is None or getattr(m, "generate", None) is None:
             h._send(404, {"error": {
                 "message": f"model {name!r} not found or not generative",
@@ -355,7 +374,8 @@ class ModelServer:
                         f"got {max_tokens!r}")
             return
         payload = {"text_input": prompt,
-                   "parameters": {"max_tokens": max_tokens}}
+                   "parameters": {"max_tokens": max_tokens,
+                                  "adapter": adapter}}
         headers = dict(h.headers.items())
         oid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:24]}"
         obj = "chat.completion" if chat else "text_completion"
