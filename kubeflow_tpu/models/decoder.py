@@ -5,9 +5,10 @@ engine (serving/engine/model.py owns the paged-decode path; this module owns
 training): importing the same init/forward keeps the fine-tune→deploy
 pipeline honest — the weights trained here serve there unchanged.
 
-``gemma_7b``-class configs map onto the same block family (Gemma's GeGLU ≈
-SwiGLU at this granularity; head/ff dims differ per config) — what the
-Pipelines Gemma benchmark (BASELINE.json config[4]) fine-tunes.
+``gemma_7b`` uses the EXACT Gemma-1 semantics (r4): GeGLU activation,
+sqrt(d_model) input-embedding scaling, decoupled head_dim=256 — the same
+config flags hf_convert sets for real Gemma checkpoints, so the Pipelines
+Gemma benchmark (BASELINE.json config[4]) fine-tunes the true block.
 """
 
 from __future__ import annotations
@@ -35,6 +36,8 @@ def gemma_7b() -> DecoderConfig:
     return DecoderConfig(
         vocab_size=256128, d_model=3072, n_layers=28, n_heads=16,
         n_kv_heads=16, d_ff=24576, rope_theta=10000.0,
+        head_dim_override=256, act="gelu_tanh", scale_embed=True,
+        norm_eps=1e-6,
     )
 
 
@@ -56,7 +59,9 @@ def lm_loss(params: dict, config: DecoderConfig, tokens: jax.Array) -> jax.Array
 def train_flops(config: DecoderConfig, batch: int, seq_len: int) -> float:
     """6·N·D matmul FLOPs (fwd+bwd) + attention term, for MFU accounting."""
     n = config.param_count() - config.vocab_size * config.d_model  # embed lookup is free
-    attn = config.n_layers * 2 * seq_len * config.d_model  # per token QK^T+PV
+    # per token QK^T+PV over the ATTENTION width (n_heads*head_dim — not
+    # d_model: gemma-7b decouples them, 4096 vs 3072)
+    attn = config.n_layers * 2 * seq_len * config.n_heads * config.head_dim
     return 6 * batch * seq_len * (n + attn / 2)
 
 

@@ -29,6 +29,12 @@ import sys
 import numpy as np
 
 _LLAMA_TYPES = {"llama", "mistral"}
+# Gemma-1: same projection layout, three block deltas the engine's config
+# flags express (GeGLU activation, sqrt(d_model) input-embedding scaling,
+# decoupled head_dim) plus (1+w) norms folded into the weights at
+# conversion.  gemma2/gemma3 add softcapping / sliding-window / extra
+# norms the engine does NOT implement — rejected, not approximated.
+_GEMMA_TYPES = {"gemma"}
 
 
 def is_hf_config(raw: dict) -> bool:
@@ -57,12 +63,13 @@ def hf_dir_needs_conversion(model_dir: str) -> bool:
 
 def _map_config(raw: dict) -> dict:
     mt = raw.get("model_type", "")
-    if mt not in _LLAMA_TYPES:
+    if mt not in _LLAMA_TYPES | _GEMMA_TYPES:
         raise ValueError(
             f"unsupported model_type {mt!r}: the engine decoder implements "
-            f"the Llama block (RMSNorm+RoPE+SwiGLU); supported: "
-            f"{sorted(_LLAMA_TYPES)}.  Models with different block math "
-            "(gemma, phi, ...) must not be silently mis-converted.")
+            f"the Llama block (+ Gemma-1's flagged deltas); supported: "
+            f"{sorted(_LLAMA_TYPES | _GEMMA_TYPES)}.  Models with different "
+            "block math (gemma2's softcapping, phi's partial rotary, ...) "
+            "must not be silently mis-converted.")
     if raw.get("rope_scaling"):
         # llama-3.1+ long-context scaling changes the RoPE frequencies; the
         # engine applies plain theta-RoPE, so converting would produce
@@ -70,16 +77,10 @@ def _map_config(raw: dict) -> dict:
         raise ValueError(
             f"rope_scaling={raw['rope_scaling']!r} is not implemented in "
             "the engine's RoPE; refusing to convert to silently-wrong "
-            "frequencies (base Llama-3 / Llama-2 / Mistral configs work)")
+            "frequencies (base Llama-3 / Llama-2 / Mistral / Gemma work)")
     implied_hd = raw["hidden_size"] // raw["num_attention_heads"]
     explicit_hd = raw.get("head_dim") or implied_hd  # None = derive
-    if explicit_hd != implied_hd:
-        # e.g. Mistral-Nemo: head_dim=128 with hidden 5120 / 32 heads = 160
-        raise ValueError(
-            f"explicit head_dim={explicit_hd} != hidden_size/"
-            f"num_attention_heads={implied_hd}; the engine derives head_dim "
-            "from the quotient, so this checkpoint cannot be mapped")
-    return {
+    out = {
         "vocab_size": raw["vocab_size"],
         "d_model": raw["hidden_size"],
         "n_layers": raw["num_hidden_layers"],
@@ -90,6 +91,23 @@ def _map_config(raw: dict) -> dict:
         "rope_theta": float(raw.get("rope_theta", 10000.0)),
         "norm_eps": float(raw.get("rms_norm_eps", 1e-5)),
     }
+    if mt in _GEMMA_TYPES:
+        # only the tanh-approx GeLU is implemented: explicit
+        # hidden_activation="gelu" (erf) or "gelu_new" would silently
+        # diverge if mapped onto tanh — reject, never approximate.
+        # (hidden_activation unset means transformers forces
+        # gelu_pytorch_tanh regardless of the legacy hidden_act field.)
+        act = raw.get("hidden_activation")
+        if act not in (None, "gelu_pytorch_tanh"):
+            raise ValueError(f"gemma hidden_activation {act!r} is not the "
+                             "tanh-approx GeLU the engine implements")
+        out.update(head_dim_override=explicit_hd, act="gelu_tanh",
+                   scale_embed=True)
+    elif explicit_hd != implied_hd:
+        # Mistral-Nemo-class: head_dim decoupled from hidden/heads (e.g.
+        # 128 with 5120/32=160) — expressible since head_dim_override
+        out["head_dim_override"] = explicit_hd
+    return out
 
 
 class _LazyTensors:
@@ -192,9 +210,14 @@ def convert_hf_checkpoint(src_dir: str, out_dir: str,
             for l in range(cfg["n_layers"])])
         gc.collect()
     out["ln_out"] = grab("model.norm.weight")
+    if raw.get("model_type") in _GEMMA_TYPES:
+        # gemma's RMSNorm multiplies by (1 + w); folding the +1 into the
+        # stored weights keeps the runtime norm shared with llama
+        for k in ("ln_attn", "ln_mlp", "ln_out"):
+            out[k] = (out[k].astype(np.float32) + 1.0).astype(store)
     if "lm_head.weight" in tensors:
         out["unembed"] = grab("lm_head.weight", transpose=True)
-    else:  # tied embeddings (llama3.2-1b style, and most tiny test configs)
+    else:  # tied embeddings (gemma, llama3.2-1b, and most tiny test configs)
         out["unembed"] = out["embed"].T.copy()
     leftovers = [n for n in tensors.remaining() if "rotary_emb" not in n]
     if leftovers:

@@ -40,10 +40,20 @@ class DecoderConfig:
     d_ff: int = 688
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
+    # Gemma-family deltas from the Llama block (hf_convert maps them):
+    # explicit head_dim (Gemma-7B: 256 with d_model 3072 / 16 heads —
+    # decoupled from the quotient); MLP activation ("silu" = SwiGLU,
+    # "gelu_tanh" = Gemma's GeGLU); sqrt(d_model) input-embedding scaling
+    # (can't be folded into the table — Gemma ties embed and unembed, and
+    # only the INPUT side scales).  Gemma's (1 + w) RMSNorm is folded into
+    # the norm weights at conversion, so the runtime norm stays shared.
+    head_dim_override: int = 0  # 0 = d_model // n_heads
+    act: str = "silu"
+    scale_embed: bool = False
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @staticmethod
     def llama3_8b() -> "DecoderConfig":
@@ -242,6 +252,17 @@ def _embed_rows(p, tokens):
     return p[tokens]
 
 
+def _embed(params, config, tokens):
+    """Input embedding incl. Gemma's sqrt(d_model) input-side scaling
+    (runtime, not folded: the table is tied to the unscaled unembed)."""
+    x = _embed_rows(params["embed"], tokens)
+    if config.scale_embed:
+        # weak-typed Python float: a np.float32 scalar would promote the
+        # whole forward to f32 activations (bf16 is the design dtype)
+        x = x * float(np.sqrt(config.d_model))
+    return x
+
+
 def _rms_norm(x, scale, eps):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale
@@ -300,8 +321,14 @@ def _block_with(params, l, config, x, positions, attend, lora=None):
     attn = attend(q)
     x = x + _proj(params, l, "wo", attn.reshape(B, S, -1), lora)
     h = _rms_norm(x, params["ln_mlp"][l], c.norm_eps)
+    if c.act == "silu":
+        act = jax.nn.silu
+    elif c.act == "gelu_tanh":
+        act = functools.partial(jax.nn.gelu, approximate=True)
+    else:  # trace-time: a typo'd config must not silently serve wrong math
+        raise ValueError(f"unknown act {c.act!r} (silu | gelu_tanh)")
     x = x + _proj(params, l, "w2",
-                  jax.nn.silu(_proj(params, l, "w1", h, lora))
+                  act(_proj(params, l, "w1", h, lora))
                   * _proj(params, l, "w3", h, lora), lora)
     return x
 
@@ -402,7 +429,7 @@ def prefill(params, config: DecoderConfig, tokens, length, page_size: int,
     B, S = tokens.shape
     lora = None if lora_params is None else (lora_params, adapter_ids)
     positions = jnp.arange(S, dtype=jnp.int32)[None, :]
-    x = _embed_rows(params["embed"], tokens)
+    x = _embed(params, c, tokens)
     causal = jnp.tril(jnp.ones((S, S), bool))[None]
     valid = (positions < length)[:, None, :]
     mask = causal & valid
@@ -466,7 +493,7 @@ def prefill_chunk(params, config: DecoderConfig, tokens, start, length,
     H = hist_page_ids.shape[0]
     T = H * page_size
     positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]
-    x = _embed_rows(params["embed"], tokens)
+    x = _embed(params, c, tokens)
     t_range = jnp.arange(T, dtype=jnp.int32)
     # causal across chunks + clipped to the real prompt
     mask = (t_range[None, None, :] <= positions[:, :, None]) & (t_range < length)[None, None, :]
@@ -540,7 +567,7 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
     pos = jnp.maximum(seq_lens - 1, 0)  # current token's position
     positions = pos[:, None]
 
-    x = _embed_rows(params["embed"], tokens)[:, None, :]  # [B, 1, D]
+    x = _embed(params, c, tokens)[:, None, :]  # [B, 1, D]
     t_range = jnp.arange(T, dtype=jnp.int32)
     mask = (t_range[None, :] < seq_lens[:, None])[:, None, :]  # [B, 1, T]
 
@@ -612,7 +639,7 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
     pos0 = jnp.maximum(seq_lens - 1, 0)
     positions = pos0[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]  # [B, K]
 
-    x = _embed_rows(params["embed"], tokens)  # [B, K, D]
+    x = _embed(params, c, tokens)  # [B, K, D]
     t_range = jnp.arange(T, dtype=jnp.int32)
     # causal over history + this chunk's own tokens (their KV is written
     # below before attention reads the gathered cache)
@@ -664,7 +691,7 @@ def forward_full(params, config: DecoderConfig, tokens,
     B, S = tokens.shape
     lora = None if lora_params is None else (lora_params, adapter_ids)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-    x = _embed_rows(params["embed"], tokens)
+    x = _embed(params, c, tokens)
     mask = jnp.tril(jnp.ones((S, S), bool))[None].repeat(B, 0)
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)

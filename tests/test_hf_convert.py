@@ -57,22 +57,62 @@ def test_converted_logits_match_transformers(tmp_path, tie):
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # compile + transformers forward; llama parity covers fast
+def test_gemma_converted_logits_match_transformers(tmp_path):
+    """Gemma-1's block deltas (GeGLU, +1 norms folded at conversion,
+    sqrt(d_model) input scaling, decoupled head_dim, tied embeddings) must
+    reproduce transformers' forward — the oracle that catches a missed
+    delta, which would serve silently-wrong real Gemma checkpoints."""
+    import torch
+
+    from kubeflow_tpu.serving.engine import model as M
+    from kubeflow_tpu.serving.engine.hf_convert import convert_hf_checkpoint
+
+    cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16,  # decoupled: 48/4 = 12 != 16
+        rope_theta=10000.0, rms_norm_eps=1e-6)
+    torch.manual_seed(0)
+    hf = transformers.GemmaForCausalLM(cfg).eval()
+    src = tmp_path / "gemma"
+    hf.save_pretrained(src)
+
+    out = tmp_path / "engine"
+    cfg_dict = convert_hf_checkpoint(str(src), str(out), dtype="float32")
+    assert cfg_dict["head_dim_override"] == 16
+    assert cfg_dict["act"] == "gelu_tanh" and cfg_dict["scale_embed"] is True
+
+    config = M.DecoderConfig.from_dir(str(out))
+    assert config.head_dim == 16
+    params = {k: jnp.asarray(v, jnp.float32)
+              for k, v in np.load(out / "params.npz").items()}
+
+    toks = np.array([[5, 17, 99, 3, 42, 7]], np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(toks)).logits.numpy()
+    got = np.asarray(M.forward_full(params, config,
+                                    jnp.asarray(toks, jnp.int32)))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
 def test_rejects_non_llama_architectures(tmp_path):
     from kubeflow_tpu.serving.engine.hf_convert import convert_hf_checkpoint
 
-    d = tmp_path / "gemma"
+    d = tmp_path / "gemma2"
     d.mkdir()
     (d / "config.json").write_text(json.dumps(
-        {"model_type": "gemma", "vocab_size": 10, "hidden_size": 8}))
-    with pytest.raises(ValueError, match="gemma"):
+        {"model_type": "gemma2", "vocab_size": 10, "hidden_size": 8}))
+    with pytest.raises(ValueError, match="gemma2"):
         convert_hf_checkpoint(str(d), str(tmp_path / "out"))
 
 
-def test_rejects_rope_scaling_and_mismatched_head_dim(tmp_path):
-    """Llama-3.1+ rope_scaling and Mistral-Nemo-style explicit head_dim
-    change the math the engine runs — converting would serve numerically
-    wrong generations with no error, so both must raise."""
-    from kubeflow_tpu.serving.engine.hf_convert import convert_hf_checkpoint
+def test_rope_scaling_rejected_but_decoupled_head_dim_maps(tmp_path):
+    """Llama-3.1+ rope_scaling changes math the engine doesn't implement —
+    it must raise.  Mistral-Nemo-style explicit head_dim IS expressible
+    (head_dim_override) and maps instead of rejecting."""
+    from kubeflow_tpu.serving.engine.hf_convert import (_map_config,
+                                                        convert_hf_checkpoint)
 
     base = {"model_type": "llama", "vocab_size": 64, "hidden_size": 32,
             "num_hidden_layers": 1, "num_attention_heads": 4,
@@ -84,11 +124,8 @@ def test_rejects_rope_scaling_and_mismatched_head_dim(tmp_path):
     with pytest.raises(ValueError, match="rope_scaling"):
         convert_hf_checkpoint(str(d1), str(tmp_path / "o1"))
 
-    d2 = tmp_path / "nemo"
-    d2.mkdir()
-    (d2 / "config.json").write_text(json.dumps(dict(base, head_dim=16)))
-    with pytest.raises(ValueError, match="head_dim"):
-        convert_hf_checkpoint(str(d2), str(tmp_path / "o2"))
+    mapped = _map_config(dict(base, head_dim=16))  # 32/4 = 8 != 16
+    assert mapped["head_dim_override"] == 16
 
 
 def test_from_dir_refuses_raw_hf_config(tmp_path):
