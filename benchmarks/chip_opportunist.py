@@ -129,6 +129,12 @@ JOBS = [
     {"name": "mfu_save_mlp_384",
      "cmd": SWEEP + ["384", "128", "1", "save_mlp", "dense", "8"],
      "timeout": 540, "first_timeout": 240},
+    # 12. multi-LoRA mixed-batch overhead on chip (r4 feature): 1b config,
+    #     4 adapters round-robin vs the plain 1b row above
+    {"name": "serving_1b_lora4",
+     "cmd": _serving_cmd("1b", ["--kv-quant", "int8", "--adapters", "4",
+                                "--requests", "48", "--concurrency", "8"]),
+     "timeout": 1500, "first_timeout": 900},
 ]
 
 

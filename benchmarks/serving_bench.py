@@ -65,6 +65,10 @@ def main() -> None:
     p.add_argument("--qps", type=float, default=0.0,
                    help="open-loop arrival rate (BASELINE protocol: 'p50 at "
                         "fixed QPS after warmup'); 0 = closed-loop burst")
+    p.add_argument("--adapters", type=int, default=0,
+                   help="multi-LoRA: N random rank-16 adapters over wq/wv; "
+                        "requests round-robin base+adapters, so the run "
+                        "measures the mixed-batch rank-r overhead")
     args = p.parse_args()
 
     import jax
@@ -87,6 +91,29 @@ def main() -> None:
         params = init_int8(jax.random.PRNGKey(0), config)
     else:
         params = init(jax.random.PRNGKey(0), config)
+    lora = None
+    if args.adapters:
+        # random rank-16 q/v adapters (the PEFT default targets); the values
+        # don't matter for throughput — the per-row gather + two rank-r
+        # matmuls per projection are the measured cost
+        import jax.numpy as jnp
+
+        rank, hd = 16, config.head_dim
+        kq, kv_ = jax.random.split(jax.random.PRNGKey(7))
+        table = {}
+        for name, dout, key in (("wq", config.n_heads * hd, kq),
+                                ("wv", config.n_kv_heads * hd, kv_)):
+            ka, kb = jax.random.split(key)
+            A = jax.random.normal(ka, (args.adapters + 1, config.n_layers,
+                                       config.d_model, rank),
+                                  jnp.bfloat16) * 0.01
+            B = jax.random.normal(kb, (args.adapters + 1, config.n_layers,
+                                       rank, dout), jnp.bfloat16) * 0.01
+            # row 0 is the engine's reserved "no adapter" slot: it MUST be
+            # zeros or the bench's base-labeled requests decode with a
+            # random delta (lora.py contract)
+            table[name] = {"A": A.at[0].set(0.0), "B": B.at[0].set(0.0)}
+        lora = (table, {f"ad{i}": i for i in range(1, args.adapters + 1)})
     engine = Engine(
         params, config,
         EngineConfig(max_slots=args.concurrency, num_pages=1024, page_size=32,
@@ -95,6 +122,7 @@ def main() -> None:
                      paged_kernel=args.paged_kernel or None,
                      kv_quant=args.kv_quant, weight_quant=args.weight_quant,
                      speculative=args.speculative),
+        lora=lora,
     )
     engine.start()
     rng = np.random.default_rng(0)
@@ -129,7 +157,9 @@ def main() -> None:
             now = time.perf_counter()
             if target > now:
                 time.sleep(target - now)
-        futs.append(engine.generate_async(prompt(i), args.max_tokens))
+        j = i % (args.adapters + 1) if args.adapters else 0
+        futs.append(engine.generate_async(prompt(i), args.max_tokens,
+                                          adapter=f"ad{j}" if j else None))
     results = [f.result(timeout=1800) for f in futs]
     wall = time.perf_counter() - t0
     final_stats = engine.stats  # before stop(): close() frees the C core
@@ -161,6 +191,7 @@ def main() -> None:
         "shared_prefix_frac": args.shared_prefix_frac,
         "prefix_cache": final_stats,
         "qps": args.qps,
+        "adapters": args.adapters,
         "platform": jax.devices()[0].platform,
         "on_tpu": on_tpu,
         # BASELINE protocol is >=1k requests at fixed QPS after warmup; a

@@ -10,13 +10,17 @@ serves" — serve.py auto-converts on load when it finds an HF-format
 config.json without engine params.
 
 Scope: Llama-architecture models (llama / llama2 / llama3 / mistral —
-RMSNorm + RoPE + SwiGLU + optional GQA).  The engine's decoder
-(model._block_with) IS this architecture, so conversion is a pure weight
-relayout: HF stores projections as [out, in] torch tensors; the engine
-right-multiplies, so every projection transposes, and per-layer tensors
-stack into one [L, ...] array (jit-friendly: one HBM buffer per name).
-Architectures with different block math (gemma's +1 norms, phi's partial
-rotary) are rejected loudly rather than converted wrong.
+RMSNorm + RoPE + SwiGLU + optional GQA, incl. Nemo-style decoupled
+head_dim) and Gemma-1, whose block deltas the engine's config flags
+express (GeGLU via act="gelu_tanh", sqrt(d_model) input-embedding
+scaling, explicit head_dim) with the (1+w) norms folded into the stored
+weights here.  Conversion is otherwise a pure weight relayout: HF stores
+projections as [out, in] torch tensors; the engine right-multiplies, so
+every projection transposes, and per-layer tensors stack into one
+[L, ...] array (jit-friendly: one HBM buffer per name).  Architectures
+with block math the engine does NOT implement (gemma-2/3 softcapping,
+phi's partial rotary, rope_scaling, non-tanh GeLU) are rejected loudly
+rather than converted wrong.
 """
 
 from __future__ import annotations
