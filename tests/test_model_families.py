@@ -106,8 +106,17 @@ def test_resnet_ddp_worker_runs_multiprocess(tmp_path):
 # ------------------------------------------------------------------ decoder
 
 
-def test_decoder_lm_learns():
+@pytest.mark.parametrize("family", ["llama", "gemma"])
+def test_decoder_lm_learns(family):
     config = decoder.tiny()
+    if family == "gemma":
+        # the gemma-flagged block (GeGLU + input-embedding scaling +
+        # decoupled head_dim) must TRAIN, not just serve — the fine-tune→
+        # deploy pipeline runs this exact config family
+        import dataclasses
+
+        config = dataclasses.replace(config, act="gelu_tanh",
+                                     scale_embed=True, head_dim_override=24)
     params = decoder.init(jax.random.PRNGKey(0), config)
     opt = optax.adamw(3e-3)
     opt_state = opt.init(params)
