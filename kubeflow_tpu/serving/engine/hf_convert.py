@@ -95,6 +95,11 @@ def _map_config(raw: dict) -> dict:
         "rope_theta": float(raw.get("rope_theta", 10000.0)),
         "norm_eps": float(raw.get("rms_norm_eps", 1e-5)),
     }
+    if "eos_token_id" in raw:
+        # passthrough: DecoderConfig ignores it, but serve.py's EOS
+        # fallback reads it — conversion overwrites the HF config.json,
+        # so a checkout declaring eos only there must not lose it
+        out["eos_token_id"] = raw["eos_token_id"]
     if mt in _GEMMA_TYPES:
         # only the tanh-approx GeLU is implemented: explicit
         # hidden_activation="gelu" (erf) or "gelu_new" would silently

@@ -93,6 +93,27 @@ def load_tokenizer(model_dir: str):
     return ByteTokenizer()
 
 
+def _checkout_eos_id(model_dir: str):
+    """The checkpoint's declared end-of-sequence token id, if any:
+    generation_config.json first (transformers' generate source of truth),
+    else the HF config.json.  A list (multi-EOS models) uses the first id
+    — the engine stops on one token."""
+    for fname in ("generation_config.json", "config.json"):
+        path = os.path.join(model_dir, fname) if model_dir else ""
+        if not path or not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                eos = json.load(f).get("eos_token_id")
+        except (OSError, ValueError):
+            continue
+        if isinstance(eos, list) and eos:
+            eos = eos[0]
+        if isinstance(eos, int) and eos >= 0:
+            return eos
+    return None
+
+
 class JetStreamModel(Model):
     """kserve-style Model serving generate() from the TPU engine."""
 
@@ -121,13 +142,23 @@ class JetStreamModel(Model):
             lora = (lora_params, adapter_ids) if lora_params is not None else None
             ec = EngineConfig()
             path = os.path.join(self.model_dir, "engine.json")
+            import dataclasses
+
+            eos_explicit = False
             if self.model_dir and os.path.exists(path):
                 with open(path) as f:
                     raw = json.load(f)
-                import dataclasses
-
                 fields = {f.name for f in dataclasses.fields(EngineConfig)}
                 ec = EngineConfig(**{k: v for k, v in raw.items() if k in fields})
+                # an operator's explicit eos_id — INCLUDING -1 "never stop
+                # early" — must win over the checkout's declaration
+                eos_explicit = "eos_id" in raw
+            if not eos_explicit:
+                # real checkouts declare their stop token; without it every
+                # generation runs to max_tokens past the model's own end
+                eos = _checkout_eos_id(self.model_dir)
+                if eos is not None:
+                    ec = dataclasses.replace(ec, eos_id=eos)
             self.engine = Engine(params, config, ec, lora=lora)
         self.engine.start()
         self.ready = True

@@ -1361,3 +1361,57 @@ def test_speculative_drafts_cross_page_boundaries(params):
     assert rc == 1 and new_page >= 1       # next page allocated normally
     eng.batcher.release(slot)
     eng.stop()
+
+
+def test_engine_stops_at_eos(params):
+    """eos_id must end a generation early: pick the token greedy actually
+    emits at step 2 as the eos, and the run must stop right there instead
+    of generating to max_tokens."""
+    prompt = [5, 7, 9, 11]
+    oracle = greedy_oracle(params, prompt, 5)
+    eng = Engine(params, CFG, EngineConfig(max_slots=1, num_pages=32,
+                                           page_size=8, max_pages_per_slot=8,
+                                           eos_id=oracle[1]))
+    eng.start()
+    try:
+        out = eng.generate(prompt, 5)
+        assert out["tokens"] == oracle[:2]  # eos token included, then stop
+        assert out["num_tokens"] == 2 < 5
+    finally:
+        eng.stop()
+
+
+def test_jetstream_reads_checkout_eos(tmp_path):
+    """A real checkout's generation_config.json declares the stop token;
+    the runtime must apply it unless engine.json explicitly set one."""
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+
+    md = tmp_path / "m"
+    md.mkdir()
+    (md / "config.json").write_text(json.dumps(
+        {"vocab_size": 101, "d_model": 64, "n_layers": 2, "n_heads": 4,
+         "n_kv_heads": 2, "d_ff": 128}))
+    (md / "engine.json").write_text(json.dumps(
+        {"max_slots": 1, "num_pages": 32, "page_size": 8,
+         "max_pages_per_slot": 8}))
+    (md / "generation_config.json").write_text(json.dumps(
+        {"eos_token_id": 2, "bos_token_id": 1}))
+    m = JetStreamModel("llm", model_dir=str(md))
+    m.load()
+    try:
+        assert m.engine.ec.eos_id == 2
+    finally:
+        m.engine.stop()
+
+    # engine.json's explicit eos wins over the checkout's — INCLUDING an
+    # explicit -1 ("never stop early", e.g. fixed-length benchmarking)
+    for explicit in (7, -1):
+        (md / "engine.json").write_text(json.dumps(
+            {"max_slots": 1, "num_pages": 32, "page_size": 8,
+             "max_pages_per_slot": 8, "eos_id": explicit}))
+        m2 = JetStreamModel(f"llm{explicit}", model_dir=str(md))
+        m2.load()
+        try:
+            assert m2.engine.ec.eos_id == explicit
+        finally:
+            m2.engine.stop()
