@@ -10,13 +10,16 @@ Run: python -m kubeflow_tpu.examples.serve_llm [--tensor-parallel N]
 CPU-safe: uses a tiny random-weight decoder; on a slice, point model_dir at
 real Llama/Gemma weights (params.npz) and size engine.json accordingly.
 
-Real checkpoints: a raw HuggingFace Llama-family checkout (safetensors +
-HF config.json + tokenizer.json, i.e. a local `meta-llama/Meta-Llama-3-8B`
-snapshot) needs NO preprocessing — point ``storage_uri`` at the directory
-and the JetStream runtime converts the weights to engine params on first
-load (``engine/hf_convert.py``) and tokenizes with the checkpoint's own
-tokenizer.  The OpenAI-compatible surface is served through the same
-ingress: POST ``{url}/openai/v1/chat/completions`` (unary or SSE).
+Real checkpoints: a raw HuggingFace checkout (safetensors + HF
+config.json + tokenizer.json — Llama/Mistral or Gemma-1, i.e. a local
+`meta-llama/Meta-Llama-3-8B` snapshot) needs NO preprocessing — point
+``storage_uri`` at the directory and the JetStream runtime converts the
+weights to engine params on first load (``engine/hf_convert.py``),
+tokenizes with the checkpoint's own tokenizer, and stops at its declared
+EOS token.  PEFT LoRA checkouts dropped under ``<model_dir>/adapters/``
+serve as their own OpenAI model ids (multi-LoRA, ``engine/lora.py``).
+The OpenAI-compatible surface is served through the same ingress:
+POST ``{url}/openai/v1/chat/completions`` (unary or SSE).
 """
 
 from __future__ import annotations
