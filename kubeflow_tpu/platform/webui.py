@@ -272,6 +272,8 @@ class DashboardWebUI:
             if parts[3] == "new":
                 return self._experiment_form(user, parts[1])
             return self._experiment(user, parts[1], parts[3])
+        if len(parts) == 4 and parts[0] == "ns" and parts[2] == "isvc":
+            return self._isvc(user, parts[1], parts[3])
         if path == "/pipelines" and self.pipelines is not None:
             return self._pipelines(user)
         if path == "/compare" and self.pipelines is not None:
@@ -310,17 +312,22 @@ class DashboardWebUI:
         summary = self.dashboard.summary(ns)
         quota = self.dashboard.quota(ns)
         activity = self.dashboard.activity(ns)
+        def name_cell(kind, i):
+            if kind == "Experiment" and self.katib is not None:
+                return (f"<a href='/ns/{_esc(ns)}/experiments/"
+                        f"{_esc(i['name'])}'>{_esc(i['name'])}</a>")
+            if kind == "InferenceService":
+                return (f"<a href='/ns/{_esc(ns)}/isvc/"
+                        f"{_esc(i['name'])}'>{_esc(i['name'])}</a>")
+            return _esc(i["name"])
+
         sections = []
         for kind, info in summary["resources"].items():
             if kind == "Notebook":
                 sections.append(self._notebook_section(ns, info))
                 continue
             rows = "".join(
-                "<tr><td>" + (
-                    f"<a href='/ns/{_esc(ns)}/experiments/{_esc(i['name'])}'>"
-                    f"{_esc(i['name'])}</a>" if kind == "Experiment"
-                    and self.katib is not None else _esc(i["name"]))
-                + f"</td>{_phase_cell(i['phase'])}</tr>"
+                f"<tr><td>{name_cell(kind, i)}</td>{_phase_cell(i['phase'])}</tr>"
                 for i in info["items"])
             new_link = (f" <a href='/ns/{_esc(ns)}/experiments/new'>new</a>"
                         if kind == "Experiment" and self.katib is not None
@@ -344,6 +351,48 @@ class DashboardWebUI:
                             "<th>object</th><th>reason</th><th>message</th>"
                             f"</tr>{arows}</table>")
         return _page(f"Namespace {ns}", "".join(sections))
+
+    def _isvc(self, user: str, ns: str, name: str) -> Optional[bytes]:
+        """InferenceService detail — what upstream's KServe models-web-app
+        shows: per-component status with revisions and the canary traffic
+        split, conditions, and the serving URLs (SURVEY §2a KServe rows)."""
+        self._authz(user, "get", "InferenceService", ns)
+        isvc = self.dashboard.api.try_get("InferenceService", name, ns)
+        if isvc is None:
+            return None
+        spec, status = isvc.get("spec", {}), isvc.get("status", {})
+        sections = []
+        urls = "".join(
+            f"<tr><td>{_esc(label)}</td><td>{_esc(url)}</td></tr>"
+            for label, url in (("external", status.get("url")),
+                               ("in-cluster", (status.get("address") or {}).get("url")))
+            if url)
+        if urls:
+            sections.append(f"<h2>URLs</h2><table>{urls}</table>")
+        for comp, info in (status.get("components") or {}).items():
+            cspec = spec.get(comp, {})
+            model = cspec.get("model", {})
+            head = (f"<h2>{_esc(comp)}</h2><p>format "
+                    f"<b>{_esc(model.get('modelFormat', {}).get('name', '-'))}</b>"
+                    f" · storage <code>{_esc(model.get('storageUri', '-'))}</code>"
+                    f" · ready revision <code>"
+                    f"{_esc(info.get('latestReadyRevision') or '-')}</code></p>")
+            trows = "".join(
+                f"<tr><td><code>{_esc(t['revisionName'])}</code></td>"
+                f"<td>{t['percent']}%</td>"
+                f"<td>{'latest' if t.get('latestRevision') else ''}</td></tr>"
+                for t in info.get("traffic", []))
+            table = (f"<table><tr><th>revision</th><th>traffic</th><th></th>"
+                     f"</tr>{trows}</table>" if trows else "")
+            sections.append(head + table)
+        crows = "".join(
+            f"<tr><td>{_esc(c['type'])}</td>{_phase_cell(c['status'])}"
+            f"<td>{_esc(c.get('reason', ''))}</td></tr>"
+            for c in status.get("conditions", []))
+        if crows:
+            sections.append("<h2>Conditions</h2><table><tr><th>type</th>"
+                            f"<th>status</th><th>reason</th></tr>{crows}</table>")
+        return _page(f"InferenceService {name}", "".join(sections))
 
     def _notebook_section(self, ns: str, info: dict) -> str:
         """Notebook rows with the culling status column upstream's

@@ -788,3 +788,55 @@ def test_prefix_affinity_covers_openai_payloads():
     # no extractable prefix -> no affinity (falls back to load/round-robin)
     assert pick(ports, json.dumps({"messages": []}).encode()) is None
     assert pick(ports, json.dumps({"max_tokens": 4}).encode()) is None
+
+
+def test_webui_isvc_detail_page(scluster):
+    """The web shell's InferenceService detail view (upstream models-web-app
+    capability): URLs, per-component revisions + traffic split, conditions —
+    RBAC'd; namespace page links to it; unknown names 404."""
+    import urllib.error
+
+    from kubeflow_tpu.platform import api as papi
+    from kubeflow_tpu.platform.controllers import install as platform_install
+    from kubeflow_tpu.platform.webui import DashboardWebUI
+
+    c, router, tmp_path = scluster
+    platform_install(c.api, c.manager)
+    c.apply(papi.profile("ml", "serve@x.io", {"cpu": "8"}))
+    c.settle(quiet=0.3)
+
+    model_dir = _write_pyfunc_model(tmp_path, "m1", factor=2)
+    c.apply(inference_service("web-llm", model_format="pyfunc",
+                              storage_uri=f"file://{model_dir}", namespace="ml"))
+
+    def ready():
+        st = (c.api.try_get("InferenceService", "web-llm", "ml") or {}).get("status", {})
+        return any(x["type"] == "Ready" and x["status"] == "True"
+                   for x in st.get("conditions", []))
+    assert c.wait_for(ready, timeout=120)
+
+    ui = DashboardWebUI(c.api)
+    try:
+        def get(path, user="serve@x.io"):
+            req = urllib.request.Request(ui.url + path,
+                                         headers={"kubeflow-userid": user})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.read().decode()
+
+        ns_page = get("/ns/ml")
+        assert "/ns/ml/isvc/web-llm" in ns_page  # linked from the listing
+
+        page = get("/ns/ml/isvc/web-llm")
+        assert "predictor" in page and "pyfunc" in page
+        assert "100%" in page           # single revision holds all traffic
+        assert "Ready" in page          # conditions table
+        assert "in-cluster" in page     # address url row
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/ns/ml/isvc/nope")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/ns/ml/isvc/web-llm", user="eve@x.io")
+        assert e.value.code == 403
+    finally:
+        ui.shutdown()
