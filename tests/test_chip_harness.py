@@ -151,3 +151,38 @@ def test_cache_headline_is_seq128_but_best_mfu_is_any_shape(monkeypatch):
     assert bench._chip_cache_best()["seq"] == 128
     assert bench._chip_cache_best()["samples_per_sec_per_chip"] == 1341.0
     assert bench._chip_cache_best_mfu()["mfu"] == 0.58
+
+
+def test_cache_rejects_records_from_edited_measured_path(monkeypatch):
+    """A cache record stamped with a code_sha is replayable ONLY while the
+    measured path still hashes to it — editing bert/trainer/mfu_sweep must
+    void old chip numbers mechanically, however fresh their timestamp."""
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    good = {"batch": 512, "seq": 128, "remat": 1, "policy": "save_attn",
+            "attn": "dense", "mfu": 0.5, "samples_per_sec_per_chip": 1000.0,
+            "step_time_ms": 300.0, "platform": "tpu", "measured_at": now,
+            "code_sha": bench.measured_code_sha()}
+    stale = dict(good, code_sha="deadbeefdeadbeef", mfu=0.9,
+                 samples_per_sec_per_chip=2000.0)
+    legacy = {k: v for k, v in good.items() if k != "code_sha"}
+
+    import json as _json
+    lines = "\n".join(_json.dumps(r) for r in (stale, good, legacy)) + "\n"
+    import io
+    monkeypatch.setattr("builtins.open", _fake_open(lines))
+    recs = list(bench._chip_cache_records())
+    assert [r.get("code_sha") for r in recs] == [good["code_sha"], None]
+    assert all(r["mfu"] == 0.5 for r in recs)  # the mismatched 0.9 is out
+
+
+def _fake_open(content):
+    import builtins
+    import io
+    real = builtins.open
+
+    def fake(path, *a, **k):
+        if str(path).endswith("BENCH_CHIP_CACHE.jsonl"):
+            return io.StringIO(content)
+        return real(path, *a, **k)
+
+    return fake
