@@ -42,7 +42,7 @@ def test_bench_active_flag_and_staleness(tmp_path, monkeypatch):
 
 
 def test_drain_queue_stands_down_for_bench(tmp_path, monkeypatch):
-    """With BENCH_ACTIVE set, drain_queue must return False before touching
+    """With BENCH_ACTIVE set, drain_queue must stand down before touching
     the chip (no preflight, no job run, no attempt burned)."""
     monkeypatch.setattr(co, "STATE", str(tmp_path / "state.json"))
     monkeypatch.setattr(co, "bench_active", lambda: True)
@@ -53,7 +53,7 @@ def test_drain_queue_stands_down_for_bench(tmp_path, monkeypatch):
     monkeypatch.setattr(co, "_tpu_preflight", boom)
     monkeypatch.setattr(co, "_run", boom)
     state = {}
-    assert co.drain_queue(state) is False
+    assert co.drain_queue(state) == "paused"
     assert state == {}
 
 
@@ -72,12 +72,12 @@ def test_drain_queue_holds_lock_and_counts_attempt_only_when_running(
     state = {}
     with bench.chip_lock(wait_s=0) as held:
         assert held
-        assert co.drain_queue(state) is False
+        assert co.drain_queue(state) == "paused"
     assert state.get("j1", {}).get("attempts", 0) == 0
 
     monkeypatch.setattr(
         co, "_run", lambda cmd, t, env: (0, json.dumps({"ok": True}) + "\n", ""))
-    assert co.drain_queue(state) is True
+    assert co.drain_queue(state) == "done"
     assert state["j1"]["attempts"] == 1 and state["j1"]["done"]
 
 
@@ -98,7 +98,7 @@ def test_unwritable_lock_is_not_contention(tmp_path, monkeypatch):
     monkeypatch.setattr(
         co, "_run", lambda cmd, t, env: (0, json.dumps({"ok": True}) + "\n", ""))
     state = {}
-    assert co.drain_queue(state) is True  # proceeded despite owned=None
+    assert co.drain_queue(state) == "done"  # proceeded despite owned=None
     assert state["j1"]["done"]
 
 
@@ -130,7 +130,7 @@ def test_drain_preflight_runs_under_the_lock(tmp_path, monkeypatch):
     monkeypatch.setattr(
         co, "_run", lambda cmd, t, env: (0, json.dumps({"ok": True}) + "\n", ""))
     state = {}
-    assert co.drain_queue(state) is True
+    assert co.drain_queue(state) == "done"
     assert state["j1"]["done"]
 
 
@@ -186,3 +186,85 @@ def _fake_open(content):
         return real(path, *a, **k)
 
     return fake
+
+
+def test_sick_tunnel_refunds_attempt_and_backs_off(tmp_path, monkeypatch):
+    """VERDICT r4 #1: a job dying at its own `trivial` stage is a wedge
+    signature — the attempt is refunded (up to MAX_REFUNDS) and the drain
+    reports sick instead of burning the rest of the queue."""
+    monkeypatch.setattr(co, "STATE", str(tmp_path / "state.json"))
+    monkeypatch.setattr(co, "RESULTS", str(tmp_path / "results.jsonl"))
+    monkeypatch.setattr(bench, "CHIP_LOCK", str(tmp_path / "chip.lock"))
+    monkeypatch.setattr(co, "bench_active", lambda: False)
+    monkeypatch.setattr(co, "_tpu_preflight", lambda *a, **k: 1)
+    monkeypatch.setattr(co, "_tunnel_healthy", lambda: True)
+    monkeypatch.setattr(co, "JOBS", [
+        {"name": "j1", "cmd": ["x"], "timeout": 5},
+        {"name": "j2", "cmd": ["x"], "timeout": 5}])
+    wedge = json.dumps(
+        {"stages": [{"stage": "trivial", "ok": False, "error": "timeout"}],
+         "all_ok": False}) + "\n"
+    monkeypatch.setattr(co, "_run", lambda cmd, t, env: (1, wedge, ""))
+
+    state = {}
+    for i in range(co.MAX_REFUNDS):
+        assert co.drain_queue(state) == "sick"
+        assert state["j1"]["attempts"] == 0, "wedge must not burn an attempt"
+        assert state["j1"]["refunds"] == i + 1
+        assert "j2" not in state, "drain must stop at the wedge"
+    # refunds exhausted: the failure now charges attempts so the job can
+    # still exhaust (a deterministically-broken trivial stage, not a wedge)
+    for i in range(co.MAX_ATTEMPTS):
+        co.drain_queue(state)
+    assert state["j1"]["attempts"] == co.MAX_ATTEMPTS
+
+
+def test_health_gate_failure_is_sick_with_no_attempts(tmp_path, monkeypatch):
+    """A failed health gate (trivial compile on a live-looking tunnel) must
+    charge NOTHING and report sick before any job runs."""
+    monkeypatch.setattr(co, "STATE", str(tmp_path / "state.json"))
+    monkeypatch.setattr(co, "RESULTS", str(tmp_path / "results.jsonl"))
+    monkeypatch.setattr(bench, "CHIP_LOCK", str(tmp_path / "chip.lock"))
+    monkeypatch.setattr(co, "bench_active", lambda: False)
+    monkeypatch.setattr(co, "_tpu_preflight", lambda *a, **k: 1)
+    monkeypatch.setattr(co, "_tunnel_healthy", lambda: False)
+
+    def boom(cmd, t, env):
+        raise AssertionError("job ran despite sick tunnel")
+
+    monkeypatch.setattr(co, "_run", boom)
+    monkeypatch.setattr(co, "JOBS", [{"name": "j1", "cmd": ["x"], "timeout": 5}])
+    state = {}
+    assert co.drain_queue(state) == "sick"
+    assert state == {}
+
+
+def test_outer_timeout_with_no_output_asks_the_tunnel(tmp_path, monkeypatch):
+    """An outer-timeout kill that produced NO stage output is ambiguous
+    (hung trivial compile vs slow job) — the drain classifies it with one
+    health-gate compile: sick tunnel refunds, healthy tunnel charges."""
+    monkeypatch.setattr(co, "STATE", str(tmp_path / "state.json"))
+    monkeypatch.setattr(co, "RESULTS", str(tmp_path / "results.jsonl"))
+    monkeypatch.setattr(bench, "CHIP_LOCK", str(tmp_path / "chip.lock"))
+    monkeypatch.setattr(co, "bench_active", lambda: False)
+    monkeypatch.setattr(co, "_tpu_preflight", lambda *a, **k: 1)
+    monkeypatch.setattr(co, "JOBS", [{"name": "j1", "cmd": ["x"], "timeout": 5}])
+
+    monkeypatch.setattr(co, "_run", lambda cmd, t, env: (None, "", ""))
+    gates = {"n": 0}
+
+    def gate():
+        # first call per drain = the drain-start gate (passes); the second
+        # is the post-timeout classification (sick)
+        gates["n"] += 1
+        return gates["n"] % 2 == 1
+
+    monkeypatch.setattr(co, "_tunnel_healthy", gate)
+    state = {}
+    assert co.drain_queue(state) == "sick"
+    assert state["j1"]["attempts"] == 0 and state["j1"]["refunds"] == 1
+
+    # tunnel healthy when re-asked -> genuine slow job, attempt charged
+    monkeypatch.setattr(co, "_tunnel_healthy", lambda: True)
+    assert co.drain_queue(state) != "sick"
+    assert state["j1"]["attempts"] == 1 and state["j1"]["refunds"] == 1
