@@ -379,8 +379,8 @@ class DashboardWebUI:
                     f"{_esc(info.get('latestReadyRevision') or '-')}</code></p>")
             trows = "".join(
                 f"<tr><td><code>{_esc(t['revisionName'])}</code></td>"
-                f"<td>{t['percent']}%</td>"
-                f"<td>{'latest' if t.get('latestRevision') else ''}</td></tr>"
+                f"<td>{_esc(t['percent'])}%</td>"
+                f"<td>{_esc('latest' if t.get('latestRevision') else '')}</td></tr>"
                 for t in info.get("traffic", []))
             table = (f"<table><tr><th>revision</th><th>traffic</th><th></th>"
                      f"</tr>{trows}</table>" if trows else "")

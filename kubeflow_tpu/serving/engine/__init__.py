@@ -1,5 +1,6 @@
 """JetStream-style TPU inference engine (SURVEY.md §2b: the Triton/TF-Serving
 replacement): C++ continuous batcher + paged-KV JAX decode."""
 
+from ..errors import RequestError  # noqa: F401  (re-export: engine raises it)
 from .engine import Engine, EngineConfig  # noqa: F401
 from .model import DecoderConfig  # noqa: F401

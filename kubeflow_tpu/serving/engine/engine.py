@@ -23,10 +23,11 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
+from ..errors import RequestError
 from .model import (DecoderConfig, decode_step, decode_step_k, prefill,
                     prefill_chunk, sample_tokens, write_pages)
 from .native import NativeBatcher
@@ -68,6 +69,10 @@ class EngineConfig:
     page_size: int = 32
     max_pages_per_slot: int = 64
     eos_id: int = -1           # -1: never stop early
+    # additional stop ids: multi-EOS checkouts (Llama-3-Instruct declares
+    # [128001, 128009] and chat turns end with <|eot_id|>=128009) stop on
+    # ANY of eos_id + eos_ids; tuple so the frozen config stays hashable
+    eos_ids: Tuple[int, ...] = ()
     temperature: float = 0.0   # 0 = greedy
     seed: int = 0
     # prompts longer than this are prefilled in page-aligned chunks of this
@@ -154,6 +159,10 @@ class Engine:
         self.params = params
         self.config = config
         self.ec = engine_config
+        # full stop set: primary eos_id (if any) plus the multi-EOS extras
+        self._stop_ids = frozenset(
+            i for i in (engine_config.eos_id,) + tuple(engine_config.eos_ids)
+            if i >= 0)
         # multi-LoRA: ``lora`` = (stacked adapter pytree, {name: id}) from
         # lora.load_adapters — id 0 is the reserved zero adapter, so the
         # per-slot id table below makes every decode row pick its own
@@ -263,12 +272,12 @@ class Engine:
         name of a loaded LoRA adapter to decode this request with (None =
         base model; unknown names raise)."""
         if not tokens:
-            raise ValueError("empty prompt")
+            raise RequestError("empty prompt")
         aid = 0
         if adapter is not None:
             if adapter not in self.adapters:
-                raise ValueError(f"unknown adapter {adapter!r} "
-                                 f"(loaded: {sorted(self.adapters)})")
+                raise RequestError(f"unknown adapter {adapter!r} "
+                                   f"(loaded: {sorted(self.adapters)})")
             aid = self.adapters[adapter]
         fut: Future = Future()
         hashes = self._page_hashes(tokens, aid)
@@ -288,7 +297,7 @@ class Engine:
                                    hashes[:n_lookup]):
             with self._lock:
                 del self._requests[rid]
-            raise ValueError(
+            raise RequestError(
                 f"prompt+generation ({len(tokens)}+{max_new_tokens}) exceeds engine capacity "
                 f"({self.ec.max_pages_per_slot * self.ec.page_size} tokens/slot)"
             )
@@ -697,7 +706,7 @@ class Engine:
         pending.context.append(token)
         if pending.stream is not None:
             pending.stream.put(token)
-        is_eos = token == self.ec.eos_id
+        is_eos = token in self._stop_ids
         rc, new_page = self.batcher.commit_token_ex(slot, is_eos)
         if rc == 1:
             # mirror the growth (finished slots are zeroed in _finish, so

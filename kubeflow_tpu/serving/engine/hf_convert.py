@@ -154,10 +154,25 @@ class _LazyTensors:
                 self._by_name[name] = t.float().numpy()
 
     def pop(self, name):
+        if name not in self._by_name:
+            # a checkout whose shards hold fewer tensors/layers than its
+            # config claims should fail with the tensor name, not a raw
+            # KeyError from deep inside the mapper
+            raise ValueError(
+                f"checkpoint is missing tensor {name!r} (config declares "
+                "more layers/weights than the shards contain)")
         src = self._by_name.pop(name)
         if isinstance(src, np.ndarray):
             return src
         return src.get_tensor(name)
+
+    def close(self) -> None:
+        for h in self._handles:
+            try:
+                h.__exit__(None, None, None)  # safe_open's only close API
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+        self._handles = []
 
     def __contains__(self, name) -> bool:
         return name in self._by_name
@@ -180,23 +195,9 @@ _PER_LAYER = {
 }
 
 
-def convert_hf_checkpoint(src_dir: str, out_dir: str,
-                          dtype: str = "bfloat16") -> dict:
-    """Convert an HF Llama-family checkout into ``out_dir`` (config.json +
-    params.npz in the engine's format).  Returns the engine config dict.
-
-    ``dtype``: storage dtype for params.npz — "bfloat16" (default; stored
-    as float16, whose 10-bit mantissa strictly covers bf16's 7 — numpy's
-    npz loader can't round-trip ml_dtypes.bfloat16) or "float32" (parity
-    testing).  load_params casts to bf16 on load either way."""
-    if dtype not in ("bfloat16", "float32"):
-        raise ValueError(f"dtype must be 'bfloat16' or 'float32', got {dtype!r}")
-    with open(os.path.join(src_dir, "config.json")) as f:
-        raw = json.load(f)
-    cfg = _map_config(raw)
-    store = np.float32 if dtype == "float32" else np.float16
-
-    tensors = _LazyTensors(src_dir)
+def _map_tensors(tensors: "_LazyTensors", cfg: dict, raw: dict, store) -> dict:
+    """Map every checkpoint tensor into the engine's layer-stacked layout;
+    raises on missing/unmapped/non-finite weights (see convert docstring)."""
 
     def grab(name, transpose=False):
         """One tensor, downcast to the storage dtype immediately — only one
@@ -232,6 +233,30 @@ def convert_hf_checkpoint(src_dir: str, out_dir: str,
     if leftovers:
         raise ValueError(f"unmapped checkpoint tensors: {leftovers[:8]} — "
                          "refusing to drop weights silently")
+    return out
+
+
+def convert_hf_checkpoint(src_dir: str, out_dir: str,
+                          dtype: str = "bfloat16") -> dict:
+    """Convert an HF Llama-family checkout into ``out_dir`` (config.json +
+    params.npz in the engine's format).  Returns the engine config dict.
+
+    ``dtype``: storage dtype for params.npz — "bfloat16" (default; stored
+    as float16, whose 10-bit mantissa strictly covers bf16's 7 — numpy's
+    npz loader can't round-trip ml_dtypes.bfloat16) or "float32" (parity
+    testing).  load_params casts to bf16 on load either way."""
+    if dtype not in ("bfloat16", "float32"):
+        raise ValueError(f"dtype must be 'bfloat16' or 'float32', got {dtype!r}")
+    with open(os.path.join(src_dir, "config.json")) as f:
+        raw = json.load(f)
+    cfg = _map_config(raw)
+    store = np.float32 if dtype == "float32" else np.float16
+
+    tensors = _LazyTensors(src_dir)
+    try:
+        out = _map_tensors(tensors, cfg, raw, store)
+    finally:
+        tensors.close()
 
     # params FIRST, config LAST, both atomic: config.json is the one gate
     # hf_dir_needs_conversion reads, so a crash anywhere before the final

@@ -21,6 +21,7 @@ import os
 from typing import Any, Optional
 
 from ..server import Model
+from ..errors import RequestError
 from .engine import Engine, EngineConfig
 from .model import DecoderConfig, load_params
 
@@ -93,11 +94,12 @@ def load_tokenizer(model_dir: str):
     return ByteTokenizer()
 
 
-def _checkout_eos_id(model_dir: str):
-    """The checkpoint's declared end-of-sequence token id, if any:
+def _checkout_eos_ids(model_dir: str) -> list:
+    """The checkpoint's declared end-of-sequence token ids, if any:
     generation_config.json first (transformers' generate source of truth),
-    else the HF config.json.  A list (multi-EOS models) uses the first id
-    — the engine stops on one token."""
+    else the HF config.json.  Multi-EOS checkouts (Llama-3-Instruct
+    declares [128001, 128009]; chat turns end with <|eot_id|>=128009) keep
+    the WHOLE list — the engine stops on any of them."""
     for fname in ("generation_config.json", "config.json"):
         path = os.path.join(model_dir, fname) if model_dir else ""
         if not path or not os.path.exists(path):
@@ -107,11 +109,13 @@ def _checkout_eos_id(model_dir: str):
                 eos = json.load(f).get("eos_token_id")
         except (OSError, ValueError):
             continue
-        if isinstance(eos, list) and eos:
-            eos = eos[0]
-        if isinstance(eos, int) and eos >= 0:
-            return eos
-    return None
+        if isinstance(eos, int):
+            eos = [eos]
+        if isinstance(eos, list):
+            ids = [i for i in eos if isinstance(i, int) and i >= 0]
+            if ids:
+                return ids
+    return []
 
 
 class JetStreamModel(Model):
@@ -149,16 +153,20 @@ class JetStreamModel(Model):
                 with open(path) as f:
                     raw = json.load(f)
                 fields = {f.name for f in dataclasses.fields(EngineConfig)}
-                ec = EngineConfig(**{k: v for k, v in raw.items() if k in fields})
+                kw = {k: v for k, v in raw.items() if k in fields}
+                if isinstance(kw.get("eos_ids"), list):  # keep config hashable
+                    kw["eos_ids"] = tuple(kw["eos_ids"])
+                ec = EngineConfig(**kw)
                 # an operator's explicit eos_id — INCLUDING -1 "never stop
                 # early" — must win over the checkout's declaration
-                eos_explicit = "eos_id" in raw
+                eos_explicit = "eos_id" in raw or "eos_ids" in raw
             if not eos_explicit:
-                # real checkouts declare their stop token; without it every
-                # generation runs to max_tokens past the model's own end
-                eos = _checkout_eos_id(self.model_dir)
-                if eos is not None:
-                    ec = dataclasses.replace(ec, eos_id=eos)
+                # real checkouts declare their stop token(s); without them
+                # every generation runs to max_tokens past the model's end
+                eos = _checkout_eos_ids(self.model_dir)
+                if eos:
+                    ec = dataclasses.replace(ec, eos_id=eos[0],
+                                             eos_ids=tuple(eos[1:]))
             self.engine = Engine(params, config, ec, lora=lora)
         self.engine.start()
         self.ready = True
@@ -189,7 +197,11 @@ class JetStreamModel(Model):
     def _parse_generate(self, payload: Any):
         prompt = payload.get("text_input", "") if isinstance(payload, dict) else str(payload)
         params = (payload.get("parameters") or {}) if isinstance(payload, dict) else {}
-        max_tokens = int(params.get("max_tokens", 32))
+        try:
+            max_tokens = int(params.get("max_tokens", 32))
+        except (TypeError, ValueError):
+            raise RequestError("max_tokens must be an integer, got "
+                               f"{params.get('max_tokens')!r}") from None
         return (self.tokenizer.encode(prompt) or [0], max_tokens,
                 params.get("adapter"))
 
@@ -207,15 +219,24 @@ class JetStreamModel(Model):
         """V2 generate_stream: yields {"text_output": piece} per token, then
         a final record with the run stats.
 
+        Parsing and submission happen EAGERLY (plain method returning a
+        generator), so per-request client faults — unknown adapter, bad
+        max_tokens, over-capacity prompt — raise HERE, before the server
+        commits to SSE headers, and take the same 400 path as unary
+        requests instead of a 200 with an in-stream error event.
+
         Pieces come from decoding the WHOLE generated-id prefix and emitting
         the delta, holding back trailing replacement chars (a multi-byte
         UTF-8 char split across byte tokens decodes to U+FFFD until its tail
         arrives) — so the concatenated stream equals the unary text_output.
         """
         ids, max_tokens, adapter = self._parse_generate(payload)
+        stream = self.engine.generate_stream(ids, max_tokens, adapter=adapter)
+        return self._stream_pieces(stream, ids, max_tokens)
+
+    def _stream_pieces(self, stream, ids: list, max_tokens: int):
         out_ids: list[int] = []
         emitted = 0
-        stream = self.engine.generate_stream(ids, max_tokens, adapter=adapter)
         try:
             for item in stream:
                 if isinstance(item, dict):
@@ -249,8 +270,8 @@ class JetStreamModel(Model):
         for inst in instances:
             ad = inst.get("adapter") if isinstance(inst, dict) else None
             if ad is not None and ad not in self.adapters:
-                raise ValueError(f"unknown adapter {ad!r} "
-                                 f"(loaded: {sorted(self.adapters)})")
+                raise RequestError(f"unknown adapter {ad!r} "
+                                   f"(loaded: {sorted(self.adapters)})")
         futures = []
         for inst in instances:
             if isinstance(inst, str):

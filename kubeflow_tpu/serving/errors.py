@@ -1,0 +1,16 @@
+"""Shared request-fault taxonomy for the serving stack.
+
+Lives in its own dependency-free module so the HTTP server (server.py,
+deliberately import-light) and the jax-heavy engine can both raise/catch
+the same class without a server→engine import edge.
+"""
+
+from __future__ import annotations
+
+
+class RequestError(ValueError):
+    """A per-request client fault (malformed body, empty prompt, unknown
+    adapter, prompt beyond capacity) — the serving layer maps this, and
+    ONLY this, to HTTP 400; any other exception is a 500 server fault.
+    Subclasses ValueError so pre-taxonomy callers' `except ValueError`
+    handlers keep working."""

@@ -21,6 +21,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
+from .errors import RequestError
+
 
 class Model:
     """Base model: override load/predict (and optionally pre/postprocess).
@@ -121,7 +123,10 @@ class ModelServer:
             def _body(self) -> Any:
                 n = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(n) if n else b"{}"
-                return json.loads(raw or b"{}")
+                try:
+                    return json.loads(raw or b"{}")
+                except ValueError as e:
+                    raise RequestError(f"malformed JSON body: {e}") from e
 
             def do_GET(self):
                 server._handle_get(self)
@@ -152,6 +157,14 @@ class ModelServer:
 
     # ------------------------------------------------------------- handlers
 
+    def _adapter_owners(self, adapter: str) -> list:
+        """Every base model serving LoRA adapter ``adapter`` — the ONE
+        definition of bare-adapter-id ownership, shared by the /models
+        listing and the POST routing so they can never skew (an id the
+        listing advertises must be one the router accepts)."""
+        return [m for m in self.models.values()
+                if adapter in (getattr(m, "adapters", {}) or {})]
+
     def _handle_get(self, h) -> None:
         path = h.path.split("?")[0].rstrip("/")
         if path == "/metrics":
@@ -179,9 +192,14 @@ class ModelServer:
                     for n in sorted(self.models)]
             for n in sorted(self.models):
                 # vLLM-style multi-LoRA: each loaded adapter is served as
-                # its own model id, rooted at its base model
+                # its own model id, rooted at its base model.  An adapter
+                # name shared by several bases is listed ONLY under its
+                # qualified base:adapter id — never advertise an id the
+                # POST routes would then 400 as ambiguous
                 for ad in sorted(getattr(self.models[n], "adapters", {}) or {}):
-                    data.append({"id": ad, "object": "model",
+                    mid = ad if len(self._adapter_owners(ad)) == 1 \
+                        else f"{n}:{ad}"
+                    data.append({"id": mid, "object": "model",
                                  "owned_by": "kubeflow-tpu", "root": n})
             h._send(200, {"object": "list", "data": data})
         elif path.startswith("/v1/models/"):
@@ -226,6 +244,17 @@ class ModelServer:
                 self._openai(h, chat=True)
             else:
                 h._send(404, {"error": f"no route {path}"})
+        except RequestError as e:
+            # per-request client faults (malformed body, unknown adapter,
+            # over-capacity prompt) — raised ONLY at request-validation
+            # sites, so engine-internal ValueErrors still surface as 500s.
+            # The OpenAI surface keeps its own error schema: clients there
+            # read error["message"], not a bare string.
+            if path.startswith("/openai/"):
+                h._send(400, {"error": {"message": str(e),
+                                        "type": "invalid_request_error"}})
+            else:
+                h._send(400, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # noqa: BLE001 — server must answer
             h._send(500, {"error": f"{type(e).__name__}: {e}"})
         finally:
@@ -328,10 +357,18 @@ class ModelServer:
             if cand is not None and ad in (getattr(cand, "adapters", {}) or {}):
                 m, adapter = cand, ad
             else:
-                for cand in self.models.values():
-                    if name in (getattr(cand, "adapters", {}) or {}):
-                        m, adapter = cand, name
-                        break
+                owners = self._adapter_owners(name)
+                if len(owners) > 1:
+                    # two bases expose the same adapter name — bare routing
+                    # would silently pick dict order; demand the qualified id
+                    h._send(400, {"error": {
+                        "message": f"adapter {name!r} is served by multiple "
+                                   "base models; use the qualified "
+                                   "'base:adapter' model id",
+                        "type": "invalid_request_error"}})
+                    return
+                if owners:
+                    m, adapter = owners[0], name
         if m is None or getattr(m, "generate", None) is None:
             h._send(404, {"error": {
                 "message": f"model {name!r} not found or not generative",

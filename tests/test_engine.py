@@ -1415,3 +1415,47 @@ def test_jetstream_reads_checkout_eos(tmp_path):
             assert m2.engine.ec.eos_id == explicit
         finally:
             m2.engine.stop()
+
+
+def test_engine_stops_on_any_declared_eos(params):
+    """ADVICE r4 (multi-EOS): Llama-3-Instruct declares [128001, 128009]
+    and chat turns end with the SECOND id — the engine must stop on any
+    member of the stop set, not just eos_id."""
+    prompt = [5, 7, 9, 11]
+    oracle = greedy_oracle(params, prompt, 5)
+    eng = Engine(params, CFG, EngineConfig(max_slots=1, num_pages=32,
+                                           page_size=8, max_pages_per_slot=8,
+                                           eos_id=100,  # never emitted
+                                           eos_ids=(99, oracle[1])))
+    eng.start()
+    try:
+        out = eng.generate(prompt, 5)
+        assert out["tokens"] == oracle[:2]
+        assert out["num_tokens"] == 2 < 5
+    finally:
+        eng.stop()
+
+
+def test_jetstream_reads_multi_eos_list(tmp_path):
+    """A generation_config.json list keeps ALL stop ids (first as eos_id,
+    rest as eos_ids), instead of collapsing to the first."""
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+
+    md = tmp_path / "m"
+    md.mkdir()
+    (md / "config.json").write_text(json.dumps(
+        {"vocab_size": 101, "d_model": 64, "n_layers": 2, "n_heads": 4,
+         "n_kv_heads": 2, "d_ff": 128}))
+    (md / "engine.json").write_text(json.dumps(
+        {"max_slots": 1, "num_pages": 32, "page_size": 8,
+         "max_pages_per_slot": 8}))
+    (md / "generation_config.json").write_text(json.dumps(
+        {"eos_token_id": [2, 9], "bos_token_id": 1}))
+    m = JetStreamModel("llm", model_dir=str(md))
+    m.load()
+    try:
+        assert m.engine.ec.eos_id == 2
+        assert m.engine.ec.eos_ids == (9,)
+        assert m.engine._stop_ids == {2, 9}
+    finally:
+        m.engine.stop()

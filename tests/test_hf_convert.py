@@ -236,3 +236,18 @@ def test_isvc_serves_raw_hf_checkout_end_to_end(tmp_path):
     finally:
         proxy.shutdown()
         c.shutdown()
+
+
+@pytest.mark.slow  # builds a transformers checkpoint
+def test_truncated_checkpoint_names_the_missing_tensor(tmp_path):
+    """ADVICE r4: a checkout whose config claims more layers than its
+    shards contain must fail with the missing tensor's name, not a raw
+    KeyError from the mapper."""
+    from kubeflow_tpu.serving.engine.hf_convert import convert_hf_checkpoint
+
+    _, src = _tiny_hf_llama(tmp_path)
+    cfg = json.loads((tmp_path / "hf" / "config.json").read_text())
+    cfg["num_hidden_layers"] = 3  # shards only hold layers 0-1
+    (tmp_path / "hf" / "config.json").write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match="missing tensor.*model.layers.2"):
+        convert_hf_checkpoint(src, str(tmp_path / "out"), dtype="float32")

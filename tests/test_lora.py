@@ -288,3 +288,99 @@ def test_openai_adapter_as_model_id(tmp_path, params):
         assert e.value.code == 404
     finally:
         srv.stop()
+
+
+def test_unknown_adapter_is_a_client_error(params):
+    """ADVICE r4: a V2 generate naming a nonexistent adapter is the
+    client's mistake — HTTP 400 with the message, not a 500."""
+    import urllib.error
+    import urllib.request
+
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+    from kubeflow_tpu.serving.server import ModelServer
+
+    eng = Engine(params, CFG,
+                 EngineConfig(max_slots=1, num_pages=32, page_size=8,
+                              max_pages_per_slot=8))
+    srv = ModelServer([JetStreamModel("llm", engine=eng)])
+    srv.start()
+    try:
+        # unary AND streaming: the stream variant must 400 BEFORE SSE
+        # headers (validation is eager), not 200 with an in-stream error
+        for route in ("generate", "generate_stream"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v2/models/llm/{route}",
+                data=json.dumps({"text_input": "ab",
+                                 "parameters": {"max_tokens": 2,
+                                                "adapter": "nope"}}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=60)
+            assert e.value.code == 400, route
+            assert "unknown adapter" in e.value.read().decode()
+        # malformed max_tokens is a client fault too
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v2/models/llm/generate",
+            data=json.dumps({"text_input": "ab",
+                             "parameters": {"max_tokens": "abc"}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=60)
+        assert e.value.code == 400
+        assert "max_tokens" in e.value.read().decode()
+    finally:
+        srv.stop()
+
+
+def test_bare_adapter_ambiguous_across_bases_needs_qualified_id(params):
+    """ADVICE r4: two bases exposing the same adapter name must not let a
+    bare adapter model-id silently route by dict order — 400 demanding the
+    qualified base:adapter form, which still works for both."""
+    import urllib.error
+    import urllib.request
+
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+    from kubeflow_tpu.serving.server import ModelServer
+
+    ec = EngineConfig(max_slots=2, num_pages=32, page_size=8,
+                      max_pages_per_slot=8)
+    lora_a, _ = _random_lora(jax.random.PRNGKey(5), ["wq"], 1, scale=0.2)
+    lora_b, _ = _random_lora(jax.random.PRNGKey(6), ["wq"], 1, scale=0.2)
+    eng_a = Engine(params, CFG, ec, lora=(lora_a, {"tuned": 1}))
+    eng_b = Engine(params, CFG, ec, lora=(lora_b, {"tuned": 1}))
+    srv = ModelServer([JetStreamModel("llm-a", engine=eng_a),
+                       JetStreamModel("llm-b", engine=eng_b)])
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/openai/v1"
+        # the listing must not advertise the ambiguous bare id — only the
+        # qualified forms a client can actually call
+        models = json.loads(urllib.request.urlopen(base + "/models",
+                                                   timeout=30).read())
+        ids = {m["id"] for m in models["data"]}
+        assert "tuned" not in ids
+        assert {"llm-a:tuned", "llm-b:tuned"} <= ids
+
+        def post(payload):
+            req = urllib.request.Request(
+                base + "/completions", data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"model": "tuned", "prompt": "ab", "max_tokens": 2})
+        assert e.value.code == 400
+        assert "multiple" in e.value.read().decode()
+        for model_id in ("llm-a:tuned", "llm-b:tuned"):
+            out = post({"model": model_id, "prompt": "ab", "max_tokens": 2})
+            assert out["usage"]["completion_tokens"] == 2
+        # a RequestError surfacing on the OpenAI routes keeps the OpenAI
+        # error schema ({"error": {"message", "type"}}), not a bare string
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"model": "llm-a", "prompt": "ab", "max_tokens": 10_000})
+        assert e.value.code == 400
+        err = json.loads(e.value.read())["error"]
+        assert "capacity" in err["message"]
+        assert err["type"] == "invalid_request_error"
+    finally:
+        srv.stop()
