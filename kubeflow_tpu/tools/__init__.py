@@ -1,0 +1,1 @@
+"""Vendored native developer/contract tools (built on first use)."""

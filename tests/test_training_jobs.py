@@ -299,9 +299,11 @@ def test_pytorchjob_scale_job_clamps(tcluster):
     client.delete_job("PyTorchJob", "scaleme")
 
 
+@pytest.mark.slow  # pod spin-up + 5s worker: keep the fast lane in budget
 @pytest.mark.skipif(shutil.which("mpirun") is None,
-                    reason="mpirun not in this image (modeled path covered "
-                           "by test_mpijob_launcher_hostfile_configmap)")
+                    reason="no system mpirun AND the vendored tools/mpirun.cc "
+                           "build failed (modeled path covered by "
+                           "test_mpijob_launcher_hostfile_configmap)")
 def test_mpijob_launcher_runs_real_mpirun(tcluster):
     """VERDICT r2 #8: when a real MPI runtime exists, the Launcher pod must
     be able to exec `mpirun` and spawn ranks (local slots — the pod 'hosts'
