@@ -500,7 +500,17 @@ class Engine:
 
     def _loop(self) -> None:
         jnp = self._jnp
+        # ENGINE_TICK_FLOOR_S: minimum wall time per engine tick that did
+        # work.  A simulator knob for router/scheduler tests on CPU: on a
+        # real TPU the host thread is idle while the chip runs the step, so
+        # N replicas on N chips scale; on the 1-core test box the tick is
+        # pure host compute and replicas only time-slice.  The floor
+        # restores the device-bound regime (host sleeps the remainder of
+        # the simulated step), letting multi-replica scheduling behavior be
+        # asserted without chips.  Unset/0 (the default) is a no-op.
+        tick_floor = float(os.environ.get("ENGINE_TICK_FLOOR_S", "0") or 0)
         while self._running:
+            tick_t0 = time.perf_counter() if tick_floor else 0.0
             did_work = False
 
             # --- admission: bookkeeping only (C++ decides; compute is below)
@@ -570,6 +580,10 @@ class Engine:
                 else:
                     self._decode_tick_single(decode_ready, seq_lens, page_table)
 
+            if did_work and tick_floor:
+                pad = tick_floor - (time.perf_counter() - tick_t0)
+                if pad > 0:
+                    time.sleep(pad)
             if not did_work:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()

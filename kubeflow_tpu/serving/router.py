@@ -63,6 +63,13 @@ class _ProxyState:
         # backends expose no engine gauges (non-engine runtime): cached so
         # plain round-robin services don't pay per-request scrape sweeps
         self.engineless_until = 0.0
+        # prefix affinity memory: prompt-prefix -> port it was last routed
+        # to.  Affinity only applies to prefixes SEEN here before — a
+        # never-seen prompt has no cached KV anywhere, so hashing it to a
+        # replica would just randomize load (measured r5: hash-affinity on
+        # all-distinct prompts made 2 replicas no faster than 1).
+        # Insertion-ordered; capped in _pick_engine_aware.
+        self.affinity: dict[str, int] = {}
         self.lock = threading.Lock()
 
 
@@ -219,12 +226,15 @@ class ServiceProxy:
     # decode requests have wildly different costs.  Scrape each replica's
     # engine gauges (short TTL), score load = queue_depth + active_slots (+
     # picks routed since the scrape), and send the request to the least
-    # loaded — except when a prefix-affinity replica is within one request
-    # of the minimum, where the shared-prefix KV cache beats perfect
-    # balance.
+    # loaded — except when the request's prompt prefix was already routed
+    # somewhere (so its KV pages are plausibly cached there) and that
+    # replica is within one request of the minimum: the shared-prefix KV
+    # cache beats perfect balance, but never-seen prompts always go
+    # least-loaded.
     _LOAD_TTL = 0.25
     _ENGINELESS_TTL = 2.0
     _AFFINITY_SLACK = 1.0
+    _AFFINITY_CAP = 1024  # prefix->port entries kept per proxy (LRU)
 
     def _pick_engine_aware(self, state: _ProxyState, ports: list[int],
                            body: Optional[bytes]) -> Optional[int]:
@@ -284,25 +294,40 @@ class ServiceProxy:
                     state.engineless_until = now + self._ENGINELESS_TTL
         if engineless:
             return None  # round-robin fallback
+        prefix = self._prompt_prefix(body)
         with state.lock:
             loads = {p: state.loads[p][1] + state.pending.get(p, 0)
                      for p in ports
                      if p in state.loads and state.loads[p][1] is not None}
             if not loads:
                 return None
-            candidates = sorted(loads)
-            best = min(candidates, key=lambda p: (loads[p], p))
-            affinity = self._affinity_port(candidates, body)
-            if (affinity is not None
-                    and loads[affinity] <= loads[best] + self._AFFINITY_SLACK):
-                best = affinity
+            best = min(loads, key=lambda p: (loads[p], p))
+            # sticky-prefix affinity: ONLY for a prefix this proxy has
+            # routed before (its KV pages are plausibly cached there), and
+            # only while that replica is within slack of the least loaded
+            if prefix is not None:
+                seen = state.affinity.get(prefix)
+                if (seen in loads
+                        and loads[seen] <= loads[best] + self._AFFINITY_SLACK):
+                    best = seen
+                # the mapping moves ONLY when the seen replica is gone from
+                # the ready set — an overload detour or a momentarily
+                # unscrapable replica (mid-compile blip) does not relocate
+                # the prefix's cached KV, so it must not relocate the
+                # mapping either; re-insertion keeps hot prefixes at the
+                # LRU tail even across detours
+                target = seen if seen in ports else best
+                state.affinity.pop(prefix, None)
+                state.affinity[prefix] = target
+                while len(state.affinity) > self._AFFINITY_CAP:
+                    state.affinity.pop(next(iter(state.affinity)))
             state.pending[best] = state.pending.get(best, 0) + 1
             return best
 
     @staticmethod
-    def _affinity_port(ports: list[int], body: Optional[bytes]) -> Optional[int]:
-        """Stable replica choice by prompt prefix, so shared system prompts
-        land where their KV pages are already cached."""
+    def _prompt_prefix(body: Optional[bytes]) -> Optional[str]:
+        """The request's prompt prefix (first 64 chars) — the affinity key
+        for landing shared system prompts where their KV is cached."""
         if not body:
             return None
         try:
@@ -327,10 +352,7 @@ class ServiceProxy:
                 prompt = content if isinstance(content, str) else None
         if not isinstance(prompt, str) or not prompt:
             return None
-        import hashlib
-
-        digest = hashlib.blake2b(prompt[:64].encode(), digest_size=4).digest()
-        return sorted(ports)[int.from_bytes(digest, "little") % len(ports)]
+        return prompt[:64]
 
     def _pick_revision(self, state: _ProxyState, traffic: dict[str, int]) -> Optional[str]:
         live = {r: p for r, p in traffic.items() if p > 0}
