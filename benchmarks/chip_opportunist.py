@@ -132,7 +132,16 @@ JOBS = [
                           "--requests", "24", "--concurrency", "4",
                           "--max-tokens", "32"]),
      "timeout": 2400, "first_timeout": 1200},
-    # 9+. dense remat micro-tuning — LAST (two rounds bought +1.8% total)
+    # 9. batch 768 unlocked by bf16 Adam moments (VERDICT r4 #2's named
+    #    lever list: "larger batch at save_mlp, bf16 optimizer states" —
+    #    both at once): halved at-rest optimizer HBM is what makes 768 fit
+    #    next to save_mlp activations; numerics pinned vs f32 in
+    #    test_bf16_optimizer_states_match_f32_training
+    {"name": "mfu_save_mlp_768_bf16opt",
+     "cmd": SWEEP + ["768", "128", "1", "save_mlp", "dense", "8"],
+     "timeout": 540, "first_timeout": 240,
+     "env": {"MFU_OPT_DTYPE": "bfloat16"}},
+    # 10+. dense remat micro-tuning — LAST (two rounds bought +1.8% total)
     {"name": "mfu_save_attn_768",
      "cmd": SWEEP + ["768", "128", "1", "save_attn", "dense", "8"],
      "timeout": 540, "first_timeout": 240},

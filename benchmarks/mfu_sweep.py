@@ -44,6 +44,10 @@ def main() -> None:
     if attn not in ("dense", "dense_mask", "flash", "flash_mask"):
         sys.exit(f"unknown ATTN {attn!r}: dense|dense_mask|flash|flash_mask")
     steps = int(sys.argv[6]) if len(sys.argv) > 6 else 10
+    # MFU_OPT_DTYPE=bfloat16 halves at-rest Adam moments: the HBM headroom
+    # that lets batch 768 fit save_mlp (read once; config and record must
+    # agree on what actually ran)
+    opt_dtype = os.environ.get("MFU_OPT_DTYPE") or None
 
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
@@ -68,7 +72,8 @@ def main() -> None:
     flops_per_batch = config.train_flops(batch_size, seq_len, max_predictions)
     trainer = Trainer(
         loss_fn, params, mesh, bert.SHARDING_RULES,
-        TrainerConfig(learning_rate=1e-4, warmup_steps=2, total_steps=steps + 4),
+        TrainerConfig(learning_rate=1e-4, warmup_steps=2, total_steps=steps + 4,
+                      optimizer_dtype=opt_dtype),
         flops_per_batch=flops_per_batch,
     )
     data = synthetic_mlm_batches(config.vocab_size, batch_size, seq_len)
@@ -110,6 +115,7 @@ def main() -> None:
     rec = {
         "batch": batch_size, "seq": seq_len, "remat": remat, "policy": policy,
         "attn": attn, "mfu": round(mfu, 4),
+        "opt_dtype": opt_dtype or "float32",
         "samples_per_sec_per_chip": round(batch_size * steps / dt / n_chips, 2),
         "step_time_ms": round(1000 * dt / steps, 2),
         "n_chips": n_chips, "platform": devices[0].platform,

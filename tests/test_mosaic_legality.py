@@ -144,3 +144,31 @@ def test_bert_train_step_with_flash_lowers_for_tpu(policy, attn, seq):
     batch = next(synthetic_mlm_batches(cfg.vocab_size, 8, seq))
     jax.export.export(tr._step, platforms=["tpu"])(tr.params, tr.opt_state,
                                                    batch)
+
+
+@pytest.mark.slow
+def test_bert_train_step_bf16_moments_lowers_for_tpu():
+    """The mfu_save_mlp_768_bf16opt queue job's step: bf16 Adam moments
+    thread through clip/adamw/apply under the TPU lowering (the at-rest
+    cast pattern must not trip Mosaic or donation), pre-checked on CPU so
+    the candidate cannot burn a chip-window attempt on a lowering error."""
+    from kubeflow_tpu.models import bert
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.train.data import synthetic_mlm_batches
+    from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
+
+    cfg = bert.BertConfig(remat=True, remat_policy="save_mlp",
+                          attention="dense")
+    params = bert.init(jax.random.PRNGKey(0), cfg)
+    mesh = build_mesh(MeshConfig(data=1, fsdp=1, tensor=1), jax.devices()[:1])
+
+    def loss_fn(p, b):
+        return bert.mlm_loss(p, cfg, b["input_ids"], b["labels"], None,
+                             max_predictions=20)
+
+    tr = Trainer(loss_fn, params, mesh, bert.SHARDING_RULES,
+                 TrainerConfig(learning_rate=1e-4, warmup_steps=2,
+                               total_steps=8, optimizer_dtype="bfloat16"))
+    batch = next(synthetic_mlm_batches(cfg.vocab_size, 8, 128))
+    jax.export.export(tr._step, platforms=["tpu"])(tr.params, tr.opt_state,
+                                                   batch)
