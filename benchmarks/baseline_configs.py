@@ -183,22 +183,27 @@ def bench_gemma() -> dict:
 
 
 def bench_serving() -> dict:
-    """BASELINE config[3]: serving latency via serving_bench.py. On the CPU
-    box this is a smoke-scale tiny-model run (the real p50 row needs the
-    chip: ``--config 1b`` / ``llama3_8b`` there); recorded with its platform
-    so it can't be mistaken for the chip number."""
+    """BASELINE config[3]: serving latency via serving_bench.py, at the FULL
+    BASELINE protocol (>=1k requests, fixed-QPS open loop, warmup excluded
+    — VERDICT r4 #7) so the row carries no protocol_note.  Still the tiny
+    model on this CPU box (the real p50 row needs the chip: ``--config 1b``
+    / ``llama3_8b`` there); recorded with its platform so it can't be
+    mistaken for the chip number.  The 2.0 QPS offered load sits below the
+    box's measured ~3.2 req/s short-prompt closed-loop capacity, leaving
+    headroom for the 25% long-prompt (4x) chunked-prefill traffic."""
     import subprocess
 
     on_cpu_env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
         out = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(__file__), "serving_bench.py"),
-             "--config", "tiny", "--requests", "16", "--concurrency", "4",
-             "--prompt-len", "32", "--max-tokens", "16", "--long-prompt-frac", "0.25"],
-            env=on_cpu_env, capture_output=True, text=True, timeout=900,
+             "--config", "tiny", "--requests", "1000", "--qps", "2.0",
+             "--concurrency", "16", "--prompt-len", "32", "--max-tokens", "16",
+             "--long-prompt-frac", "0.25"],
+            env=on_cpu_env, capture_output=True, text=True, timeout=1200,
         )
     except subprocess.TimeoutExpired:
-        return {"config": "kserve_serving_latency", "ok": False, "error": "timeout (900s)"}
+        return {"config": "kserve_serving_latency", "ok": False, "error": "timeout (1200s)"}
     line = [x for x in out.stdout.splitlines() if x.startswith("{")]
     if out.returncode != 0 or not line:
         return {"config": "kserve_serving_latency", "ok": False,

@@ -102,11 +102,16 @@ JOBS = [
     {"name": "mfu_save_mlp_256",
      "cmd": SWEEP + ["256", "128", "1", "save_mlp", "dense", "8"],
      "timeout": 540, "first_timeout": 240},
-    # 6. on-chip serving p50 at real size (BASELINE row 4); picks up
-    #    --paged-kernel automatically once #4 has validated it
+    # 6. on-chip serving p50 at real size (BASELINE row 4), at the FULL
+    #    protocol (VERDICT r4 #3: >=1k requests, fixed-QPS open loop, so
+    #    the chip row needs no protocol_note): qps 4 should sit below a
+    #    v5e's 1b-int8 decode capacity -> ~250s ideal, ~500s if capacity
+    #    halves; picks up --paged-kernel automatically once #4 validates it
     {"name": "serving_1b_int8",
-     "cmd": _serving_cmd("1b", ["--kv-quant", "int8", "--requests", "64",
-                                "--concurrency", "8"]),
+     "cmd": _serving_cmd("1b", ["--kv-quant", "int8", "--requests", "1000",
+                                "--qps", "4", "--concurrency", "16",
+                                "--max-tokens", "32",
+                                "--long-prompt-frac", "0.25"]),
      "timeout": 1500, "first_timeout": 900},
     # 7a-b. seq-512 (BERT phase-2 shape, same 65k tokens/step as 512@128):
     #    the attention-FLOPs fraction quadruples, which is where flash's

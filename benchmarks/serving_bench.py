@@ -198,6 +198,13 @@ def main() -> None:
         # shorter run is a smoke and the artifact must say so on its own
         "protocol_note": (None if args.requests >= 1000 and args.qps > 0
                           else "smoke: <1k requests or closed-loop burst"),
+        # under an open loop, tokens/s tracks the OFFERED load (qps x
+        # tokens/request) while the engine keeps up — p50/TTFT are the
+        # measured quantities; closed-loop tokens/s measures capacity.
+        # Labeled so cross-round diffs can't read a protocol switch as a
+        # throughput change.
+        "throughput_semantics": ("offered-load (open loop)" if args.qps > 0
+                                 else "capacity (closed loop)"),
     }))
 
 

@@ -77,6 +77,8 @@ def _paged_args(B, K, Hq, Hkv, hd, ps, NP, MP, quant):
 @pytest.mark.parametrize("quant", [False, True])
 @pytest.mark.parametrize("K", [1, 3])
 @pytest.mark.parametrize("hd,ps", [(128, 16),  # llama3_8b production shape
+                                   (128, 32),  # serving_bench's EngineConfig
+                                               # (1b + 8B chip queue jobs)
                                    (16, 8)])   # CPU-test toy shape
 def test_paged_attention_lowers_for_tpu(quant, K, hd, ps):
     from kubeflow_tpu.serving.engine.paged_attention import paged_attention
