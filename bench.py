@@ -47,6 +47,9 @@ CANDIDATES = [
     (1024, 1, "nothing", "dense"),   # r1 floor config (R1_CONFIG)
     (256, 1, "save_mlp", "dense"),   # every-matmul-saved: near-zero remat tax
     (384, 1, "save_mlp", "dense"),
+    # batch 768 fits save_mlp only with bf16 Adam moments (r5: halved
+    # at-rest optimizer HBM) — the 5th element is extra env for the sweep
+    (768, 1, "save_mlp", "dense", {"MFU_OPT_DTYPE": "bfloat16"}),
     (1024, 1, "save_qkv", "dense"),
 ]
 _FLASH_VALIDATED = os.path.join(REPO, "kubeflow_tpu", "ops",
@@ -176,17 +179,19 @@ def _parse_sweep_output(stdout: str):
 
 
 def _run_candidate(cand, n_chips: int, timeout_s: float):
-    batch, remat, policy, attn = cand
+    batch, remat, policy, attn = cand[:4]
+    extra_env = cand[4] if len(cand) > 4 else {}
     cmd = [sys.executable, os.path.join(REPO, "benchmarks", "mfu_sweep.py"),
            str(batch * n_chips), "128", str(remat), policy, attn, str(STEPS)]
-    rc, out, err = _run(cmd, timeout_s, _sweep_env())
+    env = _sweep_env()
+    env.update(extra_env)
+    rc, out, err = _run(cmd, timeout_s, env)
     if rc is None:
         print(f"bench: candidate {cand} timed out after {timeout_s:.0f}s",
               file=sys.stderr)
         return None
     if rc != 0:
-        tail = err.strip().splitlines()[-1:] or ["?"]
-        print(f"bench: candidate {cand} failed rc={rc}: {tail[0][:200]}",
+        print(f"bench: candidate {cand} failed rc={rc}: {error_tail(err)}",
               file=sys.stderr)
         return None
     rec = _parse_sweep_output(out)

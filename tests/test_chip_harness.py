@@ -268,3 +268,42 @@ def test_outer_timeout_with_no_output_asks_the_tunnel(tmp_path, monkeypatch):
     monkeypatch.setattr(co, "_tunnel_healthy", lambda: True)
     assert co.drain_queue(state) != "sick"
     assert state["j1"]["attempts"] == 1 and state["j1"]["refunds"] == 1
+
+
+def test_drain_resolves_serving_cmd_after_marker_lands(tmp_path, monkeypatch):
+    """Full-queue drain simulation for the window's highest-stakes path:
+    job cmds that are CALLABLES (serving jobs) must be built at drain time,
+    AFTER earlier jobs ran — so the --paged-kernel flag appears exactly
+    when a preceding job wrote PAGED_CHIP_VALIDATED, not before."""
+    monkeypatch.setattr(co, "STATE", str(tmp_path / "state.json"))
+    monkeypatch.setattr(co, "RESULTS", str(tmp_path / "results.jsonl"))
+    monkeypatch.setattr(bench, "CHIP_LOCK", str(tmp_path / "chip.lock"))
+    monkeypatch.setattr(co, "bench_active", lambda: False)
+    monkeypatch.setattr(co, "_tpu_preflight", lambda *a, **k: 1)
+    monkeypatch.setattr(co, "_tunnel_healthy", lambda: True)
+    marker = tmp_path / "PAGED_CHIP_VALIDATED"
+    monkeypatch.setattr(co, "_PAGED_MARKER", str(marker))
+
+    ran = []
+
+    def run(cmd, t, env):
+        ran.append(list(cmd))
+        if cmd == ["validate"]:
+            marker.write_text("ok")  # the engine_chip_check side effect
+        return (0, json.dumps({"ok": True}) + "\n", "")
+
+    monkeypatch.setattr(co, "_run", run)
+    monkeypatch.setattr(co, "JOBS", [
+        {"name": "serve_before", "cmd": co._serving_cmd("1b", ["--x"]),
+         "timeout": 5},
+        {"name": "validate", "cmd": ["validate"], "timeout": 5},
+        {"name": "serve_after", "cmd": co._serving_cmd("1b", ["--y"]),
+         "timeout": 5},
+    ])
+    state = {}
+    assert co.drain_queue(state) == "done"
+    assert all(state[n]["done"] for n in
+               ("serve_before", "validate", "serve_after"))
+    before, _, after = ran
+    assert "--paged-kernel" not in before and "--x" in before
+    assert "--paged-kernel" in after and "--y" in after
