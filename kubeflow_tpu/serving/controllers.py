@@ -471,7 +471,10 @@ class InferenceServiceReconciler:
         )
         env = main.setdefault("env", [])
         have = {e["name"] for e in env}
-        if comp == "transformer" and predictor_addr and "PREDICTOR_HOST" not in have:
+        # transformers AND explainers interrogate the predictor over HTTP
+        # (upstream: the Alibi explainer pod calls the predictor service)
+        if (comp in ("transformer", "explainer") and predictor_addr
+                and "PREDICTOR_HOST" not in have):
             env.append({"name": "PREDICTOR_HOST", "value": predictor_addr})
         # KServe-agent features (SURVEY.md §2a agent row): component-level
         # batcher/logger specs become env the runtime wraps the model with

@@ -442,7 +442,17 @@ class Router:
         return self._post(port, f"/v2/models/{name}/infer", payload)
 
     def explain(self, name: str, payload: dict, namespace: str = "default") -> dict:
-        port = self._entry_port(name, namespace)
+        # upstream ingress routes :explain to the EXPLAINER component's
+        # service when the ISVC has one; predictor/transformer otherwise
+        isvc = self.api.get("InferenceService", name, namespace)
+        status = isvc.get("status", {})
+        comp = (status.get("components", {}).get("explainer") or {})
+        port = comp.get("proxyPort")
+        if not port:
+            url = status.get("address", {}).get("url")
+            if not url:
+                raise LookupError(f"InferenceService {name} has no status.address yet")
+            port = int(url.rsplit(":", 1)[1])
         return self._post(port, f"/v1/models/{name}:explain", payload)
 
     # ------------------------------------------------- OpenAI-compat surface

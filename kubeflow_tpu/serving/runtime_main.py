@@ -142,6 +142,14 @@ def load_model(loader: str, name: str, model_dir: str) -> Model:
             booster.load_model(path)
         return _FnModel(name, lambda instances: _np_list(booster.predict(_np(instances))))
 
+    if loader == "explainer":
+        from .explainers import ExplainerModel
+
+        m = ExplainerModel(name, model_dir)
+        if predictor_host:
+            m.predictor = PredictorClient(predictor_host)
+        return m
+
     if loader == "jax":
         path = _find(model_dir, "model.py")
         if path is None:
@@ -237,9 +245,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--port", type=int, required=True)
     args = p.parse_args(argv)
 
-    if args.loader in ("jax", "jetstream"):
+    if args.loader in ("jax", "jetstream", "explainer"):
         # only jax-backed loaders pay the jax import; sklearn/pyfunc pods
         # must not grow a jax dependency or its multi-second startup cost
+        # (integrated_gradients explainers import jax in load())
         from ..utils.jax_platform import honor_jax_platforms
 
         honor_jax_platforms()

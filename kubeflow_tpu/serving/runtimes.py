@@ -91,6 +91,13 @@ def default_runtimes() -> list[Obj]:
             [{"name": "pyfunc", "autoSelect": True}],
             ["--loader", "pyfunc"],
         ),
+        # explainer component runtime (Alibi-server analogue): shap over the
+        # predictor HTTP hop, or white-box integrated gradients (explainers.py)
+        _runtime(
+            "kserve-explainer",
+            [{"name": "explainer", "autoSelect": True}],
+            ["--loader", "explainer"],
+        ),
     ]
 
 
