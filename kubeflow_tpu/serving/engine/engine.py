@@ -418,6 +418,11 @@ class Engine:
         return PREFILL_BUCKETS[-1]
 
     def _next_key(self):
+        if self.ec.temperature <= 0.0:
+            # greedy sampling never reads the key: don't pay a per-tick
+            # fold_in dispatch (a real host-latency tax at chip decode
+            # speeds) for a value argmax ignores
+            return self._key
         self._sample_calls += 1
         return self._jax.random.fold_in(self._key, self._sample_calls)
 

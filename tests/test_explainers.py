@@ -144,3 +144,30 @@ def test_shap_output_index_for_multi_output_predictors(tmp_path):
     out = m.explain({"instances": [x]})
     np.testing.assert_allclose(out[0]["shap_values"],
                                W2[:, 1] * np.asarray(x), rtol=1e-9)
+
+
+def test_explainer_model_integrated_gradients_path(tmp_path):
+    """The white-box runtime path: ExplainerModel loads the jax model from
+    its own model_dir via the load_jax contract and serves attributions —
+    exact w*(x-baseline) for a linear model, baseline from explainer.json."""
+    from kubeflow_tpu.serving.explainers import ExplainerModel
+
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "model.py").write_text(textwrap.dedent("""
+        import numpy as np
+        def load_jax(model_dir):
+            import jax.numpy as jnp
+            W = jnp.asarray([1.5, -2.0, 0.5, 3.0], jnp.float32)
+            return (lambda params, x: x @ params), W
+    """))
+    (d / "explainer.json").write_text(json.dumps(
+        {"method": "integrated_gradients", "steps": 8,
+         "baseline": [1.0, 0.0, 0.0, 0.0]}))
+    m = ExplainerModel("m", str(d))
+    m.load()
+    x = [2.0, -1.0, 0.0, 1.0]
+    out = m.explain({"instances": [x]})
+    expect = np.array([1.5, -2.0, 0.5, 3.0]) * (np.asarray(x) - np.array([1.0, 0, 0, 0]))
+    np.testing.assert_allclose(out[0]["attributions"], expect, rtol=1e-5,
+                               atol=1e-5)
