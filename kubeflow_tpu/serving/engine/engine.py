@@ -504,7 +504,6 @@ class Engine:
             self._prefilling[slot] = off + C
 
     def _loop(self) -> None:
-        jnp = self._jnp
         # ENGINE_TICK_FLOOR_S: minimum wall time per engine tick that did
         # work.  A simulator knob for router/scheduler tests on CPU: on a
         # real TPU the host thread is idle while the chip runs the step, so
@@ -594,17 +593,23 @@ class Engine:
                 self._wake.clear()
 
     def _decode_tick_single(self, decode_ready, seq_lens, page_table) -> None:
-        jnp = self._jnp
         tokens = np.zeros((self.ec.max_slots,), np.int32)
         for slot in decode_ready:
             gen = self._requests[self._slot_req[slot]].generated
             tokens[slot] = gen[-1] if gen else 0
+        # host mirrors go to the jit RAW — eager jnp.asarray would add a
+        # Python-level device_put op per array per tick (3 extra dispatches
+        # per token over the remote tunnel).  SAFETY INVARIANT: on CPU
+        # backends jax may zero-copy-alias aligned numpy inputs, so the
+        # mirrors must not be mutated while the step is in flight; the
+        # blocking np.asarray(sample_tokens(...)) below is that barrier —
+        # every mirror mutation (_commit and later) happens after it
         logits, self.k_pool, self.v_pool = decode_step(
-            self.params, self.config, jnp.asarray(tokens),
-            jnp.asarray(seq_lens), jnp.asarray(page_table),
+            self.params, self.config, tokens,
+            seq_lens, page_table,
             self.k_pool, self.v_pool, paged=self._paged, mesh=self._mesh,
             lora_params=self._lora,
-            adapter_ids=(jnp.asarray(self._aid_host)
+            adapter_ids=(self._aid_host
                          if self._lora is not None else None),
         )
         sampled = np.asarray(
@@ -670,7 +675,6 @@ class Engine:
         bonus token the final logit row yields (lossless vs token-by-token).
         Rejected draft KV stays masked and is overwritten by the next tick's
         row-0 write before anything reads it."""
-        jnp = self._jnp
         K = 1 + self.ec.spec_max_draft
         tokens = np.zeros((self.ec.max_slots, K), np.int32)
         for slot in decode_ready:
@@ -678,12 +682,16 @@ class Engine:
             tokens[slot, 0] = gen[-1] if gen else 0
             d = drafts.get(slot) or []
             tokens[slot, 1:1 + len(d)] = d
+        # raw host mirrors, as in _decode_tick_single — same safety
+        # invariant: the blocking sample_tokens fence below precedes every
+        # mirror mutation, so the (possibly aliased) buffers are stable
+        # while the step is in flight
         logits, self.k_pool, self.v_pool = decode_step_k(
-            self.params, self.config, jnp.asarray(tokens),
-            jnp.asarray(seq_lens), jnp.asarray(page_table),
+            self.params, self.config, tokens,
+            seq_lens, page_table,
             self.k_pool, self.v_pool, paged=self._paged, mesh=self._mesh,
             lora_params=self._lora,
-            adapter_ids=(jnp.asarray(self._aid_host)
+            adapter_ids=(self._aid_host
                          if self._lora is not None else None),
         )
         B, _, V = logits.shape
