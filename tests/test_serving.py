@@ -396,10 +396,18 @@ def test_isvc_two_replicas_beat_one_when_device_bound(tmp_path):
                     return json.loads(r.read())["tokens"]
 
             gen(0)  # warm the engine's compile path outside the clock
-            t0 = _time.perf_counter()
-            with concurrent.futures.ThreadPoolExecutor(8) as ex:
-                toks = sum(ex.map(gen, range(24)))
-            return toks / (_time.perf_counter() - t0)
+
+            def one_round() -> float:
+                t0 = _time.perf_counter()
+                with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                    toks = sum(ex.map(gen, range(32)))
+                return toks / (_time.perf_counter() - t0)
+
+            # best-of-2: the measurement windows are seconds long and other
+            # box activity (e.g. the chip watcher's probe subprocess) can
+            # land a CPU burst inside one — the tick-floor capacity ceiling
+            # makes the BEST round the meaningful number, not the average
+            return max(one_round(), one_round())
 
         tps_solo = measure("solo")
         tps_duo = measure("duo")
