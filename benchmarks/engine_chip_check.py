@@ -69,7 +69,8 @@ def _stage_decode_composed():
         k_pool = M.make_kv_pool(shape, "int8")
         v_pool = M.make_kv_pool(shape, "int8")
         _, pk, pv = M.prefill(params, cfg, toks16, jnp.int32(16), page_size)
-        k_pool, v_pool = M.write_pages(k_pool, v_pool, pk, pv,
+        # prefill returns batched [L, B, n_pages, ...]; row 0 is our prompt
+        k_pool, v_pool = M.write_pages(k_pool, v_pool, pk[:, 0], pv[:, 0],
                                        jnp.asarray([3], jnp.int32))
         pools.append((k_pool, v_pool))
     pt = jnp.asarray([[3, 0, 0, 0], [0, 0, 0, 0]], jnp.int32)

@@ -7,9 +7,18 @@ admission, slot lifecycle and KV page accounting live in the C++ core
 
     loop:
       admit queued requests into free slots  (C++ decides, all-or-nothing)
-      for each admission: bucketed prefill -> scatter KV pages -> first token
+      group prefilling slots (short prompts by bucket, long ones by chunk
+        offset) -> ONE fused prefill per group -> one fused KV-page scatter
+        -> one batched first-token sample per group
       one fused decode_step over ALL slots  (static shapes, no recompiles)
       commit sampled tokens (C++ grows pages; reports finish/OOM)
+
+    Prefill batching (Orca/Sarathi-style iteration-level scheduling): an
+    N-way burst of same-bucket prompts costs one [N, bucket] dispatch
+    instead of N serialized batch-1 dispatches, and several long prompts
+    advance one chunk each in a single call — the TTFT lever under bursty
+    load (PAPERS.md).  Observability: stats.prefill_dispatches /
+    prefill_rows / prefill_batch_hist.
 
 Continuous batching means a long generation never blocks a short one: slots
 free individually and the queue drains into them mid-flight.
@@ -243,6 +252,14 @@ class Engine:
         self._wake = threading.Event()
         self._key = jax.random.PRNGKey(engine_config.seed)
         self._sample_calls = 0
+        # O(1) cancel: future -> rid, maintained at submit/finish so a
+        # cancel storm never scans _requests under the lock
+        self._future_rid: dict[Future, int] = {}
+        # prefill batching counters (stats): fused dispatches issued, total
+        # prompt rows they carried, and a batch-size histogram
+        self._prefill_dispatches = 0
+        self._prefill_rows_total = 0
+        self._prefill_batch_hist: dict[int, int] = {}
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._jax = jax
@@ -289,6 +306,7 @@ class Engine:
                 future=fut, submitted_at=time.perf_counter(), page_hashes=hashes,
                 stream=stream, context=list(tokens), adapter_id=aid,
             )
+            self._future_rid[fut] = rid
         # lookup eligibility stops one page short of the prompt end: prefill
         # must compute at least the final prompt token to produce the logits
         # the first sampled token comes from
@@ -297,6 +315,7 @@ class Engine:
                                    hashes[:n_lookup]):
             with self._lock:
                 del self._requests[rid]
+                self._future_rid.pop(fut, None)
             raise RequestError(
                 f"prompt+generation ({len(tokens)}+{max_new_tokens}) exceeds engine capacity "
                 f"({self.ec.max_pages_per_slot * self.ec.page_size} tokens/slot)"
@@ -339,20 +358,20 @@ class Engine:
         request already finished."""
         queued_result = None
         with self._lock:
-            hit = None
-            for rid, pending in self._requests.items():
-                if pending.future is future:
-                    hit = (rid, pending)
-                    break
-            if hit is None:
+            # O(1) future -> rid index (maintained at submit/finish): cancel
+            # storms from disconnecting clients don't scan _requests under
+            # the lock the hot loop takes
+            rid = self._future_rid.get(future)
+            pending = self._requests.get(rid) if rid is not None else None
+            if pending is None:
                 return False
-            rid, pending = hit
             pending.cancelled = True
             if rid not in self._slot_req.values():
                 # still queued: resolve now — no slot will free it for us.
                 # (the C++ queue entry is reaped at admission: pending gone
                 # -> the slot is released untouched)
                 self._requests.pop(rid)
+                self._future_rid.pop(future, None)
                 queued_result = {
                     "tokens": [], "num_tokens": 0, "truncated": False,
                     "cancelled": True, "ttft_s": 0.0,
@@ -406,6 +425,9 @@ class Engine:
             "free_pages": self.batcher.free_pages,
             "spec_proposed": self._spec_proposed,
             "spec_accepted": self._spec_accepted,
+            "prefill_dispatches": self._prefill_dispatches,
+            "prefill_rows": self._prefill_rows_total,
+            "prefill_batch_hist": dict(self._prefill_batch_hist),
             **self.batcher.cache_stats(),
         }
 
@@ -415,7 +437,12 @@ class Engine:
         for b in PREFILL_BUCKETS:
             if n <= b:
                 return b
-        return PREFILL_BUCKETS[-1]
+        # past the largest static bucket (prefill_chunk may exceed it):
+        # round up to the page grid so the single-shot path still covers the
+        # whole prompt — silently reusing PREFILL_BUCKETS[-1] would truncate
+        # a 1025-token prompt to 1024 (regression-tested at that boundary)
+        ps = self.ec.page_size
+        return -(-n // ps) * ps
 
     def _next_key(self):
         if self.ec.temperature <= 0.0:
@@ -426,82 +453,116 @@ class Engine:
         self._sample_calls += 1
         return self._jax.random.fold_in(self._key, self._sample_calls)
 
-    def _sample_one(self, logits) -> int:
-        """Sample the first token from a [1, V] device logits array."""
-        tok = sample_tokens(logits, self._next_key(), self.ec.temperature)
-        return int(np.asarray(tok)[0])
+    def _count_prefill(self, rows: int) -> None:
+        """One fused prefill dispatch carrying ``rows`` prompt rows."""
+        self._prefill_dispatches += 1
+        self._prefill_rows_total += rows
+        self._prefill_batch_hist[rows] = self._prefill_batch_hist.get(rows, 0) + 1
 
-    def _prefill_tick(self, slot: int) -> None:
-        """Advance one slot's prefill by at most one chunk.
-
-        Short prompts (≤ prefill_chunk) run the single-shot bucketed prefill;
-        long ones process one page-aligned chunk per tick so the decode step
-        for already-active slots interleaves — no head-of-line stall.
-        """
+    def _prefill_short_group(self, slots: list, bucket: int) -> None:
+        """ONE fused dispatch for every same-bucket short prompt: a
+        [B, bucket] prefill, one write_pages scatter of all rows' owned
+        pages (unowned tails route to the trash page 0), and one batched
+        first-token sample — a single blocking transfer instead of B
+        round-trips, which also preserves the host-mirror aliasing fence
+        (_activate_decode mutations happen only after it returns)."""
         jnp = self._jnp
-        rid = self._slot_req[slot]
-        pending = self._requests[rid]
-        plen = len(pending.tokens)
         ps = self.ec.page_size
-        owned = self._pages_for(plen)
-        table_row = self._prefill_rows[slot]  # fetched once at admission
-
-        if self._prefilling[slot] == 0 and plen <= self.ec.prefill_chunk:
-            bucket = self._bucket(plen)
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :plen] = pending.tokens
-            logits, pk, pv = prefill(
-                self.params, self.config, jnp.asarray(toks),
-                jnp.int32(plen), ps,
-                lora_params=self._lora,
-                adapter_ids=(jnp.asarray([pending.adapter_id], jnp.int32)
-                             if self._lora is not None else None),
-            )
-            # prefill produced bucket/page_size pages; slot owns
-            # ceil(plen/page_size) — scatter only the owned prefix
-            self.k_pool, self.v_pool = write_pages(
-                self.k_pool, self.v_pool,
-                pk[:, :owned], pv[:, :owned], jnp.asarray(table_row[:owned]),
-            )
+        B = len(slots)
+        n_pages = bucket // ps
+        toks = np.zeros((B, bucket), np.int32)
+        lens = np.zeros((B,), np.int32)
+        rows = np.zeros((B, n_pages), np.int32)
+        aids = np.zeros((B,), np.int32)
+        for i, slot in enumerate(slots):
+            pending = self._requests[self._slot_req[slot]]
+            plen = len(pending.tokens)
+            toks[i, :plen] = pending.tokens
+            lens[i] = plen
+            aids[i] = pending.adapter_id
+            # prefill produces bucket/page_size pages per row; the slot owns
+            # ceil(plen/page_size) — the tail stays 0 (trash page)
+            owned = self._pages_for(plen)
+            rows[i, :owned] = self._prefill_rows[slot][:owned]
+        logits, pk, pv = prefill(
+            self.params, self.config, jnp.asarray(toks), jnp.asarray(lens), ps,
+            lora_params=self._lora,
+            adapter_ids=(jnp.asarray(aids) if self._lora is not None else None),
+        )
+        self._count_prefill(B)
+        self.k_pool, self.v_pool = write_pages(
+            self.k_pool, self.v_pool, pk, pv, jnp.asarray(rows))
+        sampled = np.asarray(
+            sample_tokens(logits, self._next_key(), self.ec.temperature))
+        now = time.perf_counter()
+        for i, slot in enumerate(slots):
+            pending = self._requests[self._slot_req[slot]]
             del self._prefilling[slot]
-            first = self._sample_one(logits)
-            pending.first_token_at = time.perf_counter()
-            self._activate_decode(slot, plen, owned, table_row)
-            self._commit(slot, first)
-            return
+            pending.first_token_at = now
+            plen = int(lens[i])
+            self._activate_decode(slot, plen, self._pages_for(plen),
+                                  self._prefill_rows[slot])
+            self._commit(slot, int(sampled[i]))
 
-        off = self._prefilling[slot]
+    def _prefill_chunk_group(self, slots: list, off: int) -> None:
+        """ONE fused chunked-prefill dispatch for every long/cache-resumed
+        prompt at the same chunk offset (same static hist geometry): each
+        row advances one page-aligned chunk; rows whose chunk completes the
+        prompt sample their first token from the shared batched sample."""
+        jnp = self._jnp
+        ps = self.ec.page_size
         C = self.ec.prefill_chunk
-        toks = np.zeros((1, C), np.int32)
-        chunk = pending.tokens[off:off + C]
-        toks[0, :len(chunk)] = chunk
+        B = len(slots)
         first_page = off // ps
-        n_chunk_pages = C // ps
-        # pages past the owned range (final-chunk padding) scatter into the
-        # reserved trash page 0; reads past `length` are masked anyway
-        chunk_ids = np.zeros((n_chunk_pages,), np.int32)
-        real = max(0, min(owned - first_page, n_chunk_pages))
-        chunk_ids[:real] = table_row[first_page:first_page + real]
-        n_hist = first_page + n_chunk_pages
-        hist_ids = np.zeros((n_hist,), np.int32)
-        hreal = min(owned, n_hist)
-        hist_ids[:hreal] = table_row[:hreal]
+        n_chunk = C // ps
+        n_hist = first_page + n_chunk
+        toks = np.zeros((B, C), np.int32)
+        lens = np.zeros((B,), np.int32)
+        aids = np.zeros((B,), np.int32)
+        chunk_ids = np.zeros((B, n_chunk), np.int32)
+        hist_ids = np.zeros((B, n_hist), np.int32)
+        table_rows = {}
+        for i, slot in enumerate(slots):
+            pending = self._requests[self._slot_req[slot]]
+            plen = len(pending.tokens)
+            chunk = pending.tokens[off:off + C]
+            toks[i, :len(chunk)] = chunk
+            lens[i] = plen
+            aids[i] = pending.adapter_id
+            owned = self._pages_for(plen)
+            table_rows[slot] = row = self._prefill_rows[slot]
+            # pages past the owned range (final-chunk padding) scatter into
+            # the reserved trash page 0; reads past `length` are masked
+            real = max(0, min(owned - first_page, n_chunk))
+            chunk_ids[i, :real] = row[first_page:first_page + real]
+            hreal = min(owned, n_hist)
+            hist_ids[i, :hreal] = row[:hreal]
         logits, self.k_pool, self.v_pool = prefill_chunk(
             self.params, self.config, jnp.asarray(toks), jnp.int32(off),
-            jnp.int32(plen), jnp.asarray(chunk_ids), jnp.asarray(hist_ids),
+            jnp.asarray(lens), jnp.asarray(chunk_ids), jnp.asarray(hist_ids),
             self.k_pool, self.v_pool, ps,
             lora_params=self._lora,
-            adapter_ids=(jnp.asarray([pending.adapter_id], jnp.int32)
-                         if self._lora is not None else None),
+            adapter_ids=(jnp.asarray(aids) if self._lora is not None else None),
         )
-        if off + C >= plen:
+        self._count_prefill(B)
+        finishing = [i for i in range(B) if off + C >= int(lens[i])]
+        if finishing:
+            # rows mid-prompt get sampled too (greedy ignores the key; their
+            # values are simply unused) — still one blocking transfer total
+            sampled = np.asarray(
+                sample_tokens(logits, self._next_key(), self.ec.temperature))
+            now = time.perf_counter()
+        for i, slot in enumerate(slots):
+            if i not in finishing:
+                self._prefilling[slot] = off + C
+                continue
+            pending = self._requests[self._slot_req[slot]]
             del self._prefilling[slot]
-            first = self._sample_one(logits)
-            pending.first_token_at = time.perf_counter()
-            self._activate_decode(slot, plen, owned, table_row)
-            self._commit(slot, first)
-        else:
-            self._prefilling[slot] = off + C
+            pending.first_token_at = now
+            plen = int(lens[i])
+            self._activate_decode(slot, plen, self._pages_for(plen),
+                                  table_rows[slot])
+            self._commit(slot, int(sampled[i]))
 
     def _loop(self) -> None:
         # ENGINE_TICK_FLOOR_S: minimum wall time per engine tick that did
@@ -545,17 +606,32 @@ class Engine:
                 self._prefilling[slot] = cached * self.ec.page_size
                 self._prefill_rows[slot] = self.batcher.slot_pages(slot)
 
-            # --- one prefill chunk per prefilling slot
+            # --- fused prefill: group prefilling slots (short prompts by
+            # bucket, long/cache-resumed ones by chunk offset) and issue ONE
+            # dispatch per group instead of one per slot — an N-way burst of
+            # same-bucket prompts is a single [N, bucket] prefill
+            shorts: dict[int, list] = {}
+            chunked: dict[int, list] = {}
             for slot in list(self._prefilling):
                 did_work = True
-                if self._requests[self._slot_req[slot]].cancelled:
+                pending = self._requests[self._slot_req[slot]]
+                if pending.cancelled:
                     # mid-prefill cancel: pool pages are partially written —
                     # free them WITHOUT caching
                     del self._prefilling[slot]
                     self._finish(slot, self._slot_req[slot], truncated=False,
                                  cancelled=True, cache_ok=False)
                     continue
-                self._prefill_tick(slot)
+                off = self._prefilling[slot]
+                plen = len(pending.tokens)
+                if off == 0 and plen <= self.ec.prefill_chunk:
+                    shorts.setdefault(self._bucket(plen), []).append(slot)
+                else:
+                    chunked.setdefault(off, []).append(slot)
+            for bucket in sorted(shorts):
+                self._prefill_short_group(shorts[bucket], bucket)
+            for off in sorted(chunked):
+                self._prefill_chunk_group(chunked[off], off)
 
             # --- one decode step over slots whose prefill is complete
             # (_slot_req membership == slot active; no C snapshot needed)
@@ -750,8 +826,9 @@ class Engine:
 
     def _finish(self, slot: int, rid: int, truncated: bool,
                 cancelled: bool = False, cache_ok: bool = True) -> None:
-        with self._lock:  # cancel() iterates _requests under this lock
+        with self._lock:  # cancel() resolves futures under this lock
             pending = self._requests.pop(rid)
+            self._future_rid.pop(pending.future, None)
             self._slot_req.pop(slot, None)
         self._pt_host[slot, :] = 0
         self._len_host[slot] = 0

@@ -416,23 +416,28 @@ def pool_layer(pool, l):
 
 
 @functools.partial(jax.jit, static_argnames=("config", "page_size"))
-def prefill(params, config: DecoderConfig, tokens, length, page_size: int,
+def prefill(params, config: DecoderConfig, tokens, lengths, page_size: int,
             lora_params=None, adapter_ids=None):
-    """Process one prompt (batch of 1, padded to a bucket).
+    """Process a batch of same-bucket prompts in ONE dispatch.
 
-    tokens: [1, S] int32 (padded); length: [] int32 actual prompt length.
-    Returns (logits_last [1, vocab], paged_k, paged_v) where paged_k/v are
-    [layers, S/page_size, Hkv, page_size, hd] — ready to scatter into the
-    global page pool at the slot's page ids.
+    tokens: [B, S] int32 (each row padded to the shared bucket S); lengths:
+    [B] int32 per-row actual prompt lengths (a scalar broadcasts — the old
+    batch-1 call shape keeps working).  adapter_ids: [B] int32 per-row LoRA
+    adapter, so mixed-adapter groups still fuse.  Returns (logits_last
+    [B, vocab], paged_k, paged_v) where paged_k/v are
+    [layers, B, S/page_size, Hkv, page_size, hd] — ready to scatter into the
+    global page pool at each row's page ids via ``write_pages``.
     """
     c = config
     B, S = tokens.shape
     lora = None if lora_params is None else (lora_params, adapter_ids)
-    positions = jnp.arange(S, dtype=jnp.int32)[None, :]
+    lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32).reshape(-1), (B,))
+    pos_row = jnp.arange(S, dtype=jnp.int32)
+    positions = jnp.broadcast_to(pos_row[None, :], (B, S))
     x = _embed(params, c, tokens)
     causal = jnp.tril(jnp.ones((S, S), bool))[None]
-    valid = (positions < length)[:, None, :]
-    mask = causal & valid
+    valid = pos_row[None, None, :] < lengths[:, None, None]
+    mask = causal & valid  # [B, S, S]
     ks, vs = [], []
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
@@ -441,80 +446,104 @@ def prefill(params, config: DecoderConfig, tokens, length, page_size: int,
         vs.append(v)
         x = _block(params, l, c, x, k, v, positions, mask, lora=lora)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
-    # logits at the last REAL token (length-1)
-    last = x[jnp.arange(B), length - 1]
+    # logits at each row's last REAL token (lengths-1)
+    last = x[jnp.arange(B), lengths - 1]
     logits = (last @ _w(params["unembed"])).astype(jnp.float32)
     n_pages = S // page_size
     paged_k = (jnp.stack(ks)
-               .reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)[:, 0]
-               .transpose(0, 1, 3, 2, 4))  # -> [L, n_pages, Hkv, ps, hd]
+               .reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)
+               .transpose(0, 1, 2, 4, 3, 5))  # -> [L, B, n_pages, Hkv, ps, hd]
     paged_v = (jnp.stack(vs)
-               .reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)[:, 0]
-               .transpose(0, 1, 3, 2, 4))
+               .reshape(c.n_layers, B, n_pages, page_size, c.n_kv_heads, c.head_dim)
+               .transpose(0, 1, 2, 4, 3, 5))
     return logits, paged_k, paged_v
 
 
 @functools.partial(jax.jit, donate_argnames=("k_pool", "v_pool"))
 def write_pages(k_pool, v_pool, paged_k, paged_v, page_ids):
-    """Scatter a prompt's paged KV into the global pools at page_ids.
+    """Scatter prefilled KV into the global pools at page_ids.
 
     k_pool/v_pool: [layers, num_pages, Hkv, page_size, hd] (donated).
-    page_ids: [n_pages] int32.
+    Batched form: paged_k/v [layers, B, n, Hkv, page_size, hd] with page_ids
+    [B, n] — the whole prefill group lands in one fused scatter (rows route
+    unowned tail pages to the reserved trash page 0).  The single-prompt
+    form (paged [layers, n, ...], page_ids [n]) also works.
     """
+    if page_ids.ndim == 2:
+        L = paged_k.shape[0]
+        paged_k = paged_k.reshape((L, -1) + paged_k.shape[3:])
+        paged_v = paged_v.reshape((L, -1) + paged_v.shape[3:])
+        page_ids = page_ids.reshape(-1)
     idx = (slice(None), page_ids)
     return pool_set(k_pool, idx, paged_k), pool_set(v_pool, idx, paged_v)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "page_size"),
                    donate_argnames=("k_pool", "v_pool"))
-def prefill_chunk(params, config: DecoderConfig, tokens, start, length,
+def prefill_chunk(params, config: DecoderConfig, tokens, start, lengths,
                   chunk_page_ids, hist_page_ids, k_pool, v_pool, page_size: int,
                   lora_params=None, adapter_ids=None):
-    """Process one page-aligned chunk of a long prompt against the page pool.
+    """Advance a BATCH of long prompts one page-aligned chunk each, in one
+    dispatch against the page pool.
 
     Long prompts are prefilled in fixed-size chunks interleaved with decode
     steps so a single long prefill never head-of-line-blocks the continuous
     batcher (the stall Triton-class servers avoid with chunked prefill;
-    SURVEY.md §3.4 hot path).
+    SURVEY.md §3.4 hot path).  Rows share the chunk offset (same static hist
+    geometry), so the engine groups chunked slots by offset.
 
-    tokens: [1, C] int32 chunk (padded past the prompt end); start: [] int32
-    offset of this chunk in the prompt; length: [] int32 total prompt length;
-    chunk_page_ids: [C/page_size] pool pages to scatter this chunk's KV into
-    (unowned tail slots point at the trash page 0); hist_page_ids: [H] pool
-    pages covering positions [0, start+C) — H is static, so each chunk index
-    compiles once and attention is O(start+C), not O(max_pages).
+    tokens: [B, C] int32 chunks (padded past each prompt end); start: []
+    int32 shared offset of this chunk in the prompts; lengths: [B] int32
+    per-row total prompt lengths (scalar broadcasts); chunk_page_ids:
+    [B, C/page_size] pool pages to scatter each row's chunk KV into (unowned
+    tail slots point at the trash page 0); hist_page_ids: [B, H] pool pages
+    covering positions [0, start+C) per row — H is static, so each chunk
+    index compiles once and attention is O(start+C), not O(max_pages).
+    Rows' owned pages are disjoint (slots own their pages; cache-shared
+    prefix pages are read-only and never appear in chunk_page_ids), so the
+    fused scatters cannot collide except on the trash page.
 
-    Returns (logits [1, vocab] at position length-1 — garbage unless this is
-    the final chunk — , k_pool, v_pool).
+    Returns (logits [B, vocab] at each row's position length-1 — garbage
+    unless that row's final chunk — , k_pool, v_pool).
     """
     c = config
     B, C = tokens.shape
     lora = None if lora_params is None else (lora_params, adapter_ids)
-    H = hist_page_ids.shape[0]
+    lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32).reshape(-1), (B,))
+    if chunk_page_ids.ndim == 1:  # legacy batch-1 call shape
+        chunk_page_ids = jnp.broadcast_to(chunk_page_ids[None, :],
+                                          (B,) + chunk_page_ids.shape)
+    if hist_page_ids.ndim == 1:
+        hist_page_ids = jnp.broadcast_to(hist_page_ids[None, :],
+                                         (B,) + hist_page_ids.shape)
+    H = hist_page_ids.shape[1]
     T = H * page_size
-    positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]
+    n_chunk = C // page_size
+    positions = start + jnp.broadcast_to(
+        jnp.arange(C, dtype=jnp.int32)[None, :], (B, C))
     x = _embed(params, c, tokens)
     t_range = jnp.arange(T, dtype=jnp.int32)
-    # causal across chunks + clipped to the real prompt
-    mask = (t_range[None, None, :] <= positions[:, :, None]) & (t_range < length)[None, None, :]
+    # causal across chunks + clipped to each row's real prompt
+    mask = ((t_range[None, None, :] <= positions[:, :, None])
+            & (t_range[None, None, :] < lengths[:, None, None]))
     for l in range(c.n_layers):
         h = _rms_norm(x, params["ln_attn"][l], c.norm_eps)
         k, v = _kv_proj(params, l, c, h, positions, lora=lora)
         k_pool = pool_set(k_pool, (l, chunk_page_ids),
-                          k.reshape(C // page_size, page_size, c.n_kv_heads, c.head_dim)
-                           .transpose(0, 2, 1, 3))  # [n, Hkv, ps, hd]
+                          k.reshape(B, n_chunk, page_size, c.n_kv_heads, c.head_dim)
+                           .transpose(0, 1, 3, 2, 4))  # [B, n, Hkv, ps, hd]
         v_pool = pool_set(v_pool, (l, chunk_page_ids),
-                          v.reshape(C // page_size, page_size, c.n_kv_heads, c.head_dim)
-                           .transpose(0, 2, 1, 3))
-        # gather [H, Hkv, ps, hd] -> [1, T, Hkv, hd] (token-major cache)
+                          v.reshape(B, n_chunk, page_size, c.n_kv_heads, c.head_dim)
+                           .transpose(0, 1, 3, 2, 4))
+        # gather [B, H, Hkv, ps, hd] -> [B, T, Hkv, hd] (token-major cache)
         k_cache = (pool_get(k_pool, (l, hist_page_ids))
-                   .transpose(0, 2, 1, 3).reshape(1, T, c.n_kv_heads, c.head_dim))
+                   .transpose(0, 1, 3, 2, 4).reshape(B, T, c.n_kv_heads, c.head_dim))
         v_cache = (pool_get(v_pool, (l, hist_page_ids))
-                   .transpose(0, 2, 1, 3).reshape(1, T, c.n_kv_heads, c.head_dim))
+                   .transpose(0, 1, 3, 2, 4).reshape(B, T, c.n_kv_heads, c.head_dim))
         x = _block(params, l, c, x, k_cache, v_cache, positions, mask,
                    lora=lora)
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
-    last = jnp.clip(length - 1 - start, 0, C - 1)
+    last = jnp.clip(lengths - 1 - start, 0, C - 1)
     logits = (x[jnp.arange(B), last] @ _w(params["unembed"])).astype(jnp.float32)
     return logits, k_pool, v_pool
 

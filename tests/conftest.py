@@ -15,6 +15,11 @@ import os
 # so workload code must ALSO route through kubeflow_tpu.parallel.distributed.initialize
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+# jax < 0.5 has no jax_num_cpu_devices config; the XLA flag is the portable
+# spelling and must land before the backend initializes
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
 
 # persistent XLA compile cache: the fast lane is compile-dominated (measured
 # 562s cold vs ~1/3 of that warm on this 1-CPU box — VERDICT r1 #10's <300s
@@ -45,7 +50,10 @@ if shutil.which("mpirun") is None:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # jax < 0.5: the XLA_FLAGS fallback above covers it
+    pass
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 
 import pytest  # noqa: E402

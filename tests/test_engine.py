@@ -192,8 +192,9 @@ def test_paged_decode_matches_full_forward(params):
     logits, pk, pv = M.prefill(params, CFG, jnp.asarray(toks[:, :plen]), jnp.int32(plen), page_size)
     np.testing.assert_allclose(np.asarray(logits)[0], full[0, plen - 1], rtol=2e-2, atol=2e-2)
 
+    # prefill returns batched [L, B, n_pages, ...]; row 0 is the prompt
     page_ids = jnp.asarray([3, 5], jnp.int32)
-    k_pool, v_pool = M.write_pages(k_pool, v_pool, pk, pv, page_ids)
+    k_pool, v_pool = M.write_pages(k_pool, v_pool, pk[:, 0], pv[:, 0], page_ids)
     B, max_pages = 3, 4
     pt = np.zeros((B, max_pages), np.int32)
     pt[1, :2] = [3, 5]
@@ -575,7 +576,8 @@ def test_int8_kv_pool_decode_logits_close_to_bf16(params):
         k_pool = M.make_kv_pool((CFG.n_layers, 16, CFG.n_kv_heads, page_size, CFG.head_dim), quant)
         v_pool = M.make_kv_pool((CFG.n_layers, 16, CFG.n_kv_heads, page_size, CFG.head_dim), quant)
         _, pk, pv = M.prefill(params, CFG, jnp.asarray(toks[:, :plen]), jnp.int32(plen), page_size)
-        k_pool, v_pool = M.write_pages(k_pool, v_pool, pk, pv, jnp.asarray([3, 5], jnp.int32))
+        k_pool, v_pool = M.write_pages(k_pool, v_pool, pk[:, 0], pv[:, 0],
+                                       jnp.asarray([3, 5], jnp.int32))
         pt = np.zeros((2, 4), np.int32)
         pt[1, :2] = [3, 5]
         tok = np.zeros((2,), np.int32)
@@ -688,7 +690,8 @@ def test_decode_step_paged_int8_matches_gather_int8(params):
         k_pool = M.make_kv_pool(shape, "int8")
         v_pool = M.make_kv_pool(shape, "int8")
         _, pk, pv = M.prefill(params, CFG, jnp.asarray(toks8), jnp.int32(8), page_size)
-        k_pool, v_pool = M.write_pages(k_pool, v_pool, pk, pv, jnp.asarray([3], jnp.int32))
+        k_pool, v_pool = M.write_pages(k_pool, v_pool, pk[:, 0], pv[:, 0],
+                                       jnp.asarray([3], jnp.int32))
         pools.append((k_pool, v_pool))
     pt = jnp.asarray([[3, 0, 0, 0], [0, 0, 0, 0]], jnp.int32)
     lens = jnp.asarray([8, 0], jnp.int32)
