@@ -32,11 +32,14 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
-from ..errors import RequestError
+from ..errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
+                      NonFiniteLogits, RequestError, TickFailure)
+from .faults import ChaosInjector, FaultConfig
 from .model import (DecoderConfig, decode_step, decode_step_k, prefill,
                     prefill_chunk, sample_tokens, write_pages)
 from .native import NativeBatcher
@@ -114,6 +117,37 @@ class EngineConfig:
     speculative: Optional[str] = None
     spec_max_draft: int = 4
     spec_ngram: int = 2
+    # ---- fault tolerance (README "Failure model") -----------------------
+    # admission control: submissions past this many queued-unadmitted
+    # requests fail fast with EngineOverloaded (0 = unbounded)
+    max_queue_depth: int = 0
+    # deadline applied to requests that don't pass one explicitly (seconds
+    # from submit; None = no deadline).  Expired requests are shed before
+    # their first token with DeadlineExceeded.
+    default_deadline_s: Optional[float] = None
+    # a request whose tick (prefill group / decode step) raises is retried
+    # in place; after this many CONSECUTIVE failures it is rejected with
+    # TickFailure instead (a successful commit resets the count)
+    max_consecutive_failures: int = 3
+    # watchdog supervisor: checks the loop thread every interval; a loop
+    # that died (escaped exception) has its in-flight futures failed and —
+    # when watchdog_restart — is restarted with a fresh decode state.  A
+    # loop stuck inside one tick longer than hang_timeout_s is DEGRADED,
+    # then epoch-fenced and restarted the same way.  hang_timeout_s must
+    # dwarf worst-case jit compile time: the first tick of a new shape
+    # legitimately blocks for minutes on a cold cache.
+    watchdog_interval_s: float = 0.5
+    hang_timeout_s: float = 300.0
+    watchdog_restart: bool = True
+    # stop(): how long the graceful drain waits for in-flight slots to
+    # finish before failing them with EngineShutdown
+    drain_timeout_s: float = 10.0
+    # verify per-row logit finiteness before committing sampled tokens
+    # (costs one extra [B]-bool device fetch per tick; a NaN row fails only
+    # its own slot with NonFiniteLogits instead of emitting garbage)
+    logit_guard: bool = True
+    # deterministic chaos injection (faults.py) — test/bench substrate
+    chaos: Optional[FaultConfig] = None
 
 
 @dataclasses.dataclass
@@ -140,6 +174,20 @@ class _Pending:
     context: list = None
     ngram_index: dict = dataclasses.field(default_factory=dict)
     ngram_p: int = 0
+    # absolute perf_counter deadline (None = none); expired requests are
+    # shed with DeadlineExceeded before their first token
+    deadline: Optional[float] = None
+    # consecutive tick failures while this request was in the offending
+    # group; reset on every successful commit, rejected at the config cap
+    failures: int = 0
+
+
+class _StaleThread(BaseException):
+    """Raised inside a superseded loop thread at its first state-mutation
+    attempt after an epoch-fenced restart.  BaseException so the isolation
+    boundaries (which catch Exception) can't contain it: the stale thread
+    exits instead of committing tokens into a slot the restarted loop may
+    have reassigned."""
 
 
 class _StreamHandle:
@@ -262,6 +310,31 @@ class Engine:
         self._prefill_batch_hist: dict[int, int] = {}
         self._spec_proposed = 0
         self._spec_accepted = 0
+        # ---- fault tolerance state --------------------------------------
+        self._chaos = (ChaosInjector(engine_config.chaos)
+                       if engine_config.chaos is not None else None)
+        self._draining = False
+        self._stopped = False
+        # epoch fence: a restarted loop bumps this; a stale (previously
+        # hung) thread that wakes up sees the mismatch and exits without
+        # touching engine state
+        self._epoch = 0
+        self._last_tick_ts = time.monotonic()
+        self._ticks = 0
+        self._ticks_failed = 0
+        self._requests_shed = 0      # deadline expiry before first token
+        self._requests_rejected = 0  # EngineOverloaded at submit
+        self._requests_failed = 0    # TickFailure / NonFiniteLogits / shutdown
+        self._nan_rows = 0
+        self._restarts = 0
+        # count of in-flight requests with failures > 0, so health() reads
+        # DEGRADED without an O(requests) scan under the hot-loop lock
+        self._retrying = 0
+        self._wd_stop = threading.Event()
+        self._wd_thread: Optional[threading.Thread] = None
+        # loop threads record their epoch here; state-mutation points check
+        # it so a stale (superseded) thread dies instead of writing
+        self._tls = threading.local()
         self._jax = jax
         self._jnp = jnp
 
@@ -269,27 +342,117 @@ class Engine:
 
     def start(self) -> None:
         self._running = True
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._draining = False
+        self._last_tick_ts = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, args=(self._epoch,), daemon=True)
         self._thread.start()
+        if self.ec.watchdog_interval_s > 0 and self._wd_thread is None:
+            self._wd_stop.clear()
+            self._wd_thread = threading.Thread(target=self._watchdog,
+                                               daemon=True)
+            self._wd_thread.start()
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = True) -> None:
+        """Graceful drain then hard stop.
+
+        New submissions are refused (EngineShutdown) immediately; requests
+        still queued behind the slots are failed with EngineShutdown (never
+        silently stranded); in-flight slots get up to ``drain_timeout_s``
+        to finish, then are failed too.  ``drain=False`` skips the wait."""
+        with self._lock:  # atomic with generate_async's shutdown check
+            self._draining = True  # generate_async refuses; health DRAINING
+        # retire the watchdog FIRST: joining it fences any _supervise in
+        # flight, so self._thread cannot be swapped for a restarted loop
+        # between the join and batcher.close() below
+        self._wd_stop.set()
+        if self._wd_thread is not None:
+            self._wd_thread.join(timeout=5)
+            self._wd_thread = None
+        # fail queued-unadmitted work NOW: no slot will ever free it if the
+        # drain below times out, and the C++ queue entries are reaped at
+        # admission (pending gone -> slot released untouched)
+        self._fail_unassigned(EngineShutdown("engine stopping"))
+        t = self._thread
+        if drain and t is not None and t.is_alive():
+            deadline = time.monotonic() + self.ec.drain_timeout_s
+            while self._slot_req and time.monotonic() < deadline:
+                time.sleep(0.01)
         self._running = False
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
+        if t is not None:
+            t.join(timeout=10)
+        # anything still in flight after the hard timeout: fail, don't hang
+        for slot in list(self._slot_req):
+            self._fail_slot(slot, EngineShutdown("engine stopped"))
+        self._fail_unassigned(EngineShutdown("engine stopped"))
         self.batcher.close()
+        self._stopped = True
+        self._draining = False  # drain is over: health reports DEAD now
+
+    def health(self) -> dict:
+        """Engine health state machine (README "Failure model").
+
+        SERVING   — loop thread alive, no distress signals
+        DEGRADED  — alive but a request is mid-retry after tick failures,
+                    or the current tick has been stuck past hang_timeout_s
+        DRAINING  — stop() in progress
+        DEAD      — loop thread not running (never started, stopped, or
+                    died with restart disabled)
+        """
+        if self._draining:
+            state = "DRAINING"
+        else:
+            t = self._thread
+            age = time.monotonic() - self._last_tick_ts
+            if not self._running or t is None or not t.is_alive():
+                state = "DEAD"
+            elif (self._slot_req or self._requests) and age > self.ec.hang_timeout_s:
+                state = "DEGRADED"
+            else:
+                # O(1) gauge, no lock: _retrying tracks requests mid-retry
+                # (maintained by _note_group_failure/_reset_failures) so a
+                # /metrics scrape never scans a deep backlog under the
+                # hot-loop lock
+                state = "DEGRADED" if self._retrying > 0 else "SERVING"
+        return {
+            "state": state,
+            "last_tick_age_s": round(time.monotonic() - self._last_tick_ts, 4),
+            "ticks": self._ticks,
+            "ticks_failed": self._ticks_failed,
+            "restarts": self._restarts,
+        }
 
     def generate_async(self, tokens: list[int], max_new_tokens: int = 32,
                        stream: Optional["queue.Queue"] = None,
-                       adapter: Optional[str] = None) -> Future:
+                       adapter: Optional[str] = None,
+                       deadline: Optional[float] = None) -> Future:
         """Submit a prompt; the Future resolves to a result dict.
 
         ``stream``: optional queue that receives each token id as it is
-        committed, then a final ``(None, result)`` sentinel.  ``adapter``:
-        name of a loaded LoRA adapter to decode this request with (None =
-        base model; unknown names raise)."""
+        committed, then a final ``(None, result)`` sentinel (or ``(None,
+        exc)`` if the request failed).  ``adapter``: name of a loaded LoRA
+        adapter to decode this request with (None = base model; unknown
+        names raise).  ``deadline``: seconds from now; if the request has
+        not produced its first token by then it is shed with
+        DeadlineExceeded (defaults to ``default_deadline_s``).  Raises
+        EngineOverloaded when the queue is at ``max_queue_depth`` and
+        EngineShutdown once stop() has begun."""
         if not tokens:
             raise RequestError("empty prompt")
+        if self._draining or self._stopped:
+            # fast-path: also keeps the overload check below from touching
+            # a closed batcher (RuntimeError) after stop(); the locked
+            # check further down is the authoritative one
+            raise EngineShutdown("engine is stopping")
+        if (self.ec.max_queue_depth > 0
+                and self.batcher.queue_depth >= self.ec.max_queue_depth):
+            self._requests_rejected += 1
+            raise EngineOverloaded(
+                f"queue depth {self.batcher.queue_depth} >= "
+                f"max_queue_depth {self.ec.max_queue_depth}")
+        if deadline is None:
+            deadline = self.ec.default_deadline_s
         aid = 0
         if adapter is not None:
             if adapter not in self.adapters:
@@ -299,12 +462,20 @@ class Engine:
         fut: Future = Future()
         hashes = self._page_hashes(tokens, aid)
         with self._lock:
+            # shutdown check is atomic with registration: stop() flips
+            # _draining under this lock BEFORE failing unassigned work, so
+            # a racing submitter either raises here or registers in time
+            # for stop()'s sweep to fail its future — never stranded
+            if self._draining or self._stopped:
+                raise EngineShutdown("engine is stopping")
             rid = self._next_id
             self._next_id += 1
             self._requests[rid] = _Pending(
                 tokens=list(tokens), max_new_tokens=max_new_tokens,
                 future=fut, submitted_at=time.perf_counter(), page_hashes=hashes,
                 stream=stream, context=list(tokens), adapter_id=aid,
+                deadline=(time.perf_counter() + deadline
+                          if deadline is not None else None),
             )
             self._future_rid[fut] = rid
         # lookup eligibility stops one page short of the prompt end: prefill
@@ -314,7 +485,9 @@ class Engine:
         if not self.batcher.submit(rid, len(tokens), max_new_tokens,
                                    hashes[:n_lookup]):
             with self._lock:
-                del self._requests[rid]
+                # pop, not del: stop()'s shutdown sweep may have already
+                # failed+removed the request in the submit window
+                self._requests.pop(rid, None)
                 self._future_rid.pop(fut, None)
             raise RequestError(
                 f"prompt+generation ({len(tokens)}+{max_new_tokens}) exceeds engine capacity "
@@ -345,9 +518,18 @@ class Engine:
         return out
 
     def generate(self, tokens: list[int], max_new_tokens: int = 32, timeout: float = 300.0,
-                 adapter: Optional[str] = None) -> dict:
-        return self.generate_async(tokens, max_new_tokens,
-                                   adapter=adapter).result(timeout=timeout)
+                 adapter: Optional[str] = None,
+                 deadline: Optional[float] = None) -> dict:
+        fut = self.generate_async(tokens, max_new_tokens, adapter=adapter,
+                                  deadline=deadline)
+        try:
+            return fut.result(timeout=timeout)
+        except FutureTimeoutError:
+            # the caller is gone but the request would keep its slot and KV
+            # pages to the token budget: cancel so the engine reaps it at
+            # its next tick (the queued case frees immediately)
+            self.cancel(fut)
+            raise
 
     def cancel(self, future: Future) -> bool:
         """Cancel the request behind a generate_async future (client went
@@ -388,7 +570,8 @@ class Engine:
 
     def generate_stream(self, tokens: list[int], max_new_tokens: int = 32,
                         timeout: float = 300.0,
-                        adapter: Optional[str] = None) -> Iterator:
+                        adapter: Optional[str] = None,
+                        deadline: Optional[float] = None) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
 
         The last item yielded is the same dict ``generate`` returns (so
@@ -401,7 +584,7 @@ class Engine:
         can be reaped via ``Engine.cancel(stream.future)``."""
         q: queue.Queue = queue.Queue()
         fut = self.generate_async(tokens, max_new_tokens, stream=q,
-                                  adapter=adapter)
+                                  adapter=adapter, deadline=deadline)
 
         def _iter():
             while True:
@@ -411,6 +594,8 @@ class Engine:
                     raise TimeoutError(
                         f"generation stalled past {timeout}s") from None
                 if isinstance(item, tuple) and item[0] is None:
+                    if isinstance(item[1], BaseException):
+                        raise item[1]  # typed engine fault (shed/failed)
                     yield item[1]  # final result dict
                     return
                 yield item
@@ -428,6 +613,14 @@ class Engine:
             "prefill_dispatches": self._prefill_dispatches,
             "prefill_rows": self._prefill_rows_total,
             "prefill_batch_hist": dict(self._prefill_batch_hist),
+            "ticks": self._ticks,
+            "ticks_failed": self._ticks_failed,
+            "requests_shed": self._requests_shed,
+            "requests_rejected": self._requests_rejected,
+            "requests_failed": self._requests_failed,
+            "nan_rows": self._nan_rows,
+            "restarts": self._restarts,
+            **({"chaos": self._chaos.stats()} if self._chaos else {}),
             **self.batcher.cache_stats(),
         }
 
@@ -459,6 +652,24 @@ class Engine:
         self._prefill_rows_total += rows
         self._prefill_batch_hist[rows] = self._prefill_batch_hist.get(rows, 0) + 1
 
+    def _guard_logits(self, logits, row_rids):
+        """Chaos NaN injection + the sample-path logit guard.
+
+        ``row_rids``: request id per leading logits row (-1 = inactive).
+        Returns (logits, ok) where ok is a device [B]-bool — True iff every
+        logit in that row (all trailing axes) is finite — or None when the
+        guard is disabled.  The caller fetches ok alongside the sampled
+        tokens and fails non-finite rows instead of committing them."""
+        jnp = self._jnp
+        if self._chaos is not None:
+            for row in self._chaos.nan_rows(row_rids):
+                logits = logits.at[row].set(jnp.nan)
+        if not self.ec.logit_guard:
+            return logits, None
+        ok = jnp.isfinite(logits).all(
+            axis=tuple(range(1, logits.ndim)))
+        return logits, ok
+
     def _prefill_short_group(self, slots: list, bucket: int) -> None:
         """ONE fused dispatch for every same-bucket short prompt: a
         [B, bucket] prefill, one write_pages scatter of all rows' owned
@@ -484,6 +695,7 @@ class Engine:
             # ceil(plen/page_size) — the tail stays 0 (trash page)
             owned = self._pages_for(plen)
             rows[i, :owned] = self._prefill_rows[slot][:owned]
+        self._check_epoch()  # last fence before touching device pools
         logits, pk, pv = prefill(
             self.params, self.config, jnp.asarray(toks), jnp.asarray(lens), ps,
             lora_params=self._lora,
@@ -492,10 +704,18 @@ class Engine:
         self._count_prefill(B)
         self.k_pool, self.v_pool = write_pages(
             self.k_pool, self.v_pool, pk, pv, jnp.asarray(rows))
+        logits, ok_dev = self._guard_logits(
+            logits, [self._slot_req[s] for s in slots])
         sampled = np.asarray(
             sample_tokens(logits, self._next_key(), self.ec.temperature))
+        ok = np.asarray(ok_dev) if ok_dev is not None else None
         now = time.perf_counter()
         for i, slot in enumerate(slots):
+            if ok is not None and not ok[i]:
+                self._nan_rows += 1
+                self._fail_slot(slot, NonFiniteLogits(
+                    "non-finite logits in prefill sample row"))
+                continue
             pending = self._requests[self._slot_req[slot]]
             del self._prefilling[slot]
             pending.first_token_at = now
@@ -537,6 +757,7 @@ class Engine:
             chunk_ids[i, :real] = row[first_page:first_page + real]
             hreal = min(owned, n_hist)
             hist_ids[i, :hreal] = row[:hreal]
+        self._check_epoch()  # last fence before rebinding device pools
         logits, self.k_pool, self.v_pool = prefill_chunk(
             self.params, self.config, jnp.asarray(toks), jnp.int32(off),
             jnp.asarray(lens), jnp.asarray(chunk_ids), jnp.asarray(hist_ids),
@@ -546,15 +767,28 @@ class Engine:
         )
         self._count_prefill(B)
         finishing = [i for i in range(B) if off + C >= int(lens[i])]
+        ok = None
         if finishing:
+            logits, ok_dev = self._guard_logits(
+                logits, [self._slot_req[s] for s in slots])
             # rows mid-prompt get sampled too (greedy ignores the key; their
             # values are simply unused) — still one blocking transfer total
             sampled = np.asarray(
                 sample_tokens(logits, self._next_key(), self.ec.temperature))
+            ok = np.asarray(ok_dev) if ok_dev is not None else None
             now = time.perf_counter()
         for i, slot in enumerate(slots):
             if i not in finishing:
                 self._prefilling[slot] = off + C
+                # an advanced chunk IS progress: without this reset a long
+                # prompt under intermittent faults would accumulate
+                # non-consecutive failures across successful chunks
+                self._reset_failures(self._requests[self._slot_req[slot]])
+                continue
+            if ok is not None and not ok[i]:
+                self._nan_rows += 1
+                self._fail_slot(slot, NonFiniteLogits(
+                    "non-finite logits in chunked-prefill sample row"))
                 continue
             pending = self._requests[self._slot_req[slot]]
             del self._prefilling[slot]
@@ -564,7 +798,7 @@ class Engine:
                                   table_rows[slot])
             self._commit(slot, int(sampled[i]))
 
-    def _loop(self) -> None:
+    def _loop(self, epoch: int) -> None:
         # ENGINE_TICK_FLOOR_S: minimum wall time per engine tick that did
         # work.  A simulator knob for router/scheduler tests on CPU: on a
         # real TPU the host thread is idle while the chip runs the step, so
@@ -573,93 +807,42 @@ class Engine:
         # restores the device-bound regime (host sleeps the remainder of
         # the simulated step), letting multi-replica scheduling behavior be
         # asserted without chips.  Unset/0 (the default) is a no-op.
+        #
+        # ``epoch`` fences restarted loops: the watchdog bumps self._epoch
+        # before reviving a hung/dead loop, so a stale thread that wakes up
+        # mid-sleep exits here without touching engine state.
         tick_floor = float(os.environ.get("ENGINE_TICK_FLOOR_S", "0") or 0)
-        while self._running:
+        self._tls.epoch = epoch
+        while self._running and self._epoch == epoch:
+            if self._chaos is not None:
+                # may sleep (slow-tick) or raise ChaosThreadDeath — a
+                # BaseException, so none of the isolation boundaries below
+                # can swallow it; it terminates this thread for the
+                # watchdog to find (caught here only to keep pytest's
+                # unhandled-thread-exception hook quiet)
+                try:
+                    self._chaos.on_tick()
+                except BaseException:
+                    return  # thread dies; state stays as-is, like a crash
+                if self._epoch != epoch:
+                    return  # supervisor replaced us while we were stalled
             tick_t0 = time.perf_counter() if tick_floor else 0.0
-            did_work = False
-
-            # --- admission: bookkeeping only (C++ decides; compute is below)
-            while True:
-                admitted = self.batcher.admit()
-                if admitted is None:
-                    break
-                did_work = True
-                slot, rid, plen, _, cached = admitted
-                # fetch + slot assignment are one atomic step vs cancel():
-                # once _slot_req holds rid, cancel defers to this loop; a
-                # queued cancel that popped the request first lands in the
-                # pending-None branch
-                with self._lock:
-                    pending = self._requests.get(rid)
-                    if pending is not None:
-                        self._slot_req[slot] = rid
-                        self._aid_host[slot] = pending.adapter_id
-                if pending is None:
-                    self.batcher.release(slot)
-                    continue
-                if pending.cancelled:  # cancelled between submit and admit
-                    self._finish(slot, rid, truncated=False,
-                                 cancelled=True, cache_ok=False)
-                    continue
-                # cache-hit pages already hold the prefix KV: prefill resumes
-                # at the first uncovered position
-                self._prefilling[slot] = cached * self.ec.page_size
-                self._prefill_rows[slot] = self.batcher.slot_pages(slot)
-
-            # --- fused prefill: group prefilling slots (short prompts by
-            # bucket, long/cache-resumed ones by chunk offset) and issue ONE
-            # dispatch per group instead of one per slot — an N-way burst of
-            # same-bucket prompts is a single [N, bucket] prefill
-            shorts: dict[int, list] = {}
-            chunked: dict[int, list] = {}
-            for slot in list(self._prefilling):
-                did_work = True
-                pending = self._requests[self._slot_req[slot]]
-                if pending.cancelled:
-                    # mid-prefill cancel: pool pages are partially written —
-                    # free them WITHOUT caching
-                    del self._prefilling[slot]
-                    self._finish(slot, self._slot_req[slot], truncated=False,
-                                 cancelled=True, cache_ok=False)
-                    continue
-                off = self._prefilling[slot]
-                plen = len(pending.tokens)
-                if off == 0 and plen <= self.ec.prefill_chunk:
-                    shorts.setdefault(self._bucket(plen), []).append(slot)
-                else:
-                    chunked.setdefault(off, []).append(slot)
-            for bucket in sorted(shorts):
-                self._prefill_short_group(shorts[bucket], bucket)
-            for off in sorted(chunked):
-                self._prefill_chunk_group(chunked[off], off)
-
-            # --- one decode step over slots whose prefill is complete
-            # (_slot_req membership == slot active; no C snapshot needed)
-            decode_ready = [
-                s for s in self._slot_req
-                if s not in self._prefilling
-            ]
-            for slot in list(decode_ready):
-                if self._requests[self._slot_req[slot]].cancelled:
-                    did_work = True
-                    decode_ready.remove(slot)
-                    # prompt KV is complete: its pages are safe to cache
-                    self._finish(slot, self._slot_req[slot], truncated=False,
-                                 cancelled=True)
-            if decode_ready:
-                did_work = True
-                # host mirrors ARE the decode view: mid-prefill slots hold
-                # len 0 / trash rows by construction (_activate_decode)
-                seq_lens = self._len_host
-                page_table = self._pt_host
-                drafts = {slot: self._draft_for(slot, seq_lens[slot])
-                          for slot in decode_ready} if self._spec else {}
-                if any(drafts.values()):
-                    self._decode_tick_speculative(decode_ready, drafts,
-                                                  seq_lens, page_table)
-                else:
-                    self._decode_tick_single(decode_ready, seq_lens, page_table)
-
+            self._ticks += 1
+            self._last_tick_ts = time.monotonic()
+            try:
+                did_work = self._tick()
+            except _StaleThread:
+                return  # superseded after a hang: exit without a trace
+            except Exception as exc:  # noqa: BLE001 — loop must survive
+                # backstop for host-side faults escaping the per-phase
+                # isolation boundaries: charge every in-flight request
+                # (K-cap rejects repeat offenders) and keep serving
+                try:
+                    self._note_group_failure(list(self._slot_req), "tick", exc)
+                except _StaleThread:
+                    return  # the "fault" was our own supersession
+                time.sleep(0.005)
+                continue
             if did_work and tick_floor:
                 pad = tick_floor - (time.perf_counter() - tick_t0)
                 if pad > 0:
@@ -667,6 +850,288 @@ class Engine:
             if not did_work:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
+
+    def _tick(self) -> bool:
+        """One engine tick: admit, shed expired, prefill groups, decode.
+        Each compute phase runs inside its own isolation boundary
+        (_isolated): an exception fails only the slots in the offending
+        group — at worst after max_consecutive_failures retries — and the
+        tick sequence continues."""
+        self._check_epoch()
+        did_work = False
+
+        # --- admission: bookkeeping only (C++ decides; compute is below)
+        while True:
+            admitted = self.batcher.admit()
+            if admitted is None:
+                break
+            did_work = True
+            slot, rid, plen, _, cached = admitted
+            # fetch + slot assignment are one atomic step vs cancel():
+            # once _slot_req holds rid, cancel defers to this loop; a
+            # queued cancel that popped the request first lands in the
+            # pending-None branch
+            with self._lock:
+                pending = self._requests.get(rid)
+                if pending is not None:
+                    self._slot_req[slot] = rid
+                    self._aid_host[slot] = pending.adapter_id
+            if pending is None:
+                self.batcher.release(slot)
+                continue
+            if pending.cancelled:  # cancelled between submit and admit
+                self._finish(slot, rid, truncated=False,
+                             cancelled=True, cache_ok=False)
+                continue
+            if (pending.deadline is not None
+                    and time.perf_counter() > pending.deadline):
+                # deadline expired while queued: shed before spending any
+                # prefill compute on a request nobody is waiting for
+                self._fail_slot(slot, DeadlineExceeded(
+                    "deadline expired after "
+                    f"{time.perf_counter() - pending.submitted_at:.3f}s "
+                    "in queue"), shed=True)
+                continue
+            # cache-hit pages already hold the prefix KV: prefill resumes
+            # at the first uncovered position
+            self._prefilling[slot] = cached * self.ec.page_size
+            self._prefill_rows[slot] = self.batcher.slot_pages(slot)
+
+        # --- fused prefill: group prefilling slots (short prompts by
+        # bucket, long/cache-resumed ones by chunk offset) and issue ONE
+        # dispatch per group instead of one per slot — an N-way burst of
+        # same-bucket prompts is a single [N, bucket] prefill
+        shorts: dict[int, list] = {}
+        chunked: dict[int, list] = {}
+        for slot in list(self._prefilling):
+            did_work = True
+            pending = self._requests.get(self._slot_req.get(slot))
+            if pending is None:  # failed out from under us: reclaim
+                self._fail_slot(slot, TickFailure("orphaned prefill slot"))
+                continue
+            if pending.cancelled:
+                # mid-prefill cancel: pool pages are partially written —
+                # free them WITHOUT caching
+                del self._prefilling[slot]
+                self._finish(slot, self._slot_req[slot], truncated=False,
+                             cancelled=True, cache_ok=False)
+                continue
+            if (pending.deadline is not None and not pending.first_token_at
+                    and time.perf_counter() > pending.deadline):
+                # shed-before-prefill also covers a chunked prefill whose
+                # deadline lapsed mid-prompt; once the first token is out
+                # the request runs to completion (cancel covers the rest)
+                self._fail_slot(slot, DeadlineExceeded(
+                    "deadline expired before first token"), shed=True)
+                continue
+            off = self._prefilling[slot]
+            plen = len(pending.tokens)
+            if off == 0 and plen <= self.ec.prefill_chunk:
+                shorts.setdefault(self._bucket(plen), []).append(slot)
+            else:
+                chunked.setdefault(off, []).append(slot)
+        for bucket in sorted(shorts):
+            self._isolated("prefill", shorts[bucket],
+                           self._prefill_short_group, shorts[bucket], bucket)
+        for off in sorted(chunked):
+            self._isolated("prefill_chunk", chunked[off],
+                           self._prefill_chunk_group, chunked[off], off)
+
+        # --- one decode step over slots whose prefill is complete
+        # (_slot_req membership == slot active; no C snapshot needed)
+        decode_ready = [
+            s for s in self._slot_req
+            if s not in self._prefilling
+        ]
+        for slot in list(decode_ready):
+            pending = self._requests.get(self._slot_req.get(slot))
+            if pending is None:
+                did_work = True
+                decode_ready.remove(slot)
+                self._fail_slot(slot, TickFailure("orphaned decode slot"))
+            elif pending.cancelled:
+                did_work = True
+                decode_ready.remove(slot)
+                # prompt KV is complete: its pages are safe to cache
+                self._finish(slot, self._slot_req[slot], truncated=False,
+                             cancelled=True)
+        if decode_ready:
+            did_work = True
+            # host mirrors ARE the decode view: mid-prefill slots hold
+            # len 0 / trash rows by construction (_activate_decode)
+            seq_lens = self._len_host
+            page_table = self._pt_host
+            drafts = {slot: self._draft_for(slot, seq_lens[slot])
+                      for slot in decode_ready} if self._spec else {}
+            if any(drafts.values()):
+                self._isolated("decode", decode_ready,
+                               self._decode_tick_speculative, decode_ready,
+                               drafts, seq_lens, page_table)
+            else:
+                self._isolated("decode", decode_ready,
+                               self._decode_tick_single, decode_ready,
+                               seq_lens, page_table)
+        return did_work
+
+    # ------------------------------------------------------ fault handling
+
+    def _isolated(self, phase: str, slots: list, fn, *args) -> bool:
+        """Isolation boundary around one tick phase: an exception fails only
+        ``slots`` (the offending group), and only after the per-request
+        consecutive-failure cap — a transient fault retries in place next
+        tick.  Retry is sound because a failed dispatch committed nothing:
+        prefill offsets/host mirrors only advance on success, and greedy
+        decode re-produces byte-identical tokens from unchanged state.
+        ChaosThreadDeath (BaseException) deliberately passes through."""
+        try:
+            if self._chaos is not None:
+                self._chaos.maybe_dispatch_error(phase)
+            fn(*args)
+            return True
+        except Exception as exc:  # noqa: BLE001 — the boundary's whole job
+            self._note_group_failure(slots, phase, exc)
+            return False
+
+    def _note_group_failure(self, slots: list, phase: str, exc: Exception) -> None:
+        self._ticks_failed += 1
+        cap = self.ec.max_consecutive_failures
+        for slot in list(slots):
+            rid = self._slot_req.get(slot)
+            pending = self._requests.get(rid) if rid is not None else None
+            if pending is None:
+                continue
+            pending.failures += 1
+            if pending.failures == 1:
+                self._retrying += 1
+            if pending.failures >= cap:
+                err = TickFailure(
+                    f"rejected after {pending.failures} consecutive "
+                    f"{phase} failures (last: {type(exc).__name__}: {exc})")
+                err.__cause__ = exc
+                self._fail_slot(slot, err)
+
+    def _check_epoch(self) -> None:
+        """Die (via _StaleThread, uncatchable by the isolation boundaries)
+        if this loop thread was superseded by a watchdog restart — the
+        restarted loop may have reassigned our slots, so any further host
+        mutation would corrupt a fresh request.  Threads with no recorded
+        epoch (watchdog, stop(), callers' threads) always pass."""
+        e = getattr(self._tls, "epoch", None)
+        if e is not None and e != self._epoch:
+            raise _StaleThread(f"epoch {e} superseded by {self._epoch}")
+
+    def _reset_failures(self, pending: _Pending) -> None:
+        """Any forward progress (a committed token, a completed prefill
+        chunk) makes the failure cap consecutive again."""
+        if pending.failures:
+            pending.failures = 0
+            self._retrying -= 1
+
+    def _release_slot_state(self, slot: int) -> None:
+        """Zero one slot's host mirrors (page row, length, adapter id,
+        prefill row).  Every release path — finish, fail, orphan-reap —
+        funnels here so a future per-slot field can't be forgotten in one
+        of them."""
+        self._pt_host[slot, :] = 0
+        self._len_host[slot] = 0
+        self._aid_host[slot] = 0
+        self._prefill_rows.pop(slot, None)
+
+    def _fail_slot(self, slot: int, exc: Exception, shed: bool = False) -> None:
+        """Fail ONE slot's request with a typed error and free its
+        slot/pages; the rest of the engine is untouched.  Pages are never
+        handed to the prefix cache — failed state is suspect by definition."""
+        self._check_epoch()
+        with self._lock:
+            rid = self._slot_req.pop(slot, None)
+            pending = self._requests.pop(rid, None) if rid is not None else None
+            if pending is not None:
+                self._future_rid.pop(pending.future, None)
+        self._release_slot_state(slot)
+        self._prefilling.pop(slot, None)
+        self.batcher.release(slot)
+        if pending is None:
+            return
+        if pending.failures:
+            self._retrying -= 1  # no longer mid-retry: it's terminal now
+        if shed:
+            self._requests_shed += 1
+        else:
+            self._requests_failed += 1
+        self._resolve_exception(pending, exc)
+
+    def _fail_unassigned(self, exc: Exception) -> None:
+        """Fail every request NOT holding a slot (still queued).  Their C++
+        queue entries are reaped at admission: pending gone -> slot released
+        untouched (same path a queued cancel takes)."""
+        with self._lock:
+            held = set(self._slot_req.values())
+            victims = [(rid, p) for rid, p in self._requests.items()
+                       if rid not in held]
+            for rid, p in victims:
+                del self._requests[rid]
+                self._future_rid.pop(p.future, None)
+        for _, p in victims:
+            self._requests_failed += 1
+            self._resolve_exception(p, exc)
+
+    def _resolve_exception(self, pending: _Pending, exc: Exception) -> None:
+        # outside _lock (same split _finish uses): done-callbacks may
+        # re-enter the engine
+        try:
+            pending.future.set_exception(exc)
+        except Exception:  # already resolved (lost race with cancel)
+            pass
+        if pending.stream is not None:
+            pending.stream.put((None, exc))
+
+    def _watchdog(self) -> None:
+        """Supervisor: detects a dead loop thread (escaped exception /
+        injected death) or one hung inside a single tick past
+        hang_timeout_s, fails the in-flight futures, and — when
+        watchdog_restart — revives the loop with a fresh decode state."""
+        while not self._wd_stop.wait(self.ec.watchdog_interval_s):
+            if not self._running or self._draining:
+                continue
+            t = self._thread
+            if t is None:
+                continue
+            if not t.is_alive():
+                self._supervise("loop thread died")
+            elif ((self._slot_req or self._requests)
+                  and time.monotonic() - self._last_tick_ts
+                  > self.ec.hang_timeout_s):
+                self._supervise(
+                    f"loop hung > {self.ec.hang_timeout_s}s inside one tick")
+
+    def _supervise(self, reason: str) -> None:
+        # fence first: a hung-but-alive thread that wakes later sees the
+        # epoch mismatch at the loop top, the _tick entry, the pre-dispatch
+        # checks, or any _commit/_finish/_fail_slot and dies (_StaleThread)
+        # before mutating host state.  RESIDUAL RISK: a thread blocked
+        # INSIDE a device call wakes past its pre-dispatch fence and can
+        # still rebind k_pool/v_pool or scatter into reassigned pages
+        # before the next check — restart-after-hang is best-effort; a
+        # production deployment escalates a repeat offender to process
+        # restart.  Loop DEATH (the common case) has no such window.
+        self._epoch += 1
+        err = TickFailure(f"engine {reason}; request abandoned by supervisor")
+        for slot in list(self._slot_req):
+            self._fail_slot(slot, err)
+        self._fail_unassigned(err)
+        self._prefilling.clear()
+        self._prefill_rows.clear()
+        self._pt_host[:] = 0
+        self._len_host[:] = 0
+        self._aid_host[:] = 0
+        if self.ec.watchdog_restart:
+            self._restarts += 1
+            self._last_tick_ts = time.monotonic()
+            self._thread = threading.Thread(
+                target=self._loop, args=(self._epoch,), daemon=True)
+            self._thread.start()
+        else:
+            self._running = False
 
     def _decode_tick_single(self, decode_ready, seq_lens, page_table) -> None:
         tokens = np.zeros((self.ec.max_slots,), np.int32)
@@ -680,6 +1145,7 @@ class Engine:
         # mirrors must not be mutated while the step is in flight; the
         # blocking np.asarray(sample_tokens(...)) below is that barrier —
         # every mirror mutation (_commit and later) happens after it
+        self._check_epoch()  # last fence before rebinding device pools
         logits, self.k_pool, self.v_pool = decode_step(
             self.params, self.config, tokens,
             seq_lens, page_table,
@@ -688,10 +1154,26 @@ class Engine:
             adapter_ids=(self._aid_host
                          if self._lora is not None else None),
         )
+        logits, ok_dev = self._guard_logits(logits, self._row_rids())
         sampled = np.asarray(
             sample_tokens(logits, self._next_key(), self.ec.temperature))
+        ok = np.asarray(ok_dev) if ok_dev is not None else None
         for slot in decode_ready:
+            if ok is not None and not ok[slot]:
+                self._nan_rows += 1
+                self._fail_slot(slot, NonFiniteLogits(
+                    f"non-finite logits in decode row (slot {slot})"))
+                continue
             self._commit(slot, int(sampled[slot]))
+
+    def _row_rids(self) -> list:
+        """Request id per decode row (slot), -1 for inactive/prefilling rows
+        — the chaos injector's per-request targeting key."""
+        rids = [-1] * self.ec.max_slots
+        for slot, rid in self._slot_req.items():
+            if slot not in self._prefilling:
+                rids[slot] = rid
+        return rids
 
     # ------------------------------------------------------- speculative
 
@@ -762,6 +1244,7 @@ class Engine:
         # invariant: the blocking sample_tokens fence below precedes every
         # mirror mutation, so the (possibly aliased) buffers are stable
         # while the step is in flight
+        self._check_epoch()  # last fence before rebinding device pools
         logits, self.k_pool, self.v_pool = decode_step_k(
             self.params, self.config, tokens,
             seq_lens, page_table,
@@ -770,11 +1253,20 @@ class Engine:
             adapter_ids=(self._aid_host
                          if self._lora is not None else None),
         )
+        logits, ok_dev = self._guard_logits(logits, self._row_rids())
         B, _, V = logits.shape
         sampled = np.asarray(sample_tokens(
             logits.reshape(B * K, V), self._next_key(), self.ec.temperature,
         )).reshape(B, K)
+        ok = np.asarray(ok_dev) if ok_dev is not None else None
         for slot in decode_ready:
+            if ok is not None and not ok[slot]:
+                # any of the slot's K verify rows non-finite: fail the slot
+                # before committing anything from the poisoned pass
+                self._nan_rows += 1
+                self._fail_slot(slot, NonFiniteLogits(
+                    f"non-finite logits in speculative verify (slot {slot})"))
+                continue
             d = drafts.get(slot) or []
             self._spec_proposed += len(d)
             for j in range(len(d) + 1):
@@ -796,6 +1288,7 @@ class Engine:
         host mirrors, making it visible to the decode step (rows are zero —
         trash page — until this point so decode KV writes can't touch a
         mid-prefill slot)."""
+        self._check_epoch()
         self._pt_host[slot, :owned] = row[:owned]
         self._len_host[slot] = plen
         self._prefill_rows.pop(slot, None)
@@ -803,8 +1296,10 @@ class Engine:
     def _commit(self, slot: int, token: int) -> int:
         """Record one generated token; returns the batcher rc (1 = keep
         decoding; anything else means the slot was finished+released)."""
+        self._check_epoch()
         rid = self._slot_req[slot]
         pending = self._requests[rid]
+        self._reset_failures(pending)  # consecutive cap: progress resets it
         pending.generated.append(token)
         pending.context.append(token)
         if pending.stream is not None:
@@ -826,14 +1321,19 @@ class Engine:
 
     def _finish(self, slot: int, rid: int, truncated: bool,
                 cancelled: bool = False, cache_ok: bool = True) -> None:
+        self._check_epoch()
         with self._lock:  # cancel() resolves futures under this lock
-            pending = self._requests.pop(rid)
-            self._future_rid.pop(pending.future, None)
+            pending = self._requests.pop(rid, None)
+            if pending is not None:
+                self._future_rid.pop(pending.future, None)
             self._slot_req.pop(slot, None)
-        self._pt_host[slot, :] = 0
-        self._len_host[slot] = 0
-        self._aid_host[slot] = 0  # released slots decode as the zero adapter
-        self._prefill_rows.pop(slot, None)
+        if pending is None:
+            # already failed out from under us (supervisor raced a stale
+            # tick): just make sure the slot state is clean
+            self._release_slot_state(slot)
+            self.batcher.release(slot)
+            return
+        self._release_slot_state(slot)  # freed slots decode as zero adapter
         # hand the prompt's full pages to the prefix cache on the way out —
         # unless the prefill never finished (cancel mid-prefill): those pages
         # hold garbage and must not be served to other requests

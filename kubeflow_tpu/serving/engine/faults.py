@@ -1,0 +1,134 @@
+"""Deterministic chaos-injection harness for the serving engine.
+
+The fault-tolerant engine core (tick isolation, deadlines, watchdog,
+graceful drain) is only trustworthy if every failure mode is *provoked on
+demand*, on CPU, in the tier-1 suite — waiting for a real TPU dispatch to
+throw is not a test plan.  This module is the injection substrate: a
+frozen, seeded ``FaultConfig`` rides inside ``EngineConfig`` and a
+``ChaosInjector`` built from it fires faults at the engine loop's
+well-defined hook points:
+
+  * ``on_tick``          — slow ticks (watchdog/hang exercise) and loop
+                           thread death (supervisor/restart exercise)
+  * ``maybe_dispatch_error`` — raises inside an isolation boundary, as a
+                           failed prefill/decode dispatch would
+  * ``nan_rows``         — picks logits rows to poison with NaN, as a
+                           numerically-diverged model would (the engine
+                           does the actual ``.at[row].set(nan)``; this
+                           module stays jax-free and import-light)
+
+Determinism: all draws come from one ``numpy`` Generator seeded from the
+config, and the engine loop is single-threaded, so a given (config, request
+schedule) replays the same fault sequence.  Fault *targeting* is by request
+id (``target_rids``) — request ids are assigned in submission order from 0,
+so tests can aim a fault at exactly one of N concurrent requests.
+
+``ChaosThreadDeath`` deliberately subclasses ``BaseException``: the tick
+isolation boundaries catch ``Exception``, and simulated thread death must
+sail through them and actually kill the loop thread, the way a real
+un-catchable failure would.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Tuple
+
+import numpy as np
+
+
+class ChaosDispatchError(RuntimeError):
+    """An injected dispatch failure (stands in for a thrown prefill/decode)."""
+
+
+class ChaosThreadDeath(BaseException):
+    """Injected loop-thread death; BaseException so isolation boundaries
+    (which catch Exception) cannot contain it — only the watchdog can."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Seeded fault plan. Frozen (rides in the frozen/hashable EngineConfig);
+    all-defaults == inject nothing."""
+
+    seed: int = 0
+    # probability that any single guarded dispatch (one prefill group / one
+    # decode tick) raises ChaosDispatchError
+    dispatch_error_rate: float = 0.0
+    # probability, per decode row per tick, that the row's logits are
+    # poisoned with NaN before sampling
+    nan_logit_rate: float = 0.0
+    # restrict NaN poisoning to these request ids (empty = any row)
+    target_rids: Tuple[int, ...] = ()
+    # sleep slow_tick_s at the top of every Nth tick (0 = off), or exactly
+    # once at tick slow_tick_on (1-based; -1 = off): makes the loop look
+    # hung to the watchdog without actually deadlocking pytest
+    slow_tick_every: int = 0
+    slow_tick_on: int = -1
+    slow_tick_s: float = 0.0
+    # raise ChaosThreadDeath at the top of this tick number (1-based;
+    # -1 = off): the loop thread dies and the supervisor must notice
+    die_on_tick: int = -1
+
+
+class ChaosInjector:
+    """Runtime half of FaultConfig: owns the RNG, the tick counter, and the
+    injected-fault counters the tests/bench assert against."""
+
+    def __init__(self, config: FaultConfig):
+        self.config = config
+        self.rng = np.random.default_rng(config.seed)
+        self.tick = 0
+        self.injected_dispatch_errors = 0
+        self.injected_nan_rows = 0
+        self.injected_slow_ticks = 0
+        self.injected_deaths = 0
+
+    def on_tick(self) -> None:
+        """Called once at the top of every engine tick (idle ticks too)."""
+        self.tick += 1
+        c = self.config
+        if c.die_on_tick > 0 and self.tick == c.die_on_tick:
+            self.injected_deaths += 1
+            raise ChaosThreadDeath(f"injected loop death at tick {self.tick}")
+        if ((c.slow_tick_every > 0 and self.tick % c.slow_tick_every == 0)
+                or (c.slow_tick_on > 0 and self.tick == c.slow_tick_on)):
+            self.injected_slow_ticks += 1
+            time.sleep(c.slow_tick_s)
+
+    def maybe_dispatch_error(self, phase: str) -> None:
+        """Called inside each isolation boundary, before the real dispatch."""
+        c = self.config
+        if c.dispatch_error_rate > 0 and self.rng.random() < c.dispatch_error_rate:
+            self.injected_dispatch_errors += 1
+            raise ChaosDispatchError(
+                f"injected {phase} dispatch fault (tick {self.tick})")
+
+    def nan_rows(self, row_rids) -> list:
+        """Rows (indices into ``row_rids``) whose logits should be poisoned
+        this tick.  ``row_rids``: request id per logits row (-1 = inactive
+        row, never poisoned)."""
+        c = self.config
+        if c.nan_logit_rate <= 0:
+            return []
+        rows = []
+        for i, rid in enumerate(row_rids):
+            if rid < 0:
+                continue
+            if c.target_rids and rid not in c.target_rids:
+                continue
+            if self.rng.random() < c.nan_logit_rate:
+                rows.append(i)
+        if rows:
+            self.injected_nan_rows += len(rows)
+        return rows
+
+    def stats(self) -> dict:
+        return {
+            "ticks_seen": self.tick,
+            "injected_dispatch_errors": self.injected_dispatch_errors,
+            "injected_nan_rows": self.injected_nan_rows,
+            "injected_slow_ticks": self.injected_slow_ticks,
+            "injected_deaths": self.injected_deaths,
+        }

@@ -21,7 +21,7 @@ import os
 from typing import Any, Optional
 
 from ..server import Model
-from ..errors import RequestError
+from ..errors import EngineError, RequestError
 from .engine import Engine, EngineConfig
 from .model import DecoderConfig, load_params
 
@@ -156,6 +156,14 @@ class JetStreamModel(Model):
                 kw = {k: v for k, v in raw.items() if k in fields}
                 if isinstance(kw.get("eos_ids"), list):  # keep config hashable
                     kw["eos_ids"] = tuple(kw["eos_ids"])
+                if isinstance(kw.get("chaos"), dict):
+                    # chaos-under-load soak straight from an engine.json
+                    from .faults import FaultConfig
+
+                    ckw = kw["chaos"]
+                    if isinstance(ckw.get("target_rids"), list):
+                        ckw["target_rids"] = tuple(ckw["target_rids"])
+                    kw["chaos"] = FaultConfig(**ckw)
                 ec = EngineConfig(**kw)
                 # an operator's explicit eos_id — INCLUDING -1 "never stop
                 # early" — must win over the checkout's declaration
@@ -186,12 +194,22 @@ class JetStreamModel(Model):
             s = self.engine.stats
         except RuntimeError:  # engine stopped
             return {}
+        health = self.engine.health()
         return {
             "engine_active_slots": s["active_slots"],
             "engine_queue_depth": s["queue_depth"],
             "engine_free_pages": s["free_pages"],
             "engine_cached_pages": s["cached_pages"],
             "engine_page_hits": s["page_hits"],
+            # failure-model surface: the router skips non-SERVING replicas
+            # and the autoscaler reads shed/reject as overload pressure.
+            # /metrics renders these via float(), so health is a 1/0 gauge
+            # (the string state lives on Engine.health() for humans)
+            "engine_serving": 1.0 if health["state"] == "SERVING" else 0.0,
+            "engine_ticks_failed": s["ticks_failed"],
+            "engine_requests_shed": s["requests_shed"],
+            "engine_requests_rejected": s["requests_rejected"],
+            "engine_restarts": s["restarts"],
         }
 
     def _parse_generate(self, payload: Any):
@@ -202,14 +220,22 @@ class JetStreamModel(Model):
         except (TypeError, ValueError):
             raise RequestError("max_tokens must be an integer, got "
                                f"{params.get('max_tokens')!r}") from None
+        deadline = params.get("deadline_s")
+        if deadline is not None:
+            try:
+                deadline = float(deadline)
+            except (TypeError, ValueError):
+                raise RequestError("deadline_s must be a number, got "
+                                   f"{deadline!r}") from None
         return (self.tokenizer.encode(prompt) or [0], max_tokens,
-                params.get("adapter"))
+                params.get("adapter"), deadline)
 
     def generate(self, payload: Any, headers: Optional[dict] = None) -> Any:
         """V2 generate extension (unary): {"text_input": str, "parameters":
-        {"max_tokens": N}} -> {"text_output": str, ...}."""
-        ids, max_tokens, adapter = self._parse_generate(payload)
-        r = self.engine.generate(ids, max_tokens, adapter=adapter)
+        {"max_tokens": N, "deadline_s": S}} -> {"text_output": str, ...}."""
+        ids, max_tokens, adapter, deadline = self._parse_generate(payload)
+        r = self.engine.generate(ids, max_tokens, adapter=adapter,
+                                 deadline=deadline)
         return {"text_output": self.tokenizer.decode(r["tokens"]),
                 "token_ids": r["tokens"], "tokens": r["num_tokens"],
                 "prompt_tokens": len(ids), "max_tokens": max_tokens,
@@ -230,8 +256,9 @@ class JetStreamModel(Model):
         UTF-8 char split across byte tokens decodes to U+FFFD until its tail
         arrives) — so the concatenated stream equals the unary text_output.
         """
-        ids, max_tokens, adapter = self._parse_generate(payload)
-        stream = self.engine.generate_stream(ids, max_tokens, adapter=adapter)
+        ids, max_tokens, adapter, deadline = self._parse_generate(payload)
+        stream = self.engine.generate_stream(ids, max_tokens, adapter=adapter,
+                                             deadline=deadline)
         return self._stream_pieces(stream, ids, max_tokens)
 
     def _stream_pieces(self, stream, ids: list, max_tokens: int):
@@ -272,21 +299,40 @@ class JetStreamModel(Model):
             if ad is not None and ad not in self.adapters:
                 raise RequestError(f"unknown adapter {ad!r} "
                                    f"(loaded: {sorted(self.adapters)})")
+            dl = inst.get("deadline_s") if isinstance(inst, dict) else None
+            if dl is not None:
+                try:
+                    float(dl)
+                except (TypeError, ValueError):
+                    raise RequestError(
+                        f"deadline_s must be a number, got {dl!r}") from None
         futures = []
         for inst in instances:
             if isinstance(inst, str):
                 prompt, max_tokens = inst, 32
-                adapter = None
+                adapter = deadline = None
             else:
                 prompt = inst.get("prompt", "")
                 max_tokens = int(inst.get("max_tokens", 32))
                 adapter = inst.get("adapter")
+                deadline = inst.get("deadline_s")
+                if deadline is not None:
+                    deadline = float(deadline)  # pre-validated above
             ids = self.tokenizer.encode(prompt) or [0]
             futures.append(self.engine.generate_async(ids, max_tokens,
-                                                      adapter=adapter))
+                                                      adapter=adapter,
+                                                      deadline=deadline))
         out = []
         for fut in futures:
-            r = fut.result(timeout=300)
+            try:
+                r = fut.result(timeout=300)
+            except EngineError as e:
+                # per-instance fault isolation (failure model): one shed or
+                # failed instance becomes an error entry; its siblings'
+                # results are still computed, returned, and awaited — NOT
+                # abandoned mid-batch holding slots nobody reads
+                out.append({"error": f"{type(e).__name__}: {e}"})
+                continue
             out.append(
                 {
                     "text": self.tokenizer.decode(r["tokens"]),

@@ -21,7 +21,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from .errors import RequestError
+from .errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
+                     RequestError)
 
 
 class Model:
@@ -255,6 +256,13 @@ class ModelServer:
                                         "type": "invalid_request_error"}})
             else:
                 h._send(400, {"error": f"{type(e).__name__}: {e}"})
+        except DeadlineExceeded as e:
+            # request shed before its first token: the gateway timeout code,
+            # so clients/routers distinguish "too slow" from "broken"
+            h._send(504, {"error": f"{type(e).__name__}: {e}"})
+        except (EngineOverloaded, EngineShutdown) as e:
+            # backpressure / drain: retryable against another replica
+            h._send(503, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # noqa: BLE001 — server must answer
             h._send(500, {"error": f"{type(e).__name__}: {e}"})
         finally:

@@ -1,0 +1,418 @@
+"""Fault-tolerance tests: tick isolation, deadlines, admission control,
+watchdog/restart, graceful drain — all driven by the deterministic chaos
+harness (engine/faults.py) on CPU.
+
+The headline scenario (ISSUE 2 acceptance): with faults injected into 1 of
+8 concurrent requests, the other 7 complete with tokens byte-identical to a
+fault-free run, the faulted future raises a typed error, and the engine
+reports SERVING afterwards with zero leaked KV pages.
+"""
+
+import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.engine import Engine, EngineConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import ChaosInjector, FaultConfig
+from kubeflow_tpu.serving.errors import (DeadlineExceeded, EngineError,
+                                         EngineOverloaded, EngineShutdown,
+                                         NonFiniteLogits, RequestError,
+                                         TickFailure)
+
+pytestmark = pytest.mark.chaos
+
+CFG = M.DecoderConfig(vocab_size=101, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+def _ec(**kw):
+    base = dict(max_slots=8, num_pages=128, page_size=8, max_pages_per_slot=16)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+PROMPTS = [[(i * 13 + j * 7) % (CFG.vocab_size - 1) + 1 for j in range(4 + i % 3)]
+           for i in range(8)]
+
+
+def _run_all(eng, n_tokens=6):
+    futs = [eng.generate_async(p, n_tokens) for p in PROMPTS]
+    out = []
+    for f in futs:
+        try:
+            out.append(f.result(timeout=180))
+        except EngineError as e:
+            out.append(e)
+    return out
+
+
+# ------------------------------------------------------- harness determinism
+
+
+def test_injector_is_deterministic_and_seeded():
+    cfg = FaultConfig(seed=7, dispatch_error_rate=0.5, nan_logit_rate=0.5)
+    seqs = []
+    for _ in range(2):
+        inj = ChaosInjector(cfg)
+        seq = []
+        for t in range(50):
+            inj.on_tick()
+            try:
+                inj.maybe_dispatch_error("decode")
+                seq.append(None)
+            except Exception:
+                seq.append("err")
+            seq.append(tuple(inj.nan_rows([0, 1, -1, 3])))
+        seqs.append(seq)
+    assert seqs[0] == seqs[1]  # same seed -> identical fault schedule
+    assert "err" in seqs[0]    # and it actually fires
+    # -1 rows (inactive) are never poisoned
+    assert all(2 not in rows for rows in seqs[0] if isinstance(rows, tuple))
+
+
+# ------------------------------------------------------ headline acceptance
+
+
+def test_nan_fault_on_one_of_eight_leaves_others_byte_identical(params):
+    """ISSUE 2 acceptance: NaN logits injected into exactly request id 3 of
+    8 concurrent requests.  The 7 others must be byte-identical to a
+    fault-free run, the victim raises NonFiniteLogits, and the engine ends
+    SERVING with every KV page back in the pool."""
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        baseline = _run_all(eng)
+        assert all(isinstance(r, dict) for r in baseline)
+    finally:
+        eng.stop()
+
+    eng = Engine(params, CFG, _ec(
+        chaos=FaultConfig(seed=0, nan_logit_rate=1.0, target_rids=(3,))))
+    eng.start()
+    try:
+        t0 = time.perf_counter()
+        chaos = _run_all(eng)
+        elapsed = time.perf_counter() - t0
+        for i, (base, got) in enumerate(zip(baseline, chaos)):
+            if i == 3:
+                assert isinstance(got, NonFiniteLogits), got
+            else:
+                assert isinstance(got, dict), (i, got)
+                assert got["tokens"] == base["tokens"], i  # byte-identical
+        assert elapsed < 120  # typed error well within any sane deadline
+        _wait(lambda: eng.stats["active_slots"] == 0, msg="slots drained")
+        s = eng.stats
+        assert s["nan_rows"] >= 1 and s["requests_failed"] == 1
+        # no leaked KV pages: everything is back in free (+0 cached: failed
+        # state is never handed to the prefix cache; the 7 good requests DO
+        # cache their prompt pages)
+        assert s["free_pages"] + s["cached_pages"] == eng.ec.num_pages - 1
+        assert eng._thread.is_alive()  # no thread death
+        assert eng.health()["state"] == "SERVING"
+        assert eng.stats["restarts"] == 0
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------- tick isolation
+
+
+def test_dispatch_faults_retry_in_place_byte_identical(params):
+    """Injected dispatch exceptions (prefill or decode) fail no one while
+    under the consecutive-failure cap: the tick retries from unchanged
+    state, so all 8 requests still match the fault-free tokens exactly."""
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        baseline = _run_all(eng)
+    finally:
+        eng.stop()
+
+    eng = Engine(params, CFG, _ec(
+        chaos=FaultConfig(seed=2, dispatch_error_rate=0.25),
+        max_consecutive_failures=50))
+    eng.start()
+    try:
+        chaos = _run_all(eng)
+        for base, got in zip(baseline, chaos):
+            assert isinstance(got, dict), got
+            assert got["tokens"] == base["tokens"]
+        s = eng.stats
+        assert s["ticks_failed"] > 0  # faults really were injected
+        assert s["requests_failed"] == 0
+        assert eng.health()["state"] == "SERVING"
+    finally:
+        eng.stop()
+
+
+def test_dispatch_faults_reject_after_consecutive_cap(params):
+    """With every dispatch failing, each request is rejected with a typed
+    TickFailure after exactly max_consecutive_failures attempts — and the
+    loop thread survives to serve the stats/health endpoints."""
+    eng = Engine(params, CFG, _ec(
+        max_slots=2,
+        chaos=FaultConfig(seed=3, dispatch_error_rate=1.0),
+        max_consecutive_failures=3))
+    eng.start()
+    try:
+        fut = eng.generate_async(PROMPTS[0], 4)
+        with pytest.raises(TickFailure) as exc:
+            fut.result(timeout=60)
+        assert "3 consecutive" in str(exc.value)
+        assert exc.value.__cause__ is not None  # original fault chained
+        _wait(lambda: eng.stats["active_slots"] == 0, msg="slot freed")
+        s = eng.stats
+        assert s["ticks_failed"] >= 3 and s["requests_failed"] == 1
+        assert s["free_pages"] + s["cached_pages"] == eng.ec.num_pages - 1
+        assert eng._thread.is_alive()
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------- deadlines and admission
+
+
+def test_expired_deadline_is_shed_before_prefill(params):
+    """A queued request whose deadline lapses behind a busy slot is shed
+    with DeadlineExceeded at admission — before any prefill compute — while
+    later work without a deadline proceeds."""
+    eng = Engine(params, CFG, _ec(max_slots=1))
+    eng.start()
+    try:
+        blocker = eng.generate_async(PROMPTS[0], 40)
+        _wait(lambda: eng.stats["active_slots"] == 1, msg="blocker admitted")
+        doomed = eng.generate_async(PROMPTS[1], 4, deadline=0.01)
+        follow = eng.generate_async(PROMPTS[2], 4)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=120)
+        assert isinstance(follow.result(timeout=120)["tokens"], list)
+        assert blocker.result(timeout=120)["num_tokens"] == 40
+        s = eng.stats
+        assert s["requests_shed"] == 1
+        assert s["free_pages"] + s["cached_pages"] == eng.ec.num_pages - 1
+    finally:
+        eng.stop()
+
+
+def test_default_deadline_config_applies(params):
+    """default_deadline_s covers submissions that don't pass one."""
+    eng = Engine(params, CFG, _ec(max_slots=1, default_deadline_s=0.01))
+    eng.start()
+    try:
+        blocker = eng.generate_async(PROMPTS[0], 30, deadline=60.0)
+        _wait(lambda: eng.stats["active_slots"] == 1, msg="blocker admitted")
+        doomed = eng.generate_async(PROMPTS[1], 4)  # inherits 0.01s
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=120)
+        assert blocker.result(timeout=120)["num_tokens"] == 30
+    finally:
+        eng.stop()
+
+
+def test_overload_backpressure_fails_fast(params):
+    """Submissions past max_queue_depth raise EngineOverloaded immediately
+    (bounded queue), without touching the futures already queued."""
+    eng = Engine(params, CFG, _ec(max_slots=1, max_queue_depth=2))
+    eng.start()
+    try:
+        blocker = eng.generate_async(PROMPTS[0], 40)
+        _wait(lambda: eng.stats["active_slots"] == 1, msg="blocker admitted")
+        q1 = eng.generate_async(PROMPTS[1], 3)
+        q2 = eng.generate_async(PROMPTS[2], 3)
+        assert eng.stats["queue_depth"] == 2
+        with pytest.raises(EngineOverloaded):
+            eng.generate_async(PROMPTS[3], 3)
+        assert eng.stats["requests_rejected"] == 1
+        for f in (blocker, q1, q2):
+            assert isinstance(f.result(timeout=180)["tokens"], list)
+    finally:
+        eng.stop()
+
+
+def test_generate_timeout_cancels_instead_of_leaking_slot(params):
+    """Satellite: generate(timeout=) expiry used to strand the request in
+    its slot holding KV pages to the token budget; now the timeout cancels
+    it and the slot frees promptly for the next caller."""
+    eng = Engine(params, CFG, _ec(max_slots=1))
+    eng.start()
+    try:
+        with pytest.raises(FutureTimeoutError):
+            eng.generate(PROMPTS[0], 120, timeout=0.02)
+        # the cancel lands at the next tick: slot + pages come back long
+        # before 120 tokens' worth of decode
+        _wait(lambda: eng.stats["active_slots"] == 0, timeout=30,
+              msg="slot freed after timeout")
+        s = eng.stats
+        assert s["free_pages"] + s["cached_pages"] == eng.ec.num_pages - 1
+        out = eng.generate(PROMPTS[1], 3, timeout=120)
+        assert out["num_tokens"] == 3
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------- watchdog / restart
+
+
+def test_thread_death_watchdog_fails_futures_and_restarts(params):
+    """Injected loop death: the supervisor detects the dead thread, fails
+    the in-flight future with a typed error, restarts the loop with fresh
+    decode state, and the revived engine serves new work."""
+    eng = Engine(params, CFG, _ec(
+        max_slots=2,
+        chaos=FaultConfig(seed=0, die_on_tick=3),
+        watchdog_interval_s=0.05))
+    eng.start()
+    try:
+        fut = eng.generate_async(PROMPTS[0], 120)  # long: mid-flight at death
+        with pytest.raises(TickFailure, match="died"):
+            fut.result(timeout=60)
+        _wait(lambda: eng.stats["restarts"] == 1, msg="watchdog restart")
+        _wait(lambda: eng.health()["state"] == "SERVING", msg="revived")
+        out = eng.generate(PROMPTS[1], 3, timeout=120)
+        assert out["num_tokens"] == 3
+        s = eng.stats
+        assert s["free_pages"] + s["cached_pages"] == eng.ec.num_pages - 1
+        assert s["chaos"]["injected_deaths"] == 1
+    finally:
+        eng.stop()
+
+
+def test_hung_loop_detected_and_epoch_fenced_restart(params):
+    """A loop stalled inside one tick past hang_timeout_s: the watchdog
+    fails the in-flight future, epoch-fences the stale thread (it exits on
+    wake without touching state), and the replacement loop serves on."""
+    eng = Engine(params, CFG, _ec(
+        max_slots=2,
+        chaos=FaultConfig(seed=0, slow_tick_on=3, slow_tick_s=2.0),
+        watchdog_interval_s=0.05, hang_timeout_s=0.4))
+    eng.start()
+    try:
+        fut = eng.generate_async(PROMPTS[0], 120)
+        with pytest.raises(TickFailure, match="hung"):
+            fut.result(timeout=60)
+        assert eng.stats["restarts"] >= 1
+        # after the stale thread wakes and exits, the new loop serves
+        out = eng.generate(PROMPTS[1], 3, timeout=120)
+        assert out["num_tokens"] == 3
+        _wait(lambda: eng.health()["state"] == "SERVING", msg="SERVING again")
+    finally:
+        eng.stop()
+
+
+def test_health_state_machine_lifecycle(params):
+    eng = Engine(params, CFG, _ec(max_slots=1))
+    assert eng.health()["state"] == "DEAD"  # not started
+    eng.start()
+    try:
+        _wait(lambda: eng.health()["state"] == "SERVING", msg="SERVING")
+    finally:
+        eng.stop()
+    assert eng.health()["state"] == "DEAD"  # stopped
+    with pytest.raises(EngineShutdown):
+        eng.generate_async([1, 2], 2)
+
+
+# ------------------------------------------------------------ graceful stop
+
+
+def test_stop_drains_in_flight_and_fails_queued(params):
+    """stop(): the in-flight request finishes (drain), the queued one is
+    resolved with EngineShutdown instead of hanging its caller forever, and
+    new submissions are refused."""
+    eng = Engine(params, CFG, _ec(max_slots=1))
+    eng.start()
+    active = eng.generate_async(PROMPTS[0], 25)
+    _wait(lambda: eng.stats["active_slots"] == 1, msg="active admitted")
+    queued = eng.generate_async(PROMPTS[1], 5)
+    eng.stop()  # graceful drain
+    assert active.result(timeout=1)["num_tokens"] == 25  # finished in drain
+    with pytest.raises(EngineShutdown):
+        queued.result(timeout=1)
+    with pytest.raises(EngineShutdown):
+        eng.generate_async(PROMPTS[2], 3)
+
+
+def test_stop_hard_timeout_fails_stuck_inflight(params):
+    """A drain that cannot finish (every dispatch fails, watchdog off) hits
+    the hard timeout and fails the in-flight future instead of hanging."""
+    eng = Engine(params, CFG, _ec(
+        max_slots=1,
+        chaos=FaultConfig(seed=1, dispatch_error_rate=1.0),
+        max_consecutive_failures=10**9,  # never rejected: genuinely stuck
+        watchdog_interval_s=0, drain_timeout_s=0.3))
+    eng.start()
+    fut = eng.generate_async(PROMPTS[0], 5)
+    time.sleep(0.2)  # let it get admitted and start failing
+    t0 = time.monotonic()
+    eng.stop()
+    assert time.monotonic() - t0 < 15  # bounded by drain_timeout + join
+    with pytest.raises(EngineShutdown):
+        fut.result(timeout=1)
+
+
+# ------------------------------------------------------- streaming surface
+
+
+def test_stream_surfaces_typed_error(params):
+    """A streaming client of a failed request gets the typed error raised
+    out of the iterator (after any tokens already streamed), not a hang."""
+    eng = Engine(params, CFG, _ec(
+        max_slots=2, chaos=FaultConfig(seed=0, nan_logit_rate=1.0)))
+    eng.start()
+    try:
+        stream = eng.generate_stream(PROMPTS[0], 8, timeout=60)
+        with pytest.raises(NonFiniteLogits):
+            list(stream)
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------- serving layer
+
+
+def test_parse_generate_deadline_param():
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+
+    m = JetStreamModel("m", engine=None)
+    ids, mt, adapter, deadline = m._parse_generate(
+        {"text_input": "ab", "parameters": {"max_tokens": 4,
+                                            "deadline_s": 2.5}})
+    assert deadline == 2.5 and mt == 4
+    with pytest.raises(RequestError, match="deadline_s"):
+        m._parse_generate({"text_input": "ab",
+                           "parameters": {"deadline_s": "soon"}})
+
+
+def test_extra_metrics_exposes_health(params):
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+
+    eng = Engine(params, CFG, _ec(max_slots=1))
+    m = JetStreamModel("m", engine=eng)
+    m.load()
+    try:
+        _wait(lambda: m.extra_metrics()["engine_serving"] == 1.0,
+              msg="metrics SERVING")
+        em = m.extra_metrics()
+        for k in ("engine_ticks_failed", "engine_requests_shed",
+                  "engine_requests_rejected", "engine_restarts"):
+            assert em[k] == 0
+    finally:
+        eng.stop()
