@@ -10,7 +10,51 @@ simulator share a process), text exposition format, optional HTTP server.
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import Optional, Sequence
+
+# Prometheus client-library default latency buckets (seconds).
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0)
+
+
+def escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or the exposition is unparseable."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(key: tuple) -> str:
+    return ",".join(f'{k}="{escape_label_value(val)}"' for k, val in key)
+
+
+def add_const_labels(text: str, labels: dict) -> str:
+    """Rewrite rendered exposition so every sample carries extra constant
+    labels.  The multi-registry merge case: two engines in one server each
+    render ``engine_ttft_seconds`` — without a distinguishing label the
+    combined scrape has duplicate series and Prometheus rejects it whole.
+    Comment/blank lines pass through; labels are appended after existing
+    ones (label order is not significant to scrapers)."""
+    if not labels:
+        return text
+    import re
+
+    extra = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    sample = re.compile(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (.*)$')
+    out = []
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            out.append(line)
+            continue
+        m = sample.match(line)
+        if m is None:  # not a sample line: leave untouched
+            out.append(line)
+            continue
+        name, labs, value = m.group(1), m.group(2), m.group(3)
+        merged = f"{labs},{extra}" if labs else extra
+        out.append(f"{name}{{{merged}}} {value}")
+    return "\n".join(out) + ("\n" if text.endswith("\n") else "")
 
 
 class _Metric:
@@ -28,7 +72,7 @@ class _Metric:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         with self._lock:
             for key, v in sorted(self._values.items()):
-                label_s = ",".join(f'{k}="{val}"' for k, val in key)
+                label_s = _fmt_labels(key)
                 lines.append(f"{self.name}{{{label_s}}} {v:g}" if label_s else f"{self.name} {v:g}")
         return "\n".join(lines)
 
@@ -58,6 +102,90 @@ class Gauge(_Metric):
         return self._values.get(self.labels_key(labels), 0.0)
 
 
+class Histogram(_Metric):
+    """Prometheus histogram: cumulative ``_bucket{le=...}`` counts plus
+    ``_sum``/``_count``, per label set.  Buckets are fixed at construction
+    (upper bounds, seconds by convention); ``+Inf`` is implicit."""
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_, "histogram")
+        self.buckets = tuple(sorted(set(float(b) for b in buckets)))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        # label key -> [per-bucket counts (non-cumulative), sum, count]
+        self._series: dict[tuple, list] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        value = float(value)
+        key = self.labels_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = [[0] * (len(self.buckets) + 1), 0.0, 0]
+            counts, _, _ = s
+            # first bucket whose upper bound holds the value; the trailing
+            # slot is +Inf
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            s[1] += value
+            s[2] += 1
+
+    def snapshot(self, **labels) -> dict:
+        """(cumulative bucket counts, sum, count) for one label set —
+        test/bench introspection without parsing the text format."""
+        key = self.labels_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                return {"buckets": {}, "sum": 0.0, "count": 0}
+            cum, acc = {}, 0
+            for b, c in zip(self.buckets, s[0]):
+                acc += c
+                cum[b] = acc
+            return {"buckets": cum, "sum": s[1], "count": s[2]}
+
+    def quantile(self, q: float, **labels) -> float:
+        """Estimate the q-quantile (0..1) by linear interpolation inside the
+        owning bucket — the PromQL histogram_quantile estimator."""
+        snap = self.snapshot(**labels)
+        n = snap["count"]
+        if n == 0:
+            return 0.0
+        rank = q * n
+        lo = 0.0
+        prev_c = 0
+        for b, c in snap["buckets"].items():
+            if c >= rank:
+                width = b - lo
+                frac = (rank - prev_c) / max(1, c - prev_c)
+                return lo + width * frac
+            lo, prev_c = b, c
+        return self.buckets[-1]
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, (counts, sum_, count) in sorted(self._series.items()):
+                base = _fmt_labels(key)
+                acc = 0
+                for b, c in zip(self.buckets, counts):
+                    acc += c
+                    lab = (base + "," if base else "") + f'le="{b:g}"'
+                    lines.append(f"{self.name}_bucket{{{lab}}} {acc}")
+                lab = (base + "," if base else "") + 'le="+Inf"'
+                lines.append(f"{self.name}_bucket{{{lab}}} {count}")
+                sfx = f"{{{base}}}" if base else ""
+                lines.append(f"{self.name}_sum{sfx} {sum_:g}")
+                lines.append(f"{self.name}_count{sfx} {count}")
+        return "\n".join(lines)
+
+
 class Registry:
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
@@ -75,6 +203,14 @@ class Registry:
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = Gauge(name, help_)
+            return m  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Histogram(name, help_, buckets)
             return m  # type: ignore[return-value]
 
     def render(self) -> str:

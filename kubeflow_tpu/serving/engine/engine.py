@@ -40,6 +40,8 @@ import numpy as np
 from ..errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
                       NonFiniteLogits, RequestError, TickFailure)
 from .faults import ChaosInjector, FaultConfig
+from .telemetry import (EngineTelemetry, FlightRecorder, RequestSpan,
+                        TickProfiler)
 from .model import (DecoderConfig, decode_step, decode_step_k, prefill,
                     prefill_chunk, sample_tokens, write_pages)
 from .native import NativeBatcher
@@ -146,6 +148,19 @@ class EngineConfig:
     # (costs one extra [B]-bool device fetch per tick; a NaN row fails only
     # its own slot with NonFiniteLogits instead of emitting garbage)
     logit_guard: bool = True
+    # ---- observability (README "Observability") -------------------------
+    # master switch for the telemetry layer: lifecycle spans, latency
+    # histograms, and the flight recorder.  Off = the loop pays one boolean
+    # check per hook (serving_bench --obs measures the on-cost)
+    telemetry: bool = True
+    # flight recorder: ring capacity (structured tick events kept for
+    # postmortem dumps) and where JSONL dumps land (None: ENGINE_FLIGHT_DIR
+    # env, else <tmpdir>/engine_flightrec)
+    flight_recorder_capacity: int = 256
+    flight_dir: Optional[str] = None
+    # completed request spans kept for Engine.trace(rid) after the request
+    # resolves (live requests are always traceable)
+    trace_history: int = 512
     # deterministic chaos injection (faults.py) — test/bench substrate
     chaos: Optional[FaultConfig] = None
 
@@ -180,6 +195,10 @@ class _Pending:
     # consecutive tick failures while this request was in the offending
     # group; reset on every successful commit, rejected at the config cap
     failures: int = 0
+    # lifecycle span (telemetry.RequestSpan; None when telemetry is off)
+    span: "RequestSpan" = None
+    # perf_counter of the most recent committed token (TPOT numerator)
+    last_token_at: float = 0.0
 
 
 class _StaleThread(BaseException):
@@ -330,6 +349,17 @@ class Engine:
         # count of in-flight requests with failures > 0, so health() reads
         # DEGRADED without an O(requests) scan under the hot-loop lock
         self._retrying = 0
+        # ---- observability (telemetry.py) -------------------------------
+        # per-engine registry (TTFT/TPOT/queue-wait/tick histograms + KV
+        # gauges), tick-event ring for postmortems, completed-span history
+        # for trace(rid), and the on-demand jax.profiler capture hook
+        self.telemetry = EngineTelemetry(enabled=engine_config.telemetry)
+        self.flight = FlightRecorder(
+            capacity=engine_config.flight_recorder_capacity,
+            dump_dir=engine_config.flight_dir)
+        self._trace_ring: "dict[int, RequestSpan]" = {}
+        self._nan_dump_tick = -1  # last tick that produced a NaN dump
+        self._profiler = TickProfiler()
         self._wd_stop = threading.Event()
         self._wd_thread: Optional[threading.Thread] = None
         # loop threads record their epoch here; state-mutation points check
@@ -476,6 +506,7 @@ class Engine:
                 stream=stream, context=list(tokens), adapter_id=aid,
                 deadline=(time.perf_counter() + deadline
                           if deadline is not None else None),
+                span=(RequestSpan(rid) if self.ec.telemetry else None),
             )
             self._future_rid[fut] = rid
         # lookup eligibility stops one page short of the prompt end: prefill
@@ -555,12 +586,14 @@ class Engine:
                 self._requests.pop(rid)
                 self._future_rid.pop(future, None)
                 queued_result = {
+                    "rid": rid,
                     "tokens": [], "num_tokens": 0, "truncated": False,
                     "cancelled": True, "ttft_s": 0.0,
                     "latency_s": time.perf_counter() - pending.submitted_at}
         if queued_result is not None:
             # resolve OUTSIDE the lock (same split _finish uses): a Future
             # done-callback may re-enter the engine and take _lock
+            self._archive_span(pending, "cancelled")
             pending.future.set_result(queued_result)
             if pending.stream is not None:
                 pending.stream.put((None, queued_result))
@@ -604,25 +637,72 @@ class Engine:
 
     @property
     def stats(self) -> dict:
-        return {
-            "active_slots": self.batcher.num_active,
-            "queue_depth": self.batcher.queue_depth,
-            "free_pages": self.batcher.free_pages,
-            "spec_proposed": self._spec_proposed,
-            "spec_accepted": self._spec_accepted,
-            "prefill_dispatches": self._prefill_dispatches,
-            "prefill_rows": self._prefill_rows_total,
-            "prefill_batch_hist": dict(self._prefill_batch_hist),
-            "ticks": self._ticks,
-            "ticks_failed": self._ticks_failed,
-            "requests_shed": self._requests_shed,
-            "requests_rejected": self._requests_rejected,
-            "requests_failed": self._requests_failed,
-            "nan_rows": self._nan_rows,
-            "restarts": self._restarts,
-            **({"chaos": self._chaos.stats()} if self._chaos else {}),
-            **self.batcher.cache_stats(),
-        }
+        # snapshot under the engine lock: atomic with respect to the
+        # _lock-guarded request paths (submit/cancel/finish registration),
+        # so a scrape never interleaves with a request being moved between
+        # queue and slot.  Loop-side counters are plain monotonic ints
+        # mutated lock-free on the hot path (individually never torn under
+        # the GIL); the lock does NOT freeze those or the C batcher
+        # mid-tick — cross-field skew of one tick is acceptable in a
+        # metrics read and not worth serializing the decode loop for
+        with self._lock:
+            return {
+                "active_slots": self.batcher.num_active,
+                "queue_depth": self.batcher.queue_depth,
+                "free_pages": self.batcher.free_pages,
+                "spec_proposed": self._spec_proposed,
+                "spec_accepted": self._spec_accepted,
+                "prefill_dispatches": self._prefill_dispatches,
+                "prefill_rows": self._prefill_rows_total,
+                "prefill_batch_hist": dict(self._prefill_batch_hist),
+                "ticks": self._ticks,
+                "ticks_failed": self._ticks_failed,
+                "requests_shed": self._requests_shed,
+                "requests_rejected": self._requests_rejected,
+                "requests_failed": self._requests_failed,
+                "nan_rows": self._nan_rows,
+                "restarts": self._restarts,
+                **({"chaos": self._chaos.stats()} if self._chaos else {}),
+                **self.batcher.cache_stats(),
+            }
+
+    # ---------------------------------------------------------- tracing API
+
+    def trace(self, rid: int) -> Optional[dict]:
+        """Lifecycle trace for a request id: live requests come from their
+        in-flight span, resolved ones from the bounded trace history.  None
+        when telemetry is off or the rid fell out of the history ring."""
+        with self._lock:
+            pending = self._requests.get(rid)
+            span = pending.span if pending is not None else self._trace_ring.get(rid)
+        return span.to_dict() if span is not None else None
+
+    def trace_n_ticks(self, n: int, trace_dir: str) -> str:
+        """Capture a jax.profiler (XLA) trace of the next ``n`` live engine
+        ticks into ``trace_dir``.  Start/stop run on the loop thread at tick
+        boundaries; returns immediately — poll ``profiler_active`` (or just
+        wait) for completion.  Raises if a capture is already in flight."""
+        self._profiler.request(n, trace_dir)
+        self._wake.set()  # an idle loop still ticks; make sure it wakes now
+        return trace_dir
+
+    @property
+    def profiler_active(self) -> bool:
+        return self._profiler.active
+
+    def _archive_span(self, pending: "_Pending", outcome: str) -> None:
+        """Terminal-mark a request's span, count the outcome, and retire the
+        span into the bounded trace history (oldest evicted first)."""
+        self.telemetry.count_outcome(outcome)
+        span = pending.span
+        if span is None:
+            return
+        if span.outcome is None:
+            span.mark(outcome)
+        with self._lock:
+            self._trace_ring[span.rid] = span
+            while len(self._trace_ring) > self.ec.trace_history:
+                self._trace_ring.pop(next(iter(self._trace_ring)))
 
     # ------------------------------------------------------------------ loop
 
@@ -651,6 +731,7 @@ class Engine:
         self._prefill_dispatches += 1
         self._prefill_rows_total += rows
         self._prefill_batch_hist[rows] = self._prefill_batch_hist.get(rows, 0) + 1
+        self.telemetry.observe_prefill_batch(rows)
 
     def _guard_logits(self, logits, row_rids):
         """Chaos NaN injection + the sample-path logit guard.
@@ -687,6 +768,8 @@ class Engine:
         aids = np.zeros((B,), np.int32)
         for i, slot in enumerate(slots):
             pending = self._requests[self._slot_req[slot]]
+            if pending.span is not None:
+                pending.span.mark("prefill")
             plen = len(pending.tokens)
             toks[i, :plen] = pending.tokens
             lens[i] = plen
@@ -712,17 +795,21 @@ class Engine:
         now = time.perf_counter()
         for i, slot in enumerate(slots):
             if ok is not None and not ok[i]:
-                self._nan_rows += 1
-                self._fail_slot(slot, NonFiniteLogits(
-                    "non-finite logits in prefill sample row"))
+                self._fail_nan(slot, "prefill sample row")
                 continue
             pending = self._requests[self._slot_req[slot]]
             del self._prefilling[slot]
             pending.first_token_at = now
+            self._mark_first_token(pending, now)
             plen = int(lens[i])
             self._activate_decode(slot, plen, self._pages_for(plen),
                                   self._prefill_rows[slot])
             self._commit(slot, int(sampled[i]))
+
+    def _mark_first_token(self, pending: "_Pending", now: float) -> None:
+        if pending.span is not None:
+            pending.span.mark("first_token")
+        self.telemetry.observe_ttft(now - pending.submitted_at)
 
     def _prefill_chunk_group(self, slots: list, off: int) -> None:
         """ONE fused chunked-prefill dispatch for every long/cache-resumed
@@ -744,6 +831,8 @@ class Engine:
         table_rows = {}
         for i, slot in enumerate(slots):
             pending = self._requests[self._slot_req[slot]]
+            if pending.span is not None:
+                pending.span.mark("prefill")
             plen = len(pending.tokens)
             chunk = pending.tokens[off:off + C]
             toks[i, :len(chunk)] = chunk
@@ -786,13 +875,12 @@ class Engine:
                 self._reset_failures(self._requests[self._slot_req[slot]])
                 continue
             if ok is not None and not ok[i]:
-                self._nan_rows += 1
-                self._fail_slot(slot, NonFiniteLogits(
-                    "non-finite logits in chunked-prefill sample row"))
+                self._fail_nan(slot, "chunked-prefill sample row")
                 continue
             pending = self._requests[self._slot_req[slot]]
             del self._prefilling[slot]
             pending.first_token_at = now
+            self._mark_first_token(pending, now)
             plen = int(lens[i])
             self._activate_decode(slot, plen, self._pages_for(plen),
                                   table_rows[slot])
@@ -826,9 +914,12 @@ class Engine:
                     return  # thread dies; state stays as-is, like a crash
                 if self._epoch != epoch:
                     return  # supervisor replaced us while we were stalled
-            tick_t0 = time.perf_counter() if tick_floor else 0.0
+            obs = self.ec.telemetry
+            tick_t0 = time.perf_counter() if (tick_floor or obs) else 0.0
             self._ticks += 1
             self._last_tick_ts = time.monotonic()
+            self._profiler.on_tick_start(self._ticks)
+            did_work = False
             try:
                 did_work = self._tick()
             except _StaleThread:
@@ -841,8 +932,22 @@ class Engine:
                     self._note_group_failure(list(self._slot_req), "tick", exc)
                 except _StaleThread:
                     return  # the "fault" was our own supersession
+                if obs:
+                    # failed ticks belong in the duration histogram too —
+                    # the slowest, most diagnostic ticks are often exactly
+                    # the ones that end in an escaped exception
+                    self.telemetry.observe_tick(time.perf_counter() - tick_t0)
                 time.sleep(0.005)
                 continue
+            finally:
+                # work ticks only: the capture window must not be consumed
+                # by idle 20ms waits (a failed tick counts — it dispatched)
+                self._profiler.on_tick_end(self._ticks, did_work
+                                           or bool(self._slot_req))
+            if obs and did_work:
+                # tick-duration histogram: work ticks only — idle 20ms waits
+                # would swamp the distribution with scheduler noise
+                self.telemetry.observe_tick(time.perf_counter() - tick_t0)
             if did_work and tick_floor:
                 pad = tick_floor - (time.perf_counter() - tick_t0)
                 if pad > 0:
@@ -879,6 +984,9 @@ class Engine:
             if pending is None:
                 self.batcher.release(slot)
                 continue
+            if pending.span is not None:
+                now = pending.span.mark("admitted")
+                self.telemetry.observe_queue_wait(now - pending.submitted_at)
             if pending.cancelled:  # cancelled between submit and admit
                 self._finish(slot, rid, truncated=False,
                              cancelled=True, cache_ok=False)
@@ -932,10 +1040,14 @@ class Engine:
                 chunked.setdefault(off, []).append(slot)
         for bucket in sorted(shorts):
             self._isolated("prefill", shorts[bucket],
-                           self._prefill_short_group, shorts[bucket], bucket)
+                           self._prefill_short_group, shorts[bucket], bucket,
+                           shape={"rows": len(shorts[bucket]),
+                                  "bucket": bucket})
         for off in sorted(chunked):
             self._isolated("prefill_chunk", chunked[off],
-                           self._prefill_chunk_group, chunked[off], off)
+                           self._prefill_chunk_group, chunked[off], off,
+                           shape={"rows": len(chunked[off]), "offset": off,
+                                  "chunk": self.ec.prefill_chunk})
 
         # --- one decode step over slots whose prefill is complete
         # (_slot_req membership == slot active; no C snapshot needed)
@@ -966,35 +1078,60 @@ class Engine:
             if any(drafts.values()):
                 self._isolated("decode", decode_ready,
                                self._decode_tick_speculative, decode_ready,
-                               drafts, seq_lens, page_table)
+                               drafts, seq_lens, page_table,
+                               shape={"rows": len(decode_ready),
+                                      "speculative": True,
+                                      "k": 1 + self.ec.spec_max_draft})
             else:
                 self._isolated("decode", decode_ready,
                                self._decode_tick_single, decode_ready,
-                               seq_lens, page_table)
+                               seq_lens, page_table,
+                               shape={"rows": len(decode_ready)})
         return did_work
 
     # ------------------------------------------------------ fault handling
 
-    def _isolated(self, phase: str, slots: list, fn, *args) -> bool:
+    def _isolated(self, phase: str, slots: list, fn, *args,
+                  shape: Optional[dict] = None) -> bool:
         """Isolation boundary around one tick phase: an exception fails only
         ``slots`` (the offending group), and only after the per-request
         consecutive-failure cap — a transient fault retries in place next
         tick.  Retry is sound because a failed dispatch committed nothing:
         prefill offsets/host mirrors only advance on success, and greedy
         decode re-produces byte-identical tokens from unchanged state.
-        ChaosThreadDeath (BaseException) deliberately passes through."""
+        ChaosThreadDeath (BaseException) deliberately passes through.
+
+        Every guarded dispatch also leaves a flight-recorder event (tick,
+        phase, slot set, dispatch shape, duration, outcome) — the raw
+        material of the postmortem dumps."""
+        obs = self.ec.telemetry
+        t0 = time.perf_counter() if obs else 0.0
         try:
             if self._chaos is not None:
                 self._chaos.maybe_dispatch_error(phase)
             fn(*args)
+            if obs:
+                self._flight_event(phase, slots, shape, t0, "ok")
             return True
         except Exception as exc:  # noqa: BLE001 — the boundary's whole job
+            if obs:
+                self._flight_event(phase, slots, shape, t0, "error",
+                                   error=f"{type(exc).__name__}: {exc}")
             self._note_group_failure(slots, phase, exc)
             return False
+
+    def _flight_event(self, phase: str, slots: list, shape: Optional[dict],
+                      t0: float, outcome: str, **extra) -> None:
+        self.flight.record(
+            tick=self._ticks, phase=phase, slots=list(slots),
+            rids=[self._slot_req.get(s) for s in slots],
+            shape=shape, duration_s=round(time.perf_counter() - t0, 6),
+            outcome=outcome, **extra)
 
     def _note_group_failure(self, slots: list, phase: str, exc: Exception) -> None:
         self._ticks_failed += 1
         cap = self.ec.max_consecutive_failures
+        escalated = []
         for slot in list(slots):
             rid = self._slot_req.get(slot)
             pending = self._requests.get(rid) if rid is not None else None
@@ -1008,7 +1145,37 @@ class Engine:
                     f"rejected after {pending.failures} consecutive "
                     f"{phase} failures (last: {type(exc).__name__}: {exc})")
                 err.__cause__ = exc
+                escalated.append(rid)
                 self._fail_slot(slot, err)
+        if escalated and self.ec.telemetry:
+            # a request crossed the consecutive-failure cap: that is a
+            # postmortem-worthy event — persist the tick-event ring now,
+            # while the failing tick's records are still in it
+            self.flight.dump(
+                "tick_failure_escalation",
+                extra={"phase": phase, "rids": escalated, "tick": self._ticks,
+                       "error": f"{type(exc).__name__}: {exc}"})
+
+    def _fail_nan(self, slot: int, where: str) -> None:
+        """NaN-guard trip: fail the poisoned slot with NonFiniteLogits and
+        dump the flight recorder — numerically diverged model state is the
+        canonical "what was the engine doing?" postmortem case.  One dump
+        per TICK, not per row: a whole poisoned batch is one incident, and
+        per-row dumps would burn the recorder's lifetime dump cap on
+        near-identical postmortems."""
+        self._nan_rows += 1
+        if self.ec.telemetry:
+            self._flight_event("nan_guard", [slot], None,
+                               time.perf_counter(), "nan",
+                               error=f"non-finite logits in {where}")
+            if self._nan_dump_tick != self._ticks:
+                self._nan_dump_tick = self._ticks
+                self.flight.dump(
+                    "nan_guard_trip",
+                    extra={"slot": slot, "rid": self._slot_req.get(slot),
+                           "where": where, "tick": self._ticks})
+        self._fail_slot(slot, NonFiniteLogits(
+            f"non-finite logits in {where}"))
 
     def _check_epoch(self) -> None:
         """Die (via _StaleThread, uncatchable by the isolation boundaries)
@@ -1058,6 +1225,7 @@ class Engine:
             self._requests_shed += 1
         else:
             self._requests_failed += 1
+        self._archive_span(pending, "shed" if shed else "failed")
         self._resolve_exception(pending, exc)
 
     def _fail_unassigned(self, exc: Exception) -> None:
@@ -1073,6 +1241,7 @@ class Engine:
                 self._future_rid.pop(p.future, None)
         for _, p in victims:
             self._requests_failed += 1
+            self._archive_span(p, "failed")
             self._resolve_exception(p, exc)
 
     def _resolve_exception(self, pending: _Pending, exc: Exception) -> None:
@@ -1115,6 +1284,19 @@ class Engine:
         # production deployment escalates a repeat offender to process
         # restart.  Loop DEATH (the common case) has no such window.
         self._epoch += 1
+        if self.ec.telemetry:
+            # the postmortem the flight recorder exists for: what the loop
+            # was doing when the watchdog had to step in
+            self.flight.record(tick=self._ticks, phase="watchdog",
+                               slots=list(self._slot_req),
+                               rids=list(self._slot_req.values()),
+                               shape=None, duration_s=0.0,
+                               outcome="supervise", error=reason)
+            self.flight.dump(
+                "watchdog_" + ("restart" if self.ec.watchdog_restart
+                               else "halt"),
+                extra={"detail": reason, "tick": self._ticks,
+                       "epoch": self._epoch, "restarts": self._restarts})
         err = TickFailure(f"engine {reason}; request abandoned by supervisor")
         for slot in list(self._slot_req):
             self._fail_slot(slot, err)
@@ -1160,9 +1342,7 @@ class Engine:
         ok = np.asarray(ok_dev) if ok_dev is not None else None
         for slot in decode_ready:
             if ok is not None and not ok[slot]:
-                self._nan_rows += 1
-                self._fail_slot(slot, NonFiniteLogits(
-                    f"non-finite logits in decode row (slot {slot})"))
+                self._fail_nan(slot, f"decode row (slot {slot})")
                 continue
             self._commit(slot, int(sampled[slot]))
 
@@ -1263,9 +1443,7 @@ class Engine:
             if ok is not None and not ok[slot]:
                 # any of the slot's K verify rows non-finite: fail the slot
                 # before committing anything from the poisoned pass
-                self._nan_rows += 1
-                self._fail_slot(slot, NonFiniteLogits(
-                    f"non-finite logits in speculative verify (slot {slot})"))
+                self._fail_nan(slot, f"speculative verify (slot {slot})")
                 continue
             d = drafts.get(slot) or []
             self._spec_proposed += len(d)
@@ -1300,6 +1478,12 @@ class Engine:
         rid = self._slot_req[slot]
         pending = self._requests[rid]
         self._reset_failures(pending)  # consecutive cap: progress resets it
+        if self.ec.telemetry:
+            now = time.perf_counter()
+            if pending.last_token_at:
+                # inter-token interval (TPOT) — the decode-speed histogram
+                self.telemetry.observe_tpot(now - pending.last_token_at)
+            pending.last_token_at = now
         pending.generated.append(token)
         pending.context.append(token)
         if pending.stream is not None:
@@ -1338,8 +1522,10 @@ class Engine:
         # unless the prefill never finished (cancel mid-prefill): those pages
         # hold garbage and must not be served to other requests
         self.batcher.release(slot, pending.page_hashes if cache_ok else None)
+        self._archive_span(pending, "cancelled" if cancelled else "done")
         now = time.perf_counter()
         result = {
+            "rid": rid,
             "tokens": pending.generated,
             "num_tokens": len(pending.generated),
             "truncated": truncated,

@@ -212,6 +212,39 @@ class JetStreamModel(Model):
             "engine_restarts": s["restarts"],
         }
 
+    def metrics_text(self) -> str:
+        """The engine's telemetry registry in Prometheus text format —
+        TTFT/TPOT/queue-wait/tick-duration histograms, prefill-batch-size,
+        KV-page gauges — appended verbatim to the server's /metrics (the
+        real exposition path; extra_metrics stays the flat-gauge surface
+        the router/autoscaler scrape-parse)."""
+        if self.engine is None:
+            return ""
+        try:
+            s = self.engine.stats
+            # occupancy gauges are refreshed at scrape time, not per tick:
+            # a gauge only needs to be right when somebody reads it
+            self.engine.telemetry.set_kv_pages(
+                s["free_pages"], s.get("cached_pages", 0),
+                self.engine.ec.num_pages - 1)  # page 0 is the trash page
+        except RuntimeError:  # engine stopped
+            return ""
+        from ...core.metrics import add_const_labels
+
+        # every sample carries model="<name>": two engine-backed models in
+        # one server must render DISTINCT series, not duplicate samples a
+        # scraper would reject wholesale
+        return add_const_labels(self.engine.telemetry.render(),
+                                {"model": self.name})
+
+    @staticmethod
+    def _wants_trace(headers: Optional[dict]) -> bool:
+        """Opt-in request tracing: any truthy ``X-Request-Trace`` header."""
+        for k, v in (headers or {}).items():
+            if k.lower() == "x-request-trace":
+                return str(v).strip().lower() not in ("", "0", "false", "no")
+        return False
+
     def _parse_generate(self, payload: Any):
         prompt = payload.get("text_input", "") if isinstance(payload, dict) else str(payload)
         params = (payload.get("parameters") or {}) if isinstance(payload, dict) else {}
@@ -232,14 +265,19 @@ class JetStreamModel(Model):
 
     def generate(self, payload: Any, headers: Optional[dict] = None) -> Any:
         """V2 generate extension (unary): {"text_input": str, "parameters":
-        {"max_tokens": N, "deadline_s": S}} -> {"text_output": str, ...}."""
+        {"max_tokens": N, "deadline_s": S}} -> {"text_output": str, ...}.
+        A truthy ``X-Request-Trace`` header adds the request's lifecycle
+        span (``Engine.trace``) as a ``trace`` field."""
         ids, max_tokens, adapter, deadline = self._parse_generate(payload)
         r = self.engine.generate(ids, max_tokens, adapter=adapter,
                                  deadline=deadline)
-        return {"text_output": self.tokenizer.decode(r["tokens"]),
-                "token_ids": r["tokens"], "tokens": r["num_tokens"],
-                "prompt_tokens": len(ids), "max_tokens": max_tokens,
-                "ttft_s": round(r["ttft_s"], 4), "latency_s": round(r["latency_s"], 4)}
+        out = {"text_output": self.tokenizer.decode(r["tokens"]),
+               "token_ids": r["tokens"], "tokens": r["num_tokens"],
+               "prompt_tokens": len(ids), "max_tokens": max_tokens,
+               "ttft_s": round(r["ttft_s"], 4), "latency_s": round(r["latency_s"], 4)}
+        if self._wants_trace(headers):
+            out["trace"] = self.engine.trace(r["rid"])
+        return out
 
     def generate_stream(self, payload: Any, headers: Optional[dict] = None):
         """V2 generate_stream: yields {"text_output": piece} per token, then
@@ -259,9 +297,11 @@ class JetStreamModel(Model):
         ids, max_tokens, adapter, deadline = self._parse_generate(payload)
         stream = self.engine.generate_stream(ids, max_tokens, adapter=adapter,
                                              deadline=deadline)
-        return self._stream_pieces(stream, ids, max_tokens)
+        return self._stream_pieces(stream, ids, max_tokens,
+                                   with_trace=self._wants_trace(headers))
 
-    def _stream_pieces(self, stream, ids: list, max_tokens: int):
+    def _stream_pieces(self, stream, ids: list, max_tokens: int,
+                       with_trace: bool = False):
         out_ids: list[int] = []
         emitted = 0
         try:
@@ -270,10 +310,13 @@ class JetStreamModel(Model):
                     full = self.tokenizer.decode(out_ids)
                     if len(full) > emitted:  # flush held-back tail
                         yield {"text_output": full[emitted:]}
-                    yield {"text_output": "", "done": True, "tokens": item["num_tokens"],
-                           "prompt_tokens": len(ids), "max_tokens": max_tokens,
-                           "ttft_s": round(item["ttft_s"], 4),
-                           "latency_s": round(item["latency_s"], 4)}
+                    final = {"text_output": "", "done": True, "tokens": item["num_tokens"],
+                             "prompt_tokens": len(ids), "max_tokens": max_tokens,
+                             "ttft_s": round(item["ttft_s"], 4),
+                             "latency_s": round(item["latency_s"], 4)}
+                    if with_trace:
+                        final["trace"] = self.engine.trace(item["rid"])
+                    yield final
                     return
                 out_ids.append(item)
                 full = self.tokenizer.decode(out_ids)

@@ -1,0 +1,321 @@
+"""Serving telemetry: request lifecycle spans, engine histograms, and the
+flight recorder (ISSUE 3).
+
+PR 2 made the engine loop fault-tolerant but observable only through flat
+gauges.  This module is the missing instrumentation layer, in three parts:
+
+  * ``EngineTelemetry`` — a per-engine ``core.metrics.Registry`` holding the
+    serving histograms the JetStream/vLLM literature treats as first-class
+    (PAPERS.md): TTFT, TPOT (inter-token), queue-wait, tick-duration,
+    prefill-batch-size, plus KV-page-occupancy gauges and a requests-total
+    counter by outcome.  The model server renders the registry into
+    ``/metrics`` verbatim (valid Prometheus text exposition), replacing the
+    old float()-coerced gauge path for distribution data.
+  * ``RequestSpan`` — one per request: monotonic (perf_counter) phase marks
+    from queued through admitted/prefill/first_token to a terminal outcome.
+    Exposed live via ``Engine.trace(rid)`` and, opt-in, as an
+    ``X-Request-Trace`` response field on the generate surfaces.
+  * ``FlightRecorder`` — a bounded ring buffer of structured tick events
+    (phase, slots, dispatch shape, duration, outcome).  The engine dumps it
+    as JSONL on TickFailure escalation, NaN-guard trips, and watchdog
+    restarts, so a chaos-test failure or a production incident leaves a
+    readable postmortem instead of nothing.
+
+Everything here is host-side, allocation-light, and lock-scoped so the
+decode hot loop pays nanoseconds when telemetry is on and a boolean check
+when it is off (serving_bench --obs asserts the p50 overhead budget).
+
+``TickProfiler`` wires ``jax.profiler`` to the tick loop: ``Engine.
+trace_n_ticks(n, dir)`` captures an XLA trace of exactly n live ticks.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from ...core.metrics import Registry
+
+# Latency-class buckets (seconds).  TTFT/queue-wait span sub-ms CPU ticks up
+# to cold-compile minutes; TPOT/tick-duration are per-step and an order of
+# magnitude tighter.
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+STEP_BUCKETS_S = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                  0.25, 0.5, 1.0, 2.5)
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+# terminal span phases (everything else is a lifecycle waypoint)
+TERMINAL_PHASES = ("done", "shed", "failed", "cancelled")
+
+
+class RequestSpan:
+    """Per-request lifecycle record: (phase, perf_counter) marks.
+
+    Phases, in order: queued -> admitted -> prefill[xN] -> first_token ->
+    done | shed | failed | cancelled.  Mutated only by the submitting thread
+    (queued) and the engine loop (everything else), so marks need no lock;
+    readers get a copying ``to_dict``.
+    """
+
+    __slots__ = ("rid", "events", "outcome")
+
+    def __init__(self, rid: int):
+        self.rid = rid
+        self.events: list = [("queued", time.perf_counter())]
+        self.outcome: Optional[str] = None
+
+    def mark(self, phase: str) -> float:
+        t = time.perf_counter()
+        self.events.append((phase, t))
+        if phase in TERMINAL_PHASES:
+            self.outcome = phase
+        return t
+
+    def t(self, phase: str) -> Optional[float]:
+        """First mark of ``phase`` (None if never reached)."""
+        for p, ts in self.events:
+            if p == phase:
+                return ts
+        return None
+
+    def to_dict(self) -> dict:
+        """JSON-safe trace: phases with timestamps relative to submit,
+        plus the derived intervals dashboards actually plot."""
+        events = list(self.events)
+        t0 = events[0][1]
+        out = {
+            "rid": self.rid,
+            "outcome": self.outcome,
+            "events": [{"phase": p, "t_s": round(ts - t0, 6)}
+                       for p, ts in events],
+        }
+        by = {}
+        for p, ts in events:  # first occurrence wins
+            by.setdefault(p, ts)
+        if "admitted" in by:
+            out["queue_wait_s"] = round(by["admitted"] - t0, 6)
+        if "first_token" in by:
+            out["ttft_s"] = round(by["first_token"] - t0, 6)
+        term = next((by[p] for p in TERMINAL_PHASES if p in by), None)
+        if term is not None:
+            out["latency_s"] = round(term - t0, 6)
+        out["prefill_chunks"] = sum(1 for p, _ in events if p == "prefill")
+        return out
+
+
+class FlightRecorder:
+    """Bounded ring of structured tick events + JSONL postmortem dumps.
+
+    ``record`` is called from the engine loop only; ``snapshot``/``dump``
+    from any thread.  Dumps are capped per recorder so a chaos soak cannot
+    fill a disk with identical postmortems."""
+
+    def __init__(self, capacity: int = 256, dump_dir: Optional[str] = None,
+                 max_dumps: int = 16):
+        self._ring: collections.deque = collections.deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._dumps = 0
+        self.max_dumps = max_dumps
+        self.dump_dir = (dump_dir or os.environ.get("ENGINE_FLIGHT_DIR")
+                         or os.path.join(tempfile.gettempdir(),
+                                         "engine_flightrec"))
+        self.last_dump_path: Optional[str] = None
+
+    def record(self, **event) -> None:
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            event["t_s"] = round(time.perf_counter(), 6)
+            self._ring.append(event)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, reason: str, extra: Optional[dict] = None) -> Optional[str]:
+        """Write the ring as JSONL (header line first) and return the path;
+        None once the per-recorder dump cap is hit or the write fails —
+        postmortems must never take the serving path down with them."""
+        with self._lock:
+            if self._dumps >= self.max_dumps:
+                return None
+            self._dumps += 1  # reserve a slot (refunded if the write fails)
+            n = self._dumps
+            events = list(self._ring)
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            path = os.path.join(
+                self.dump_dir, f"flightrec-{os.getpid()}-{n:03d}.jsonl")
+            # reserved header keys win over extra (an extra "reason" must
+            # not mask what triggered the dump)
+            header = {**(extra or {}), "reason": reason,
+                      "wall_time": time.time(), "events": len(events)}
+            with open(path, "w") as f:
+                f.write(json.dumps(header) + "\n")
+                for e in events:
+                    f.write(json.dumps(e) + "\n")
+            self.last_dump_path = path
+            return path
+        except OSError:
+            # refund the slot: a transiently full/unwritable disk must not
+            # permanently exhaust the cap and silence later real incidents
+            with self._lock:
+                self._dumps -= 1
+            return None
+
+
+class EngineTelemetry:
+    """The engine's metric surface: one Registry per engine (replicas are
+    separate processes in production; separate engines in one test process
+    must not pollute each other's distributions).  All observe paths no-op
+    on ``enabled=False`` so the bench can measure the overhead honestly."""
+
+    def __init__(self, enabled: bool = True,
+                 registry: Optional[Registry] = None):
+        self.enabled = enabled
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        self.ttft = r.histogram(
+            "engine_ttft_seconds",
+            "time from submit to first committed token", LATENCY_BUCKETS_S)
+        self.tpot = r.histogram(
+            "engine_tpot_seconds",
+            "inter-token interval during decode (time per output token)",
+            STEP_BUCKETS_S)
+        self.queue_wait = r.histogram(
+            "engine_queue_wait_seconds",
+            "time from submit to slot admission", LATENCY_BUCKETS_S)
+        self.tick_duration = r.histogram(
+            "engine_tick_duration_seconds",
+            "wall time of one engine tick that did work", STEP_BUCKETS_S)
+        self.prefill_batch = r.histogram(
+            "engine_prefill_batch_size",
+            "prompt rows per fused prefill dispatch", BATCH_BUCKETS)
+        self.requests_total = r.counter(
+            "engine_requests_total", "terminal request outcomes")
+        self.kv_occupancy = r.gauge(
+            "engine_kv_page_occupancy_ratio",
+            "fraction of KV pool pages not free (in use or prefix-cached)")
+        self.kv_pages = r.gauge(
+            "engine_kv_pages", "KV pool pages by state (free/cached/used)")
+
+    # Observe methods stay branch-cheap: one attribute check, then a dict
+    # op under the metric's own lock.
+
+    def observe_ttft(self, s: float) -> None:
+        if self.enabled:
+            self.ttft.observe(s)
+
+    def observe_tpot(self, s: float) -> None:
+        if self.enabled:
+            self.tpot.observe(s)
+
+    def observe_queue_wait(self, s: float) -> None:
+        if self.enabled:
+            self.queue_wait.observe(s)
+
+    def observe_tick(self, s: float) -> None:
+        if self.enabled:
+            self.tick_duration.observe(s)
+
+    def observe_prefill_batch(self, rows: int) -> None:
+        if self.enabled:
+            self.prefill_batch.observe(rows)
+
+    def count_outcome(self, outcome: str) -> None:
+        if self.enabled:
+            self.requests_total.inc(outcome=outcome)
+
+    def set_kv_pages(self, free: int, cached: int, total: int) -> None:
+        if not self.enabled or total <= 0:
+            return
+        used = max(0, total - free - cached)
+        self.kv_pages.set(free, state="free")
+        self.kv_pages.set(cached, state="cached")
+        self.kv_pages.set(used, state="used")
+        self.kv_occupancy.set((total - free) / total)
+
+    def render(self) -> str:
+        return self.registry.render()
+
+
+class TickProfiler:
+    """jax.profiler glue for ``Engine.trace_n_ticks``: the engine loop calls
+    the two hooks at tick boundaries; start/stop happen ON the loop thread
+    so the captured trace brackets whole ticks, never a half-dispatch.
+
+    The n-tick window counts WORK ticks only: a capture armed on an idle
+    engine starts recording immediately (so the first dispatch's compile is
+    in the trace) but stays open until ``n`` ticks that actually dispatched
+    have elapsed — idle 20ms waits must not run the window down to an empty
+    profile.  Corollary: a capture on an engine that never receives work
+    stays active until work arrives or the engine stops.
+
+    State transitions are lock-guarded (request() runs on a caller thread),
+    and profiler failures degrade to a recorded error string — a broken
+    profiler install must not take the decode loop down."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: Optional[tuple] = None  # (n_work_ticks, dir)
+        self._remaining: Optional[int] = None
+        self.last_error: Optional[str] = None
+        self.captures = 0
+
+    def request(self, n_ticks: int, trace_dir: str) -> None:
+        if n_ticks <= 0:
+            raise ValueError("n_ticks must be positive")
+        with self._lock:
+            if self._pending is not None or self._remaining is not None:
+                raise RuntimeError("a profiler capture is already in flight")
+            self._pending = (n_ticks, trace_dir)
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._pending is not None or self._remaining is not None
+
+    def on_tick_start(self, tick: int) -> None:
+        with self._lock:
+            if self._pending is None:
+                return
+            n, d = self._pending
+            self._pending = None
+            self._remaining = n
+        try:
+            import jax
+
+            os.makedirs(d, exist_ok=True)
+            jax.profiler.start_trace(d)
+        except Exception as e:  # noqa: BLE001 — profiling is best-effort
+            self.last_error = f"{type(e).__name__}: {e}"
+            with self._lock:
+                self._remaining = None
+
+    def on_tick_end(self, tick: int, did_work: bool) -> None:
+        with self._lock:
+            if self._remaining is None:
+                return
+            if did_work:
+                self._remaining -= 1
+            if self._remaining > 0:
+                return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            self.captures += 1
+        except Exception as e:  # noqa: BLE001
+            self.last_error = f"{type(e).__name__}: {e}"
+        finally:
+            # deactivate only AFTER stop_trace has run: `active` going False
+            # is the caller-visible "capture finished" signal
+            with self._lock:
+                self._remaining = None

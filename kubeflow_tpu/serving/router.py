@@ -29,6 +29,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..core.api import APIServer, Obj
+from ..core.metrics import REGISTRY
 from .api import LABEL_ISVC, LABEL_REVISION
 from .controllers import (
     DEPLOYMENT_FOR_SERVICE_ANNOTATION,
@@ -40,6 +41,20 @@ from .controllers import (
 )
 
 ACTIVATION_TIMEOUT = 30.0
+
+# Ingress-side observability (shared core registry, rendered by
+# core.metrics.serve): per-backend relay counts by status class and the
+# ingress-observed latency distribution — the request-path complement of the
+# engine's own TTFT/TPOT histograms (a gap between the two is queueing or
+# relay overhead, exactly what a latency postmortem needs to localize).
+INGRESS_REQUESTS = REGISTRY.counter(
+    "ingress_requests_total",
+    "requests relayed by service proxies, by service/backend/status class")
+INGRESS_LATENCY = REGISTRY.histogram(
+    "ingress_request_seconds",
+    "ingress-observed relay latency incl. backend time, by service",
+    buckets=(0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0, 120.0))
 
 
 class _ProxyState:
@@ -115,6 +130,10 @@ class ServiceProxy:
                 try:
                     backend = proxy._pick_backend(state, body=body)
                 except LookupError as e:
+                    # same status-class label scheme as the relay path below,
+                    # so sum-by-code dashboards see these 503s too
+                    INGRESS_REQUESTS.inc(service=state.service_name,
+                                         backend="none", code="5xx")
                     self._reply(503, json.dumps({"error": str(e)}).encode())
                     return
                 url = f"http://127.0.0.1:{backend}{self.path}"
@@ -124,6 +143,8 @@ class ServiceProxy:
                                if k.lower() not in hop_by_hop}
                 fwd_headers.setdefault("Content-Type", "application/json")
                 req = urllib.request.Request(url, data=body, method=self.command, headers=fwd_headers)
+                t0 = time.perf_counter()
+                status = 502
                 try:
                     # relay timeout = per-read backend silence, NOT total
                     # request time; it must exceed any client-side budget
@@ -131,6 +152,7 @@ class ServiceProxy:
                     # 502s slow-but-alive generations its clients were
                     # still willing to wait for
                     with urllib.request.urlopen(req, timeout=300) as r:
+                        status = r.status
                         ctype = r.headers.get("Content-Type") or ""
                         if ctype.startswith("text/event-stream"):
                             # SSE passthrough: relay chunks as they arrive
@@ -141,9 +163,18 @@ class ServiceProxy:
                         else:
                             self._reply(r.status, r.read(), ctype or None)
                 except urllib.error.HTTPError as e:
+                    status = e.code
                     self._reply(e.code, e.read(), e.headers.get("Content-Type"))
                 except Exception as e:  # noqa: BLE001
+                    status = 502
                     self._reply(502, json.dumps({"error": f"backend: {e}"}).encode())
+                finally:
+                    # latency covers the full relay (SSE: the whole stream)
+                    INGRESS_LATENCY.observe(time.perf_counter() - t0,
+                                            service=state.service_name)
+                    INGRESS_REQUESTS.inc(service=state.service_name,
+                                         backend=str(backend),
+                                         code=f"{status // 100}xx")
 
             def _stream(self, r, ctype: str) -> None:
                 # nothing may bubble out of here: once any response byte is

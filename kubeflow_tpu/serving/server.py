@@ -166,17 +166,60 @@ class ModelServer:
         return [m for m in self.models.values()
                 if adapter in (getattr(m, "adapters", {}) or {})]
 
+    def _render_metrics(self) -> str:
+        """Prometheus text exposition: the server's own HTTP gauges, then the
+        models' flat ``extra_metrics`` gauges (non-numeric values skipped —
+        a bad model metric must not 500 the scrape — and ``# TYPE`` emitted
+        once per metric name), then each model's telemetry registry
+        (``metrics_text``: TTFT/TPOT/queue-wait/tick histograms), with
+        duplicate HELP/TYPE headers dropped when several models share a
+        registry metric name."""
+        chunks = [self.metrics.render()]
+        typed = {line.split(" ")[2] for line in chunks[0].splitlines()
+                 if line.startswith("# TYPE ")}
+        extra: dict = {}
+        for m in self.models.values():
+            try:
+                em = m.extra_metrics()
+            except Exception:  # noqa: BLE001 — scrape must answer
+                continue
+            for k, v in em.items():
+                try:
+                    extra[k] = extra.get(k, 0.0) + float(v)
+                except (TypeError, ValueError):
+                    continue  # non-numeric gauge: skip, don't 500
+        for k in sorted(extra):
+            if k not in typed:
+                typed.add(k)
+                chunks.append(f"# TYPE {k} gauge\n")
+            chunks.append(f"{k} {extra[k]}\n")
+        for m in self.models.values():
+            fn = getattr(m, "metrics_text", None)
+            if not callable(fn):
+                continue
+            try:
+                block = fn() or ""
+            except Exception:  # noqa: BLE001
+                continue
+            kept = []
+            for line in block.splitlines():
+                if line.startswith(("# TYPE ", "# HELP ")):
+                    name = line.split(" ")[2]
+                    if line.startswith("# TYPE "):
+                        if name in typed:
+                            continue
+                        typed.add(name)
+                    elif name in typed:
+                        continue  # HELP for an already-emitted metric
+                kept.append(line)
+            if kept:
+                chunks.append("\n".join(kept) + "\n")
+        return "".join(chunks)
+
     def _handle_get(self, h) -> None:
         path = h.path.split("?")[0].rstrip("/")
         if path == "/metrics":
-            text = self.metrics.render()
-            extra: dict = {}
-            for m in self.models.values():
-                for k, v in m.extra_metrics().items():
-                    extra[k] = extra.get(k, 0.0) + float(v)
-            for k in sorted(extra):
-                text += f"# TYPE {k} gauge\n{k} {extra[k]}\n"
-            h._send(200, text, content_type="text/plain")
+            h._send(200, self._render_metrics(), content_type="text/plain")
         elif path in ("", "/", "/healthz", "/v2/health/live"):
             h._send(200, {"status": "alive"})
         elif path == "/v2/health/ready":

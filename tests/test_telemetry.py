@@ -1,0 +1,640 @@
+"""Observability-layer tests (ISSUE 3): Prometheus exposition conformance,
+request lifecycle spans, the flight recorder under the chaos harness, the
+jax.profiler tick capture, and the /metrics serving surface.
+
+Conformance here means the text format a real Prometheus scraper parses:
+one HELP/TYPE pair per metric name, monotone non-decreasing cumulative
+histogram buckets ending in ``+Inf`` == ``_count``, and escaped label
+values.
+"""
+
+import json
+import glob
+import re
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.core.metrics import Histogram, Registry, escape_label_value
+from kubeflow_tpu.serving.engine import Engine, EngineConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import FaultConfig
+from kubeflow_tpu.serving.engine.serve import JetStreamModel
+from kubeflow_tpu.serving.errors import NonFiniteLogits, TickFailure
+from kubeflow_tpu.serving.server import Model, ModelServer
+
+pytestmark = pytest.mark.obs
+
+CFG = M.DecoderConfig(vocab_size=101, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+def _ec(**kw):
+    base = dict(max_slots=4, num_pages=128, page_size=8, max_pages_per_slot=16)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+PROMPTS = [[(i * 13 + j * 7) % (CFG.vocab_size - 1) + 1 for j in range(4 + i % 3)]
+           for i in range(8)]
+
+
+# --------------------------------------------------- exposition conformance
+
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? ([0-9eE+.inf-]+)$')
+
+
+def check_exposition(text: str) -> dict:
+    """Validate Prometheus text format; returns {name: [(labels, value)]}.
+
+    Asserts: every line parses, at most ONE ``# TYPE`` per metric name, and
+    every histogram's cumulative buckets are non-decreasing with the +Inf
+    bucket equal to ``_count``."""
+    types: dict = {}
+    samples: dict = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, labels, value = m.group(1), m.group(2) or "", float(m.group(3))
+        samples.setdefault(name, []).append((labels, value))
+    def norm(labels: str) -> tuple:
+        """Label pairs minus ``le`` as a sorted tuple (series identity)."""
+        parts = [p for p in (labels or "").strip("{}").split(",")
+                 if p and not p.startswith('le="')]
+        return tuple(sorted(parts))
+
+    for name, kind in types.items():
+        if kind != "histogram":
+            continue
+        counts = {norm(lab): v for lab, v in samples.get(f"{name}_count", [])}
+        assert counts, f"histogram {name} missing _count"
+        assert samples.get(f"{name}_sum"), f"histogram {name} missing _sum"
+        series: dict = {}
+        for labels, v in samples.get(f"{name}_bucket", []):
+            le = re.search(r'le="([^"]*)"', labels).group(1)
+            series.setdefault(norm(labels), []).append((le, v))
+        for base, bs in series.items():
+            vals = [v for _, v in bs]
+            assert vals == sorted(vals), f"{name}{base} buckets not monotone"
+            assert bs[-1][0] == "+Inf", f"{name}{base} missing +Inf bucket"
+            assert bs[-1][1] == counts[base], f"{name}{base} +Inf != _count"
+    return samples
+
+
+def test_histogram_render_conformance():
+    h = Histogram("req_seconds", "request latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = h.render()
+    samples = check_exposition(text)
+    le = {re.search(r'le="([^"]*)"', lab).group(1): v
+          for lab, v in samples["req_seconds_bucket"]}
+    assert le == {"0.1": 1, "1": 3, "10": 4, "+Inf": 5}
+    assert samples["req_seconds_count"][0][1] == 5
+    assert abs(samples["req_seconds_sum"][0][1] - 56.05) < 1e-9
+    # exactly one HELP and one TYPE line
+    assert text.count("# TYPE req_seconds ") == 1
+    assert text.count("# HELP req_seconds ") == 1
+
+
+def test_histogram_labels_and_quantile():
+    h = Histogram("lat", "x", buckets=(1, 2, 4))
+    for v in (0.5, 1.5, 3.0, 9.0):
+        h.observe(v, phase="decode")
+    h.observe(0.5, phase="prefill")
+    snap = h.snapshot(phase="decode")
+    assert snap["count"] == 4 and snap["buckets"][4] == 3
+    assert h.snapshot(phase="prefill")["count"] == 1
+    q = h.quantile(0.5, phase="decode")
+    assert 1.0 <= q <= 4.0  # interpolated within the owning bucket
+    check_exposition(h.render())
+
+
+def test_label_escaping_round_trip():
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    r = Registry()
+    g = r.gauge("weird", "gauge with hostile label values")
+    g.set(1.0, path='a"b\\c\nd')
+    text = r.render()
+    # the rendered line must stay a single parseable line
+    lines = [ln for ln in text.splitlines() if ln.startswith("weird{")]
+    assert len(lines) == 1
+    assert '\\"' in lines[0] and "\\n" in lines[0]
+    check_exposition(text)
+
+
+def test_registry_mixed_metrics_render():
+    r = Registry()
+    r.counter("c_total", "count").inc(code="2xx")
+    r.gauge("g", "gauge").set(3.5)
+    r.histogram("h_seconds", "hist", buckets=(1, 2)).observe(1.5)
+    samples = check_exposition(r.render())
+    assert samples["c_total"][0][1] == 1
+    assert samples["g"][0][1] == 3.5
+    assert samples["h_seconds_count"][0][1] == 1
+
+
+# ----------------------------------------------------- request spans / trace
+
+
+def test_span_ordering_and_trace_api(params):
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        r = eng.generate(PROMPTS[0], 5)
+        tr = eng.trace(r["rid"])
+        assert tr is not None and tr["outcome"] == "done"
+        phases = [e["phase"] for e in tr["events"]]
+        # lifecycle order: queued -> admitted -> prefill+ -> first_token -> done
+        assert phases[0] == "queued" and phases[-1] == "done"
+        assert phases.index("admitted") < phases.index("prefill")
+        assert phases.index("prefill") < phases.index("first_token")
+        ts = [e["t_s"] for e in tr["events"]]
+        assert ts == sorted(ts) and ts[0] == 0.0
+        assert tr["queue_wait_s"] <= tr["ttft_s"] <= tr["latency_s"]
+        assert tr["prefill_chunks"] >= 1
+        # derived intervals agree with the result dict's own measurements
+        assert abs(tr["ttft_s"] - r["ttft_s"]) < 0.05
+        assert eng.trace(10**9) is None  # unknown rid
+    finally:
+        eng.stop()
+
+
+def test_span_ordering_survives_chaos_retries(params):
+    """Spans stay well-ordered when ticks fail and retry in place (the
+    PR 2 chaos harness): repeated prefill marks, then first_token."""
+    eng = Engine(params, CFG, _ec(
+        chaos=FaultConfig(seed=2, dispatch_error_rate=0.3),
+        max_consecutive_failures=100))
+    eng.start()
+    try:
+        r = eng.generate(PROMPTS[1], 4, timeout=180)
+        tr = eng.trace(r["rid"])
+        assert tr["outcome"] == "done"
+        ts = [e["t_s"] for e in tr["events"]]
+        assert ts == sorted(ts)
+        assert [e["phase"] for e in tr["events"]].count("first_token") == 1
+    finally:
+        eng.stop()
+
+
+def test_trace_for_failed_request_and_telemetry_off(params):
+    eng = Engine(params, CFG, _ec(
+        max_slots=2, chaos=FaultConfig(seed=0, nan_logit_rate=1.0),
+        flight_dir=None))
+    eng.start()
+    try:
+        fut = eng.generate_async(PROMPTS[0], 4)
+        with pytest.raises(NonFiniteLogits):
+            fut.result(timeout=60)
+        # rid 0 was the first submission; its span is archived as failed
+        tr = eng.trace(0)
+        assert tr is not None and tr["outcome"] == "failed"
+    finally:
+        eng.stop()
+
+    eng = Engine(params, CFG, _ec(telemetry=False))
+    eng.start()
+    try:
+        r = eng.generate(PROMPTS[0], 3)
+        assert eng.trace(r["rid"]) is None  # no spans when telemetry is off
+        assert eng.telemetry.ttft.snapshot()["count"] == 0
+        assert eng.flight.snapshot() == []
+    finally:
+        eng.stop()
+
+
+def test_latency_histograms_populated(params):
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        n_tok = 5
+        futs = [eng.generate_async(p, n_tok) for p in PROMPTS[:4]]
+        results = [f.result(timeout=180) for f in futs]
+        assert all(r["num_tokens"] == n_tok for r in results)
+        tel = eng.telemetry
+        assert tel.ttft.snapshot()["count"] == 4
+        assert tel.queue_wait.snapshot()["count"] == 4
+        # TPOT: inter-token gaps = tokens-1 per request
+        assert tel.tpot.snapshot()["count"] == 4 * (n_tok - 1)
+        assert tel.tick_duration.snapshot()["count"] >= 1
+        assert tel.prefill_batch.snapshot()["count"] >= 1
+        # sum of TTFTs matches the result-dict measurements
+        measured = sum(r["ttft_s"] for r in results)
+        assert abs(tel.ttft.snapshot()["sum"] - measured) < 0.1
+        check_exposition(tel.render())
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------- flight recorder
+
+
+def _read_dump(path):
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f]
+    return lines[0], lines[1:]
+
+
+def test_flight_recorder_dumps_on_tick_failure_escalation(params, tmp_path):
+    """Acceptance: a chaos-injected TickFailure escalation produces a JSONL
+    dump containing the failing tick's phase, slots, and outcome."""
+    eng = Engine(params, CFG, _ec(
+        max_slots=2,
+        chaos=FaultConfig(seed=3, dispatch_error_rate=1.0),
+        max_consecutive_failures=3, flight_dir=str(tmp_path)))
+    eng.start()
+    try:
+        fut = eng.generate_async(PROMPTS[0], 4)
+        with pytest.raises(TickFailure):
+            fut.result(timeout=60)
+    finally:
+        eng.stop()
+    dumps = sorted(glob.glob(str(tmp_path / "flightrec-*.jsonl")))
+    assert dumps, "no flight-recorder dump written"
+    header, events = _read_dump(dumps[0])
+    assert header["reason"] == "tick_failure_escalation"
+    assert header["rids"] == [0] and header["phase"] in ("prefill", "decode")
+    assert events, "dump carries no tick events"
+    errs = [e for e in events if e["outcome"] == "error"]
+    assert len(errs) >= 3  # the three consecutive failures are all on record
+    for e in errs:
+        assert e["phase"] in ("prefill", "decode")
+        assert e["slots"] and isinstance(e["slots"], list)
+        assert "ChaosDispatchError" in e["error"]
+        assert e["duration_s"] >= 0 and e["tick"] >= 1
+    # events are sequenced and dispatch shapes recorded
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    assert any(e.get("shape") for e in events)
+
+
+def test_flight_recorder_dumps_on_watchdog_restart(params, tmp_path):
+    eng = Engine(params, CFG, _ec(
+        max_slots=2, chaos=FaultConfig(seed=0, die_on_tick=3),
+        watchdog_interval_s=0.05, flight_dir=str(tmp_path)))
+    eng.start()
+    try:
+        fut = eng.generate_async(PROMPTS[0], 120)
+        with pytest.raises(TickFailure, match="died"):
+            fut.result(timeout=60)
+        _wait(lambda: eng.stats["restarts"] == 1, msg="watchdog restart")
+        _wait(lambda: glob.glob(str(tmp_path / "flightrec-*.jsonl")),
+              msg="flight dump")
+        header, events = _read_dump(
+            sorted(glob.glob(str(tmp_path / "flightrec-*.jsonl")))[0])
+        assert header["reason"] == "watchdog_restart"
+        assert "reason" in header and "tick" in header
+        sup = [e for e in events if e["outcome"] == "supervise"]
+        assert sup and "died" in sup[0]["error"]
+        # the loop's work before death is on record too
+        assert any(e["outcome"] == "ok" for e in events)
+    finally:
+        eng.stop()
+
+
+def test_flight_recorder_dumps_on_nan_guard_trip(params, tmp_path):
+    eng = Engine(params, CFG, _ec(
+        max_slots=2,
+        chaos=FaultConfig(seed=0, nan_logit_rate=1.0, target_rids=(0,)),
+        flight_dir=str(tmp_path)))
+    eng.start()
+    try:
+        fut = eng.generate_async(PROMPTS[0], 4)
+        with pytest.raises(NonFiniteLogits):
+            fut.result(timeout=60)
+    finally:
+        eng.stop()
+    dumps = sorted(glob.glob(str(tmp_path / "flightrec-*.jsonl")))
+    assert dumps
+    header, events = _read_dump(dumps[0])
+    assert header["reason"] == "nan_guard_trip"
+    assert header["rid"] == 0 and "where" in header
+    assert any(e["outcome"] == "nan" for e in events)
+
+
+def test_flight_recorder_ring_bounds_and_dump_cap(tmp_path):
+    from kubeflow_tpu.serving.engine.telemetry import FlightRecorder
+
+    fr = FlightRecorder(capacity=4, dump_dir=str(tmp_path), max_dumps=2)
+    for i in range(10):
+        fr.record(tick=i, phase="decode", outcome="ok")
+    snap = fr.snapshot()
+    assert len(snap) == 4 and snap[0]["tick"] == 6  # oldest evicted
+    assert fr.dump("one") and fr.dump("two")
+    assert fr.dump("three") is None  # capped
+    assert len(glob.glob(str(tmp_path / "*.jsonl"))) == 2
+
+    # a FAILED write must refund its cap slot, not burn it
+    blocked = tmp_path / "not-a-dir"
+    blocked.write_text("")  # makedirs will raise: path exists as a file
+    fr2 = FlightRecorder(capacity=4, dump_dir=str(blocked / "sub"), max_dumps=1)
+    fr2.record(tick=1, phase="decode", outcome="ok")
+    assert fr2.dump("io-fail") is None
+    fr2.dump_dir = str(tmp_path / "recovered")
+    assert fr2.dump("after-recovery") is not None  # slot was refunded
+
+
+# ------------------------------------------------------------ thread safety
+
+
+def test_stats_snapshot_is_consistent_under_load(params):
+    """Satellite: Engine.stats is read by server threads while the loop
+    mutates it — hammer it concurrently and require coherent snapshots."""
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                s = eng.stats
+                # invariants that a torn read would violate
+                assert s["free_pages"] + s["cached_pages"] <= eng.ec.num_pages - 1
+                assert s["ticks_failed"] <= s["ticks"]
+                assert isinstance(s["prefill_batch_hist"], dict)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        futs = [eng.generate_async(p, 6) for p in PROMPTS]
+        for f in futs:
+            f.result(timeout=180)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        eng.stop()
+    assert not errors, errors[:1]
+
+
+# ------------------------------------------------------------- jax.profiler
+
+
+def test_trace_n_ticks_captures_xla_profile(params, tmp_path):
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        d = str(tmp_path / "xla")
+        assert eng.trace_n_ticks(3, d) == d
+        with pytest.raises(RuntimeError):
+            eng.trace_n_ticks(2, d)  # one capture at a time
+        eng.generate(PROMPTS[0], 4)  # force live ticks through the capture
+        _wait(lambda: not eng.profiler_active, msg="profiler stop")
+        assert eng._profiler.last_error is None, eng._profiler.last_error
+        assert eng._profiler.captures == 1
+        # jax writes the trace under plugins/profile/<ts>/
+        assert glob.glob(d + "/**/*", recursive=True), "no profile artifacts"
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------- /metrics + tracing
+
+
+def test_model_server_metrics_exposition(params):
+    """Acceptance: GET /metrics serves the TTFT/TPOT/queue-wait/tick
+    histograms in valid Prometheus text format next to the legacy gauges."""
+    eng = Engine(params, CFG, _ec(max_slots=2))
+    m = JetStreamModel("llm", engine=eng)
+    server = ModelServer([m], port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = json.dumps({"text_input": "hello", "parameters":
+                           {"max_tokens": 4}}).encode()
+        req = urllib.request.Request(
+            base + "/v2/models/llm/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        samples = check_exposition(text)  # asserts TYPE-once + monotonicity
+        for name in ("engine_ttft_seconds", "engine_tpot_seconds",
+                     "engine_queue_wait_seconds",
+                     "engine_tick_duration_seconds"):
+            assert f"{name}_count" in samples, f"missing {name}"
+            assert samples[f"{name}_count"][0][1] >= 1
+        assert "engine_prefill_batch_size_count" in samples
+        assert "engine_kv_page_occupancy_ratio" in samples
+        assert samples["engine_requests_total"][0][1] >= 1
+        # legacy flat gauges still present for the router/autoscaler
+        assert "engine_queue_depth" in samples
+        assert "inflight_requests" in samples
+    finally:
+        server.stop()
+        eng.stop()
+
+
+def test_metrics_skips_non_numeric_and_broken_extra_metrics():
+    class Weird(Model):
+        def load(self):
+            self.ready = True
+
+        def predict(self, payload, headers=None):
+            return payload
+
+        def extra_metrics(self):
+            return {"bad_string": "not-a-number", "good": 2.0}
+
+    class Broken(Model):
+        def load(self):
+            self.ready = True
+
+        def predict(self, payload, headers=None):
+            return payload
+
+        def extra_metrics(self):
+            raise RuntimeError("backend gone")
+
+    server = ModelServer([Weird("w"), Broken("b")], port=0)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=30) as r:
+            assert r.status == 200  # the satellite bug: this used to 500
+            text = r.read().decode()
+        assert "bad_string" not in text
+        assert "good 2" in text
+        check_exposition(text)
+    finally:
+        server.stop()
+
+
+def test_metrics_text_type_lines_deduped_across_models():
+    """Two models sharing registry metric names must not emit duplicate
+    HELP/TYPE headers — and their samples must stay distinct series (the
+    per-model constant label), or the combined scrape is invalid."""
+    from kubeflow_tpu.core.metrics import add_const_labels
+
+    reg = Registry()
+    reg.histogram("shared_seconds", "shared", buckets=(1.0,)).observe(0.5)
+
+    class R(Model):
+        def load(self):
+            self.ready = True
+
+        def predict(self, payload, headers=None):
+            return payload
+
+        def metrics_text(self):
+            return add_const_labels(reg.render(), {"model": self.name})
+
+    server = ModelServer([R("a"), R("b")], port=0)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert text.count("# TYPE shared_seconds histogram") == 1
+        assert text.count("# HELP shared_seconds") == 1
+        samples = check_exposition(text)
+        models = {re.search(r'model="([^"]*)"', lab).group(1)
+                  for lab, _ in samples["shared_seconds_count"]}
+        assert models == {"a", "b"}  # distinct series, no duplicates
+    finally:
+        server.stop()
+
+
+def test_two_engine_models_render_distinct_series(params):
+    """Regression: two engine-backed models in one server used to render
+    identical metric names with no distinguishing label — duplicate samples
+    a Prometheus scraper rejects wholesale."""
+    engines = [Engine(params, CFG, _ec(max_slots=2)) for _ in range(2)]
+    models = [JetStreamModel(n, engine=e) for n, e in zip("ab", engines)]
+    server = ModelServer(models, port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = json.dumps({"text_input": "x", "parameters":
+                           {"max_tokens": 2}}).encode()
+        for name in "ab":
+            req = urllib.request.Request(
+                base + f"/v2/models/{name}/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                assert r.status == 200
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        samples = check_exposition(text)  # TYPE-once + per-series monotone
+        counts = samples["engine_ttft_seconds_count"]
+        labels = {lab for lab, _ in counts}
+        assert len(counts) == 2 and len(labels) == 2
+        assert {re.search(r'model="([^"]*)"', lab).group(1)
+                for lab in labels} == {"a", "b"}
+    finally:
+        server.stop()
+        for e in engines:
+            e.stop()
+
+
+def test_x_request_trace_response_field(params):
+    eng = Engine(params, CFG, _ec(max_slots=2))
+    m = JetStreamModel("llm", engine=eng)
+    server = ModelServer([m], port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = json.dumps({"text_input": "hi", "parameters":
+                           {"max_tokens": 3}}).encode()
+
+        def post(headers):
+            req = urllib.request.Request(
+                base + "/v2/models/llm/generate", data=body,
+                headers={"Content-Type": "application/json", **headers})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return json.loads(r.read())
+
+        plain = post({})
+        assert "trace" not in plain  # opt-in only
+        traced = post({"X-Request-Trace": "1"})
+        assert traced["tokens"] == 3
+        tr = traced["trace"]
+        assert tr["outcome"] == "done"
+        phases = [e["phase"] for e in tr["events"]]
+        assert phases[0] == "queued" and "first_token" in phases
+        off = post({"X-Request-Trace": "0"})
+        assert "trace" not in off
+    finally:
+        server.stop()
+        eng.stop()
+
+
+def test_x_request_trace_on_stream_final_event(params):
+    eng = Engine(params, CFG, _ec(max_slots=2))
+    m = JetStreamModel("llm", engine=eng)
+    m.load()
+    try:
+        events = list(m.generate_stream(
+            {"text_input": "abc", "parameters": {"max_tokens": 3}},
+            headers={"X-Request-Trace": "true"}))
+        final = events[-1]
+        assert final["done"] and final["trace"]["outcome"] == "done"
+        plain = list(m.generate_stream(
+            {"text_input": "abc", "parameters": {"max_tokens": 3}}))
+        assert "trace" not in plain[-1]
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------------- bench smoke
+
+
+@pytest.mark.slow
+def test_serving_bench_obs_smoke(tmp_path):
+    """serving_bench --obs end-to-end on the tiny config: writes the
+    BENCH_OBS.json artifact and enforces the overhead budget."""
+    import subprocess
+    import sys
+    import os
+
+    out = tmp_path / "BENCH_OBS.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "benchmarks/serving_bench.py", "--obs",
+         "--config", "tiny", "--requests", "8", "--concurrency", "4",
+         "--prompt-len", "16", "--max-tokens", "8",
+         "--obs-budget", "25",  # smoke: generous budget on a noisy CI box
+         "--out", str(out)],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=900, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rec = json.loads(out.read_text())
+    assert rec["histograms"]["ttft_count"] == 8 + 1  # 8 requests + warmup
+    assert rec["pass"] is True
