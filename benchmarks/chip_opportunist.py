@@ -153,6 +153,17 @@ JOBS = [
     {"name": "mfu_save_mlp_384",
      "cmd": SWEEP + ["384", "128", "1", "save_mlp", "dense", "8"],
      "timeout": 540, "first_timeout": 240},
+    # 12a. QoS scheduler SLO headline on chip (ISSUE 4): FIFO vs priority+
+    #     preemption under a saturated pool — interactive p99 TTFT
+    #     improvement with byte-identity and leak invariants asserted;
+    #     writes BENCH_SLO.json, which bench.py folds into the artifact
+    {"name": "serving_slo_1b",
+     "cmd": _serving_cmd("1b", ["--slo", "--kv-quant", "int8",
+                                "--requests", "32", "--concurrency", "8",
+                                "--prompt-len", "128", "--max-tokens", "32",
+                                "--qps", "8",
+                                "--out", os.path.join(REPO, "BENCH_SLO.json")]),
+     "timeout": 1500, "first_timeout": 900},
     # 12. multi-LoRA mixed-batch overhead on chip (r4 feature): 1b config,
     #     4 adapters round-robin vs the plain 1b row above
     {"name": "serving_1b_lora4",

@@ -294,6 +294,138 @@ def _run_obs(args, config, params, lora) -> None:
             f"{args.obs_budget}% budget")
 
 
+def _run_slo(args, config, params, lora) -> None:
+    """QoS/SLO scenario (ISSUE 4): a mixed interactive+batch open-loop load
+    against a saturated engine, run twice — FIFO admission (the pre-QoS
+    baseline: SchedulerConfig(policy="fifo", preemption off)) and the QoS
+    scheduler (priority classes + preemption with KV swap/recompute).
+
+    Protocol: ``--concurrency`` batch-class jobs long enough to hold every
+    slot (and most of a deliberately tight page pool) are submitted first;
+    ``--requests`` short interactive-class requests then arrive open-loop at
+    ``--qps`` (default 8).  Headline: interactive p99 TTFT improvement at
+    preserved batch throughput.  Also asserts the acceptance invariants —
+    every preempted-then-resumed greedy request byte-identical to its
+    uncontended run, and zero leaked KV pages."""
+    import json as _json
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import Engine, EngineConfig, SchedulerConfig
+
+    page_size = 32
+    rng = np.random.default_rng(0)
+    n_batch = args.concurrency
+    n_inter = args.requests
+    batch_prompt_len = args.prompt_len
+    batch_tokens = 4 * args.max_tokens
+    inter_prompt_len = max(8, args.prompt_len // 8)
+    inter_tokens = max(4, args.max_tokens // 8)
+    pages_per_slot = (batch_prompt_len + batch_tokens) // page_size + 2
+    # a deliberately TIGHT pool: the batch jobs' steady state owns nearly
+    # every page, so interactive admission is blocked on pages as well as
+    # slots — the preempt-with-swap path, not just the slot-swap path
+    num_pages = n_batch * pages_per_slot + 4
+    qps = args.qps if args.qps > 0 else 8.0
+    batch_prompts = [rng.integers(1, config.vocab_size, size=batch_prompt_len).tolist()
+                     for _ in range(n_batch)]
+    inter_prompts = [rng.integers(1, config.vocab_size, size=inter_prompt_len).tolist()
+                     for _ in range(n_inter)]
+
+    def one_pass(qos: bool):
+        scfg = (SchedulerConfig(swap_policy="auto",
+                                swap_min_tokens=batch_prompt_len)
+                if qos else SchedulerConfig(policy="fifo", preemption=False))
+        ec = EngineConfig(
+            max_slots=n_batch, page_size=page_size, num_pages=num_pages,
+            max_pages_per_slot=pages_per_slot, scheduler=scfg,
+            tensor_parallel=args.tensor_parallel,
+            paged_kernel=args.paged_kernel or None,
+            kv_quant=args.kv_quant, weight_quant=args.weight_quant,
+        )
+        eng = Engine(params, config, ec, lora=lora)
+        eng.start()
+        eng.generate(batch_prompts[0][:8], 2)  # warmup compile
+        t0 = _time.perf_counter()
+        bfuts = [eng.generate_async(p, batch_tokens, priority="batch")
+                 for p in batch_prompts]
+        ifuts = []
+        for i, p in enumerate(inter_prompts):
+            target = t0 + 0.05 + i / qps
+            now = _time.perf_counter()
+            if target > now:
+                _time.sleep(target - now)
+            ifuts.append(eng.generate_async(p, inter_tokens,
+                                            priority="interactive"))
+        ires = [f.result(timeout=1800) for f in ifuts]
+        bres = [f.result(timeout=1800) for f in bfuts]
+        wall = _time.perf_counter() - t0
+        stats = eng.stats
+        eng.stop()
+        ittft = np.array([r["ttft_s"] for r in ires])
+        btoks = sum(r["num_tokens"] for r in bres)
+        leaked = (num_pages - 1) - stats["free_pages"] - stats["cached_pages"]
+        return {
+            "interactive_ttft_p50_s": round(float(np.percentile(ittft, 50)), 4),
+            "interactive_ttft_p99_s": round(float(np.percentile(ittft, 99)), 4),
+            "batch_tokens": btoks,
+            "batch_tokens_per_sec": round(btoks / wall, 2),
+            "wall_s": round(wall, 3),
+            "preemptions": stats["preemptions"],
+            "swapped_out": stats["swapped_out"],
+            "swapped_in": stats["swapped_in"],
+            "swap_bytes_out": stats["swap_bytes_out"],
+            "kv_pages_leaked": int(leaked),
+            "batch_token_ids": [r["tokens"] for r in bres],
+            "batch_preemptions": [r["preemptions"] for r in bres],
+        }
+
+    fifo = one_pass(False)
+    qos = one_pass(True)
+    # byte-identity acceptance: the QoS pass preempts batch jobs mid-decode;
+    # under greedy each must still emit exactly the FIFO pass's tokens
+    identical = all(a == b for a, b in zip(fifo.pop("batch_token_ids"),
+                                           qos.pop("batch_token_ids")))
+    out = {
+        "metric": f"slo_mixed_load_{args.config}",
+        "requests_interactive": n_inter,
+        "requests_batch": n_batch,
+        "interactive_qps": qps,
+        "prompt_len_batch": batch_prompt_len,
+        "max_tokens_batch": batch_tokens,
+        "prompt_len_interactive": inter_prompt_len,
+        "max_tokens_interactive": inter_tokens,
+        "num_pages": num_pages,
+        "fifo": fifo,
+        "qos": qos,
+        "interactive_ttft_p99_improvement_x": (
+            round(fifo["interactive_ttft_p99_s"]
+                  / max(1e-9, qos["interactive_ttft_p99_s"]), 2)),
+        "batch_throughput_ratio": (
+            round(qos["batch_tokens_per_sec"]
+                  / max(1e-9, fifo["batch_tokens_per_sec"]), 3)),
+        "preempted_resumed_byte_identical": identical,
+        "platform": jax.devices()[0].platform,
+        "protocol_note": "batch flood saturates slots+pages, interactive "
+                         "arrives open-loop; FIFO vs QoS scheduler passes "
+                         "share prompts/seeds so greedy outputs are "
+                         "comparable byte-for-byte",
+    }
+    line = _json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if not identical:
+        raise SystemExit("preempted-then-resumed outputs diverged from the "
+                         "uncontended (FIFO) run")
+    if qos["kv_pages_leaked"] or fifo["kv_pages_leaked"]:
+        raise SystemExit(f"KV pages leaked: fifo={fifo['kv_pages_leaked']} "
+                         f"qos={qos['kv_pages_leaked']}")
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="1b", choices=["tiny", "1b", "llama3_8b"])
@@ -334,6 +466,14 @@ def main() -> None:
     p.add_argument("--deadline-s", type=float, default=120.0,
                    help="per-request deadline for the chaos scenario "
                         "(expired requests are shed with DeadlineExceeded)")
+    p.add_argument("--slo", action="store_true",
+                   help="QoS/SLO scenario (ISSUE 4): mixed interactive+batch "
+                        "open-loop load on a saturated pool, FIFO baseline "
+                        "vs the QoS scheduler (priority classes + preempt "
+                        "with KV swap); reports interactive p99 TTFT "
+                        "improvement, batch-throughput ratio, preemption "
+                        "byte-identity and page leaks (BENCH_SLO.json via "
+                        "--out)")
     p.add_argument("--obs", action="store_true",
                    help="telemetry-overhead smoke (ISSUE 3): closed-loop "
                         "workload with the observability layer on vs off; "
@@ -400,6 +540,9 @@ def main() -> None:
         return
     if args.obs:
         _run_obs(args, config, params, lora)
+        return
+    if args.slo:
+        _run_slo(args, config, params, lora)
         return
     engine = Engine(
         params, config,

@@ -21,8 +21,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .shard_map_compat import shard_map
 
 NEG_INF = -1e9
 

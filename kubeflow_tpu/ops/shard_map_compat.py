@@ -1,0 +1,21 @@
+"""One shared shard_map import for every jax on the support matrix.
+
+jax >= 0.6 exports ``shard_map`` at the top level and spells the
+replication-check kwarg ``check_vma``; jax 0.4.x (this image) has it under
+``jax.experimental.shard_map`` with the kwarg spelled ``check_rep``.  Both
+callers (ring_attention, ulysses) import from here so the translation can
+never drift between them.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6
+    from jax import shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        kw["check_rep"] = kw.pop("check_vma", False)
+        return _shard_map(f, **kw)
+
+__all__ = ["shard_map"]

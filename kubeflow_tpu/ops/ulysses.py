@@ -17,8 +17,10 @@ import functools
 from typing import Callable, Optional
 
 import jax
-from jax import shard_map
+
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .shard_map_compat import shard_map
 
 from .attention import multihead_attention
 

@@ -4,3 +4,5 @@ replacement): C++ continuous batcher + paged-KV JAX decode."""
 from ..errors import RequestError  # noqa: F401  (re-export: engine raises it)
 from .engine import Engine, EngineConfig  # noqa: F401
 from .model import DecoderConfig  # noqa: F401
+from .scheduler import (PRIORITY_CLASSES, SchedulerConfig,  # noqa: F401
+                        normalize_priority)

@@ -6,7 +6,11 @@ admission, slot lifecycle and KV page accounting live in the C++ core
 (core.cc via native.py); this module runs the decode loop on the accelerator:
 
     loop:
-      admit queued requests into free slots  (C++ decides, all-or-nothing)
+      reap deadline-expired queue entries
+      admit queued requests into free slots in QoS policy order
+        (scheduler.py decides WHO: priority class / EDF / adapter fair
+        share, preempting a lower-priority decode slot when the head is
+        blocked; the C++ core decides WHETHER pages fit, all-or-nothing)
       group prefilling slots (short prompts by bucket, long ones by chunk
         offset) -> ONE fused prefill per group -> one fused KV-page scatter
         -> one batched first-token sample per group
@@ -40,6 +44,8 @@ import numpy as np
 from ..errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
                       NonFiniteLogits, RequestError, TickFailure)
 from .faults import ChaosInjector, FaultConfig
+from .scheduler import (PRIORITY_RANK, HostSwapStore, QosScheduler,
+                        QueueEntry, SchedulerConfig, normalize_priority)
 from .telemetry import (EngineTelemetry, FlightRecorder, RequestSpan,
                         TickProfiler)
 from .model import (DecoderConfig, decode_step, decode_step_k, prefill,
@@ -163,6 +169,12 @@ class EngineConfig:
     trace_history: int = 512
     # deterministic chaos injection (faults.py) — test/bench substrate
     chaos: Optional[FaultConfig] = None
+    # ---- QoS scheduling (README "Scheduling & QoS") ---------------------
+    # per-tick admission policy + preemption knobs (scheduler.py).  None =
+    # SchedulerConfig() — priority classes / EDF / fair share, preemption
+    # on.  SchedulerConfig(policy="fifo", preemption=False) restores the
+    # pre-QoS submission-order behavior (the SLO bench baseline).
+    scheduler: Optional[SchedulerConfig] = None
 
 
 @dataclasses.dataclass
@@ -199,6 +211,18 @@ class _Pending:
     span: "RequestSpan" = None
     # perf_counter of the most recent committed token (TPOT numerator)
     last_token_at: float = 0.0
+    # ---- QoS scheduling state (scheduler.py) ---------------------------
+    # priority class + its admission rank (interactive=0 < batch <
+    # best_effort); preemption only evicts strictly larger ranks
+    priority: str = "interactive"
+    rank: int = 0
+    # times this request was preempted out of its decode slot
+    preemptions: int = 0
+    # swap-preempted: KV pages live in the HostSwapStore under this rid;
+    # resume_len is the committed context length to restore (seq_len at
+    # eviction — KV coverage and decode input reconstruct from it exactly)
+    swapped: bool = False
+    resume_len: int = 0
 
 
 class _StaleThread(BaseException):
@@ -329,6 +353,23 @@ class Engine:
         self._prefill_batch_hist: dict[int, int] = {}
         self._spec_proposed = 0
         self._spec_accepted = 0
+        # ---- QoS scheduling state (scheduler.py) ------------------------
+        # submissions land in the host-side scheduler queue, NOT the C++
+        # queue: each tick drains it in policy order (priority/EDF/fair
+        # share) via submit-then-admit, so the C++ FIFO only ever holds the
+        # entry being admitted right now (or a rare failed-admit leftover)
+        self._scfg = (engine_config.scheduler
+                      if engine_config.scheduler is not None
+                      else SchedulerConfig())
+        weights: dict = {}
+        for name, w in self._scfg.adapter_weights:
+            if name not in self.adapters:
+                raise ValueError(f"adapter_weights names unknown adapter "
+                                 f"{name!r} (loaded: {sorted(self.adapters)})")
+            weights[self.adapters[name]] = float(w)
+        self._sched = QosScheduler(self._scfg, weights)
+        self._swap_store = HostSwapStore(self._scfg.swap_max_bytes)
+        self._preemptions = 0
         # ---- fault tolerance state --------------------------------------
         self._chaos = (ChaosInjector(engine_config.chaos)
                        if engine_config.chaos is not None else None)
@@ -456,7 +497,8 @@ class Engine:
     def generate_async(self, tokens: list[int], max_new_tokens: int = 32,
                        stream: Optional["queue.Queue"] = None,
                        adapter: Optional[str] = None,
-                       deadline: Optional[float] = None) -> Future:
+                       deadline: Optional[float] = None,
+                       priority: Optional[str] = None) -> Future:
         """Submit a prompt; the Future resolves to a result dict.
 
         ``stream``: optional queue that receives each token id as it is
@@ -465,21 +507,34 @@ class Engine:
         adapter to decode this request with (None = base model; unknown
         names raise).  ``deadline``: seconds from now; if the request has
         not produced its first token by then it is shed with
-        DeadlineExceeded (defaults to ``default_deadline_s``).  Raises
+        DeadlineExceeded (defaults to ``default_deadline_s``).
+        ``priority``: QoS class — "interactive" (default) | "batch" |
+        "best_effort" — deciding admission order and preemption standing
+        (scheduler.py; unknown classes raise RequestError).  Raises
         EngineOverloaded when the queue is at ``max_queue_depth`` and
         EngineShutdown once stop() has begun."""
         if not tokens:
             raise RequestError("empty prompt")
+        prio = normalize_priority(priority)
         if self._draining or self._stopped:
             # fast-path: also keeps the overload check below from touching
             # a closed batcher (RuntimeError) after stop(); the locked
             # check further down is the authoritative one
             raise EngineShutdown("engine is stopping")
-        if (self.ec.max_queue_depth > 0
-                and self.batcher.queue_depth >= self.ec.max_queue_depth):
+        # capacity check (the old C++ submit-time -1): a request that can
+        # never fit must fail HERE, not head-of-line-block the scheduler
+        if (self._pages_for(len(tokens) + max_new_tokens)
+                > self.ec.max_pages_per_slot
+                or self._pages_for(len(tokens)) >= self.ec.num_pages):
+            raise RequestError(
+                f"prompt+generation ({len(tokens)}+{max_new_tokens}) exceeds engine capacity "
+                f"({self.ec.max_pages_per_slot * self.ec.page_size} tokens/slot)"
+            )
+        depth = len(self._sched) + self.batcher.queue_depth
+        if self.ec.max_queue_depth > 0 and depth >= self.ec.max_queue_depth:
             self._requests_rejected += 1
             raise EngineOverloaded(
-                f"queue depth {self.batcher.queue_depth} >= "
+                f"queue depth {depth} >= "
                 f"max_queue_depth {self.ec.max_queue_depth}")
         if deadline is None:
             deadline = self.ec.default_deadline_s
@@ -491,6 +546,7 @@ class Engine:
             aid = self.adapters[adapter]
         fut: Future = Future()
         hashes = self._page_hashes(tokens, aid)
+        now = time.perf_counter()
         with self._lock:
             # shutdown check is atomic with registration: stop() flips
             # _draining under this lock BEFORE failing unassigned work, so
@@ -500,32 +556,28 @@ class Engine:
                 raise EngineShutdown("engine is stopping")
             rid = self._next_id
             self._next_id += 1
-            self._requests[rid] = _Pending(
+            pending = self._requests[rid] = _Pending(
                 tokens=list(tokens), max_new_tokens=max_new_tokens,
-                future=fut, submitted_at=time.perf_counter(), page_hashes=hashes,
+                future=fut, submitted_at=now, page_hashes=hashes,
                 stream=stream, context=list(tokens), adapter_id=aid,
-                deadline=(time.perf_counter() + deadline
-                          if deadline is not None else None),
+                deadline=(now + deadline if deadline is not None else None),
                 span=(RequestSpan(rid) if self.ec.telemetry else None),
+                priority=prio, rank=PRIORITY_RANK[prio],
             )
             self._future_rid[fut] = rid
-        # lookup eligibility stops one page short of the prompt end: prefill
-        # must compute at least the final prompt token to produce the logits
-        # the first sampled token comes from
-        n_lookup = (len(tokens) - 1) // self.ec.page_size
-        if not self.batcher.submit(rid, len(tokens), max_new_tokens,
-                                   hashes[:n_lookup]):
-            with self._lock:
-                # pop, not del: stop()'s shutdown sweep may have already
-                # failed+removed the request in the submit window
-                self._requests.pop(rid, None)
-                self._future_rid.pop(fut, None)
-            raise RequestError(
-                f"prompt+generation ({len(tokens)}+{max_new_tokens}) exceeds engine capacity "
-                f"({self.ec.max_pages_per_slot * self.ec.page_size} tokens/slot)"
-            )
+        # the request now waits in the HOST scheduler queue; the engine
+        # loop submits it to the C++ core only when the policy admits it
+        # (per-tick admission — the Orca iteration-level scheduling point)
+        self._sched.push(self._entry_for(rid, pending))
         self._wake.set()
         return fut
+
+    def _entry_for(self, rid: int, pending: _Pending) -> QueueEntry:
+        return QueueEntry(
+            rid=rid, rank=pending.rank, deadline=pending.deadline,
+            submitted_at=pending.submitted_at,
+            adapter_id=pending.adapter_id,
+            pages=self._pages_for(len(pending.tokens)))
 
     def _page_hashes(self, tokens: list[int], adapter_id: int = 0) -> "np.ndarray":
         """Chain hashes for each FULL prompt page: hash(page i) folds in
@@ -550,9 +602,10 @@ class Engine:
 
     def generate(self, tokens: list[int], max_new_tokens: int = 32, timeout: float = 300.0,
                  adapter: Optional[str] = None,
-                 deadline: Optional[float] = None) -> dict:
+                 deadline: Optional[float] = None,
+                 priority: Optional[str] = None) -> dict:
         fut = self.generate_async(tokens, max_new_tokens, adapter=adapter,
-                                  deadline=deadline)
+                                  deadline=deadline, priority=priority)
         try:
             return fut.result(timeout=timeout)
         except FutureTimeoutError:
@@ -569,7 +622,6 @@ class Engine:
         by the engine loop at its next tick, keeping whatever tokens were
         committed, and its slot/pages free right after. Returns False if the
         request already finished."""
-        queued_result = None
         with self._lock:
             # O(1) future -> rid index (maintained at submit/finish): cancel
             # storms from disconnecting clients don't scan _requests under
@@ -579,32 +631,71 @@ class Engine:
             if pending is None:
                 return False
             pending.cancelled = True
-            if rid not in self._slot_req.values():
+            queued = rid not in self._slot_req.values()
+            if queued:
                 # still queued: resolve now — no slot will free it for us.
                 # (the C++ queue entry is reaped at admission: pending gone
-                # -> the slot is released untouched)
+                # -> the slot is released untouched).  A preempted request
+                # keeps the tokens it committed before eviction.
                 self._requests.pop(rid)
                 self._future_rid.pop(future, None)
-                queued_result = {
-                    "rid": rid,
-                    "tokens": [], "num_tokens": 0, "truncated": False,
-                    "cancelled": True, "ttft_s": 0.0,
-                    "latency_s": time.perf_counter() - pending.submitted_at}
-        if queued_result is not None:
+        if queued:
             # resolve OUTSIDE the lock (same split _finish uses): a Future
             # done-callback may re-enter the engine and take _lock
+            self._sched.remove(rid)
+            self._swap_store.discard(rid)
             self._archive_span(pending, "cancelled")
-            pending.future.set_result(queued_result)
+            result = self._cancelled_result(rid, pending)
+            pending.future.set_result(result)
             if pending.stream is not None:
-                pending.stream.put((None, queued_result))
+                pending.stream.put((None, result))
             return True
         self._wake.set()
+        return True
+
+    def _cancelled_result(self, rid: int, pending: _Pending) -> dict:
+        """The result dict a cancelled-while-queued request resolves to —
+        same schema as _finish's (a preempted request keeps its committed
+        tokens, preemption count and original TTFT)."""
+        return {
+            "rid": rid,
+            "tokens": pending.generated,
+            "num_tokens": len(pending.generated),
+            "truncated": False,
+            "cancelled": True,
+            "preemptions": pending.preemptions,
+            "ttft_s": (pending.first_token_at - pending.submitted_at
+                       if pending.first_token_at else 0.0),
+            "latency_s": time.perf_counter() - pending.submitted_at,
+        }
+
+    def _resolve_queued_cancel(self, rid: int, pending: _Pending) -> bool:
+        """Loop-side twin of cancel()'s queued branch: pop a cancelled
+        queued request and resolve its future with the tokens it kept.
+        False when another path (cancel() itself) won the race and already
+        resolved it."""
+        with self._lock:
+            if self._requests.get(rid) is not pending:
+                return False
+            self._requests.pop(rid, None)
+            self._future_rid.pop(pending.future, None)
+        self._sched.remove(rid)
+        self._swap_store.discard(rid)
+        self._archive_span(pending, "cancelled")
+        result = self._cancelled_result(rid, pending)
+        try:
+            pending.future.set_result(result)
+        except Exception:  # already resolved (lost a race with cancel)
+            pass
+        if pending.stream is not None:
+            pending.stream.put((None, result))
         return True
 
     def generate_stream(self, tokens: list[int], max_new_tokens: int = 32,
                         timeout: float = 300.0,
                         adapter: Optional[str] = None,
-                        deadline: Optional[float] = None) -> Iterator:
+                        deadline: Optional[float] = None,
+                        priority: Optional[str] = None) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
 
         The last item yielded is the same dict ``generate`` returns (so
@@ -617,7 +708,8 @@ class Engine:
         can be reaped via ``Engine.cancel(stream.future)``."""
         q: queue.Queue = queue.Queue()
         fut = self.generate_async(tokens, max_new_tokens, stream=q,
-                                  adapter=adapter, deadline=deadline)
+                                  adapter=adapter, deadline=deadline,
+                                  priority=priority)
 
         def _iter():
             while True:
@@ -648,8 +740,12 @@ class Engine:
         with self._lock:
             return {
                 "active_slots": self.batcher.num_active,
-                "queue_depth": self.batcher.queue_depth,
+                # host scheduler queue + the (normally empty) C++ queue
+                "queue_depth": len(self._sched) + self.batcher.queue_depth,
                 "free_pages": self.batcher.free_pages,
+                "preemptions": self._preemptions,
+                "scheduler": self._sched.snapshot(),
+                **self._swap_store.stats(),
                 "spec_proposed": self._spec_proposed,
                 "spec_accepted": self._spec_accepted,
                 "prefill_dispatches": self._prefill_dispatches,
@@ -799,7 +895,6 @@ class Engine:
                 continue
             pending = self._requests[self._slot_req[slot]]
             del self._prefilling[slot]
-            pending.first_token_at = now
             self._mark_first_token(pending, now)
             plen = int(lens[i])
             self._activate_decode(slot, plen, self._pages_for(plen),
@@ -807,6 +902,11 @@ class Engine:
             self._commit(slot, int(sampled[i]))
 
     def _mark_first_token(self, pending: "_Pending", now: float) -> None:
+        if pending.first_token_at:
+            # resume prefill after a drop-preempt: the first token left
+            # long ago — TTFT and the span mark must not move
+            return
+        pending.first_token_at = now
         if pending.span is not None:
             pending.span.mark("first_token")
         self.telemetry.observe_ttft(now - pending.submitted_at)
@@ -879,7 +979,6 @@ class Engine:
                 continue
             pending = self._requests[self._slot_req[slot]]
             del self._prefilling[slot]
-            pending.first_token_at = now
             self._mark_first_token(pending, now)
             plen = int(lens[i])
             self._activate_decode(slot, plen, self._pages_for(plen),
@@ -957,53 +1056,55 @@ class Engine:
                 self._wake.clear()
 
     def _tick(self) -> bool:
-        """One engine tick: admit, shed expired, prefill groups, decode.
-        Each compute phase runs inside its own isolation boundary
-        (_isolated): an exception fails only the slots in the offending
-        group — at worst after max_consecutive_failures retries — and the
-        tick sequence continues."""
+        """One engine tick: reap expired, admit (policy order, preempting
+        when a higher class is blocked), prefill groups, decode.  Each
+        compute phase runs inside its own isolation boundary (_isolated):
+        an exception fails only the slots in the offending group — at worst
+        after max_consecutive_failures retries — and the tick sequence
+        continues."""
         self._check_epoch()
+        now = time.perf_counter()
         did_work = False
 
-        # --- admission: bookkeeping only (C++ decides; compute is below)
+        # --- eager queue reaping: deadline-expired queued requests shed
+        # NOW, not when they reach the admission head — they were holding
+        # queue-depth budget for work nobody is waiting for
+        did_work |= self._reap_expired_queue(now)
+
+        # --- drain C++-queued leftovers (an admit that failed after its
+        # submit last tick — rare; the scheduler queue is the real queue)
         while True:
             admitted = self.batcher.admit()
             if admitted is None:
                 break
             did_work = True
-            slot, rid, plen, _, cached = admitted
-            # fetch + slot assignment are one atomic step vs cancel():
-            # once _slot_req holds rid, cancel defers to this loop; a
-            # queued cancel that popped the request first lands in the
-            # pending-None branch
-            with self._lock:
-                pending = self._requests.get(rid)
-                if pending is not None:
-                    self._slot_req[slot] = rid
-                    self._aid_host[slot] = pending.adapter_id
-            if pending is None:
-                self.batcher.release(slot)
-                continue
-            if pending.span is not None:
-                now = pending.span.mark("admitted")
-                self.telemetry.observe_queue_wait(now - pending.submitted_at)
-            if pending.cancelled:  # cancelled between submit and admit
-                self._finish(slot, rid, truncated=False,
-                             cancelled=True, cache_ok=False)
-                continue
-            if (pending.deadline is not None
-                    and time.perf_counter() > pending.deadline):
-                # deadline expired while queued: shed before spending any
-                # prefill compute on a request nobody is waiting for
-                self._fail_slot(slot, DeadlineExceeded(
-                    "deadline expired after "
-                    f"{time.perf_counter() - pending.submitted_at:.3f}s "
-                    "in queue"), shed=True)
-                continue
-            # cache-hit pages already hold the prefix KV: prefill resumes
-            # at the first uncovered position
-            self._prefilling[slot] = cached * self.ec.page_size
-            self._prefill_rows[slot] = self.batcher.slot_pages(slot)
+            self._install_admitted(admitted)
+
+        # --- chaos: forced preemption storms (faults.py)
+        if (self._chaos is not None and self._chaos.should_preempt()):
+            victim = self._pick_victim(max_rank=-1)
+            if victim is not None:
+                did_work = True
+                self._preempt_slot(victim, "chaos")
+
+        # --- pool-pressure relief: below the free-page watermark, evict a
+        # strictly lower-priority decode slot before growth OOM-truncates a
+        # higher-priority one (off unless min_free_pages is set)
+        if self._scfg.preemption and self._scfg.min_free_pages > 0:
+            free = self.batcher.free_pages + self.batcher.reclaimable()
+            if free < self._scfg.min_free_pages:
+                ranks = [self._requests[r].rank
+                         for s, r in self._slot_req.items()
+                         if s not in self._prefilling and r in self._requests]
+                if len(ranks) > 1:
+                    victim = self._pick_victim(max_rank=min(ranks))
+                    if victim is not None:
+                        did_work = True
+                        self._preempt_slot(victim, "pool")
+
+        # --- scheduler admission: drain the host queue in policy order,
+        # preempting a lower-priority decode slot when the head is blocked
+        did_work |= self._admit_from_scheduler()
 
         # --- fused prefill: group prefilling slots (short prompts by
         # bucket, long/cache-resumed ones by chunk offset) and issue ONE
@@ -1088,6 +1189,302 @@ class Engine:
                                seq_lens, page_table,
                                shape={"rows": len(decode_ready)})
         return did_work
+
+    # ------------------------------------------- QoS admission / preemption
+
+    def _install_admitted(self, admitted) -> None:
+        """Bookkeeping for one C++ admission: bind the slot, then route to
+        swap-resume (restore KV, straight to decode) or prefill (fresh or
+        prefix-cache-resumed — the recompute path after a drop-preempt
+        lands here too, usually re-adopting its own cached pages)."""
+        slot, rid, plen, _, cached = admitted
+        # fetch + slot assignment are one atomic step vs cancel():
+        # once _slot_req holds rid, cancel defers to this loop; a
+        # queued cancel that popped the request first lands in the
+        # pending-None branch
+        with self._lock:
+            pending = self._requests.get(rid)
+            if pending is not None:
+                self._slot_req[slot] = rid
+                self._aid_host[slot] = pending.adapter_id
+        if pending is None:
+            self.batcher.release(slot)
+            self._swap_store.discard(rid)
+            return
+        if pending.span is not None:
+            now = pending.span.mark(
+                "admitted" if not pending.preemptions else "readmitted")
+            if not pending.preemptions:
+                self.telemetry.observe_queue_wait(
+                    now - pending.submitted_at, pending.priority)
+        if pending.cancelled:  # cancelled between submit and admit
+            self._swap_store.discard(rid)
+            self._finish(slot, rid, truncated=False,
+                         cancelled=True, cache_ok=False)
+            return
+        if (pending.deadline is not None and not pending.first_token_at
+                and time.perf_counter() > pending.deadline):
+            # deadline expired while queued: shed before spending any
+            # prefill compute on a request nobody is waiting for (never
+            # after the first token — a preempted request always resumes)
+            self._fail_slot(slot, DeadlineExceeded(
+                "deadline expired after "
+                f"{time.perf_counter() - pending.submitted_at:.3f}s "
+                "in queue"), shed=True)
+            return
+        if pending.swapped:
+            item = self._swap_store.pop(rid)
+            if item is not None:
+                try:
+                    self._resume_swapped(slot, pending, item)
+                except Exception as exc:  # noqa: BLE001 — fail the slot,
+                    # never leave it half-installed (len 0, no prefill)
+                    # for the decode step to feed garbage through
+                    err = TickFailure(
+                        f"swap-in failed: {type(exc).__name__}: {exc}")
+                    err.__cause__ = exc
+                    self._fail_slot(slot, err)
+                return
+            # blob lost (store cleared under us): degrade to recompute —
+            # tokens already hold the full context, pages were released
+            # uncached so this is a cold re-prefill, but still correct
+            pending.swapped = False
+        # cache-hit pages already hold the prefix KV: prefill resumes
+        # at the first uncovered position
+        self._prefilling[slot] = cached * self.ec.page_size
+        self._prefill_rows[slot] = self.batcher.slot_pages(slot)
+
+    def _resume_swapped(self, slot: int, pending: _Pending, item) -> None:
+        """Swap-in: scatter the evicted KV pages from the host store into
+        the slot's freshly allocated pages and rebind the host mirrors —
+        the slot rejoins decode exactly where it left off (seq_len, page
+        row, last committed token), byte-identical under greedy."""
+        (blob_k, blob_v), nbytes = item
+        jnp = self._jnp
+        L = pending.resume_len
+        owned = self._pages_for(L)
+        # swap submits carry no prefix hashes, so every page here is
+        # freshly owned by this slot — the .set below can never write a
+        # shared prefix-cache page
+        row = self.batcher.slot_pages(slot)
+        pages = np.ascontiguousarray(row[:owned])
+        self._check_epoch()  # last fence before rebinding device pools
+        tree_map = self._jax.tree_util.tree_map
+        put = lambda pool, host: pool.at[:, pages].set(jnp.asarray(host))  # noqa: E731
+        self.k_pool = tree_map(put, self.k_pool, blob_k)
+        self.v_pool = tree_map(put, self.v_pool, blob_v)
+        pending.swapped = False
+        self.telemetry.count_swap("in", nbytes)
+        if pending.span is not None:
+            pending.span.mark("resumed")
+        if self.ec.telemetry:
+            self._flight_event("swap_in", [slot],
+                               {"pages": owned, "bytes": nbytes},
+                               time.perf_counter(), "ok")
+        self._activate_decode(slot, L, owned, row)
+
+    def _reap_expired_queue(self, now: float) -> bool:
+        """Shed every queued request whose deadline lapsed — every tick,
+        not at the admission head, so an expired entry stops holding its
+        queue-depth budget the moment it is dead.  Preempted requests
+        (first token already out) are never shed; they resume."""
+        did = False
+        for entry in self._sched.expired(now):
+            with self._lock:
+                pending = self._requests.get(entry.rid)
+                if pending is None:
+                    self._sched.remove(entry.rid)
+                    continue
+                if pending.first_token_at or pending.cancelled:
+                    continue  # resumes / resolves via its own path
+                self._requests.pop(entry.rid)
+                self._future_rid.pop(pending.future, None)
+            self._sched.remove(entry.rid)
+            self._sched.reaped += 1
+            self._requests_shed += 1
+            did = True
+            self._archive_span(pending, "shed")
+            self._resolve_exception(pending, DeadlineExceeded(
+                "deadline expired after "
+                f"{now - pending.submitted_at:.3f}s in queue (reaped)"))
+        return did
+
+    def _admit_from_scheduler(self) -> bool:
+        """Drain the host scheduler queue in policy order: submit-then-admit
+        one request at a time while slots and pages allow; when the head is
+        blocked, preempt a strictly lower-priority decode slot (at most
+        max_preemptions_per_tick) and retry — otherwise stop (strict
+        priority: never bypass a blocked head for a lower class)."""
+        did = False
+        budget = (self._scfg.max_preemptions_per_tick
+                  if self._scfg.preemption else 0)
+        while True:
+            entry = self._sched.peek()
+            if entry is None:
+                break
+            rid = entry.rid
+            pending = self._requests.get(rid)
+            if pending is None:
+                self._sched.remove(rid)  # resolved out from under the queue
+                continue
+            if pending.cancelled:
+                # queued cancel that landed after the preempt re-queue:
+                # resolve here, don't burn an admission on it
+                if self._resolve_queued_cancel(rid, pending):
+                    did = True
+                else:
+                    self._sched.remove(rid)  # cancel() already resolved it
+                continue
+            plen = len(pending.tokens)
+            need = self._pages_for(plen)
+            have_slot = self.batcher.free_slots > 0
+            # min_free_pages doubles as an ADMISSION reserve: a slot
+            # evicted for pool pressure must not be readmitted while the
+            # pool is still below the watermark (same-tick readmission
+            # would otherwise thrash a full swap-out/in every tick)
+            have_pages = (self.batcher.free_pages + self.batcher.reclaimable()
+                          >= need + self._scfg.min_free_pages)
+            if not (have_slot and have_pages):
+                if budget > 0:
+                    victim = self._pick_victim(max_rank=entry.rank)
+                    if victim is not None:
+                        budget -= 1
+                        did = True
+                        self._preempt_slot(
+                            victim, "pages" if have_slot else "priority")
+                        continue  # re-evaluate the head with freed capacity
+                break
+            self._sched.pop(entry)
+            did = True
+            mnew = max(1, pending.max_new_tokens - len(pending.generated))
+            lookup = None
+            if not pending.swapped:
+                # lookup eligibility stops one page short of the prompt
+                # end: prefill must compute at least the final token to
+                # produce the logits the first sampled token comes from
+                n_lookup = (plen - 1) // self.ec.page_size
+                lookup = pending.page_hashes[:n_lookup]
+            if not self.batcher.submit(rid, plen, mnew, lookup):
+                # defensive: capacity was validated at generate_async
+                with self._lock:
+                    self._requests.pop(rid, None)
+                    self._future_rid.pop(pending.future, None)
+                self._swap_store.discard(rid)
+                self._requests_failed += 1
+                self._archive_span(pending, "failed")
+                self._resolve_exception(pending, RequestError(
+                    f"prompt+generation ({plen}+{mnew}) exceeds engine "
+                    "capacity"))
+                continue
+            admitted = self.batcher.admit()
+            if admitted is None:
+                break  # stays at the C++ queue head; drained next tick
+            self._install_admitted(admitted)
+        return did
+
+    def _pick_victim(self, max_rank: int) -> Optional[int]:
+        """The decode-ready slot to preempt: rank strictly greater than
+        ``max_rank`` (pass -1 for "any"), preferring the lowest class,
+        then the latest deadline (no deadline = latest), then the most
+        recent submission (least queue investment lost).  None when no
+        eligible victim exists — equals never preempt equals."""
+        best, best_key = None, None
+        for slot, rid in self._slot_req.items():
+            if slot in self._prefilling:
+                continue  # mid-prefill KV is incomplete; not preemptible
+            p = self._requests.get(rid)
+            if p is None or p.cancelled or p.rank <= max_rank:
+                continue
+            key = (p.rank,
+                   p.deadline if p.deadline is not None else float("inf"),
+                   p.submitted_at)
+            if best_key is None or key > best_key:
+                best, best_key = slot, key
+        return best
+
+    def _preempt_slot(self, slot: int, reason: str) -> None:
+        """Evict one decoding slot: its KV pages are swapped to the host
+        store (restored byte-identically on resume) or dropped into the
+        prefix cache (re-prefill recovers them — usually as cache hits on
+        the very same pages), the slot/pages free, and the request
+        re-queues with its class, deadline and original submit time.  Under
+        greedy sampling resume is byte-identical either way: swap restores
+        the exact KV state; recompute re-derives it from the full committed
+        context (prompt + generated so far)."""
+        self._check_epoch()
+        rid = self._slot_req.get(slot)
+        pending = self._requests.get(rid) if rid is not None else None
+        if pending is None:
+            return
+        if pending.cancelled:  # cancel raced the eviction: just finish it
+            self._finish(slot, rid, truncated=False, cancelled=True)
+            return
+        ps = self.ec.page_size
+        L = int(self._len_host[slot])
+        # committed KV covers positions [0, L-2] (the last token's KV is
+        # written by its NEXT decode step); pages_for(L) bounds the pages
+        # that hold it — a speculative reserve page past that is garbage
+        # and simply frees with the slot
+        owned = self._pages_for(L)
+        row = self._pt_host[slot, :owned].copy()
+        mode = self._scfg.swap_policy
+        if mode == "auto":
+            mode = "swap" if L >= self._scfg.swap_min_tokens else "recompute"
+        t0 = time.perf_counter()
+        nbytes = 0
+        if mode == "swap" and owned > 0:
+            pages = np.ascontiguousarray(row)
+            tree_map = self._jax.tree_util.tree_map
+            fetch = lambda leaf: np.asarray(leaf[:, pages])  # noqa: E731
+            blob = (tree_map(fetch, self.k_pool),
+                    tree_map(fetch, self.v_pool))
+            nbytes = sum(leaf.nbytes for leaf in
+                         self._jax.tree_util.tree_leaves(blob))
+            if self._swap_store.put(rid, blob, nbytes):
+                self.telemetry.count_swap("out", nbytes)
+            else:
+                mode, nbytes = "recompute", 0  # over budget: drop instead
+        release_hashes = None
+        if mode == "swap":
+            pending.swapped = True
+            pending.resume_len = L
+            pending.tokens = list(pending.context)
+        else:
+            # drop-and-recompute: the resume prompt is the full committed
+            # context; its completed full pages go to the prefix cache so
+            # the re-prefill usually re-adopts them instead of recomputing
+            pending.swapped = False
+            pending.tokens = list(pending.context)
+            pending.page_hashes = self._page_hashes(
+                pending.context, pending.adapter_id)
+            release_hashes = pending.page_hashes[:max(0, (L - 1) // ps)]
+        pending.preemptions += 1
+        self._preemptions += 1
+        self._reset_failures(pending)
+        # the requeue gap is queue wait, not decode speed: without this
+        # reset the first post-resume commit would record the whole
+        # preemption pause as one TPOT observation
+        pending.last_token_at = 0.0
+        if pending.span is not None:
+            pending.span.mark("preempted")
+        self.telemetry.count_preemption(reason, mode)
+        if self.ec.telemetry:
+            self._flight_event(
+                "preempt", [slot],
+                {"reason": reason, "mode": mode, "pages": owned,
+                 "bytes": nbytes, "seq_len": L},
+                t0, "ok")
+        with self._lock:
+            self._slot_req.pop(slot, None)
+        self._release_slot_state(slot)
+        self.batcher.release(slot, release_hashes)
+        self._sched.push(self._entry_for(rid, pending))
+        if pending.cancelled:
+            # cancel() landed during the swap-out window (it saw the slot
+            # still bound and deferred to us): resolve NOW — a cancelled
+            # entry must not sit in the queue until it reaches the policy
+            # head, holding queue-depth budget with a waiting caller
+            self._resolve_queued_cancel(rid, pending)
 
     # ------------------------------------------------------ fault handling
 
@@ -1239,7 +1636,9 @@ class Engine:
             for rid, p in victims:
                 del self._requests[rid]
                 self._future_rid.pop(p.future, None)
-        for _, p in victims:
+        for rid, p in victims:
+            self._sched.remove(rid)
+            self._swap_store.discard(rid)
             self._requests_failed += 1
             self._archive_span(p, "failed")
             self._resolve_exception(p, exc)
@@ -1301,6 +1700,8 @@ class Engine:
         for slot in list(self._slot_req):
             self._fail_slot(slot, err)
         self._fail_unassigned(err)
+        self._sched.clear()
+        self._swap_store.clear()
         self._prefilling.clear()
         self._prefill_rows.clear()
         self._pt_host[:] = 0
@@ -1530,6 +1931,7 @@ class Engine:
             "num_tokens": len(pending.generated),
             "truncated": truncated,
             "cancelled": cancelled,
+            "preemptions": pending.preemptions,
             "ttft_s": (pending.first_token_at - pending.submitted_at
                        if pending.first_token_at else 0.0),
             "latency_s": now - pending.submitted_at,

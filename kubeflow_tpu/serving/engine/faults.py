@@ -70,6 +70,10 @@ class FaultConfig:
     # raise ChaosThreadDeath at the top of this tick number (1-based;
     # -1 = off): the loop thread dies and the supervisor must notice
     die_on_tick: int = -1
+    # force-preempt the engine's lowest-priority decode slot every Nth tick
+    # (0 = off): the preemption-storm substrate for the QoS scheduler's
+    # swap/resume byte-identity and page-leak tests (engine/scheduler.py)
+    preempt_every: int = 0
 
 
 class ChaosInjector:
@@ -84,6 +88,7 @@ class ChaosInjector:
         self.injected_nan_rows = 0
         self.injected_slow_ticks = 0
         self.injected_deaths = 0
+        self.injected_preempt_signals = 0
 
     def on_tick(self) -> None:
         """Called once at the top of every engine tick (idle ticks too)."""
@@ -96,6 +101,16 @@ class ChaosInjector:
                 or (c.slow_tick_on > 0 and self.tick == c.slow_tick_on)):
             self.injected_slow_ticks += 1
             time.sleep(c.slow_tick_s)
+
+    def should_preempt(self) -> bool:
+        """Called once per tick by the engine's preemption hook: True on
+        every ``preempt_every``-th tick.  Counts SIGNALS — the engine may
+        find no eligible decode slot to evict that tick."""
+        c = self.config
+        if c.preempt_every > 0 and self.tick % c.preempt_every == 0:
+            self.injected_preempt_signals += 1
+            return True
+        return False
 
     def maybe_dispatch_error(self, phase: str) -> None:
         """Called inside each isolation boundary, before the real dispatch."""
@@ -131,4 +146,5 @@ class ChaosInjector:
             "injected_nan_rows": self.injected_nan_rows,
             "injected_slow_ticks": self.injected_slow_ticks,
             "injected_deaths": self.injected_deaths,
+            "injected_preempt_signals": self.injected_preempt_signals,
         }

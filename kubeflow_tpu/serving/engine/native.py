@@ -137,7 +137,14 @@ class NativeBatcher:
 
     def release(self, slot: int, prefix_hashes=None) -> None:
         """Free the slot; with ``prefix_hashes`` (uint64, one per full PROMPT
-        page) the covered pages enter the prefix cache instead."""
+        page) the covered pages enter the prefix cache instead.
+
+        Preemption (engine/scheduler.py) rides this same path: a swap
+        eviction releases WITHOUT hashes (the pages' contents moved to the
+        host store and must not be served from cache), while a
+        drop-and-recompute eviction releases WITH the victim's completed
+        full-page hashes — the resume prefill then re-adopts those very
+        pages as cache hits instead of recomputing them."""
         h = np.ascontiguousarray(prefix_hashes if prefix_hashes is not None else [],
                                  dtype=np.uint64)
         self.lib.eng_release_cached(self._handle(), slot, h, len(h))
@@ -192,6 +199,12 @@ class NativeBatcher:
     @property
     def num_active(self) -> int:
         return self.lib.eng_num_active(self._handle())
+
+    @property
+    def free_slots(self) -> int:
+        """Slots not currently holding a request — the QoS scheduler's
+        admission headroom check (engine/scheduler.py)."""
+        return self.max_slots - self.lib.eng_num_active(self._handle())
 
     def __del__(self):  # pragma: no cover - defensive
         try:

@@ -24,6 +24,7 @@ from ..server import Model
 from ..errors import EngineError, RequestError
 from .engine import Engine, EngineConfig
 from .model import DecoderConfig, load_params
+from .scheduler import normalize_priority
 
 
 class ByteTokenizer:
@@ -164,6 +165,18 @@ class JetStreamModel(Model):
                     if isinstance(ckw.get("target_rids"), list):
                         ckw["target_rids"] = tuple(ckw["target_rids"])
                     kw["chaos"] = FaultConfig(**ckw)
+                if isinstance(kw.get("scheduler"), dict):
+                    # QoS policy straight from an engine.json (README
+                    # "Scheduling & QoS"): adapter_weights arrives as a
+                    # JSON list of [name, weight] pairs
+                    from .scheduler import SchedulerConfig
+
+                    skw = kw["scheduler"]
+                    if isinstance(skw.get("adapter_weights"), list):
+                        skw["adapter_weights"] = tuple(
+                            (str(n), float(w))
+                            for n, w in skw["adapter_weights"])
+                    kw["scheduler"] = SchedulerConfig(**skw)
                 ec = EngineConfig(**kw)
                 # an operator's explicit eos_id — INCLUDING -1 "never stop
                 # early" — must win over the checkout's declaration
@@ -210,6 +223,9 @@ class JetStreamModel(Model):
             "engine_requests_shed": s["requests_shed"],
             "engine_requests_rejected": s["requests_rejected"],
             "engine_restarts": s["restarts"],
+            # QoS surface: preemption churn + host swap-store pressure
+            "engine_preemptions": s["preemptions"],
+            "engine_swap_used_bytes": s["swap_used_bytes"],
         }
 
     def metrics_text(self) -> str:
@@ -245,7 +261,16 @@ class JetStreamModel(Model):
                 return str(v).strip().lower() not in ("", "0", "false", "no")
         return False
 
-    def _parse_generate(self, payload: Any):
+    @staticmethod
+    def _header_priority(headers: Optional[dict]):
+        """``X-Priority`` header — the per-request QoS default the ingress
+        forwards verbatim; an explicit ``priority`` request param wins."""
+        for k, v in (headers or {}).items():
+            if k.lower() == "x-priority":
+                return v
+        return None
+
+    def _parse_generate(self, payload: Any, headers: Optional[dict] = None):
         prompt = payload.get("text_input", "") if isinstance(payload, dict) else str(payload)
         params = (payload.get("parameters") or {}) if isinstance(payload, dict) else {}
         try:
@@ -260,17 +285,25 @@ class JetStreamModel(Model):
             except (TypeError, ValueError):
                 raise RequestError("deadline_s must be a number, got "
                                    f"{deadline!r}") from None
+        priority = params.get("priority")
+        if priority is None:
+            priority = self._header_priority(headers)
+        if priority is not None:
+            priority = normalize_priority(priority)  # RequestError on junk
         return (self.tokenizer.encode(prompt) or [0], max_tokens,
-                params.get("adapter"), deadline)
+                params.get("adapter"), deadline, priority)
 
     def generate(self, payload: Any, headers: Optional[dict] = None) -> Any:
         """V2 generate extension (unary): {"text_input": str, "parameters":
-        {"max_tokens": N, "deadline_s": S}} -> {"text_output": str, ...}.
-        A truthy ``X-Request-Trace`` header adds the request's lifecycle
-        span (``Engine.trace``) as a ``trace`` field."""
-        ids, max_tokens, adapter, deadline = self._parse_generate(payload)
+        {"max_tokens": N, "deadline_s": S, "priority": "interactive" |
+        "batch" | "best_effort"}} -> {"text_output": str, ...}.  An
+        ``X-Priority`` header supplies the QoS class when the parameter is
+        absent.  A truthy ``X-Request-Trace`` header adds the request's
+        lifecycle span (``Engine.trace``) as a ``trace`` field."""
+        ids, max_tokens, adapter, deadline, priority = \
+            self._parse_generate(payload, headers)
         r = self.engine.generate(ids, max_tokens, adapter=adapter,
-                                 deadline=deadline)
+                                 deadline=deadline, priority=priority)
         out = {"text_output": self.tokenizer.decode(r["tokens"]),
                "token_ids": r["tokens"], "tokens": r["num_tokens"],
                "prompt_tokens": len(ids), "max_tokens": max_tokens,
@@ -294,9 +327,11 @@ class JetStreamModel(Model):
         UTF-8 char split across byte tokens decodes to U+FFFD until its tail
         arrives) — so the concatenated stream equals the unary text_output.
         """
-        ids, max_tokens, adapter, deadline = self._parse_generate(payload)
+        ids, max_tokens, adapter, deadline, priority = \
+            self._parse_generate(payload, headers)
         stream = self.engine.generate_stream(ids, max_tokens, adapter=adapter,
-                                             deadline=deadline)
+                                             deadline=deadline,
+                                             priority=priority)
         return self._stream_pieces(stream, ids, max_tokens,
                                    with_trace=self._wants_trace(headers))
 
@@ -334,9 +369,10 @@ class JetStreamModel(Model):
 
     def predict(self, payload: Any, headers: Optional[dict] = None) -> Any:
         instances = payload.get("instances", []) if isinstance(payload, dict) else payload
-        # validate every adapter name BEFORE submitting anything: a bad name
-        # mid-loop would 500 the whole request while already-submitted
-        # generations burn slots with nobody reading their futures
+        header_prio = self._header_priority(headers)
+        # validate every adapter name / priority BEFORE submitting anything:
+        # a bad value mid-loop would 500 the whole request while already-
+        # submitted generations burn slots with nobody reading their futures
         for inst in instances:
             ad = inst.get("adapter") if isinstance(inst, dict) else None
             if ad is not None and ad not in self.adapters:
@@ -349,11 +385,15 @@ class JetStreamModel(Model):
                 except (TypeError, ValueError):
                     raise RequestError(
                         f"deadline_s must be a number, got {dl!r}") from None
+            pr = inst.get("priority") if isinstance(inst, dict) else None
+            if pr is not None or header_prio is not None:
+                normalize_priority(pr if pr is not None else header_prio)
         futures = []
         for inst in instances:
             if isinstance(inst, str):
                 prompt, max_tokens = inst, 32
                 adapter = deadline = None
+                priority = header_prio
             else:
                 prompt = inst.get("prompt", "")
                 max_tokens = int(inst.get("max_tokens", 32))
@@ -361,10 +401,14 @@ class JetStreamModel(Model):
                 deadline = inst.get("deadline_s")
                 if deadline is not None:
                     deadline = float(deadline)  # pre-validated above
+                priority = inst.get("priority")
+                if priority is None:
+                    priority = header_prio
             ids = self.tokenizer.encode(prompt) or [0]
             futures.append(self.engine.generate_async(ids, max_tokens,
                                                       adapter=adapter,
-                                                      deadline=deadline))
+                                                      deadline=deadline,
+                                                      priority=priority))
         out = []
         for fut in futures:
             try:

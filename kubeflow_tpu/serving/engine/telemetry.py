@@ -58,7 +58,9 @@ class RequestSpan:
     """Per-request lifecycle record: (phase, perf_counter) marks.
 
     Phases, in order: queued -> admitted -> prefill[xN] -> first_token ->
-    done | shed | failed | cancelled.  Mutated only by the submitting thread
+    done | shed | failed | cancelled.  A preempted request additionally
+    cycles through preempted -> readmitted -> (resumed | prefill[xN])
+    before its terminal phase.  Mutated only by the submitting thread
     (queued) and the engine loop (everything else), so marks need no lock;
     readers get a copying ``to_dict``.
     """
@@ -200,6 +202,22 @@ class EngineTelemetry:
             "prompt rows per fused prefill dispatch", BATCH_BUCKETS)
         self.requests_total = r.counter(
             "engine_requests_total", "terminal request outcomes")
+        # QoS scheduler surface (ISSUE 4): preemption counts by reason
+        # (priority/pages/pool/chaos) and mode (swap/recompute), KV bytes
+        # moved through the host swap store, and queue wait broken out by
+        # priority class (the unlabeled engine_queue_wait_seconds above
+        # keeps the aggregate series stable for existing dashboards)
+        self.preemptions = r.counter(
+            "engine_preemptions_total",
+            "decode-slot preemptions by reason and mode")
+        self.swapped_bytes = r.counter(
+            "engine_swapped_bytes_total",
+            "KV bytes moved between the device pool and the host swap "
+            "store, by direction")
+        self.class_queue_wait = r.histogram(
+            "engine_class_queue_wait_seconds",
+            "time from submit to slot admission, by priority class",
+            LATENCY_BUCKETS_S)
         self.kv_occupancy = r.gauge(
             "engine_kv_page_occupancy_ratio",
             "fraction of KV pool pages not free (in use or prefix-cached)")
@@ -217,9 +235,20 @@ class EngineTelemetry:
         if self.enabled:
             self.tpot.observe(s)
 
-    def observe_queue_wait(self, s: float) -> None:
+    def observe_queue_wait(self, s: float,
+                           priority: Optional[str] = None) -> None:
         if self.enabled:
             self.queue_wait.observe(s)
+            if priority is not None:
+                self.class_queue_wait.observe(s, priority=priority)
+
+    def count_preemption(self, reason: str, mode: str) -> None:
+        if self.enabled:
+            self.preemptions.inc(reason=reason, mode=mode)
+
+    def count_swap(self, direction: str, nbytes: int) -> None:
+        if self.enabled:
+            self.swapped_bytes.inc(nbytes, direction=direction)
 
     def observe_tick(self, s: float) -> None:
         if self.enabled:

@@ -457,20 +457,33 @@ class Router:
             raise LookupError(f"InferenceService {name} has no status.address yet")
         return int(url.rsplit(":", 1)[1])
 
-    def _post(self, port: int, path: str, payload: dict, timeout: float = 60.0) -> dict:
+    def _post(self, port: int, path: str, payload: dict, timeout: float = 60.0,
+              headers: Optional[dict] = None) -> dict:
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}{path}",
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **(headers or {})},
         )
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return json.loads(r.read())
 
-    def predict(self, name: str, payload: dict, namespace: str = "default", protocol: str = "v1") -> dict:
+    def predict(self, name: str, payload: dict, namespace: str = "default",
+                protocol: str = "v1", priority: Optional[str] = None,
+                headers: Optional[dict] = None) -> dict:
+        """``priority`` rides as an ``X-Priority`` header: the ingress proxy
+        forwards it verbatim (it is not hop-by-hop) and the engine-backed
+        model applies it to every instance that doesn't carry its own
+        ``priority`` field — so callers can demote a whole batch request to
+        the ``batch`` class without rewriting its instances."""
         port = self._entry_port(name, namespace)
+        hdrs = dict(headers or {})
+        if priority is not None:
+            hdrs.setdefault("X-Priority", priority)
         if protocol == "v1":
-            return self._post(port, f"/v1/models/{name}:predict", payload)
-        return self._post(port, f"/v2/models/{name}/infer", payload)
+            return self._post(port, f"/v1/models/{name}:predict", payload,
+                              headers=hdrs)
+        return self._post(port, f"/v2/models/{name}/infer", payload,
+                          headers=hdrs)
 
     def explain(self, name: str, payload: dict, namespace: str = "default") -> dict:
         # upstream ingress routes :explain to the EXPLAINER component's

@@ -463,7 +463,12 @@ class ModelServer:
             return
         payload = {"text_input": prompt,
                    "parameters": {"max_tokens": max_tokens,
-                                  "adapter": adapter}}
+                                  "adapter": adapter,
+                                  # QoS passthrough (engine scheduler):
+                                  # body param wins; the model layer falls
+                                  # back to the X-Priority header and 400s
+                                  # unknown classes
+                                  "priority": body.get("priority")}}
         headers = dict(h.headers.items())
         oid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:24]}"
         obj = "chat.completion" if chat else "text_completion"
