@@ -579,6 +579,24 @@ def main() -> None:
             }
     except Exception as e:  # sidebar only — never sink the bench line
         out["slo"] = {"error": str(e)[:200]}
+    try:
+        # pipelined-decode sidebar: serving_bench --overlap's headline
+        # (BENCH_OVERLAP.json) — dispatch-gap reduction is the overlap
+        # proof, the byte-identity/leak flags are the acceptance invariants
+        ov_path = os.path.join(REPO, "BENCH_OVERLAP.json")
+        if os.path.exists(ov_path):
+            with open(ov_path) as f:
+                ov = json.loads(f.readline())
+            out["overlap"] = {
+                "tokens_per_sec_speedup_x": ov.get("tokens_per_sec_speedup_x"),
+                "dispatch_gap_reduction_x": ov.get("dispatch_gap_reduction_x"),
+                "byte_identical": ov.get("byte_identical"),
+                "chaos_byte_identical": ov.get("chaos_byte_identical"),
+                "kv_pages_leaked": ov.get("kv_pages_leaked"),
+                "platform": ov.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["overlap"] = {"error": str(e)[:200]}
     print(json.dumps(out))
 
 

@@ -164,6 +164,17 @@ JOBS = [
                                 "--qps", "8",
                                 "--out", os.path.join(REPO, "BENCH_SLO.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # pipelined-decode overlap on a real chip (ISSUE 5): the inter-dispatch
+    # host gap the pipeline removes IS device idle time on a TPU, so the
+    # tokens/s speedup here — unlike the CPU box's parity-bounded number —
+    # measures the actual overlap win; refreshes BENCH_OVERLAP.json
+    {"name": "serving_overlap_1b",
+     "cmd": _serving_cmd("1b", ["--overlap", "--requests", "32",
+                                "--concurrency", "8",
+                                "--prompt-len", "128", "--max-tokens", "64",
+                                "--out",
+                                os.path.join(REPO, "BENCH_OVERLAP.json")]),
+     "timeout": 1500, "first_timeout": 900},
     # 12. multi-LoRA mixed-batch overhead on chip (r4 feature): 1b config,
     #     4 adapters round-robin vs the plain 1b row above
     {"name": "serving_1b_lora4",

@@ -294,6 +294,173 @@ def _run_obs(args, config, params, lora) -> None:
             f"{args.obs_budget}% budget")
 
 
+def _run_overlap(args, config, params, lora) -> None:
+    """Pipelined-decode overlap scenario (ISSUE 5): the same simultaneous-
+    arrival decode workload run with ``pipeline_depth`` 0 (sync oracle) and
+    1 (device-resident token feedback + commit-behind) at several slot
+    counts.  Headlines: steady-state decode tokens/s ratio and the mean
+    inter-dispatch host gap ratio (sync mode's gap embeds the blocking
+    sample readback; pipelined mode's is host bookkeeping only — the
+    engine_dispatch_gap_seconds histogram is the measurement).  Asserts the
+    acceptance invariants: every greedy request byte-identical between the
+    two depths — including a chaos pass with forced preemptions landing at
+    pipeline fences — and zero leaked KV pages."""
+    import json as _json
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import (Engine, EngineConfig,
+                                             SchedulerConfig)
+    from kubeflow_tpu.serving.engine.faults import FaultConfig
+
+    page_size = 32
+    pages_per_slot = (args.prompt_len + args.max_tokens) // page_size + 2
+    slot_counts = sorted({1, max(2, args.concurrency // 2), args.concurrency})
+    rng = np.random.default_rng(0)
+    prompts_all = [rng.integers(1, config.vocab_size,
+                                size=args.prompt_len).tolist()
+                   for _ in range(max(slot_counts))]
+
+    def one_pass(slots: int, depth: int, chaos: bool = False):
+        ec = EngineConfig(
+            max_slots=slots, page_size=page_size,
+            num_pages=max(256, slots * pages_per_slot + 8),
+            max_pages_per_slot=pages_per_slot,
+            pipeline_depth=depth,
+            tensor_parallel=args.tensor_parallel,
+            paged_kernel=args.paged_kernel or None,
+            kv_quant=args.kv_quant, weight_quant=args.weight_quant,
+            # swap-mode preemption restores the EXACT evicted KV, so the
+            # chaos pass compares byte-for-byte against the uncontended
+            # oracle no matter where the storm lands; recompute-resume can
+            # legitimately flip an exact bf16 logit tie through the padded
+            # re-prefill path (the PR 3 tie caveat) and would make this
+            # acceptance check flaky
+            scheduler=SchedulerConfig(swap_policy="swap"),
+            chaos=(FaultConfig(seed=0, preempt_every=9) if chaos else None),
+        )
+        eng = Engine(params, config, ec, lora=lora)
+        # submit BEFORE the loop starts (burst protocol): tick 1 admits
+        # everything, so the run is steady-state decode almost end to end
+        futs = [eng.generate_async(prompts_all[i], args.max_tokens)
+                for i in range(slots)]
+        t0 = _time.perf_counter()
+        eng.start()
+        results = [f.result(timeout=1800) for f in futs]
+        wall = _time.perf_counter() - t0
+        stats = eng.stats
+        gap = eng.telemetry.dispatch_gap.snapshot()
+        eng.stop()
+        toks = sum(r["num_tokens"] for r in results)
+        return {
+            "slots": slots,
+            "pipeline_depth": depth,
+            "chaos_preempt": chaos,
+            "tokens_per_sec": round(toks / wall, 2),
+            "wall_s": round(wall, 4),
+            "mean_dispatch_gap_s": (round(gap["sum"] / gap["count"], 7)
+                                    if gap["count"] else None),
+            "gap_samples": gap["count"],
+            "fences": stats["pipeline_fences"],
+            "fence_reasons": stats["pipeline_fence_reasons"],
+            "preemptions": stats["preemptions"],
+            "kv_pages_leaked": int((ec.num_pages - 1) - stats["free_pages"]
+                                   - stats["cached_pages"]),
+            "tokens": [r["tokens"] for r in results],
+        }
+
+    scenarios = []
+    identical = True
+    leaked = 0
+    reps = 3
+    ratios = {}
+    for slots in slot_counts:
+        one_pass(slots, 0)  # warmup: compiles decode_step at this width
+        one_pass(slots, 1)  # warmup: compiles decode_step_sample
+        # back-to-back (sync, pipelined) PAIRS, summarized by the median of
+        # per-pair throughput ratios: this box's background load drifts by
+        # tens of percent across seconds, and only time-adjacent pairing
+        # cancels it (same reasoning as _run_obs's alternating passes) —
+        # the absolute rows kept are each mode's best pass
+        best = {0: None, 1: None}
+        pair_ratios = []
+        for _ in range(reps):
+            sync = one_pass(slots, 0)
+            pipe = one_pass(slots, 1)
+            identical &= sync["tokens"] == pipe["tokens"]
+            pair_ratios.append(pipe["tokens_per_sec"]
+                               / max(1e-9, sync["tokens_per_sec"]))
+            for depth, rec in ((0, sync), (1, pipe)):
+                leaked += rec["kv_pages_leaked"]
+                rec.pop("tokens")
+                if (best[depth] is None
+                        or rec["tokens_per_sec"]
+                        > best[depth]["tokens_per_sec"]):
+                    best[depth] = rec
+        pair_ratios.sort()
+        ratios[slots] = round(pair_ratios[len(pair_ratios) // 2], 3)
+        for depth in (0, 1):
+            best[depth]["tokens_per_sec_ratio_median"] = ratios[slots]
+            scenarios.append(best[depth])
+    # chaos acceptance pass: forced preemptions every few ticks while the
+    # pipeline runs — fences drain cleanly, outputs stay byte-identical to
+    # the uncontended SYNC oracle at the same slot count
+    top = max(slot_counts)
+    sync_ref = one_pass(top, 0)
+    chaos = one_pass(top, 1, chaos=True)
+    chaos_identical = chaos["tokens"] == sync_ref["tokens"]
+    leaked += chaos["kv_pages_leaked"]
+    chaos.pop("tokens")
+    scenarios.append(chaos)
+
+    by = {(s["slots"], s["pipeline_depth"], s["chaos_preempt"]): s
+          for s in scenarios}
+    top_sync, top_pipe = by[(top, 0, False)], by[(top, 1, False)]
+    gap_ratio = (round(top_sync["mean_dispatch_gap_s"]
+                       / max(1e-9, top_pipe["mean_dispatch_gap_s"]), 2)
+                 if top_sync["mean_dispatch_gap_s"]
+                 and top_pipe["mean_dispatch_gap_s"] else None)
+    out = {
+        "metric": f"pipelined_decode_overlap_{args.config}",
+        "prompt_len": args.prompt_len,
+        "max_tokens": args.max_tokens,
+        "slot_counts": slot_counts,
+        "scenarios": scenarios,
+        # median of time-adjacent paired ratios at the top slot count (the
+        # serving shape) — robust to this box's background-load drift
+        "tokens_per_sec_speedup_x": ratios[top],
+        "tokens_per_sec_speedup_by_slots": ratios,
+        "dispatch_gap_reduction_x": gap_ratio,
+        "byte_identical": identical,
+        "chaos_byte_identical": chaos_identical,
+        "chaos_preemptions": chaos["preemptions"],
+        "kv_pages_leaked": leaked,
+        "platform": jax.devices()[0].platform,
+        "protocol_note": "simultaneous-arrival decode burst per slot count; "
+                         "3 back-to-back (sync, pipelined) pairs after per-"
+                         "shape warmup, speedup = median of per-pair ratios "
+                         "(time-adjacent pairing cancels background-load "
+                         "drift); chaos pass adds preempt_every=9 storms "
+                         "against the sync oracle's outputs.  On a single-"
+                         "core CPU box the host/device overlap cannot "
+                         "shorten compute, so tokens/s is parity-bounded "
+                         "there and the gap histogram is the structural "
+                         "overlap proof; on an accelerator the gap IS "
+                         "device idle time.",
+    }
+    line = _json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if not (identical and chaos_identical):
+        raise SystemExit("pipelined outputs diverged from the sync oracle")
+    if leaked:
+        raise SystemExit(f"KV pages leaked across overlap passes: {leaked}")
+
+
 def _run_slo(args, config, params, lora) -> None:
     """QoS/SLO scenario (ISSUE 4): a mixed interactive+batch open-loop load
     against a saturated engine, run twice — FIFO admission (the pre-QoS
@@ -474,6 +641,14 @@ def main() -> None:
                         "improvement, batch-throughput ratio, preemption "
                         "byte-identity and page leaks (BENCH_SLO.json via "
                         "--out)")
+    p.add_argument("--overlap", action="store_true",
+                   help="pipelined-decode overlap scenario (ISSUE 5): sync "
+                        "(pipeline_depth 0) vs pipelined (1) decode at "
+                        "several slot counts; reports tokens/s speedup, "
+                        "mean inter-dispatch host-gap reduction, greedy "
+                        "byte-identity (incl. a preemption-storm chaos "
+                        "pass) and page leaks (BENCH_OVERLAP.json via "
+                        "--out)")
     p.add_argument("--obs", action="store_true",
                    help="telemetry-overhead smoke (ISSUE 3): closed-loop "
                         "workload with the observability layer on vs off; "
@@ -540,6 +715,9 @@ def main() -> None:
         return
     if args.obs:
         _run_obs(args, config, params, lora)
+        return
+    if args.overlap:
+        _run_overlap(args, config, params, lora)
         return
     if args.slo:
         _run_slo(args, config, params, lora)

@@ -26,6 +26,25 @@ admission, slot lifecycle and KV page accounting live in the C++ core
 
 Continuous batching means a long generation never blocks a short one: slots
 free individually and the queue drains into them mid-flight.
+
+Tick pipelining (ISSUE 5, README "Tick pipelining"): with
+``pipeline_depth=1`` (the default) the steady-state decode loop is a
+one-deep pipeline.  Sampling and the NaN guard are fused into the decode
+dispatch (model.decode_step_sample), so each tick returns a guarded-token
+DEVICE array the next tick consumes directly — no host upload of tokens
+and no blocking readback between steps (seq_lens ride a host shadow
+advanced by pure arithmetic, so they too never wait on the device).  Tick
+N's
+tokens start a non-blocking host copy at dispatch time and are committed to
+the C++ batcher while tick N+1 is already running (commit-behind); page
+accounting therefore lags one step, covered by a lookahead
+``reserve_page`` before each dispatch.  Any roster change — admit, finish,
+preempt, NaN-failed row, cancel, watchdog restart — drains the pipeline to
+a sync barrier (a "fence") before host mirrors and device state are
+rebuilt, replacing the sync loop's "blocking sample is the aliasing fence"
+invariant with per-dispatch page-table snapshots.  ``pipeline_depth=0``
+keeps the fully synchronous loop as the parity oracle: greedy outputs are
+byte-identical between the two modes.
 """
 
 from __future__ import annotations
@@ -48,8 +67,9 @@ from .scheduler import (PRIORITY_RANK, HostSwapStore, QosScheduler,
                         QueueEntry, SchedulerConfig, normalize_priority)
 from .telemetry import (EngineTelemetry, FlightRecorder, RequestSpan,
                         TickProfiler)
-from .model import (DecoderConfig, decode_step, decode_step_k, prefill,
-                    prefill_chunk, sample_tokens, write_pages)
+from .model import (DecoderConfig, decode_step, decode_step_k,
+                    decode_step_sample, prefill, prefill_chunk,
+                    sample_tokens, write_pages)
 from .native import NativeBatcher
 
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
@@ -117,6 +137,12 @@ class EngineConfig:
     # scales, dequant fused into each matmul) — Llama-8B-class weights fit a
     # single 16GB v5e next to the KV pool.  None defers to ENGINE_WEIGHT_QUANT.
     weight_quant: Optional[str] = None
+    # decode-loop pipelining: 1 (default) overlaps host orchestration with
+    # the device step — sampling fused into the decode dispatch, async
+    # token readback, commit-behind with lookahead page reservation; 0 is
+    # the fully synchronous loop (the greedy-parity oracle).  Speculative
+    # decoding ticks always run synchronously regardless.
+    pipeline_depth: int = 1
     # speculative decoding: "prompt_lookup" drafts the continuation of the
     # last n-gram's previous occurrence in the context and verifies up to
     # spec_max_draft tokens in ONE decode pass (lossless under greedy —
@@ -336,6 +362,52 @@ class Engine:
             (engine_config.max_slots, engine_config.max_pages_per_slot), np.int32)
         self._len_host = np.zeros((engine_config.max_slots,), np.int32)
         self._prefill_rows: dict[int, "np.ndarray"] = {}  # slot -> page row
+        # ---- pipelined decode state (README "Tick pipelining") ----------
+        if engine_config.pipeline_depth not in (0, 1):
+            raise ValueError("pipeline_depth must be 0 (sync) or 1")
+        self._pipe_depth = engine_config.pipeline_depth
+        # the one uncommitted in-flight tick: {"sampled": dev guarded-token
+        # array, "slots": tuple, "rids": {slot: rid}} — committed behind
+        # the NEXT dispatch, or at a fence
+        self._inflight: Optional[dict] = None
+        # device-resident token array feeding the next dispatch (the
+        # previous tick's guarded sample — the feedback edge that keeps
+        # host round-trips off the steady-state path); None = rebuild from
+        # host mirrors before dispatching
+        self._dec_state = None
+        # host shadow of the seq_lens the NEXT dispatch will use (committed
+        # length + in-flight lag) — advanced by pure arithmetic (never read
+        # back), uploaded per dispatch, and drives the lookahead page
+        # reservation.  Rebound, never mutated in place: the in-flight
+        # dispatch may alias it zero-copy on CPU backends.
+        self._dec_lens_shadow = np.zeros((engine_config.max_slots,), np.int32)
+        # any roster change (activate/release/preempt/restart) flips this:
+        # the next pipelined dispatch drains + rebuilds first; the reason
+        # labels the fence in engine_pipeline_fences_total
+        self._roster_dirty = True
+        self._dirty_reason: Optional[str] = None
+        # double-buffered page-table snapshots: commit-behind mutates
+        # _pt_host while a dispatch is in flight, so each dispatch gets its
+        # own stable copy (the sync loop's blocking sample made the raw
+        # mirror safe; the pipeline must not rely on that)
+        self._pt_dispatch = [np.zeros_like(self._pt_host) for _ in range(2)]
+        self._pt_flip = 0
+        # steady-state host caches (invalidated on roster changes): last
+        # committed token per slot — the sync decode input, maintained by
+        # _commit instead of a per-tick Python scatter over all slots —
+        # and the request-id-per-row list _guard_logits consumes
+        self._tok_host = np.zeros((engine_config.max_slots,), np.int32)
+        self._row_rids_c: Optional[list] = None
+        self._fences = 0
+        self._fence_reasons: dict[str, int] = {}
+        # (tick, perf_counter) of the last decode dispatch completion —
+        # consecutive-tick gaps land in engine_dispatch_gap_seconds
+        self._dispatch_mark: Optional[tuple] = None
+        # copy_to_host_async is a real D2H DMA kickoff on accelerators but
+        # BLOCKS until the computation completes on the CPU backend (there
+        # is nothing to overlap with) — measured 15% per-tick regression at
+        # 1 slot; the commit-behind np.asarray handles CPU readiness fine
+        self._async_readback = jax.default_backend() != "cpu"
         self._next_id = 0
         self._lock = threading.Lock()
         self._running = False
@@ -454,6 +526,10 @@ class Engine:
         if t is not None:
             t.join(timeout=10)
         # anything still in flight after the hard timeout: fail, don't hang
+        # (the loop is joined: an uncommitted pipeline tick is dropped with
+        # its requests, never committed into a closing batcher)
+        self._inflight = None
+        self._dec_state = None
         for slot in list(self._slot_req):
             self._fail_slot(slot, EngineShutdown("engine stopped"))
         self._fail_unassigned(EngineShutdown("engine stopped"))
@@ -751,6 +827,9 @@ class Engine:
                 "prefill_dispatches": self._prefill_dispatches,
                 "prefill_rows": self._prefill_rows_total,
                 "prefill_batch_hist": dict(self._prefill_batch_hist),
+                "pipeline_depth": self._pipe_depth,
+                "pipeline_fences": self._fences,
+                "pipeline_fence_reasons": dict(self._fence_reasons),
                 "ticks": self._ticks,
                 "ticks_failed": self._ticks_failed,
                 "requests_shed": self._requests_shed,
@@ -829,7 +908,7 @@ class Engine:
         self._prefill_batch_hist[rows] = self._prefill_batch_hist.get(rows, 0) + 1
         self.telemetry.observe_prefill_batch(rows)
 
-    def _guard_logits(self, logits, row_rids):
+    def _guard_logits(self, logits, row_rids, phase: str = "decode"):
         """Chaos NaN injection + the sample-path logit guard.
 
         ``row_rids``: request id per leading logits row (-1 = inactive).
@@ -839,7 +918,7 @@ class Engine:
         tokens and fails non-finite rows instead of committing them."""
         jnp = self._jnp
         if self._chaos is not None:
-            for row in self._chaos.nan_rows(row_rids):
+            for row in self._chaos.nan_rows(row_rids, phase):
                 logits = logits.at[row].set(jnp.nan)
         if not self.ec.logit_guard:
             return logits, None
@@ -884,7 +963,7 @@ class Engine:
         self.k_pool, self.v_pool = write_pages(
             self.k_pool, self.v_pool, pk, pv, jnp.asarray(rows))
         logits, ok_dev = self._guard_logits(
-            logits, [self._slot_req[s] for s in slots])
+            logits, [self._slot_req[s] for s in slots], phase="prefill")
         sampled = np.asarray(
             sample_tokens(logits, self._next_key(), self.ec.temperature))
         ok = np.asarray(ok_dev) if ok_dev is not None else None
@@ -959,7 +1038,7 @@ class Engine:
         ok = None
         if finishing:
             logits, ok_dev = self._guard_logits(
-                logits, [self._slot_req[s] for s in slots])
+                logits, [self._slot_req[s] for s in slots], phase="prefill")
             # rows mid-prompt get sampled too (greedy ignores the key; their
             # values are simply unused) — still one blocking transfer total
             sampled = np.asarray(
@@ -1170,6 +1249,12 @@ class Engine:
                              cancelled=True)
         if decode_ready:
             did_work = True
+            if self._pipe_depth > 0 and self._spec is None:
+                self._isolated("decode", decode_ready,
+                               self._decode_tick_pipelined, decode_ready,
+                               shape={"rows": len(decode_ready),
+                                      "pipelined": True})
+                return did_work
             # host mirrors ARE the decode view: mid-prefill slots hold
             # len 0 / trash rows by construction (_activate_decode)
             seq_lens = self._len_host
@@ -1188,6 +1273,12 @@ class Engine:
                                self._decode_tick_single, decode_ready,
                                seq_lens, page_table,
                                shape={"rows": len(decode_ready)})
+        elif self._inflight is not None:
+            # the roster drained to empty behind the last dispatch (every
+            # row finished at commit-behind): retire the in-flight tick —
+            # its tokens belong to already-resolved requests and discard
+            did_work = True
+            self._drain_pipeline("idle")
         return did_work
 
     # ------------------------------------------- QoS admission / preemption
@@ -1412,6 +1503,12 @@ class Engine:
         the exact KV state; recompute re-derives it from the full committed
         context (prompt + generated so far)."""
         self._check_epoch()
+        # pipeline fence BEFORE reading the victim's mirrors: the in-flight
+        # tick's commit lands first, so the swap/recompute snapshot captures
+        # every committed token (the not-yet-dispatched one is re-derived on
+        # resume, byte-identical under greedy).  The drain may finish or
+        # fail the victim — re-validate below.
+        self._drain_pipeline("preempt")
         rid = self._slot_req.get(slot)
         pending = self._requests.get(rid) if rid is not None else None
         if pending is None:
@@ -1561,6 +1658,7 @@ class Engine:
         per-row dumps would burn the recorder's lifetime dump cap on
         near-identical postmortems."""
         self._nan_rows += 1
+        self._mark_roster_change("nan")  # before the release's "finish"
         if self.ec.telemetry:
             self._flight_event("nan_guard", [slot], None,
                                time.perf_counter(), "nan",
@@ -1593,13 +1691,16 @@ class Engine:
 
     def _release_slot_state(self, slot: int) -> None:
         """Zero one slot's host mirrors (page row, length, adapter id,
-        prefill row).  Every release path — finish, fail, orphan-reap —
-        funnels here so a future per-slot field can't be forgotten in one
-        of them."""
+        cached decode token, prefill row).  Every release path — finish,
+        fail, orphan-reap — funnels here so a future per-slot field can't
+        be forgotten in one of them.  A release is a roster change: the
+        decode pipeline fences before its next dispatch."""
         self._pt_host[slot, :] = 0
         self._len_host[slot] = 0
         self._aid_host[slot] = 0
+        self._tok_host[slot] = 0
         self._prefill_rows.pop(slot, None)
+        self._mark_roster_change("finish")
 
     def _fail_slot(self, slot: int, exc: Exception, shed: bool = False) -> None:
         """Fail ONE slot's request with a typed error and free its
@@ -1697,6 +1798,10 @@ class Engine:
                 extra={"detail": reason, "tick": self._ticks,
                        "epoch": self._epoch, "restarts": self._restarts})
         err = TickFailure(f"engine {reason}; request abandoned by supervisor")
+        # drop (never commit) the in-flight pipeline tick: its requests are
+        # being failed wholesale, and a readback here — on the watchdog
+        # thread, against a possibly-hung dispatch — could block forever
+        self._discard_pipeline()
         for slot in list(self._slot_req):
             self._fail_slot(slot, err)
         self._fail_unassigned(err)
@@ -1707,6 +1812,7 @@ class Engine:
         self._pt_host[:] = 0
         self._len_host[:] = 0
         self._aid_host[:] = 0
+        self._tok_host[:] = 0
         if self.ec.watchdog_restart:
             self._restarts += 1
             self._last_tick_ts = time.monotonic()
@@ -1717,10 +1823,10 @@ class Engine:
             self._running = False
 
     def _decode_tick_single(self, decode_ready, seq_lens, page_table) -> None:
-        tokens = np.zeros((self.ec.max_slots,), np.int32)
-        for slot in decode_ready:
-            gen = self._requests[self._slot_req[slot]].generated
-            tokens[slot] = gen[-1] if gen else 0
+        # _tok_host is maintained by _commit/_activate_decode (steady-state
+        # ticks no longer rebuild it with a Python pass over all slots);
+        # inactive/prefilling rows stay 0 via _release_slot_state
+        tokens = self._tok_host
         # host mirrors go to the jit RAW — eager jnp.asarray would add a
         # Python-level device_put op per array per tick (3 extra dispatches
         # per token over the remote tunnel).  SAFETY INVARIANT: on CPU
@@ -1729,6 +1835,8 @@ class Engine:
         # blocking np.asarray(sample_tokens(...)) below is that barrier —
         # every mirror mutation (_commit and later) happens after it
         self._check_epoch()  # last fence before rebinding device pools
+        t_issue = time.perf_counter()
+        self._note_dispatch_gap(t_issue)
         logits, self.k_pool, self.v_pool = decode_step(
             self.params, self.config, tokens,
             seq_lens, page_table,
@@ -1737,6 +1845,7 @@ class Engine:
             adapter_ids=(self._aid_host
                          if self._lora is not None else None),
         )
+        self._dispatch_mark = (self._ticks, time.perf_counter())
         logits, ok_dev = self._guard_logits(logits, self._row_rids())
         sampled = np.asarray(
             sample_tokens(logits, self._next_key(), self.ec.temperature))
@@ -1749,12 +1858,235 @@ class Engine:
 
     def _row_rids(self) -> list:
         """Request id per decode row (slot), -1 for inactive/prefilling rows
-        — the chaos injector's per-request targeting key."""
-        rids = [-1] * self.ec.max_slots
-        for slot, rid in self._slot_req.items():
-            if slot not in self._prefilling:
-                rids[slot] = rid
+        — the chaos injector's per-request targeting key.  Cached between
+        roster changes (_mark_roster_change invalidates) so steady-state
+        ticks skip the per-tick Python pass over all slots."""
+        rids = self._row_rids_c
+        if rids is None:
+            rids = [-1] * self.ec.max_slots
+            for slot, rid in self._slot_req.items():
+                if slot not in self._prefilling:
+                    rids[slot] = rid
+            self._row_rids_c = rids
         return rids
+
+    def _note_dispatch_gap(self, t_issue: float) -> None:
+        """Observe the host-side gap since the previous decode dispatch
+        completed — only across consecutive decode ticks, so idle waits and
+        prefill-only ticks don't pollute the overlap histogram."""
+        mark = self._dispatch_mark
+        if (self.ec.telemetry and mark is not None
+                and mark[0] >= self._ticks - 1):
+            self.telemetry.observe_dispatch_gap(t_issue - mark[1])
+
+    # ------------------------------------------------- pipelined decode loop
+
+    def _mark_roster_change(self, reason: str = "roster") -> None:
+        """A slot joined or left the decode roster: the next pipelined
+        dispatch must drain + rebuild device state first, and the cached
+        row-rid view is stale.  ``reason`` labels the resulting fence in
+        engine_pipeline_fences_total (first RECORDED cause wins until
+        consumed — the dirty flag can outlive a consumed reason, e.g. at
+        engine start or when a drain leaves no decode-ready rows, so an
+        already-dirty state with no reason still takes this one)."""
+        if self._dirty_reason is None:
+            self._dirty_reason = reason
+        self._roster_dirty = True
+        self._row_rids_c = None
+
+    def _count_fence(self, reason: str) -> None:
+        self._fences += 1
+        self._fence_reasons[reason] = self._fence_reasons.get(reason, 0) + 1
+        self.telemetry.count_fence(reason)
+
+    def _drain_pipeline(self, reason: str) -> None:
+        """Pipeline fence: block on the in-flight tick's async readback,
+        commit its tokens, and discard device decode state so the next
+        dispatch rebuilds from the (now fully current) host mirrors.  A
+        no-op — and not counted — when nothing is in flight."""
+        rec, self._inflight = self._inflight, None
+        self._dec_state = None
+        if rec is None:
+            return
+        self._count_fence(reason)
+        self._commit_inflight(rec)
+
+    def _discard_pipeline(self) -> None:
+        """Drop pipeline state WITHOUT committing (watchdog restart / stop:
+        the in-flight tick's requests are being failed wholesale, and this
+        may run off the loop thread where a device readback could block on
+        a hung dispatch forever)."""
+        if self._inflight is not None:
+            self._count_fence("restart")
+        self._inflight = None
+        self._dec_state = None
+        self._roster_dirty = True
+
+    def _commit_inflight(self, rec: dict) -> None:
+        """Commit-behind: land tick N's sampled tokens in the C++ batcher
+        (and host mirrors/streams) — called right after tick N+1's dispatch,
+        or from a fence.  Rows whose slot was rebound or released since the
+        dispatch are discarded via the rid guard; a guard-tripped row
+        (negative guarded token, see model.decode_step_sample) fails only
+        itself, exactly like the sync loop's post-sample check."""
+        sampled = np.asarray(rec["sampled"])  # async copy started at dispatch
+        for slot in rec["slots"]:
+            rid = rec["rids"][slot]
+            if self._slot_req.get(slot) != rid or rid not in self._requests:
+                continue  # finished/failed/preempted behind the dispatch
+            tok = int(sampled[slot])
+            if tok < 0:  # guard encoding: -token - 1 == non-finite row
+                self._fail_nan(slot, f"pipelined decode row (slot {slot})")
+                continue
+            self._commit(slot, tok)
+
+    def _ready_now(self) -> list:
+        """The decode-ready slot set as of RIGHT NOW (post-drain): bound to
+        a live request and not mid-prefill."""
+        return [s for s in self._slot_req
+                if s not in self._prefilling
+                and self._slot_req[s] in self._requests]
+
+    def _rebuild_device_state(self, decode_ready) -> None:
+        """Upload the last committed token per slot — the device-resident
+        feedback edge the fused decode step then carries forward between
+        fences (seq_lens ride the host shadow: advanced by arithmetic,
+        uploaded per dispatch, never read back from the device)."""
+        toks = np.zeros((self.ec.max_slots,), np.int32)
+        for slot in decode_ready:
+            gen = self._requests[self._slot_req[slot]].generated
+            toks[slot] = gen[-1] if gen else 0
+        self._dec_lens_shadow = self._len_host.copy()
+        self._dec_state = self._jnp.asarray(toks)
+        self._roster_dirty = False
+        # reasons recorded by the drain's OWN commits (a finish/nan during
+        # the fence) are absorbed by this rebuild — a dangling one would
+        # mislabel the next unrelated fence
+        self._dirty_reason = None
+
+    def _reserve_lookahead(self, decode_ready) -> bool:
+        """Commit-behind page accounting: the C++ page grant for tick N's
+        token happens one tick late, so BEFORE dispatching with seq_lens S
+        every live row must already own pages_for(S) pages — reserve the
+        shortfall now (native.reserve_page; a later commit crossing into the
+        reserved page allocates nothing, so the two paths compose).  False
+        when the pool can't cover a row: the caller falls back to one sync
+        tick, whose commit-time OOM handling truncates exactly like the
+        sync loop.
+
+        Coverage invariant (keeps non-boundary ticks O(1) per row, no
+        owned-page scan): after a rebuild, owned >= pages_for(len) holds by
+        the commit-growth invariant, and every boundary tick below restores
+        owned >= pages_for(S) — so a row only needs work when THIS
+        dispatch's KV write position starts a new page ((S-1) % page_size
+        == 0); a reservation failure fences + rebuilds, re-establishing the
+        invariant before the next pipelined dispatch."""
+        ps = self.ec.page_size
+        for slot in decode_ready:
+            S = int(self._dec_lens_shadow[slot])
+            if S <= 0 or (S - 1) % ps:
+                continue  # covered by the pages already verified for S-1
+            need = self._pages_for(S)
+            if need > self.ec.max_pages_per_slot:
+                # one-past-final masked step of a row finishing behind the
+                # dispatch: the fused step trash-routes its KV write
+                continue
+            owned = int(np.count_nonzero(self._pt_host[slot]))
+            while owned < need:
+                p = self.batcher.reserve_page(slot)
+                if p < 0:
+                    return False
+                self._pt_host[slot, owned] = p
+                owned += 1
+        return True
+
+    def _decode_tick_pipelined(self, decode_ready) -> None:
+        """One pipelined decode tick: fence if the roster changed, reserve
+        lookahead pages, dispatch the fused step (device consumes its own
+        previous output), start the async token readback, then commit the
+        PREVIOUS tick's tokens while this one runs on device."""
+        self._check_epoch()  # a superseded thread must not touch pipeline
+        try:
+            if self._roster_dirty or self._dec_state is None:
+                reason, self._dirty_reason = (self._dirty_reason or "roster",
+                                              None)
+                self._drain_pipeline(reason)
+                # the drain's blocking readback is the hang window the
+                # watchdog fires on: a stale thread resuming here must die
+                # before rebuilding state the restarted loop now owns
+                self._check_epoch()
+                # the drain's commits may have finished/failed rows (or, via
+                # a NaN fail, released slots): recompute the ready set
+                decode_ready = self._ready_now()
+                if not decode_ready:
+                    return
+                self._rebuild_device_state(decode_ready)
+            if not self._reserve_lookahead(decode_ready):
+                # pool exhausted at the lookahead: run this tick through the
+                # sync path (its commit-time rc==-2 handling truncates the
+                # right row); device state rebuilds next tick
+                self._drain_pipeline("pool")
+                decode_ready = self._ready_now()  # drain may finish rows
+                if not decode_ready:
+                    return
+                self._decode_tick_single(decode_ready, self._len_host,
+                                         self._pt_host)
+                return
+            tok_dev = self._dec_state
+            # per-dispatch page-table snapshot: commit-behind mutates
+            # _pt_host while this dispatch is in flight, and the previous
+            # snapshot may still back the in-flight tick — alternate
+            self._pt_flip ^= 1
+            buf = self._pt_dispatch[self._pt_flip]
+            np.copyto(buf, self._pt_host)
+            poison = None
+            if self._chaos is not None:
+                poison = np.zeros((self.ec.max_slots,), bool)
+                for row in self._chaos.nan_rows(self._row_rids()):
+                    poison[row] = True
+            self._check_epoch()  # last fence before rebinding device pools
+            t_issue = time.perf_counter()
+            self._note_dispatch_gap(t_issue)
+            sampled, self.k_pool, self.v_pool = decode_step_sample(
+                self.params, self.config, tok_dev, self._dec_lens_shadow,
+                buf, self.k_pool, self.v_pool, self._next_key(), poison,
+                temperature=self.ec.temperature,
+                guard=self.ec.logit_guard,
+                paged=self._paged, mesh=self._mesh,
+                lora_params=self._lora,
+                adapter_ids=(np.array(self._aid_host)
+                             if self._lora is not None else None),
+            )
+            self._dispatch_mark = (self._ticks, time.perf_counter())
+            if self._async_readback:
+                try:
+                    # async readback: the D2H copy overlaps the device
+                    # step; the commit (next tick or fence) finds it ready
+                    sampled.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — best-effort prefetch
+                    pass
+            prev, self._inflight = self._inflight, {
+                "sampled": sampled, "slots": tuple(decode_ready),
+                "rids": {s: self._slot_req[s] for s in decode_ready},
+            }
+            self._dec_state = sampled
+            self._dec_lens_shadow = np.where(
+                self._dec_lens_shadow > 0, self._dec_lens_shadow + 1, 0)
+            if prev is not None:
+                # commit-behind: tick N lands while tick N+1 runs on device
+                self._commit_inflight(prev)
+        except BaseException:
+            # a failed pipelined tick leaves in-flight/device state suspect
+            # (donated pools, unread arrays): reset so the retry rebuilds
+            # from committed host state — greedy re-derives any dropped
+            # in-flight token byte-identically.  A SUPERSEDED thread
+            # (_StaleThread) must not touch the state: it now belongs to
+            # the restarted loop, which reset it itself in _supervise.
+            if getattr(self._tls, "epoch", None) in (None, self._epoch):
+                self._inflight = None
+                self._dec_state = None
+                self._roster_dirty = True
+            raise
 
     # ------------------------------------------------------- speculative
 
@@ -1866,11 +2198,17 @@ class Engine:
         """Prefill finished: install the slot's page row + length into the
         host mirrors, making it visible to the decode step (rows are zero —
         trash page — until this point so decode KV writes can't touch a
-        mid-prefill slot)."""
+        mid-prefill slot).  A new decode row is a roster change: the
+        pipeline fences before its next dispatch."""
         self._check_epoch()
         self._pt_host[slot, :owned] = row[:owned]
         self._len_host[slot] = plen
+        pending = self._requests.get(self._slot_req.get(slot))
+        self._tok_host[slot] = (pending.generated[-1]
+                                if pending is not None and pending.generated
+                                else 0)
         self._prefill_rows.pop(slot, None)
+        self._mark_roster_change("admit")
 
     def _commit(self, slot: int, token: int) -> int:
         """Record one generated token; returns the batcher rc (1 = keep
@@ -1893,8 +2231,10 @@ class Engine:
         rc, new_page = self.batcher.commit_token_ex(slot, is_eos)
         if rc == 1:
             # mirror the growth (finished slots are zeroed in _finish, so
-            # only the keep-decoding path needs it)
+            # only the keep-decoding path needs it); _tok_host feeds the
+            # next sync decode dispatch without a per-tick rebuild
             self._len_host[slot] += 1
+            self._tok_host[slot] = token
             if new_page >= 0:
                 idx = self._pages_for(int(self._len_host[slot])) - 1
                 self._pt_host[slot, idx] = new_page

@@ -27,6 +27,15 @@ so tests can aim a fault at exactly one of N concurrent requests.
 isolation boundaries catch ``Exception``, and simulated thread death must
 sail through them and actually kill the loop thread, the way a real
 un-catchable failure would.
+
+Pipelined decode (engine.py "Tick pipelining"): every injection lands at a
+pipeline FENCE point, never mid-overlap.  ``should_preempt`` signals are
+consumed at the tick top and ``_preempt_slot`` drains the pipeline before
+touching the victim; decode-phase NaNs ride the fused dispatch as a poison
+mask and surface at the commit-behind fence one tick later (``nan_phase=
+"decode"`` aims there specifically); dispatch errors raise inside the
+decode isolation boundary, which resets the pipeline so the retry rebuilds
+from committed host state — all byte-identical under greedy either way.
 """
 
 from __future__ import annotations
@@ -61,6 +70,13 @@ class FaultConfig:
     nan_logit_rate: float = 0.0
     # restrict NaN poisoning to these request ids (empty = any row)
     target_rids: Tuple[int, ...] = ()
+    # restrict NaN poisoning to one sample phase: "" = any, "prefill" =
+    # only the fused first-token sample, "decode" = only decode ticks.
+    # "decode" is how the pipelined-loop tests aim a NaN at a row that has
+    # already LEFT the synchronous prefill path — the poison then rides the
+    # fused decode dispatch and is detected at the commit-behind fence, one
+    # tick after injection (engine.py "Tick pipelining")
+    nan_phase: str = ""
     # sleep slow_tick_s at the top of every Nth tick (0 = off), or exactly
     # once at tick slow_tick_on (1-based; -1 = off): makes the loop look
     # hung to the watchdog without actually deadlocking pytest
@@ -120,12 +136,17 @@ class ChaosInjector:
             raise ChaosDispatchError(
                 f"injected {phase} dispatch fault (tick {self.tick})")
 
-    def nan_rows(self, row_rids) -> list:
+    def nan_rows(self, row_rids, phase: str = "decode") -> list:
         """Rows (indices into ``row_rids``) whose logits should be poisoned
         this tick.  ``row_rids``: request id per logits row (-1 = inactive
-        row, never poisoned)."""
+        row, never poisoned).  ``phase`` is the sample site asking
+        ("prefill" | "decode"); draws happen only when the config's
+        ``nan_phase`` matches (empty matches both), so phase filtering does
+        not perturb the RNG stream of the phase under test."""
         c = self.config
         if c.nan_logit_rate <= 0:
+            return []
+        if c.nan_phase and phase != c.nan_phase:
             return []
         rows = []
         for i, rid in enumerate(row_rids):

@@ -564,29 +564,14 @@ def sample_tokens(logits, key, temperature: float = 0.0):
 # -------------------------------------------------------------------- decode
 
 
-@functools.partial(jax.jit, static_argnames=("config", "paged", "mesh"),
-                   donate_argnames=("k_pool", "v_pool"))
-def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
-                k_pool, v_pool, paged: bool = False, mesh=None,
-                lora_params=None, adapter_ids=None):
-    """One decode step for ALL slots.
-
-    tokens: [B] int32 current token per slot; seq_lens: [B] int32 length
-    INCLUDING the current token; page_table: [B, max_pages] int32;
-    k_pool/v_pool: [L, P, Hkv, page_size, hd] (donated, updated in place).
-    Returns (logits [B, vocab], k_pool, v_pool).
-
-    The current token's KV is written into its page slot BEFORE attention, so
-    attention covers positions [0, seq_len).  Inactive slots (seq_len==0) are
-    clamped to position 0 and produce garbage logits that the caller ignores
-    — static shapes beat recompiles (XLA semantics, system brief).
-
-    ``paged=True`` runs attention as the Pallas paged kernel directly over
-    the pool (paged_attention.py) instead of gathering each slot's pages
-    into a contiguous cache first — removing the per-step KV copy.  The
-    kernel reads int8 pools natively and runs per-shard under ``mesh``
-    (the engine's tensor mesh), so paged composes with kv_quant and TP.
-    """
+def _decode_core(params, config: DecoderConfig, tokens, seq_lens, page_table,
+                 k_pool, v_pool, paged: bool = False, mesh=None,
+                 lora_params=None, adapter_ids=None):
+    """Shared trace body of the single-token decode step — ``decode_step``
+    (logits out, host samples) and ``decode_step_sample`` (sampling fused
+    in, the pipelined engine's path) both inline this, so the two entry
+    points can never drift numerically (greedy byte-identity between the
+    sync and pipelined decode loops rests on that)."""
     c = config
     B = tokens.shape[0]
     lora = None if lora_params is None else (lora_params, adapter_ids)
@@ -601,7 +586,17 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
     mask = (t_range[None, :] < seq_lens[:, None])[:, None, :]  # [B, 1, T]
 
     page_of = pos // page_size
-    page_id = jnp.take_along_axis(page_table, page_of[:, None], axis=1)[:, 0]
+    # a row stepped past its page table (the pipelined loop's one extra
+    # masked step for a row that finished behind the dispatch) routes its KV
+    # write to the trash page 0 explicitly — take_along_axis CLIPS under
+    # jit, which would alias the row's last owned page and corrupt
+    # committed KV (same guard decode_step_k carries for padded drafts)
+    page_id = jnp.where(
+        page_of < max_pages,
+        jnp.take_along_axis(page_table,
+                            jnp.minimum(page_of, max_pages - 1)[:, None],
+                            axis=1)[:, 0],
+        0)
     offset = pos % page_size
 
     for l in range(c.n_layers):
@@ -628,6 +623,88 @@ def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     logits = (x[:, 0] @ _w(params["unembed"])).astype(jnp.float32)
     return logits, k_pool, v_pool
+
+
+@functools.partial(jax.jit, static_argnames=("config", "paged", "mesh"),
+                   donate_argnames=("k_pool", "v_pool"))
+def decode_step(params, config: DecoderConfig, tokens, seq_lens, page_table,
+                k_pool, v_pool, paged: bool = False, mesh=None,
+                lora_params=None, adapter_ids=None):
+    """One decode step for ALL slots.
+
+    tokens: [B] int32 current token per slot; seq_lens: [B] int32 length
+    INCLUDING the current token; page_table: [B, max_pages] int32;
+    k_pool/v_pool: [L, P, Hkv, page_size, hd] (donated, updated in place).
+    Returns (logits [B, vocab], k_pool, v_pool).
+
+    The current token's KV is written into its page slot BEFORE attention, so
+    attention covers positions [0, seq_len).  Inactive slots (seq_len==0) are
+    clamped to position 0 and produce garbage logits that the caller ignores
+    — static shapes beat recompiles (XLA semantics, system brief).
+
+    ``paged=True`` runs attention as the Pallas paged kernel directly over
+    the pool (paged_attention.py) instead of gathering each slot's pages
+    into a contiguous cache first — removing the per-step KV copy.  The
+    kernel reads int8 pools natively and runs per-shard under ``mesh``
+    (the engine's tensor mesh), so paged composes with kv_quant and TP.
+    """
+    return _decode_core(params, config, tokens, seq_lens, page_table,
+                        k_pool, v_pool, paged=paged, mesh=mesh,
+                        lora_params=lora_params, adapter_ids=adapter_ids)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "temperature", "guard",
+                                    "paged", "mesh"),
+                   donate_argnames=("k_pool", "v_pool"))
+def decode_step_sample(params, config: DecoderConfig, tokens, seq_lens,
+                       page_table, k_pool, v_pool, key, poison=None,
+                       temperature: float = 0.0, guard: bool = True,
+                       paged: bool = False, mesh=None,
+                       lora_params=None, adapter_ids=None):
+    """Decode step with sampling and the NaN guard fused into ONE dispatch
+    — the pipelined engine loop's tick body.
+
+    Same decode semantics as ``decode_step`` (shared ``_decode_core``
+    trace), then on device: ``poison`` ([B] bool or None — the chaos
+    injector's NaN mask) overwrites selected rows' logits with NaN; the
+    token is argmax at temperature 0 else categorical under ``key``.
+    Returns (guarded [B] i32, k_pool, v_pool) where ``guarded[b]`` is the
+    sampled token when row b's logits are all finite, and ``-token - 1``
+    (always negative) when the guard tripped — the caller decodes
+    ``ok = guarded >= 0``.  With ``guard=False`` the raw sample is
+    returned (non-negative by construction).
+
+    The guard rides INSIDE the token instead of as a second [B]-bool
+    output, and the seq-len advance is NOT returned at all (the engine's
+    host shadow derives it by pure arithmetic, no readback needed): each
+    extra small output measurably degrades the XLA:CPU executable of this
+    program (~15% per step for the [1]-row case), and a one-int-per-row
+    result is also the minimum possible D2H readback on accelerators.
+    ``tokens`` may therefore contain a negative id when the previous
+    tick's row was poisoned (the engine fences and discards that row one
+    commit later) — it is clamped before the embedding gather so the
+    in-flight garbage row stays garbage-in-garbage-out, never OOB.
+
+    Greedy byte-identity with the sync path: logits come from the same
+    core, argmax matches ``sample_tokens``, and finite(min) & finite(max)
+    over a row is exactly ``isfinite(row).all()`` (jnp.min/max propagate
+    NaN, and any infinity surfaces at one of the extremes).
+    """
+    logits, k_pool, v_pool = _decode_core(
+        params, config, jnp.maximum(tokens, 0), seq_lens, page_table,
+        k_pool, v_pool, paged=paged, mesh=mesh, lora_params=lora_params,
+        adapter_ids=adapter_ids)
+    if poison is not None:
+        logits = jnp.where(poison[:, None], jnp.float32(jnp.nan), logits)
+    # the SAME sampler the sync loop dispatches (inlines under this jit):
+    # an edit to sample_tokens can never split the two paths' numerics
+    sampled = sample_tokens(logits, key, temperature)
+    if guard:
+        ok = (jnp.isfinite(jnp.min(logits, axis=-1))
+              & jnp.isfinite(jnp.max(logits, axis=-1)))
+        sampled = jnp.where(ok, sampled, -sampled - 1)
+    return sampled, k_pool, v_pool
 
 
 @functools.partial(jax.jit, static_argnames=("config", "paged", "mesh"),

@@ -131,8 +131,20 @@ class NativeBatcher:
         return rc, new_page.value
 
     def reserve_page(self, slot: int) -> int:
-        """Pre-allocate one page for an active slot (speculative drafts
-        across a page boundary). Returns page id, -1 no-op, -2 pool empty."""
+        """Pre-allocate one page for an active slot.  Returns the page id,
+        -1 no-op (bad/inactive slot or per-slot cap), -2 pool empty.
+
+        Lookahead contract (the engine's two consumers rely on it):
+        speculative drafting reserves the next page so boundary-tick drafts
+        have owned KV positions, and the PIPELINED decode loop reserves
+        every page a dispatch will write into BEFORE dispatching, because
+        its commits — and therefore the C++ page grants — run one tick
+        behind the device (commit-behind).  A later ``commit_token_ex``
+        that crosses into a reserved page finds the slot's page list
+        already long enough and allocates nothing, so reservation and
+        commit-growth compose; a reservation never used (the row finished
+        behind the dispatch, or drafts were rejected) is freed with the
+        slot by ``release`` like any owned page — no leak path."""
         return load_library().eng_reserve_page(self._handle(), slot)
 
     def release(self, slot: int, prefix_hashes=None) -> None:

@@ -49,6 +49,13 @@ LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 STEP_BUCKETS_S = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                   0.25, 0.5, 1.0, 2.5)
 BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+# Inter-dispatch host gap (pipelined decode, ISSUE 5): the time the host
+# spends between handing the device one decode dispatch and the next.  The
+# whole point of the pipeline is to push this toward zero, so the buckets
+# reach well below STEP_BUCKETS_S — a sync loop's gap includes the blocking
+# sample readback (~device step time), a pipelined loop's is bookkeeping.
+GAP_BUCKETS_S = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                 0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
 
 # terminal span phases (everything else is a lifecycle waypoint)
 TERMINAL_PHASES = ("done", "shed", "failed", "cancelled")
@@ -223,6 +230,17 @@ class EngineTelemetry:
             "fraction of KV pool pages not free (in use or prefix-cached)")
         self.kv_pages = r.gauge(
             "engine_kv_pages", "KV pool pages by state (free/cached/used)")
+        # Pipelined decode surface (ISSUE 5): the dispatch-gap histogram is
+        # the overlap proof (sync mode's gap embeds the blocking sample;
+        # pipelined mode's is host bookkeeping only), and the fence counter
+        # shows how often roster changes force the pipeline to drain.
+        self.dispatch_gap = r.histogram(
+            "engine_dispatch_gap_seconds",
+            "host-side gap between consecutive decode dispatches "
+            "(device idle exposure between steps)", GAP_BUCKETS_S)
+        self.pipeline_fences = r.counter(
+            "engine_pipeline_fences_total",
+            "decode-pipeline drains to a sync barrier, by reason")
 
     # Observe methods stay branch-cheap: one attribute check, then a dict
     # op under the metric's own lock.
@@ -253,6 +271,14 @@ class EngineTelemetry:
     def observe_tick(self, s: float) -> None:
         if self.enabled:
             self.tick_duration.observe(s)
+
+    def observe_dispatch_gap(self, s: float) -> None:
+        if self.enabled:
+            self.dispatch_gap.observe(s)
+
+    def count_fence(self, reason: str) -> None:
+        if self.enabled:
+            self.pipeline_fences.inc(reason=reason)
 
     def observe_prefill_batch(self, rows: int) -> None:
         if self.enabled:

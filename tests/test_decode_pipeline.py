@@ -1,0 +1,294 @@
+"""Pipelined decode loop tests (ISSUE 5): device-resident token feedback,
+async readback + commit-behind, and pipeline fences.
+
+The contract under test: with ``pipeline_depth=1`` the engine overlaps host
+orchestration with the device step, and EVERY greedy output is
+byte-identical to the synchronous loop (``pipeline_depth=0``, the parity
+oracle) — through admissions, EOS stops, page-boundary growth (lookahead
+reservation), NaN-poisoned rows, preemption storms, pool-exhaustion
+truncation, cancels, and watchdog restarts — with zero leaked KV pages.
+"""
+
+import queue
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.engine import Engine, EngineConfig, SchedulerConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import FaultConfig
+from kubeflow_tpu.serving.errors import EngineError, NonFiniteLogits, TickFailure
+
+pytestmark = pytest.mark.pipeline
+
+CFG = M.DecoderConfig(vocab_size=101, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+def _ec(**kw):
+    base = dict(max_slots=4, num_pages=128, page_size=8, max_pages_per_slot=16)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+PROMPTS = [[(i * 13 + j * 7) % (CFG.vocab_size - 1) + 1
+            for j in range(4 + i % 3)] for i in range(6)]
+
+
+def _assert_no_leak(stats, num_pages=128):
+    """Every usable page (page 0 is the reserved trash page) is back in the
+    free list or the prefix cache."""
+    assert (stats["free_pages"] + stats["cached_pages"]) == num_pages - 1, stats
+
+
+def _run(params, ec, prompts=PROMPTS, n_tokens=12, stagger=0.0):
+    """Submit prompts (optionally staggered to force mid-stream admits),
+    collect (tokens-or-error list, stats)."""
+    eng = Engine(params, CFG, ec)
+    eng.start()
+    try:
+        futs = []
+        for i, p in enumerate(prompts):
+            futs.append(eng.generate_async(p, n_tokens))
+            if stagger and i == len(prompts) // 2:
+                time.sleep(stagger)
+        out = []
+        for f in futs:
+            try:
+                out.append(f.result(timeout=180)["tokens"])
+            except EngineError as e:
+                out.append(e)
+        stats = eng.stats
+        return out, stats, eng
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------- config surface
+
+
+def test_pipeline_depth_validated(params):
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        Engine(params, CFG, _ec(pipeline_depth=2))
+
+
+# ------------------------------------------------------------ greedy parity
+
+
+def test_multi_slot_byte_identity_with_staggered_admits(params):
+    """6 prompts over 4 slots, half submitted mid-decode: admissions and
+    finishes fence the pipeline repeatedly, and every output must still be
+    byte-identical to the sync loop."""
+    sync, s0, _ = _run(params, _ec(pipeline_depth=0), stagger=0.2)
+    pipe, s1, _ = _run(params, _ec(pipeline_depth=1), stagger=0.2)
+    assert pipe == sync
+    assert s0["pipeline_depth"] == 0 and s0["pipeline_fences"] == 0
+    assert s1["pipeline_depth"] == 1
+    _assert_no_leak(s1)
+
+
+def test_single_slot_long_generation_crosses_pages(params):
+    """One request generating far past its prompt's last page: the
+    commit-behind lookahead must reserve each next page before the dispatch
+    that writes into it (a missing page would trash-route real KV and break
+    identity)."""
+    prompt = PROMPTS[0]
+    sync, _, _ = _run(params, _ec(pipeline_depth=0, max_slots=1),
+                      prompts=[prompt], n_tokens=40)
+    pipe, s1, _ = _run(params, _ec(pipeline_depth=1, max_slots=1),
+                       prompts=[prompt], n_tokens=40)
+    assert pipe == sync and len(pipe[0]) == 40
+    _assert_no_leak(s1)
+
+
+def test_eos_finish_mid_pipeline(params):
+    """A row stopping on EOS finishes at the commit-behind fence while the
+    next tick already ran its one extra masked step — outputs must match
+    the sync loop exactly (the extra step's KV lands in reserved/trash
+    pages and frees with the slot)."""
+    base, _, _ = _run(params, _ec(pipeline_depth=0, max_slots=1),
+                      prompts=[PROMPTS[1]], n_tokens=16)
+    eos = base[0][7]  # stop on the 8th generated token
+    sync, s0, _ = _run(params, _ec(pipeline_depth=0, max_slots=1, eos_ids=(eos,)),
+                       prompts=[PROMPTS[1]], n_tokens=16)
+    pipe, s1, _ = _run(params, _ec(pipeline_depth=1, max_slots=1, eos_ids=(eos,)),
+                       prompts=[PROMPTS[1]], n_tokens=16)
+    assert pipe == sync
+    assert pipe[0][-1] == eos and len(pipe[0]) <= 9
+    _assert_no_leak(s1)
+
+
+# ------------------------------------------------------------- chaos: NaN
+
+
+def test_nan_in_decode_fails_only_victim_at_fence(params):
+    """A NaN aimed at one row's DECODE sample (nan_phase="decode" — it must
+    survive prefill) is detected at the commit-behind fence: only the victim
+    fails, every other request stays byte-identical, zero pages leak, and
+    the fence is counted under reason "nan"."""
+    clean, _, _ = _run(params, _ec(pipeline_depth=1))
+    chaos_ec = _ec(pipeline_depth=1,
+                   chaos=FaultConfig(seed=0, nan_logit_rate=1.0,
+                                     target_rids=(2,), nan_phase="decode"))
+    eng = Engine(params, CFG, chaos_ec)
+    eng.start()
+    try:
+        futs = [eng.generate_async(p, 12) for p in PROMPTS]
+        got = []
+        for f in futs:
+            try:
+                got.append(f.result(timeout=180)["tokens"])
+            except EngineError as e:
+                got.append(e)
+        for i, (want, have) in enumerate(zip(clean, got)):
+            if i == 2:
+                assert isinstance(have, NonFiniteLogits), have
+            else:
+                assert have == want, i
+        stats = eng.stats
+        assert stats["nan_rows"] >= 1
+        assert stats["pipeline_fence_reasons"].get("nan", 0) >= 1
+        _assert_no_leak(stats)
+        assert eng.health()["state"] == "SERVING"
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------ chaos: preemption
+
+
+def test_preemption_storm_mid_pipeline_byte_identical(params):
+    """Forced preemptions every few ticks evict decode slots mid-pipeline:
+    each eviction drains to a fence first (the swap snapshot must include
+    every committed token), and all outputs stay byte-identical to an
+    uncontended sync run with zero leaked pages."""
+    sync, _, _ = _run(params, _ec(pipeline_depth=0, max_slots=2),
+                      prompts=PROMPTS[:3], n_tokens=16)
+    ec = _ec(pipeline_depth=1, max_slots=2,
+             scheduler=SchedulerConfig(swap_policy="auto", swap_min_tokens=4),
+             chaos=FaultConfig(seed=0, preempt_every=5))
+    pipe, stats, _ = _run(params, ec, prompts=PROMPTS[:3], n_tokens=16)
+    assert pipe == sync
+    assert stats["preemptions"] >= 1
+    assert stats["pipeline_fence_reasons"].get("preempt", 0) >= 1
+    _assert_no_leak(stats)
+
+
+# ------------------------------------------------- watchdog restart / drain
+
+
+def test_watchdog_restart_clears_pipeline(params):
+    """Loop death mid-pipeline: the supervisor discards the in-flight tick
+    (never committing into reassigned slots), fails the stranded requests
+    with a typed error, and the restarted loop serves new work."""
+    ec = _ec(pipeline_depth=1, max_slots=2,
+             watchdog_interval_s=0.05, hang_timeout_s=2.0,
+             chaos=FaultConfig(seed=0, die_on_tick=8))
+    eng = Engine(params, CFG, ec)
+    eng.start()
+    try:
+        futs = [eng.generate_async(p, 64) for p in PROMPTS[:2]]
+        for f in futs:
+            with pytest.raises((TickFailure, EngineError)):
+                f.result(timeout=60)
+        t0 = time.monotonic()
+        while eng.stats["restarts"] < 1 and time.monotonic() - t0 < 30:
+            time.sleep(0.05)
+        assert eng.stats["restarts"] == 1
+        # fresh work completes on the restarted loop, still pipelined
+        r = eng.generate(PROMPTS[2], 8, timeout=120)
+        assert len(r["tokens"]) == 8
+        assert eng.health()["state"] == "SERVING"
+    finally:
+        eng.stop()
+
+
+# --------------------------------------------------- pool-exhaustion parity
+
+
+def test_pool_exhaustion_truncates_like_sync(params):
+    """When the lookahead reservation cannot cover a dispatch, the tick
+    falls back to the sync path whose commit-time OOM handling truncates —
+    tokens and truncated flags must match pipeline_depth=0 exactly."""
+    # 2 slots x small pool: both rows grow until the pool runs dry
+    kw = dict(max_slots=2, num_pages=8, page_size=8, max_pages_per_slot=8)
+
+    def run(depth):
+        eng = Engine(params, CFG, _ec(pipeline_depth=depth, **kw))
+        eng.start()
+        try:
+            futs = [eng.generate_async(p, 48) for p in PROMPTS[:2]]
+            res = [f.result(timeout=180) for f in futs]
+            stats = eng.stats
+            return [(r["tokens"], r["truncated"]) for r in res], stats
+        finally:
+            eng.stop()
+
+    sync, s0 = run(0)
+    pipe, s1 = run(1)
+    assert pipe == sync
+    assert any(trunc for _, trunc in pipe)  # the scenario actually OOM'd
+    _assert_no_leak(s1, num_pages=8)
+
+
+# ------------------------------------------------------------------- cancel
+
+
+def test_cancel_mid_decode_resolves_and_frees(params):
+    eng = Engine(params, CFG, _ec(pipeline_depth=1, max_slots=1))
+    eng.start()
+    try:
+        q: queue.Queue = queue.Queue()
+        fut = eng.generate_async(PROMPTS[0], 100, stream=q)
+        q.get(timeout=60)  # first token is out: the request is decoding
+        assert eng.cancel(fut)
+        r = fut.result(timeout=60)
+        assert r["cancelled"] and r["num_tokens"] >= 1
+        stats = eng.stats
+        assert stats["active_slots"] == 0
+        _assert_no_leak(stats)
+    finally:
+        eng.stop()
+
+
+# -------------------------------------------------------------- observability
+
+
+def test_streaming_matches_result_order(params):
+    eng = Engine(params, CFG, _ec(pipeline_depth=1, max_slots=2))
+    eng.start()
+    try:
+        stream = eng.generate_stream(PROMPTS[0], 12, timeout=120)
+        items = list(stream)
+        result = items[-1]
+        assert items[:-1] == result["tokens"] and len(items[:-1]) == 12
+    finally:
+        eng.stop()
+
+
+def test_fence_and_gap_metrics_exposed(params):
+    """The overlap proof surfaces: engine_dispatch_gap_seconds has samples,
+    engine_pipeline_fences_total renders with reason labels, and stats
+    carries the fence breakdown."""
+    eng = Engine(params, CFG, _ec(pipeline_depth=1))
+    eng.start()
+    try:
+        futs = [eng.generate_async(p, 12) for p in PROMPTS]
+        for f in futs:
+            f.result(timeout=180)
+        stats = eng.stats
+        assert stats["pipeline_fences"] >= 1
+        assert sum(stats["pipeline_fence_reasons"].values()) == stats["pipeline_fences"]
+        assert eng.telemetry.dispatch_gap.snapshot()["count"] > 0
+        text = eng.telemetry.render()
+        assert "engine_dispatch_gap_seconds_bucket" in text
+        assert 'engine_pipeline_fences_total{reason="' in text
+    finally:
+        eng.stop()
