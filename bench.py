@@ -597,6 +597,29 @@ def main() -> None:
             }
     except Exception as e:  # sidebar only — never sink the bench line
         out["overlap"] = {"error": str(e)[:200]}
+    try:
+        # fleet-robustness sidebar: serving_bench --fleet-chaos's headline
+        # (BENCH_FLEET.json) — completion + byte-continuity across replica
+        # kill/hang/disconnect failover, survivor leak audit, p99 penalty,
+        # and whether the router's retry/ejection story reached /metrics
+        fl_path = os.path.join(REPO, "BENCH_FLEET.json")
+        if os.path.exists(fl_path):
+            with open(fl_path) as f:
+                fl = json.loads(f.readline())
+            out["fleet"] = {
+                "replicas": fl.get("replicas"),
+                "completion_rate": fl.get("completion_rate"),
+                "byte_identical_across_failover":
+                    fl.get("byte_identical_across_failover"),
+                "kv_pages_leaked_survivors":
+                    fl.get("kv_pages_leaked_survivors"),
+                "p99_penalty_x": fl.get("p99_penalty_x"),
+                "ingress_retries": fl.get("ingress_retries"),
+                "ingress_ejections": fl.get("ingress_ejections"),
+                "platform": fl.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["fleet"] = {"error": str(e)[:200]}
     print(json.dumps(out))
 
 

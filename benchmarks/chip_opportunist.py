@@ -175,6 +175,21 @@ JOBS = [
                                 "--out",
                                 os.path.join(REPO, "BENCH_OVERLAP.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # fleet chaos on a real chip (ISSUE 6): 3 in-process engine replicas on
+    # one device behind the real ServiceProxy — replica kill mid-decode +
+    # hang + slow + mid-stream disconnects, asserting 100% completion,
+    # byte-identical failover re-admission, and 0 survivor page leaks at
+    # TPU decode speeds (where the ingress stall detector races real
+    # device-rate token emission, not CPU-slowed ticks); refreshes
+    # BENCH_FLEET.json
+    {"name": "serving_fleet_chaos_tiny",
+     "cmd": _serving_cmd("tiny", ["--fleet-chaos", "--requests", "16",
+                                  "--concurrency", "4",
+                                  "--prompt-len", "48",
+                                  "--max-tokens", "24",
+                                  "--out",
+                                  os.path.join(REPO, "BENCH_FLEET.json")]),
+     "timeout": 1500, "first_timeout": 900},
     # 12. multi-LoRA mixed-batch overhead on chip (r4 feature): 1b config,
     #     4 adapters round-robin vs the plain 1b row above
     {"name": "serving_1b_lora4",

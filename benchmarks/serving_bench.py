@@ -593,6 +593,352 @@ def _run_slo(args, config, params, lora) -> None:
                          f"qos={qos['kv_pages_leaked']}")
 
 
+def _run_fleet(args, config, params, lora) -> None:
+    """Fleet chaos scenario (ISSUE 6): N in-process engine replicas behind
+    the real ServiceProxy, streamed requests through the ingress, and a
+    seeded FleetFaultConfig that kills one replica mid-decode, hangs
+    another, makes a third chronically slow, and cuts every Nth relayed
+    stream's connection.  Asserts the acceptance invariants: 100% of
+    requests complete, every streamed output is BYTE-IDENTICAL to the clean
+    fleet pass (no duplicated or dropped tokens across failover +
+    re-admission), 0 leaked KV pages on surviving replicas, bounded p99
+    penalty, and router retry/ejection counters on /metrics telling the
+    story.  Results land in BENCH_FLEET.json via --out."""
+    import concurrent.futures
+    import json as _json
+    import time as _time
+    import urllib.request as _url
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.core.api import APIServer
+    from kubeflow_tpu.core.metrics import REGISTRY
+    from kubeflow_tpu.serving.api import LABEL_ISVC
+    from kubeflow_tpu.serving.controllers import (POD_PORT_ANNOTATION,
+                                                  PROXY_PORT_ANNOTATION)
+    from kubeflow_tpu.serving.engine import Engine, EngineConfig
+    from kubeflow_tpu.serving.engine.faults import (FleetChaos,
+                                                    FleetFaultConfig)
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+    from kubeflow_tpu.serving.router import (INGRESS_EJECTIONS,
+                                             INGRESS_RETRIES,
+                                             RELAY_TIMEOUT_ANNOTATION,
+                                             ServiceProxy)
+    from kubeflow_tpu.serving.server import ModelServer
+    from kubeflow_tpu.utils.net import find_free_ports
+
+    n_rep = args.fleet_replicas
+    slots = args.concurrency
+    page_size = 16
+    # worst resumed prompt = prompt + full generation folded back in
+    pages_per_slot = (args.prompt_len + 2 * args.max_tokens) // page_size + 2
+    num_pages = max(64, slots * pages_per_slot + 8)
+    stall_s = args.fleet_stall_s
+    rng = np.random.default_rng(0)
+    letters = "abcdefghijklmnopqrstuvwxyz "
+
+    def mk_prompt():
+        return "".join(letters[j] for j in rng.integers(0, len(letters),
+                                                        size=args.prompt_len))
+
+    def screen_prompts(needed: int) -> list:
+        """Composition-stable prompts: greedy argmax over bf16 logits can
+        legitimately flip on EXACT ties, and the prefill dispatch shape
+        ([B, bucket]) varies with admission timing — so a tie-adjacent
+        prompt's trajectory differs between schedules with no fault
+        injected at all (measured on this box: 2 of 12 random prompts).
+        The byte-continuity check must catch failover duplication/drops,
+        not bf16 tie flips, so candidates are screened on a referee
+        engine: solo-serial vs 2-way vs ``slots``-way concurrent, plus a
+        mid-trajectory resume re-prefill (prompt+half the ids folded back
+        in, the failover seam's exact math).  Only prompts whose
+        trajectory is identical across all four survive."""
+        from kubeflow_tpu.serving.engine.serve import ByteTokenizer
+
+        tok = ByteTokenizer()
+        ec = EngineConfig(max_slots=slots, page_size=page_size,
+                          num_pages=num_pages,
+                          max_pages_per_slot=pages_per_slot,
+                          tensor_parallel=args.tensor_parallel,
+                          paged_kernel=args.paged_kernel or None,
+                          kv_quant=args.kv_quant,
+                          weight_quant=args.weight_quant)
+        eng = Engine(params, config, ec)
+        eng.start()
+        eng.generate(tok.encode(mk_prompt()), 2)  # warmup compile
+        kept, dropped = [], 0
+        mt = args.max_tokens
+        while len(kept) < needed and dropped < 4 * needed:
+            cand = [mk_prompt() for _ in range(slots)]
+            ids = [tok.encode(p) for p in cand]
+            solo = [eng.generate(i, mt)["tokens"] for i in ids]
+            futs = [eng.generate_async(i, mt) for i in ids]
+            conc = [f.result(timeout=600)["tokens"] for f in futs]
+            duo = []
+            for k in range(0, slots, 2):
+                fs = [eng.generate_async(i, mt) for i in ids[k:k + 2]]
+                duo += [f.result(timeout=600)["tokens"] for f in fs]
+            for p, i, s, c, d in zip(cand, ids, solo, conc, duo):
+                half = mt // 2
+                seam = eng.generate(i + s[:half], mt - half)["tokens"]
+                if s == c == d and seam == s[half:]:
+                    if len(kept) < needed:
+                        kept.append(p)
+                else:
+                    dropped += 1
+        eng.stop()
+        if len(kept) < needed:
+            raise SystemExit(
+                f"fleet chaos: only {len(kept)}/{needed} composition-"
+                f"stable prompts after screening ({dropped} dropped)")
+        log = f"fleet chaos: prompt screening dropped {dropped} tie-prone"
+        print(log + f", kept {len(kept)}")
+        return kept
+
+    prompts = screen_prompts(args.requests)
+
+    chaos_cfg = FleetFaultConfig(
+        seed=0,
+        kill=(0,), kill_after_tokens=max(4, args.max_tokens // 4),
+        hang=(1,) if n_rep >= 3 else (),
+        hang_after_tokens=max(6, args.max_tokens // 3),
+        hang_s=2.5 * stall_s,
+        slow=(2,) if n_rep >= 3 else (),
+        slow_tick_s=0.005,
+        cut_stream_every=4, cut_after_events=3)
+
+    def build(with_chaos: bool):
+        chaos = FleetChaos(chaos_cfg) if with_chaos else None
+        api = APIServer()
+        proxy = ServiceProxy(api)
+        proxy.chaos = chaos
+        svc_port = find_free_ports(1)[0]
+        api.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "fleet",
+                         "labels": {LABEL_ISVC: "fleet"},
+                         "annotations": {
+                             PROXY_PORT_ANNOTATION: str(svc_port),
+                             RELAY_TIMEOUT_ANNOTATION: str(stall_s)}},
+            "spec": {"selector": {"app": "fleet"}}})
+        engines, servers = [], []
+        for i in range(n_rep):
+            ec = EngineConfig(
+                max_slots=slots, page_size=page_size, num_pages=num_pages,
+                max_pages_per_slot=pages_per_slot,
+                tensor_parallel=args.tensor_parallel,
+                paged_kernel=args.paged_kernel or None,
+                kv_quant=args.kv_quant, weight_quant=args.weight_quant,
+                chaos=(chaos.engine_faults(i) if chaos else None))
+            eng = Engine(params, config, ec, lora=lora)
+            srv = ModelServer([JetStreamModel("fleet", "", engine=eng)],
+                              port=0)
+            srv.start()
+            api.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"fleet-{i}",
+                             "labels": {"app": "fleet"},
+                             "annotations": {POD_PORT_ANNOTATION:
+                                             str(srv.port)}},
+                "spec": {},
+                "status": {"phase": "Running",
+                           "conditions": [{"type": "Ready",
+                                           "status": "True"}]}})
+            if chaos is not None:
+                chaos.register_replica(
+                    i, srv.port,
+                    kill_cb=(lambda e=eng: e.stop(drain=False)),
+                    hang_cb=(lambda e=eng:
+                             e._chaos.arm_slow(chaos_cfg.hang_s)))
+            engines.append(eng)
+            servers.append(srv)
+        proxy.sync()
+        return api, proxy, svc_port, engines, servers, chaos
+
+    def stream_one(port: int, prompt: str, mt: int):
+        req = _url.Request(
+            f"http://127.0.0.1:{port}/v2/models/fleet/generate_stream",
+            data=_json.dumps({"text_input": prompt,
+                              "parameters": {"max_tokens": mt}}).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = _time.perf_counter()
+        pieces, final, buf = [], None, b""
+        with _url.urlopen(req, timeout=600) as r:
+            while True:
+                chunk = r.read1(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n\n" in buf:
+                    raw, buf = buf.split(b"\n\n", 1)
+                    for line in raw.splitlines():
+                        if not line.startswith(b"data:"):
+                            continue
+                        ev = _json.loads(line[5:].strip())
+                        if "error" in ev:
+                            raise RuntimeError(str(ev["error"]))
+                        if ev.get("done"):
+                            final = ev
+                        elif ev.get("text_output"):
+                            pieces.append(ev["text_output"])
+        if final is None:
+            raise RuntimeError("stream ended without done event")
+        return "".join(pieces), final, _time.perf_counter() - t0
+
+    def one_pass(with_chaos: bool):
+        api, proxy, svc_port, engines, servers, chaos = build(with_chaos)
+        try:
+            # warmup per replica, DIRECTLY against its backend port (the
+            # chaos token counters only see ingress relays): compiles the
+            # prompt bucket AND the worst resumed-prompt bucket
+            long_warm = prompts[0] + "x" * args.max_tokens
+            for srv in servers:
+                stream_one(srv.port, prompts[0], 4)
+                stream_one(srv.port, long_warm, 4)
+            t0 = _time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
+                outs = list(ex.map(
+                    lambda pr: stream_one(svc_port, pr, args.max_tokens),
+                    prompts))
+            wall = _time.perf_counter() - t0
+            # survivors must drain fully before the leak audit: a stream
+            # the ingress abandoned (failover) still occupies its slot
+            # until the backend notices the closed socket and cancels
+            deadline = _time.monotonic() + 30.0
+            def busy(e):
+                try:
+                    s = e.stats
+                    return s["active_slots"] > 0 or s["queue_depth"] > 0
+                except RuntimeError:
+                    return False
+            while (_time.monotonic() < deadline
+                   and any(busy(e) for e in engines
+                           if e.health()["state"] != "DEAD")):
+                _time.sleep(0.05)
+            leaks, survivor_states = {}, {}
+            for i, e in enumerate(engines):
+                st = e.health()["state"]
+                survivor_states[f"replica_{i}"] = st
+                if st == "DEAD":
+                    continue
+                s = e.stats
+                leaks[f"replica_{i}"] = int(
+                    (num_pages - 1) - s["free_pages"] - s["cached_pages"])
+            return {
+                "texts": [o[0] for o in outs],
+                "tokens": [o[1]["tokens"] for o in outs],
+                "lat": [o[2] for o in outs],
+                "wall": wall,
+                "leaks": leaks,
+                "states": survivor_states,
+                "chaos": chaos.stats() if chaos else None,
+            }
+        finally:
+            proxy.shutdown()
+            for srv in servers:
+                srv.stop()
+            for eng in engines:
+                try:
+                    eng.stop(drain=False)
+                except Exception:  # noqa: BLE001 — already dead/stopped
+                    pass
+
+    def _sum(counter) -> float:
+        return sum(counter.series().values())
+
+    clean = one_pass(False)
+    retries0, ejections0 = _sum(INGRESS_RETRIES), _sum(INGRESS_EJECTIONS)
+    chaos = one_pass(True)
+    retries = _sum(INGRESS_RETRIES) - retries0
+    ejections = _sum(INGRESS_EJECTIONS) - ejections0
+    exposition = REGISTRY.render()
+
+    n = args.requests
+    identical = all(a == b for a, b in zip(clean["texts"], chaos["texts"]))
+    complete = (len(chaos["texts"]) == n
+                and all(t == args.max_tokens for t in chaos["tokens"]))
+    leaked = sum(chaos["leaks"].values())
+    p99_clean = float(np.percentile(clean["lat"], 99))
+    p99_chaos = float(np.percentile(chaos["lat"], 99))
+    penalty = p99_chaos / max(1e-9, p99_clean)
+    out = {
+        "metric": f"fleet_chaos_{args.config}",
+        "replicas": n_rep,
+        "requests": n,
+        "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len,
+        "max_tokens": args.max_tokens,
+        "fault_plan": {
+            "kill": list(chaos_cfg.kill),
+            "kill_after_tokens": chaos_cfg.kill_after_tokens,
+            "hang": list(chaos_cfg.hang), "hang_s": chaos_cfg.hang_s,
+            "slow": list(chaos_cfg.slow),
+            "slow_tick_s": chaos_cfg.slow_tick_s,
+            "cut_stream_every": chaos_cfg.cut_stream_every,
+            "stall_timeout_s": stall_s},
+        "completed": len(chaos["texts"]),
+        "completion_rate": round(len(chaos["texts"]) / n, 4),
+        "byte_identical_across_failover": identical,
+        "tokens_per_request_exact": complete,
+        "kv_pages_leaked_survivors": leaked,
+        "replica_states_after": chaos["states"],
+        "injected": chaos["chaos"],
+        "ingress_retries": retries,
+        "ingress_ejections": ejections,
+        "router_metrics_exposed": ("ingress_retries_total" in exposition
+                                   and "ingress_backend_state" in exposition
+                                   and "ingress_ejections_total" in exposition),
+        "p99_latency_clean_s": round(p99_clean, 4),
+        "p99_latency_chaos_s": round(p99_chaos, 4),
+        "p99_penalty_x": round(penalty, 3),
+        "p99_budget_x": args.fleet_p99_budget,
+        "wall_clean_s": round(clean["wall"], 3),
+        "wall_chaos_s": round(chaos["wall"], 3),
+        "platform": jax.devices()[0].platform,
+        "protocol_note": "closed-loop streamed requests through the real "
+                         "ServiceProxy over N in-process replicas; clean "
+                         "pass = reference for the greedy byte-continuity "
+                         "check; chaos pass kills replica 0 mid-decode, "
+                         "hangs replica 1, slows replica 2, and cuts every "
+                         "4th relayed stream; failover re-admits with "
+                         "resume_token_ids.  Prompts are pre-screened for "
+                         "composition stability (solo vs 2-way vs N-way "
+                         "prefill batching vs mid-trajectory re-prefill): "
+                         "bf16 argmax can flip on exact logit ties across "
+                         "dispatch shapes, and the continuity check must "
+                         "catch failover dup/drops, not tie flips",
+    }
+    line = _json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if not complete:
+        raise SystemExit(
+            f"fleet chaos: only {len(chaos['texts'])}/{n} requests "
+            "completed with the full token budget")
+    if not identical:
+        for i, (a, b) in enumerate(zip(clean["texts"], chaos["texts"])):
+            if a != b:
+                k = next((j for j in range(min(len(a), len(b)))
+                          if a[j] != b[j]), min(len(a), len(b)))
+                print(f"fleet chaos divergence req {i}: clean len {len(a)} "
+                      f"chaos len {len(b)} first diff at char {k}: "
+                      f"clean={a[k:k+12]!r} chaos={b[k:k+12]!r}")
+        raise SystemExit("fleet chaos: streamed outputs diverged from the "
+                         "clean pass (duplicated or dropped tokens)")
+    if leaked:
+        raise SystemExit(
+            f"fleet chaos: {leaked} KV pages leaked on survivors")
+    if penalty > args.fleet_p99_budget:
+        raise SystemExit(f"fleet chaos: p99 penalty {penalty:.2f}x exceeds "
+                         f"budget {args.fleet_p99_budget}x")
+    if retries <= 0 or chaos["chaos"]["kills_fired"] < 1:
+        raise SystemExit("fleet chaos: injections did not engage "
+                         f"(retries={retries}, {chaos['chaos']})")
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="1b", choices=["tiny", "1b", "llama3_8b"])
@@ -649,6 +995,24 @@ def main() -> None:
                         "byte-identity (incl. a preemption-storm chaos "
                         "pass) and page leaks (BENCH_OVERLAP.json via "
                         "--out)")
+    p.add_argument("--fleet-chaos", action="store_true",
+                   help="fleet chaos scenario (ISSUE 6): N in-process "
+                        "replicas behind the real ServiceProxy; seeded "
+                        "replica kill mid-decode + hang + slow replica + "
+                        "mid-stream disconnects; asserts 100%% completion, "
+                        "byte-identical streams across failover "
+                        "(resume_token_ids re-admission), 0 leaked KV "
+                        "pages on survivors, bounded p99 penalty, and "
+                        "router retry/ejection metrics (BENCH_FLEET.json "
+                        "via --out)")
+    p.add_argument("--fleet-replicas", type=int, default=3,
+                   help="replica count for --fleet-chaos")
+    p.add_argument("--fleet-stall-s", type=float, default=2.0,
+                   help="ingress per-read stall timeout (relay-timeout "
+                        "annotation) for --fleet-chaos")
+    p.add_argument("--fleet-p99-budget", type=float, default=15.0,
+                   help="max acceptable chaos/clean p99 latency ratio for "
+                        "--fleet-chaos")
     p.add_argument("--obs", action="store_true",
                    help="telemetry-overhead smoke (ISSUE 3): closed-loop "
                         "workload with the observability layer on vs off; "
@@ -721,6 +1085,9 @@ def main() -> None:
         return
     if args.slo:
         _run_slo(args, config, params, lora)
+        return
+    if args.fleet_chaos:
+        _run_fleet(args, config, params, lora)
         return
     engine = Engine(
         params, config,

@@ -68,6 +68,14 @@ class _Metric:
     def labels_key(self, labels: dict) -> tuple:
         return tuple(sorted(labels.items()))
 
+    def series(self) -> dict:
+        """Snapshot of every label set's current value, keyed by the sorted
+        (label, value) tuple — counter/gauge introspection for tests and
+        benches without parsing the text exposition (the fleet bench reads
+        retry/ejection counters this way)."""
+        with self._lock:
+            return dict(self._values)
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         with self._lock:

@@ -53,6 +53,11 @@ class RequestBatcher(Model):
         self.inner.load()
         self.ready = self.inner.ready
 
+    def health(self) -> dict:
+        # the wrapped model owns the replica-health truth (an engine model
+        # reports its SERVING/DRAINING/DEAD machine through the batcher)
+        return self.inner.health()
+
     def predict(self, payload: Any, headers: Optional[dict] = None) -> Any:
         instances = payload.get("instances") if isinstance(payload, dict) else None
         if not instances or len(instances) != 1:
@@ -135,6 +140,9 @@ class PayloadLogger(Model):
     def load(self) -> None:
         self.inner.load()
         self.ready = self.inner.ready
+
+    def health(self) -> dict:
+        return self.inner.health()
 
     def _emit(self, record: dict) -> None:
         if self._sink:

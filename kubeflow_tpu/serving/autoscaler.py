@@ -10,6 +10,16 @@ ready pods, computes desired = ceil(total_inflight / target), and patches
 Scale-down is damped (a stability window) and scale-to-zero additionally
 waits for `grace` seconds of zero traffic; the router's activator path
 (router.py) un-zeroes on the next request.
+
+Fleet robustness (README "Fleet robustness"): the scrape timeout is
+configurable (constructor arg + per-deployment annotation) and a scrape
+that times out is a STALE SAMPLE — the last known-good reading is reused
+inside a short staleness window, and beyond it the pod counts as
+"unscraped", which vetoes every scale-down decision (missing data can hide
+load, never invent it).  Replicas whose engine reports non-SERVING health
+also veto scale-down: shrinking the fleet while part of it is sick would
+cut below SLO-safe capacity.  Draining pods (controllers.DRAINING_
+ANNOTATION) are exiting and count toward neither capacity nor load.
 """
 
 from __future__ import annotations
@@ -21,19 +31,31 @@ from typing import Optional
 
 from ..core.api import APIServer, Obj
 from .api import (
+    GROUP,
     MAX_REPLICAS_ANNOTATION,
     MIN_REPLICAS_ANNOTATION,
     SCALE_TO_ZERO_GRACE_ANNOTATION,
     TARGET_CONCURRENCY_ANNOTATION,
 )
-from .controllers import SCALED_TO_ZERO_ANNOTATION, pod_is_ready, pod_port
+from .controllers import (DRAINING_ANNOTATION, SCALED_TO_ZERO_ANNOTATION,
+                          pod_is_ready, pod_port)
 
 DEFAULT_SCALE_TO_ZERO_GRACE = 1.5  # seconds (simulator timescale)
 SCALE_DOWN_WINDOW = 1.0
 ACTIVATED_AT_ANNOTATION = "serving.kubeflow.org/activated-at"
+SCRAPE_TIMEOUT_ANNOTATION = f"{GROUP}/scrape-timeout"
+DEFAULT_SCRAPE_TIMEOUT_S = 0.25
+# how long a cached last-known-good sample may stand in for a timed-out
+# scrape before the pod counts as unscraped (scale-down veto)
+STALE_SAMPLE_WINDOW_S = 2.0
+# how long persistent replica unhealthiness keeps vetoing scale-down: the
+# veto protects SLO capacity through TRANSIENT sickness (watchdog restart,
+# degraded retry), but a terminally dead engine on a still-ready pod must
+# not pin the fleet size forever — past this window scaling resumes
+UNHEALTHY_VETO_WINDOW_S = 30.0
 
 
-def scrape_metrics(port: int, timeout: float = 0.25) -> Optional[dict]:
+def scrape_metrics(port: int, timeout: float = DEFAULT_SCRAPE_TIMEOUT_S) -> Optional[dict]:
     try:
         with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=timeout) as r:
             text = r.read().decode()
@@ -52,20 +74,38 @@ def scrape_metrics(port: int, timeout: float = 0.25) -> Optional[dict]:
 
 
 class ConcurrencyAutoscaler:
-    def __init__(self, api: APIServer):
+    def __init__(self, api: APIServer,
+                 scrape_timeout: float = DEFAULT_SCRAPE_TIMEOUT_S):
         self.api = api
+        self.scrape_timeout = scrape_timeout
         # per-deployment uid: time the current lower desired value was first seen
         self._downscale_since: dict[str, tuple[int, float]] = {}
         self._last_traffic: dict[str, float] = {}
+        # pod UID -> (monotonic scrape time, sample): last known-good
+        # readings, reused for STALE_SAMPLE_WINDOW_S on a scrape timeout.
+        # Keyed by uid, not name: a recreated pod must NOT inherit its
+        # predecessor's reading and dodge the unscraped veto.  Pruned to
+        # live pods every sync.
+        self._samples: dict[str, tuple[float, dict]] = {}
+        self._live_uids: set = set()
+        # deployment uid -> monotonic time unhealthiness was first seen
+        # (bounds the unhealthy scale-down veto)
+        self._unhealthy_since: dict[str, float] = {}
 
     def sync(self) -> bool:
         changed = False
+        self._live_uids = set()
         for deploy in self.api.list("Deployment"):
             ann = deploy["metadata"].get("annotations", {})
             if TARGET_CONCURRENCY_ANNOTATION not in ann:
                 continue
             if self._autoscale(deploy, ann):
                 changed = True
+        # drop cached samples for pods that no longer exist (recreated pods
+        # get fresh uids; deleted deployments stop accumulating entries)
+        for uid in list(self._samples):
+            if uid not in self._live_uids:
+                del self._samples[uid]
         return changed
 
     def _autoscale(self, deploy: Obj, ann: dict) -> bool:
@@ -77,32 +117,51 @@ class ConcurrencyAutoscaler:
         uid = deploy["metadata"]["uid"]
         current = int(deploy["spec"].get("replicas", 1))
 
+        scrape_timeout = float(ann.get(SCRAPE_TIMEOUT_ANNOTATION,
+                                       self.scrape_timeout))
         selector = (deploy["spec"].get("selector") or {}).get("matchLabels") or {}
         pods = self.api.list("Pod", namespace=ns, label_selector=selector)
         inflight = 0.0
         engine_load = 0.0
         ready = 0
         unscraped = 0
+        unhealthy = 0
         last_traffic = self._last_traffic.get(uid, 0.0)
+        now_mono = time.monotonic()
         for p in pods:
+            if DRAINING_ANNOTATION in p["metadata"].get("annotations", {}):
+                continue  # exiting: neither capacity nor load
             if not pod_is_ready(p):
                 continue
             ready += 1
+            pod_uid = p["metadata"]["uid"]
+            self._live_uids.add(pod_uid)
             port = pod_port(p)
-            m = scrape_metrics(port) if port else None
+            m = scrape_metrics(port, timeout=scrape_timeout) if port else None
             if m is None:
-                # a ready pod we cannot scrape (busy with a long request, or
-                # mid-restart) means traffic state is UNKNOWN for that pod —
-                # scale-UP must still work (overload is exactly when scrapes
-                # fail); only scale-DOWN decisions are vetoed below
-                unscraped += 1
-                continue
+                # scrape timed out: a STALE SAMPLE, not a zero reading —
+                # reuse the last known-good scrape inside the staleness
+                # window; past it the pod's traffic state is UNKNOWN.
+                # Scale-UP must still work (overload is exactly when
+                # scrapes fail); only scale-DOWN is vetoed below.
+                cached = self._samples.get(pod_uid)
+                if cached is None or now_mono - cached[0] > STALE_SAMPLE_WINDOW_S:
+                    unscraped += 1
+                    continue
+                m = cached[1]
+            else:
+                self._samples[pod_uid] = (now_mono, m)
             inflight += m.get("inflight_requests", 0.0)
             # engine replicas (VERDICT r2 #7): queued + active generation
             # requests are the true demand — one HTTP predict can carry many
             # prompts, so HTTP inflight alone under-reports engine backlog
             engine_load += (m.get("engine_queue_depth", 0.0)
                             + m.get("engine_active_slots", 0.0))
+            # engine health surface: a ready pod whose engine is not
+            # SERVING (watchdog-dead, degraded-restarting) is not SLO-safe
+            # capacity — it vetoes scale-down below
+            if "engine_serving" in m and m["engine_serving"] < 1.0:
+                unhealthy += 1
             last_traffic = max(last_traffic, m.get("last_request_timestamp", 0.0))
         self._last_traffic[uid] = last_traffic
 
@@ -119,10 +178,23 @@ class ConcurrencyAutoscaler:
             self._downscale_since.pop(uid, None)
             return self._scale(deploy, desired, zero=False)
 
+        if unhealthy:
+            # any UNHEALTHY replica means the fleet's real capacity is
+            # below its replica count — shrinking it further would cut
+            # below SLO-safe capacity, so scale-down is vetoed... but only
+            # for UNHEALTHY_VETO_WINDOW_S: a terminally dead engine on a
+            # still-ready pod (nothing here replaces pods) must not pin
+            # the fleet size forever.
+            first = self._unhealthy_since.setdefault(uid, now)
+            if now - first < UNHEALTHY_VETO_WINDOW_S:
+                self._downscale_since.pop(uid, None)
+                return False
+        else:
+            self._unhealthy_since.pop(uid, None)
         if unscraped:
             # missing data can only hide load, never invent it: with any
-            # unscraped pod the true desired can be higher but not lower, so
-            # scale-down (incl. to zero) is off the table this round
+            # unscraped pod the true desired can be higher but not lower,
+            # so scale-down (incl. to zero) is off the table this round
             self._downscale_since.pop(uid, None)
             return False
 

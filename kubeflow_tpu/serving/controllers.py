@@ -19,6 +19,7 @@ from __future__ import annotations
 import copy
 import hashlib
 import json
+import time
 import urllib.request
 from typing import Optional
 
@@ -50,6 +51,13 @@ PROXY_PORT_ANNOTATION = f"{sapi.GROUP}/proxy-port"
 TRAFFIC_ANNOTATION = f"{sapi.GROUP}/traffic"
 SCALED_TO_ZERO_ANNOTATION = f"{sapi.GROUP}/scaled-to-zero"
 DEPLOYMENT_FOR_SERVICE_ANNOTATION = f"{sapi.GROUP}/deployments"
+# graceful replica drain (README "Fleet robustness"): a scale-down victim is
+# MARKED draining (value = wall time the drain began) instead of deleted;
+# the service proxy stops routing to it, the reconciler waits for its
+# in-flight work to finish (or the timeout), then deletes it.
+DRAINING_ANNOTATION = f"{sapi.GROUP}/draining"
+DRAIN_TIMEOUT_S = 10.0
+DRAIN_POLL_S = 0.1
 
 
 def _hash(obj) -> str:
@@ -125,12 +133,40 @@ class DeploymentReconciler:
                 self.api.try_delete("Pod", p["metadata"]["name"], req.namespace)
                 by_name.pop(p["metadata"]["name"], None)
 
-        # scale down: delete highest indices first
+        # scale down: drain highest indices first — mark the victim
+        # draining (the proxy stops routing to it on sight of the
+        # annotation), wait for its in-flight work to finish, then delete.
+        # A pod that never empties is force-deleted at the drain timeout.
         live = sorted(by_name)
-        while len(live) > desired:
-            victim = live.pop()
-            self.api.try_delete("Pod", victim, req.namespace)
-            by_name.pop(victim, None)
+        draining = False
+        # a cancelled scale-down (replicas bounced back up before the
+        # victim emptied) must UN-mark the survivor, or it would stay
+        # invisible to the router and autoscaler forever
+        for name in live[:desired]:
+            if DRAINING_ANNOTATION in by_name[name]["metadata"].get(
+                    "annotations", {}):
+                self.api.patch(
+                    "Pod", name,
+                    {"metadata": {"annotations": {DRAINING_ANNOTATION: None}}},
+                    req.namespace)
+        for victim in live[desired:]:
+            pod = by_name[victim]
+            ann = pod["metadata"].get("annotations", {})
+            if DRAINING_ANNOTATION not in ann:
+                self.api.patch(
+                    "Pod", victim,
+                    {"metadata": {"annotations": {
+                        DRAINING_ANNOTATION: str(time.time())}}},
+                    req.namespace)
+                draining = True
+                continue
+            started = float(ann.get(DRAINING_ANNOTATION) or 0.0)
+            if (self._pod_drained(pod)
+                    or time.time() - started >= DRAIN_TIMEOUT_S):
+                self.api.try_delete("Pod", victim, req.namespace)
+                by_name.pop(victim, None)
+            else:
+                draining = True
 
         # scale up: fill the lowest free indices
         i = 0
@@ -167,7 +203,30 @@ class DeploymentReconciler:
             # readiness signal, so stay reasonably fresh)
             return Result(requeue_after=_poll_backoff(self._attempts, key, 1.0))
         self._attempts.pop(key, None)
+        if draining:
+            # a drain in progress needs the reconciler back promptly: the
+            # victim is deleted the moment its in-flight count hits zero
+            return Result(requeue_after=DRAIN_POLL_S)
         return None
+
+    def _pod_drained(self, pod: Obj) -> bool:
+        """True when a draining pod provably has no in-flight work left: no
+        active HTTP requests AND (for engine pods) no active slots or
+        queued generations.  A failed scrape is UNKNOWN, not drained — a
+        busy pod is exactly the one whose scrape times out, and deleting
+        on unknown would kill the in-flight work the drain exists to
+        protect; a truly dead pod is force-deleted at DRAIN_TIMEOUT_S."""
+        port = pod_port(pod)
+        if port is None:
+            return True
+        from .autoscaler import scrape_metrics  # local: avoids import cycle
+
+        m = scrape_metrics(port, timeout=0.5)
+        if m is None:
+            return False
+        return (m.get("inflight_requests", 0.0) == 0.0
+                and m.get("engine_active_slots", 0.0) == 0.0
+                and m.get("engine_queue_depth", 0.0) == 0.0)
 
     def _create_pod(self, deploy: Obj, name: str, template: dict, thash: str) -> None:
         port = find_free_ports(1)[0]

@@ -496,6 +496,24 @@ class Engine:
                                                daemon=True)
             self._wd_thread.start()
 
+    def begin_drain(self) -> None:
+        """Enter DRAINING without stopping: new submissions are refused with
+        EngineShutdown, but queued and in-flight requests keep running to
+        completion — the fleet-level graceful-drain handshake (the router
+        stops routing to a DRAINING replica on its next health probe; the
+        operator calls ``stop()`` once ``stats['active_slots']`` and
+        ``stats['queue_depth']`` reach zero, or lets the drain timeout
+        force it)."""
+        with self._lock:
+            self._draining = True
+
+    def cancel_drain(self) -> None:
+        """Abort an in-progress ``begin_drain`` (scale-down was cancelled):
+        the engine resumes accepting submissions.  No-op after stop()."""
+        with self._lock:
+            if not self._stopped:
+                self._draining = False
+
     def stop(self, drain: bool = True) -> None:
         """Graceful drain then hard stop.
 
@@ -1888,8 +1906,12 @@ class Engine:
         engine_pipeline_fences_total (first RECORDED cause wins until
         consumed — the dirty flag can outlive a consumed reason, e.g. at
         engine start or when a drain leaves no decode-ready rows, so an
-        already-dirty state with no reason still takes this one)."""
-        if self._dirty_reason is None:
+        already-dirty state with no reason still takes this one).
+        Exception: "nan" overrides a pending mundane cause — a NaN trip is
+        the postmortem-relevant label, and losing it to an admit/finish
+        that happened to dirty the roster first in the same tick would
+        hide the one fence an incident review looks for."""
+        if self._dirty_reason is None or reason == "nan":
             self._dirty_reason = reason
         self._roster_dirty = True
         self._row_rids_c = None

@@ -36,13 +36,24 @@ mask and surface at the commit-behind fence one tick later (``nan_phase=
 "decode"`` aims there specifically); dispatch errors raise inside the
 decode isolation boundary, which resets the pipeline so the retry rebuilds
 from committed host state — all byte-identical under greedy either way.
+
+Fleet scope (ISSUE 6): ``FleetFaultConfig``/``FleetChaos`` extend the same
+discipline to N replicas behind the service proxy — seeded replica kill /
+hang / chronic slowness / mid-stream disconnects, timed in tokens the
+ingress has relayed so the injection lands exactly mid-decode.  The proxy
+reports every relayed stream event (``ServiceProxy.chaos``); kills/hangs
+fire one-shot callbacks, cuts break the relay connection while the replica
+survives.  The failover + re-admission machinery (router.py) must then
+keep every stream byte-identical — asserted by ``tests/test_fleet.py`` and
+``serving_bench --fleet-chaos``.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -105,6 +116,16 @@ class ChaosInjector:
         self.injected_slow_ticks = 0
         self.injected_deaths = 0
         self.injected_preempt_signals = 0
+        # externally-armed one-shot slow tick (fleet chaos "hang"): set by
+        # arm_slow from any thread, consumed by the loop at its next tick
+        self._armed_slow_s = 0.0
+
+    def arm_slow(self, duration_s: float) -> None:
+        """Arm ONE slow tick of ``duration_s`` from outside the loop — the
+        fleet harness's mid-decode hang: the replica keeps its sockets open
+        but its engine loop goes silent, exactly the failure the ingress
+        stall detector (relay timeout) exists for."""
+        self._armed_slow_s = float(duration_s)
 
     def on_tick(self) -> None:
         """Called once at the top of every engine tick (idle ticks too)."""
@@ -113,6 +134,10 @@ class ChaosInjector:
         if c.die_on_tick > 0 and self.tick == c.die_on_tick:
             self.injected_deaths += 1
             raise ChaosThreadDeath(f"injected loop death at tick {self.tick}")
+        armed, self._armed_slow_s = self._armed_slow_s, 0.0
+        if armed > 0:
+            self.injected_slow_ticks += 1
+            time.sleep(armed)
         if ((c.slow_tick_every > 0 and self.tick % c.slow_tick_every == 0)
                 or (c.slow_tick_on > 0 and self.tick == c.slow_tick_on)):
             self.injected_slow_ticks += 1
@@ -169,3 +194,116 @@ class ChaosInjector:
             "injected_deaths": self.injected_deaths,
             "injected_preempt_signals": self.injected_preempt_signals,
         }
+
+
+# --------------------------------------------------------------- fleet scope
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetFaultConfig:
+    """Seeded fault plan over N in-process replicas (ISSUE 6): which
+    replicas get killed / hung / slowed, and when — measured in TOKENS THE
+    INGRESS HAS RELAYED from the victim, so the injection lands exactly
+    mid-decode, deterministically, independent of host speed.  All-defaults
+    == inject nothing.  The runtime half is ``FleetChaos``, which the
+    service proxy's resumable relay feeds (``ServiceProxy.chaos``)."""
+
+    seed: int = 0
+    # replica indices whose engine is hard-stopped mid-decode (in-flight
+    # work fails, health goes DEAD, the router must fail over + re-admit)
+    kill: Tuple[int, ...] = ()
+    kill_after_tokens: int = 6
+    # replica indices whose engine loop goes silent for hang_s mid-decode
+    # (sockets stay open — only the ingress stall detector can catch it)
+    hang: Tuple[int, ...] = ()
+    hang_after_tokens: int = 6
+    hang_s: float = 5.0
+    # chronically slow replicas: every engine tick sleeps slow_tick_s
+    slow: Tuple[int, ...] = ()
+    slow_tick_s: float = 0.02
+    # ingress-side flaky network: cut every Nth relayed stream (0 = off)
+    # after it has relayed cut_after_events events — the replica survives,
+    # the CONNECTION dies, and re-admission must still be token-exact
+    cut_stream_every: int = 0
+    cut_after_events: int = 4
+
+
+class FleetChaos:
+    """Runtime half of FleetFaultConfig: owns per-backend token counters,
+    fires one-shot kill/hang callbacks at exact token counts, and decides
+    which relayed streams get their connection cut.  Thread-safe (relay
+    handler threads feed it concurrently); callbacks run on their own
+    thread so a blocking ``Engine.stop`` never stalls a live relay."""
+
+    def __init__(self, config: FleetFaultConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self._by_port: dict = {}      # port -> (replica idx, kill_cb, hang_cb)
+        self._tokens: dict = {}       # port -> relayed token events
+        self._fired: set = set()      # ports whose one-shot action fired
+        self._stream_no: dict = {}    # stream key -> 1-based stream number
+        self._stream_events: dict = {}
+        self._cut_done: set = set()
+        self.kills_fired = 0
+        self.hangs_fired = 0
+        self.streams_cut = 0
+
+    def engine_faults(self, idx: int) -> FaultConfig:
+        """The per-engine FaultConfig replica ``idx`` should be built with:
+        slow replicas tick with a per-tick sleep; every other replica gets
+        an inert injector (so hang's ``arm_slow`` has a target)."""
+        c = self.config
+        if idx in c.slow:
+            return FaultConfig(seed=c.seed + idx, slow_tick_every=1,
+                               slow_tick_s=c.slow_tick_s)
+        return FaultConfig(seed=c.seed + idx)
+
+    def register_replica(self, idx: int, port: int,
+                         kill_cb=None, hang_cb=None) -> None:
+        with self._lock:
+            self._by_port[port] = (idx, kill_cb, hang_cb)
+
+    def on_relay_event(self, port: int, stream_key) -> Optional[str]:
+        """Called by the ingress relay after each relayed stream event.
+        Returns "cut" when THIS stream's connection should drop now; fires
+        the port's one-shot kill/hang callback when its token count is
+        reached."""
+        c = self.config
+        with self._lock:
+            self._tokens[port] = self._tokens.get(port, 0) + 1
+            n = self._tokens[port]
+            if stream_key not in self._stream_no:
+                self._stream_no[stream_key] = len(self._stream_no) + 1
+            self._stream_events[stream_key] = \
+                self._stream_events.get(stream_key, 0) + 1
+            info = self._by_port.get(port)
+            cb = None
+            if info is not None and port not in self._fired:
+                idx, kill_cb, hang_cb = info
+                if idx in c.kill and n >= c.kill_after_tokens:
+                    self._fired.add(port)
+                    self.kills_fired += 1
+                    cb = kill_cb
+                elif idx in c.hang and n >= c.hang_after_tokens:
+                    self._fired.add(port)
+                    self.hangs_fired += 1
+                    cb = hang_cb
+            cut = (c.cut_stream_every > 0
+                   and self._stream_no[stream_key] % c.cut_stream_every == 0
+                   and stream_key not in self._cut_done
+                   and self._stream_events[stream_key] >= c.cut_after_events)
+            if cut:
+                self._cut_done.add(stream_key)
+                self.streams_cut += 1
+        if cb is not None:
+            threading.Thread(target=cb, daemon=True).start()
+        return "cut" if cut else None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "kills_fired": self.kills_fired,
+                "hangs_fired": self.hangs_fired,
+                "streams_cut": self.streams_cut,
+                "tokens_relayed_by_port": dict(self._tokens),
+            }

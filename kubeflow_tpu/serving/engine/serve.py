@@ -198,6 +198,18 @@ class JetStreamModel(Model):
         ids; vLLM-style multi-LoRA)."""
         return self.engine.adapters if self.engine is not None else {}
 
+    def health(self) -> dict:
+        """The engine's health state machine over HTTP (server.py serves
+        this on ``GET /engine/health``): SERVING/DEGRADED/DRAINING/DEAD
+        plus the watchdog counters — the signal the service proxy's
+        per-backend failure detector probes."""
+        if self.engine is None:
+            return {"state": "DEAD", "reason": "no engine"}
+        try:
+            return self.engine.health()
+        except Exception as e:  # noqa: BLE001 — a probe must answer
+            return {"state": "DEAD", "reason": f"{type(e).__name__}: {e}"}
+
     def extra_metrics(self) -> dict:
         """Per-replica engine state for the router's least-loaded pick and
         the autoscaler's backlog signal (SURVEY.md §3.4 production QPS)."""
@@ -243,6 +255,7 @@ class JetStreamModel(Model):
             self.engine.telemetry.set_kv_pages(
                 s["free_pages"], s.get("cached_pages", 0),
                 self.engine.ec.num_pages - 1)  # page 0 is the trash page
+            self.engine.telemetry.set_health(self.engine.health()["state"])
         except RuntimeError:  # engine stopped
             return ""
         from ...core.metrics import add_const_labels
@@ -270,6 +283,17 @@ class JetStreamModel(Model):
                 return v
         return None
 
+    @staticmethod
+    def _wants_ids(headers: Optional[dict]) -> bool:
+        """Truthy ``X-Stream-Resume`` header: the caller (the service
+        proxy's failover relay) wants every stream event annotated with the
+        token ids it covers, so a broken stream can be re-admitted
+        elsewhere with ``resume_token_ids``."""
+        for k, v in (headers or {}).items():
+            if k.lower() == "x-stream-resume":
+                return str(v).strip().lower() not in ("", "0", "false", "no")
+        return False
+
     def _parse_generate(self, payload: Any, headers: Optional[dict] = None):
         prompt = payload.get("text_input", "") if isinstance(payload, dict) else str(payload)
         params = (payload.get("parameters") or {}) if isinstance(payload, dict) else {}
@@ -290,8 +314,21 @@ class JetStreamModel(Model):
             priority = self._header_priority(headers)
         if priority is not None:
             priority = normalize_priority(priority)  # RequestError on junk
+        # failover re-admission (README "Fleet robustness"): token ids an
+        # earlier replica already generated.  They fold into the prompt so
+        # the generation resumes AFTER them — under greedy decoding the
+        # continuation is exactly what the dead replica would have emitted,
+        # and the re-prefill is a prefix-cache hit when those pages exist.
+        resume = params.get("resume_token_ids")
+        if resume is not None:
+            if (not isinstance(resume, list)
+                    or not all(isinstance(i, int) and i >= 0 for i in resume)):
+                raise RequestError("resume_token_ids must be a list of "
+                                   "non-negative token ids, got "
+                                   f"{resume!r}")
+            resume = list(resume)
         return (self.tokenizer.encode(prompt) or [0], max_tokens,
-                params.get("adapter"), deadline, priority)
+                params.get("adapter"), deadline, priority, resume)
 
     def generate(self, payload: Any, headers: Optional[dict] = None) -> Any:
         """V2 generate extension (unary): {"text_input": str, "parameters":
@@ -300,12 +337,28 @@ class JetStreamModel(Model):
         ``X-Priority`` header supplies the QoS class when the parameter is
         absent.  A truthy ``X-Request-Trace`` header adds the request's
         lifecycle span (``Engine.trace``) as a ``trace`` field."""
-        ids, max_tokens, adapter, deadline, priority = \
+        ids, max_tokens, adapter, deadline, priority, resume = \
             self._parse_generate(payload, headers)
-        r = self.engine.generate(ids, max_tokens, adapter=adapter,
+        resume = resume or []
+        max_new = max_tokens - len(resume)
+        if resume and max_new <= 0:
+            # the run was already complete when the failover happened:
+            # nothing left to generate
+            return {"text_output": "", "token_ids": [],
+                    "tokens": len(resume), "prompt_tokens": len(ids),
+                    "max_tokens": max_tokens, "ttft_s": 0.0, "latency_s": 0.0}
+        r = self.engine.generate(ids + resume, max_new, adapter=adapter,
                                  deadline=deadline, priority=priority)
-        out = {"text_output": self.tokenizer.decode(r["tokens"]),
-               "token_ids": r["tokens"], "tokens": r["num_tokens"],
+        # the seam slices at the STABLE prefix of the resumed text: resume
+        # ids may end mid-UTF-8 sequence, whose completed decoding spans a
+        # different char count than its U+FFFD placeholders (same rule as
+        # the streamed path's _stable_len)
+        out = {"text_output": self.tokenizer.decode(resume + r["tokens"])
+                              [self._stable_len(
+                                  self.tokenizer.decode(resume)):]
+                              if resume else self.tokenizer.decode(r["tokens"]),
+               "token_ids": r["tokens"],
+               "tokens": r["num_tokens"] + len(resume),
                "prompt_tokens": len(ids), "max_tokens": max_tokens,
                "ttft_s": round(r["ttft_s"], 4), "latency_s": round(r["latency_s"], 4)}
         if self._wants_trace(headers):
@@ -326,26 +379,67 @@ class JetStreamModel(Model):
         the delta, holding back trailing replacement chars (a multi-byte
         UTF-8 char split across byte tokens decodes to U+FFFD until its tail
         arrives) — so the concatenated stream equals the unary text_output.
+
+        A truthy ``X-Stream-Resume`` header (the ingress failover relay)
+        makes every event carry the ``token_ids`` it covers — including
+        empty-text events when the decoded piece is held back — and a
+        ``parameters.resume_token_ids`` list folds previously-generated ids
+        into the prompt so the stream emits only the continuation.
         """
-        ids, max_tokens, adapter, deadline, priority = \
+        ids, max_tokens, adapter, deadline, priority, resume = \
             self._parse_generate(payload, headers)
-        stream = self.engine.generate_stream(ids, max_tokens, adapter=adapter,
+        resume = resume or []
+        emit_ids = self._wants_ids(headers)
+        max_new = max_tokens - len(resume)
+        if resume and max_new <= 0:
+            return self._resume_complete(resume, ids, max_tokens)
+        stream = self.engine.generate_stream(ids + resume, max_new,
+                                             adapter=adapter,
                                              deadline=deadline,
                                              priority=priority)
         return self._stream_pieces(stream, ids, max_tokens,
-                                   with_trace=self._wants_trace(headers))
+                                   with_trace=self._wants_trace(headers),
+                                   emit_ids=emit_ids, prior_ids=resume)
+
+    @staticmethod
+    def _stable_len(full: str, floor: int = 0) -> int:
+        """Length of the stable (client-safe) prefix of ``full``: up to 3
+        trailing U+FFFD chars may be an incomplete UTF-8 sequence still
+        waiting for its tail bytes and are held back."""
+        stable = len(full)
+        while (stable > floor and full[stable - 1] == "�"
+               and len(full) - stable < 3):
+            stable -= 1
+        return stable
+
+    def _resume_complete(self, resume: list, ids: list, max_tokens: int):
+        """Degenerate resume: every token was already generated before the
+        failover — emit any held-back text tail, then the final record."""
+        full = self.tokenizer.decode(resume)
+        emitted = self._stable_len(full)
+        if full[emitted:]:
+            yield {"text_output": full[emitted:]}
+        yield {"text_output": "", "done": True, "tokens": len(resume),
+               "prompt_tokens": len(ids), "max_tokens": max_tokens,
+               "ttft_s": 0.0, "latency_s": 0.0}
 
     def _stream_pieces(self, stream, ids: list, max_tokens: int,
-                       with_trace: bool = False):
-        out_ids: list[int] = []
-        emitted = 0
+                       with_trace: bool = False, emit_ids: bool = False,
+                       prior_ids: Optional[list] = None):
+        out_ids: list[int] = list(prior_ids or [])
+        base = len(out_ids)
+        # text already delivered by the PREVIOUS replica = the stable prefix
+        # of the resumed ids (the ingress relayed exactly the stable pieces)
+        emitted = self._stable_len(self.tokenizer.decode(out_ids)) if out_ids else 0
+        reported = base  # ids already carried by an emitted event
         try:
             for item in stream:
                 if isinstance(item, dict):
                     full = self.tokenizer.decode(out_ids)
                     if len(full) > emitted:  # flush held-back tail
                         yield {"text_output": full[emitted:]}
-                    final = {"text_output": "", "done": True, "tokens": item["num_tokens"],
+                    final = {"text_output": "", "done": True,
+                             "tokens": item["num_tokens"] + base,
                              "prompt_tokens": len(ids), "max_tokens": max_tokens,
                              "ttft_s": round(item["ttft_s"], 4),
                              "latency_s": round(item["latency_s"], 4)}
@@ -355,10 +449,16 @@ class JetStreamModel(Model):
                     return
                 out_ids.append(item)
                 full = self.tokenizer.decode(out_ids)
-                stable = len(full)
-                while stable > emitted and full[stable - 1] == "�" and len(full) - stable < 3:
-                    stable -= 1  # ≤3 trailing bytes may be an incomplete UTF-8 seq
-                if stable > emitted:
+                stable = self._stable_len(full, emitted)
+                if emit_ids:
+                    # one event per token so every id reaches the failover
+                    # relay promptly — even when its text is held back
+                    ev = {"text_output": full[emitted:stable],
+                          "token_ids": out_ids[reported:]}
+                    reported = len(out_ids)
+                    emitted = max(emitted, stable)
+                    yield ev
+                elif stable > emitted:
                     yield {"text_output": full[emitted:stable]}
                     emitted = stable
         finally:

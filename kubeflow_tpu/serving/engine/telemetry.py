@@ -241,6 +241,14 @@ class EngineTelemetry:
         self.pipeline_fences = r.counter(
             "engine_pipeline_fences_total",
             "decode-pipeline drains to a sync barrier, by reason")
+        # Fleet robustness surface (ISSUE 6): the engine's health state as a
+        # one-hot labeled gauge so dashboards can plot state transitions —
+        # the scrape-time complement of the router's active /engine/health
+        # probe (refreshed in JetStreamModel.metrics_text).
+        self.health_state = r.gauge(
+            "engine_health_state",
+            "engine health state machine, one-hot by state "
+            "(SERVING/DEGRADED/DRAINING/DEAD)")
 
     # Observe methods stay branch-cheap: one attribute check, then a dict
     # op under the metric's own lock.
@@ -287,6 +295,12 @@ class EngineTelemetry:
     def count_outcome(self, outcome: str) -> None:
         if self.enabled:
             self.requests_total.inc(outcome=outcome)
+
+    def set_health(self, state: str) -> None:
+        if not self.enabled:
+            return
+        for s in ("SERVING", "DEGRADED", "DRAINING", "DEAD"):
+            self.health_state.set(1.0 if s == state else 0.0, state=s)
 
     def set_kv_pages(self, free: int, cached: int, total: int) -> None:
         if not self.enabled or total <= 0:

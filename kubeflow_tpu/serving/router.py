@@ -16,11 +16,26 @@ equivalents:
   * ``Router`` — the client-facing entry: resolves an InferenceService to its
     entry component (transformer if present, else predictor) and speaks
     V1/V2 protocol to its service proxy.
+
+Fleet fault tolerance (README "Fleet robustness"): every backend carries a
+health state machine (healthy → suspect → ejected → probation, plus
+draining) fed by active ``/engine/health`` probes AND passive request
+outcomes (connect errors, 5xx, stream stalls).  Ejection is a per-backend
+circuit breaker with exponential backoff; an empty routable set fails fast
+with 503.  Failed non-streamed requests retry against another replica with
+a jittered exponential backoff under a retry budget; a ``generate_stream``
+relay that loses its backend mid-stream RE-ADMITS the request on a healthy
+replica with the already-relayed token ids folded into the prompt
+(``resume_token_ids``) so the continuation is a re-prefill — a prefix-cache
+hit when those pages exist — and the client stream resumes with no
+duplicated or dropped tokens.
 """
 
 from __future__ import annotations
 
+import copy
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -30,9 +45,10 @@ from typing import Optional
 
 from ..core.api import APIServer, Obj
 from ..core.metrics import REGISTRY
-from .api import LABEL_ISVC, LABEL_REVISION
+from .api import GROUP, LABEL_ISVC, LABEL_REVISION
 from .controllers import (
     DEPLOYMENT_FOR_SERVICE_ANNOTATION,
+    DRAINING_ANNOTATION,
     PROXY_PORT_ANNOTATION,
     SCALED_TO_ZERO_ANNOTATION,
     TRAFFIC_ANNOTATION,
@@ -41,6 +57,16 @@ from .controllers import (
 )
 
 ACTIVATION_TIMEOUT = 30.0
+
+# Per-Service relay knobs (annotations on the Service object; defaults are
+# the ServiceProxy class attributes).  relay-timeout is the per-read backend
+# silence budget (stall detector); hedge-timeout, when set, caps the FIRST
+# attempt of a non-streamed request so a slow replica triggers a re-dispatch
+# to another backend instead of holding the client; retry-budget is the max
+# number of failover re-attempts after the first try.
+RELAY_TIMEOUT_ANNOTATION = f"{GROUP}/relay-timeout"
+HEDGE_TIMEOUT_ANNOTATION = f"{GROUP}/hedge-timeout"
+RETRY_BUDGET_ANNOTATION = f"{GROUP}/retry-budget"
 
 # Ingress-side observability (shared core registry, rendered by
 # core.metrics.serve): per-backend relay counts by status class and the
@@ -55,6 +81,49 @@ INGRESS_LATENCY = REGISTRY.histogram(
     "ingress-observed relay latency incl. backend time, by service",
     buckets=(0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
              60.0, 120.0))
+# Fleet fault-tolerance surface: failover retries by reason
+# (connect/status_5xx/stall/stream), backend ejections (circuit-breaker
+# opens), stall-triggered hedged re-dispatches, and a gauge of backends per
+# health state — together these are the story a failover incident leaves.
+INGRESS_RETRIES = REGISTRY.counter(
+    "ingress_retries_total",
+    "failover re-attempts by service and reason")
+INGRESS_EJECTIONS = REGISTRY.counter(
+    "ingress_ejections_total",
+    "backend ejections (circuit breaker opened), by service")
+INGRESS_HEDGED = REGISTRY.counter(
+    "ingress_hedged_total",
+    "stall-triggered hedged re-dispatches of non-streamed requests")
+INGRESS_BACKEND_STATE = REGISTRY.gauge(
+    "ingress_backend_state",
+    "backends per health state (healthy/suspect/ejected/probation/draining)")
+
+# health states a backend can occupy; terminal routing decision per state:
+# healthy/suspect route, probation routes only as a fallback set, ejected
+# and draining never route.
+_BACKEND_STATES = ("healthy", "suspect", "ejected", "probation", "draining")
+
+
+class _BackendHealth:
+    """Per-backend failure-detector record (guarded by _ProxyState.lock)."""
+
+    __slots__ = ("state", "fails", "ejections", "until", "probed_at")
+
+    def __init__(self):
+        self.state = "healthy"
+        self.fails = 0        # consecutive failures since last success
+        self.ejections = 0    # consecutive ejection rounds (breaker backoff)
+        self.until = 0.0      # monotonic deadline of the current ejection
+        self.probed_at = 0.0  # monotonic time of the last active probe
+
+
+class _ClientGone(Exception):
+    """The downstream client hung up mid-relay: stop, never failover."""
+
+
+class _BackendStreamError(Exception):
+    """The backend's SSE stream broke (EOF before done, read error, stall,
+    or an in-stream error event): failover material."""
 
 
 class _ProxyState:
@@ -85,6 +154,11 @@ class _ProxyState:
         # all-distinct prompts made 2 replicas no faster than 1).
         # Insertion-ordered; capped in _pick_engine_aware.
         self.affinity: dict[str, int] = {}
+        # fleet fault tolerance: per-backend health records + the set of
+        # ports some thread is actively probing outside the lock (single-
+        # flight, same discipline as `refreshing` above)
+        self.health: dict[int, _BackendHealth] = {}
+        self.probing: set[int] = set()
         self.lock = threading.Lock()
 
 
@@ -94,6 +168,10 @@ class ServiceProxy:
     def __init__(self, api: APIServer):
         self.api = api
         self._servers: dict[tuple[str, str], ThreadingHTTPServer] = {}
+        # optional fleet chaos hooks (faults.FleetChaos): the resumable
+        # relay reports every relayed token event so seeded kill/hang/cut
+        # injections fire at exact token counts (bench/test substrate)
+        self.chaos = None
 
     def sync(self) -> bool:
         changed = False
@@ -127,78 +205,55 @@ class ServiceProxy:
             def _forward(self):
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n) if n else None
-                try:
-                    backend = proxy._pick_backend(state, body=body)
-                except LookupError as e:
-                    # same status-class label scheme as the relay path below,
-                    # so sum-by-code dashboards see these 503s too
-                    INGRESS_REQUESTS.inc(service=state.service_name,
-                                         backend="none", code="5xx")
-                    self._reply(503, json.dumps({"error": str(e)}).encode())
-                    return
-                url = f"http://127.0.0.1:{backend}{self.path}"
-                hop_by_hop = {"host", "content-length", "connection", "keep-alive",
-                              "transfer-encoding", "upgrade", "te", "trailers"}
-                fwd_headers = {k: v for k, v in self.headers.items()
-                               if k.lower() not in hop_by_hop}
-                fwd_headers.setdefault("Content-Type", "application/json")
-                req = urllib.request.Request(url, data=body, method=self.command, headers=fwd_headers)
-                t0 = time.perf_counter()
-                status = 502
-                try:
-                    # relay timeout = per-read backend silence, NOT total
-                    # request time; it must exceed any client-side budget
-                    # (Router sets 120s for LLM generation) or the ingress
-                    # 502s slow-but-alive generations its clients were
-                    # still willing to wait for
-                    with urllib.request.urlopen(req, timeout=300) as r:
-                        status = r.status
-                        ctype = r.headers.get("Content-Type") or ""
-                        if ctype.startswith("text/event-stream"):
-                            # SSE passthrough: relay chunks as they arrive
-                            # (buffering r.read() would hold every token
-                            # until the generation finished — the ingress
-                            # must not defeat streaming)
-                            self._stream(r, ctype)
-                        else:
-                            self._reply(r.status, r.read(), ctype or None)
-                except urllib.error.HTTPError as e:
-                    status = e.code
-                    self._reply(e.code, e.read(), e.headers.get("Content-Type"))
-                except Exception as e:  # noqa: BLE001
-                    status = 502
-                    self._reply(502, json.dumps({"error": f"backend: {e}"}).encode())
-                finally:
-                    # latency covers the full relay (SSE: the whole stream)
-                    INGRESS_LATENCY.observe(time.perf_counter() - t0,
-                                            service=state.service_name)
-                    INGRESS_REQUESTS.inc(service=state.service_name,
-                                         backend=str(backend),
-                                         code=f"{status // 100}xx")
+                proxy._relay(self, state, body)
 
-            def _stream(self, r, ctype: str) -> None:
-                # nothing may bubble out of here: once any response byte is
-                # on the wire, _forward's catch-all would write a SECOND
-                # HTTP response into the body (same invariant as the model
-                # server's _sse_write) — so even the header writes live
-                # inside the try (a client can hang up before them too)
+            def _stream(self, r, ctype: str) -> bool:
+                # non-resumable SSE passthrough (OpenAI surface, transformer
+                # chains): relay chunks as they arrive — buffering r.read()
+                # would hold every token until the generation finished.
+                # Once any response byte is on the wire nothing may bubble
+                # out of here: _forward's caller would write a SECOND HTTP
+                # response into the body (same invariant as the model
+                # server's _sse_write), so even the header writes live
+                # inside the try (a client can hang up before them too).
+                # Returns False when the BACKEND failed mid-stream (the
+                # caller charges the failure detector a strike).
+                backend_ok = True
                 try:
                     self.send_response(r.status)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Cache-Control", "no-cache")
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
+                except Exception:  # noqa: BLE001 — client gone pre-headers
+                    self.close_connection = True
+                    return backend_ok
+                try:
                     while True:
-                        chunk = r.read1(65536)  # whatever the backend flushed
+                        try:
+                            chunk = r.read1(65536)  # whatever backend flushed
+                        except Exception as e:  # noqa: BLE001 — incl. stalls
+                            # backend died mid-stream but the CLIENT side is
+                            # intact: a silent truncation would look like a
+                            # clean close, so emit a terminal structured
+                            # error event before finishing the framing
+                            backend_ok = False
+                            err = json.dumps({"error": f"backend: {e}",
+                                              "done": True}).encode()
+                            self._chunk(b"data: " + err + b"\n\n")
+                            break
                         if not chunk:
                             break
-                        self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
-                        self.wfile.flush()
+                        self._chunk(chunk)
                     self.wfile.write(b"0\r\n\r\n")
-                except Exception:  # noqa: BLE001 — incl. IncompleteRead
-                    # backend died or client hung up mid-stream: the framing
-                    # is already broken — close the connection, never re-reply
+                    self.wfile.flush()
+                except Exception:  # noqa: BLE001 — client hung up mid-stream
                     self.close_connection = True
+                return backend_ok
+
+            def _chunk(self, data: bytes) -> None:
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+                self.wfile.flush()
 
             def _reply(self, code: int, data: bytes, ctype: Optional[str] = "application/json"):
                 self.send_response(code)
@@ -223,10 +278,476 @@ class ServiceProxy:
 
         threading.Thread(target=close, daemon=True).start()
 
+    # ------------------------------------------------------- failover relay
+
+    # default relay knobs (overridable per Service via annotations above)
+    _RELAY_TIMEOUT_S = 300.0  # per-read backend silence budget
+    _RETRY_BUDGET = 3         # failover re-attempts after the first try
+    _BACKOFF_BASE_S = 0.05
+    _BACKOFF_MAX_S = 2.0
+
+    def _get_service(self, state: _ProxyState) -> Optional[Obj]:
+        return self.api.try_get("Service", state.service_name,
+                                state.namespace)
+
+    def _relay(self, handler, state: _ProxyState, body: Optional[bytes]) -> None:
+        """One client request end to end: pick → attempt → (on failure)
+        re-pick and retry under the budget.  Retry is idempotency-safe by
+        construction: a non-streamed request retries only while NOTHING has
+        been written to the client, and a streamed one re-admits with its
+        relayed token ids so the continuation picks up exactly where the
+        dead backend stopped."""
+        svc = self._get_service(state)
+        ann = (svc or {}).get("metadata", {}).get("annotations", {})
+        budget = int(float(ann.get(RETRY_BUDGET_ANNOTATION,
+                                   self._RETRY_BUDGET)))
+        relay_timeout = float(ann.get(RELAY_TIMEOUT_ANNOTATION,
+                                      self._RELAY_TIMEOUT_S))
+        hedge_s = float(ann.get(HEDGE_TIMEOUT_ANNOTATION, 0.0))
+        resume = self._resume_context(handler.path, body)
+        sse = _SSERelay(handler)
+        hop_by_hop = {"host", "content-length", "connection", "keep-alive",
+                      "transfer-encoding", "upgrade", "te", "trailers"}
+        fwd_headers = {k: v for k, v in handler.headers.items()
+                       if k.lower() not in hop_by_hop}
+        fwd_headers.setdefault("Content-Type", "application/json")
+        t0 = time.perf_counter()
+        status = 502
+        backend_label = "none"
+        attempt = 0
+        tried: set[int] = set()
+        try:
+            while True:
+                try:
+                    backend = self._pick_backend(state, body=body,
+                                                 exclude=frozenset(tried),
+                                                 svc=svc)
+                except LookupError as e:
+                    status = 503
+                    if sse.started:
+                        sse.error_event(str(e))
+                    else:
+                        handler._reply(
+                            503, json.dumps({"error": str(e)}).encode())
+                    return
+                backend_label = str(backend)
+                data, hdrs = body, dict(fwd_headers)
+                if resume is not None:
+                    # ask the engine surface to annotate stream events with
+                    # the token ids they cover — the re-admission currency
+                    hdrs["X-Stream-Resume"] = "1"
+                    if resume.token_ids:
+                        data = resume.request_body()
+                        hdrs["Content-Type"] = "application/json"
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{backend}{handler.path}",
+                    data=data, method=handler.command, headers=hdrs)
+                # relay timeout = per-read backend silence (the stall
+                # detector), NOT total request time; it must exceed any
+                # client-side budget or the ingress would 502 slow-but-
+                # alive generations.  A hedge timeout, when configured,
+                # tightens only the first non-streamed attempt.
+                attempt_timeout = relay_timeout
+                # never hedge a request that will stream: urlopen's timeout
+                # persists as the per-read socket timeout for the WHOLE
+                # relay, so a tight hedge cap would kill healthy slow
+                # streams mid-generation.  The path check covers EVERY
+                # generate_stream request (string-body ones have no resume
+                # ctx); _wants_stream covers OpenAI "stream": true bodies.
+                hedging = (hedge_s > 0 and resume is None
+                           and attempt == 0 and handler.command != "GET"
+                           and not handler.path.split("?")[0].rstrip("/")
+                           .endswith("/generate_stream")
+                           and not self._wants_stream(body))
+                if hedging:
+                    attempt_timeout = min(attempt_timeout, hedge_s)
+                reason = None
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=attempt_timeout) as r:
+                        status = r.status
+                        ctype = r.headers.get("Content-Type") or ""
+                        if ctype.startswith("text/event-stream"):
+                            if resume is not None:
+                                self._relay_resumable(state, r, sse, resume,
+                                                      backend)
+                                ok = True
+                            else:
+                                ok = handler._stream(r, ctype)
+                            self._note_backend(state, backend, ok)
+                            return
+                        payload = r.read()
+                        self._note_backend(state, backend, True)
+                        if sse.started:
+                            # a RESUMED stream landed on a backend that
+                            # answered non-SSE: replying normally would
+                            # write a second HTTP response into the live
+                            # chunked body — terminal error event instead
+                            sse.error_event(
+                                "re-admission returned a non-stream "
+                                f"response ({r.status}, {ctype or '?'})")
+                            return
+                        handler._reply(r.status, payload, ctype or None)
+                        return
+                except urllib.error.HTTPError as e:
+                    status = e.code
+                    if e.code < 500:  # client fault: the backend is fine
+                        self._note_backend(state, backend, True)
+                        if sse.started:  # a RESUMED request was refused
+                            sse.error_event(
+                                f"re-admission refused: {e.code}")
+                        else:
+                            handler._reply(e.code, e.read(),
+                                           e.headers.get("Content-Type"))
+                        return
+                    self._note_backend(state, backend, False)
+                    if attempt >= budget:
+                        if sse.started:
+                            sse.error_event(
+                                f"backend failed with {e.code} after "
+                                f"{attempt + 1} attempts")
+                        else:
+                            handler._reply(e.code, e.read(),
+                                           e.headers.get("Content-Type"))
+                        return
+                    reason = "status_5xx"
+                except _ClientGone:
+                    handler.close_connection = True
+                    return
+                except _BackendStreamError as e:
+                    self._note_backend(state, backend, False)
+                    if attempt >= budget:
+                        status = 502
+                        sse.error_event(
+                            f"backend stream failed after {attempt + 1} "
+                            f"attempts: {e}")
+                        return
+                    reason = "stream"
+                except Exception as e:  # noqa: BLE001 — URLError/OSError/...
+                    self._note_backend(state, backend, False)
+                    stalled = self._is_timeout(e)
+                    if attempt >= budget:
+                        status = 502
+                        msg = f"backend: {e}"
+                        if sse.started:
+                            sse.error_event(msg)
+                        else:
+                            handler._reply(
+                                502, json.dumps({"error": msg}).encode())
+                        return
+                    if hedging and stalled:
+                        reason = "stall"
+                        INGRESS_HEDGED.inc(service=state.service_name)
+                    else:
+                        reason = "stall" if stalled else "connect"
+                attempt += 1
+                tried.add(backend)
+                INGRESS_RETRIES.inc(service=state.service_name, reason=reason)
+                if not sse.started:
+                    # jittered exponential backoff — but never while a live
+                    # client stream is waiting on its continuation
+                    delay = min(self._BACKOFF_MAX_S,
+                                self._BACKOFF_BASE_S * (2 ** (attempt - 1)))
+                    time.sleep(random.uniform(0, delay))
+        finally:
+            # latency covers the full relay (SSE: the whole stream, across
+            # every failover attempt)
+            INGRESS_LATENCY.observe(time.perf_counter() - t0,
+                                    service=state.service_name)
+            INGRESS_REQUESTS.inc(service=state.service_name,
+                                 backend=backend_label,
+                                 code=f"{status // 100}xx")
+
+    @staticmethod
+    def _wants_stream(body: Optional[bytes]) -> bool:
+        """True when the request body asks for a streamed response (the
+        OpenAI surface's ``"stream": true``)."""
+        if not body:
+            return False
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return False
+        return bool(isinstance(payload, dict) and payload.get("stream"))
+
+    @staticmethod
+    def _is_timeout(e: Exception) -> bool:
+        import socket
+
+        cause = getattr(e, "reason", e)
+        return isinstance(cause, (TimeoutError, socket.timeout))
+
+    @staticmethod
+    def _resume_context(path: str, body: Optional[bytes]):
+        """A _ResumeCtx when this request is a resumable token stream (the
+        V2 generate_stream surface with a text prompt), else None."""
+        if not path.split("?")[0].rstrip("/").endswith("/generate_stream"):
+            return None
+        if not body:
+            return None
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return None
+        if not isinstance(payload, dict) or not isinstance(
+                payload.get("text_input"), str):
+            return None
+        return _ResumeCtx(payload)
+
+    def _relay_resumable(self, state: _ProxyState, r, sse: "_SSERelay",
+                         resume: "_ResumeCtx", backend: int) -> None:
+        """Parse-and-relay one backend SSE stream, recording the token ids
+        behind every relayed event into ``resume`` so a broken stream can be
+        re-admitted elsewhere.  Raises _BackendStreamError on EOF-before-
+        done, read errors/stalls, or an in-stream backend error event;
+        raises _ClientGone when the downstream client hangs up."""
+        chaos = self.chaos
+        buf = b""
+        while True:
+            try:
+                chunk = r.read1(65536)
+            except Exception as e:  # noqa: BLE001 — conn reset, stall, ...
+                raise _BackendStreamError(f"read: {e}") from e
+            if not chunk:
+                # SSE is close-delimited: EOF before the done event means
+                # the backend died mid-generation
+                raise _BackendStreamError("stream ended before done event")
+            buf += chunk
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                event = None
+                for line in raw.splitlines():
+                    if line.startswith(b"data:"):
+                        try:
+                            event = json.loads(line[5:].strip())
+                        except ValueError:
+                            event = None
+                if not isinstance(event, dict):
+                    continue
+                if "error" in event:
+                    # engine fault surfaced as a structured in-stream error
+                    # event (the model server's _sse_write contract): same
+                    # failover path as a dropped connection
+                    raise _BackendStreamError(str(event["error"]))
+                ids = event.pop("token_ids", None)
+                if ids:
+                    resume.token_ids.extend(int(i) for i in ids)
+                if event.get("done"):
+                    if resume.token_ids and "tokens" in event:
+                        # across failovers the LAST backend only knows its
+                        # continuation; the ingress knows the whole run
+                        event["tokens"] = max(int(event["tokens"]),
+                                              len(resume.token_ids))
+                    sse.event(event)
+                    sse.finish()
+                    return
+                if event.get("text_output"):
+                    # empty pieces exist only to carry token_ids promptly
+                    # (held-back UTF-8 tails); the client never sees them
+                    sse.event(event)
+                if chaos is not None:
+                    act = chaos.on_relay_event(backend, resume.key)
+                    if act == "cut":
+                        raise _BackendStreamError(
+                            "chaos: injected mid-stream disconnect")
+
+    # --------------------------------------------------- backend health FSM
+
+    _HEALTH_TTL = 0.5        # active probe cadence per backend
+    _PROBE_TIMEOUT_S = 0.25
+    _FAIL_THRESHOLD = 3      # consecutive failures: suspect -> ejected
+    _EJECT_BASE_S = 1.0      # first ejection duration; doubles per round
+    _EJECT_MAX_S = 30.0
+
+    def _note_backend(self, state: _ProxyState, port: int, ok: bool) -> None:
+        """Passive failure detection: every relay outcome feeds the backend
+        state machine.  Success heals (and closes the breaker); consecutive
+        failures walk healthy → suspect → ejected; a probation failure
+        re-ejects with doubled backoff."""
+        with state.lock:
+            h = state.health.setdefault(port, _BackendHealth())
+            if ok:
+                # a completing IN-FLIGHT relay must not resurrect a
+                # draining backend (its orderly goodbye stands; only a
+                # probe seeing SERVING again — drain cancelled — heals it)
+                if h.state != "draining":
+                    h.state = "healthy"
+                h.fails = 0
+                h.ejections = 0
+            else:
+                h.fails += 1
+                if h.state == "probation" or h.fails >= self._FAIL_THRESHOLD:
+                    self._eject(state, h)
+                elif h.state == "healthy":
+                    h.state = "suspect"
+            self._set_state_gauge(state)
+
+    def _eject(self, state: _ProxyState, h: _BackendHealth) -> None:
+        """Open the breaker (caller holds state.lock): route nothing to this
+        backend until the backoff lapses, then probation."""
+        h.state = "ejected"
+        h.until = time.monotonic() + min(
+            self._EJECT_MAX_S, self._EJECT_BASE_S * (2.0 ** h.ejections))
+        h.ejections += 1
+        h.fails = 0
+        INGRESS_EJECTIONS.inc(service=state.service_name)
+
+    def _set_state_gauge(self, state: _ProxyState) -> None:
+        counts = {s: 0 for s in _BACKEND_STATES}
+        for h in state.health.values():
+            counts[h.state] = counts.get(h.state, 0) + 1
+        for s, n in counts.items():
+            INGRESS_BACKEND_STATE.set(n, service=state.service_name, state=s)
+
+    def _probe_engine_health(self, port: int) -> str:
+        """One active probe: 'ok' | 'draining' | 'dead' | 'fail'.  Backends
+        without the route (non-engine runtimes) count as ok — readiness
+        probes already cover them."""
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/engine/health",
+                    timeout=self._PROBE_TIMEOUT_S) as r:
+                payload = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return "ok"
+            try:
+                payload = json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                return "fail"
+        except Exception:  # noqa: BLE001 — connect error / stall
+            return "fail"
+        st = (payload or {}).get("state", "SERVING")
+        if st in ("SERVING", "DEGRADED"):
+            return "ok"  # DEGRADED still serves; passive detection decides
+        if st == "DRAINING":
+            return "draining"
+        return "dead"
+
+    def _refresh_health(self, state: _ProxyState, ports: list[int]) -> None:
+        """Active probing with the same single-flight-outside-the-lock
+        discipline as the load scrape: claim expired ports, probe unlocked,
+        write transitions back."""
+        claimed = []
+        with state.lock:
+            now = time.monotonic()
+            for p in ports:
+                h = state.health.setdefault(p, _BackendHealth())
+                if (now - h.probed_at >= self._HEALTH_TTL
+                        and p not in state.probing):
+                    state.probing.add(p)
+                    claimed.append(p)
+        if not claimed:
+            return
+        if len(claimed) == 1:
+            results = {claimed[0]: self._probe_engine_health(claimed[0])}
+        else:
+            # probe independently-failing backends concurrently: serial
+            # probing would charge the one claiming request up to
+            # N x _PROBE_TIMEOUT_S of latency before its relay starts
+            results = {}
+
+            def probe(p=None):
+                results[p] = self._probe_engine_health(p)
+
+            ts = [threading.Thread(target=probe, kwargs={"p": p})
+                  for p in claimed]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        with state.lock:
+            now = time.monotonic()
+            for p in claimed:
+                state.probing.discard(p)
+                h = state.health.setdefault(p, _BackendHealth())
+                h.probed_at = now
+                res = results[p]
+                if res == "ok":
+                    # a passing probe confirms the ENGINE is alive; it does
+                    # not erase passive strikes (a backend can report
+                    # SERVING while 500ing requests) and never reopens a
+                    # live breaker — ejection timing is the breaker's.
+                    # It heals probation (the half-open trial) and undoes
+                    # a drain that was cancelled.
+                    if h.state == "probation":
+                        h.state = "healthy"
+                        h.fails = 0
+                        h.ejections = 0
+                    elif h.state == "draining":
+                        h.state = "healthy"
+                elif res == "draining":
+                    # drain is an orderly goodbye, not a failure: stop
+                    # routing but charge no breaker strikes
+                    h.state = "draining"
+                    h.fails = 0
+                elif res == "dead":
+                    # a DEAD engine needs no three strikes
+                    if h.state != "ejected":
+                        self._eject(state, h)
+                else:  # "fail": passive-style strike
+                    h.fails += 1
+                    if (h.state == "probation"
+                            or h.fails >= self._FAIL_THRESHOLD):
+                        self._eject(state, h)
+                    elif h.state == "healthy":
+                        h.state = "suspect"
+            self._set_state_gauge(state)
+
+    def _prune_health(self, state: _ProxyState, ports: list[int],
+                      selector: dict) -> None:
+        """Drop health records for backends that no longer exist: pod churn
+        (rollouts, scale cycles) allocates fresh ports, and keeping the old
+        records would leak one _BackendHealth per port ever seen AND freeze
+        their last state into the ingress_backend_state gauge (phantom
+        'ejected' backends on dashboards).  The keep-set is EVERY pod of
+        the service — all revisions, ready or not, draining included — so
+        a canary request cannot wipe the stable revision's breaker state;
+        records mid-probe are left for the probe writeback to finish."""
+        with state.lock:
+            if len(state.health) <= len(ports):
+                return  # quick out: nothing can be stale
+        keep = {pod_port(p)
+                for p in self.api.list("Pod", namespace=state.namespace,
+                                       label_selector=selector)}
+        keep.discard(None)
+        with state.lock:
+            for p in list(state.health):
+                if p not in keep and p not in state.probing:
+                    del state.health[p]
+            self._set_state_gauge(state)
+
+    def _routable_ports(self, state: _ProxyState, ports: list[int]) -> list[int]:
+        """Ports the state machine allows traffic to: healthy/suspect first;
+        probation backends only as the fallback set (their next request is
+        the breaker's half-open trial); ejected and draining never."""
+        with state.lock:
+            now = time.monotonic()
+            primary, fallback = [], []
+            for p in ports:
+                h = state.health.get(p)
+                if h is None:
+                    primary.append(p)
+                    continue
+                if h.state == "ejected" and now >= h.until:
+                    h.state = "probation"
+                if h.state in ("healthy", "suspect"):
+                    primary.append(p)
+                elif h.state == "probation":
+                    fallback.append(p)
+            self._set_state_gauge(state)
+        return primary or fallback
+
     # ----------------------------------------------------------- backend pick
 
-    def _pick_backend(self, state: _ProxyState, body: Optional[bytes] = None) -> int:
-        svc = self.api.try_get("Service", state.service_name, state.namespace)
+    def _pick_backend(self, state: _ProxyState, body: Optional[bytes] = None,
+                      exclude: frozenset = frozenset(),
+                      svc: Optional[Obj] = None) -> int:
+        # the caller's relay loop passes the Service it already fetched;
+        # a sub-second-stale object is fine here (annotations and selector
+        # churn far slower than requests)
+        if svc is None:
+            svc = self._get_service(state)
         if svc is None:
             raise LookupError(f"service {state.service_name} gone")
         ann = svc["metadata"].get("annotations", {})
@@ -245,12 +766,24 @@ class ServiceProxy:
                 time.sleep(0.05)
             if not pods:
                 raise LookupError(f"no ready backend for {state.service_name} (rev={revision})")
-        if len(pods) > 1:
-            port = self._pick_engine_aware(state, [pod_port(p) for p in pods], body)
+        ports = [pod_port(p) for p in pods]
+        self._prune_health(state, ports, selector)
+        self._refresh_health(state, ports)
+        routable = self._routable_ports(state, ports)
+        if not routable:
+            # the empty-healthy-set fail-fast path: every backend is
+            # ejected (breaker open) or draining — a 503 NOW beats a
+            # doomed relay attempt against a known-bad replica
+            raise LookupError(
+                f"no healthy backend for {state.service_name}: "
+                f"{len(ports)} ready but all ejected/draining")
+        cand = [p for p in routable if p not in exclude] or routable
+        if len(cand) > 1:
+            port = self._pick_engine_aware(state, cand, body)
             if port is not None:
                 return port
         state.rr += 1
-        return pod_port(pods[state.rr % len(pods)])
+        return cand[state.rr % len(cand)]
 
     # engine-aware pick (SURVEY.md §3.4 production QPS; VERDICT r2 #7): with
     # several engine replicas behind one Service, round-robin ignores that
@@ -410,6 +943,10 @@ class ServiceProxy:
             p
             for p in self.api.list("Pod", namespace=ns, label_selector=sel)
             if pod_is_ready(p) and pod_port(p) is not None
+            # draining pods (scale-down victims finishing their in-flight
+            # work, controllers.py) take no NEW traffic — this is the
+            # "stop routing" half of graceful replica drain
+            and DRAINING_ANNOTATION not in p["metadata"].get("annotations", {})
         ]
         return sorted(pods, key=lambda p: p["metadata"]["name"])
 
@@ -441,6 +978,83 @@ class ServiceProxy:
     def shutdown(self) -> None:
         for key in list(self._servers):
             self._stop(key)
+
+
+class _ResumeCtx:
+    """Re-admission state for one resumable client stream: the parsed
+    request payload plus every generated token id relayed so far.  A
+    failover re-submits the ORIGINAL prompt with ``resume_token_ids`` so the
+    new replica re-prefills prompt+generated (a prefix-cache hit when those
+    pages exist) and streams only the continuation."""
+
+    __slots__ = ("payload", "token_ids", "key")
+    _seq = iter(range(1, 2 ** 62))
+
+    def __init__(self, payload: dict):
+        self.payload = payload
+        self.token_ids: list[int] = []
+        # process-unique stream key (id() can be recycled after GC): the
+        # fleet-chaos injector counts streams and events by this
+        self.key = next(self._seq)
+
+    def request_body(self) -> bytes:
+        p = copy.deepcopy(self.payload)
+        params = p.setdefault("parameters", {})
+        if not isinstance(params, dict):
+            params = p["parameters"] = {}
+        params["resume_token_ids"] = list(self.token_ids)
+        return json.dumps(p).encode()
+
+
+class _SSERelay:
+    """Client-side SSE writer for the resumable relay: headers go out
+    lazily (a pre-stream failure can still be a clean HTTP error), events
+    are chunked-framed, and client write failures surface as _ClientGone so
+    the failover loop stops instead of burning replicas for nobody."""
+
+    __slots__ = ("h", "started")
+
+    def __init__(self, handler):
+        self.h = handler
+        self.started = False
+
+    def start(self) -> None:
+        if self.started:
+            return
+        try:
+            self.h.send_response(200)
+            self.h.send_header("Content-Type", "text/event-stream")
+            self.h.send_header("Cache-Control", "no-cache")
+            self.h.send_header("Transfer-Encoding", "chunked")
+            self.h.end_headers()
+        except Exception as e:  # noqa: BLE001
+            raise _ClientGone(str(e)) from e
+        self.started = True
+
+    def event(self, obj: dict) -> None:
+        self.start()
+        data = b"data: " + json.dumps(obj).encode() + b"\n\n"
+        try:
+            self.h._chunk(data)
+        except Exception as e:  # noqa: BLE001
+            raise _ClientGone(str(e)) from e
+
+    def finish(self) -> None:
+        try:
+            self.h.wfile.write(b"0\r\n\r\n")
+            self.h.wfile.flush()
+        except Exception as e:  # noqa: BLE001
+            raise _ClientGone(str(e)) from e
+
+    def error_event(self, msg: str) -> None:
+        """Terminal structured error event (the satellite fix for silent
+        mid-SSE truncation) — best-effort: the client may be gone too."""
+        try:
+            self.event({"error": msg, "done": True})
+            self.finish()
+        except _ClientGone:
+            pass
+        self.h.close_connection = True
 
 
 class Router:

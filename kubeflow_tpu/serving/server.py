@@ -52,6 +52,13 @@ class Model:
     def explain(self, payload: Any, headers: Optional[dict] = None) -> Any:
         raise NotImplementedError(f"model {self.name} has no explainer")
 
+    def health(self) -> dict:
+        """Replica health for the fleet layer (served on GET
+        /engine/health).  Engine-backed models override this with the
+        engine's SERVING/DEGRADED/DRAINING/DEAD state machine; plain
+        models are SERVING once loaded."""
+        return {"state": "SERVING" if self.ready else "DEAD"}
+
     def extra_metrics(self) -> dict:
         """Numeric gauges merged into the server's /metrics output — engine
         models report queue/slot/cache state here so the router can route
@@ -216,12 +223,44 @@ class ModelServer:
                 chunks.append("\n".join(kept) + "\n")
         return "".join(chunks)
 
+    # worst-first ordering of replica health states: a multi-model server
+    # reports the sickest model's state (the proxy ejects on DEAD, drains
+    # on DRAINING, keeps routing on DEGRADED)
+    _HEALTH_ORDER = ("DEAD", "DRAINING", "DEGRADED", "SERVING")
+
+    def _engine_health(self) -> tuple[int, dict]:
+        """Aggregate replica health: per-model states + the worst one.
+        200 while the replica can still serve (SERVING/DEGRADED), 503 once
+        it should stop receiving traffic (DRAINING/DEAD)."""
+        states = {}
+        worst = "SERVING"
+        for name, m in self.models.items():
+            try:
+                hd = m.health()
+            except Exception as e:  # noqa: BLE001 — a probe must answer
+                hd = {"state": "DEAD", "reason": f"{type(e).__name__}: {e}"}
+            states[name] = hd
+            # clamp unknown states to DEAD BEFORE comparing AND assigning:
+            # a custom model returning e.g. "READY" must degrade the
+            # aggregate, not crash the next iteration's index()
+            st = hd.get("state", "DEAD")
+            if st not in self._HEALTH_ORDER:
+                st = "DEAD"
+            if (self._HEALTH_ORDER.index(st)
+                    < self._HEALTH_ORDER.index(worst)):
+                worst = st
+        code = 200 if worst in ("SERVING", "DEGRADED") else 503
+        return code, {"state": worst, "models": states}
+
     def _handle_get(self, h) -> None:
         path = h.path.split("?")[0].rstrip("/")
         if path == "/metrics":
             h._send(200, self._render_metrics(), content_type="text/plain")
         elif path in ("", "/", "/healthz", "/v2/health/live"):
             h._send(200, {"status": "alive"})
+        elif path == "/engine/health":
+            code, body = self._engine_health()
+            h._send(code, body)
         elif path == "/v2/health/ready":
             ready = all(m.ready for m in self.models.values())
             h._send(200 if ready else 503, {"ready": ready})

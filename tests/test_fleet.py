@@ -1,0 +1,684 @@
+"""Fleet fault-tolerance tests (ISSUE 6): backend health state machine,
+router failover with safe re-admission, graceful drain, and the fleet
+chaos harness — all on CPU, in-process.
+
+The headline scenarios (ISSUE 6 acceptance):
+
+  * a replica killed MID-DECODE loses nothing: the ingress re-admits the
+    stream on a healthy replica with ``resume_token_ids`` and the client
+    sees a byte-identical token sequence (no duplicates, no drops);
+  * a mid-stream connection cut (replica survives) reconnects the same way;
+  * ejected/dead backends are skipped by ``_pick_backend`` and traffic
+    recovers when they return, with the empty-healthy-set failing fast;
+  * a backend dying mid-SSE yields a terminal structured error event,
+    never a silent truncation;
+  * autoscaler scrape timeouts are stale samples, unhealthy replicas veto
+    scale-down, and deployment scale-down drains before deleting.
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import pytest
+
+from kubeflow_tpu.core.api import APIServer
+from kubeflow_tpu.serving.api import LABEL_ISVC
+from kubeflow_tpu.serving.controllers import (DRAINING_ANNOTATION,
+                                              POD_PORT_ANNOTATION,
+                                              PROXY_PORT_ANNOTATION)
+from kubeflow_tpu.serving.engine import Engine, EngineConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import FleetChaos, FleetFaultConfig
+from kubeflow_tpu.serving.engine.serve import JetStreamModel
+from kubeflow_tpu.serving.errors import EngineShutdown, RequestError
+from kubeflow_tpu.serving.router import (INGRESS_EJECTIONS, INGRESS_RETRIES,
+                                         RELAY_TIMEOUT_ANNOTATION,
+                                         RETRY_BUDGET_ANNOTATION,
+                                         ServiceProxy, _ProxyState)
+from kubeflow_tpu.serving.server import Model, ModelServer
+from kubeflow_tpu.utils.net import find_free_ports
+
+pytestmark = pytest.mark.fleet
+
+# vocab >= 256: the JetStream byte tokenizer addresses ids 0..255
+CFG = M.DecoderConfig(vocab_size=288, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+def _counter_sum(counter) -> float:
+    return sum(counter.series().values())
+
+
+# ------------------------------------------------------ state machine units
+
+
+def test_backend_state_machine_transitions():
+    proxy = ServiceProxy(APIServer())
+    state = _ProxyState("svc", "default")
+    port = 12345
+    ej0 = _counter_sum(INGRESS_EJECTIONS)
+    # healthy -> suspect on first failure, ejected at the threshold
+    proxy._note_backend(state, port, False)
+    assert state.health[port].state == "suspect"
+    for _ in range(proxy._FAIL_THRESHOLD - 1):
+        proxy._note_backend(state, port, False)
+    h = state.health[port]
+    assert h.state == "ejected" and h.until > time.monotonic()
+    assert _counter_sum(INGRESS_EJECTIONS) == ej0 + 1
+    first_backoff = h.until - time.monotonic()
+    # expiry -> probation (via the routable-set scan)
+    h.until = time.monotonic() - 0.01
+    assert proxy._routable_ports(state, [port]) == [port]
+    assert h.state == "probation"
+    # probation failure -> re-ejected with DOUBLED backoff
+    proxy._note_backend(state, port, False)
+    assert h.state == "ejected"
+    assert h.until - time.monotonic() > 1.5 * first_backoff
+    # success heals and closes the breaker
+    h.until = time.monotonic() - 0.01
+    proxy._routable_ports(state, [port])
+    proxy._note_backend(state, port, True)
+    assert h.state == "healthy" and h.ejections == 0 and h.fails == 0
+
+
+def test_routable_ports_skip_ejected_and_draining():
+    proxy = ServiceProxy(APIServer())
+    state = _ProxyState("svc", "default")
+    for p, st in ((1, "healthy"), (2, "ejected"), (3, "draining"),
+                  (4, "suspect")):
+        proxy._note_backend(state, p, True)
+        state.health[p].state = st
+        state.health[p].until = time.monotonic() + 30
+    assert proxy._routable_ports(state, [1, 2, 3, 4]) == [1, 4]
+    # all unroutable -> empty (the caller fails fast with 503)
+    state.health[1].state = state.health[4].state = "ejected"
+    state.health[1].until = state.health[4].until = time.monotonic() + 30
+    assert proxy._routable_ports(state, [1, 2, 3, 4]) == []
+    # probation backends are the fallback set once a breaker expires
+    state.health[2].until = time.monotonic() - 0.01
+    assert proxy._routable_ports(state, [1, 2, 3, 4]) == [2]
+
+
+def test_fleet_chaos_injector_units():
+    cfg = FleetFaultConfig(kill=(0,), kill_after_tokens=3, slow=(2,),
+                           slow_tick_s=0.033, cut_stream_every=2,
+                           cut_after_events=2)
+    chaos = FleetChaos(cfg)
+    assert chaos.engine_faults(2).slow_tick_every == 1
+    assert chaos.engine_faults(2).slow_tick_s == 0.033
+    assert chaos.engine_faults(0).slow_tick_every == 0
+    fired = []
+    chaos.register_replica(0, 7000, kill_cb=lambda: fired.append("kill"))
+    # stream 1 (key "a"): never cut (odd stream number)
+    assert chaos.on_relay_event(7000, "a") is None
+    assert chaos.on_relay_event(7000, "a") is None
+    assert chaos.on_relay_event(7000, "a") is None  # 3rd token: kill fires
+    time.sleep(0.05)  # callback thread
+    assert fired == ["kill"] and chaos.stats()["kills_fired"] == 1
+    assert chaos.on_relay_event(7000, "a") is None  # one-shot: no refire
+    assert chaos.stats()["kills_fired"] == 1
+    # stream 2 (key "b"): cut exactly once, at its 2nd event
+    assert chaos.on_relay_event(7000, "b") is None
+    assert chaos.on_relay_event(7000, "b") == "cut"
+    assert chaos.on_relay_event(7000, "b") is None  # cut is per-stream once
+    assert chaos.stats()["streams_cut"] == 1
+
+
+# --------------------------------------------------------- proxy selection
+
+
+class _Echo(Model):
+    def predict(self, payload, headers=None):
+        return payload.get("instances", []) if isinstance(payload, dict) else payload
+
+
+class _Failing(Model):
+    """Always-500 backend: the passive-detection + retry substrate."""
+
+    def predict(self, payload, headers=None):
+        raise RuntimeError("injected backend failure")
+
+
+def _mk_service(api, name, svc_port, ann=None):
+    api.create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": name, "labels": {LABEL_ISVC: name},
+                     "annotations": {PROXY_PORT_ANNOTATION: str(svc_port),
+                                     **(ann or {})}},
+        "spec": {"selector": {"app": name}}})
+
+
+def _mk_pod(api, name, app, port):
+    api.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "labels": {"app": app},
+                     "annotations": {POD_PORT_ANNOTATION: str(port)}},
+        "spec": {},
+        "status": {"phase": "Running",
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+def _post(port, path, body, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_pick_backend_skips_ejected_and_fails_fast(monkeypatch):
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    monkeypatch.setattr(ServiceProxy, "_HEALTH_TTL", 1e9)  # no active probes
+    srv_a = ModelServer([_Echo("m")], port=0)
+    srv_b = ModelServer([_Echo("m")], port=0)
+    srv_a.start()
+    srv_b.start()
+    try:
+        _mk_service(api, "svc", find_free_ports(1)[0])
+        _mk_pod(api, "svc-0", "svc", srv_a.port)
+        _mk_pod(api, "svc-1", "svc", srv_b.port)
+        state = _ProxyState("svc", "default")
+        # eject A: every pick lands on B
+        proxy._note_backend(state, srv_a.port, True)
+        state.health[srv_a.port].state = "ejected"
+        state.health[srv_a.port].until = time.monotonic() + 30
+        for _ in range(4):
+            assert proxy._pick_backend(state) == srv_b.port
+        # eject B too: empty healthy set fails fast
+        proxy._note_backend(state, srv_b.port, True)
+        state.health[srv_b.port].state = "ejected"
+        state.health[srv_b.port].until = time.monotonic() + 30
+        with pytest.raises(LookupError, match="ejected"):
+            proxy._pick_backend(state)
+        # A's breaker expires -> probation fallback carries traffic again
+        state.health[srv_a.port].until = time.monotonic() - 0.01
+        assert proxy._pick_backend(state) == srv_a.port
+        # and a success heals it back to healthy
+        proxy._note_backend(state, srv_a.port, True)
+        assert state.health[srv_a.port].state == "healthy"
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_unary_failover_retries_to_healthy_backend():
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    srv_bad = ModelServer([_Failing("m")], port=0)
+    srv_ok = ModelServer([_Echo("m")], port=0)
+    srv_bad.start()
+    srv_ok.start()
+    svc_port = find_free_ports(1)[0]
+    try:
+        _mk_service(api, "svc", svc_port)
+        _mk_pod(api, "svc-0", "svc", srv_bad.port)
+        _mk_pod(api, "svc-1", "svc", srv_ok.port)
+        proxy.sync()
+        r0 = _counter_sum(INGRESS_RETRIES)
+        # every request lands a 200 even when the RR pick hits the 500ing
+        # backend first (retry against the healthy one)
+        for i in range(6):
+            code, out = _post(svc_port, "/v1/models/m:predict",
+                              {"instances": [i]})
+            assert code == 200 and out == {"predictions": [i]}
+        assert _counter_sum(INGRESS_RETRIES) > r0
+        # the failing backend accumulated strikes and is ejected: traffic
+        # keeps flowing without paying its 500s
+        code, out = _post(svc_port, "/v1/models/m:predict", {"instances": [9]})
+        assert code == 200 and out == {"predictions": [9]}
+    finally:
+        proxy.shutdown()
+        srv_bad.stop()
+        srv_ok.stop()
+
+
+# ------------------------------------------------- engine fleets (streams)
+
+
+def _mk_fleet(params, n, chaos=None, ann=None, max_slots=4):
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    proxy.chaos = chaos
+    svc_port = find_free_ports(1)[0]
+    _mk_service(api, "fleet", svc_port,
+                ann={RELAY_TIMEOUT_ANNOTATION: "2.0", **(ann or {})})
+    engines, servers = [], []
+    for i in range(n):
+        ec = EngineConfig(max_slots=max_slots, page_size=8, num_pages=96,
+                          max_pages_per_slot=24,
+                          chaos=(chaos.engine_faults(i) if chaos else None))
+        eng = Engine(params, CFG, ec)
+        srv = ModelServer([JetStreamModel("fleet", "", engine=eng)], port=0)
+        srv.start()
+        _mk_pod(api, f"fleet-{i}", "fleet", srv.port)
+        engines.append(eng)
+        servers.append(srv)
+    proxy.sync()
+    return api, proxy, svc_port, engines, servers
+
+
+def _teardown_fleet(proxy, engines, servers):
+    proxy.shutdown()
+    for srv in servers:
+        srv.stop()
+    for eng in engines:
+        try:
+            eng.stop(drain=False)
+        except Exception:  # noqa: BLE001 — already dead
+            pass
+
+
+def _stream(port, prompt, mt, timeout=60):
+    """Client-side SSE read of /generate_stream: (text, events, final)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v2/models/fleet/generate_stream",
+        data=json.dumps({"text_input": prompt,
+                         "parameters": {"max_tokens": mt}}).encode(),
+        headers={"Content-Type": "application/json"})
+    pieces, events, final, buf = [], [], None, b""
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        while True:
+            chunk = r.read1(65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                for line in raw.splitlines():
+                    if not line.startswith(b"data:"):
+                        continue
+                    ev = json.loads(line[5:].strip())
+                    events.append(ev)
+                    if ev.get("done") and "error" not in ev:
+                        final = ev
+                    elif "error" not in ev and ev.get("text_output"):
+                        pieces.append(ev["text_output"])
+    return "".join(pieces), events, final
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog"
+
+
+def _warm(servers, mt=4):
+    for srv in servers:
+        _stream(srv.port, PROMPT, mt)
+        _stream(srv.port, PROMPT + "x" * 24, mt)
+
+
+def test_stream_failover_replica_killed_mid_decode(params):
+    # reference text from an unchaosed fleet
+    api, proxy, svc_port, engines, servers = _mk_fleet(params, 2)
+    try:
+        _warm(servers)
+        ref, _, ref_final = _stream(svc_port, PROMPT, 20)
+        assert ref_final["tokens"] == 20
+    finally:
+        _teardown_fleet(proxy, engines, servers)
+
+    chaos = FleetChaos(FleetFaultConfig(kill=(0, 1), kill_after_tokens=6))
+    api, proxy, svc_port, engines, servers = _mk_fleet(params, 2, chaos)
+    # ONE victim — whichever replica serves 6 relayed tokens first dies
+    # (routing decides who that is); the guard keeps the failover target
+    # alive when ITS relayed count later crosses the threshold too
+    killed = []
+
+    def kill_maker(i):
+        def cb():
+            if not killed:
+                killed.append(i)
+                engines[i].stop(drain=False)
+        return cb
+
+    for i, srv in enumerate(servers):
+        chaos.register_replica(i, srv.port, kill_cb=kill_maker(i))
+    try:
+        _warm(servers)
+        txt, events, final = _stream(svc_port, PROMPT, 20)
+        assert len(killed) == 1
+        # byte-level continuity: no duplicated, no dropped tokens
+        assert txt == ref
+        assert final["tokens"] == 20
+        assert not any("error" in e for e in events)
+        # the victim is DEAD, the survivor leaked nothing
+        victim, survivor = killed[0], 1 - killed[0]
+        assert engines[victim].health()["state"] == "DEAD"
+        s = engines[survivor].stats
+        assert (96 - 1) - s["free_pages"] - s["cached_pages"] == 0
+    finally:
+        _teardown_fleet(proxy, engines, servers)
+
+
+def test_stream_cut_mid_flight_reconnects_token_exact(params):
+    api, proxy, svc_port, engines, servers = _mk_fleet(params, 2)
+    try:
+        _warm(servers)
+        ref, _, _ = _stream(svc_port, PROMPT, 16)
+    finally:
+        _teardown_fleet(proxy, engines, servers)
+
+    chaos = FleetChaos(FleetFaultConfig(cut_stream_every=1,
+                                        cut_after_events=4))
+    api, proxy, svc_port, engines, servers = _mk_fleet(params, 2, chaos)
+    try:
+        _warm(servers)
+        txt, events, final = _stream(svc_port, PROMPT, 16)
+        assert chaos.stats()["streams_cut"] == 1
+        assert txt == ref and final["tokens"] == 16
+    finally:
+        _teardown_fleet(proxy, engines, servers)
+
+
+def test_stream_terminal_error_event_when_fleet_exhausted(params):
+    """Satellite: a stream with no failover target ends with a STRUCTURED
+    error event — never a silent truncation that parses as success."""
+    chaos = FleetChaos(FleetFaultConfig(kill=(0,), kill_after_tokens=4))
+    api, proxy, svc_port, engines, servers = _mk_fleet(
+        params, 1, chaos, ann={RETRY_BUDGET_ANNOTATION: "1"})
+    chaos.register_replica(0, servers[0].port,
+                           kill_cb=lambda: engines[0].stop(drain=False))
+    try:
+        _warm(servers)
+        txt, events, final = _stream(svc_port, PROMPT, 32)
+        assert final is None  # no clean done record ...
+        assert events and "error" in events[-1]  # ... but a terminal event
+        assert events[-1].get("done") is True
+    finally:
+        _teardown_fleet(proxy, engines, servers)
+
+
+def test_nonresumable_sse_truncation_emits_error_event():
+    """The generic (non-engine) SSE passthrough: a backend connection that
+    RESETS mid-stream yields a terminal error event to the client."""
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(b'data: {"text_output": "hi"}\n\n')
+            self.wfile.flush()
+            time.sleep(0.2)  # let the proxy relay the event first
+            # hard RST (SO_LINGER 0): the proxy's read raises instead of
+            # seeing a clean EOF
+            self.connection.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                struct.pack("ii", 1, 0))
+            self.connection.close()
+            self.close_connection = True
+
+    backend = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=backend.serve_forever, daemon=True).start()
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    svc_port = find_free_ports(1)[0]
+    _mk_service(api, "svc", svc_port)
+    _mk_pod(api, "svc-0", "svc", backend.server_address[1])
+    proxy.sync()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc_port}/v1/models/m:predict",
+            data=b"{}", headers={"Content-Type": "application/json"})
+        events = []
+        with urllib.request.urlopen(req, timeout=30) as r:
+            buf = b""
+            while True:
+                try:
+                    chunk = r.read1(65536)
+                except Exception:  # noqa: BLE001
+                    break
+                if not chunk:
+                    break
+                buf += chunk
+            for raw in buf.split(b"\n\n"):
+                for line in raw.splitlines():
+                    if line.startswith(b"data:"):
+                        events.append(json.loads(line[5:].strip()))
+        assert events[0] == {"text_output": "hi"}
+        assert "error" in events[-1] and events[-1].get("done") is True
+    finally:
+        proxy.shutdown()
+        backend.shutdown()
+        backend.server_close()
+
+
+# ------------------------------------------------ engine drain + health HTTP
+
+
+def test_engine_health_endpoint_and_begin_drain(params):
+    ec = EngineConfig(max_slots=2, page_size=8, num_pages=64,
+                      max_pages_per_slot=16)
+    eng = Engine(params, CFG, ec)
+    srv = ModelServer([JetStreamModel("m", "", engine=eng)], port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/engine/health", timeout=5) as r:
+            body = json.loads(r.read())
+        assert r.status == 200 and body["state"] == "SERVING"
+        assert body["models"]["m"]["state"] == "SERVING"
+
+        # drain: in-flight finishes, new work refused, health says DRAINING
+        fut = eng.generate_async([1, 2, 3], 12)
+        eng.begin_drain()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/engine/health", timeout=5)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["state"] == "DRAINING"
+        with pytest.raises(EngineShutdown):
+            eng.generate_async([4, 5], 4)
+        r = fut.result(timeout=60)  # the in-flight request still completes
+        assert r["num_tokens"] == 12
+        # cancel_drain reopens admission
+        eng.cancel_drain()
+        assert eng.health()["state"] == "SERVING"
+        assert eng.generate([1, 2], 2)["num_tokens"] == 2
+        eng.stop()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/engine/health", timeout=5)
+        assert json.loads(exc.value.read())["state"] == "DEAD"
+    finally:
+        srv.stop()
+        try:
+            eng.stop(drain=False)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def test_resume_token_ids_continuation(params):
+    """serve-level re-admission contract: resume_token_ids folds into the
+    prompt, the stream emits ONLY the continuation, and the final record
+    counts the whole generation."""
+    ec = EngineConfig(max_slots=2, page_size=8, num_pages=64,
+                      max_pages_per_slot=16)
+    eng = Engine(params, CFG, ec)
+    eng.start()
+    model = JetStreamModel("m", "", engine=eng)
+    try:
+        full = model.generate({"text_input": PROMPT,
+                               "parameters": {"max_tokens": 16}})
+        assert full["tokens"] == 16
+        cut = 7
+        resumed = model.generate_stream(
+            {"text_input": PROMPT,
+             "parameters": {"max_tokens": 16,
+                            "resume_token_ids": full["token_ids"][:cut]}},
+            headers={"X-Stream-Resume": "1"})
+        events = list(resumed)
+        final = events[-1]
+        assert final["done"] and final["tokens"] == 16
+        new_ids = [i for e in events for i in e.get("token_ids", [])]
+        assert new_ids == full["token_ids"][cut:]
+        # degenerate resume: everything was already generated
+        done_events = list(model.generate_stream(
+            {"text_input": PROMPT,
+             "parameters": {"max_tokens": 16,
+                            "resume_token_ids": full["token_ids"]}},
+            headers={"X-Stream-Resume": "1"}))
+        assert done_events[-1]["done"] and done_events[-1]["tokens"] == 16
+        with pytest.raises(RequestError, match="resume_token_ids"):
+            model._parse_generate({"text_input": "x",
+                                   "parameters":
+                                   {"resume_token_ids": ["a", -1]}})
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------- autoscaler + drain control
+
+
+def _mk_deploy(api, name, replicas, ann=None):
+    from kubeflow_tpu.serving.api import TARGET_CONCURRENCY_ANNOTATION
+
+    return api.create({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": name,
+                     "annotations": {TARGET_CONCURRENCY_ANNOTATION: "4",
+                                     **(ann or {})}},
+        "spec": {"replicas": replicas,
+                 "selector": {"matchLabels": {"app": name}},
+                 "template": {"metadata": {"labels": {"app": name}},
+                              "spec": {"containers": [
+                                  {"name": "c", "command": ["x"]}]}}}})
+
+
+def test_autoscaler_stale_sample_and_unhealthy_veto(monkeypatch):
+    from kubeflow_tpu.serving import autoscaler as asc
+
+    api = APIServer()
+    a = asc.ConcurrencyAutoscaler(api, scrape_timeout=0.05)
+    _mk_deploy(api, "d", 2, ann={asc.SCRAPE_TIMEOUT_ANNOTATION: "0.07"})
+    for i in range(2):
+        _mk_pod(api, f"d-{i}", "d", 9000 + i)
+    monkeypatch.setattr(asc, "SCALE_DOWN_WINDOW", 0.0)
+
+    seen_timeouts = []
+    samples = {9000: {"inflight_requests": 0.0, "engine_serving": 1.0},
+               9001: {"inflight_requests": 0.0, "engine_serving": 1.0}}
+
+    def fake_scrape(port, timeout=asc.DEFAULT_SCRAPE_TIMEOUT_S):
+        seen_timeouts.append(timeout)
+        return samples.get(port)
+
+    monkeypatch.setattr(asc, "scrape_metrics", fake_scrape)
+    # healthy + idle: scale-down proceeds (needs two syncs: window start,
+    # then past the zeroed window)
+    a.sync()
+    changed = a.sync()
+    assert changed
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 1
+    # the per-deployment annotation overrode the constructor timeout
+    assert seen_timeouts and all(t == 0.07 for t in seen_timeouts)
+
+    # unhealthy replica: scale-down vetoed even at zero load
+    api.patch("Deployment", "d", {"spec": {"replicas": 2}})
+    samples[9001]["engine_serving"] = 0.0
+    a.sync()
+    assert not a.sync()
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 2
+
+    # scrape timeout right after a good sample: the cached reading stands
+    # in (stale sample) — the pod is NOT treated as a zero reading
+    samples[9001]["engine_serving"] = 1.0
+    a.sync()  # caches both samples + opens the (zeroed) downscale window
+    samples[9001] = None
+    assert a.sync()  # still scales down, on the cached sample
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 1
+
+    # past the staleness window, the pod is unscraped: veto again
+    api.patch("Deployment", "d", {"spec": {"replicas": 2}})
+    monkeypatch.setattr(asc, "STALE_SAMPLE_WINDOW_S", 0.0)
+    a.sync()
+    assert not a.sync()
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 2
+
+
+def test_scale_down_drains_pod_before_delete():
+    from kubeflow_tpu.core.controller import Request
+    from kubeflow_tpu.serving.controllers import DeploymentReconciler
+
+    api = APIServer()
+    rec = DeploymentReconciler(api)
+    _mk_deploy(api, "d", 2)
+    req = Request(name="d", namespace="default")
+    rec.reconcile(req)
+    pods = api.list("Pod", label_selector={"app": "d"})
+    assert len(pods) == 2
+    for p in pods:  # the unit kubelet: mark running so probes say ready
+        p["status"] = {"phase": "Running"}
+        api.update_status(p)
+    rec.reconcile(req)
+
+    api.patch("Deployment", "d", {"spec": {"replicas": 1}})
+    rec.reconcile(req)
+    # first pass MARKS the victim draining — it must still exist
+    pods = {p["metadata"]["name"]: p
+            for p in api.list("Pod", label_selector={"app": "d"})}
+    assert len(pods) == 2
+    victim = pods["d-1"]
+    assert DRAINING_ANNOTATION in victim["metadata"]["annotations"]
+    # the router refuses to route to a draining pod
+    proxy = ServiceProxy(api)
+    assert [p["metadata"]["name"]
+            for p in proxy._ready_pods("default", {"app": "d"}, None)] \
+        == ["d-0"]
+    # an UNREACHABLE victim is unknown, not drained: it must survive until
+    # the drain timeout, never be deleted on a failed scrape
+    rec.reconcile(req)
+    assert len(api.list("Pod", label_selector={"app": "d"})) == 2
+    # cancelled scale-down: replicas bounce back up → the victim is
+    # UN-marked and rejoins the routable set
+    api.patch("Deployment", "d", {"spec": {"replicas": 2}})
+    rec.reconcile(req)
+    victim = api.get("Pod", "d-1")
+    assert DRAINING_ANNOTATION not in victim["metadata"]["annotations"]
+    assert len(proxy._ready_pods("default", {"app": "d"}, None)) == 2
+    # scale down again; this time the victim provably reports idle
+    # (a live /metrics endpoint with zero in-flight) → mark, then delete
+    api.patch("Deployment", "d", {"spec": {"replicas": 1}})
+    rec.reconcile(req)  # marks
+    from kubeflow_tpu.serving.controllers import pod_port as _pp
+
+    class _Idle(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b"inflight_requests 0\n"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    idle = ThreadingHTTPServer(
+        ("127.0.0.1", _pp(api.get("Pod", "d-1"))), _Idle)
+    threading.Thread(target=idle.serve_forever, daemon=True).start()
+    try:
+        rec.reconcile(req)  # scrape says idle → deleted
+        names = [p["metadata"]["name"]
+                 for p in api.list("Pod", label_selector={"app": "d"})]
+        assert names == ["d-0"]
+    finally:
+        idle.shutdown()
+        idle.server_close()

@@ -441,7 +441,14 @@ def test_isvc_scale_to_zero_and_activation(scluster):
         deploys = c.api.list("Deployment", label_selector={sapi.LABEL_ISVC: "zero"})
         return deploys and all(d["spec"]["replicas"] == 0 for d in deploys)
     assert c.wait_for(scaled_to_zero, timeout=60), _debug(c, "zero")
-    assert not [p for p in c.api.list("Pod") if p["metadata"]["labels"].get(sapi.LABEL_ISVC) == "zero"]
+
+    # graceful drain (README "Fleet robustness"): the victim pod is marked
+    # draining first (router stops routing), then deleted once idle — so
+    # the pods disappear a reconcile cycle after spec.replicas hits 0
+    def pods_gone():
+        return not [p for p in c.api.list("Pod")
+                    if p["metadata"]["labels"].get(sapi.LABEL_ISVC) == "zero"]
+    assert c.wait_for(pods_gone, timeout=30), _debug(c, "zero")
     # isvc stays Ready while scaled to zero
     deploys = c.api.list("Deployment", label_selector={sapi.LABEL_ISVC: "zero"})
     assert deploys[0]["metadata"]["annotations"].get(SCALED_TO_ZERO_ANNOTATION) == "true"
