@@ -598,6 +598,31 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["overlap"] = {"error": str(e)[:200]}
     try:
+        # sessions sidebar: serving_bench --sessions's headline
+        # (BENCH_SESSIONS.json) — warm-vs-cold TTFT per tier is the tiered-
+        # KV payoff, the identity/leak/reconcile flags are the durability
+        # acceptance invariants, chaos shows storage faults degrading
+        se_path = os.path.join(REPO, "BENCH_SESSIONS.json")
+        if os.path.exists(se_path):
+            with open(se_path) as f:
+                se = json.loads(f.readline())
+            out["sessions"] = {
+                "warm_ttft_p50_s": se.get("warm_ttft_p50_s"),
+                "warm_speedup_x": se.get("warm_speedup_x"),
+                "warm_ttft_lt_cold": se.get("warm_ttft_lt_cold"),
+                "byte_identical_vs_uninterrupted":
+                    se.get("byte_identical_vs_uninterrupted"),
+                "chaos_completed": se.get("chaos", {}).get("completed"),
+                "chaos_degraded_restores":
+                    se.get("chaos", {}).get("degraded_restores"),
+                "kv_pages_leaked": se.get("kv_pages_leaked"),
+                "budgets_reconciled_at_drain":
+                    se.get("budgets_reconciled_at_drain"),
+                "platform": se.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["sessions"] = {"error": str(e)[:200]}
+    try:
         # fleet-robustness sidebar: serving_bench --fleet-chaos's headline
         # (BENCH_FLEET.json) — completion + byte-continuity across replica
         # kill/hang/disconnect failover, survivor leak audit, p99 penalty,

@@ -190,6 +190,21 @@ JOBS = [
                                   "--out",
                                   os.path.join(REPO, "BENCH_FLEET.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # sessions on a real chip (ISSUE 7): multi-turn replay over the tiered
+    # KV store — on TPU the cold baseline re-prefills at real HBM rates, so
+    # warm-vs-cold TTFT here measures the genuine restore payoff (host-RAM
+    # scatter + disk read vs chip prefill FLOPs), with the byte-identity,
+    # leak and budget-reconcile gates asserted at device speed; refreshes
+    # BENCH_SESSIONS.json
+    {"name": "serving_sessions_tiny",
+     "cmd": _serving_cmd("tiny", ["--sessions", "--requests", "4",
+                                  "--concurrency", "4",
+                                  "--prompt-len", "192",
+                                  "--max-tokens", "16",
+                                  "--out",
+                                  os.path.join(REPO,
+                                               "BENCH_SESSIONS.json")]),
+     "timeout": 1500, "first_timeout": 900},
     # 12. multi-LoRA mixed-batch overhead on chip (r4 feature): 1b config,
     #     4 adapters round-robin vs the plain 1b row above
     {"name": "serving_1b_lora4",

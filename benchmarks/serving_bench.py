@@ -332,13 +332,13 @@ def _run_overlap(args, config, params, lora) -> None:
             tensor_parallel=args.tensor_parallel,
             paged_kernel=args.paged_kernel or None,
             kv_quant=args.kv_quant, weight_quant=args.weight_quant,
-            # swap-mode preemption restores the EXACT evicted KV, so the
-            # chaos pass compares byte-for-byte against the uncontended
-            # oracle no matter where the storm lands; recompute-resume can
-            # legitimately flip an exact bf16 logit tie through the padded
-            # re-prefill path (the PR 3 tie caveat) and would make this
-            # acceptance check flaky
-            scheduler=SchedulerConfig(swap_policy="swap"),
+            # "auto" mixes swap restores and recompute-resumes: both are
+            # byte-identical to the uncontended oracle now that greedy
+            # ties break deterministically in-kernel (lowest token id) —
+            # the old "recompute can flip an exact bf16 tie through the
+            # padded re-prefill path" caveat no longer applies
+            scheduler=SchedulerConfig(swap_policy="auto",
+                                      swap_min_tokens=args.prompt_len),
             chaos=(FaultConfig(seed=0, preempt_every=9) if chaos else None),
         )
         eng = Engine(params, config, ec, lora=lora)
@@ -593,17 +593,317 @@ def _run_slo(args, config, params, lora) -> None:
                          f"qos={qos['kv_pages_leaked']}")
 
 
+def _run_sessions(args, config, params, lora) -> None:
+    """Session-replay scenario (ISSUE 7): the same multi-turn conversations
+    replayed five ways —
+
+      * **reference** (the "uninterrupted run"): ONE persistent engine, no
+        sessions — the device prefix cache keeps each turn's prefix pages
+        HBM-resident, so this is the trajectory an engine that never
+        dropped the KV would produce.  The byte-identity oracle.
+      * **cold**: every turn on a FRESH engine (empty cache, no sessions)
+        — the honest full-re-prefill TTFT baseline;
+      * **host-warm**: one engine, turns carry a ``session_id``, the prior
+        turn's KV restores from the host tier;
+      * **disk-warm**: a fresh engine PER TURN sharing one ``disk_dir`` —
+        every warm turn exercises full restart recovery (manifest replay +
+        checksummed disk restore);
+      * **chaos**: the disk-warm protocol under seeded storage faults
+        (torn writes + bit flips + slow disk): every turn still completes,
+        degraded restores falling back to re-prefill.
+
+    Warm restores must be BYTE-IDENTICAL to the reference: the store
+    hands back the exact bytes the prefix cache would have kept resident,
+    and page-aligned turn geometry (below) makes the warm prefill the
+    same chunked computation.  The cold pass is a different computation
+    graph (single-shot padded prefill), so it is the latency baseline,
+    not the identity oracle — bf16 near-ties may legally differ there,
+    exactly as they may against any other engine's cold run.
+
+    Headlines: warm-turn TTFT p50 per tier vs cold (warm must win),
+    byte-identity of every host/disk restore vs reference, chaos
+    completion 100%, 0 leaked KV pages, and tier budgets reconciling to
+    zero after the sessions are dropped.  Results land in
+    BENCH_SESSIONS.json via --out."""
+    import json as _json
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import (Engine, EngineConfig,
+                                             KVStoreConfig)
+    from kubeflow_tpu.serving.engine.faults import StorageFaultConfig
+
+    page_size = 32
+    turns = 3
+    n_sessions = max(2, min(args.requests, args.concurrency))
+    # page-aligned turn geometry: prompt_len and (reply + new text) are
+    # page multiples, so a turn's full prompt pages == the session's
+    # pinned coverage == the reference's cache coverage — every warm path
+    # resumes at the SAME offset through the SAME chunked-prefill graph,
+    # which is what makes bit-exact comparison against the reference fair
+    prompt_len = -(-args.prompt_len // page_size) * page_size
+    new_per_turn = (-(-(args.prompt_len // 2 + args.max_tokens)
+                      // page_size) * page_size) - args.max_tokens
+    # final-turn prompt = base + (turns-1) * (reply + new text)
+    max_ctx = (prompt_len
+               + (turns - 1) * (args.max_tokens + new_per_turn)
+               + args.max_tokens)
+    pages_per_slot = max_ctx // page_size + 2
+    ec_base = dict(
+        max_slots=args.concurrency, page_size=page_size,
+        num_pages=max(256, args.concurrency * pages_per_slot + 8),
+        max_pages_per_slot=pages_per_slot,
+        tensor_parallel=args.tensor_parallel,
+        paged_kernel=args.paged_kernel or None,
+        kv_quant=args.kv_quant, weight_quant=args.weight_quant)
+    rng = np.random.default_rng(0)
+    base_prompts = [rng.integers(1, config.vocab_size,
+                                 size=prompt_len).tolist()
+                    for _ in range(n_sessions)]
+    new_tokens = [[rng.integers(1, config.vocab_size,
+                                size=new_per_turn).tolist()
+                   for _ in range(turns - 1)] for _ in range(n_sessions)]
+
+    # reference trajectory drives EVERY pass's prompts (teacher-forced
+    # conversation): turn t's prompt is identical across protocols, so
+    # token comparisons and TTFTs are same-input throughout
+    ref_ctxs: list = None  # filled by the reference replay
+
+    def replay(mode: str, disk_dir=None, chaos=None):
+        """One full replay of every conversation; returns per-(session,
+        turn) token trajectories + TTFTs + bookkeeping."""
+        nonlocal ref_ctxs
+        kv = KVStoreConfig(disk_dir=disk_dir, chaos=chaos) if disk_dir \
+            else None
+        building_ref = mode == "reference"
+        ctxs = list(base_prompts)
+        if building_ref:
+            ref_ctxs = [[list(base_prompts[i])] for i in range(n_sessions)]
+        toks = [[] for _ in range(n_sessions)]
+        ttfts = [[] for _ in range(n_sessions)]
+        restores = []
+        leaked = 0
+        verify_fails = 0
+        eng = None
+
+        def fresh():
+            e = Engine(params, config,
+                       EngineConfig(**ec_base, kv_store=kv), lora=lora)
+            e.start()
+            return e
+
+        if mode in ("host", "reference"):
+            eng = fresh()
+        for t in range(turns):
+            if mode in ("cold", "disk", "chaos"):
+                eng = fresh()  # cold device cache; disk modes = restart
+            for i in range(n_sessions):
+                prompt = (ctxs[i] if building_ref else ref_ctxs[i][t])
+                sid = (f"conv-{i}" if mode in ("host", "disk", "chaos")
+                       else None)
+                r = eng.generate(prompt, args.max_tokens, session_id=sid)
+                toks[i].append(r["tokens"])
+                ttfts[i].append(r["ttft_s"])
+                if sid is not None:
+                    restores.append(r["session"]["restore"])
+                if building_ref and t < turns - 1:
+                    ctxs[i] = ctxs[i] + r["tokens"] + new_tokens[i][t]
+                    ref_ctxs[i].append(list(ctxs[i]))
+            if mode in ("cold", "disk", "chaos"):
+                s = eng.stats
+                leaked += ((eng.ec.num_pages - 1) - s["free_pages"]
+                           - s["cached_pages"])
+                verify_fails += s["kv_verify_failures"]
+                eng.stop()
+        stats = {}
+        if mode in ("host", "reference"):
+            for i in range(n_sessions):
+                eng.drop_session(f"conv-{i}")
+            stats = eng.stats
+            leaked = ((eng.ec.num_pages - 1) - stats["free_pages"]
+                      - stats["cached_pages"])
+            eng.stop()
+        elif mode in ("disk", "chaos"):
+            # final audit pass: a fresh engine sees the manifest; dropping
+            # every session must reconcile both tiers to zero
+            eng = Engine(params, config,
+                         EngineConfig(**ec_base, kv_store=kv), lora=lora)
+            for sid in list(eng.sessions()):
+                eng.drop_session(sid)
+            stats = eng.stats
+            eng.stop(drain=False)  # never started; frees the native core
+        return {"tokens": toks, "ttfts": ttfts, "restores": restores,
+                "leaked": int(leaked), "verify_fails": int(verify_fails),
+                "stats": stats}
+
+    # warmup WITH sessions: compiles every prefill bucket/chunk shape,
+    # the decode shape, AND the per-coverage pin-gather/restore-scatter
+    # executables, so no measured turn pays a jit compile
+    warm_dir = tempfile.mkdtemp(prefix="bench_sess_warm_")
+    warm = Engine(params, config,
+                  EngineConfig(**ec_base,
+                               kv_store=KVStoreConfig(disk_dir=warm_dir)),
+                  lora=lora)
+    warm.start()
+    ctx = list(base_prompts[0])
+    for t in range(turns):
+        r = warm.generate(ctx, args.max_tokens, session_id="warmup")
+        if t < turns - 1:
+            ctx = ctx + r["tokens"] + new_tokens[0][t]
+    warm.stop()
+    shutil.rmtree(warm_dir, ignore_errors=True)
+
+    reference = replay("reference")
+    cold = replay("cold")
+    host_dir = tempfile.mkdtemp(prefix="bench_sess_")
+    host = replay("host", disk_dir=host_dir)
+    disk_dir = tempfile.mkdtemp(prefix="bench_sess_")
+    disk = replay("disk", disk_dir=disk_dir)
+    chaos_dir = tempfile.mkdtemp(prefix="bench_sess_")
+    chaos_cfg = StorageFaultConfig(seed=0, torn_write_every=5,
+                                   bit_flip_every=4, slow_read_s=0.002,
+                                   slow_read_every=2)
+    chaos = replay("chaos", disk_dir=chaos_dir, chaos=chaos_cfg)
+
+    def warm_ttft_p50(rec):
+        # turns >= 1 only: turn 0 is cold for every protocol
+        vals = [rec["ttfts"][i][t] for i in range(n_sessions)
+                for t in range(1, turns)]
+        return round(float(np.percentile(vals, 50)), 4)
+
+    ident = {
+        name: rec["tokens"] == reference["tokens"]
+        for name, rec in (("host", host), ("disk", disk))
+    }
+    # chaos identity applies to the turns that actually RESTORED; degraded
+    # turns re-prefill through the cold graph, where bf16 near-ties may
+    # legally differ (same caveat as the cold pass itself)
+    warm_idx = [k for k, r in enumerate(chaos["restores"])
+                if r in ("host", "disk")]
+    # restores[k] was recorded at flat index k = turn * n_sessions + i
+    chaos_flat = [chaos["tokens"][i][t] for t in range(turns)
+                  for i in range(n_sessions)]
+    ref_flat = [reference["tokens"][i][t] for t in range(turns)
+                for i in range(n_sessions)]
+    ident["chaos_restored_turns"] = all(
+        chaos_flat[k] == ref_flat[k] for k in warm_idx)
+    ttft_ref = warm_ttft_p50(reference)
+    ttft_cold = warm_ttft_p50(cold)
+    ttft_host = warm_ttft_p50(host)
+    ttft_disk = warm_ttft_p50(disk)
+    ttft_chaos = warm_ttft_p50(chaos)
+    degraded = sum(1 for r in chaos["restores"] if r == "degraded")
+    # a warm-turn "cold" under chaos = the pin itself was lost (ENOSPC
+    # class): the turn started over rather than restoring a corrupt blob
+    cold_warm_turns = sum(1 for k, r in enumerate(chaos["restores"])
+                          if r == "cold" and k >= n_sessions)
+    leaked = (reference["leaked"] + cold["leaked"] + host["leaked"]
+              + disk["leaked"] + chaos["leaked"])
+    reconciled = all(
+        rec["stats"].get("kv_host_used_bytes", 0) == 0
+        and rec["stats"].get("kv_disk_used_bytes", 0) == 0
+        and rec["stats"].get("swap_used_bytes", 0) == 0
+        for rec in (host, disk, chaos))
+    completed = all(len(rec["tokens"][i]) == turns
+                    and all(len(tt) == args.max_tokens
+                            for tt in rec["tokens"][i])
+                    for rec in (host, disk, chaos)
+                    for i in range(n_sessions))
+    out = {
+        "metric": f"sessions_replay_{args.config}",
+        "sessions": n_sessions,
+        "turns": turns,
+        "prompt_len": prompt_len,
+        "new_tokens_per_turn": new_per_turn,
+        "max_tokens": args.max_tokens,
+        "final_context_len": max_ctx - args.max_tokens,
+        "warm_ttft_p50_s": {"cold": ttft_cold, "device_cache": ttft_ref,
+                            "host": ttft_host, "disk": ttft_disk,
+                            "disk_chaos": ttft_chaos},
+        "warm_speedup_x": {
+            "host": round(ttft_cold / max(1e-9, ttft_host), 2),
+            "disk": round(ttft_cold / max(1e-9, ttft_disk), 2)},
+        "warm_ttft_lt_cold": ttft_host < ttft_cold and ttft_disk < ttft_cold,
+        "byte_identical_vs_uninterrupted": ident,
+        "cold_matches_reference": cold["tokens"] == reference["tokens"],
+        "restores": {
+            "host_pass": {r: host["restores"].count(r)
+                          for r in sorted(set(host["restores"]))},
+            "disk_pass": {r: disk["restores"].count(r)
+                          for r in sorted(set(disk["restores"]))},
+            "chaos_pass": {r: chaos["restores"].count(r)
+                           for r in sorted(set(chaos["restores"]))}},
+        "chaos": {
+            "completed": completed,
+            "degraded_restores": degraded,
+            "cold_warm_turns": cold_warm_turns,
+            "verify_failures": chaos["verify_fails"],
+            "fault_plan": {"torn_write_every": chaos_cfg.torn_write_every,
+                           "bit_flip_every": chaos_cfg.bit_flip_every,
+                           "slow_read_s": chaos_cfg.slow_read_s}},
+        "kv_pages_leaked": leaked,
+        "budgets_reconciled_at_drain": reconciled,
+        "platform": jax.devices()[0].platform,
+        "protocol_note": "teacher-forced multi-turn replay (every pass "
+                         "serves the reference trajectory's prompts); "
+                         "reference = one persistent engine, prefix cache "
+                         "keeps prefixes device-resident (the "
+                         "'uninterrupted run' identity oracle); cold = "
+                         "fresh engine per turn; host-warm = one engine "
+                         "with session pins; disk-warm = fresh engine PER "
+                         "TURN sharing one disk_dir (every warm turn is a "
+                         "full restart recovery through the manifest); "
+                         "chaos = disk-warm under seeded torn-write/bit-"
+                         "flip/slow-disk faults.  Warm TTFT excludes each "
+                         "protocol's turn 0.  Page-aligned geometry makes "
+                         "warm restores the same chunked-prefill graph as "
+                         "the reference, hence the bit-exact gate; the "
+                         "cold pass runs the single-shot padded graph, "
+                         "where bf16 near-ties may legally differ",
+    }
+    for d in (host_dir, disk_dir, chaos_dir):
+        shutil.rmtree(d, ignore_errors=True)
+    line = _json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if not all(ident.values()):
+        raise SystemExit(
+            f"session restores diverged from the uninterrupted run: {ident}")
+    if not completed:
+        raise SystemExit("a session turn failed to complete under chaos")
+    if leaked:
+        raise SystemExit(f"KV pages leaked across session replays: {leaked}")
+    if not reconciled:
+        raise SystemExit("tier budgets did not reconcile to zero at drain")
+    if not (ttft_host < ttft_cold and ttft_disk < ttft_cold):
+        raise SystemExit(
+            f"warm TTFT did not beat cold (cold {ttft_cold}s, "
+            f"host {ttft_host}s, disk {ttft_disk}s)")
+    if chaos["verify_fails"] + degraded + cold_warm_turns < 1:
+        raise SystemExit("storage chaos did not engage "
+                         f"({chaos_cfg} injected nothing visible)")
+
+
 def _run_fleet(args, config, params, lora) -> None:
     """Fleet chaos scenario (ISSUE 6): N in-process engine replicas behind
     the real ServiceProxy, streamed requests through the ingress, and a
     seeded FleetFaultConfig that kills one replica mid-decode, hangs
     another, makes a third chronically slow, and cuts every Nth relayed
     stream's connection.  Asserts the acceptance invariants: 100% of
-    requests complete, every streamed output is BYTE-IDENTICAL to the clean
-    fleet pass (no duplicated or dropped tokens across failover +
-    re-admission), 0 leaked KV pages on surviving replicas, bounded p99
-    penalty, and router retry/ejection counters on /metrics telling the
-    story.  Results land in BENCH_FLEET.json via --out."""
+    requests complete, stream continuity holds across failover +
+    re-admission — byte-identical to the clean fleet pass for requests
+    whose dispatch schedule matched, tie-aware greedy equivalence (every
+    emitted token within tie_eps of the full-forward oracle max along its
+    own trajectory) for the rest, which catches duplicated/dropped tokens
+    while admitting cross-dispatch-shape bf16 GEMM drift — plus 0 leaked
+    KV pages on surviving replicas, bounded p99 penalty, and router
+    retry/ejection counters on /metrics telling the story.  Results land
+    in BENCH_FLEET.json via --out."""
     import concurrent.futures
     import json as _json
     import time as _time
@@ -642,61 +942,16 @@ def _run_fleet(args, config, params, lora) -> None:
         return "".join(letters[j] for j in rng.integers(0, len(letters),
                                                         size=args.prompt_len))
 
-    def screen_prompts(needed: int) -> list:
-        """Composition-stable prompts: greedy argmax over bf16 logits can
-        legitimately flip on EXACT ties, and the prefill dispatch shape
-        ([B, bucket]) varies with admission timing — so a tie-adjacent
-        prompt's trajectory differs between schedules with no fault
-        injected at all (measured on this box: 2 of 12 random prompts).
-        The byte-continuity check must catch failover duplication/drops,
-        not bf16 tie flips, so candidates are screened on a referee
-        engine: solo-serial vs 2-way vs ``slots``-way concurrent, plus a
-        mid-trajectory resume re-prefill (prompt+half the ids folded back
-        in, the failover seam's exact math).  Only prompts whose
-        trajectory is identical across all four survive."""
-        from kubeflow_tpu.serving.engine.serve import ByteTokenizer
-
-        tok = ByteTokenizer()
-        ec = EngineConfig(max_slots=slots, page_size=page_size,
-                          num_pages=num_pages,
-                          max_pages_per_slot=pages_per_slot,
-                          tensor_parallel=args.tensor_parallel,
-                          paged_kernel=args.paged_kernel or None,
-                          kv_quant=args.kv_quant,
-                          weight_quant=args.weight_quant)
-        eng = Engine(params, config, ec)
-        eng.start()
-        eng.generate(tok.encode(mk_prompt()), 2)  # warmup compile
-        kept, dropped = [], 0
-        mt = args.max_tokens
-        while len(kept) < needed and dropped < 4 * needed:
-            cand = [mk_prompt() for _ in range(slots)]
-            ids = [tok.encode(p) for p in cand]
-            solo = [eng.generate(i, mt)["tokens"] for i in ids]
-            futs = [eng.generate_async(i, mt) for i in ids]
-            conc = [f.result(timeout=600)["tokens"] for f in futs]
-            duo = []
-            for k in range(0, slots, 2):
-                fs = [eng.generate_async(i, mt) for i in ids[k:k + 2]]
-                duo += [f.result(timeout=600)["tokens"] for f in fs]
-            for p, i, s, c, d in zip(cand, ids, solo, conc, duo):
-                half = mt // 2
-                seam = eng.generate(i + s[:half], mt - half)["tokens"]
-                if s == c == d and seam == s[half:]:
-                    if len(kept) < needed:
-                        kept.append(p)
-                else:
-                    dropped += 1
-        eng.stop()
-        if len(kept) < needed:
-            raise SystemExit(
-                f"fleet chaos: only {len(kept)}/{needed} composition-"
-                f"stable prompts after screening ({dropped} dropped)")
-        log = f"fleet chaos: prompt screening dropped {dropped} tie-prone"
-        print(log + f", kept {len(kept)}")
-        return kept
-
-    prompts = screen_prompts(args.requests)
+    # No prompt pre-screening (the PR 6 referee-engine workaround is gone):
+    # the sample kernels now break greedy ties deterministically (lowest
+    # token id, model.sample_tokens), which removes tie-ORDER flips, and
+    # the residual cross-shape effect — [1,bucket] vs [B,bucket] prefills
+    # of the same row differ by up to ~0.03 logits of bf16 GEMM drift on
+    # XLA:CPU, enough to flip a NEAR-tie between schedules — is handled at
+    # verification time instead: divergent requests get the tie-aware
+    # greedy-equivalence audit below rather than being screened out of the
+    # workload up front.
+    prompts = [mk_prompt() for _ in range(args.requests)]
 
     chaos_cfg = FleetFaultConfig(
         seed=0,
@@ -757,13 +1012,17 @@ def _run_fleet(args, config, params, lora) -> None:
         return api, proxy, svc_port, engines, servers, chaos
 
     def stream_one(port: int, prompt: str, mt: int):
+        # X-Stream-Resume: every event carries its token_ids, so the
+        # client-side id sequence is reconstructable — the tie-aware
+        # divergence verifier below consumes it
         req = _url.Request(
             f"http://127.0.0.1:{port}/v2/models/fleet/generate_stream",
             data=_json.dumps({"text_input": prompt,
                               "parameters": {"max_tokens": mt}}).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     "X-Stream-Resume": "1"})
         t0 = _time.perf_counter()
-        pieces, final, buf = [], None, b""
+        pieces, ids, final, buf = [], [], None, b""
         with _url.urlopen(req, timeout=600) as r:
             while True:
                 chunk = r.read1(65536)
@@ -780,11 +1039,13 @@ def _run_fleet(args, config, params, lora) -> None:
                             raise RuntimeError(str(ev["error"]))
                         if ev.get("done"):
                             final = ev
-                        elif ev.get("text_output"):
-                            pieces.append(ev["text_output"])
+                        else:
+                            if ev.get("text_output"):
+                                pieces.append(ev["text_output"])
+                            ids.extend(ev.get("token_ids") or ())
         if final is None:
             raise RuntimeError("stream ended without done event")
-        return "".join(pieces), final, _time.perf_counter() - t0
+        return "".join(pieces), final, _time.perf_counter() - t0, ids
 
     def one_pass(with_chaos: bool):
         api, proxy, svc_port, engines, servers, chaos = build(with_chaos)
@@ -829,6 +1090,7 @@ def _run_fleet(args, config, params, lora) -> None:
                 "texts": [o[0] for o in outs],
                 "tokens": [o[1]["tokens"] for o in outs],
                 "lat": [o[2] for o in outs],
+                "ids": [o[3] for o in outs],
                 "wall": wall,
                 "leaks": leaks,
                 "states": survivor_states,
@@ -855,7 +1117,49 @@ def _run_fleet(args, config, params, lora) -> None:
     exposition = REGISTRY.render()
 
     n = args.requests
-    identical = all(a == b for a, b in zip(clean["texts"], chaos["texts"]))
+
+    def verify_tie_aware(prompt_text: str, ids: list):
+        """Greedy-equivalence oracle along the request's OWN trajectory
+        (tests/test_engine.assert_greedy_equivalent's logic): every
+        emitted token's full-forward logit must sit within ``tie_eps`` of
+        that step's max.  Cross-dispatch-shape bf16 GEMM drift (measured
+        ~0.03 max logit delta on XLA:CPU between [1,bucket] and
+        [B,bucket] prefills of the same row) legally flips near-tied
+        argmaxes between schedules, so exact text equality with the clean
+        pass is not the right oracle for drift — but a DUPLICATED or
+        DROPPED token conditions the continuation on the wrong history,
+        whose tokens then miss the oracle max by O(1) logits, far outside
+        tie_eps.  Returns (ok, first_bad_step, deficit)."""
+        import jax.numpy as _jnp
+
+        from kubeflow_tpu.serving.engine.model import forward_full
+        from kubeflow_tpu.serving.engine.serve import ByteTokenizer
+
+        toks = ByteTokenizer().encode(prompt_text)
+        for j, g in enumerate(ids):
+            logits = np.asarray(forward_full(
+                params, config, _jnp.asarray([toks], _jnp.int32)))[0, -1]
+            top = float(logits.max())
+            if float(logits[g]) < top - args.fleet_tie_eps:
+                return False, j, round(top - float(logits[g]), 4)
+            toks.append(g)
+        return True, -1, 0.0
+
+    diverged = [i for i, (a, b) in enumerate(zip(clean["texts"],
+                                                 chaos["texts"])) if a != b]
+    # strict byte-continuity for schedule-stable requests; tie-aware
+    # greedy equivalence for the rest (deterministic in-kernel tie-break
+    # removed tie-ORDER flips, so what remains is cross-shape value
+    # drift, which this oracle admits while still catching dup/drops)
+    divergence_audit = []
+    for i in diverged:
+        ok, step, deficit = verify_tie_aware(prompts[i], chaos["ids"][i])
+        divergence_audit.append({"request": i, "tie_aware_ok": ok,
+                                 "first_bad_step": step,
+                                 "logit_deficit": deficit})
+    identical = not diverged
+    continuity_ok = identical or all(a["tie_aware_ok"]
+                                     for a in divergence_audit)
     complete = (len(chaos["texts"]) == n
                 and all(t == args.max_tokens for t in chaos["tokens"]))
     leaked = sum(chaos["leaks"].values())
@@ -880,6 +1184,12 @@ def _run_fleet(args, config, params, lora) -> None:
         "completed": len(chaos["texts"]),
         "completion_rate": round(len(chaos["texts"]) / n, 4),
         "byte_identical_across_failover": identical,
+        "diverged_requests": len(diverged),
+        "diverged_tie_aware_verified": (all(a["tie_aware_ok"]
+                                            for a in divergence_audit)
+                                        if divergence_audit else None),
+        "divergence_audit": divergence_audit,
+        "tie_eps": args.fleet_tie_eps,
         "tokens_per_request_exact": complete,
         "kv_pages_leaked_survivors": leaked,
         "replica_states_after": chaos["states"],
@@ -902,12 +1212,14 @@ def _run_fleet(args, config, params, lora) -> None:
                          "check; chaos pass kills replica 0 mid-decode, "
                          "hangs replica 1, slows replica 2, and cuts every "
                          "4th relayed stream; failover re-admits with "
-                         "resume_token_ids.  Prompts are pre-screened for "
-                         "composition stability (solo vs 2-way vs N-way "
-                         "prefill batching vs mid-trajectory re-prefill): "
-                         "bf16 argmax can flip on exact logit ties across "
-                         "dispatch shapes, and the continuity check must "
-                         "catch failover dup/drops, not tie flips",
+                         "resume_token_ids.  Random prompts, unscreened: "
+                         "greedy ties break deterministically in-kernel "
+                         "(lowest token id), and requests that still "
+                         "diverge from the clean pass (cross-dispatch-"
+                         "shape bf16 GEMM drift flipping near-ties) are "
+                         "verified token-by-token against the tie-aware "
+                         "full-forward greedy oracle, which catches "
+                         "failover dup/drops while admitting drift",
     }
     line = _json.dumps(out)
     print(line)
@@ -918,16 +1230,15 @@ def _run_fleet(args, config, params, lora) -> None:
         raise SystemExit(
             f"fleet chaos: only {len(chaos['texts'])}/{n} requests "
             "completed with the full token budget")
-    if not identical:
-        for i, (a, b) in enumerate(zip(clean["texts"], chaos["texts"])):
-            if a != b:
-                k = next((j for j in range(min(len(a), len(b)))
-                          if a[j] != b[j]), min(len(a), len(b)))
-                print(f"fleet chaos divergence req {i}: clean len {len(a)} "
-                      f"chaos len {len(b)} first diff at char {k}: "
-                      f"clean={a[k:k+12]!r} chaos={b[k:k+12]!r}")
-        raise SystemExit("fleet chaos: streamed outputs diverged from the "
-                         "clean pass (duplicated or dropped tokens)")
+    if not continuity_ok:
+        for a in divergence_audit:
+            if not a["tie_aware_ok"]:
+                print(f"fleet chaos continuity FAILURE req {a['request']}: "
+                      f"token at step {a['first_bad_step']} misses the "
+                      f"greedy oracle by {a['logit_deficit']} logits "
+                      "(duplicated/dropped token, not bf16 drift)")
+        raise SystemExit("fleet chaos: streamed outputs broke greedy "
+                         "continuity (duplicated or dropped tokens)")
     if leaked:
         raise SystemExit(
             f"fleet chaos: {leaked} KV pages leaked on survivors")
@@ -995,6 +1306,14 @@ def main() -> None:
                         "byte-identity (incl. a preemption-storm chaos "
                         "pass) and page leaks (BENCH_OVERLAP.json via "
                         "--out)")
+    p.add_argument("--sessions", action="store_true",
+                   help="session-replay scenario (ISSUE 7): multi-turn "
+                        "conversations replayed cold vs host-warm vs "
+                        "disk-warm (fresh engine per turn = restart "
+                        "recovery) vs disk-warm-under-storage-chaos; "
+                        "asserts byte-identity, 0 leaks, budget "
+                        "reconciliation and warm TTFT < cold TTFT "
+                        "(BENCH_SESSIONS.json via --out)")
     p.add_argument("--fleet-chaos", action="store_true",
                    help="fleet chaos scenario (ISSUE 6): N in-process "
                         "replicas behind the real ServiceProxy; seeded "
@@ -1013,6 +1332,12 @@ def main() -> None:
     p.add_argument("--fleet-p99-budget", type=float, default=15.0,
                    help="max acceptable chaos/clean p99 latency ratio for "
                         "--fleet-chaos")
+    p.add_argument("--fleet-tie-eps", type=float, default=0.05,
+                   help="logit tolerance for the tie-aware continuity "
+                        "verifier on clean-vs-chaos divergent requests "
+                        "(covers cross-dispatch-shape bf16 GEMM drift, "
+                        "measured ~0.03 on XLA:CPU; a dup/dropped token "
+                        "misses the oracle by whole logits)")
     p.add_argument("--obs", action="store_true",
                    help="telemetry-overhead smoke (ISSUE 3): closed-loop "
                         "workload with the observability layer on vs off; "
@@ -1085,6 +1410,9 @@ def main() -> None:
         return
     if args.slo:
         _run_slo(args, config, params, lora)
+        return
+    if args.sessions:
+        _run_sessions(args, config, params, lora)
         return
     if args.fleet_chaos:
         _run_fleet(args, config, params, lora)

@@ -10,6 +10,12 @@ downloading/unloading models into a running server.
 Here each is a composable wrapper/sidecar-object around the Python ``Model``
 host, which is where the sidecar boundary lands in the in-process design:
 the wrapped model IS the queue-proxy hop of §3.4.
+
+``ChatSession`` (ISSUE 7) is the multi-turn driver on top: it holds a
+``session_id`` and the accumulated transcript, so every agent/chat turn
+rides the engine's tiered-KV session pin — the prior turns' KV restores
+from host RAM or disk instead of re-prefilling the whole conversation
+(README "Sessions & tiered KV").
 """
 
 from __future__ import annotations
@@ -18,6 +24,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from typing import Any, Callable, Optional
 
 from ..core.api import APIServer
@@ -114,6 +121,86 @@ class RequestBatcher(Model):
             for _, done, slot in batch:
                 slot["error"] = e
                 done.set()
+
+
+# ---------------------------------------------------------------- sessions
+
+
+class ChatSession:
+    """Multi-turn conversation/agent-loop driver over an engine-backed
+    model (engine/serve.JetStreamModel).
+
+    Each ``turn(text)`` sends the FULL accumulated context plus the new
+    text, tagged with this session's ``session_id`` — so the engine
+    restores the prior turns' pinned KV from the tiered store (host RAM,
+    or disk after a restart) and prefills only the new tail, instead of
+    re-paying the whole conversation's prefill every turn.
+
+    The context is carried as TOKEN IDS, not re-tokenized text: the
+    engine pins chain hashes over the previous turn's exact id sequence,
+    and a subword tokenizer re-encoding ``transcript + reply + text`` may
+    merge tokens across the seams — every hash would then mismatch and
+    each turn would silently restore cold.  Appending
+    ``encode(new text)`` to the carried ids keeps the pinned prefix
+    byte-stable by construction.  (Corollary for remote HTTP clients:
+    send id-stable prompts, or accept that seam merges cost the warm
+    restore, never correctness.)
+
+    After a process restart, rebuilding a ChatSession with the same
+    ``session_id`` and carried ``context_ids`` resumes warm from the
+    engine's disk manifest.  The per-turn ``restore`` history ("host"/
+    "disk"/"cache"/"cold"/"degraded") is kept for tests and capacity
+    dashboards."""
+
+    def __init__(self, model, session_id: Optional[str] = None,
+                 max_tokens: int = 64, context_ids: Optional[list] = None):
+        if getattr(model, "engine", None) is None:
+            raise ValueError("ChatSession requires an engine-backed model")
+        self.model = model
+        self.session_id = session_id or f"chat-{uuid.uuid4().hex[:16]}"
+        self.max_tokens = max_tokens
+        self.context_ids: list[int] = list(context_ids or [])
+        self.transcript = (model.tokenizer.decode(self.context_ids)
+                           if self.context_ids else "")
+        self.turns = 0
+        self.restore_history: list[str] = []
+
+    def turn(self, text: str, max_tokens: Optional[int] = None) -> dict:
+        """One conversation turn: returns a generate-shaped record
+        (``text_output``, ``ttft_s``, ``session`` block, ...) and folds
+        prompt + reply ids into the carried context for the next turn."""
+        ids = self.context_ids + self.model.tokenizer.encode(text)
+        if not ids:
+            # the engine refuses empty prompts; the substitute token must
+            # ALSO enter the carried context, or every later turn's hash
+            # chain would mismatch the pinned pages from position 0
+            ids = [0]
+        r = self.model.engine.generate(
+            ids, max_tokens or self.max_tokens,
+            session_id=self.session_id)
+        reply = self.model.tokenizer.decode(r["tokens"])
+        self.context_ids = ids + r["tokens"]
+        self.transcript += text + reply
+        self.turns += 1
+        self.restore_history.append(
+            (r.get("session") or {}).get("restore", "cold"))
+        return {"text_output": reply, "token_ids": r["tokens"],
+                "tokens": r["num_tokens"], "prompt_tokens": len(ids),
+                "ttft_s": round(r["ttft_s"], 4),
+                "latency_s": round(r["latency_s"], 4),
+                "session": r.get("session")}
+
+    def end(self) -> bool:
+        """Drop the session's pinned KV from the engine's tiered store
+        (best-effort; returns False when the model has no engine or the
+        session was never pinned)."""
+        eng = getattr(self.model, "engine", None)
+        if eng is None:
+            return False
+        try:
+            return bool(eng.drop_session(self.session_id))
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            return False
 
 
 # ----------------------------------------------------------------- logger

@@ -1,8 +1,9 @@
 """JetStream-style TPU inference engine (SURVEY.md §2b: the Triton/TF-Serving
 replacement): C++ continuous batcher + paged-KV JAX decode."""
 
-from ..errors import RequestError  # noqa: F401  (re-export: engine raises it)
+from ..errors import RequestError, SessionBusy  # noqa: F401  (re-exports)
 from .engine import Engine, EngineConfig  # noqa: F401
+from .kvstore import KVStoreConfig, TieredKVStore  # noqa: F401
 from .model import DecoderConfig  # noqa: F401
 from .scheduler import (PRIORITY_CLASSES, SchedulerConfig,  # noqa: F401
                         normalize_priority)

@@ -45,6 +45,16 @@ rebuilt, replacing the sync loop's "blocking sample is the aliasing fence"
 invariant with per-dispatch page-table snapshots.  ``pipeline_depth=0``
 keeps the fully synchronous loop as the parity oracle: greedy outputs are
 byte-identical between the two modes.
+
+Sessions & tiered KV (ISSUE 7, README "Sessions & tiered KV"): requests
+carrying a ``session_id`` pin their finished turn's KV pages into the
+tiered store (kvstore.py: host RAM aging to checksummed disk page files)
+instead of freeing them; the next turn restores the pinned prefix at
+admission — byte-identically, verified — and re-prefills only the new
+tail.  Every storage failure (torn write, bit flip, missing file, ENOSPC)
+degrades transparently to recompute; pinned sessions survive watchdog
+restart (host tier, swap cleared + counters reset) and full engine
+restart (disk manifest replay, lazy re-adoption).
 """
 
 from __future__ import annotations
@@ -61,10 +71,12 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 from ..errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
-                      NonFiniteLogits, RequestError, TickFailure)
+                      NonFiniteLogits, RequestError, SessionBusy,
+                      TickFailure)
 from .faults import ChaosInjector, FaultConfig
-from .scheduler import (PRIORITY_RANK, HostSwapStore, QosScheduler,
-                        QueueEntry, SchedulerConfig, normalize_priority)
+from .kvstore import KVStoreConfig, TieredKVStore, normalize_session_id
+from .scheduler import (PRIORITY_RANK, QosScheduler, QueueEntry,
+                        SchedulerConfig, normalize_priority)
 from .telemetry import (EngineTelemetry, FlightRecorder, RequestSpan,
                         TickProfiler)
 from .model import (DecoderConfig, decode_step, decode_step_k,
@@ -201,6 +213,13 @@ class EngineConfig:
     # on.  SchedulerConfig(policy="fifo", preemption=False) restores the
     # pre-QoS submission-order behavior (the SLO bench baseline).
     scheduler: Optional[SchedulerConfig] = None
+    # ---- tiered KV store / sessions (README "Sessions & tiered KV") ----
+    # host-RAM + disk tier budgets and placement for preemption swap and
+    # pinned session KV (kvstore.py).  None = KVStoreConfig with the
+    # scheduler's swap_max_bytes as the host budget and a fresh private
+    # disk dir (tiering works, but sessions only survive a full engine
+    # restart when disk_dir points somewhere stable).
+    kv_store: Optional[KVStoreConfig] = None
 
 
 @dataclasses.dataclass
@@ -244,11 +263,22 @@ class _Pending:
     rank: int = 0
     # times this request was preempted out of its decode slot
     preemptions: int = 0
-    # swap-preempted: KV pages live in the HostSwapStore under this rid;
+    # swap-preempted: KV pages live in the tiered KV store under this rid;
     # resume_len is the committed context length to restore (seq_len at
     # eviction — KV coverage and decode input reconstruct from it exactly)
     swapped: bool = False
     resume_len: int = 0
+    # ---- sessions (README "Sessions & tiered KV") ----------------------
+    # own request id (set at submit; the session-busy release key)
+    rid: int = -1
+    # conversation pin: a finished turn's KV pages park in the tiered
+    # store under this id instead of vanishing with the slot, and the
+    # next turn restores them instead of re-prefilling
+    session_id: "Optional[str]" = None
+    # how this turn's prefix was recovered — None until the first
+    # admission, then host|disk|cache|cold|degraded (degraded = the store
+    # had the session but verification failed; fell back to re-prefill)
+    session_restore: "Optional[str]" = None
 
 
 class _StaleThread(BaseException):
@@ -440,8 +470,12 @@ class Engine:
                                  f"{name!r} (loaded: {sorted(self.adapters)})")
             weights[self.adapters[name]] = float(w)
         self._sched = QosScheduler(self._scfg, weights)
-        self._swap_store = HostSwapStore(self._scfg.swap_max_bytes)
         self._preemptions = 0
+        # ---- sessions (ISSUE 7) -----------------------------------------
+        # session id -> rid of its one queued/in-flight turn: a session's
+        # KV timeline is serial, so a second concurrent turn is refused
+        # with SessionBusy (HTTP 409).  Guarded by self._lock.
+        self._session_active: dict[str, int] = {}
         # ---- fault tolerance state --------------------------------------
         self._chaos = (ChaosInjector(engine_config.chaos)
                        if engine_config.chaos is not None else None)
@@ -467,6 +501,14 @@ class Engine:
         # gauges), tick-event ring for postmortems, completed-span history
         # for trace(rid), and the on-demand jax.profiler capture hook
         self.telemetry = EngineTelemetry(enabled=engine_config.telemetry)
+        # tiered KV backing store (kvstore.py): preemption swap blobs +
+        # pinned session KV over host RAM aging to checksummed disk page
+        # files; a stable disk_dir makes pinned sessions survive a full
+        # engine restart (the store replays its manifest here, re-adopting
+        # pages lazily on first touch)
+        kvcfg = (engine_config.kv_store if engine_config.kv_store is not None
+                 else KVStoreConfig(host_max_bytes=self._scfg.swap_max_bytes))
+        self._kv = TieredKVStore(kvcfg, on_event=self.telemetry.count_kv_event)
         self.flight = FlightRecorder(
             capacity=engine_config.flight_recorder_capacity,
             dump_dir=engine_config.flight_dir)
@@ -552,6 +594,10 @@ class Engine:
             self._fail_slot(slot, EngineShutdown("engine stopped"))
         self._fail_unassigned(EngineShutdown("engine stopped"))
         self.batcher.close()
+        # release the tiered KV store: an ephemeral (auto-tempdir) store
+        # deletes its page files — nothing could ever recover them; an
+        # explicit disk_dir keeps the session manifest for the next engine
+        self._kv.close()
         self._stopped = True
         self._draining = False  # drain is over: health reports DEAD now
 
@@ -592,7 +638,8 @@ class Engine:
                        stream: Optional["queue.Queue"] = None,
                        adapter: Optional[str] = None,
                        deadline: Optional[float] = None,
-                       priority: Optional[str] = None) -> Future:
+                       priority: Optional[str] = None,
+                       session_id: Optional[str] = None) -> Future:
         """Submit a prompt; the Future resolves to a result dict.
 
         ``stream``: optional queue that receives each token id as it is
@@ -604,12 +651,19 @@ class Engine:
         DeadlineExceeded (defaults to ``default_deadline_s``).
         ``priority``: QoS class — "interactive" (default) | "batch" |
         "best_effort" — deciding admission order and preemption standing
-        (scheduler.py; unknown classes raise RequestError).  Raises
-        EngineOverloaded when the queue is at ``max_queue_depth`` and
-        EngineShutdown once stop() has begun."""
+        (scheduler.py; unknown classes raise RequestError).
+        ``session_id``: conversation pin (README "Sessions & tiered KV"):
+        the finished turn's KV pages park in the tiered store under this
+        id and the NEXT turn with the same id — whose prompt must extend
+        this turn's context — restores them instead of re-prefilling;
+        a second turn while one is in flight raises SessionBusy (409).
+        Raises EngineOverloaded when the queue is at ``max_queue_depth``
+        and EngineShutdown once stop() has begun."""
         if not tokens:
             raise RequestError("empty prompt")
         prio = normalize_priority(priority)
+        if session_id is not None:
+            session_id = normalize_session_id(session_id)
         if self._draining or self._stopped:
             # fast-path: also keeps the overload check below from touching
             # a closed batcher (RuntimeError) after stop(); the locked
@@ -648,6 +702,10 @@ class Engine:
             # for stop()'s sweep to fail its future — never stranded
             if self._draining or self._stopped:
                 raise EngineShutdown("engine is stopping")
+            if session_id is not None and session_id in self._session_active:
+                raise SessionBusy(
+                    f"session {session_id!r} already has request "
+                    f"{self._session_active[session_id]} in flight")
             rid = self._next_id
             self._next_id += 1
             pending = self._requests[rid] = _Pending(
@@ -657,7 +715,10 @@ class Engine:
                 deadline=(now + deadline if deadline is not None else None),
                 span=(RequestSpan(rid) if self.ec.telemetry else None),
                 priority=prio, rank=PRIORITY_RANK[prio],
+                rid=rid, session_id=session_id,
             )
+            if session_id is not None:
+                self._session_active[session_id] = rid
             self._future_rid[fut] = rid
         # the request now waits in the HOST scheduler queue; the engine
         # loop submits it to the C++ core only when the policy admits it
@@ -697,9 +758,11 @@ class Engine:
     def generate(self, tokens: list[int], max_new_tokens: int = 32, timeout: float = 300.0,
                  adapter: Optional[str] = None,
                  deadline: Optional[float] = None,
-                 priority: Optional[str] = None) -> dict:
+                 priority: Optional[str] = None,
+                 session_id: Optional[str] = None) -> dict:
         fut = self.generate_async(tokens, max_new_tokens, adapter=adapter,
-                                  deadline=deadline, priority=priority)
+                                  deadline=deadline, priority=priority,
+                                  session_id=session_id)
         try:
             return fut.result(timeout=timeout)
         except FutureTimeoutError:
@@ -737,7 +800,7 @@ class Engine:
             # resolve OUTSIDE the lock (same split _finish uses): a Future
             # done-callback may re-enter the engine and take _lock
             self._sched.remove(rid)
-            self._swap_store.discard(rid)
+            self._kv.discard_swap(rid)
             self._archive_span(pending, "cancelled")
             result = self._cancelled_result(rid, pending)
             pending.future.set_result(result)
@@ -774,7 +837,7 @@ class Engine:
             self._requests.pop(rid, None)
             self._future_rid.pop(pending.future, None)
         self._sched.remove(rid)
-        self._swap_store.discard(rid)
+        self._kv.discard_swap(rid)
         self._archive_span(pending, "cancelled")
         result = self._cancelled_result(rid, pending)
         try:
@@ -789,7 +852,8 @@ class Engine:
                         timeout: float = 300.0,
                         adapter: Optional[str] = None,
                         deadline: Optional[float] = None,
-                        priority: Optional[str] = None) -> Iterator:
+                        priority: Optional[str] = None,
+                        session_id: Optional[str] = None) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
 
         The last item yielded is the same dict ``generate`` returns (so
@@ -803,7 +867,7 @@ class Engine:
         q: queue.Queue = queue.Queue()
         fut = self.generate_async(tokens, max_new_tokens, stream=q,
                                   adapter=adapter, deadline=deadline,
-                                  priority=priority)
+                                  priority=priority, session_id=session_id)
 
         def _iter():
             while True:
@@ -839,7 +903,7 @@ class Engine:
                 "free_pages": self.batcher.free_pages,
                 "preemptions": self._preemptions,
                 "scheduler": self._sched.snapshot(),
-                **self._swap_store.stats(),
+                **self._kv.stats(),
                 "spec_proposed": self._spec_proposed,
                 "spec_accepted": self._spec_accepted,
                 "prefill_dispatches": self._prefill_dispatches,
@@ -858,6 +922,20 @@ class Engine:
                 **({"chaos": self._chaos.stats()} if self._chaos else {}),
                 **self.batcher.cache_stats(),
             }
+
+    # ---------------------------------------------------------- sessions API
+
+    def sessions(self) -> dict:
+        """Pinned sessions in the tiered KV store: id -> {nbytes, version,
+        tiers, context_len, pages}.  Surviving entries from a previous
+        engine run (manifest replay) appear here before first touch."""
+        return self._kv.session_list()
+
+    def drop_session(self, session_id: str) -> bool:
+        """Unpin a session: its KV leaves both tiers and the manifest.
+        False if no such session.  In-flight turns are unaffected (their
+        pin at finish simply re-creates the entry)."""
+        return self._kv.drop_session(session_id)
 
     # ---------------------------------------------------------- tracing API
 
@@ -885,7 +963,17 @@ class Engine:
 
     def _archive_span(self, pending: "_Pending", outcome: str) -> None:
         """Terminal-mark a request's span, count the outcome, and retire the
-        span into the bounded trace history (oldest evicted first)."""
+        span into the bounded trace history (oldest evicted first).
+
+        Also the ONE session-busy release point: every terminal path —
+        finish, fail, shed, cancel (both races), reap, drain — funnels
+        through here exactly once per request, so a session can never be
+        left permanently "in flight" by a missed edge case."""
+        sid = pending.session_id
+        if sid is not None:
+            with self._lock:
+                if self._session_active.get(sid) == pending.rid:
+                    del self._session_active[sid]
         self.telemetry.count_outcome(outcome)
         span = pending.span
         if span is None:
@@ -1318,7 +1406,7 @@ class Engine:
                 self._aid_host[slot] = pending.adapter_id
         if pending is None:
             self.batcher.release(slot)
-            self._swap_store.discard(rid)
+            self._kv.discard_swap(rid)
             return
         if pending.span is not None:
             now = pending.span.mark(
@@ -1327,7 +1415,7 @@ class Engine:
                 self.telemetry.observe_queue_wait(
                     now - pending.submitted_at, pending.priority)
         if pending.cancelled:  # cancelled between submit and admit
-            self._swap_store.discard(rid)
+            self._kv.discard_swap(rid)
             self._finish(slot, rid, truncated=False,
                          cancelled=True, cache_ok=False)
             return
@@ -1342,7 +1430,7 @@ class Engine:
                 "in queue"), shed=True)
             return
         if pending.swapped:
-            item = self._swap_store.pop(rid)
+            item = self._kv.pop_swap(rid)
             if item is not None:
                 try:
                     self._resume_swapped(slot, pending, item)
@@ -1359,9 +1447,89 @@ class Engine:
             # uncached so this is a cold re-prefill, but still correct
             pending.swapped = False
         # cache-hit pages already hold the prefix KV: prefill resumes
-        # at the first uncovered position
-        self._prefilling[slot] = cached * self.ec.page_size
+        # at the first uncovered position.  A session's FIRST admission
+        # additionally restores pinned prefix pages from the tiered store
+        # (host/disk) past whatever the device cache covered; any store
+        # failure degrades to exactly this cache offset
+        off = cached * self.ec.page_size
+        if pending.session_id is not None and pending.session_restore is None:
+            off = self._restore_session(slot, pending, cached)
+        self._prefilling[slot] = off
         self._prefill_rows[slot] = self.batcher.slot_pages(slot)
+
+    def _restore_session(self, slot: int, pending: _Pending,
+                         cached: int) -> int:
+        """Session-turn prefix restore (README "Sessions & tiered KV"):
+        fetch the session's pinned KV pages from the tiered store, verify
+        (the store checksums every restore), match the stored chain hashes
+        against this prompt's, and scatter the pages the device prefix
+        cache did NOT already cover into the slot's freshly-allocated
+        row.  Returns the prefill offset (tokens already covered).
+
+        Degrades, never fails: a miss, a checksum/torn-write/missing-file
+        verification failure, a prompt that does not extend the pinned
+        context, or any unexpected error here falls back to the plain
+        prefix-cache offset — the turn re-prefills and still completes.
+        ``pending.session_restore`` records the outcome for the result
+        dict and the engine_session_restores_total metric."""
+        ps = self.ec.page_size
+        t0 = time.perf_counter()
+        try:
+            outcome, payload = self._kv.restore_session(pending.session_id)
+            if payload is None:
+                pending.session_restore = ("degraded" if outcome == "corrupt"
+                                           else "cold")
+                self.telemetry.count_session_restore(pending.session_restore)
+                return cached * ps
+            blob, nbytes, meta = payload
+            stored = np.asarray(meta.get("hashes", ()), np.uint64)
+            own = pending.page_hashes
+            plen = len(pending.tokens)
+            # the restore ceiling: full pages only, and at least ONE prompt
+            # position must remain uncovered so prefill computes the final
+            # logits the first sampled token comes from
+            limit = min(len(stored), len(own), max(0, (plen - 1) // ps))
+            usable = 0
+            while usable < limit and own[usable] == stored[usable]:
+                usable += 1
+            if usable <= cached:
+                # device prefix cache already covers everything the store
+                # could offer (or the prompt diverged from the pinned
+                # context before the cache frontier)
+                pending.session_restore = "cache" if cached > 0 else "cold"
+                self.telemetry.count_session_restore(pending.session_restore)
+                return cached * ps
+            row = self.batcher.slot_pages(slot)
+            pages = np.ascontiguousarray(row[cached:usable])
+            self._check_epoch()  # last fence before rebinding device pools
+            jnp = self._jnp
+            tree_map = self._jax.tree_util.tree_map
+
+            def put(pool, host):
+                return pool.at[:, pages].set(
+                    jnp.asarray(np.ascontiguousarray(host[:, cached:usable])))
+
+            blob_k, blob_v = blob
+            self.k_pool = tree_map(put, self.k_pool, blob_k)
+            self.v_pool = tree_map(put, self.v_pool, blob_v)
+            pending.session_restore = outcome  # "host" | "disk"
+            self.telemetry.count_session_restore(outcome)
+            if pending.span is not None:
+                pending.span.mark("session_restore")
+            if self.ec.telemetry:
+                self._flight_event(
+                    "session_restore", [slot],
+                    {"tier": outcome, "pages": int(usable - cached),
+                     "cached": cached, "bytes": nbytes}, t0, "ok")
+            return usable * ps
+        except Exception as exc:  # noqa: BLE001 — restore must degrade
+            pending.session_restore = "degraded"
+            self.telemetry.count_session_restore("degraded")
+            if self.ec.telemetry:
+                self._flight_event("session_restore", [slot], None, t0,
+                                   "error",
+                                   error=f"{type(exc).__name__}: {exc}")
+            return cached * ps
 
     def _resume_swapped(self, slot: int, pending: _Pending, item) -> None:
         """Swap-in: scatter the evicted KV pages from the host store into
@@ -1478,7 +1646,7 @@ class Engine:
                 with self._lock:
                     self._requests.pop(rid, None)
                     self._future_rid.pop(pending.future, None)
-                self._swap_store.discard(rid)
+                self._kv.discard_swap(rid)
                 self._requests_failed += 1
                 self._archive_span(pending, "failed")
                 self._resolve_exception(pending, RequestError(
@@ -1555,7 +1723,7 @@ class Engine:
                     tree_map(fetch, self.v_pool))
             nbytes = sum(leaf.nbytes for leaf in
                          self._jax.tree_util.tree_leaves(blob))
-            if self._swap_store.put(rid, blob, nbytes):
+            if self._kv.put_swap(rid, blob, nbytes):
                 self.telemetry.count_swap("out", nbytes)
             else:
                 mode, nbytes = "recompute", 0  # over budget: drop instead
@@ -1757,7 +1925,7 @@ class Engine:
                 self._future_rid.pop(p.future, None)
         for rid, p in victims:
             self._sched.remove(rid)
-            self._swap_store.discard(rid)
+            self._kv.discard_swap(rid)
             self._requests_failed += 1
             self._archive_span(p, "failed")
             self._resolve_exception(p, exc)
@@ -1824,7 +1992,7 @@ class Engine:
             self._fail_slot(slot, err)
         self._fail_unassigned(err)
         self._sched.clear()
-        self._swap_store.clear()
+        self._kv.clear_swap()
         self._prefilling.clear()
         self._prefill_rows.clear()
         self._pt_host[:] = 0
@@ -2280,11 +2448,22 @@ class Engine:
             self._release_slot_state(slot)
             self.batcher.release(slot)
             return
+        # session pin BEFORE the mirrors zero: the slot's page row and
+        # committed length are what the snapshot reads
+        session = None
+        if pending.session_id is not None:
+            session = self._pin_session(slot, pending, cache_ok)
         self._release_slot_state(slot)  # freed slots decode as zero adapter
         # hand the prompt's full pages to the prefix cache on the way out —
         # unless the prefill never finished (cancel mid-prefill): those pages
-        # hold garbage and must not be served to other requests
-        self.batcher.release(slot, pending.page_hashes if cache_ok else None)
+        # hold garbage and must not be served to other requests.  A
+        # successfully pinned session's pages live in the tiered store
+        # instead: releasing them to the device cache too would double-home
+        # the bytes and make warm-tier attribution (host vs cache) racy
+        release_hashes = pending.page_hashes if cache_ok else None
+        if session is not None and session.get("pinned"):
+            release_hashes = None
+        self.batcher.release(slot, release_hashes)
         self._archive_span(pending, "cancelled" if cancelled else "done")
         now = time.perf_counter()
         result = {
@@ -2298,6 +2477,76 @@ class Engine:
                        if pending.first_token_at else 0.0),
             "latency_s": now - pending.submitted_at,
         }
+        if pending.session_id is not None:
+            # "evicted" is a COUNT, not the ids: session ids are bearer
+            # capabilities (kvstore.normalize_session_id), so leaking
+            # another client's id in this client's response would hand
+            # over their conversation.  The full ids stay server-side
+            # (store stats / flight events) for operators.
+            result["session"] = {
+                "id": pending.session_id,
+                "restore": pending.session_restore or "cold",
+                "pinned": bool(session and session.get("pinned")),
+                "durable": bool(session and session.get("durable")),
+                "evicted": len(session.get("evicted") or ()) if session else 0,
+            }
+            err = (session or {}).get("error") or (session or {}).get("reason")
+            if err:
+                result["session"]["error"] = err
         pending.future.set_result(result)
         if pending.stream is not None:
             pending.stream.put((None, result))
+
+    def _pin_session(self, slot: int, pending: _Pending,
+                     cache_ok: bool) -> dict:
+        """Park a finishing session turn's KV pages in the tiered store
+        (README "Sessions & tiered KV"): snapshot every COMPLETE page of
+        committed KV (positions [0, L-2] — the final token's KV is only
+        written by the decode step that never runs) plus the context's
+        chain hashes, so the next turn can verify byte-exact prefix
+        identity before re-adopting.  Degrades, never raises."""
+        sid = pending.session_id
+        if not cache_ok:
+            return {"pinned": False, "reason": "incomplete prefill"}
+        ps = self.ec.page_size
+        L = int(self._len_host[slot])
+        covered = max(0, (L - 1) // ps)
+        covered = min(covered, int(np.count_nonzero(self._pt_host[slot])))
+        if covered == 0:
+            self.telemetry.count_session_pin("rejected")
+            return {"pinned": False,
+                    "reason": "committed context shorter than one page"}
+        t0 = time.perf_counter()
+        try:
+            row = np.ascontiguousarray(self._pt_host[slot, :covered])
+            tree_map = self._jax.tree_util.tree_map
+            fetch = lambda leaf: np.asarray(leaf[:, row])  # noqa: E731
+            blob = (tree_map(fetch, self.k_pool),
+                    tree_map(fetch, self.v_pool))
+            nbytes = sum(leaf.nbytes for leaf in
+                         self._jax.tree_util.tree_leaves(blob))
+            hashes = self._page_hashes(pending.context,
+                                       pending.adapter_id)[:covered]
+            meta = {"hashes": [int(h) for h in hashes],
+                    "context_len": len(pending.context),
+                    "adapter_id": pending.adapter_id,
+                    "pages": covered}
+            res = self._kv.pin_session(sid, blob, nbytes, meta)
+        except Exception as exc:  # noqa: BLE001 — pin must not fail the turn
+            self.telemetry.count_session_pin("rejected")
+            if self.ec.telemetry:
+                self._flight_event("session_pin", [slot], None, t0, "error",
+                                   error=f"{type(exc).__name__}: {exc}")
+            return {"pinned": False,
+                    "reason": f"{type(exc).__name__}: {exc}"}
+        self.telemetry.count_session_pin(
+            "durable" if res.get("durable")
+            else "pinned" if res.get("pinned") else "rejected")
+        if self.ec.telemetry:
+            self._flight_event(
+                "session_pin", [slot],
+                {"pages": covered, "bytes": res.get("nbytes"),
+                 "durable": res.get("durable"),
+                 "evicted": len(res.get("evicted") or ())},
+                t0, "ok" if res.get("pinned") else "rejected")
+        return res

@@ -555,9 +555,27 @@ def sample_tokens(logits, key, temperature: float = 0.0):
     Greedy at temperature 0, else categorical with per-call key.  Keeping the
     sample on-device means only B int32s cross the host boundary per decode
     step instead of the [B, V] logits tensor (V can be 128k for Llama-3).
+
+    Greedy ties break DETERMINISTICALLY to the lowest token id.  A bare
+    ``argmax`` leaves tie order to the backend's reduction tiling, which
+    varies with the dispatch shape — two exactly-tied bf16 logits could
+    argmax differently between a ``[1, bucket]`` and an ``[8, bucket]``
+    prefill of the same prompt (observed on real prompts while hardening
+    the fleet bench, PR 6), breaking cross-schedule byte-identity checks
+    with no fault injected.  ``max`` then a min-reduce over matching
+    indices is associative/commutative in both steps, so the choice is
+    identical across batch compositions, backends, and shardings.  A row
+    with no finite max (all-NaN chaos poison) matches nothing and clamps
+    to V-1 — garbage the NaN guard discards before commit, exactly like
+    the old path's unspecified argmax-of-NaN row.
     """
     if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        V = logits.shape[-1]
+        top = jnp.max(logits, axis=-1, keepdims=True)
+        ids = jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                       logits.ndim - 1)
+        low = jnp.min(jnp.where(logits == top, ids, jnp.int32(V)), axis=-1)
+        return jnp.minimum(low, V - 1).astype(jnp.int32)
     return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
 
 

@@ -19,9 +19,11 @@ Three pieces:
     submission-order behavior — the bench baseline.
   * ``SchedulerConfig`` — frozen knobs riding inside ``EngineConfig``
     (preemption on/off, swap-vs-recompute policy, host swap budget).
-  * ``HostSwapStore`` — the host-RAM backing store for preempted KV pages:
-    byte-budgeted blobs keyed by request id.  Over budget, preemption falls
-    back to drop-and-recompute (which the engine turns into a prefix-cache
+  * the swap backing store — since ISSUE 7 this is the tiered, durable
+    ``kvstore.TieredKVStore`` (host RAM aging to checksummed disk page
+    files); ``HostSwapStore`` remains as a host-only compatibility facade
+    re-exported from kvstore.py.  Over budget, preemption falls back to
+    drop-and-recompute (which the engine turns into a prefix-cache
     release, so "recompute" usually means re-adopting the very same pages).
 
 Preemption itself lives in the engine (it touches slots, pools and the C++
@@ -215,64 +217,7 @@ class QosScheduler:
                     "admitted": self.admitted, "reaped": self.reaped}
 
 
-class HostSwapStore:
-    """Host-RAM backing store for preempted slots' KV pages.
-
-    Blobs are whatever the engine hands over (numpy pytrees + resume
-    metadata), keyed by request id, with a hard byte budget: ``put`` past
-    the budget returns False and the engine falls back to drop-and-
-    recompute — swap must degrade, never OOM the host."""
-
-    def __init__(self, max_bytes: int = 1 << 30):
-        self.max_bytes = max_bytes
-        self._lock = threading.Lock()
-        self._blobs: Dict[int, tuple] = {}  # rid -> (blob, nbytes)
-        self.used_bytes = 0
-        self.swapped_out = 0
-        self.swapped_in = 0
-        self.bytes_out = 0
-        self.bytes_in = 0
-        self.rejected = 0  # puts refused by the budget
-
-    def put(self, rid: int, blob, nbytes: int) -> bool:
-        with self._lock:
-            if self.used_bytes + nbytes > self.max_bytes:
-                self.rejected += 1
-                return False
-            self._blobs[rid] = (blob, nbytes)
-            self.used_bytes += nbytes
-            self.swapped_out += 1
-            self.bytes_out += nbytes
-            return True
-
-    def pop(self, rid: int):
-        """-> (blob, nbytes) or None; releases the budget."""
-        with self._lock:
-            item = self._blobs.pop(rid, None)
-            if item is None:
-                return None
-            self.used_bytes -= item[1]
-            self.swapped_in += 1
-            self.bytes_in += item[1]
-            return item
-
-    def discard(self, rid: int) -> None:
-        """Drop a blob without the swap-in accounting (terminal request)."""
-        with self._lock:
-            item = self._blobs.pop(rid, None)
-            if item is not None:
-                self.used_bytes -= item[1]
-
-    def clear(self) -> None:
-        with self._lock:
-            self._blobs.clear()
-            self.used_bytes = 0
-
-    def stats(self) -> dict:
-        with self._lock:
-            return {"swap_used_bytes": self.used_bytes,
-                    "swapped_out": self.swapped_out,
-                    "swapped_in": self.swapped_in,
-                    "swap_bytes_out": self.bytes_out,
-                    "swap_bytes_in": self.bytes_in,
-                    "swap_rejected": self.rejected}
+# The flat host-RAM swap store grew into the tiered, durable KV store
+# (kvstore.py, ISSUE 7).  Re-exported here so pre-tiering imports —
+# `from .scheduler import HostSwapStore` — keep working.
+from .kvstore import HostSwapStore  # noqa: E402,F401

@@ -23,6 +23,7 @@ from typing import Any, Optional
 from ..server import Model
 from ..errors import EngineError, RequestError
 from .engine import Engine, EngineConfig
+from .kvstore import normalize_session_id
 from .model import DecoderConfig, load_params
 from .scheduler import normalize_priority
 
@@ -177,6 +178,18 @@ class JetStreamModel(Model):
                             (str(n), float(w))
                             for n, w in skw["adapter_weights"])
                     kw["scheduler"] = SchedulerConfig(**skw)
+                if isinstance(kw.get("kv_store"), dict):
+                    # tiered KV / session durability straight from an
+                    # engine.json (README "Sessions & tiered KV"): point
+                    # disk_dir at a persistent volume so pinned sessions
+                    # survive pod restarts
+                    from .faults import StorageFaultConfig
+                    from .kvstore import KVStoreConfig
+
+                    kkw = kw["kv_store"]
+                    if isinstance(kkw.get("chaos"), dict):
+                        kkw["chaos"] = StorageFaultConfig(**kkw["chaos"])
+                    kw["kv_store"] = KVStoreConfig(**kkw)
                 ec = EngineConfig(**kw)
                 # an operator's explicit eos_id — INCLUDING -1 "never stop
                 # early" — must win over the checkout's declaration
@@ -238,6 +251,12 @@ class JetStreamModel(Model):
             # QoS surface: preemption churn + host swap-store pressure
             "engine_preemptions": s["preemptions"],
             "engine_swap_used_bytes": s["swap_used_bytes"],
+            # tiered KV / session surface (README "Sessions & tiered KV")
+            "engine_kv_host_used_bytes": s["kv_host_used_bytes"],
+            "engine_kv_disk_used_bytes": s["kv_disk_used_bytes"],
+            "engine_kv_verify_failures": s["kv_verify_failures"],
+            "engine_sessions_pinned": s["sessions_pinned"],
+            "engine_session_evictions": s["session_evictions"],
         }
 
     def metrics_text(self) -> str:
@@ -255,6 +274,8 @@ class JetStreamModel(Model):
             self.engine.telemetry.set_kv_pages(
                 s["free_pages"], s.get("cached_pages", 0),
                 self.engine.ec.num_pages - 1)  # page 0 is the trash page
+            self.engine.telemetry.set_kv_store_bytes(
+                s["kv_host_used_bytes"], s["kv_disk_used_bytes"])
             self.engine.telemetry.set_health(self.engine.health()["state"])
         except RuntimeError:  # engine stopped
             return ""
@@ -280,6 +301,15 @@ class JetStreamModel(Model):
         forwards verbatim; an explicit ``priority`` request param wins."""
         for k, v in (headers or {}).items():
             if k.lower() == "x-priority":
+                return v
+        return None
+
+    @staticmethod
+    def _header_session(headers: Optional[dict]):
+        """``X-Session-Id`` header — the session pin when the request body
+        carries no ``session_id`` parameter (the param wins)."""
+        for k, v in (headers or {}).items():
+            if k.lower() == "x-session-id":
                 return v
         return None
 
@@ -327,8 +357,16 @@ class JetStreamModel(Model):
                                    "non-negative token ids, got "
                                    f"{resume!r}")
             resume = list(resume)
+        # conversation pinning (README "Sessions & tiered KV"): the engine
+        # parks this turn's KV under the id and the next turn restores it;
+        # an X-Session-Id header stands in when the parameter is absent
+        session = params.get("session_id")
+        if session is None:
+            session = self._header_session(headers)
+        if session is not None:
+            session = normalize_session_id(session)  # RequestError -> 400
         return (self.tokenizer.encode(prompt) or [0], max_tokens,
-                params.get("adapter"), deadline, priority, resume)
+                params.get("adapter"), deadline, priority, resume, session)
 
     def generate(self, payload: Any, headers: Optional[dict] = None) -> Any:
         """V2 generate extension (unary): {"text_input": str, "parameters":
@@ -336,8 +374,12 @@ class JetStreamModel(Model):
         "batch" | "best_effort"}} -> {"text_output": str, ...}.  An
         ``X-Priority`` header supplies the QoS class when the parameter is
         absent.  A truthy ``X-Request-Trace`` header adds the request's
-        lifecycle span (``Engine.trace``) as a ``trace`` field."""
-        ids, max_tokens, adapter, deadline, priority, resume = \
+        lifecycle span (``Engine.trace``) as a ``trace`` field.  A
+        ``session_id`` parameter (or ``X-Session-Id`` header) pins the
+        turn's KV for the next turn and restores this turn's prefix from
+        the tiered store; the response carries a ``session`` block
+        (restore tier, pinned/durable flags, evictions)."""
+        ids, max_tokens, adapter, deadline, priority, resume, session = \
             self._parse_generate(payload, headers)
         resume = resume or []
         max_new = max_tokens - len(resume)
@@ -348,7 +390,8 @@ class JetStreamModel(Model):
                     "tokens": len(resume), "prompt_tokens": len(ids),
                     "max_tokens": max_tokens, "ttft_s": 0.0, "latency_s": 0.0}
         r = self.engine.generate(ids + resume, max_new, adapter=adapter,
-                                 deadline=deadline, priority=priority)
+                                 deadline=deadline, priority=priority,
+                                 session_id=session)
         # the seam slices at the STABLE prefix of the resumed text: resume
         # ids may end mid-UTF-8 sequence, whose completed decoding spans a
         # different char count than its U+FFFD placeholders (same rule as
@@ -361,6 +404,8 @@ class JetStreamModel(Model):
                "tokens": r["num_tokens"] + len(resume),
                "prompt_tokens": len(ids), "max_tokens": max_tokens,
                "ttft_s": round(r["ttft_s"], 4), "latency_s": round(r["latency_s"], 4)}
+        if "session" in r:
+            out["session"] = r["session"]
         if self._wants_trace(headers):
             out["trace"] = self.engine.trace(r["rid"])
         return out
@@ -386,7 +431,7 @@ class JetStreamModel(Model):
         ``parameters.resume_token_ids`` list folds previously-generated ids
         into the prompt so the stream emits only the continuation.
         """
-        ids, max_tokens, adapter, deadline, priority, resume = \
+        ids, max_tokens, adapter, deadline, priority, resume, session = \
             self._parse_generate(payload, headers)
         resume = resume or []
         emit_ids = self._wants_ids(headers)
@@ -396,7 +441,8 @@ class JetStreamModel(Model):
         stream = self.engine.generate_stream(ids + resume, max_new,
                                              adapter=adapter,
                                              deadline=deadline,
-                                             priority=priority)
+                                             priority=priority,
+                                             session_id=session)
         return self._stream_pieces(stream, ids, max_tokens,
                                    with_trace=self._wants_trace(headers),
                                    emit_ids=emit_ids, prior_ids=resume)
@@ -443,6 +489,8 @@ class JetStreamModel(Model):
                              "prompt_tokens": len(ids), "max_tokens": max_tokens,
                              "ttft_s": round(item["ttft_s"], 4),
                              "latency_s": round(item["latency_s"], 4)}
+                    if "session" in item:
+                        final["session"] = item["session"]
                     if with_trace:
                         final["trace"] = self.engine.trace(item["rid"])
                     yield final

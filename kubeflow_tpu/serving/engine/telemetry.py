@@ -241,6 +241,26 @@ class EngineTelemetry:
         self.pipeline_fences = r.counter(
             "engine_pipeline_fences_total",
             "decode-pipeline drains to a sync barrier, by reason")
+        # Tiered KV store / session surface (ISSUE 7): per-tier occupancy
+        # (set at scrape time from the store's stats), an operations
+        # counter labeled by tier and event (spill/evict/verify_fail/...),
+        # and session-turn restore outcomes by source — "host" and "disk"
+        # are warm hits, "cache" means the device prefix cache already
+        # covered the prefix, "cold"/"degraded" are the re-prefill paths
+        # (degraded = the store had the session but verification failed).
+        self.kv_store_bytes = r.gauge(
+            "engine_kv_store_bytes",
+            "tiered KV store occupancy in bytes, by tier (host/disk)")
+        self.kv_store_events = r.counter(
+            "engine_kv_store_events_total",
+            "tiered KV store operations by tier and event")
+        self.session_restores = r.counter(
+            "engine_session_restores_total",
+            "session-turn KV restore outcomes by source "
+            "(host/disk/cache/cold/degraded)")
+        self.session_pins = r.counter(
+            "engine_session_pins_total",
+            "session pin attempts by outcome (pinned/durable/rejected)")
         # Fleet robustness surface (ISSUE 6): the engine's health state as a
         # one-hot labeled gauge so dashboards can plot state transitions —
         # the scrape-time complement of the router's active /engine/health
@@ -287,6 +307,23 @@ class EngineTelemetry:
     def count_fence(self, reason: str) -> None:
         if self.enabled:
             self.pipeline_fences.inc(reason=reason)
+
+    def count_kv_event(self, tier: str, event: str) -> None:
+        if self.enabled:
+            self.kv_store_events.inc(tier=tier, event=event)
+
+    def count_session_restore(self, source: str) -> None:
+        if self.enabled:
+            self.session_restores.inc(source=source)
+
+    def count_session_pin(self, outcome: str) -> None:
+        if self.enabled:
+            self.session_pins.inc(outcome=outcome)
+
+    def set_kv_store_bytes(self, host: int, disk: int) -> None:
+        if self.enabled:
+            self.kv_store_bytes.set(host, tier="host")
+            self.kv_store_bytes.set(disk, tier="disk")
 
     def observe_prefill_batch(self, rows: int) -> None:
         if self.enabled:

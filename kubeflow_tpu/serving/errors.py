@@ -33,6 +33,14 @@ class DeadlineExceeded(EngineError):
     completion (the client's cancel path covers abandonment)."""
 
 
+class SessionBusy(EngineError):
+    """A second request named a ``session_id`` that already has a request
+    queued or in flight: a session's KV timeline is strictly serial (turn
+    N+1's restore depends on turn N's pin), so concurrent turns are
+    refused at submit.  HTTP 409 — retry after the in-flight turn
+    resolves."""
+
+
 class EngineOverloaded(EngineError):
     """Admission control: the engine queue is at ``max_queue_depth`` and the
     submission was refused immediately (backpressure instead of unbounded

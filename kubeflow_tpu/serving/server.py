@@ -22,7 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 from .errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
-                     RequestError)
+                     RequestError, SessionBusy)
 
 
 class Model:
@@ -120,11 +120,14 @@ class ModelServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _send(self, code: int, body: Any, content_type: str = "application/json"):
+            def _send(self, code: int, body: Any, content_type: str = "application/json",
+                      extra_headers: Optional[dict] = None):
                 data = body.encode() if isinstance(body, str) else json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -342,6 +345,16 @@ class ModelServer:
             # request shed before its first token: the gateway timeout code,
             # so clients/routers distinguish "too slow" from "broken"
             h._send(504, {"error": f"{type(e).__name__}: {e}"})
+        except SessionBusy as e:
+            # a session's turns are strictly serial: a second concurrent
+            # turn conflicts with the in-flight one — 409, retry after it
+            # resolves (NOT 503: another replica cannot serve it either,
+            # the session's KV timeline lives with the in-flight turn)
+            if path.startswith("/openai/"):
+                h._send(409, {"error": {"message": str(e),
+                                        "type": "session_busy"}})
+            else:
+                h._send(409, {"error": f"{type(e).__name__}: {e}"})
         except (EngineOverloaded, EngineShutdown) as e:
             # backpressure / drain: retryable against another replica
             h._send(503, {"error": f"{type(e).__name__}: {e}"})
@@ -386,7 +399,7 @@ class ModelServer:
             out = verb(body, headers)
             out = dict(out) if isinstance(out, dict) else {"text_output": out}
             out.setdefault("model_name", name)
-            h._send(200, out)
+            h._send(200, out, extra_headers=_session_headers(out))
             return
         gen = verb(body, headers)
         self._sse_write(
@@ -507,7 +520,10 @@ class ModelServer:
                                   # body param wins; the model layer falls
                                   # back to the X-Priority header and 400s
                                   # unknown classes
-                                  "priority": body.get("priority")}}
+                                  "priority": body.get("priority"),
+                                  # conversation pinning passthrough (the
+                                  # model layer falls back to X-Session-Id)
+                                  "session_id": body.get("session_id")}}
         headers = dict(h.headers.items())
         oid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:24]}"
         obj = "chat.completion" if chat else "text_completion"
@@ -596,6 +612,25 @@ class ModelServer:
                 "outputs": [{"name": "output-0", "shape": shape, "datatype": dtype, "data": data}],
             }
         h._send(200, out)
+
+
+def _session_headers(out: dict) -> Optional[dict]:
+    """Session/eviction response headers for a unary generate (README
+    "Sessions & tiered KV"): the restore tier and pin outcome, plus HOW
+    MANY sessions the tiered store evicted to make room for this pin —
+    the client-visible pressure signal.  A count, never the evicted ids:
+    session ids are bearer capabilities and belong to other clients.
+    The id itself is safe to echo — validated to visible ASCII at parse
+    time (kvstore.normalize_session_id), so it cannot split headers."""
+    sess = out.get("session") if isinstance(out, dict) else None
+    if not isinstance(sess, dict):
+        return None
+    hdrs = {"X-Session-Id": sess.get("id", ""),
+            "X-Session-Restore": sess.get("restore", "cold"),
+            "X-Session-Pinned": "true" if sess.get("pinned") else "false"}
+    if sess.get("evicted"):
+        hdrs["X-Session-Evicted"] = str(sess["evicted"])
+    return hdrs
 
 
 def _as_v2_tensor(result: Any) -> tuple[list, list[int], str]:

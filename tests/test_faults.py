@@ -392,10 +392,11 @@ def test_parse_generate_deadline_param():
     from kubeflow_tpu.serving.engine.serve import JetStreamModel
 
     m = JetStreamModel("m", engine=None)
-    ids, mt, adapter, deadline, priority, resume = m._parse_generate(
+    ids, mt, adapter, deadline, priority, resume, session = m._parse_generate(
         {"text_input": "ab", "parameters": {"max_tokens": 4,
                                             "deadline_s": 2.5}})
     assert deadline == 2.5 and mt == 4 and priority is None and resume is None
+    assert session is None
     with pytest.raises(RequestError, match="deadline_s"):
         m._parse_generate({"text_input": "ab",
                            "parameters": {"deadline_s": "soon"}})

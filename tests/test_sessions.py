@@ -1,0 +1,544 @@
+"""Durable tiered KV store + crash-recoverable sessions (ISSUE 7).
+
+The acceptance headlines:
+
+  * a session turn restored from the tiered store — host tier, disk tier,
+    or post-restart manifest recovery — emits tokens BYTE-IDENTICAL to an
+    uninterrupted full-context run;
+  * every storage-fault class (torn write, bit flip/checksum mismatch,
+    slow disk, ENOSPC mid-spill, missing file) degrades to re-prefill:
+    the turn still completes, byte-identically, with 0 leaked KV pages;
+  * tier budgets reconcile to zero at drain, eviction under budget
+    pressure is LRU-ordered with unpinned (swap) entries going first,
+    and a concurrent same-session turn is refused (HTTP 409).
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.engine import Engine, EngineConfig, KVStoreConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import FaultConfig, StorageFaultConfig
+from kubeflow_tpu.serving.engine.kvstore import TieredKVStore
+from kubeflow_tpu.serving.errors import RequestError, SessionBusy
+
+pytestmark = pytest.mark.session
+
+CFG = M.DecoderConfig(vocab_size=101, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128)
+
+PAGE = 8
+PROMPT = [(i * 13) % (CFG.vocab_size - 1) + 1 for i in range(20)]
+TURN2_EXTRA = [5, 6, 7, 8, 9]
+TURN3_EXTRA = [11, 12, 13]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+def _ec(**kw):
+    base = dict(max_slots=4, num_pages=128, page_size=PAGE,
+                max_pages_per_slot=32)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _leaked(eng) -> int:
+    s = eng.stats
+    return (eng.ec.num_pages - 1) - s["free_pages"] - s["cached_pages"]
+
+
+@pytest.fixture(scope="module")
+def cold(params):
+    """The uninterrupted-oracle trajectories: each turn run cold (fresh
+    engine, full context, no sessions) — the byte-identity reference for
+    every tier/fault scenario below."""
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        r1 = eng.generate(PROMPT, 12)
+        ctx2 = PROMPT + r1["tokens"] + TURN2_EXTRA
+        r2 = eng.generate(ctx2, 12)
+        ctx3 = ctx2 + r2["tokens"] + TURN3_EXTRA
+        r3 = eng.generate(ctx3, 12)
+        return {"t1": r1["tokens"], "ctx2": ctx2, "t2": r2["tokens"],
+                "ctx3": ctx3, "t3": r3["tokens"]}
+    finally:
+        eng.stop()
+
+
+def _run_turns(eng, cold, sid="s", n=3):
+    """Drive the session conversation on ``eng``; returns per-turn results."""
+    out = [eng.generate(PROMPT, 12, session_id=sid)]
+    if n >= 2:
+        out.append(eng.generate(cold["ctx2"], 12, session_id=sid))
+    if n >= 3:
+        out.append(eng.generate(cold["ctx3"], 12, session_id=sid))
+    return out
+
+
+# ------------------------------------------------- tier-hit byte-identity
+
+
+def test_host_tier_warm_turn_byte_identical(params, cold, tmp_path):
+    eng = Engine(params, CFG, _ec(
+        kv_store=KVStoreConfig(disk_dir=str(tmp_path / "kv"))))
+    eng.start()
+    try:
+        r1, r2, r3 = _run_turns(eng, cold)
+        assert r1["tokens"] == cold["t1"]
+        assert r1["session"]["pinned"] and r1["session"]["durable"]
+        assert r2["tokens"] == cold["t2"]  # byte-identical to cold oracle
+        assert r2["session"]["restore"] == "host"
+        assert r3["tokens"] == cold["t3"]
+        assert r3["session"]["restore"] == "host"
+        assert _leaked(eng) == 0
+        s = eng.stats
+        assert s["sessions_pinned"] == 1
+        assert s["session_restores"]["host"] == 2
+    finally:
+        eng.stop()
+
+
+def test_disk_tier_warm_turn_byte_identical(params, cold, tmp_path):
+    """Host budget 0: the pin can only live as a disk page file, so the
+    warm turn restores through the checksummed read path."""
+    eng = Engine(params, CFG, _ec(
+        kv_store=KVStoreConfig(host_max_bytes=0,
+                               disk_dir=str(tmp_path / "kv"))))
+    eng.start()
+    try:
+        r1, r2 = _run_turns(eng, cold, n=2)
+        assert r1["tokens"] == cold["t1"]
+        assert r1["session"]["pinned"] and r1["session"]["durable"]
+        assert r2["tokens"] == cold["t2"]
+        assert r2["session"]["restore"] == "disk"
+        assert _leaked(eng) == 0
+        assert eng.stats["kv_host_used_bytes"] == 0
+    finally:
+        eng.stop()
+
+
+def test_full_restart_manifest_recovery(params, cold, tmp_path):
+    """A brand-new Engine pointed at the same disk_dir replays the session
+    manifest and restores the pinned turn byte-identically (lazy disk
+    re-adoption on first touch)."""
+    kv = KVStoreConfig(disk_dir=str(tmp_path / "kv"))
+    eng = Engine(params, CFG, _ec(kv_store=kv))
+    eng.start()
+    try:
+        r1 = eng.generate(PROMPT, 12, session_id="s")
+        assert r1["session"]["durable"]
+    finally:
+        eng.stop()
+
+    eng = Engine(params, CFG, _ec(kv_store=kv))
+    assert "s" in eng.sessions()  # manifest replayed before any touch
+    assert eng.sessions()["s"]["tiers"] == ["disk"]
+    eng.start()
+    try:
+        r2 = eng.generate(cold["ctx2"], 12, session_id="s")
+        assert r2["tokens"] == cold["t2"]
+        assert r2["session"]["restore"] == "disk"
+        assert _leaked(eng) == 0
+    finally:
+        eng.stop()
+
+
+def test_restore_after_watchdog_restart(params, cold, tmp_path):
+    """Watchdog restart between turns: the loop thread dies (injected),
+    the supervisor revives it, and the NEXT turn still restores the pinned
+    session from the host tier — while the restart's swap-store
+    reconciliation leaves no phantom swap traffic in stats (the
+    HostSwapStore.clear() satellite)."""
+    eng = Engine(params, CFG, _ec(
+        kv_store=KVStoreConfig(disk_dir=str(tmp_path / "kv")),
+        watchdog_interval_s=0.05,
+        chaos=FaultConfig(die_on_tick=10_000)))
+    eng.start()
+    try:
+        r1 = eng.generate(PROMPT, 12, session_id="s")
+        assert r1["session"]["pinned"]
+        # arm the loop death at the very next tick, then wait for the
+        # supervisor to notice and restart
+        restarts0 = eng.stats["restarts"]
+        eng._chaos.config = FaultConfig(die_on_tick=eng._chaos.tick + 1)
+        deadline = time.monotonic() + 30
+        while eng.stats["restarts"] == restarts0:
+            assert time.monotonic() < deadline, "watchdog never restarted"
+            time.sleep(0.02)
+        r2 = eng.generate(cold["ctx2"], 12, session_id="s")
+        assert r2["tokens"] == cold["t2"]
+        assert r2["session"]["restore"] == "host"  # pin survived the restart
+        s = eng.stats
+        # post-restart epoch: swap counters reconciled to zero, and the
+        # session turn performed no swap traffic to show
+        assert s["swapped_out"] == 0 and s["swapped_in"] == 0
+        assert s["swap_bytes_out"] == 0 and s["swap_used_bytes"] == 0
+        assert _leaked(eng) == 0
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------ storage-fault degradation
+
+
+def _chaos_engine(params, tmp_path, storage, host_max=0):
+    return Engine(params, CFG, _ec(
+        kv_store=KVStoreConfig(host_max_bytes=host_max,
+                               disk_dir=str(tmp_path / "kv"),
+                               chaos=storage)))
+
+
+@pytest.mark.parametrize("fault,expect_restore", [
+    (StorageFaultConfig(torn_write_every=1), "degraded"),
+    (StorageFaultConfig(bit_flip_every=1), "degraded"),
+    (StorageFaultConfig(enospc_every=1), "cold"),
+    (StorageFaultConfig(slow_read_s=0.05, slow_write_s=0.05), "disk"),
+])
+def test_storage_fault_classes_degrade_not_fail(params, cold, tmp_path,
+                                                fault, expect_restore):
+    """Every fault class: the session turn COMPLETES byte-identically.
+    Torn writes and bit flips are caught by the verifier (degraded ->
+    re-prefill); ENOSPC means the pin never landed (cold next turn); a
+    merely slow disk still restores correctly."""
+    eng = _chaos_engine(params, tmp_path, fault)
+    eng.start()
+    try:
+        r1 = eng.generate(PROMPT, 12, session_id="s")
+        assert r1["tokens"] == cold["t1"]
+        r2 = eng.generate(cold["ctx2"], 12, session_id="s")
+        assert r2["tokens"] == cold["t2"]  # degraded, never wrong
+        assert r2["session"]["restore"] == expect_restore
+        assert _leaked(eng) == 0
+        s = eng.stats
+        if expect_restore == "degraded":
+            assert s["kv_verify_failures"] >= 1
+            assert s["storage_chaos"]["injected_torn_writes"] \
+                + s["storage_chaos"]["injected_bit_flips"] >= 1
+        if expect_restore == "cold":
+            assert s["storage_chaos"]["injected_enospc"] >= 1
+            assert not r1["session"]["durable"]
+    finally:
+        eng.stop()
+
+
+def test_missing_page_file_degrades(params, cold, tmp_path):
+    """Delete the page file behind the store's back (disk wiped between
+    restarts): the restore misses, the turn re-prefills byte-identically."""
+    kvdir = str(tmp_path / "kv")
+    eng = Engine(params, CFG, _ec(
+        kv_store=KVStoreConfig(host_max_bytes=0, disk_dir=kvdir)))
+    eng.start()
+    try:
+        eng.generate(PROMPT, 12, session_id="s")
+        for name in os.listdir(kvdir):
+            if name.endswith(".kvpg"):
+                os.unlink(os.path.join(kvdir, name))
+        r2 = eng.generate(cold["ctx2"], 12, session_id="s")
+        assert r2["tokens"] == cold["t2"]
+        assert r2["session"]["restore"] == "degraded"
+        assert eng.stats["kv_verify_failures"] >= 1
+        assert _leaked(eng) == 0
+    finally:
+        eng.stop()
+
+
+def test_diverged_prompt_falls_back_cold(params, cold, tmp_path):
+    """A turn whose prompt does NOT extend the pinned context (the client
+    edited history) must not adopt mismatched KV: hash-prefix comparison
+    yields nothing usable and the turn runs cold — and correct."""
+    eng = Engine(params, CFG, _ec(
+        kv_store=KVStoreConfig(disk_dir=str(tmp_path / "kv"))))
+    eng.start()
+    try:
+        eng.generate(PROMPT, 12, session_id="s")
+        other = [(i * 7) % (CFG.vocab_size - 1) + 1 for i in range(40)]
+        oracle = eng.generate(other, 12)  # no session: plain run
+        got = eng.generate(other, 12, session_id="s2")  # fresh sid, cold
+        diverged = eng.generate(other, 12, session_id="s")
+        assert diverged["tokens"] == oracle["tokens"] == got["tokens"]
+        assert diverged["session"]["restore"] in ("cold", "cache")
+        assert _leaked(eng) == 0
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------- budgets, eviction, drain
+
+
+def test_eviction_under_budget_pressure_is_lru(tmp_path):
+    """Store-level eviction ordering: unpinned (swap) disk entries go
+    first; pinned sessions yield only to another pinned entry, least-
+    recently-used first — and the evicted ids are reported to the caller
+    (the eviction-headers surface)."""
+    blob = (np.arange(256, dtype=np.float32),)  # 1 KiB payload
+    kv = TieredKVStore(KVStoreConfig(host_max_bytes=0, disk_max_bytes=2500,
+                                     disk_dir=str(tmp_path / "kv")))
+    assert kv.pin_session("a", blob, 1024, {})["pinned"]
+    assert kv.pin_session("b", blob, 1024, {})["pinned"]
+    assert kv.restore_session("a")[0] == "disk"  # touch: b is now LRU
+    res = kv.pin_session("c", blob, 1024, {})
+    assert res["pinned"] and res["evicted"] == ["b"]  # LRU session evicted
+    out_b, _ = kv.restore_session("b")
+    assert out_b == "miss"
+    assert kv.restore_session("a")[0] == "disk"  # survivor intact
+    s = kv.stats()
+    assert s["session_evictions"] == 1 and s["sessions_pinned"] == 2
+
+    # the per-pin eviction report must survive the ops ring's 16-entry
+    # trim: after MANY lifetime evictions, a pin that evicts still
+    # reports exactly its own victims (pressure reporting must not go
+    # dark exactly when pressure is highest)
+    for i in range(40):
+        res = kv.pin_session(f"churn-{i}", blob, 1024, {})
+        assert res["pinned"]
+        if i >= 2:
+            assert len(res["evicted"]) == 1, (i, res)
+    assert len(kv.last_evicted_sessions) == 16  # ops ring stays bounded
+
+    # unpinned-first: a swap spill victim is chosen before any session
+    kv2 = TieredKVStore(KVStoreConfig(host_max_bytes=1024,
+                                      disk_max_bytes=2200,
+                                      disk_dir=str(tmp_path / "kv2")))
+    assert kv2.pin_session("keep", blob, 1024, {})["pinned"]
+    assert kv2.put_swap(1, blob, 1024)          # host tier
+    assert kv2.put_swap(2, blob, 1024)          # spills swap/1 to disk
+    assert kv2.pin_session("keep2", blob, 1024, {})["pinned"]  # needs room
+    s2 = kv2.stats()
+    assert s2["kv_disk_evictions"] == 1         # swap/1 evicted, not a session
+    assert s2["session_evictions"] == 0
+    assert kv2.restore_session("keep")[0] in ("host", "disk")
+
+
+def test_degraded_repin_keeps_previous_durable_copy(tmp_path):
+    """A re-pin whose disk write fails (ENOSPC on the 2nd write) serves
+    the NEW context from the host tier but carries the PREVIOUS version's
+    durable snapshot — a restart recovers the older, shorter context
+    (whose hashes are a prefix of the new one) instead of losing the
+    conversation outright."""
+    blob1 = (np.arange(256, dtype=np.float32),)
+    blob2 = (np.arange(512, dtype=np.float32),)
+    kv_cfg = dict(host_max_bytes=1 << 20, disk_max_bytes=1 << 20,
+                  disk_dir=str(tmp_path / "kv"))
+    kv = TieredKVStore(KVStoreConfig(
+        **kv_cfg, chaos=StorageFaultConfig(enospc_on=2)))
+    assert kv.pin_session("s", blob1, 1024, {"hashes": [1]})["durable"]
+    r2 = kv.pin_session("s", blob2, 2048, {"hashes": [1, 2]})
+    assert r2["pinned"] and not r2["durable"] and r2["stale_durable"]
+    out, payload = kv.restore_session("s")  # live store: new version, host
+    assert out == "host" and np.array_equal(payload[0][0], blob2[0])
+    kv2 = TieredKVStore(KVStoreConfig(**kv_cfg))  # restart: old version
+    out, payload = kv2.restore_session("s")
+    assert out == "disk"
+    assert np.array_equal(payload[0][0], blob1[0])
+    assert payload[2]["hashes"] == [1]  # the FILE's meta, not the host's
+
+
+def test_ephemeral_store_dir_removed_on_stop(params):
+    """Default config (no explicit disk_dir): the store's private tempdir
+    is deleted at Engine.stop() — page files must not accumulate in /tmp
+    across engine lifecycles."""
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        eng.generate(PROMPT, 12, session_id="s")
+        d = eng._kv.disk_dir
+        assert d and os.path.isdir(d)
+    finally:
+        eng.stop()
+    assert not os.path.exists(d)
+
+
+def test_budgets_reconcile_to_zero_at_drain(params, cold, tmp_path):
+    """After the conversation ends and the session is dropped, every tier
+    reads zero bytes — nothing leaks into host RAM, disk, or the device
+    page pool."""
+    kvdir = str(tmp_path / "kv")
+    eng = Engine(params, CFG, _ec(kv_store=KVStoreConfig(disk_dir=kvdir)))
+    eng.start()
+    try:
+        _run_turns(eng, cold)
+        assert eng.stats["sessions_pinned"] == 1
+        assert eng.drop_session("s")
+        assert not eng.drop_session("s")  # already gone
+        s = eng.stats
+        assert s["kv_host_used_bytes"] == 0
+        assert s["kv_disk_used_bytes"] == 0
+        assert s["swap_used_bytes"] == 0
+        assert _leaked(eng) == 0
+        assert not [f for f in os.listdir(kvdir) if f.endswith(".kvpg")]
+        # manifest reflects the drop: a restarted engine sees no sessions
+        eng2 = Engine(params, CFG, _ec(kv_store=KVStoreConfig(disk_dir=kvdir)))
+        assert eng2.sessions() == {}
+    finally:
+        eng.stop()
+
+
+def test_short_context_pin_degrades(params, tmp_path):
+    """A turn whose committed context spans less than one full page has
+    nothing restorable to pin — reported, not failed."""
+    eng = Engine(params, CFG, _ec(
+        kv_store=KVStoreConfig(disk_dir=str(tmp_path / "kv"))))
+    eng.start()
+    try:
+        r = eng.generate(PROMPT[:3], 2, session_id="tiny")
+        assert not r["session"]["pinned"]
+        assert "page" in r["session"]["error"]
+        assert _leaked(eng) == 0
+    finally:
+        eng.stop()
+
+
+def test_preemption_storm_with_sessions(params, cold, tmp_path):
+    """Sessions and the QoS preemption machinery compose: under a forced
+    preemption storm the session turns still restore/pin byte-identically
+    with zero leaks (swap traffic and session pins share the tiered
+    store)."""
+    eng = Engine(params, CFG, _ec(
+        kv_store=KVStoreConfig(disk_dir=str(tmp_path / "kv")),
+        chaos=FaultConfig(preempt_every=5)))
+    eng.start()
+    try:
+        r1, r2 = _run_turns(eng, cold, n=2)
+        assert r1["tokens"] == cold["t1"]
+        assert r2["tokens"] == cold["t2"]
+        assert _leaked(eng) == 0
+        assert eng.stats["swap_used_bytes"] == 0
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------- concurrency + HTTP
+
+
+def test_concurrent_same_session_rejected(params, tmp_path):
+    eng = Engine(params, CFG, _ec(
+        max_slots=1, kv_store=KVStoreConfig(disk_dir=str(tmp_path / "kv"))))
+    eng.start()
+    try:
+        fut = eng.generate_async(PROMPT, 30, session_id="s")
+        with pytest.raises(SessionBusy):
+            eng.generate_async(PROMPT + [1], 4, session_id="s")
+        fut.result(timeout=180)
+        # in-flight turn resolved: the session accepts again
+        r = eng.generate(PROMPT + [1, 2], 4, session_id="s")
+        assert r["session"]["id"] == "s"
+    finally:
+        eng.stop()
+
+    # validation happens before any registration; session ids echo into
+    # HTTP response headers, so control chars / non-ASCII must be refused
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        for bad in ("", 7, "x" * 300, "evil\r\nSet-Cookie: a=b",
+                    "sp ace", "emoji-\U0001f600"):
+            with pytest.raises(RequestError):
+                eng.generate_async(PROMPT, 2, session_id=bad)
+    finally:
+        eng.stop()
+
+
+def test_http_session_api(params, cold, tmp_path):
+    """The full HTTP surface: session_id parameter (and X-Session-Id
+    header), the response session block, the X-Session-* response headers,
+    and 409 on a concurrent same-session turn."""
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+    from kubeflow_tpu.serving.server import ModelServer
+
+    eng = Engine(params, CFG, _ec(
+        kv_store=KVStoreConfig(disk_dir=str(tmp_path / "kv"))))
+    model = JetStreamModel("llm", engine=eng)
+    srv = ModelServer([model], port=0)
+    srv.start()
+    try:
+        tok = model.tokenizer
+
+        def gen(prompt_ids, body_extra=None, headers=None):
+            body = {"text_input": tok.decode(prompt_ids),
+                    "parameters": {"max_tokens": 12,
+                                   **(body_extra or {})}}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v2/models/llm/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json",
+                         **(headers or {})})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return json.loads(r.read()), dict(r.headers)
+
+        # byte-token prompts survive the decode/encode round trip
+        p1 = tok.encode(tok.decode(PROMPT))
+        out, hdrs = gen(p1, {"session_id": "web"})
+        assert out["session"]["id"] == "web" and out["session"]["pinned"]
+        assert hdrs["X-Session-Id"] == "web"
+        assert hdrs["X-Session-Restore"] == "cold"
+        assert hdrs["X-Session-Pinned"] == "true"
+        ctx2 = p1 + out["token_ids"] + TURN2_EXTRA
+        out2, hdrs2 = gen(ctx2, headers={"X-Session-Id": "web"})
+        assert hdrs2["X-Session-Restore"] == "host"
+        assert out2["session"]["restore"] == "host"
+
+        # concurrent turn -> 409 (hold the engine's only path busy via a
+        # long low-priority run on the same session)
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(1) as ex:
+            slow = ex.submit(gen, ctx2 + out2["token_ids"] + [1],
+                             {"session_id": "web", "max_tokens": 40})
+            time.sleep(0.2)  # the slow turn is registered by now
+            with pytest.raises(urllib.error.HTTPError) as err:
+                gen(p1, {"session_id": "web"})
+            assert err.value.code == 409
+            assert "session" in json.loads(err.value.read())["error"].lower()
+            slow.result(timeout=180)
+
+        # bad session_id -> 400
+        with pytest.raises(urllib.error.HTTPError) as err:
+            gen(p1, {"session_id": ""})
+        assert err.value.code == 400
+
+        # metric exposition: per-tier occupancy + restore counter series
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert 'engine_kv_store_bytes{tier="host",model="llm"}' in text
+        assert 'engine_session_restores_total' in text
+        assert 'source="host"' in text
+    finally:
+        srv.stop()
+        eng.stop()
+
+
+def test_chat_session_driver(params, tmp_path):
+    """agent.ChatSession: transcript accumulation across turns, warm
+    restores after the first turn, and end() dropping the pin."""
+    from kubeflow_tpu.serving.agent import ChatSession
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+
+    eng = Engine(params, CFG, _ec(
+        kv_store=KVStoreConfig(disk_dir=str(tmp_path / "kv"))))
+    model = JetStreamModel("llm", engine=eng)
+    model.load()
+    try:
+        chat = ChatSession(model, max_tokens=10)
+        out1 = chat.turn("hello there, long opening message!")
+        assert chat.turns == 1 and chat.restore_history == ["cold"]
+        assert chat.transcript.startswith("hello there")
+        out2 = chat.turn(" tell me more about that topic")
+        assert chat.restore_history[1] in ("host", "disk")
+        assert out2["session"]["pinned"]
+        assert chat.session_id in eng.sessions()
+        assert chat.end()
+        assert chat.session_id not in eng.sessions()
+    finally:
+        eng.stop()
