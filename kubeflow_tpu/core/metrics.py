@@ -9,6 +9,7 @@ simulator share a process), text exposition format, optional HTTP server.
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Optional, Sequence
 
@@ -57,6 +58,16 @@ def add_const_labels(text: str, labels: dict) -> str:
     return "\n".join(out) + ("\n" if text.endswith("\n") else "")
 
 
+def _fmt_value(v: float) -> str:
+    """Exact sample rendering: %g keeps 6 significant digits, which would
+    round counters/sums past ~1e6 at the SOURCE exposition and break the
+    fleet merge's sum-exact contract before merging even starts.  Integral
+    values render as ints, everything else at full precision."""
+    if math.isfinite(v) and v == int(v):
+        return str(int(v))
+    return repr(v)
+
+
 class _Metric:
     def __init__(self, name: str, help_: str, kind: str):
         self.name = name
@@ -76,12 +87,21 @@ class _Metric:
         with self._lock:
             return dict(self._values)
 
+    def remove(self, **labels) -> None:
+        """Drop one label set's sample entirely.  A gauge whose underlying
+        signal has no data must STOP exporting, not freeze at its last
+        value (the SLO exporter uses this when a series' samples age out
+        of every window)."""
+        with self._lock:
+            self._values.pop(self.labels_key(labels), None)
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         with self._lock:
             for key, v in sorted(self._values.items()):
                 label_s = _fmt_labels(key)
-                lines.append(f"{self.name}{{{label_s}}} {v:g}" if label_s else f"{self.name} {v:g}")
+                v_s = _fmt_value(v)
+                lines.append(f"{self.name}{{{label_s}}} {v_s}" if label_s else f"{self.name} {v_s}")
         return "\n".join(lines)
 
 
@@ -143,6 +163,12 @@ class Histogram(_Metric):
             s[1] += value
             s[2] += 1
 
+    def remove(self, **labels) -> None:
+        # histogram samples live in _series, not the base class's _values —
+        # without this override remove() would silently no-op
+        with self._lock:
+            self._series.pop(self.labels_key(labels), None)
+
     def snapshot(self, **labels) -> dict:
         """(cumulative bucket counts, sum, count) for one label set —
         test/bench introspection without parsing the text format."""
@@ -189,7 +215,7 @@ class Histogram(_Metric):
                 lab = (base + "," if base else "") + 'le="+Inf"'
                 lines.append(f"{self.name}_bucket{{{lab}}} {count}")
                 sfx = f"{{{base}}}" if base else ""
-                lines.append(f"{self.name}_sum{sfx} {sum_:g}")
+                lines.append(f"{self.name}_sum{sfx} {_fmt_value(sum_)}")
                 lines.append(f"{self.name}_count{sfx} {count}")
         return "\n".join(lines)
 
@@ -221,10 +247,155 @@ class Registry:
                 m = self._metrics[name] = Histogram(name, help_, buckets)
             return m  # type: ignore[return-value]
 
+    def names(self) -> list[str]:
+        """Registered metric names — the metrics-conformance test walks
+        these against the README metric table."""
+        with self._lock:
+            return sorted(self._metrics)
+
     def render(self) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
         return "\n".join(m.render() for m in metrics) + "\n"
+
+
+# ------------------------------------------------ fleet-scrape merge helpers
+
+_EXPO_SAMPLE = None  # compiled lazily (merge is a debug/scrape-time path)
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse Prometheus text exposition into
+    ``{name: {"type": kind|None, "help": str|None, "samples":
+    [(labels_dict, value)]}}``.  Histogram component series
+    (``_bucket``/``_sum``/``_count``) are grouped under their base name's
+    entry when a ``# TYPE <base> histogram`` line declared them."""
+    global _EXPO_SAMPLE
+    if _EXPO_SAMPLE is None:
+        import re
+        _EXPO_SAMPLE = (
+            re.compile(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$'),
+            re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"'),
+            re.compile(r'\\(.)'))
+    sample_re, label_re, esc_re = _EXPO_SAMPLE
+    # single-pass unescape: chained str.replace would decode the \\ of a
+    # literal backslash FIRST or LAST and either way corrupt sequences
+    # like backslash-then-n (escaped as \\n, which must NOT become \n)
+    unescape = lambda v: esc_re.sub(  # noqa: E731
+        lambda m: {"n": "\n"}.get(m.group(1), m.group(1)), v)
+    out: dict = {}
+
+    def entry(name: str) -> dict:
+        return out.setdefault(name, {"type": None, "help": None,
+                                     "samples": []})
+
+    hist_bases: set = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            if len(parts) >= 4:
+                entry(parts[2])["type"] = parts[3].strip()
+                if parts[3].strip() == "histogram":
+                    hist_bases.add(parts[2])
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) >= 3:
+                entry(parts[2])["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if m is None:
+            continue
+        name, labs, val = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            value = float(val)
+        except ValueError:
+            continue
+        labels = {k: unescape(v) for k, v in label_re.findall(labs)}
+        # histogram component samples file under the BASE name so merge
+        # logic sees one histogram, not three pseudo-metrics
+        base = name
+        for sfx in ("_bucket", "_sum", "_count"):
+            if name.endswith(sfx) and name[:-len(sfx)] in hist_bases:
+                base = name[:-len(sfx)]
+                labels["__series__"] = sfx
+                break
+        entry(base)["samples"].append((labels, value))
+    return out
+
+
+def merge_expositions(replica_texts: dict) -> str:
+    """Merge per-replica /metrics expositions into one fleet view
+    (``GET /fleet/metrics`` on the service proxy).
+
+    Counters and histograms are ADDITIVE across replicas: series with the
+    same label set sum sample-by-sample — histogram buckets, ``_sum`` and
+    ``_count`` are all plain sums, so the merged histogram is exactly the
+    histogram of the union of observations (sum-exact, tested).  Gauges
+    are NOT additive (two replicas' occupancy ratios don't add): each
+    gauge sample instead keeps its replica as a ``replica`` label.
+    Untyped samples (the model server's flat extra_metrics gauges) are
+    treated as gauges.  ``replica_texts``: {replica_label: exposition}."""
+    merged: dict = {}
+    for replica in sorted(replica_texts):
+        parsed = parse_exposition(replica_texts[replica])
+        for name, rec in parsed.items():
+            m = merged.setdefault(name, {"type": rec["type"],
+                                         "help": rec["help"],
+                                         "series": {}})
+            if m["type"] is None:
+                m["type"] = rec["type"]
+            if m["help"] is None:
+                m["help"] = rec["help"]
+            kind = rec["type"] or "gauge"
+            additive = kind in ("counter", "histogram")
+            for labels, value in rec["samples"]:
+                labels = dict(labels)
+                if not additive:
+                    labels["replica"] = replica
+                key = tuple(sorted(labels.items()))
+                if additive:
+                    m["series"][key] = m["series"].get(key, 0.0) + value
+                else:
+                    m["series"][key] = value
+    lines = []
+    for name in sorted(merged):
+        m = merged[name]
+        kind = m["type"] or "gauge"
+        if m["help"] is not None:
+            lines.append(f"# HELP {name} {m['help']}")
+        lines.append(f"# TYPE {name} {kind}")
+        for key in sorted(m["series"], key=_series_sort_key):
+            labels = dict(key)
+            sfx = labels.pop("__series__", "")
+            label_s = _fmt_labels(tuple(sorted(labels.items())))
+            sample_name = name + sfx
+            body = f"{sample_name}{{{label_s}}}" if label_s else sample_name
+            lines.append(f"{body} {_fmt_value(m['series'][key])}")
+    return "\n".join(lines) + "\n"
+
+
+def _series_sort_key(key: tuple) -> tuple:
+    """Stable series ordering for the merged exposition: histogram
+    component (_bucket < _sum < _count), then bucket bound numerically,
+    then the remaining labels lexically — so merged buckets render in
+    ascending-le order like a native histogram."""
+    labels = dict(key)
+    sfx = labels.pop("__series__", "")
+    sfx_rank = {"": 0, "_bucket": 0, "_sum": 1, "_count": 2}.get(sfx, 3)
+    le = labels.pop("le", None)
+    if le == "+Inf":
+        le_rank = float("inf")
+    else:
+        try:
+            le_rank = float(le) if le is not None else float("-inf")
+        except ValueError:
+            le_rank = float("inf")
+    return (tuple(sorted(labels.items())), sfx_rank, le_rank)
 
 
 REGISTRY = Registry()

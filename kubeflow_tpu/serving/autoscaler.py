@@ -25,6 +25,7 @@ ANNOTATION) are exiting and count toward neither capacity nor load.
 from __future__ import annotations
 
 import math
+import re
 import time
 import urllib.request
 from typing import Optional
@@ -54,6 +55,20 @@ STALE_SAMPLE_WINDOW_S = 2.0
 # not pin the fleet size forever — past this window scaling resumes
 UNHEALTHY_VETO_WINDOW_S = 30.0
 
+# slo_attainment_ratio{class="...",metric="...",model="..."} sample keys in
+# a scraped exposition (the engine registry's per-class SLO gauges,
+# serving/slo.py) — collected READ-ONLY into the autoscaler's slo_view for
+# now: ROADMAP item 4 scales replicas on p99-TTFT attainment per class,
+# and this is that exact input signal; the scaling decision itself is a
+# later PR, deliberately decoupled from landing the signal plane.
+# The lookahead regex is safe here ONLY because both label values are
+# engine-controlled identifiers (normalized priority classes and the
+# fixed slo.SLO_METRICS names) that can never contain quotes/escapes;
+# free-form label values need core.metrics.parse_exposition instead.
+_SLO_SAMPLE_RE = re.compile(
+    r'^slo_attainment_ratio\{(?=[^}]*class="(?P<cls>[^"]*)")'
+    r'(?=[^}]*metric="(?P<metric>[^"]*)")[^}]*\}$')
+
 
 def scrape_metrics(port: int, timeout: float = DEFAULT_SCRAPE_TIMEOUT_S) -> Optional[dict]:
     try:
@@ -65,7 +80,10 @@ def scrape_metrics(port: int, timeout: float = DEFAULT_SCRAPE_TIMEOUT_S) -> Opti
     for line in text.splitlines():
         if line.startswith("#") or not line.strip():
             continue
-        k, _, v = line.partition(" ")
+        # split on the LAST space: the value never contains one, but a
+        # label VALUE can (model names ride in via add_const_labels), and
+        # truncating the key there would silently drop the series
+        k, _, v = line.rpartition(" ")
         try:
             out[k] = float(v)
         except ValueError:
@@ -91,21 +109,32 @@ class ConcurrencyAutoscaler:
         # deployment uid -> monotonic time unhealthiness was first seen
         # (bounds the unhealthy scale-down veto)
         self._unhealthy_since: dict[str, float] = {}
+        # deployment uid -> {(class, metric): worst attainment across
+        # replicas} — the SLO signal plane, read-only for now (see
+        # _SLO_SAMPLE_RE); surfaced via slo_view()
+        self._slo_view: dict[str, dict] = {}
 
     def sync(self) -> bool:
         changed = False
         self._live_uids = set()
+        deploy_uids = set()
         for deploy in self.api.list("Deployment"):
             ann = deploy["metadata"].get("annotations", {})
             if TARGET_CONCURRENCY_ANNOTATION not in ann:
                 continue
+            deploy_uids.add(deploy["metadata"]["uid"])
             if self._autoscale(deploy, ann):
                 changed = True
         # drop cached samples for pods that no longer exist (recreated pods
-        # get fresh uids; deleted deployments stop accumulating entries)
+        # get fresh uids; deleted deployments stop accumulating entries);
+        # same pruning for the SLO view — a deleted deployment must not
+        # haunt slo_view() as a phantom violator
         for uid in list(self._samples):
             if uid not in self._live_uids:
                 del self._samples[uid]
+        for uid in list(self._slo_view):
+            if uid not in deploy_uids:
+                del self._slo_view[uid]
         return changed
 
     def _autoscale(self, deploy: Obj, ann: dict) -> bool:
@@ -126,6 +155,7 @@ class ConcurrencyAutoscaler:
         ready = 0
         unscraped = 0
         unhealthy = 0
+        slo_worst: dict = {}
         last_traffic = self._last_traffic.get(uid, 0.0)
         now_mono = time.monotonic()
         for p in pods:
@@ -162,8 +192,16 @@ class ConcurrencyAutoscaler:
             # capacity — it vetoes scale-down below
             if "engine_serving" in m and m["engine_serving"] < 1.0:
                 unhealthy += 1
+            # SLO attainment per (class, metric), worst replica wins —
+            # collected only; scaling stays concurrency-driven this PR
+            for k, v in m.items():
+                sm = _SLO_SAMPLE_RE.match(k)
+                if sm is not None:
+                    key = (sm.group("cls"), sm.group("metric"))
+                    slo_worst[key] = min(slo_worst.get(key, 1.0), v)
             last_traffic = max(last_traffic, m.get("last_request_timestamp", 0.0))
         self._last_traffic[uid] = last_traffic
+        self._slo_view[uid] = slo_worst
 
         if current == 0:
             return False  # activation is the router's job
@@ -218,6 +256,15 @@ class ConcurrencyAutoscaler:
             ):
                 return self._scale(deploy, 0, zero=True)
         return False
+
+    def slo_view(self) -> dict:
+        """Read-only per-deployment SLO attainment, worst replica per
+        (class, metric): ``{deployment_uid: {(class, metric):
+        attainment}}``.  This is ROADMAP item 4's autoscaling input —
+        exposed now so dashboards/operators (and the eventual SLO-driven
+        scaler) read one coherent view; no scaling decision consumes it
+        yet."""
+        return {uid: dict(v) for uid, v in self._slo_view.items()}
 
     def _scale(self, deploy: Obj, replicas: int, zero: bool) -> bool:
         ann_patch = {SCALED_TO_ZERO_ANNOTATION: "true" if zero else None}

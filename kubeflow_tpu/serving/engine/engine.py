@@ -73,6 +73,7 @@ import numpy as np
 from ..errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
                       NonFiniteLogits, RequestError, SessionBusy,
                       TickFailure)
+from ..slo import SloConfig, SloTracker
 from .faults import ChaosInjector, FaultConfig
 from .kvstore import KVStoreConfig, TieredKVStore, normalize_session_id
 from .scheduler import (PRIORITY_RANK, QosScheduler, QueueEntry,
@@ -203,8 +204,17 @@ class EngineConfig:
     flight_recorder_capacity: int = 256
     flight_dir: Optional[str] = None
     # completed request spans kept for Engine.trace(rid) after the request
-    # resolves (live requests are always traceable)
+    # resolves (live requests are always traceable).  Budgeted in BOTH
+    # entries and approximate bytes (spans vary in size with prefill
+    # chunks, preemption cycles, links): a long-lived fleet replica must
+    # not grow span history without bound.  Evictions count in
+    # engine_trace_evictions_total.
     trace_history: int = 512
+    trace_history_bytes: int = 1_000_000
+    # per-class SLO targets/windows (serving/slo.py; engine.json "slo"
+    # block).  None = SloConfig() defaults — tracking runs whenever
+    # telemetry does, so slo_attainment_ratio{class,metric} always exports
+    slo: "Optional[SloConfig]" = None
     # deterministic chaos injection (faults.py) — test/bench substrate
     chaos: Optional[FaultConfig] = None
     # ---- QoS scheduling (README "Scheduling & QoS") ---------------------
@@ -500,7 +510,10 @@ class Engine:
         # per-engine registry (TTFT/TPOT/queue-wait/tick histograms + KV
         # gauges), tick-event ring for postmortems, completed-span history
         # for trace(rid), and the on-demand jax.profiler capture hook
-        self.telemetry = EngineTelemetry(enabled=engine_config.telemetry)
+        self.telemetry = EngineTelemetry(
+            enabled=engine_config.telemetry,
+            slo=(SloTracker(engine_config.slo or SloConfig())
+                 if engine_config.telemetry else None))
         # tiered KV backing store (kvstore.py): preemption swap blobs +
         # pinned session KV over host RAM aging to checksummed disk page
         # files; a stable disk_dir makes pinned sessions survive a full
@@ -513,6 +526,19 @@ class Engine:
             capacity=engine_config.flight_recorder_capacity,
             dump_dir=engine_config.flight_dir)
         self._trace_ring: "dict[int, RequestSpan]" = {}
+        # retained-size accounting for the trace ring (trace_history_bytes
+        # budget; sizes cached per rid so evict decrements exactly what
+        # archive charged)
+        self._trace_ring_bytes = 0
+        self._trace_sizes: dict[int, int] = {}
+        # trace id -> flight-recorder dump paths referencing it (bounded):
+        # a failover postmortem finds the dying replica's flight dump from
+        # the assembled trace tree instead of grepping the flight dir
+        self._trace_dumps: "dict[str, list[str]]" = {}
+        # session id -> (trace_id, span_id) of its most recent terminal
+        # turn, so turn N+1's span links turn N (bounded alongside
+        # _trace_dumps by _TRACE_REF_CAP)
+        self._session_spans: "dict[str, tuple[str, str]]" = {}
         self._nan_dump_tick = -1  # last tick that produced a NaN dump
         self._profiler = TickProfiler()
         self._wd_stop = threading.Event()
@@ -639,7 +665,9 @@ class Engine:
                        adapter: Optional[str] = None,
                        deadline: Optional[float] = None,
                        priority: Optional[str] = None,
-                       session_id: Optional[str] = None) -> Future:
+                       session_id: Optional[str] = None,
+                       trace=None,
+                       links: Optional[list] = None) -> Future:
         """Submit a prompt; the Future resolves to a result dict.
 
         ``stream``: optional queue that receives each token id as it is
@@ -657,6 +685,13 @@ class Engine:
         id and the NEXT turn with the same id — whose prompt must extend
         this turn's context — restores them instead of re-prefilling;
         a second turn while one is in flight raises SessionBusy (409).
+        ``trace``: a ``core.tracing.TraceContext`` to adopt — the
+        request's span joins that trace as a child (the ingress relay
+        passes the hop context here via the ``traceparent`` header); a
+        fresh trace is minted when absent.  ``links``: cross-trace span
+        links (e.g. the failed relay hop a re-admission resumes from);
+        a ``session_prev`` link to the session's previous turn is added
+        automatically.
         Raises EngineOverloaded when the queue is at ``max_queue_depth``
         and EngineShutdown once stop() has begun."""
         if not tokens:
@@ -708,12 +743,23 @@ class Engine:
                     f"{self._session_active[session_id]} in flight")
             rid = self._next_id
             self._next_id += 1
+            span = None
+            if self.ec.telemetry:
+                span = RequestSpan(rid, trace=trace, links=links)
+                if session_id is not None:
+                    prev = self._session_spans.get(session_id)
+                    if prev is not None:
+                        # turn N+1 links turn N: a session's timeline stays
+                        # navigable even though each turn is its own trace
+                        span.links.append({"type": "session_prev",
+                                           "trace_id": prev[0],
+                                           "span_id": prev[1]})
             pending = self._requests[rid] = _Pending(
                 tokens=list(tokens), max_new_tokens=max_new_tokens,
                 future=fut, submitted_at=now, page_hashes=hashes,
                 stream=stream, context=list(tokens), adapter_id=aid,
                 deadline=(now + deadline if deadline is not None else None),
-                span=(RequestSpan(rid) if self.ec.telemetry else None),
+                span=span,
                 priority=prio, rank=PRIORITY_RANK[prio],
                 rid=rid, session_id=session_id,
             )
@@ -759,10 +805,12 @@ class Engine:
                  adapter: Optional[str] = None,
                  deadline: Optional[float] = None,
                  priority: Optional[str] = None,
-                 session_id: Optional[str] = None) -> dict:
+                 session_id: Optional[str] = None,
+                 trace=None, links: Optional[list] = None) -> dict:
         fut = self.generate_async(tokens, max_new_tokens, adapter=adapter,
                                   deadline=deadline, priority=priority,
-                                  session_id=session_id)
+                                  session_id=session_id, trace=trace,
+                                  links=links)
         try:
             return fut.result(timeout=timeout)
         except FutureTimeoutError:
@@ -853,7 +901,9 @@ class Engine:
                         adapter: Optional[str] = None,
                         deadline: Optional[float] = None,
                         priority: Optional[str] = None,
-                        session_id: Optional[str] = None) -> Iterator:
+                        session_id: Optional[str] = None,
+                        trace=None,
+                        links: Optional[list] = None) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
 
         The last item yielded is the same dict ``generate`` returns (so
@@ -867,7 +917,8 @@ class Engine:
         q: queue.Queue = queue.Queue()
         fut = self.generate_async(tokens, max_new_tokens, stream=q,
                                   adapter=adapter, deadline=deadline,
-                                  priority=priority, session_id=session_id)
+                                  priority=priority, session_id=session_id,
+                                  trace=trace, links=links)
 
         def _iter():
             while True:
@@ -919,6 +970,10 @@ class Engine:
                 "requests_failed": self._requests_failed,
                 "nan_rows": self._nan_rows,
                 "restarts": self._restarts,
+                "trace_history_entries": len(self._trace_ring),
+                "trace_history_bytes": self._trace_ring_bytes,
+                **({"slo": self.telemetry.slo.snapshot()}
+                   if self.telemetry.slo is not None else {}),
                 **({"chaos": self._chaos.stats()} if self._chaos else {}),
                 **self.batcher.cache_stats(),
             }
@@ -947,6 +1002,55 @@ class Engine:
             pending = self._requests.get(rid)
             span = pending.span if pending is not None else self._trace_ring.get(rid)
         return span.to_dict() if span is not None else None
+
+    # bound on the auxiliary trace-reference maps (flight-dump refs,
+    # session last-span links): small, fixed, oldest-out — these are
+    # debugging breadcrumbs, not the span history itself
+    _TRACE_REF_CAP = 256
+
+    def trace_by_id(self, trace_id: str) -> dict:
+        """Every span this engine holds for one distributed trace id —
+        live requests and the bounded history — plus the flight-recorder
+        dump paths that reference it.  The service proxy's
+        ``GET /debug/trace/<id>`` fans this out across replicas and
+        assembles the hop tree; an O(history) scan is fine on a debug
+        path."""
+        with self._lock:
+            spans = [p.span for p in self._requests.values()
+                     if p.span is not None and p.span.trace_id == trace_id]
+            seen = {id(s) for s in spans}
+            spans += [s for s in self._trace_ring.values()
+                      if s.trace_id == trace_id and id(s) not in seen]
+            dumps = list(self._trace_dumps.get(trace_id, ()))
+        return {"trace_id": trace_id,
+                "spans": [s.to_dict() for s in spans],
+                "flight_dumps": dumps}
+
+    def _note_dump(self, path: Optional[str], trace_ids) -> None:
+        """Remember which traces a flight dump concerns, so the assembled
+        trace tree can point an incident responder at the postmortem file
+        on the replica that produced it."""
+        if path is None:
+            return
+        with self._lock:
+            for tid in trace_ids:
+                if tid is None:
+                    continue
+                paths = self._trace_dumps.setdefault(tid, [])
+                if path not in paths:
+                    paths.append(path)
+            while len(self._trace_dumps) > self._TRACE_REF_CAP:
+                self._trace_dumps.pop(next(iter(self._trace_dumps)))
+
+    def _slot_trace_ids(self, slots: list) -> list:
+        """Trace id per slot (None for unbound rows) — the flight-event /
+        dump correlation key.  Loop-thread only."""
+        out = []
+        for s in slots:
+            p = self._requests.get(self._slot_req.get(s))
+            out.append(p.span.trace_id
+                       if p is not None and p.span is not None else None)
+        return out
 
     def trace_n_ticks(self, n: int, trace_dir: str) -> str:
         """Capture a jax.profiler (XLA) trace of the next ``n`` live engine
@@ -980,10 +1084,36 @@ class Engine:
             return
         if span.outcome is None:
             span.mark(outcome)
+        evicted = 0
         with self._lock:
+            if sid is not None:
+                # the NEXT turn's span links this one (session_prev);
+                # pop-then-insert keeps active sessions at the LRU tail —
+                # plain reassignment would leave them at their original
+                # position and evict the LONGEST-LIVED session first
+                self._session_spans.pop(sid, None)
+                self._session_spans[sid] = (span.trace_id, span.span_id)
+                while len(self._session_spans) > self._TRACE_REF_CAP:
+                    self._session_spans.pop(next(iter(self._session_spans)))
+            nb = span.nbytes()
             self._trace_ring[span.rid] = span
-            while len(self._trace_ring) > self.ec.trace_history:
-                self._trace_ring.pop(next(iter(self._trace_ring)))
+            self._trace_sizes[span.rid] = nb
+            self._trace_ring_bytes += nb
+            # dual budget (ISSUE 8 satellite): entries AND bytes — a fleet
+            # soak of span-heavy requests (long prefills, preemption
+            # cycles) must not grow history past the byte cap even while
+            # under the entry cap
+            while (self._trace_ring
+                   and (len(self._trace_ring) > self.ec.trace_history
+                        or self._trace_ring_bytes
+                        > self.ec.trace_history_bytes)):
+                old_rid = next(iter(self._trace_ring))
+                if old_rid == span.rid:
+                    break  # never evict the span being archived
+                self._trace_ring.pop(old_rid)
+                self._trace_ring_bytes -= self._trace_sizes.pop(old_rid, 0)
+                evicted += 1
+        self.telemetry.count_trace_evictions(evicted)
 
     # ------------------------------------------------------------------ loop
 
@@ -1094,7 +1224,8 @@ class Engine:
         pending.first_token_at = now
         if pending.span is not None:
             pending.span.mark("first_token")
-        self.telemetry.observe_ttft(now - pending.submitted_at)
+        self.telemetry.observe_ttft(now - pending.submitted_at,
+                                    pending.priority)
 
     def _prefill_chunk_group(self, slots: list, off: int) -> None:
         """ONE fused chunked-prefill dispatch for every long/cache-resumed
@@ -1802,9 +1933,13 @@ class Engine:
 
     def _flight_event(self, phase: str, slots: list, shape: Optional[dict],
                       t0: float, outcome: str, **extra) -> None:
+        # tick events carry BOTH correlation keys (ISSUE 8 satellite):
+        # request ids for engine-local digging, trace ids so a fleet-wide
+        # trace assembly can cite the exact tick events of any hop
         self.flight.record(
             tick=self._ticks, phase=phase, slots=list(slots),
             rids=[self._slot_req.get(s) for s in slots],
+            trace_ids=self._slot_trace_ids(slots),
             shape=shape, duration_s=round(time.perf_counter() - t0, 6),
             outcome=outcome, **extra)
 
@@ -1812,6 +1947,7 @@ class Engine:
         self._ticks_failed += 1
         cap = self.ec.max_consecutive_failures
         escalated = []
+        escalated_tids = []
         for slot in list(slots):
             rid = self._slot_req.get(slot)
             pending = self._requests.get(rid) if rid is not None else None
@@ -1826,15 +1962,19 @@ class Engine:
                     f"{phase} failures (last: {type(exc).__name__}: {exc})")
                 err.__cause__ = exc
                 escalated.append(rid)
+                if pending.span is not None:
+                    escalated_tids.append(pending.span.trace_id)
                 self._fail_slot(slot, err)
         if escalated and self.ec.telemetry:
             # a request crossed the consecutive-failure cap: that is a
             # postmortem-worthy event — persist the tick-event ring now,
             # while the failing tick's records are still in it
-            self.flight.dump(
+            path = self.flight.dump(
                 "tick_failure_escalation",
                 extra={"phase": phase, "rids": escalated, "tick": self._ticks,
+                       "trace_ids": escalated_tids,
                        "error": f"{type(exc).__name__}: {exc}"})
+            self._note_dump(path, escalated_tids)
 
     def _fail_nan(self, slot: int, where: str) -> None:
         """NaN-guard trip: fail the poisoned slot with NonFiniteLogits and
@@ -1846,15 +1986,18 @@ class Engine:
         self._nan_rows += 1
         self._mark_roster_change("nan")  # before the release's "finish"
         if self.ec.telemetry:
+            tids = self._slot_trace_ids([slot])
             self._flight_event("nan_guard", [slot], None,
                                time.perf_counter(), "nan",
                                error=f"non-finite logits in {where}")
             if self._nan_dump_tick != self._ticks:
                 self._nan_dump_tick = self._ticks
-                self.flight.dump(
+                path = self.flight.dump(
                     "nan_guard_trip",
                     extra={"slot": slot, "rid": self._slot_req.get(slot),
+                           "trace_ids": tids,
                            "where": where, "tick": self._ticks})
+                self._note_dump(path, tids)
         self._fail_slot(slot, NonFiniteLogits(
             f"non-finite logits in {where}"))
 
@@ -1972,17 +2115,31 @@ class Engine:
         self._epoch += 1
         if self.ec.telemetry:
             # the postmortem the flight recorder exists for: what the loop
-            # was doing when the watchdog had to step in
+            # was doing when the watchdog had to step in.  Best-effort
+            # trace ids (no lock: the loop may be hung holding state) so
+            # the failover trace tree can cite this dying replica's dump.
+            try:
+                tids = [p.span.trace_id
+                        for p in list(self._requests.values())
+                        if p.span is not None]
+            except RuntimeError:
+                # a concurrent generate_async resized the dict under our
+                # lock-free snapshot; losing the ids beats killing the
+                # watchdog thread mid-recovery
+                tids = []
             self.flight.record(tick=self._ticks, phase="watchdog",
                                slots=list(self._slot_req),
                                rids=list(self._slot_req.values()),
+                               trace_ids=tids,
                                shape=None, duration_s=0.0,
                                outcome="supervise", error=reason)
-            self.flight.dump(
+            path = self.flight.dump(
                 "watchdog_" + ("restart" if self.ec.watchdog_restart
                                else "halt"),
                 extra={"detail": reason, "tick": self._ticks,
+                       "trace_ids": tids,
                        "epoch": self._epoch, "restarts": self._restarts})
+            self._note_dump(path, tids)
         err = TickFailure(f"engine {reason}; request abandoned by supervisor")
         # drop (never commit) the in-flight pipeline tick: its requests are
         # being failed wholesale, and a readback here — on the watchdog
@@ -2149,10 +2306,15 @@ class Engine:
         self._dec_lens_shadow = self._len_host.copy()
         self._dec_state = self._jnp.asarray(toks)
         self._roster_dirty = False
-        # reasons recorded by the drain's OWN commits (a finish/nan during
-        # the fence) are absorbed by this rebuild — a dangling one would
-        # mislabel the next unrelated fence
-        self._dirty_reason = None
+        # reasons recorded by the drain's OWN commits (a finish during the
+        # fence) are absorbed by this rebuild — a dangling one would
+        # mislabel the next unrelated fence.  EXCEPT "nan": a NaN trip is
+        # the one label a postmortem looks for (same precedence rule as
+        # _mark_roster_change), and a poisoned token committed DURING an
+        # admit/finish drain would otherwise leave no nan-labeled fence at
+        # all — keep it so the next fence carries it
+        if self._dirty_reason != "nan":
+            self._dirty_reason = None
 
     def _reserve_lookahead(self, decode_ready) -> bool:
         """Commit-behind page accounting: the C++ page grant for tick N's
@@ -2411,7 +2573,8 @@ class Engine:
             now = time.perf_counter()
             if pending.last_token_at:
                 # inter-token interval (TPOT) — the decode-speed histogram
-                self.telemetry.observe_tpot(now - pending.last_token_at)
+                self.telemetry.observe_tpot(now - pending.last_token_at,
+                                            pending.priority)
             pending.last_token_at = now
         pending.generated.append(token)
         pending.context.append(token)

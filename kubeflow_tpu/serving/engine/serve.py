@@ -20,6 +20,7 @@ import json
 import os
 from typing import Any, Optional
 
+from ...core import tracing
 from ..server import Model
 from ..errors import EngineError, RequestError
 from .engine import Engine, EngineConfig
@@ -178,6 +179,13 @@ class JetStreamModel(Model):
                             (str(n), float(w))
                             for n, w in skw["adapter_weights"])
                     kw["scheduler"] = SchedulerConfig(**skw)
+                if isinstance(kw.get("slo"), dict):
+                    # per-class SLO targets straight from an engine.json
+                    # (README "Observability"): the attainment/burn-rate
+                    # gauges the autoscaler will eventually scale on
+                    from ..slo import SloConfig
+
+                    kw["slo"] = SloConfig.from_json(kw["slo"])
                 if isinstance(kw.get("kv_store"), dict):
                     # tiered KV / session durability straight from an
                     # engine.json (README "Sessions & tiered KV"): point
@@ -277,6 +285,10 @@ class JetStreamModel(Model):
             self.engine.telemetry.set_kv_store_bytes(
                 s["kv_host_used_bytes"], s["kv_disk_used_bytes"])
             self.engine.telemetry.set_health(self.engine.health()["state"])
+            # SLO attainment/burn gauges recompute from the rolling
+            # windows at scrape time — same "right when read" discipline
+            # as the occupancy gauges above
+            self.engine.telemetry.refresh_slo()
         except RuntimeError:  # engine stopped
             return ""
         from ...core.metrics import add_const_labels
@@ -286,6 +298,17 @@ class JetStreamModel(Model):
         # scraper would reject wholesale
         return add_const_labels(self.engine.telemetry.render(),
                                 {"model": self.name})
+
+    def trace_spans(self, trace_id: str) -> dict:
+        """Engine spans + flight-dump references for one distributed trace
+        id — the replica-local half of ``GET /engine/trace/<id>`` (the
+        service proxy fans out across replicas and assembles the tree)."""
+        if self.engine is None:
+            return {"trace_id": trace_id, "spans": [], "flight_dumps": []}
+        try:
+            return self.engine.trace_by_id(trace_id)
+        except Exception:  # noqa: BLE001 — a debug read must answer
+            return {"trace_id": trace_id, "spans": [], "flight_dumps": []}
 
     @staticmethod
     def _wants_trace(headers: Optional[dict]) -> bool:
@@ -311,6 +334,33 @@ class JetStreamModel(Model):
         for k, v in (headers or {}).items():
             if k.lower() == "x-session-id":
                 return v
+        return None
+
+    @staticmethod
+    def _trace_ctx(headers: Optional[dict]):
+        """Inbound W3C ``traceparent`` (the ingress relay stamps one per
+        attempt): the engine span adopts its trace id and becomes a child
+        of the relay hop.  Malformed headers mint a fresh trace instead of
+        failing the request."""
+        for k, v in (headers or {}).items():
+            if k.lower() == tracing.TRACEPARENT_HEADER:
+                return tracing.parse_traceparent(v)
+        return None
+
+    @staticmethod
+    def _resume_link(headers: Optional[dict]) -> Optional[list]:
+        """``X-Resume-From`` (the failover relay's re-admission marker):
+        the span id of the relay hop whose backend died mid-stream.  The
+        engine span links it so the assembled trace shows the continuation
+        hanging off the failed hop.  Anything that is not a bare span id is
+        dropped: the header is client-controlled and span budget accounting
+        (RequestSpan.nbytes) charges links at fixed size."""
+        for k, v in (headers or {}).items():
+            if k.lower() == "x-resume-from" and v:
+                sid = str(v).strip().lower()
+                if tracing.SPAN_ID_RE.match(sid):
+                    return [{"type": "resumed_from", "span_id": sid}]
+                return None
         return None
 
     @staticmethod
@@ -391,7 +441,9 @@ class JetStreamModel(Model):
                     "max_tokens": max_tokens, "ttft_s": 0.0, "latency_s": 0.0}
         r = self.engine.generate(ids + resume, max_new, adapter=adapter,
                                  deadline=deadline, priority=priority,
-                                 session_id=session)
+                                 session_id=session,
+                                 trace=self._trace_ctx(headers),
+                                 links=self._resume_link(headers))
         # the seam slices at the STABLE prefix of the resumed text: resume
         # ids may end mid-UTF-8 sequence, whose completed decoding spans a
         # different char count than its U+FFFD placeholders (same rule as
@@ -442,7 +494,9 @@ class JetStreamModel(Model):
                                              adapter=adapter,
                                              deadline=deadline,
                                              priority=priority,
-                                             session_id=session)
+                                             session_id=session,
+                                             trace=self._trace_ctx(headers),
+                                             links=self._resume_link(headers))
         return self._stream_pieces(stream, ids, max_tokens,
                                    with_trace=self._wants_trace(headers),
                                    emit_ids=emit_ids, prior_ids=resume)

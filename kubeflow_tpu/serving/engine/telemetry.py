@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Optional
 
+from ...core import tracing
 from ...core.metrics import Registry
 
 # Latency-class buckets (seconds).  TTFT/queue-wait span sub-ms CPU ticks up
@@ -70,14 +71,31 @@ class RequestSpan:
     before its terminal phase.  Mutated only by the submitting thread
     (queued) and the engine loop (everything else), so marks need no lock;
     readers get a copying ``to_dict``.
+
+    Fleet tracing (ISSUE 8): every span carries a trace identity — the
+    ingress-minted W3C-style context when the request arrived with a
+    ``traceparent`` header (so the engine span is a child of the relay
+    hop that delivered it), a locally-minted trace otherwise.  ``links``
+    connect spans across trace boundaries: a failover re-admission links
+    the failed relay hop (``resumed_from``), a session's turn N+1 links
+    turn N (``session_prev``).
     """
 
-    __slots__ = ("rid", "events", "outcome")
+    __slots__ = ("rid", "events", "outcome", "trace_id", "span_id",
+                 "parent_id", "links")
 
-    def __init__(self, rid: int):
+    def __init__(self, rid: int, trace=None, links=None):
         self.rid = rid
         self.events: list = [("queued", time.perf_counter())]
         self.outcome: Optional[str] = None
+        if trace is not None:
+            self.trace_id = trace.trace_id
+            self.parent_id = trace.span_id
+        else:
+            self.trace_id = tracing.new_trace_id()
+            self.parent_id = None
+        self.span_id = tracing.new_span_id()
+        self.links: list = list(links or ())
 
     def mark(self, phase: str) -> float:
         t = time.perf_counter()
@@ -100,10 +118,16 @@ class RequestSpan:
         t0 = events[0][1]
         out = {
             "rid": self.rid,
+            "component": "engine",
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
             "outcome": self.outcome,
             "events": [{"phase": p, "t_s": round(ts - t0, 6)}
                        for p, ts in events],
         }
+        if self.links:
+            out["links"] = [dict(l) for l in self.links]
         by = {}
         for p, ts in events:  # first occurrence wins
             by.setdefault(p, ts)
@@ -116,6 +140,13 @@ class RequestSpan:
             out["latency_s"] = round(term - t0, 6)
         out["prefill_chunks"] = sum(1 for p, _ in events if p == "prefill")
         return out
+
+    def nbytes(self) -> int:
+        """Approximate retained size — the trace-history byte budget's
+        accounting unit.  Deliberately a cheap closed form (not a real
+        serialization): the budget needs proportionality, not precision,
+        and this runs on every archive."""
+        return 160 + 48 * len(self.events) + 96 * len(self.links)
 
 
 class FlightRecorder:
@@ -187,9 +218,13 @@ class EngineTelemetry:
     on ``enabled=False`` so the bench can measure the overhead honestly."""
 
     def __init__(self, enabled: bool = True,
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None, slo=None):
         self.enabled = enabled
         self.registry = registry if registry is not None else Registry()
+        # SLO attainment tracker (serving/slo.py, ISSUE 8): fed from the
+        # same TTFT/TPOT/queue-wait hooks, exported at scrape time via
+        # refresh_slo().  None = no tracking (telemetry-off benches).
+        self.slo = slo
         r = self.registry
         self.ttft = r.histogram(
             "engine_ttft_seconds",
@@ -269,17 +304,39 @@ class EngineTelemetry:
             "engine_health_state",
             "engine health state machine, one-hot by state "
             "(SERVING/DEGRADED/DRAINING/DEAD)")
+        # Fleet observability surface (ISSUE 8): per-class SLO attainment
+        # over rolling windows (refreshed at scrape from the SloTracker),
+        # multi-window burn rate, and the trace-history eviction counter
+        # (RequestSpan history is byte/entry budgeted; evictions here mean
+        # the budget is working, a flat 0 on a long run means it's sized
+        # right).
+        self.slo_attainment = r.gauge(
+            "slo_attainment_ratio",
+            "fraction of in-window requests meeting their latency target, "
+            "by priority class and metric (ttft/tpot/queue_wait)")
+        self.slo_burn = r.gauge(
+            "slo_burn_rate",
+            "error-budget burn rate (1-attainment)/(1-objective), by "
+            "class, metric and rolling window")
+        self.trace_evictions = r.counter(
+            "engine_trace_evictions_total",
+            "request spans evicted from the bounded trace history "
+            "(entry or byte budget)")
 
     # Observe methods stay branch-cheap: one attribute check, then a dict
     # op under the metric's own lock.
 
-    def observe_ttft(self, s: float) -> None:
+    def observe_ttft(self, s: float, priority: Optional[str] = None) -> None:
         if self.enabled:
             self.ttft.observe(s)
+            if self.slo is not None and priority is not None:
+                self.slo.observe(priority, "ttft", s)
 
-    def observe_tpot(self, s: float) -> None:
+    def observe_tpot(self, s: float, priority: Optional[str] = None) -> None:
         if self.enabled:
             self.tpot.observe(s)
+            if self.slo is not None and priority is not None:
+                self.slo.observe(priority, "tpot", s)
 
     def observe_queue_wait(self, s: float,
                            priority: Optional[str] = None) -> None:
@@ -287,6 +344,19 @@ class EngineTelemetry:
             self.queue_wait.observe(s)
             if priority is not None:
                 self.class_queue_wait.observe(s, priority=priority)
+                if self.slo is not None:
+                    self.slo.observe(priority, "queue_wait", s)
+
+    def count_trace_evictions(self, n: int) -> None:
+        if self.enabled and n:
+            self.trace_evictions.inc(n)
+
+    def refresh_slo(self) -> None:
+        """Recompute the SLO gauges from the tracker's rolling windows —
+        scrape-time only (a gauge needs to be right when read, and the
+        window math is O(samples), not O(1))."""
+        if self.enabled and self.slo is not None:
+            self.slo.export(self.slo_attainment, self.slo_burn)
 
     def count_preemption(self, reason: str, mode: str) -> None:
         if self.enabled:

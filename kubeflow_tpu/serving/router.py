@@ -43,8 +43,9 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..core import tracing
 from ..core.api import APIServer, Obj
-from ..core.metrics import REGISTRY
+from ..core.metrics import REGISTRY, merge_expositions
 from .api import GROUP, LABEL_ISVC, LABEL_REVISION
 from .controllers import (
     DEPLOYMENT_FOR_SERVICE_ANNOTATION,
@@ -97,6 +98,15 @@ INGRESS_HEDGED = REGISTRY.counter(
 INGRESS_BACKEND_STATE = REGISTRY.gauge(
     "ingress_backend_state",
     "backends per health state (healthy/suspect/ejected/probation/draining)")
+# Fleet observability surface (ISSUE 8): every relay gets a W3C-style
+# trace context (minted here, or adopted from an inbound traceparent);
+# every attempt — retries, hedges, mid-stream failover re-admissions —
+# becomes a child hop span stored in a bounded per-proxy TraceStore that
+# GET /debug/trace/<id> assembles (with the engines' spans) into the hop
+# tree.  The eviction counter is the history-pressure signal.
+INGRESS_TRACE_EVICTIONS = REGISTRY.counter(
+    "ingress_trace_evictions_total",
+    "relay traces evicted from the proxy's bounded trace store")
 
 # health states a backend can occupy; terminal routing decision per state:
 # healthy/suspect route, probation routes only as a fallback set, ejected
@@ -172,6 +182,11 @@ class ServiceProxy:
         # relay reports every relayed token event so seeded kill/hang/cut
         # injections fire at exact token counts (bench/test substrate)
         self.chaos = None
+        # ingress half of the distributed trace (README "Observability"):
+        # finished relay hop spans, bounded in traces AND bytes
+        self.traces = tracing.TraceStore(
+            max_traces=512, max_bytes=2_000_000,
+            on_evict=lambda n: INGRESS_TRACE_EVICTIONS.inc(n))
 
     def sync(self) -> bool:
         changed = False
@@ -203,8 +218,23 @@ class ServiceProxy:
                 pass
 
             def _forward(self):
+                # the body is always drained, even for the proxy-native
+                # GETs below: unread Content-Length bytes would be parsed
+                # as the NEXT request line on this keep-alive connection
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n) if n else None
+                path = self.path.split("?")[0].rstrip("/")
+                if self.command == "GET":
+                    # proxy-native debug/aggregation surface (ISSUE 8):
+                    # these answer FROM the proxy (fanning out underneath)
+                    # instead of relaying to one backend
+                    if path.startswith("/debug/trace/"):
+                        proxy._serve_trace(self, state,
+                                           path[len("/debug/trace/"):])
+                        return
+                    if path == "/fleet/metrics":
+                        proxy._serve_fleet_metrics(self, state)
+                        return
                 proxy._relay(self, state, body)
 
             def _stream(self, r, ctype: str) -> bool:
@@ -224,6 +254,9 @@ class ServiceProxy:
                     self.send_header("Content-Type", ctype)
                     self.send_header("Cache-Control", "no-cache")
                     self.send_header("Transfer-Encoding", "chunked")
+                    if getattr(self, "_trace_id", None):
+                        # the client's handle into GET /debug/trace/<id>
+                        self.send_header("X-Trace-Id", self._trace_id)
                     self.end_headers()
                 except Exception:  # noqa: BLE001 — client gone pre-headers
                     self.close_connection = True
@@ -255,10 +288,14 @@ class ServiceProxy:
                 self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
                 self.wfile.flush()
 
-            def _reply(self, code: int, data: bytes, ctype: Optional[str] = "application/json"):
+            def _reply(self, code: int, data: bytes,
+                       ctype: Optional[str] = "application/json",
+                       extra: Optional[dict] = None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype or "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -306,8 +343,24 @@ class ServiceProxy:
         hedge_s = float(ann.get(HEDGE_TIMEOUT_ANNOTATION, 0.0))
         resume = self._resume_context(handler.path, body)
         sse = _SSERelay(handler)
+        # distributed trace (README "Observability"): adopt the caller's
+        # traceparent (this relay's root span becomes its child) or mint a
+        # fresh trace; every attempt below is a child hop of the root.
+        # The inbound header is stripped from the forwarded set — each
+        # attempt re-stamps its OWN hop context.
+        inbound = tracing.parse_traceparent(
+            handler.headers.get(tracing.TRACEPARENT_HEADER))
+        root = inbound.child() if inbound is not None \
+            else tracing.TraceContext.mint()
+        sse.trace_id = root.trace_id
+        handler._trace_id = root.trace_id
+        prev_failed_hop: Optional[str] = None
         hop_by_hop = {"host", "content-length", "connection", "keep-alive",
-                      "transfer-encoding", "upgrade", "te", "trailers"}
+                      "transfer-encoding", "upgrade", "te", "trailers",
+                      # internal signaling headers the relay mints itself:
+                      # forwarding a client's copy would let it forge
+                      # failover (resumed_from) edges into traces
+                      tracing.TRACEPARENT_HEADER, "x-resume-from"}
         fwd_headers = {k: v for k, v in handler.headers.items()
                        if k.lower() not in hop_by_hop}
         fwd_headers.setdefault("Content-Type", "application/json")
@@ -316,6 +369,34 @@ class ServiceProxy:
         backend_label = "none"
         attempt = 0
         tried: set[int] = set()
+        # true only for the dispatch immediately following a hedge-armed
+        # stall: THAT attempt is the hedged re-dispatch ingress_hedged_total
+        # counts, not the tight-timeout first attempt that armed it
+        hedge_redispatch = False
+
+        def reply(code: int, data: bytes, ctype: Optional[str] = None):
+            handler._reply(code, data, ctype,
+                           extra={"X-Trace-Id": root.trace_id})
+
+        def note_hop(hop, backend, kind, hop_t0, outcome,
+                     error: Optional[str] = None,
+                     backend_state: Optional[str] = None) -> None:
+            span = {"trace_id": root.trace_id, "span_id": hop.span_id,
+                    "parent_id": hop.parent_id, "component": "ingress",
+                    "name": "relay_attempt", "attempt": attempt,
+                    "kind": kind, "backend": backend,
+                    "backend_state": backend_state, "outcome": outcome,
+                    "t_start_s": round(hop_t0 - t0, 6),
+                    "duration_s": round(time.perf_counter() - hop_t0, 6)}
+            if error is not None:
+                span["error"] = error
+            if prev_failed_hop is not None:
+                # the hop this one picks up from: retries reference the
+                # failed attempt; stream re-admissions are the satellite's
+                # "resumed_from" edge in the assembled tree
+                span["resumed_from"] = prev_failed_hop
+            self.traces.put(root.trace_id, span)
+
         try:
             while True:
                 try:
@@ -324,14 +405,21 @@ class ServiceProxy:
                                                  svc=svc)
                 except LookupError as e:
                     status = 503
+                    note_hop(root.child(), None, "pick",
+                             time.perf_counter(), "no_backend", str(e))
                     if sse.started:
                         sse.error_event(str(e))
                     else:
-                        handler._reply(
-                            503, json.dumps({"error": str(e)}).encode())
+                        reply(503, json.dumps({"error": str(e)}).encode())
                     return
                 backend_label = str(backend)
+                hop = root.child()
+                hop_t0 = time.perf_counter()
+                with state.lock:
+                    h_rec = state.health.get(backend)
+                    hop_state = h_rec.state if h_rec is not None else "unknown"
                 data, hdrs = body, dict(fwd_headers)
+                hdrs[tracing.TRACEPARENT_HEADER] = hop.traceparent()
                 if resume is not None:
                     # ask the engine surface to annotate stream events with
                     # the token ids they cover — the re-admission currency
@@ -339,6 +427,11 @@ class ServiceProxy:
                     if resume.token_ids:
                         data = resume.request_body()
                         hdrs["Content-Type"] = "application/json"
+                        if prev_failed_hop is not None:
+                            # the engine span links the failed hop: the
+                            # assembled tree shows the continuation
+                            # hanging off the attempt that died
+                            hdrs["X-Resume-From"] = prev_failed_hop
                 req = urllib.request.Request(
                     f"http://127.0.0.1:{backend}{handler.path}",
                     data=data, method=handler.command, headers=hdrs)
@@ -361,6 +454,9 @@ class ServiceProxy:
                            and not self._wants_stream(body))
                 if hedging:
                     attempt_timeout = min(attempt_timeout, hedge_s)
+                kind = ("resume" if resume is not None and resume.token_ids
+                        else "hedge" if hedge_redispatch else "relay")
+                hedge_redispatch = False
                 reason = None
                 try:
                     with urllib.request.urlopen(
@@ -375,6 +471,9 @@ class ServiceProxy:
                             else:
                                 ok = handler._stream(r, ctype)
                             self._note_backend(state, backend, ok)
+                            note_hop(hop, backend, kind, hop_t0,
+                                     "ok" if ok else "stream_error",
+                                     backend_state=hop_state)
                             return
                         payload = r.read()
                         self._note_backend(state, backend, True)
@@ -382,40 +481,58 @@ class ServiceProxy:
                             # a RESUMED stream landed on a backend that
                             # answered non-SSE: replying normally would
                             # write a second HTTP response into the live
-                            # chunked body — terminal error event instead
+                            # chunked body — terminal error event instead.
+                            # The request dies here, so the hop must NOT
+                            # read outcome=ok (the trace would show the
+                            # failed re-admission as a clean failover)
+                            note_hop(hop, backend, kind, hop_t0,
+                                     "resume_non_stream",
+                                     f"HTTP {r.status}, {ctype or '?'}",
+                                     backend_state=hop_state)
                             sse.error_event(
                                 "re-admission returned a non-stream "
                                 f"response ({r.status}, {ctype or '?'})")
                             return
-                        handler._reply(r.status, payload, ctype or None)
+                        note_hop(hop, backend, kind, hop_t0, "ok",
+                                 backend_state=hop_state)
+                        reply(r.status, payload, ctype or None)
                         return
                 except urllib.error.HTTPError as e:
                     status = e.code
                     if e.code < 500:  # client fault: the backend is fine
                         self._note_backend(state, backend, True)
+                        note_hop(hop, backend, kind, hop_t0,
+                                 f"status_{e.code}",
+                                 backend_state=hop_state)
                         if sse.started:  # a RESUMED request was refused
                             sse.error_event(
                                 f"re-admission refused: {e.code}")
                         else:
-                            handler._reply(e.code, e.read(),
-                                           e.headers.get("Content-Type"))
+                            reply(e.code, e.read(),
+                                  e.headers.get("Content-Type"))
                         return
                     self._note_backend(state, backend, False)
+                    note_hop(hop, backend, kind, hop_t0, "status_5xx",
+                             f"HTTP {e.code}", backend_state=hop_state)
                     if attempt >= budget:
                         if sse.started:
                             sse.error_event(
                                 f"backend failed with {e.code} after "
                                 f"{attempt + 1} attempts")
                         else:
-                            handler._reply(e.code, e.read(),
-                                           e.headers.get("Content-Type"))
+                            reply(e.code, e.read(),
+                                  e.headers.get("Content-Type"))
                         return
                     reason = "status_5xx"
-                except _ClientGone:
+                except _ClientGone as e:
+                    note_hop(hop, backend, kind, hop_t0, "client_gone",
+                             str(e), backend_state=hop_state)
                     handler.close_connection = True
                     return
                 except _BackendStreamError as e:
                     self._note_backend(state, backend, False)
+                    note_hop(hop, backend, kind, hop_t0, "stream_error",
+                             str(e), backend_state=hop_state)
                     if attempt >= budget:
                         status = 502
                         sse.error_event(
@@ -426,22 +543,26 @@ class ServiceProxy:
                 except Exception as e:  # noqa: BLE001 — URLError/OSError/...
                     self._note_backend(state, backend, False)
                     stalled = self._is_timeout(e)
+                    note_hop(hop, backend, kind, hop_t0,
+                             "stall" if stalled else "connect", str(e),
+                             backend_state=hop_state)
                     if attempt >= budget:
                         status = 502
                         msg = f"backend: {e}"
                         if sse.started:
                             sse.error_event(msg)
                         else:
-                            handler._reply(
-                                502, json.dumps({"error": msg}).encode())
+                            reply(502, json.dumps({"error": msg}).encode())
                         return
                     if hedging and stalled:
                         reason = "stall"
                         INGRESS_HEDGED.inc(service=state.service_name)
+                        hedge_redispatch = True
                     else:
                         reason = "stall" if stalled else "connect"
                 attempt += 1
                 tried.add(backend)
+                prev_failed_hop = hop.span_id
                 INGRESS_RETRIES.inc(service=state.service_name, reason=reason)
                 if not sse.started:
                     # jittered exponential backoff — but never while a live
@@ -457,6 +578,15 @@ class ServiceProxy:
             INGRESS_REQUESTS.inc(service=state.service_name,
                                  backend=backend_label,
                                  code=f"{status // 100}xx")
+            # root span last: the hop spans are its children in the tree
+            self.traces.put(root.trace_id, {
+                "trace_id": root.trace_id, "span_id": root.span_id,
+                "parent_id": root.parent_id, "component": "ingress",
+                "name": "request", "service": state.service_name,
+                "path": handler.path, "method": handler.command,
+                "status": status, "attempts": attempt + 1,
+                "t_start_s": 0.0,
+                "duration_s": round(time.perf_counter() - t0, 6)})
 
     @staticmethod
     def _wants_stream(body: Optional[bytes]) -> bool:
@@ -550,6 +680,104 @@ class ServiceProxy:
                     if act == "cut":
                         raise _BackendStreamError(
                             "chaos: injected mid-stream disconnect")
+
+    # --------------------------------------- fleet observability endpoints
+
+    _FANOUT_TIMEOUT_S = 0.5  # per-replica budget for trace/metrics fan-out
+
+    def _service_pods(self, state: _ProxyState) -> list:
+        """(name, port) of EVERY pod behind the service — all revisions,
+        ready or not, draining included: a dying replica's server usually
+        still answers, and its spans/flight dumps are exactly what a
+        failover postmortem needs."""
+        svc = self._get_service(state)
+        if svc is None:
+            return []
+        selector = svc["spec"].get("selector") or {}
+        out = []
+        for p in self.api.list("Pod", namespace=state.namespace,
+                               label_selector=selector):
+            port = pod_port(p)
+            if port is not None:
+                out.append((p["metadata"]["name"], port))
+        return sorted(out)
+
+    def _fan_out(self, pods: list, path: str) -> dict:
+        """Concurrently GET ``path`` from every replica; {name: parsed
+        body or None}.  One slow replica costs the fan-out timeout once,
+        not once per replica."""
+        results: dict = {}
+
+        def fetch(name: str, port: int) -> None:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}",
+                        timeout=self._FANOUT_TIMEOUT_S) as r:
+                    results[name] = r.read()
+            except Exception:  # noqa: BLE001 — unreachable replica
+                results[name] = None
+
+        ts = [threading.Thread(target=fetch, args=(n, p)) for n, p in pods]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return results
+
+    def _serve_trace(self, handler, state: _ProxyState,
+                     trace_id: str) -> None:
+        """GET /debug/trace/<id>: the assembled end-to-end trace — this
+        proxy's relay hop spans plus every replica's engine spans
+        (GET /engine/trace/<id> fan-out), nested into the hop tree, with
+        the flight-recorder dumps any replica recorded for this trace."""
+        trace_id = trace_id.strip().lower()
+        spans = [dict(s) for s in self.traces.get(trace_id)]
+        dumps: list = []
+        pods = self._service_pods(state)
+        unreachable: list = []
+        for name, raw in sorted(self._fan_out(
+                pods, f"/engine/trace/{trace_id}").items()):
+            if raw is None:
+                unreachable.append(name)
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                unreachable.append(name)
+                continue
+            for s in rec.get("spans") or ():
+                s = dict(s)
+                s["replica"] = name
+                spans.append(s)
+            for p in rec.get("flight_dumps") or ():
+                dumps.append({"replica": name, "path": p})
+        body = {"trace_id": trace_id, "spans": spans,
+                "tree": tracing.build_tree(spans),
+                "flight_dumps": dumps,
+                "replicas_queried": [n for n, _ in pods],
+                "replicas_unreachable": unreachable}
+        handler._reply(200 if spans else 404, json.dumps(body).encode())
+
+    def _serve_fleet_metrics(self, handler, state: _ProxyState) -> None:
+        """GET /fleet/metrics: every replica's /metrics merged into one
+        exposition — counters and histograms sum across replicas
+        (bucket-exact), gauges keep a ``replica`` label
+        (core.metrics.merge_expositions)."""
+        pods = self._service_pods(state)
+        texts: dict = {}
+        unreachable: list = []
+        for name, raw in self._fan_out(pods, "/metrics").items():
+            if raw is None:
+                unreachable.append(name)
+            else:
+                texts[name] = raw.decode(errors="replace")
+        header = (f"# fleet/metrics: {len(texts)}/{len(pods)} replicas "
+                  f"of {state.service_name} merged")
+        if unreachable:
+            header += f"; unreachable: {','.join(sorted(unreachable))}"
+        body = header + "\n" + merge_expositions(texts)
+        handler._reply(200, body.encode(),
+                       "text/plain; version=0.0.4")
 
     # --------------------------------------------------- backend health FSM
 
@@ -1012,11 +1240,12 @@ class _SSERelay:
     are chunked-framed, and client write failures surface as _ClientGone so
     the failover loop stops instead of burning replicas for nobody."""
 
-    __slots__ = ("h", "started")
+    __slots__ = ("h", "started", "trace_id")
 
     def __init__(self, handler):
         self.h = handler
         self.started = False
+        self.trace_id: Optional[str] = None
 
     def start(self) -> None:
         if self.started:
@@ -1026,6 +1255,9 @@ class _SSERelay:
             self.h.send_header("Content-Type", "text/event-stream")
             self.h.send_header("Cache-Control", "no-cache")
             self.h.send_header("Transfer-Encoding", "chunked")
+            if self.trace_id:
+                # the stream's handle into GET /debug/trace/<id>
+                self.h.send_header("X-Trace-Id", self.trace_id)
             self.h.end_headers()
         except Exception as e:  # noqa: BLE001
             raise _ClientGone(str(e)) from e

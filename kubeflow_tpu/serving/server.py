@@ -264,6 +264,27 @@ class ModelServer:
         elif path == "/engine/health":
             code, body = self._engine_health()
             h._send(code, body)
+        elif path.startswith("/engine/trace/"):
+            # replica-local spans for one distributed trace id: every
+            # model contributes (engine-backed ones hold RequestSpans;
+            # plain models have none).  Always 200 — the proxy's fan-out
+            # merges empties; a trace unknown HERE may live elsewhere.
+            # normalize like the proxy does: ids are stored lowercase, and
+            # an uppercase copy-paste must not read as "not on this replica"
+            tid = path[len("/engine/trace/"):].strip().lower()
+            spans, dumps = [], []
+            for m in self.models.values():
+                fn = getattr(m, "trace_spans", None)
+                if not callable(fn):
+                    continue
+                try:
+                    rec = fn(tid) or {}
+                except Exception:  # noqa: BLE001 — debug read must answer
+                    continue
+                spans.extend(rec.get("spans") or ())
+                dumps.extend(rec.get("flight_dumps") or ())
+            h._send(200, {"trace_id": tid, "spans": spans,
+                          "flight_dumps": dumps})
         elif path == "/v2/health/ready":
             ready = all(m.ready for m in self.models.values())
             h._send(200 if ready else 503, {"ready": ready})

@@ -1,0 +1,59 @@
+"""Metrics-conformance gate (ISSUE 8 satellite): the README metric table
+must list exactly the serving metric names the code registers, and vice
+versa — the table had drifted across seven PRs of new counters, and a
+dashboard built from stale docs silently graphs nothing.
+
+Scope: the serving observability namespaces (``engine_*``, ``ingress_*``,
+``slo_*``) that live in a Registry the test can enumerate.  The flat
+``extra_metrics`` gauges (engine_queue_depth & co) are a scrape-surface,
+not registry metrics, and stay out of scope — as do the controller/
+training-operator counters, which predate the serving plane.
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.obs
+
+README = Path(__file__).resolve().parent.parent / "README.md"
+
+# serving-observability namespaces under conformance
+_SCOPE = re.compile(r"^(engine_|ingress_|slo_)")
+
+
+def registered_names() -> set:
+    from kubeflow_tpu.core.metrics import REGISTRY
+    from kubeflow_tpu.serving import router  # noqa: F401 — registers ingress_*
+    from kubeflow_tpu.serving.engine.telemetry import EngineTelemetry
+
+    names = set(EngineTelemetry(enabled=True).registry.names())
+    names |= set(REGISTRY.names())
+    return {n for n in names if _SCOPE.match(n)}
+
+
+def documented_names() -> set:
+    """Metric names from README table rows: lines like
+    ``| `engine_ttft_seconds` | histogram | ... |``."""
+    names = set()
+    for line in README.read_text().splitlines():
+        m = re.match(r"^\|\s*`([a-z0-9_]+)`\s*\|", line)
+        if m and _SCOPE.match(m.group(1)):
+            names.add(m.group(1))
+    return names
+
+
+def test_readme_metric_table_matches_registered_metrics():
+    code = registered_names()
+    docs = documented_names()
+    assert code, "no registered metrics found — enumeration broke"
+    missing_from_docs = sorted(code - docs)
+    missing_from_code = sorted(docs - code)
+    assert not missing_from_docs, (
+        "metrics registered in code but absent from the README metric "
+        f"table: {missing_from_docs} — add a table row per metric")
+    assert not missing_from_code, (
+        "metrics documented in the README table but not registered in "
+        f"code: {missing_from_code} — remove the stale rows (or restore "
+        "the metric)")

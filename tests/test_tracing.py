@@ -1,0 +1,646 @@
+"""Fleet observability plane tests (ISSUE 8): end-to-end trace context,
+`/debug/trace` assembly across failover, SLO attainment tracking, fleet
+metrics aggregation, and the bounded trace-history budgets.
+
+The hard-path continuity matrix (ISSUE 8 satellite):
+
+  * mid-stream failover re-admission keeps ONE trace id, with the new
+    engine span linking the failed relay hop (``resumed_from``);
+  * session turn N+1 links turn N (``session_prev``);
+  * retries/hedges appear as distinct child hop spans under one root;
+  * ``/fleet/metrics`` merges replica histograms sum-exactly (buckets
+    additive) while gauges keep a ``replica`` label.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from kubeflow_tpu.core.api import APIServer
+from kubeflow_tpu.core.metrics import (Registry, merge_expositions,
+                                       parse_exposition)
+from kubeflow_tpu.core.tracing import (TraceContext, TraceStore, build_tree,
+                                       parse_traceparent)
+from kubeflow_tpu.serving.api import LABEL_ISVC
+from kubeflow_tpu.serving.controllers import (POD_PORT_ANNOTATION,
+                                              PROXY_PORT_ANNOTATION)
+from kubeflow_tpu.serving.engine import Engine, EngineConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import FleetChaos, FleetFaultConfig
+from kubeflow_tpu.serving.engine.serve import JetStreamModel
+from kubeflow_tpu.serving.router import ServiceProxy, RELAY_TIMEOUT_ANNOTATION
+from kubeflow_tpu.serving.server import Model, ModelServer
+from kubeflow_tpu.serving.slo import SloConfig, SloTracker
+from kubeflow_tpu.utils.net import find_free_ports
+
+pytestmark = pytest.mark.obs
+
+# vocab >= 256: the JetStream byte tokenizer addresses ids 0..255
+CFG = M.DecoderConfig(vocab_size=288, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+# ------------------------------------------------------- context + store units
+
+
+def test_traceparent_roundtrip_and_rejects():
+    ctx = TraceContext.mint()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    parsed = parse_traceparent(ctx.traceparent())
+    assert parsed is not None
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.span_id == ctx.span_id
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_id == ctx.span_id
+    assert child.span_id != ctx.span_id
+    for bad in (None, "", "garbage", "00-short-short-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace id
+                "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+                "zz-" + "a" * 32 + "-" + "b" * 16 + "-01",
+                12345):
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_trace_store_entry_and_byte_budgets():
+    evictions = []
+    store = TraceStore(max_traces=3, max_bytes=10_000_000,
+                       on_evict=evictions.append)
+    for i in range(5):
+        store.put(f"t{i}", {"span_id": f"s{i}", "n": i})
+    assert len(store) == 3
+    assert sum(evictions) == 2
+    assert store.get("t0") == [] and store.get("t1") == []
+    assert store.get("t4") == [{"span_id": "s4", "n": 4}]
+    # byte budget: whole traces evict oldest-first once bytes overflow
+    store2 = TraceStore(max_traces=100, max_bytes=400,
+                        on_evict=evictions.append)
+    for i in range(10):
+        store2.put(f"b{i}", {"span_id": f"s{i}", "pad": "x" * 100})
+    assert store2.stats()["bytes"] <= 400
+    assert 0 < len(store2) < 10
+    # a multi-span trace stays whole until IT is the eviction victim
+    assert all(len(store2.get(t)) in (0, 1)
+               for t in (f"b{i}" for i in range(10)))
+
+
+def test_build_tree_nests_by_parent():
+    spans = [
+        {"span_id": "root", "parent_id": None, "t_start_s": 0.0},
+        {"span_id": "hop1", "parent_id": "root", "t_start_s": 0.1},
+        {"span_id": "hop2", "parent_id": "root", "t_start_s": 0.2},
+        {"span_id": "eng2", "parent_id": "hop2", "t_start_s": 0.3},
+        {"span_id": "orphan", "parent_id": "gone", "t_start_s": 0.4},
+    ]
+    tree = build_tree(spans)
+    assert [n["span_id"] for n in tree] == ["root", "orphan"]
+    root = tree[0]
+    assert [c["span_id"] for c in root["children"]] == ["hop1", "hop2"]
+    assert root["children"][1]["children"][0]["span_id"] == "eng2"
+
+
+# -------------------------------------------------------- exposition merging
+
+
+def test_merge_expositions_histogram_sum_exact():
+    regs = {}
+    for name, values in (("r0", (0.05, 0.5, 5.0)),
+                         ("r1", (0.5, 0.5, 50.0, 0.01))):
+        r = Registry()
+        h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+        for v in values:
+            h.observe(v, model="m")
+        r.counter("req_total", "requests").inc(len(values), model="m")
+        r.gauge("occ_ratio", "occupancy").set(0.5 if name == "r0" else 0.25)
+        regs[name] = r.render()
+    merged = parse_exposition(merge_expositions(regs))
+    lat = merged["lat_seconds"]
+    assert lat["type"] == "histogram"
+    by = {}
+    for labels, v in lat["samples"]:
+        by[(labels.get("__series__"), labels.get("le"))] = v
+    # bucket-exact: merged cumulative counts == elementwise sums
+    assert by[("_bucket", "0.1")] == 2      # 0.05, 0.01
+    assert by[("_bucket", "1")] == 5        # + three 0.5s
+    assert by[("_bucket", "10")] == 6       # + 5.0
+    assert by[("_bucket", "+Inf")] == 7     # + 50.0
+    assert by[("_count", None)] == 7
+    assert abs(by[("_sum", None)] - sum((0.05, 0.5, 5.0, 0.5, 0.5, 50.0,
+                                         0.01))) < 1e-9
+    # counters sum; gauges stay per-replica via the replica label
+    req = dict((tuple(sorted(l.items())), v)
+               for l, v in merged["req_total"]["samples"])
+    assert req[(("model", "m"),)] == 7
+    occ = {l["replica"]: v for l, v in merged["occ_ratio"]["samples"]}
+    assert occ == {"r0": 0.5, "r1": 0.25}
+
+
+# ------------------------------------------------------------- SLO tracking
+
+
+def test_slo_tracker_attainment_and_burn():
+    cfg = SloConfig(targets=(("interactive", "ttft", 0.1),),
+                    objective=0.9, windows=(10.0, 100.0))
+    t = SloTracker(cfg)
+    assert t.attainment("interactive", "ttft", now=100.0) is None
+    # 8 in-target + 2 over-target inside the short window
+    for i in range(8):
+        t.observe("interactive", "ttft", 0.05, now=95.0 + i * 0.1)
+    for i in range(2):
+        t.observe("interactive", "ttft", 0.5, now=96.0 + i)
+    att = t.attainment("interactive", "ttft", 10.0, now=100.0)
+    assert att == pytest.approx(0.8)
+    # burn = (1 - 0.8) / (1 - 0.9) = 2x budget burn
+    assert t.burn_rate("interactive", "ttft", 10.0,
+                       now=100.0) == pytest.approx(2.0)
+    # the old samples age out of the short window but not the long one
+    att_later = t.attainment("interactive", "ttft", 10.0, now=120.0)
+    assert att_later is None
+    assert t.attainment("interactive", "ttft", 100.0,
+                        now=120.0) == pytest.approx(0.8)
+    # unconfigured series are free and invisible
+    t.observe("batch", "ttft", 9.9, now=100.0)
+    assert t.attainment("batch", "ttft", now=100.0) is None
+    snap = t.snapshot(now=100.0)
+    assert snap["interactive"]["ttft"]["target_s"] == 0.1
+
+
+def test_slo_export_removes_stale_series():
+    """A series whose samples aged out of every window must VANISH from
+    the gauges, not freeze at its last (possibly violating) value."""
+    cfg = SloConfig(targets=(("interactive", "ttft", 0.1),),
+                    objective=0.9, windows=(10.0,))
+    t = SloTracker(cfg)
+    r = Registry()
+    att = r.gauge("slo_attainment_ratio", "")
+    burn = r.gauge("slo_burn_rate", "")
+    t.observe("interactive", "ttft", 0.5, now=100.0)  # violating sample
+    t.export(att, burn, now=101.0)
+    assert att.value(**{"class": "interactive", "metric": "ttft"}) == 0.0
+    assert att.series() and burn.series()
+    t.export(att, burn, now=200.0)  # window empty now
+    assert att.series() == {} and burn.series() == {}
+
+
+def test_parse_exposition_unescapes_backslash_sequences():
+    # literal backslash-then-n escapes to \\n and must decode back to
+    # backslash-n, NOT newline (ordering bug in chained str.replace)
+    text = ('# TYPE g gauge\n'
+            'g{path="C:\\\\new",q="a\\"b",nl="x\\ny"} 1\n')
+    (labels, v), = parse_exposition(text)["g"]["samples"]
+    assert labels["path"] == "C:\\new"
+    assert labels["q"] == 'a"b'
+    assert labels["nl"] == "x\ny"
+
+
+def test_slo_config_from_json_validation():
+    cfg = SloConfig.from_json({
+        "targets": {"interactive": {"ttft": 0.25, "tpot": None}},
+        "objective": 0.95, "windows": [30, 300]})
+    targets = {(c, m): t for c, m, t in cfg.targets}
+    assert targets[("interactive", "ttft")] == 0.25
+    assert ("interactive", "tpot") not in targets  # null drops the series
+    assert targets[("batch", "ttft")] == 10.0  # defaults survive
+    assert cfg.windows == (30.0, 300.0)
+    with pytest.raises(ValueError, match="unknown SLO metric"):
+        SloConfig.from_json({"targets": {"interactive": {"nope": 1}}})
+    with pytest.raises(ValueError, match="objective"):
+        SloConfig.from_json({"objective": 1.5})
+    with pytest.raises(ValueError, match="windows"):
+        SloConfig.from_json({"windows": []})
+
+
+def test_engine_exports_slo_gauges(params):
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, page_size=8, num_pages=64, max_pages_per_slot=8))
+    model = JetStreamModel("m", "", engine=eng)
+    eng.start()
+    try:
+        eng.generate([1, 2, 3, 4], 6)
+        text = model.metrics_text()
+        assert ('slo_attainment_ratio{class="interactive",metric="ttft"'
+                in text)
+        assert 'slo_burn_rate{class="interactive"' in text
+        assert "engine_trace_evictions_total" in text
+        assert "slo" in eng.stats
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------- trace history budgets
+
+
+def test_trace_history_entry_budget_evicts_and_counts(params):
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, page_size=8, num_pages=64, max_pages_per_slot=8,
+        trace_history=3))
+    eng.start()
+    try:
+        rids = [eng.generate([1, 2, 3, i + 1], 2)["rid"] for i in range(6)]
+        assert eng.stats["trace_history_entries"] <= 3
+        assert eng.telemetry.trace_evictions.value() >= 3
+        assert eng.trace(rids[0]) is None  # evicted
+        assert eng.trace(rids[-1]) is not None  # newest survives
+    finally:
+        eng.stop()
+
+
+def test_trace_history_byte_budget_evicts(params):
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, page_size=8, num_pages=64, max_pages_per_slot=8,
+        trace_history=10_000, trace_history_bytes=900))
+    eng.start()
+    try:
+        for i in range(8):
+            eng.generate([1, 2, 3, i + 1], 2)
+        s = eng.stats
+        assert s["trace_history_bytes"] <= 900
+        assert s["trace_history_entries"] < 8
+        assert eng.telemetry.trace_evictions.value() >= 1
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------- engine-side trace identity
+
+
+def test_engine_adopts_trace_and_links_session_turns(params):
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, page_size=8, num_pages=64, max_pages_per_slot=8))
+    eng.start()
+    try:
+        ctx = TraceContext.mint()
+        r1 = eng.generate([1, 2, 3, 4] * 4, 6, trace=ctx.child(),
+                          session_id="chat-1")
+        t1 = eng.trace(r1["rid"])
+        assert t1["trace_id"] == ctx.trace_id
+        assert t1["parent_id"] is not None
+        assert t1["component"] == "engine"
+        by_id = eng.trace_by_id(ctx.trace_id)
+        assert [s["rid"] for s in by_id["spans"]] == [r1["rid"]]
+        # turn 2 (its own trace) links turn 1's span
+        r2 = eng.generate([1, 2, 3, 4] * 4 + r1["tokens"], 4,
+                          session_id="chat-1")
+        t2 = eng.trace(r2["rid"])
+        assert t2["trace_id"] != t1["trace_id"]  # fresh trace, minted
+        links = {l["type"]: l for l in t2.get("links", ())}
+        assert links["session_prev"]["trace_id"] == t1["trace_id"]
+        assert links["session_prev"]["span_id"] == t1["span_id"]
+        # flight events carry both correlation keys
+        ev = [e for e in eng.flight.snapshot() if e.get("trace_ids")]
+        assert ev and any(ctx.trace_id in (e.get("trace_ids") or ())
+                          for e in ev)
+    finally:
+        eng.stop()
+
+
+def test_flight_dump_referenced_from_trace(params, tmp_path):
+    """Satellite: a postmortem flight dump lands in the trace view —
+    trace_by_id (and therefore /debug/trace via the fan-out) cites the
+    dump file the incident produced, instead of leaving the responder to
+    grep the flight dir by timestamp."""
+    from kubeflow_tpu.serving.engine.faults import FaultConfig
+    from kubeflow_tpu.serving.errors import NonFiniteLogits
+
+    eng = Engine(params, CFG, EngineConfig(
+        max_slots=2, page_size=8, num_pages=64, max_pages_per_slot=8,
+        flight_dir=str(tmp_path),
+        chaos=FaultConfig(nan_logit_rate=1.0, target_rids=(0,))))
+    srv = ModelServer([JetStreamModel("fleet", "", engine=eng)], port=0)
+    srv.start()
+    try:
+        ctx = TraceContext.mint()
+        with pytest.raises(NonFiniteLogits):
+            eng.generate([1, 2, 3, 4], 4, trace=ctx.child())
+        rec = eng.trace_by_id(ctx.trace_id)
+        assert rec["spans"] and rec["spans"][0]["outcome"] == "failed"
+        assert rec["flight_dumps"], "NaN dump not referenced from trace"
+        assert all(str(tmp_path) in p for p in rec["flight_dumps"])
+        # the dump header itself carries the trace ids (grep-able both ways)
+        with open(rec["flight_dumps"][0]) as f:
+            header = json.loads(f.readline())
+        assert ctx.trace_id in header.get("trace_ids", ())
+        # and the HTTP surface serves the same reference
+        code, body = _get_json(srv.port,
+                               f"/engine/trace/{ctx.trace_id}")
+        assert code == 200
+        assert body["flight_dumps"] == rec["flight_dumps"]
+    finally:
+        srv.stop()
+        eng.stop()
+
+
+# ----------------------------------------------------------- proxy fixtures
+
+
+def _mk_service(api, name, svc_port, ann=None):
+    api.create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": name, "labels": {LABEL_ISVC: name},
+                     "annotations": {PROXY_PORT_ANNOTATION: str(svc_port),
+                                     **(ann or {})}},
+        "spec": {"selector": {"app": name}}})
+
+
+def _mk_pod(api, name, app, port):
+    api.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "labels": {"app": app},
+                     "annotations": {POD_PORT_ANNOTATION: str(port)}},
+        "spec": {},
+        "status": {"phase": "Running",
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+def _mk_fleet(params, n, chaos=None, ann=None):
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    proxy.chaos = chaos
+    svc_port = find_free_ports(1)[0]
+    _mk_service(api, "fleet", svc_port,
+                ann={RELAY_TIMEOUT_ANNOTATION: "2.0", **(ann or {})})
+    engines, servers = [], []
+    for i in range(n):
+        ec = EngineConfig(max_slots=4, page_size=8, num_pages=96,
+                          max_pages_per_slot=24,
+                          chaos=(chaos.engine_faults(i) if chaos else None))
+        eng = Engine(params, CFG, ec)
+        srv = ModelServer([JetStreamModel("fleet", "", engine=eng)], port=0)
+        srv.start()
+        _mk_pod(api, f"fleet-{i}", "fleet", srv.port)
+        engines.append(eng)
+        servers.append(srv)
+    proxy.sync()
+    return api, proxy, svc_port, engines, servers
+
+
+def _teardown(proxy, engines, servers):
+    proxy.shutdown()
+    for srv in servers:
+        srv.stop()
+    for eng in engines:
+        try:
+            eng.stop(drain=False)
+        except Exception:  # noqa: BLE001 — already dead
+            pass
+
+
+def _stream(port, prompt, mt, traceparent=None, timeout=60):
+    hdrs = {"Content-Type": "application/json"}
+    if traceparent:
+        hdrs["traceparent"] = traceparent
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v2/models/fleet/generate_stream",
+        data=json.dumps({"text_input": prompt,
+                         "parameters": {"max_tokens": mt}}).encode(),
+        headers=hdrs)
+    pieces, final, buf = [], None, b""
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        trace_hdr = r.headers.get("X-Trace-Id")
+        while True:
+            chunk = r.read1(65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                for line in raw.splitlines():
+                    if not line.startswith(b"data:"):
+                        continue
+                    ev = json.loads(line[5:].strip())
+                    if ev.get("done") and "error" not in ev:
+                        final = ev
+                    elif "error" not in ev and ev.get("text_output"):
+                        pieces.append(ev["text_output"])
+    return "".join(pieces), final, trace_hdr
+
+
+def _get_json(port, path, timeout=10):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog"
+
+
+# -------------------------------------------------- failover trace continuity
+
+
+def test_failover_keeps_one_trace_with_resumed_links(params):
+    """The acceptance headline: a replica killed mid-decode yields ONE
+    assembled trace containing the failed hop, the failover hop, and the
+    engine spans on BOTH replicas, with the continuation linking the
+    failed hop."""
+    chaos = FleetChaos(FleetFaultConfig(kill=(0, 1), kill_after_tokens=6))
+    api, proxy, svc_port, engines, servers = _mk_fleet(params, 2, chaos)
+    killed = []
+
+    def kill_maker(i):
+        def cb():
+            if not killed:
+                killed.append(i)
+                engines[i].stop(drain=False)
+        return cb
+
+    for i, srv in enumerate(servers):
+        chaos.register_replica(i, srv.port, kill_cb=kill_maker(i))
+    try:
+        for srv in servers:
+            _stream(srv.port, PROMPT, 4)
+            _stream(srv.port, PROMPT + "x" * 24, 4)
+        ctx = TraceContext.mint()
+        txt, final, trace_hdr = _stream(svc_port, PROMPT, 20,
+                                        traceparent=ctx.traceparent())
+        assert len(killed) == 1 and final["tokens"] == 20
+        # the stream's response headers advertise the trace id
+        assert trace_hdr == ctx.trace_id
+        code, tr = _get_json(svc_port, f"/debug/trace/{ctx.trace_id}")
+        assert code == 200
+        hops = [s for s in tr["spans"] if s.get("name") == "relay_attempt"]
+        assert len(hops) == 2
+        failed = [h for h in hops if h["outcome"] != "ok"]
+        resumed = [h for h in hops if h["kind"] == "resume"]
+        assert len(failed) == 1 and len(resumed) == 1
+        assert resumed[0]["outcome"] == "ok"
+        # the failover hop references the hop it picks up from
+        assert resumed[0]["resumed_from"] == failed[0]["span_id"]
+        # engine spans from BOTH replicas, one trace id end to end
+        eng_spans = [s for s in tr["spans"] if s.get("component") == "engine"]
+        assert len(eng_spans) == 2
+        assert {s["replica"] for s in eng_spans} == {"fleet-0", "fleet-1"}
+        assert all(s["trace_id"] == ctx.trace_id for s in eng_spans)
+        survivor = [s for s in eng_spans if s["outcome"] == "done"]
+        assert len(survivor) == 1
+        links = {l["type"]: l for l in survivor[0].get("links", ())}
+        assert links["resumed_from"]["span_id"] == failed[0]["span_id"]
+        # engine spans hang off their delivering hops in the tree
+        assert len(tr["tree"]) == 1
+        root = tr["tree"][0]
+        assert root["name"] == "request"
+        hop_children = {c["span_id"]: c for c in root["children"]}
+        assert all(h["span_id"] in hop_children for h in hops)
+        assert any(c["children"] for c in root["children"])
+    finally:
+        _teardown(proxy, engines, servers)
+
+
+def test_unary_retries_are_distinct_child_spans():
+    class _Failing(Model):
+        def predict(self, payload, headers=None):
+            raise RuntimeError("injected backend failure")
+
+    class _Echo(Model):
+        def predict(self, payload, headers=None):
+            return payload.get("instances", [])
+
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    srv_bad = ModelServer([_Failing("m")], port=0)
+    srv_ok = ModelServer([_Echo("m")], port=0)
+    srv_bad.start()
+    srv_ok.start()
+    svc_port = find_free_ports(1)[0]
+    try:
+        _mk_service(api, "svc", svc_port)
+        _mk_pod(api, "svc-0", "svc", srv_bad.port)
+        _mk_pod(api, "svc-1", "svc", srv_ok.port)
+        proxy.sync()
+        # drive requests until one pays a retry (RR may hit the good
+        # backend first); the traced request is the one that retried
+        for _ in range(4):
+            ctx = TraceContext.mint()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{svc_port}/v1/models/m:predict",
+                data=json.dumps({"instances": [1]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": ctx.traceparent()})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+                assert r.headers.get("X-Trace-Id") == ctx.trace_id
+            hops = [s for s in proxy.traces.get(ctx.trace_id)
+                    if s.get("name") == "relay_attempt"]
+            if len(hops) >= 2:
+                break
+        assert len(hops) == 2
+        assert hops[0]["outcome"] == "status_5xx"
+        assert hops[1]["outcome"] == "ok"
+        assert hops[0]["span_id"] != hops[1]["span_id"]
+        # both are children of the same relay root (distinct siblings)
+        assert hops[0]["parent_id"] == hops[1]["parent_id"]
+        assert hops[1]["resumed_from"] == hops[0]["span_id"]
+        roots = [s for s in proxy.traces.get(ctx.trace_id)
+                 if s.get("name") == "request"]
+        assert len(roots) == 1 and roots[0]["attempts"] == 2
+        # adopted inbound context: the relay root is OUR child
+        assert roots[0]["parent_id"] == ctx.span_id
+    finally:
+        proxy.shutdown()
+        srv_bad.stop()
+        srv_ok.stop()
+
+
+# --------------------------------------------------------- fleet aggregation
+
+
+def test_fleet_metrics_merge_is_sum_exact(params):
+    api, proxy, svc_port, engines, servers = _mk_fleet(params, 2)
+    try:
+        # uneven load so the sum is distinguishable from any single replica
+        for srv, n in zip(servers, (1, 2)):
+            for i in range(n):
+                _stream(srv.port, PROMPT + str(i), 4)
+        per_replica = []
+        for srv in servers:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+                per_replica.append(parse_exposition(r.read().decode()))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{svc_port}/fleet/metrics",
+                timeout=10) as r:
+            assert r.headers.get("Content-Type", "").startswith("text/plain")
+            merged = parse_exposition(r.read().decode())
+
+        def hist_counts(parsed):
+            out = {}
+            for labels, v in parsed.get("engine_ttft_seconds",
+                                        {"samples": ()})["samples"]:
+                if labels.get("__series__") == "_bucket":
+                    out[labels["le"]] = out.get(labels["le"], 0.0) + v
+            return out
+
+        want = {}
+        for p in per_replica:
+            for le, v in hist_counts(p).items():
+                want[le] = want.get(le, 0.0) + v
+        assert want and hist_counts(merged) == want
+        # counters sum too; gauges keep a replica label per series
+        req_sum = sum(v for p in per_replica
+                      for l, v in p["engine_requests_total"]["samples"])
+        got_sum = sum(v for l, v
+                      in merged["engine_requests_total"]["samples"])
+        assert got_sum == req_sum == 3
+        replicas = {l.get("replica")
+                    for l, _ in merged["engine_kv_pages"]["samples"]}
+        assert replicas == {"fleet-0", "fleet-1"}
+        # the SLO gauges ride along per-replica
+        assert "slo_attainment_ratio" in merged
+    finally:
+        _teardown(proxy, engines, servers)
+
+
+def test_debug_trace_unknown_id_404s(params):
+    api, proxy, svc_port, engines, servers = _mk_fleet(params, 1)
+    try:
+        code, body = _get_json(svc_port, "/debug/trace/" + "ab" * 16)
+        assert code == 404
+        assert body["spans"] == []
+        assert body["replicas_queried"] == ["fleet-0"]
+    finally:
+        _teardown(proxy, engines, servers)
+
+
+# ------------------------------------------------------- autoscaler slo view
+
+
+def test_autoscaler_collects_slo_view(monkeypatch):
+    from kubeflow_tpu.serving import autoscaler as asc
+    from kubeflow_tpu.serving.api import TARGET_CONCURRENCY_ANNOTATION
+
+    api = APIServer()
+    a = asc.ConcurrencyAutoscaler(api)
+    api.create({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "d", "annotations": {
+            TARGET_CONCURRENCY_ANNOTATION: "4"}},
+        "spec": {"replicas": 1,
+                 "selector": {"matchLabels": {"app": "d"}}}})
+    _mk_pod(api, "d-0", "d", 59999)
+
+    def fake_scrape(port, timeout=asc.DEFAULT_SCRAPE_TIMEOUT_S):
+        return {
+            "inflight_requests": 1.0,
+            'slo_attainment_ratio{class="interactive",metric="ttft",'
+            'model="m"}': 0.93,
+            'slo_attainment_ratio{class="batch",metric="queue_wait",'
+            'model="m"}': 1.0,
+        }
+
+    monkeypatch.setattr(asc, "scrape_metrics", fake_scrape)
+    a.sync()
+    view = a.slo_view()
+    assert len(view) == 1
+    (slo,) = view.values()
+    assert slo[("interactive", "ttft")] == pytest.approx(0.93)
+    assert slo[("batch", "queue_wait")] == 1.0
