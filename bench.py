@@ -598,6 +598,29 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["overlap"] = {"error": str(e)[:200]}
     try:
+        # pipelined-speculative sidebar: serving_bench --spec's headline
+        # (BENCH_SPEC.json) — accept rate is the host-overhead divisor the
+        # fused verify path buys, the mode-matrix byte-identity and the
+        # chaos/leak flags are the acceptance invariants
+        sp_path = os.path.join(REPO, "BENCH_SPEC.json")
+        if os.path.exists(sp_path):
+            with open(sp_path) as f:
+                sp = json.loads(f.readline())
+            out["spec"] = {
+                "accept_rate": sp.get("accept_rate"),
+                "pipelined_vs_sync_spec_x":
+                    sp.get("pipelined_vs_sync_spec_x"),
+                "tokens_per_sec_pipelined_spec":
+                    sp.get("tokens_per_sec_pipelined_spec"),
+                "byte_identical": sp.get("byte_identical"),
+                "chaos_victim_failed_only":
+                    sp.get("chaos", {}).get("victim_failed_only"),
+                "kv_pages_leaked": sp.get("kv_pages_leaked"),
+                "platform": sp.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["spec"] = {"error": str(e)[:200]}
+    try:
         # sessions sidebar: serving_bench --sessions's headline
         # (BENCH_SESSIONS.json) — warm-vs-cold TTFT per tier is the tiered-
         # KV payoff, the identity/leak/reconcile flags are the durability

@@ -190,6 +190,18 @@ JOBS = [
                                   "--out",
                                   os.path.join(REPO, "BENCH_FLEET.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # pipelined speculative decoding on a real chip (ISSUE 9): the fused
+    # verify dispatch's removed host gap IS device idle time on a TPU, and
+    # every accepted draft multiplies it — so the pipelined-vs-sync-spec
+    # ratio here (unlike the CPU box's parity-bounded number) measures the
+    # real overlap x acceptance win; refreshes BENCH_SPEC.json
+    {"name": "serving_spec_tiny",
+     "cmd": _serving_cmd("tiny", ["--spec", "--concurrency", "8",
+                                  "--prompt-len", "48",
+                                  "--max-tokens", "48",
+                                  "--out",
+                                  os.path.join(REPO, "BENCH_SPEC.json")]),
+     "timeout": 1500, "first_timeout": 900},
     # sessions on a real chip (ISSUE 7): multi-turn replay over the tiered
     # KV store — on TPU the cold baseline re-prefills at real HBM rates, so
     # warm-vs-cold TTFT here measures the genuine restore payoff (host-RAM

@@ -46,6 +46,18 @@ invariant with per-dispatch page-table snapshots.  ``pipeline_depth=0``
 keeps the fully synchronous loop as the parity oracle: greedy outputs are
 byte-identical between the two modes.
 
+Pipelined speculative decoding (ISSUE 9, README "Speculative decoding"):
+``speculative="prompt_lookup"`` now COMPOSES with the pipeline instead of
+forcing sync ticks.  Verify + longest-prefix accept/reject + NaN guard
+fuse into one dispatch (model.decode_step_verify_sample) returning a
+single packed ``[B, K]`` token row per tick; the next dispatch derives its
+committed-token feedback from that packed output on device, commit-behind
+extends to 1..K tokens per slot per tick (the C++ commits, stream pushes
+and TPOT telemetry run while the next verify executes), and the lookahead
+reserve covers up to K pages ahead.  The host n-gram index advances from
+the async readback between completion and the next dispatch — the only
+host work left on the critical path is the draft lookup itself.
+
 Sessions & tiered KV (ISSUE 7, README "Sessions & tiered KV"): requests
 carrying a ``session_id`` pin their finished turn's KV pages into the
 tiered store (kvstore.py: host RAM aging to checksummed disk page files)
@@ -81,8 +93,9 @@ from .scheduler import (PRIORITY_RANK, QosScheduler, QueueEntry,
 from .telemetry import (EngineTelemetry, FlightRecorder, RequestSpan,
                         TickProfiler)
 from .model import (DecoderConfig, decode_step, decode_step_k,
-                    decode_step_sample, prefill, prefill_chunk,
-                    sample_tokens, write_pages)
+                    decode_step_sample, decode_step_sample_packed,
+                    decode_step_verify_sample, prefill,
+                    prefill_chunk, sample_tokens, write_pages)
 from .native import NativeBatcher
 
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024)
@@ -153,8 +166,10 @@ class EngineConfig:
     # decode-loop pipelining: 1 (default) overlaps host orchestration with
     # the device step — sampling fused into the decode dispatch, async
     # token readback, commit-behind with lookahead page reservation; 0 is
-    # the fully synchronous loop (the greedy-parity oracle).  Speculative
-    # decoding ticks always run synchronously regardless.
+    # the fully synchronous loop (the greedy-parity oracle).  Composes
+    # with ``speculative``: verify + accept/reject + guard fuse into one
+    # dispatch and commits run behind it, 1..K tokens per slot per tick
+    # (README "Speculative decoding").
     pipeline_depth: int = 1
     # speculative decoding: "prompt_lookup" drafts the continuation of the
     # last n-gram's previous occurrence in the context and verifies up to
@@ -369,6 +384,9 @@ class Engine:
         if self._spec and engine_config.temperature > 0:
             raise ValueError("speculative decoding requires temperature 0 "
                              "(greedy acceptance is what makes it lossless)")
+        if self._spec and (engine_config.spec_max_draft < 1
+                           or engine_config.spec_ngram < 1):
+            raise ValueError("spec_max_draft and spec_ngram must be >= 1")
         from .model import make_kv_pool
 
         self._mesh = None
@@ -552,6 +570,14 @@ class Engine:
     # ---------------------------------------------------------------- public
 
     def start(self) -> None:
+        if (self._running and self._thread is not None
+                and self._thread.is_alive()):
+            # idempotent: serve.py's load() starts the engine it was handed,
+            # which a caller may already have started — a second loop
+            # thread on the same pools would race every dispatch's
+            # buffer-donation contract (two ticks donating the same
+            # k_pool/v_pool = "buffer has been deleted or donated" chaos)
+            return
         self._running = True
         self._draining = False
         self._last_tick_ts = time.monotonic()
@@ -1486,11 +1512,23 @@ class Engine:
                              cancelled=True)
         if decode_ready:
             did_work = True
-            if self._pipe_depth > 0 and self._spec is None:
-                self._isolated("decode", decode_ready,
-                               self._decode_tick_pipelined, decode_ready,
-                               shape={"rows": len(decode_ready),
-                                      "pipelined": True})
+            if self._pipe_depth > 0:
+                if self._spec is not None:
+                    # speculative ticks no longer force the sync loop: the
+                    # fused verify dispatch (ISSUE 9) keeps drafts on the
+                    # pipelined path, 1..K tokens committing behind it
+                    self._isolated("verify", decode_ready,
+                                   self._decode_tick_spec_pipelined,
+                                   decode_ready,
+                                   shape={"rows": len(decode_ready),
+                                          "speculative": True,
+                                          "pipelined": True,
+                                          "k": 1 + self.ec.spec_max_draft})
+                else:
+                    self._isolated("decode", decode_ready,
+                                   self._decode_tick_pipelined, decode_ready,
+                                   shape={"rows": len(decode_ready),
+                                          "pipelined": True})
                 return did_work
             # host mirrors ARE the decode view: mid-prefill slots hold
             # len 0 / trash rows by construction (_activate_decode)
@@ -2276,6 +2314,9 @@ class Engine:
         dispatch are discarded via the rid guard; a guard-tripped row
         (negative guarded token, see model.decode_step_sample) fails only
         itself, exactly like the sync loop's post-sample check."""
+        if rec.get("kind") == "spec":
+            self._commit_inflight_spec(rec)
+            return
         sampled = np.asarray(rec["sampled"])  # async copy started at dispatch
         for slot in rec["slots"]:
             rid = rec["rids"][slot]
@@ -2287,6 +2328,149 @@ class Engine:
                 continue
             self._commit(slot, tok)
 
+    # -------------------------------------------- pipelined speculative loop
+
+    def _accepted_row(self, pending: _Pending, row: "np.ndarray") -> list:
+        """Decode one packed verify row into the token list the sync commit
+        walk would have committed: the leading non-sentinel entries,
+        truncated at the remaining token budget and at the first stop id
+        (the batcher finishes the slot there — rc != 1 ends the sync walk).
+        Empty == the row's NaN guard tripped (sentinel-only row)."""
+        n = int((row >= 0).sum())  # packed rows are leading-accepted
+        toks = [int(t) for t in row[:n]]
+        budget = pending.max_new_tokens - len(pending.generated)
+        toks = toks[:max(0, budget)]
+        for j, t in enumerate(toks):
+            if t in self._stop_ids:
+                return toks[:j + 1]
+        return toks
+
+    def _stage_inflight_spec(self, rec: dict) -> bool:
+        """Read back the in-flight verify tick's packed tokens (async copy
+        started at dispatch) and STAGE them: append to ``pending.context``
+        (next tick's drafts read it) and advance the seq-len shadow — the
+        cheap host edits drafting needs NOW.  The heavyweight per-token
+        work (C++ commits, stream pushes, TPOT telemetry) stays deferred to
+        the commit-behind after the next dispatch.
+
+        Returns False when the tick needs a fence BEFORE the next dispatch
+        — a row finished (EOS / budget) or tripped the NaN guard, so its
+        release/fail must land before the next dispatch's snapshot — with
+        ``rec["fence_reason"]`` set to the postmortem-relevant label."""
+        packed = np.asarray(rec["packed"])
+        rec["packed_np"] = packed
+        reason = None
+        shadow = None
+        for slot in rec["slots"]:
+            rid = rec["rids"][slot]
+            pending = self._requests.get(rid)
+            if self._slot_req.get(slot) != rid or pending is None:
+                continue
+            toks = self._accepted_row(pending, packed[slot])
+            rec["staged"][slot] = toks
+            if not toks:  # sentinel row: NaN guard tripped mid-verify
+                reason = "nan"
+                continue
+            pending.context.extend(toks)
+            if (len(pending.generated) + len(toks) >= pending.max_new_tokens
+                    or toks[-1] in self._stop_ids):
+                reason = reason or "finish"  # slot finishes at commit
+            if shadow is None:
+                # rebound, never mutated in place: the in-flight dispatch
+                # may alias the previous shadow zero-copy on CPU backends
+                shadow = self._dec_lens_shadow.copy()
+            shadow[slot] += len(toks)
+        if shadow is not None:
+            self._dec_lens_shadow = shadow
+        rec["fence_reason"] = reason
+        return reason is None
+
+    def _commit_inflight_spec(self, rec: dict) -> None:
+        """Commit-behind for a fused verify tick: land 1..K staged tokens
+        per slot in the C++ batcher (and streams/telemetry).  Rows not yet
+        staged (a fence drained the pipeline before the steady-state
+        readback — preempt, stop, idle) are decoded from the packed array
+        here, context append included.  A sentinel (NaN-guarded) row fails
+        only its own slot, exactly like the sync verify's whole-pass
+        check."""
+        packed = rec.get("packed_np")
+        if packed is None:
+            packed = np.asarray(rec["packed"])
+        for slot in rec["slots"]:
+            rid = rec["rids"][slot]
+            if self._slot_req.get(slot) != rid or rid not in self._requests:
+                continue  # finished/failed/preempted behind the dispatch
+            pending = self._requests[rid]
+            toks = rec["staged"].get(slot)
+            staged = toks is not None
+            if not staged:
+                toks = self._accepted_row(pending, packed[slot])
+            if not toks:
+                rec["staged"].pop(slot, None)
+                self._fail_nan(slot, f"fused verify row (slot {slot})")
+                continue
+            d = rec["drafts"].get(slot) or ()
+            self._spec_proposed += len(d)
+            committed = 0
+            for t in toks:
+                rc = self._commit(slot, int(t), ctx=not staged)
+                committed += 1
+                if staged:
+                    # shrink-on-commit: anything left in rec["staged"]
+                    # after an exception — including one raised by a later
+                    # token's _commit — is exactly the uncommitted
+                    # remainder the failed tick's rollback must un-stage
+                    # from pending.context
+                    rec["staged"][slot] = toks[committed:]
+                if rc != 1:
+                    break  # finished / truncated: slot already released
+            if staged:
+                rest = rec["staged"].pop(slot)
+                if rest:
+                    # the batcher finished earlier than staging predicted —
+                    # un-stage the tail so context stays exactly prompt +
+                    # generated (preempt/pin snapshots read it)
+                    del pending.context[-len(rest):]
+            # accepted draft tokens = committed minus the bonus/correction
+            # token (the sync walk's per-token increment, summed)
+            acc = max(0, committed - 1)
+            self._spec_accepted += acc
+            if d:
+                self.telemetry.observe_spec(len(d), acc)
+
+    def _cover_row0(self, slot: int, S: int) -> bool:
+        """Commit-behind page accounting for the speculative pipeline: a
+        multi-token tick advances the shadow by 1..K, so the next
+        dispatch's row-0 write (position S-1) may sit past the pages the
+        committed length implies — reserve the shortfall up to
+        pages_for(S), i.e. as much as K/page_size + 1 pages ahead of the
+        landed commits (draft positions need no extra cover: _draft_for
+        clamps them to owned room, exactly like the sync path).  Returns
+        the slot's owned-page count (the tick hands it to _draft_for so
+        the row is scanned once per tick, not twice), or -1 when the pool
+        can't cover the row — the caller falls back to one sync tick
+        whose commit-time OOM truncates exactly like depth 0."""
+        need = self._pages_for(S)
+        if need > self.ec.max_pages_per_slot:
+            return -1
+        return self._reserve_to(slot, need)
+
+    def _reserve_to(self, slot: int, need: int) -> int:
+        """Reserve pages until the slot OWNS ``need`` (native.reserve_page;
+        a later commit crossing into a reserved page allocates nothing) and
+        mirror them into the host page table.  Returns the resulting
+        owned-page count (>= need), or -1 on pool exhaustion — both
+        lookahead callers fall back to a sync tick whose commit-time OOM
+        truncates exactly like depth 0."""
+        owned = int(np.count_nonzero(self._pt_host[slot]))
+        while owned < need:
+            p = self.batcher.reserve_page(slot)
+            if p < 0:
+                return -1
+            self._pt_host[slot, owned] = p
+            owned += 1
+        return owned
+
     def _ready_now(self) -> list:
         """The decode-ready slot set as of RIGHT NOW (post-drain): bound to
         a live request and not mid-prefill."""
@@ -2297,14 +2481,26 @@ class Engine:
     def _rebuild_device_state(self, decode_ready) -> None:
         """Upload the last committed token per slot — the device-resident
         feedback edge the fused decode step then carries forward between
-        fences (seq_lens ride the host shadow: advanced by arithmetic,
-        uploaded per dispatch, never read back from the device)."""
-        toks = np.zeros((self.ec.max_slots,), np.int32)
-        for slot in decode_ready:
-            gen = self._requests[self._slot_req[slot]].generated
-            toks[slot] = gen[-1] if gen else 0
+        fences (seq_lens ride the host shadow: advanced per-tick from the
+        committed/staged token counts, uploaded per dispatch, never read
+        back from the device).  In speculative mode the feedback is a SEED
+        packed row ``[last_token, -1, ...]`` shaped like the fused verify
+        dispatch's output, so steady-state ticks chain the previous packed
+        output directly."""
+        if self._spec is not None:
+            K = 1 + self.ec.spec_max_draft
+            seed = np.full((self.ec.max_slots, K), -1, np.int32)
+            for slot in decode_ready:
+                gen = self._requests[self._slot_req[slot]].generated
+                seed[slot, 0] = gen[-1] if gen else 0
+            self._dec_state = self._jnp.asarray(seed)
+        else:
+            toks = np.zeros((self.ec.max_slots,), np.int32)
+            for slot in decode_ready:
+                gen = self._requests[self._slot_req[slot]].generated
+                toks[slot] = gen[-1] if gen else 0
+            self._dec_state = self._jnp.asarray(toks)
         self._dec_lens_shadow = self._len_host.copy()
-        self._dec_state = self._jnp.asarray(toks)
         self._roster_dirty = False
         # reasons recorded by the drain's OWN commits (a finish during the
         # fence) are absorbed by this rebuild — a dangling one would
@@ -2343,13 +2539,8 @@ class Engine:
                 # one-past-final masked step of a row finishing behind the
                 # dispatch: the fused step trash-routes its KV write
                 continue
-            owned = int(np.count_nonzero(self._pt_host[slot]))
-            while owned < need:
-                p = self.batcher.reserve_page(slot)
-                if p < 0:
-                    return False
-                self._pt_host[slot, owned] = p
-                owned += 1
+            if self._reserve_to(slot, need) < 0:
+                return False
         return True
 
     def _decode_tick_pipelined(self, decode_ready) -> None:
@@ -2440,26 +2631,223 @@ class Engine:
                 self._roster_dirty = True
             raise
 
+    def _decode_tick_spec_pipelined(self, decode_ready) -> None:
+        """One pipelined SPECULATIVE tick (ISSUE 9): fence if the roster
+        changed, read back the previous verify tick's packed tokens (async
+        copy started at its dispatch) and stage them, draft from the
+        staged context, reserve up to K lookahead pages per slot, dispatch
+        the fused verify step (the device derives its own committed-token
+        feedback from the previous packed output), then commit the
+        PREVIOUS tick's 1..K tokens per slot while this one runs on device
+        — the per-token host work (C++ commits, stream pushes, TPOT) runs
+        behind the dispatch, cut off the critical path by the acceptance
+        factor."""
+        self._check_epoch()  # a superseded thread must not touch pipeline
+        K = 1 + self.ec.spec_max_draft
+        staged_rec = None  # staged-but-uncommitted record, for rollback
+        try:
+            if self._roster_dirty or self._dec_state is None:
+                reason, self._dirty_reason = (self._dirty_reason or "roster",
+                                              None)
+                self._drain_pipeline(reason)
+                self._check_epoch()
+                decode_ready = self._ready_now()
+                if not decode_ready:
+                    return
+                self._rebuild_device_state(decode_ready)
+            prev = self._inflight
+            staged_n = {}
+            if prev is not None:
+                if not self._stage_inflight_spec(prev):
+                    # a row finished (EOS/budget) or tripped the NaN guard
+                    # behind the dispatch: commit NOW at a fence so the
+                    # release/fail lands before the next dispatch's
+                    # page-table snapshot — the spec twin of the plain
+                    # loop's finish/nan fences.  Staging already extended
+                    # pending.context, so the rollback must see this
+                    # record if the drain's commit raises partway
+                    staged_rec = prev
+                    fr = prev["fence_reason"]
+                    self._drain_pipeline(fr)
+                    if fr == "nan" and self._dirty_reason == "nan":
+                        # this fence already carried the nan label; the
+                        # _fail_nan inside the drain re-marked the roster —
+                        # don't bill a second nan fence for the same trip
+                        self._dirty_reason = None
+                    self._check_epoch()
+                    decode_ready = self._ready_now()
+                    if not decode_ready:
+                        return
+                    self._rebuild_device_state(decode_ready)
+                    prev = None
+                else:
+                    staged_rec = prev
+                    staged_n = {s: len(t)
+                                for s, t in prev["staged"].items()}
+            # ---- row-0 lookahead cover + drafts (the sync loop's exact
+            # draft-size policy via _draft_for, so the any-drafts gate
+            # below fires on the same ticks as the sync loop's)
+            jnp = self._jnp
+            drafts = np.zeros((self.ec.max_slots, K - 1), np.int32)
+            dlen = np.zeros((self.ec.max_slots,), np.int32)
+            by_slot = {}
+            shadow = self._dec_lens_shadow
+            for slot in decode_ready:
+                S = int(shadow[slot])
+                if S <= 0:
+                    continue
+                owned = self._cover_row0(slot, S)
+                if owned < 0:
+                    # pool exhausted at the lookahead: run this tick through
+                    # the sync path (its commit-time rc==-2 handling
+                    # truncates the right row); device state rebuilds next
+                    # tick — same fallback the plain pipelined loop takes
+                    self._drain_pipeline("pool")
+                    decode_ready = self._ready_now()
+                    if not decode_ready:
+                        return
+                    self._decode_tick_single(decode_ready, self._len_host,
+                                             self._pt_host)
+                    return
+                pending = self._requests[self._slot_req[slot]]
+                gen = len(pending.generated) + staged_n.get(slot, 0)
+                d = self._draft_for(slot, S, gen_count=gen, owned=owned)
+                if d:
+                    drafts[slot, :len(d)] = d
+                    dlen[slot] = len(d)
+                    by_slot[slot] = list(d)
+            # per-dispatch page-table snapshot (double-buffered): the
+            # commit-behind below mutates _pt_host while this dispatch and
+            # possibly the previous one are still in flight
+            self._pt_flip ^= 1
+            buf = self._pt_dispatch[self._pt_flip]
+            np.copyto(buf, self._pt_host)
+            self._check_epoch()  # last fence before rebinding device pools
+            if by_slot:
+                # verify tick: 1 committed + up to K-1 draft tokens per row
+                # in one fused dispatch, accept/reject resolved on device
+                poison = None
+                if self._chaos is not None:
+                    poison = np.zeros((self.ec.max_slots,), bool)
+                    for row in self._chaos.nan_rows(self._row_rids(),
+                                                    phase="verify"):
+                        poison[row] = True
+                t_issue = time.perf_counter()
+                self._note_dispatch_gap(t_issue)
+                packed, self.k_pool, self.v_pool = decode_step_verify_sample(
+                    self.params, self.config, self._dec_state, drafts, dlen,
+                    shadow, buf, self.k_pool, self.v_pool, self._next_key(),
+                    poison,
+                    temperature=self.ec.temperature,
+                    guard=self.ec.logit_guard,
+                    paged=self._paged, mesh=self._mesh,
+                    lora_params=self._lora,
+                    adapter_ids=(np.array(self._aid_host)
+                                 if self._lora is not None else None),
+                )
+            else:
+                # no drafts anywhere this tick: mirror the sync loop's
+                # single-token dispatch (decode_step_sample_packed shares
+                # _sample_core/_decode_core with the sync decode_step, so a
+                # no-draft tick's numerics are STRUCTURALLY identical
+                # between the two modes — dispatching the K-wide verify
+                # here instead would expose bf16 reduction-order drift to
+                # near-ties).  The packed-shaped feedback derive and repack
+                # ride INSIDE the jit, so an index-miss tick stays one
+                # dispatch and mode switches need no fence.
+                poison = None
+                if self._chaos is not None:
+                    poison = np.zeros((self.ec.max_slots,), bool)
+                    for row in self._chaos.nan_rows(self._row_rids()):
+                        poison[row] = True
+                t_issue = time.perf_counter()
+                self._note_dispatch_gap(t_issue)
+                packed, self.k_pool, self.v_pool = decode_step_sample_packed(
+                    self.params, self.config, self._dec_state, shadow, buf,
+                    self.k_pool, self.v_pool, self._next_key(), poison,
+                    temperature=self.ec.temperature,
+                    guard=self.ec.logit_guard,
+                    paged=self._paged, mesh=self._mesh,
+                    lora_params=self._lora,
+                    adapter_ids=(np.array(self._aid_host)
+                                 if self._lora is not None else None),
+                )
+            self._dispatch_mark = (self._ticks, time.perf_counter())
+            if self._async_readback:
+                try:
+                    packed.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — best-effort prefetch
+                    pass
+            prev2, self._inflight = prev, {
+                "kind": "spec", "packed": packed,
+                "slots": tuple(decode_ready),
+                "rids": {s: self._slot_req[s] for s in decode_ready},
+                "drafts": by_slot, "staged": {},
+            }
+            self._dec_state = packed
+            if prev2 is not None:
+                # commit-behind: tick N's 1..K tokens per slot land while
+                # tick N+1 runs on device
+                self._commit_inflight(prev2)
+        except BaseException:
+            # same recovery contract as _decode_tick_pipelined: a failed
+            # tick leaves in-flight/device state suspect — reset so the
+            # retry rebuilds from committed host state (greedy re-derives
+            # any dropped tokens byte-identically); a SUPERSEDED thread
+            # must not touch state the restarted loop now owns
+            if getattr(self._tls, "epoch", None) in (None, self._epoch):
+                if staged_rec is not None:
+                    # un-stage context tokens the commit-behind never
+                    # landed (the commit pops each slot's staged entry as
+                    # it commits): the retry re-derives them byte-
+                    # identically, and a double-append here would poison
+                    # every later draft/preempt/pin snapshot
+                    for slot, toks in staged_rec.get("staged", {}).items():
+                        p = self._requests.get(
+                            staged_rec["rids"].get(slot))
+                        if p is not None and toks:
+                            del p.context[-len(toks):]
+                self._inflight = None
+                self._dec_state = None
+                self._roster_dirty = True
+            raise
+
     # ------------------------------------------------------- speculative
 
-    def _draft_for(self, slot: int, seq_len: int) -> list[int]:
+    def _draft_for(self, slot: int, seq_len: int,
+                   gen_count: Optional[int] = None,
+                   owned: Optional[int] = None) -> list[int]:
         """Prompt-lookup draft: continuation of the most recent earlier
         occurrence of the context's final n-gram, clamped so every draft
         position stays inside the slot's currently-owned pages.
 
         The n-gram index is built incrementally (each committed position is
         indexed exactly once per request), so a tick costs O(new tokens),
-        not an O(context) backward scan — the long-context host-loop fix."""
+        not an O(context) backward scan — the long-context host-loop fix.
+
+        ``gen_count`` overrides the generated-token count the budget clamp
+        uses: the pipelined speculative loop passes committed + STAGED
+        (readback landed, commit-behind pending) so drafts never overshoot
+        the token budget.  The room/reserve policy here is THE draft-size
+        policy for both loops — sharing it keeps the sync and pipelined
+        tick sequences structurally aligned (same any-drafts gate, same
+        dispatch shapes), which greedy byte-identity across the two modes
+        rests on.  ``owned`` passes a just-computed owned-page count (the
+        pipelined tick's _cover_row0 already scanned the row; scanning it
+        twice per tick is host work on the path this PR strips)."""
         if seq_len == 0:
             return []
         ps = self.ec.page_size
         # draft row j writes KV at position seq_len-1+j, which must land in
         # an OWNED page; count room against owned pages (reservations
         # included), not just the pages the committed length implies
-        owned = int(np.count_nonzero(self._pt_host[slot]))
+        if owned is None:
+            owned = int(np.count_nonzero(self._pt_host[slot]))
         room = owned * ps - seq_len
         pending = self._requests[self._slot_req[slot]]
-        budget = pending.max_new_tokens - len(pending.generated) - 1
+        if gen_count is None:
+            gen_count = len(pending.generated)
+        budget = pending.max_new_tokens - gen_count - 1
         if (room < min(self.ec.spec_max_draft, budget)
                 and self.batcher.free_pages > self.ec.max_slots):
             # near the boundary with drafts still wanted: reserve the next
@@ -2470,7 +2858,16 @@ class Engine:
             if p >= 0:
                 self._pt_host[slot, owned] = p
                 room += ps
-        limit = min(self.ec.spec_max_draft, room, budget)
+        return self._lookup_draft(pending,
+                                  min(self.ec.spec_max_draft, room, budget))
+
+    def _lookup_draft(self, pending: _Pending, limit: int) -> list:
+        """The prompt-lookup index walk shared by the sync and pipelined
+        speculative paths: advance the incremental n-gram index over any
+        newly-appended context (each position indexed exactly once per
+        request — staged tokens from the pipelined readback included), then
+        return up to ``limit`` continuation tokens of the most recent
+        EARLIER occurrence of the context's final n-gram."""
         if limit <= 0:
             return []
         ctx = pending.context
@@ -2510,6 +2907,8 @@ class Engine:
         # mirror mutation, so the (possibly aliased) buffers are stable
         # while the step is in flight
         self._check_epoch()  # last fence before rebinding device pools
+        t_issue = time.perf_counter()
+        self._note_dispatch_gap(t_issue)
         logits, self.k_pool, self.v_pool = decode_step_k(
             self.params, self.config, tokens,
             seq_lens, page_table,
@@ -2518,7 +2917,9 @@ class Engine:
             adapter_ids=(self._aid_host
                          if self._lora is not None else None),
         )
-        logits, ok_dev = self._guard_logits(logits, self._row_rids())
+        self._dispatch_mark = (self._ticks, time.perf_counter())
+        logits, ok_dev = self._guard_logits(logits, self._row_rids(),
+                                            phase="verify")
         B, _, V = logits.shape
         sampled = np.asarray(sample_tokens(
             logits.reshape(B * K, V), self._next_key(), self.ec.temperature,
@@ -2532,6 +2933,7 @@ class Engine:
                 continue
             d = drafts.get(slot) or []
             self._spec_proposed += len(d)
+            acc = 0
             for j in range(len(d) + 1):
                 tok = int(sampled[slot, j])
                 rc = self._commit(slot, tok)
@@ -2542,6 +2944,9 @@ class Engine:
                 if j >= len(d) or d[j] != tok:
                     break
                 self._spec_accepted += 1
+                acc += 1
+            if d:
+                self.telemetry.observe_spec(len(d), acc)
 
     def _pages_for(self, tokens: int) -> int:
         return (tokens + self.ec.page_size - 1) // self.ec.page_size
@@ -2562,9 +2967,12 @@ class Engine:
         self._prefill_rows.pop(slot, None)
         self._mark_roster_change("admit")
 
-    def _commit(self, slot: int, token: int) -> int:
+    def _commit(self, slot: int, token: int, ctx: bool = True) -> int:
         """Record one generated token; returns the batcher rc (1 = keep
-        decoding; anything else means the slot was finished+released)."""
+        decoding; anything else means the slot was finished+released).
+        ``ctx=False``: the token was already STAGED into ``pending.context``
+        by the pipelined speculative loop's readback (drafting needed it
+        before this commit-behind landed) — don't append it twice."""
         self._check_epoch()
         rid = self._slot_req[slot]
         pending = self._requests[rid]
@@ -2577,7 +2985,8 @@ class Engine:
                                             pending.priority)
             pending.last_token_at = now
         pending.generated.append(token)
-        pending.context.append(token)
+        if ctx:
+            pending.context.append(token)
         if pending.stream is not None:
             pending.stream.put(token)
         is_eos = token in self._stop_ids

@@ -33,9 +33,12 @@ pipeline FENCE point, never mid-overlap.  ``should_preempt`` signals are
 consumed at the tick top and ``_preempt_slot`` drains the pipeline before
 touching the victim; decode-phase NaNs ride the fused dispatch as a poison
 mask and surface at the commit-behind fence one tick later (``nan_phase=
-"decode"`` aims there specifically); dispatch errors raise inside the
-decode isolation boundary, which resets the pipeline so the retry rebuilds
-from committed host state — all byte-identical under greedy either way.
+"decode"`` aims there specifically, ``nan_phase="verify"`` aims at the
+fused speculative verify dispatch instead — its sentinel-encoded row must
+fail ONLY the victim slot with zero phantom accepted tokens, ISSUE 9);
+dispatch errors raise inside the decode isolation boundary, which resets
+the pipeline so the retry rebuilds from committed host state — all
+byte-identical under greedy either way.
 
 Storage scope (ISSUE 7): ``StorageFaultConfig``/``StorageChaos`` inject
 byte-level faults into the tiered KV store's disk tier (kvstore.py) —
@@ -91,11 +94,16 @@ class FaultConfig:
     # restrict NaN poisoning to these request ids (empty = any row)
     target_rids: Tuple[int, ...] = ()
     # restrict NaN poisoning to one sample phase: "" = any, "prefill" =
-    # only the fused first-token sample, "decode" = only decode ticks.
-    # "decode" is how the pipelined-loop tests aim a NaN at a row that has
-    # already LEFT the synchronous prefill path — the poison then rides the
-    # fused decode dispatch and is detected at the commit-behind fence, one
-    # tick after injection (engine.py "Tick pipelining")
+    # only the fused first-token sample, "decode" = only plain decode
+    # ticks, "verify" = only speculative verify passes (sync AND the fused
+    # pipelined dispatch — ISSUE 9).  "decode" is how the pipelined-loop
+    # tests aim a NaN at a row that has already LEFT the synchronous
+    # prefill path — the poison then rides the fused decode dispatch and
+    # is detected at the commit-behind fence, one tick after injection
+    # (engine.py "Tick pipelining"); "verify" does the same for the
+    # speculative path, where the guard must also discard every
+    # not-yet-committed accepted token of the poisoned pass (no phantom
+    # multi-token commit from NaN logits)
     nan_phase: str = ""
     # sleep slow_tick_s at the top of every Nth tick (0 = off), or exactly
     # once at tick slow_tick_on (1-based; -1 = off): makes the loop look
@@ -174,9 +182,10 @@ class ChaosInjector:
         """Rows (indices into ``row_rids``) whose logits should be poisoned
         this tick.  ``row_rids``: request id per logits row (-1 = inactive
         row, never poisoned).  ``phase`` is the sample site asking
-        ("prefill" | "decode"); draws happen only when the config's
-        ``nan_phase`` matches (empty matches both), so phase filtering does
-        not perturb the RNG stream of the phase under test."""
+        ("prefill" | "decode" | "verify"); draws happen only when the
+        config's ``nan_phase`` matches (empty matches all), so phase
+        filtering does not perturb the RNG stream of the phase under
+        test."""
         c = self.config
         if c.nan_logit_rate <= 0:
             return []

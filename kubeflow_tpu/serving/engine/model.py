@@ -709,6 +709,18 @@ def decode_step_sample(params, config: DecoderConfig, tokens, seq_lens,
     over a row is exactly ``isfinite(row).all()`` (jnp.min/max propagate
     NaN, and any infinity surfaces at one of the extremes).
     """
+    return _sample_core(params, config, tokens, seq_lens, page_table,
+                        k_pool, v_pool, key, poison, temperature, guard,
+                        paged, mesh, lora_params, adapter_ids)
+
+
+def _sample_core(params, config, tokens, seq_lens, page_table, k_pool,
+                 v_pool, key, poison, temperature, guard, paged, mesh,
+                 lora_params, adapter_ids):
+    """Shared trace body of the fused single-token step —
+    ``decode_step_sample`` and ``decode_step_sample_packed`` both inline
+    this, so the plain pipelined loop and the speculative loop's no-draft
+    tick can never drift numerically."""
     logits, k_pool, v_pool = _decode_core(
         params, config, jnp.maximum(tokens, 0), seq_lens, page_table,
         k_pool, v_pool, paged=paged, mesh=mesh, lora_params=lora_params,
@@ -725,35 +737,47 @@ def decode_step_sample(params, config: DecoderConfig, tokens, seq_lens,
     return sampled, k_pool, v_pool
 
 
-@functools.partial(jax.jit, static_argnames=("config", "paged", "mesh"),
+@functools.partial(jax.jit,
+                   static_argnames=("config", "temperature", "guard",
+                                    "paged", "mesh"),
                    donate_argnames=("k_pool", "v_pool"))
-def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
-                  k_pool, v_pool, paged: bool = False, mesh=None,
-                  lora_params=None, adapter_ids=None):
-    """Speculative verify step: process 1 committed + (K-1) draft tokens per
-    slot in ONE pass.
+def decode_step_sample_packed(params, config: DecoderConfig, prev_packed,
+                              seq_lens, page_table, k_pool, v_pool, key,
+                              poison=None, temperature: float = 0.0,
+                              guard: bool = True, paged: bool = False,
+                              mesh=None, lora_params=None, adapter_ids=None):
+    """No-draft tick of the pipelined speculative loop: the fused
+    single-token step (same ``_sample_core`` trace as
+    ``decode_step_sample``) wearing ``decode_step_verify_sample``'s packed
+    ``[B, K]`` feedback edge on BOTH sides, so index-miss ticks stay ONE
+    dispatch.  Input token = last accepted entry of the previous tick's
+    packed row, derived in-trace (an all-sentinel NaN row yields -1, which
+    ``_sample_core`` clamps — garbage-in-garbage-out, the engine fences
+    that row one commit later); output = ``[tok, -1, ...]`` (a
+    guard-tripped sample is negative, so its leading-nonneg count is 0 —
+    exactly the verify path's all-sentinel NaN encoding)."""
+    B, K = prev_packed.shape
+    n_prev = jnp.sum((prev_packed >= 0).astype(jnp.int32), axis=1)
+    tok0 = jnp.take_along_axis(
+        prev_packed, jnp.maximum(n_prev - 1, 0)[:, None], axis=1)[:, 0]
+    sampled, k_pool, v_pool = _sample_core(
+        params, config, tok0, seq_lens, page_table, k_pool, v_pool, key,
+        poison, temperature, guard, paged, mesh, lora_params, adapter_ids)
+    packed = jnp.concatenate(
+        [sampled[:, None], jnp.full((B, K - 1), -1, jnp.int32)], axis=1)
+    return packed, k_pool, v_pool
 
-    tokens: [B, K] int32 — tokens[b, 0] is the slot's last committed token
-    (position seq_lens[b]-1); tokens[b, 1:] are draft tokens at the following
-    positions. seq_lens counts ONLY committed tokens. Returns
-    (logits [B, K, vocab], k_pool, v_pool): logits[b, j] predicts the token
-    at position seq_lens[b]+j — the caller accepts the longest draft prefix
-    that matches argmax (greedy speculative decoding is lossless).
 
-    KV for every draft position is written to the pool; rejected positions
-    hold garbage that stays masked (reads clip at the committed seq_len) and
-    is overwritten when a real token reaches that position. The caller must
-    ensure draft positions stay within the slot's OWNED pages (the engine
-    clamps draft length to the current page's remaining room).
-
-    Inactive slots (seq_len==0) clamp to position 0 and produce garbage
-    logits the caller ignores — static shapes beat recompiles.
-
-    ``paged=True`` verifies through the Pallas kernel (paged_attention.py):
-    each query row's causal horizon is offset by its draft index in-kernel,
-    so speculative decoding composes with paged attention (and, via the
-    kernel's int8/shard_map support, with kv_quant and TP).
-    """
+def _decode_core_k(params, config: DecoderConfig, tokens, seq_lens,
+                   page_table, k_pool, v_pool, paged: bool = False, mesh=None,
+                   lora_params=None, adapter_ids=None):
+    """Shared trace body of the K-token (speculative verify) step —
+    ``decode_step_k`` (logits out, host accepts) and
+    ``decode_step_verify_sample`` (accept/reject + sampling fused in, the
+    pipelined engine's speculative path) both inline this, so the two
+    entry points can never drift numerically (greedy byte-identity between
+    the sync and pipelined speculative loops rests on that, exactly like
+    ``_decode_core`` does for the single-token step)."""
     c = config
     B, K = tokens.shape
     lora = None if lora_params is None else (lora_params, adapter_ids)
@@ -802,6 +826,132 @@ def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
     x = _rms_norm(x, params["ln_out"], c.norm_eps)
     logits = (x @ _w(params["unembed"])).astype(jnp.float32)
     return logits, k_pool, v_pool
+
+
+@functools.partial(jax.jit, static_argnames=("config", "paged", "mesh"),
+                   donate_argnames=("k_pool", "v_pool"))
+def decode_step_k(params, config: DecoderConfig, tokens, seq_lens, page_table,
+                  k_pool, v_pool, paged: bool = False, mesh=None,
+                  lora_params=None, adapter_ids=None):
+    """Speculative verify step: process 1 committed + (K-1) draft tokens per
+    slot in ONE pass.
+
+    tokens: [B, K] int32 — tokens[b, 0] is the slot's last committed token
+    (position seq_lens[b]-1); tokens[b, 1:] are draft tokens at the following
+    positions. seq_lens counts ONLY committed tokens. Returns
+    (logits [B, K, vocab], k_pool, v_pool): logits[b, j] predicts the token
+    at position seq_lens[b]+j — the caller accepts the longest draft prefix
+    that matches argmax (greedy speculative decoding is lossless).
+
+    KV for every draft position is written to the pool; rejected positions
+    hold garbage that stays masked (reads clip at the committed seq_len) and
+    is overwritten when a real token reaches that position. The caller must
+    ensure draft positions stay within the slot's OWNED pages (the engine
+    clamps draft length to the current page's remaining room).
+
+    Inactive slots (seq_len==0) clamp to position 0 and produce garbage
+    logits the caller ignores — static shapes beat recompiles.
+
+    ``paged=True`` verifies through the Pallas kernel (paged_attention.py):
+    each query row's causal horizon is offset by its draft index in-kernel,
+    so speculative decoding composes with paged attention (and, via the
+    kernel's int8/shard_map support, with kv_quant and TP).
+    """
+    return _decode_core_k(params, config, tokens, seq_lens, page_table,
+                          k_pool, v_pool, paged=paged, mesh=mesh,
+                          lora_params=lora_params, adapter_ids=adapter_ids)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "temperature", "guard",
+                                    "paged", "mesh"),
+                   donate_argnames=("k_pool", "v_pool"))
+def decode_step_verify_sample(params, config: DecoderConfig, prev_packed,
+                              drafts, draft_len, seq_lens, page_table,
+                              k_pool, v_pool, key, poison=None,
+                              temperature: float = 0.0, guard: bool = True,
+                              paged: bool = False, mesh=None,
+                              lora_params=None, adapter_ids=None):
+    """Speculative verify with longest-prefix accept/reject, sampling and
+    the NaN guard fused into ONE dispatch — the pipelined engine loop's
+    speculative tick body (the K-token sibling of ``decode_step_sample``,
+    sharing ``_decode_core_k`` with the sync path's ``decode_step_k``).
+
+    ``prev_packed``: [B, K] int32 — the PREVIOUS verify tick's packed
+    output (see below), kept device-resident so the committed-token
+    feedback edge never round-trips through the host: row b's input token
+    0 is derived in-kernel as the last accepted entry of
+    ``prev_packed[b]``.  After a fence the engine seeds it with a
+    host-built row ``[last_committed, -1, -1, ...]``.  ``drafts``:
+    [B, K-1] int32 prompt-lookup draft tokens (host-uploaded — the n-gram
+    index is host state); ``draft_len``: [B] int32 valid draft count per
+    row (padding beyond it never matches, so padded rows cannot be
+    accepted).  ``seq_lens``: [B] int32 committed length per slot
+    INCLUDING the current token — the engine's host shadow, advanced from
+    the previous tick's readback and uploaded per dispatch, never read
+    back.
+
+    Output discipline (mirrors ``decode_step_sample``'s single small
+    output): ONE packed [B, K] int32 row per slot.  ``packed[b, :m]`` are
+    the m = accepted+1 tokens greedy would have committed (the accepted
+    draft prefix plus the bonus/correction token from the first
+    non-matching row) and every later entry is the sentinel ``-1`` — the
+    accepted COUNT is encoded in the packing, not a second output.  A row
+    whose logits tripped the NaN guard (any of its K verify rows
+    non-finite, matching the sync loop's whole-pass check) is
+    sentinel-encoded as ALL ``-1`` (leading count 0, impossible for a
+    healthy row — every live row emits at least the bonus token); the
+    engine fails that slot at the commit-behind fence.  ``poison``
+    ([B] bool or None) is the chaos injector's NaN mask for the
+    ``nan_phase="verify"`` fault class.
+
+    Losslessness/byte-identity: logits come from the same ``_decode_core_k``
+    trace the sync verify dispatches, the per-row sampler IS
+    ``sample_tokens`` (inlined under this jit), and the acceptance rule —
+    longest prefix j with drafts[b, j] == argmax(logits[b, j]) — is the
+    device transliteration of the sync loop's commit-then-compare walk, so
+    accepted tokens are exactly what token-by-token greedy decoding would
+    have produced.
+    """
+    B, K = prev_packed.shape
+    # committed-token feedback, derived on device: the last accepted token
+    # of the previous packed row (index = count of non-sentinel entries - 1;
+    # packed rows are leading-accepted by construction).  A sentinel-only
+    # row (previous guard trip) clamps to 0 — the engine fences and discards
+    # that slot before its garbage can be committed.
+    n_prev = jnp.sum((prev_packed >= 0).astype(jnp.int32), axis=1)
+    tok0 = jnp.take_along_axis(
+        prev_packed, jnp.maximum(n_prev - 1, 0)[:, None], axis=1)[:, 0]
+    tokens = jnp.concatenate(
+        [jnp.maximum(tok0, 0)[:, None], drafts.astype(jnp.int32)], axis=1)
+    logits, k_pool, v_pool = _decode_core_k(
+        params, config, tokens, seq_lens, page_table, k_pool, v_pool,
+        paged=paged, mesh=mesh, lora_params=lora_params,
+        adapter_ids=adapter_ids)
+    if poison is not None:
+        logits = jnp.where(poison[:, None, None], jnp.float32(jnp.nan),
+                           logits)
+    V = logits.shape[-1]
+    # the SAME sampler both sync paths dispatch (inlines under this jit):
+    # an edit to sample_tokens can never split the paths' numerics
+    sampled = sample_tokens(logits.reshape(B * K, V), key,
+                            temperature).reshape(B, K)
+    # longest-prefix accept: row j is committable iff every earlier draft
+    # matched what greedy produced at its position (the sync loop's
+    # "if d[j] != tok: break" as a cumulative product), and padding past
+    # draft_len never matches
+    j_draft = jax.lax.broadcasted_iota(jnp.int32, (B, K - 1), 1)
+    match = (drafts == sampled[:, : K - 1]) & (j_draft < draft_len[:, None])
+    n_acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    j_tok = jax.lax.broadcasted_iota(jnp.int32, (B, K), 1)
+    packed = jnp.where(j_tok <= n_acc[:, None], sampled, jnp.int32(-1))
+    if guard:
+        # finite(min) & finite(max) over a row's K*V logits is exactly
+        # isfinite(row).all() — same identity decode_step_sample documents
+        ok = (jnp.isfinite(jnp.min(logits, axis=(1, 2)))
+              & jnp.isfinite(jnp.max(logits, axis=(1, 2))))
+        packed = jnp.where(ok[:, None], packed, jnp.int32(-1))
+    return packed, k_pool, v_pool
 
 
 # ----------------------------------------------------------------- reference

@@ -134,7 +134,7 @@ class NativeBatcher:
         """Pre-allocate one page for an active slot.  Returns the page id,
         -1 no-op (bad/inactive slot or per-slot cap), -2 pool empty.
 
-        Lookahead contract (the engine's two consumers rely on it):
+        Lookahead contract (the engine's consumers rely on it):
         speculative drafting reserves the next page so boundary-tick drafts
         have owned KV positions, and the PIPELINED decode loop reserves
         every page a dispatch will write into BEFORE dispatching, because
@@ -144,7 +144,18 @@ class NativeBatcher:
         already long enough and allocates nothing, so reservation and
         commit-growth compose; a reservation never used (the row finished
         behind the dispatch, or drafts were rejected) is freed with the
-        slot by ``release`` like any owned page — no leak path."""
+        slot by ``release`` like any owned page — no leak path.
+
+        Multi-token (speculative) extension, ISSUE 9: the pipelined
+        VERIFY dispatch writes up to K = 1 + spec_max_draft positions per
+        slot per tick, and its commits land 1..K ``commit_token_ex``
+        calls per slot one tick late — so the engine reserves up to
+        ``pages_for(seq_len + draft_len)`` pages (as many as K/page_size
+        + 1 new pages) before each dispatch.  The same composition rule
+        makes this safe: however many of those 1..K commits cross page
+        boundaries, each crossing finds its page already reserved and
+        allocates nothing, so variable tokens-per-tick never races the
+        free list, and rejected-draft reservations free with the slot."""
         return load_library().eng_reserve_page(self._handle(), slot)
 
     def release(self, slot: int, prefix_hashes=None) -> None:

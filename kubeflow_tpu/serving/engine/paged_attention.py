@@ -16,7 +16,10 @@ Design (pallas_guide.md):
   * GQA: the q block per (slot, kv head) is the [K*group, hd] bundle of the
     query heads sharing that KV head — K > 1 is the speculative-verify case
     (1 committed + K-1 draft tokens in one pass), with each query row's
-    causal horizon offset by its draft index;
+    causal horizon offset by its draft index.  This per-row horizon is the
+    whole verify-pass contract, so BOTH speculative entry points — the sync
+    ``decode_step_k`` and the pipelined fused ``decode_step_verify_sample``
+    (ISSUE 9) — run through this same kernel unchanged when paged=True;
   * pages past every query's horizon are masked per-position and skipped as
     whole blocks via ``pl.when`` (no FLOPs for dead pages — the paged
     analogue of flash attention's causal block skip);

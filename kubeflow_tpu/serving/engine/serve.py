@@ -199,6 +199,29 @@ class JetStreamModel(Model):
                         kkw["chaos"] = StorageFaultConfig(**kkw["chaos"])
                     kw["kv_store"] = KVStoreConfig(**kkw)
                 ec = EngineConfig(**kw)
+                # speculative block (README "Speculative decoding"):
+                # validate the knob composition HERE with a config-level
+                # message — Engine's own ValueError is correct but names no
+                # file, and a pod that crash-loops on a bad engine.json
+                # should say which key to fix.  (Requests carry no
+                # temperature parameter; the greedy requirement is a
+                # config-time contract, not a per-request 400.)
+                if ec.speculative is not None:
+                    if ec.speculative != "prompt_lookup":
+                        raise ValueError(
+                            f"{path}: speculative={ec.speculative!r} is not "
+                            "supported (only \"prompt_lookup\")")
+                    if ec.temperature > 0:
+                        raise ValueError(
+                            f"{path}: speculative=\"prompt_lookup\" requires "
+                            f"temperature 0, got {ec.temperature} — greedy "
+                            "acceptance is what makes speculative decoding "
+                            "lossless")
+                    if ec.spec_max_draft < 1 or ec.spec_ngram < 1:
+                        raise ValueError(
+                            f"{path}: spec_max_draft and spec_ngram must be "
+                            f">= 1 (got {ec.spec_max_draft}, "
+                            f"{ec.spec_ngram})")
                 # an operator's explicit eos_id — INCLUDING -1 "never stop
                 # early" — must win over the checkout's declaration
                 eos_explicit = "eos_id" in raw or "eos_ids" in raw

@@ -58,6 +58,12 @@ BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 GAP_BUCKETS_S = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
                  0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
 
+# Speculative accept-length histogram (ISSUE 9): accepted draft tokens per
+# verify pass, 0..K — the distribution behind the headline accept rate
+# (engine_spec_accepted_tokens_total / engine_spec_draft_tokens_total).
+# Buckets reach the largest spec_max_draft anyone configures in practice.
+SPEC_ACCEPT_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16)
+
 # terminal span phases (everything else is a lifecycle waypoint)
 TERMINAL_PHASES = ("done", "shed", "failed", "cancelled")
 
@@ -276,6 +282,22 @@ class EngineTelemetry:
         self.pipeline_fences = r.counter(
             "engine_pipeline_fences_total",
             "decode-pipeline drains to a sync barrier, by reason")
+        # Speculative decoding surface (ISSUE 9): drafted vs accepted token
+        # totals (their ratio is the accept rate — the factor by which the
+        # fused verify path divides per-token host overhead) and the
+        # per-verify-pass accept-length distribution.  Counted identically
+        # by the sync (depth-0 oracle) and pipelined speculative loops.
+        self.spec_draft_tokens = r.counter(
+            "engine_spec_draft_tokens_total",
+            "prompt-lookup draft tokens proposed to the verify step")
+        self.spec_accepted_tokens = r.counter(
+            "engine_spec_accepted_tokens_total",
+            "draft tokens accepted by greedy verification (excludes the "
+            "per-pass bonus token)")
+        self.spec_accept_len = r.histogram(
+            "engine_spec_accept_len",
+            "accepted draft tokens per verify pass with drafts proposed "
+            "(0..spec_max_draft)", SPEC_ACCEPT_BUCKETS)
         # Tiered KV store / session surface (ISSUE 7): per-tier occupancy
         # (set at scrape time from the store's stats), an operations
         # counter labeled by tier and event (spill/evict/verify_fail/...),
@@ -377,6 +399,17 @@ class EngineTelemetry:
     def count_fence(self, reason: str) -> None:
         if self.enabled:
             self.pipeline_fences.inc(reason=reason)
+
+    def observe_spec(self, drafted: int, accepted: int) -> None:
+        """One verify pass that PROPOSED drafts: ``drafted`` tokens offered,
+        ``accepted`` of them kept (bonus token excluded).  No-draft passes
+        are not observed — they would swamp the accept-length histogram
+        with structural zeros during index-miss phases."""
+        if self.enabled and drafted:
+            self.spec_draft_tokens.inc(drafted)
+            if accepted:
+                self.spec_accepted_tokens.inc(accepted)
+            self.spec_accept_len.observe(accepted)
 
     def count_kv_event(self, tier: str, event: str) -> None:
         if self.enabled:

@@ -96,6 +96,11 @@ def check_exposition(text: str) -> dict:
     for name, kind in types.items():
         if kind != "histogram":
             continue
+        if not any(s.startswith(name + "_") for s in samples):
+            # declared-but-unobserved histogram (e.g. engine_spec_accept_len
+            # on a speculation-off engine): TYPE/HELP with zero series is
+            # valid exposition — there is just nothing to check yet
+            continue
         counts = {norm(lab): v for lab, v in samples.get(f"{name}_count", [])}
         assert counts, f"histogram {name} missing _count"
         assert samples.get(f"{name}_sum"), f"histogram {name} missing _sum"
