@@ -621,6 +621,30 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["spec"] = {"error": str(e)[:200]}
     try:
+        # disaggregated-serving sidebar: serving_bench --disagg's headline
+        # (BENCH_DISAGG.json) — the decode-pool TPOT ratio under a prefill
+        # burst is the role-split payoff, the identity/leak/chaos flags
+        # are the handoff acceptance invariants
+        dg_path = os.path.join(REPO, "BENCH_DISAGG.json")
+        if os.path.exists(dg_path):
+            with open(dg_path) as f:
+                dg = json.loads(f.readline())
+            out["disagg"] = {
+                "disagg_over_unified_tpot_x":
+                    dg.get("disagg_over_unified_tpot_x"),
+                "p99_tpot_during_burst_disagg_s":
+                    dg.get("p99_tpot_during_burst_disagg_s"),
+                "p99_tpot_during_burst_unified_s":
+                    dg.get("p99_tpot_during_burst_unified_s"),
+                "byte_identical_disagg": dg.get("byte_identical_disagg"),
+                "byte_identical_chaos": dg.get("byte_identical_chaos"),
+                "kv_pages_leaked": dg.get("kv_pages_leaked"),
+                "handoff_frames_pending": dg.get("handoff_frames_pending"),
+                "platform": dg.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["disagg"] = {"error": str(e)[:200]}
+    try:
         # sessions sidebar: serving_bench --sessions's headline
         # (BENCH_SESSIONS.json) — warm-vs-cold TTFT per tier is the tiered-
         # KV payoff, the identity/leak/reconcile flags are the durability

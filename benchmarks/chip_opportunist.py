@@ -217,6 +217,21 @@ JOBS = [
                                   os.path.join(REPO,
                                                "BENCH_SESSIONS.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # disaggregated prefill/decode on a real chip (ISSUE 10): on TPU the
+    # tick floor is the genuine device step, so the decode-pool p99 TPOT
+    # under a prefill burst vs the unified arm measures the real
+    # role-split payoff (prefill FLOPs displaced off the decode chip), and
+    # the handoff byte-identity/leak/chaos gates run at device speed;
+    # refreshes BENCH_DISAGG.json
+    # (floor 2ms keeps the steady streams alive through the burst window
+    # even at chip decode rates; the device step dominates when slower)
+    {"name": "serving_disagg_tiny",
+     "cmd": _serving_cmd("tiny", ["--disagg", "--prompt-len", "160",
+                                  "--max-tokens", "384",
+                                  "--disagg-tick-floor", "0.002",
+                                  "--out",
+                                  os.path.join(REPO, "BENCH_DISAGG.json")]),
+     "timeout": 1500, "first_timeout": 900},
     # 12. multi-LoRA mixed-batch overhead on chip (r4 feature): 1b config,
     #     4 adapters round-robin vs the plain 1b row above
     {"name": "serving_1b_lora4",

@@ -209,12 +209,15 @@ def _run_chaos(args, config, params, lora) -> None:
 
 
 def _sse_generate(port: int, model: str, prompt: str, mt: int,
-                  headers: dict = None, timeout: float = 600.0):
+                  headers: dict = None, timeout: float = 600.0,
+                  stamps: list = None):
     """POST ``/v2/models/<model>/generate_stream`` and consume the SSE
     body — the one stream-client used by every fleet-scope phase, so the
     framing rules (``data:`` lines, blank-line event boundary, error event
     raises, missing done event raises) live in exactly one place.
-    Returns (joined text, token ids, final done event, wall seconds)."""
+    Returns (joined text, token ids, final done event, wall seconds).
+    ``stamps``: optional list that receives one perf_counter arrival time
+    per token id (the --disagg TPOT measurement)."""
     import json as _json
     import time as _time
     import urllib.request as _url
@@ -231,6 +234,7 @@ def _sse_generate(port: int, model: str, prompt: str, mt: int,
             chunk = r.read1(65536)
             if not chunk:
                 break
+            now = _time.perf_counter()
             buf += chunk
             while b"\n\n" in buf:
                 raw, buf = buf.split(b"\n\n", 1)
@@ -245,7 +249,10 @@ def _sse_generate(port: int, model: str, prompt: str, mt: int,
                     else:
                         if ev.get("text_output"):
                             pieces.append(ev["text_output"])
-                        ids.extend(ev.get("token_ids") or ())
+                        new = ev.get("token_ids") or ()
+                        ids.extend(new)
+                        if stamps is not None:
+                            stamps.extend([now] * len(new))
     if final is None:
         raise RuntimeError("stream ended without done event")
     return "".join(pieces), ids, final, _time.perf_counter() - t0
@@ -1879,6 +1886,379 @@ def _run_fleet(args, config, params, lora) -> None:
                          f"(retries={retries}, {chaos['chaos']})")
 
 
+def _run_disagg(args, config, params, lora) -> None:
+    """Disaggregated prefill/decode scenario (ISSUE 10): a prefill-burst-
+    over-steady-decode workload on a role-split arm (1 prefill + 1 decode
+    replica behind the real ServiceProxy) vs a unified arm (2 unified
+    replicas).  Gates: every request completes with its exact token
+    budget; outputs keep greedy continuity vs a serial single-engine
+    oracle (byte-identical, or tie-aware-verified where cross-dispatch-
+    shape bf16 drift legally flips a near-tie); 0 leaked KV pages and 0
+    pending handoff frames on every replica — including a handoff-chaos
+    pass (torn + slow + expired + dead-link pulls) where every request
+    still completes via the degraded re-prefill; and the steady decode
+    streams' p99 TPOT during the burst window on the disagg arm <= the
+    unified arm's (the decode replica never runs the burst's prefills).
+    ENGINE_TICK_FLOOR_S restores the device-bound regime on the CPU box
+    (replicas only time-slice one core otherwise), as in the router
+    benches.  Results land in BENCH_DISAGG.json via --out."""
+    import concurrent.futures
+    import json as _json
+    import os as _os
+    import time as _time
+    import urllib.request as _url
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.core.api import APIServer
+    from kubeflow_tpu.serving import disagg as _disagg
+    from kubeflow_tpu.serving.api import LABEL_ISVC
+    from kubeflow_tpu.serving.controllers import (POD_PORT_ANNOTATION,
+                                                  PROXY_PORT_ANNOTATION)
+    from kubeflow_tpu.serving.engine import Engine, EngineConfig
+    from kubeflow_tpu.serving.engine.faults import HandoffFaultConfig
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+    from kubeflow_tpu.serving.router import (RELAY_TIMEOUT_ANNOTATION,
+                                             ServiceProxy)
+    from kubeflow_tpu.serving.server import ModelServer
+    from kubeflow_tpu.utils.net import find_free_ports
+
+    n_steady = args.disagg_steady
+    n_burst = args.disagg_burst
+    steady_mt = args.max_tokens
+    burst_mt = 4
+    # steady prompts land in DISTINCT prefill buckets (32/64/128/256), so
+    # their prefills never fuse and their outputs stay strictly
+    # byte-identical to the serial oracle — the burst prompts DO fuse
+    # ([B, bucket] vs the oracle's [1, bucket]), which is exactly the
+    # cross-dispatch-shape bf16 near-tie effect the tie-aware audit
+    # admits (--fleet-chaos precedent)
+    steady_lens = (16, 40, 90, 130)
+    burst_len = max(args.prompt_len, 156)  # above min-prompt: splits
+    min_prompt = 140                       # steady (<=130) stays unified
+    page_size = 16
+    slots = n_steady + max(2, n_burst // 2)
+    pages_per_slot = (burst_len + steady_mt) // page_size + 3
+    num_pages = max(96, slots * pages_per_slot + 8)
+    rng = np.random.default_rng(0)
+    letters = "abcdefghijklmnopqrstuvwxyz "
+
+    def mk_prompt(n):
+        return "".join(letters[j]
+                       for j in rng.integers(0, len(letters), size=n))
+
+    steady_prompts = [mk_prompt(steady_lens[i % len(steady_lens)])
+                      for i in range(n_steady)]
+    burst_prompts = [mk_prompt(burst_len) for _ in range(n_burst)]
+
+    # the device-bound regime: each tick that did work costs the floor, so
+    # prefill ticks displace decode ticks the way they do on a real chip
+    prev_floor = _os.environ.get("ENGINE_TICK_FLOOR_S")
+    _os.environ["ENGINE_TICK_FLOOR_S"] = str(args.disagg_tick_floor)
+
+    chaos_plan = {
+        "prefill": HandoffFaultConfig(expire_export_every=4),
+        "decode": HandoffFaultConfig(torn_pull_every=3, dead_link_on=2,
+                                     slow_pull_s=0.05, slow_pull_every=5),
+    }
+
+    def build(roles, with_chaos: bool):
+        api = APIServer()
+        proxy = ServiceProxy(api)
+        svc_port = find_free_ports(1)[0]
+        api.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "fleet", "labels": {LABEL_ISVC: "fleet"},
+                         "annotations": {
+                             PROXY_PORT_ANNOTATION: str(svc_port),
+                             RELAY_TIMEOUT_ANNOTATION: "30.0",
+                             _disagg.DISAGG_ANNOTATION: "auto",
+                             _disagg.DISAGG_MIN_PROMPT_ANNOTATION:
+                                 str(min_prompt)}},
+            "spec": {"selector": {"app": "fleet"}}})
+        engines, servers = [], []
+        for i, role in enumerate(roles):
+            ec = EngineConfig(
+                max_slots=slots, page_size=page_size, num_pages=num_pages,
+                max_pages_per_slot=pages_per_slot, role=role,
+                tensor_parallel=args.tensor_parallel,
+                paged_kernel=args.paged_kernel or None,
+                kv_quant=args.kv_quant, weight_quant=args.weight_quant,
+                handoff_chaos=(chaos_plan.get(role)
+                               if with_chaos else None))
+            eng = Engine(params, config, ec, lora=lora)
+            srv = ModelServer([JetStreamModel("fleet", "", engine=eng)],
+                              port=0)
+            srv.start()
+            api.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"fleet-{i}",
+                             "labels": {"app": "fleet"},
+                             "annotations": {
+                                 POD_PORT_ANNOTATION: str(srv.port),
+                                 _disagg.ROLE_ANNOTATION: role}},
+                "spec": {},
+                "status": {"phase": "Running",
+                           "conditions": [{"type": "Ready",
+                                           "status": "True"}]}})
+            engines.append(eng)
+            servers.append(srv)
+        proxy.sync()
+        return api, proxy, svc_port, engines, servers
+
+    def stream_timed(port: int, prompt: str, mt: int):
+        """The shared SSE client with per-token arrival stamps ->
+        (ids, times, final).  X-Stream-Resume makes the relay forward the
+        token ids (the identity audit's currency)."""
+        times: list = []
+        _text, ids, final, _dt = _sse_generate(
+            port, "fleet", prompt, mt,
+            headers={"X-Stream-Resume": "1"}, stamps=times)
+        return ids, times, final
+
+    def unary(port: int, prompt: str, mt: int):
+        req = _url.Request(
+            f"http://127.0.0.1:{port}/v2/models/fleet/generate",
+            data=_json.dumps({"text_input": prompt,
+                              "parameters": {"max_tokens": mt}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with _url.urlopen(req, timeout=600) as r:
+            return _json.loads(r.read())
+
+    def one_pass(roles, with_chaos=False):
+        api, proxy, svc_port, engines, servers = build(roles, with_chaos)
+        try:
+            # warm every replica directly (compile both prompt buckets +
+            # the prefill/decode phase graphs) before timing anything
+            for srv in servers:
+                unary(srv.port, steady_prompts[0], 2)
+                unary(srv.port, burst_prompts[0], 2)
+                unary(srv.port, burst_prompts[0] + "xy", 2)
+            steady_out = [None] * n_steady
+
+            def run_steady(i):
+                steady_out[i] = stream_timed(svc_port, steady_prompts[i],
+                                             steady_mt)
+
+            threads = [concurrent.futures.ThreadPoolExecutor(1)
+                       for _ in range(n_steady)]
+            futs = [t.submit(run_steady, i)
+                    for i, t in enumerate(threads)]
+            # let the steady decodes reach cruise before the burst lands —
+            # but early enough that most of each stream overlaps the burst
+            _time.sleep(max(0.15, 4 * args.disagg_tick_floor))
+            burst_t0 = _time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(n_burst) as ex:
+                burst_out = list(ex.map(
+                    lambda pr: unary(svc_port, pr, burst_mt),
+                    burst_prompts))
+            burst_t1 = _time.perf_counter()
+            for f in futs:
+                f.result(timeout=600)
+            for t in threads:
+                t.shutdown()
+            leaks = {}
+            pending = {}
+            for i, e in enumerate(engines):
+                s = e.stats
+                leaks[f"replica_{i}"] = int(
+                    (num_pages - 1) - s["free_pages"] - s["cached_pages"])
+                pending[f"replica_{i}"] = e._handoffs.sweep()
+            # steady-stream inter-token gaps inside the burst window: the
+            # TPOT the burst's prefills would have stalled
+            gaps = []
+            for ids, times, _final in steady_out:
+                in_win = [t for t in times
+                          if burst_t0 <= t <= burst_t1 + 0.25]
+                gaps.extend(np.diff(in_win).tolist())
+            stats = {
+                "steady": steady_out, "burst": burst_out,
+                "gaps": gaps, "leaks": leaks, "pending": pending,
+                "handoff": [e.stats["handoff"] for e in engines],
+                "chaos": [e.stats.get("handoff_chaos")
+                          for e in engines],
+                "burst_window_s": burst_t1 - burst_t0,
+            }
+            return stats
+        finally:
+            proxy.shutdown()
+            for srv in servers:
+                srv.stop()
+            for eng in engines:
+                try:
+                    eng.stop(drain=False)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # serial single-engine oracle (the depth-0 greedy reference)
+    oracle = {}
+    ref_ec = EngineConfig(max_slots=slots, page_size=page_size,
+                          num_pages=num_pages,
+                          max_pages_per_slot=pages_per_slot,
+                          tensor_parallel=args.tensor_parallel,
+                          paged_kernel=args.paged_kernel or None,
+                          kv_quant=args.kv_quant,
+                          weight_quant=args.weight_quant)
+    ref_eng = Engine(params, config, ref_ec, lora=lora)
+    ref_model = JetStreamModel("fleet", "", engine=ref_eng)
+    ref_eng.start()
+    try:
+        for pr in steady_prompts:
+            oracle[pr] = ref_model.generate(
+                {"text_input": pr,
+                 "parameters": {"max_tokens": steady_mt}})["token_ids"]
+        for pr in burst_prompts:
+            oracle[pr] = ref_model.generate(
+                {"text_input": pr,
+                 "parameters": {"max_tokens": burst_mt}})["token_ids"]
+    finally:
+        ref_eng.stop(drain=False)
+
+    def verify_tie_aware(prompt_text: str, ids: list):
+        """Same audit as the fleet bench: every emitted token's full-
+        forward logit within tie_eps of that step's max along the
+        request's own trajectory (dup/drops miss by whole logits)."""
+        import jax.numpy as _jnp
+
+        from kubeflow_tpu.serving.engine.model import forward_full
+        from kubeflow_tpu.serving.engine.serve import ByteTokenizer
+
+        toks = ByteTokenizer().encode(prompt_text)
+        for j, g in enumerate(ids):
+            logits = np.asarray(forward_full(
+                params, config, _jnp.asarray([toks], _jnp.int32)))[0, -1]
+            top = float(logits.max())
+            if float(logits[g]) < top - args.fleet_tie_eps:
+                return False, j, round(top - float(logits[g]), 4)
+            toks.append(g)
+        return True, -1, 0.0
+
+    def audit(pass_stats):
+        """(complete, divergence_audit, continuity_ok) for one pass."""
+        complete = True
+        divergent = []
+        for pr, (ids, _t, final) in zip(steady_prompts,
+                                        pass_stats["steady"]):
+            if final["tokens"] != steady_mt:
+                complete = False
+            if ids != oracle[pr]:
+                divergent.append((pr, ids))
+        for pr, out in zip(burst_prompts, pass_stats["burst"]):
+            if out.get("tokens") != burst_mt:
+                complete = False
+            if out.get("token_ids") != oracle[pr]:
+                divergent.append((pr, out.get("token_ids") or []))
+        rows = []
+        for pr, ids in divergent:
+            ok, step, deficit = verify_tie_aware(pr, ids)
+            rows.append({"tie_aware_ok": ok, "first_bad_step": step,
+                         "logit_deficit": deficit})
+        return complete, rows, all(r["tie_aware_ok"] for r in rows)
+
+    try:
+        placements0 = dict(_disagg.PLACEMENTS.series())
+        uni = one_pass(("unified", "unified"))
+        dis = one_pass(("prefill", "decode"))
+        chaos = one_pass(("prefill", "decode"), with_chaos=True)
+        placements = {
+            k[0][1]: v - placements0.get(k, 0)
+            for k, v in _disagg.PLACEMENTS.series().items()}
+    finally:
+        if prev_floor is None:
+            _os.environ.pop("ENGINE_TICK_FLOOR_S", None)
+        else:
+            _os.environ["ENGINE_TICK_FLOOR_S"] = prev_floor
+
+    uni_ok, uni_audit, uni_cont = audit(uni)
+    dis_ok, dis_audit, dis_cont = audit(dis)
+    ch_ok, ch_audit, ch_cont = audit(chaos)
+    p99_uni = float(np.percentile(uni["gaps"], 99)) if uni["gaps"] else 0.0
+    p99_dis = float(np.percentile(dis["gaps"], 99)) if dis["gaps"] else 0.0
+    ratio = p99_dis / max(1e-9, p99_uni)
+    handoffs = sum(h["exports"] for h in dis["handoff"])
+    chaos_injected = {}
+    for c in chaos["chaos"]:
+        for k, v in (c or {}).items():
+            if k.startswith("injected_"):
+                chaos_injected[k] = chaos_injected.get(k, 0) + v
+    out = {
+        "metric": f"serving_disagg_{args.config}",
+        "steady_streams": n_steady,
+        "burst_requests": n_burst,
+        "steady_max_tokens": steady_mt,
+        "burst_max_tokens": burst_mt,
+        "steady_prompt_lens": list(steady_lens),
+        "burst_prompt_len": burst_len,
+        "tick_floor_s": args.disagg_tick_floor,
+        "placements": placements,
+        "handoff_exports_disagg": handoffs,
+        "p99_tpot_during_burst_unified_s": round(p99_uni, 5),
+        "p99_tpot_during_burst_disagg_s": round(p99_dis, 5),
+        "disagg_over_unified_tpot_x": round(ratio, 3),
+        "tpot_budget_x": args.disagg_tpot_budget,
+        "byte_identical_unified": uni_ok and not uni_audit,
+        "byte_identical_disagg": dis_ok and not dis_audit,
+        "byte_identical_chaos": ch_ok and not ch_audit,
+        "divergent_tie_aware_verified": {
+            "unified": uni_cont, "disagg": dis_cont, "chaos": ch_cont},
+        "divergence_audit": {"unified": uni_audit, "disagg": dis_audit,
+                             "chaos": ch_audit},
+        "tie_eps": args.fleet_tie_eps,
+        "kv_pages_leaked": {"unified": sum(uni["leaks"].values()),
+                            "disagg": sum(dis["leaks"].values()),
+                            "chaos": sum(chaos["leaks"].values())},
+        "handoff_frames_pending": {
+            "disagg": sum(dis["pending"].values()),
+            "chaos": sum(chaos["pending"].values())},
+        "chaos_injected": chaos_injected,
+        "chaos_handoff_stats": chaos["handoff"],
+        "platform": jax.devices()[0].platform,
+        "protocol_note": (
+            "steady decode streams (short prompts, routed to the decode "
+            "pool) overlap a concurrent burst of long-prompt/short-decode "
+            "requests (split prefill->decode via verified KV handoff); "
+            "unified arm = 2 unified replicas sharing both workloads; "
+            "p99 TPOT measured client-side over steady-stream inter-token "
+            "gaps inside the burst window; ENGINE_TICK_FLOOR_S simulates "
+            "the device-bound regime on CPU; oracle = serial single "
+            "engine, divergences audited tie-aware as in --fleet-chaos"),
+    }
+    line = _json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    failures = []
+    if not (uni_ok and dis_ok and ch_ok):
+        failures.append("a request missed its exact token budget")
+    if not (uni_cont and dis_cont and ch_cont):
+        failures.append("greedy continuity broke (dup/dropped tokens)")
+    for arm, leaked in out["kv_pages_leaked"].items():
+        if leaked:
+            failures.append(f"{arm}: {leaked} KV pages leaked")
+    for arm, pend in out["handoff_frames_pending"].items():
+        if pend:
+            failures.append(f"{arm}: {pend} handoff frames leaked")
+    if handoffs < n_burst:
+        failures.append(
+            f"handoffs did not engage (exports {handoffs} < {n_burst})")
+    if not any(chaos_injected.values()):
+        failures.append(f"handoff chaos did not engage ({chaos_injected})")
+    if not uni["gaps"] or not dis["gaps"]:
+        failures.append(
+            "no steady-stream TPOT samples inside the burst window "
+            f"(unified {len(uni['gaps'])}, disagg {len(dis['gaps'])}) — "
+            "the interference measurement never happened")
+    if ratio > args.disagg_tpot_budget:
+        failures.append(
+            f"decode-pool p99 TPOT under burst {p99_dis * 1e3:.2f}ms "
+            f"exceeds unified {p99_uni * 1e3:.2f}ms x budget "
+            f"{args.disagg_tpot_budget}")
+    if failures:
+        raise SystemExit("disagg bench FAILED: " + "; ".join(failures))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="1b", choices=["tiny", "1b", "llama3_8b"])
@@ -1987,6 +2367,26 @@ def main() -> None:
                         "(covers cross-dispatch-shape bf16 GEMM drift, "
                         "measured ~0.03 on XLA:CPU; a dup/dropped token "
                         "misses the oracle by whole logits)")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated prefill/decode scenario (ISSUE 10): "
+                        "role-split arm (1 prefill + 1 decode replica) vs "
+                        "unified arm (2 unified) under steady decode "
+                        "streams + a concurrent long-prompt burst; gates "
+                        "greedy continuity vs the serial oracle, 0 leaked "
+                        "KV pages / handoff frames (incl. a handoff-chaos "
+                        "pass), and decode-pool p99 TPOT during the burst "
+                        "<= the unified arm's (BENCH_DISAGG.json via "
+                        "--out)")
+    p.add_argument("--disagg-steady", type=int, default=4,
+                   help="steady decode streams for --disagg")
+    p.add_argument("--disagg-burst", type=int, default=8,
+                   help="burst prefill-heavy requests for --disagg")
+    p.add_argument("--disagg-tick-floor", type=float, default=0.01,
+                   help="ENGINE_TICK_FLOOR_S for --disagg (device-bound "
+                        "regime simulation on CPU; see router tests)")
+    p.add_argument("--disagg-tpot-budget", type=float, default=1.0,
+                   help="max acceptable disagg/unified p99-TPOT ratio "
+                        "during the burst window for --disagg")
     p.add_argument("--obs", action="store_true",
                    help="telemetry-overhead smoke (ISSUE 3): closed-loop "
                         "workload with the observability layer on vs off; "
@@ -2070,6 +2470,9 @@ def main() -> None:
         return
     if args.fleet_chaos:
         _run_fleet(args, config, params, lora)
+        return
+    if args.disagg:
+        _run_disagg(args, config, params, lora)
         return
     engine = Engine(
         params, config,

@@ -46,6 +46,21 @@ SCALE_DOWN_WINDOW = 1.0
 ACTIVATED_AT_ANNOTATION = "serving.kubeflow.org/activated-at"
 SCRAPE_TIMEOUT_ANNOTATION = f"{GROUP}/scrape-timeout"
 DEFAULT_SCRAPE_TIMEOUT_S = 0.25
+# ---- SLO-driven scaling (ISSUE 10 satellite; PR 8's read-only slo_view
+# becomes an actuator).  Opt-in per deployment via the slo-scaling
+# annotation — the concurrency policy stays the default.  The governing
+# metric follows the pool's disaggregation role (disagg.ROLE_ANNOTATION on
+# the pod template): a PREFILL pool is judged on TTFT attainment (its
+# whole job is first tokens), a DECODE pool on TPOT attainment (steady
+# inter-token latency is what it protects), unified pools on TTFT.  A
+# worst-replica attainment below the objective scales the pool UP one
+# replica per sync (and vetoes scale-down); recovery hands control back
+# to the concurrency policy, whose normal damped path scales back down.
+SLO_SCALING_ANNOTATION = f"{GROUP}/slo-scaling"
+SLO_OBJECTIVE_ANNOTATION = f"{GROUP}/slo-objective"
+DEFAULT_SLO_OBJECTIVE = 0.99
+_ROLE_SLO_METRIC = {"prefill": "ttft", "decode": "tpot",
+                    "unified": "ttft"}
 # how long a cached last-known-good sample may stand in for a timed-out
 # scrape before the pod counts as unscraped (scale-down veto)
 STALE_SAMPLE_WINDOW_S = 2.0
@@ -212,9 +227,39 @@ class ConcurrencyAutoscaler:
         desired = max(desired, min_r, 0)
         desired = min(desired, max_r)
 
+        # SLO actuator (opt-in): worst-replica attainment of the pool's
+        # role metric below the objective raises desired one replica above
+        # current — and, below, vetoes scale-down while the burn lasts.
+        slo_violated = False
+        if (str(ann.get(SLO_SCALING_ANNOTATION, "")).strip().lower()
+                in ("1", "true", "yes", "on")):
+            tmpl_ann = (((deploy["spec"].get("template") or {})
+                         .get("metadata") or {}).get("annotations") or {})
+            from .disagg import ROLE_ANNOTATION
+
+            role = tmpl_ann.get(ROLE_ANNOTATION) \
+                or ann.get(ROLE_ANNOTATION) or "unified"
+            metric = _ROLE_SLO_METRIC.get(role, "ttft")
+            try:
+                objective = float(ann.get(SLO_OBJECTIVE_ANNOTATION,
+                                          DEFAULT_SLO_OBJECTIVE))
+            except ValueError:
+                objective = DEFAULT_SLO_OBJECTIVE
+            vals = [v for (cls, m), v in slo_worst.items() if m == metric]
+            if vals and min(vals) < objective:
+                slo_violated = True
+                desired = max(desired, min(current + 1, max_r))
+
         if desired > current:
             self._downscale_since.pop(uid, None)
             return self._scale(deploy, desired, zero=False)
+
+        if slo_violated:
+            # already at max_r (or a single-replica floor): hold — a pool
+            # burning its error budget must never shrink, and the damped
+            # downscale window must not keep counting through the burn
+            self._downscale_since.pop(uid, None)
+            return False
 
         if unhealthy:
             # any UNHEALTHY replica means the fleet's real capacity is

@@ -82,12 +82,15 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
+from ..disagg import HandoffStore, normalize_role
 from ..errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
                       NonFiniteLogits, RequestError, SessionBusy,
                       TickFailure)
 from ..slo import SloConfig, SloTracker
-from .faults import ChaosInjector, FaultConfig
-from .kvstore import KVStoreConfig, TieredKVStore, normalize_session_id
+from .faults import (ChaosInjector, FaultConfig, HandoffChaos,
+                     HandoffFaultConfig)
+from .kvstore import (KVStoreConfig, TieredKVStore, normalize_session_id,
+                      pack_frame)
 from .scheduler import (PRIORITY_RANK, QosScheduler, QueueEntry,
                         SchedulerConfig, normalize_priority)
 from .telemetry import (EngineTelemetry, FlightRecorder, RequestSpan,
@@ -245,6 +248,23 @@ class EngineConfig:
     # disk dir (tiering works, but sessions only survive a full engine
     # restart when disk_dir points somewhere stable).
     kv_store: Optional[KVStoreConfig] = None
+    # ---- disaggregated serving (README "Disaggregated serving") --------
+    # the replica's declared role: "prefill" | "decode" | "unified".
+    # Advisory at engine level (any engine can export or import handoffs);
+    # the service proxy reads the matching pod annotation for placement —
+    # engine.json carries this so the engine and its pod cannot silently
+    # disagree in a hand-rolled deployment.
+    role: str = "unified"
+    # exported-KV handle lifetime + byte budget (disagg.HandoffStore): an
+    # orphaned export (decode replica died before pulling) expires instead
+    # of pinning pool-sized blobs in host RAM; budget overruns evict
+    # oldest-first and that export degrades to the unified path
+    handoff_ttl_s: float = 60.0
+    handoff_max_bytes: int = 256 << 20
+    # deterministic handoff-fault injection (faults.HandoffFaultConfig):
+    # torn/slow/dead-link pulls, pre-expired exports — every one must
+    # degrade to re-prefill, never fail a request
+    handoff_chaos: Optional[HandoffFaultConfig] = None
 
 
 @dataclasses.dataclass
@@ -304,6 +324,15 @@ class _Pending:
     # admission, then host|disk|cache|cold|degraded (degraded = the store
     # had the session but verification failed; fell back to re-prefill)
     session_restore: "Optional[str]" = None
+    # ---- disaggregated serving (README "Disaggregated serving") --------
+    # prefill phase: export this request's committed KV pages into the
+    # handoff store at finish (the decode replica pulls them by handle)
+    handoff: bool = False
+    # decode phase: the prompt's KV arrived as a verified handoff blob
+    # (parked in the tiered store under this rid; scattered at admission
+    # via the swap-resume path).  Any import failure degrades to plain
+    # re-prefill — this flag routes that degradation instead of _fail_slot
+    handoff_import: bool = False
 
 
 class _StaleThread(BaseException):
@@ -540,6 +569,16 @@ class Engine:
         kvcfg = (engine_config.kv_store if engine_config.kv_store is not None
                  else KVStoreConfig(host_max_bytes=self._scfg.swap_max_bytes))
         self._kv = TieredKVStore(kvcfg, on_event=self.telemetry.count_kv_event)
+        # ---- disaggregated serving (README "Disaggregated serving") -----
+        # exported-KV handle registry (prefill side) + the handoff chaos
+        # injector the decode side's pull path consults (serve.py)
+        normalize_role(engine_config.role)
+        self._handoffs = HandoffStore(
+            ttl_s=engine_config.handoff_ttl_s,
+            max_bytes=engine_config.handoff_max_bytes)
+        self._handoff_chaos = (HandoffChaos(engine_config.handoff_chaos)
+                               if engine_config.handoff_chaos is not None
+                               else None)
         self.flight = FlightRecorder(
             capacity=engine_config.flight_recorder_capacity,
             dump_dir=engine_config.flight_dir)
@@ -650,6 +689,9 @@ class Engine:
         # deletes its page files — nothing could ever recover them; an
         # explicit disk_dir keeps the session manifest for the next engine
         self._kv.close()
+        # exported-but-unpulled handoff frames die with the engine: their
+        # handles are only routable to THIS process
+        self._handoffs.clear()
         self._stopped = True
         self._draining = False  # drain is over: health reports DEAD now
 
@@ -680,6 +722,7 @@ class Engine:
                 state = "DEGRADED" if self._retrying > 0 else "SERVING"
         return {
             "state": state,
+            "role": self.ec.role,
             "last_tick_age_s": round(time.monotonic() - self._last_tick_ts, 4),
             "ticks": self._ticks,
             "ticks_failed": self._ticks_failed,
@@ -692,6 +735,8 @@ class Engine:
                        deadline: Optional[float] = None,
                        priority: Optional[str] = None,
                        session_id: Optional[str] = None,
+                       handoff: bool = False,
+                       kv_import=None,
                        trace=None,
                        links: Optional[list] = None) -> Future:
         """Submit a prompt; the Future resolves to a result dict.
@@ -718,6 +763,17 @@ class Engine:
         links (e.g. the failed relay hop a re-admission resumes from);
         a ``session_prev`` link to the session's previous turn is added
         automatically.
+        ``handoff``: disaggregated PREFILL phase (README "Disaggregated
+        serving") — at finish the request's committed KV pages are
+        exported into the handoff store and the result dict carries a
+        ``handoff`` block with the one-shot pull handle.
+        ``kv_import``: disaggregated DECODE phase — a verified
+        ``(blob, nbytes, resume_len)`` of KV pages covering the prompt
+        (which must already include the prefill phase's first token);
+        the admission path scatters them into a fresh slot row and decode
+        starts without re-prefilling.  Any import problem — budget
+        rejection here, blob lost or scatter failure later — silently
+        degrades to a plain (prefix-cache-assisted) re-prefill.
         Raises EngineOverloaded when the queue is at ``max_queue_depth``
         and EngineShutdown once stop() has begun."""
         if not tokens:
@@ -787,11 +843,34 @@ class Engine:
                 deadline=(now + deadline if deadline is not None else None),
                 span=span,
                 priority=prio, rank=PRIORITY_RANK[prio],
-                rid=rid, session_id=session_id,
+                rid=rid, session_id=session_id, handoff=handoff,
             )
             if session_id is not None:
                 self._session_active[session_id] = rid
             self._future_rid[fut] = rid
+        if kv_import is not None:
+            # park the pulled blob in the tiered store under this rid; the
+            # admission path then takes the swap-resume scatter verbatim.
+            # resume_len must equal the submitted token count — the blob's
+            # KV covers positions [0, len(tokens)-2] and the first decode
+            # step writes position len(tokens)-1 (serve.py validated the
+            # frame; this is the engine-side backstop)
+            blob, nbytes, resume_len = kv_import
+            ok = False
+            if int(resume_len) == len(tokens):
+                try:
+                    ok = self._kv.put_swap(rid, blob, int(nbytes),
+                                           count=False)
+                except Exception:  # noqa: BLE001 — import must degrade
+                    ok = False
+            if ok:
+                pending.swapped = True
+                pending.resume_len = int(resume_len)
+                pending.handoff_import = True
+                self.telemetry.count_handoff("import")
+                self.telemetry.count_handoff_bytes("in", int(nbytes))
+            else:
+                self.telemetry.count_handoff("degraded")
         # the request now waits in the HOST scheduler queue; the engine
         # loop submits it to the C++ core only when the policy admits it
         # (per-tick admission — the Orca iteration-level scheduling point)
@@ -832,10 +911,12 @@ class Engine:
                  deadline: Optional[float] = None,
                  priority: Optional[str] = None,
                  session_id: Optional[str] = None,
+                 handoff: bool = False, kv_import=None,
                  trace=None, links: Optional[list] = None) -> dict:
         fut = self.generate_async(tokens, max_new_tokens, adapter=adapter,
                                   deadline=deadline, priority=priority,
-                                  session_id=session_id, trace=trace,
+                                  session_id=session_id, handoff=handoff,
+                                  kv_import=kv_import, trace=trace,
                                   links=links)
         try:
             return fut.result(timeout=timeout)
@@ -928,6 +1009,7 @@ class Engine:
                         deadline: Optional[float] = None,
                         priority: Optional[str] = None,
                         session_id: Optional[str] = None,
+                        kv_import=None,
                         trace=None,
                         links: Optional[list] = None) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
@@ -944,6 +1026,7 @@ class Engine:
         fut = self.generate_async(tokens, max_new_tokens, stream=q,
                                   adapter=adapter, deadline=deadline,
                                   priority=priority, session_id=session_id,
+                                  kv_import=kv_import,
                                   trace=trace, links=links)
 
         def _iter():
@@ -998,6 +1081,10 @@ class Engine:
                 "restarts": self._restarts,
                 "trace_history_entries": len(self._trace_ring),
                 "trace_history_bytes": self._trace_ring_bytes,
+                "role": self.ec.role,
+                "handoff": self._handoffs.stats(),
+                **({"handoff_chaos": self._handoff_chaos.stats()}
+                   if self._handoff_chaos is not None else {}),
                 **({"slo": self.telemetry.slo.snapshot()}
                    if self.telemetry.slo is not None else {}),
                 **({"chaos": self._chaos.stats()} if self._chaos else {}),
@@ -1599,22 +1686,44 @@ class Engine:
                 "in queue"), shed=True)
             return
         if pending.swapped:
-            item = self._kv.pop_swap(rid)
+            item = self._kv.pop_swap(rid, count=not pending.handoff_import)
             if item is not None:
                 try:
                     self._resume_swapped(slot, pending, item)
-                except Exception as exc:  # noqa: BLE001 — fail the slot,
-                    # never leave it half-installed (len 0, no prefill)
-                    # for the decode step to feed garbage through
-                    err = TickFailure(
-                        f"swap-in failed: {type(exc).__name__}: {exc}")
-                    err.__cause__ = exc
-                    self._fail_slot(slot, err)
-                return
-            # blob lost (store cleared under us): degrade to recompute —
-            # tokens already hold the full context, pages were released
-            # uncached so this is a cold re-prefill, but still correct
-            pending.swapped = False
+                    return
+                except _StaleThread:
+                    raise
+                except Exception as exc:  # noqa: BLE001
+                    if pending.handoff_import:
+                        # a handoff blob that survived CRC verification
+                        # but failed the scatter (shape skew the serve
+                        # layer's check missed): degrade to a plain
+                        # re-prefill below — the slot's pages are owned
+                        # and prefill overwrites whatever the partial
+                        # scatter touched.  "Never a failed request."
+                        pending.swapped = False
+                        self.telemetry.count_handoff("degraded")
+                        if self.ec.telemetry:
+                            self._flight_event(
+                                "handoff_import", [slot], None,
+                                time.perf_counter(), "error",
+                                error=f"{type(exc).__name__}: {exc}")
+                    else:
+                        # never leave the slot half-installed (len 0, no
+                        # prefill) for the decode step to feed garbage
+                        err = TickFailure(
+                            f"swap-in failed: {type(exc).__name__}: {exc}")
+                        err.__cause__ = exc
+                        self._fail_slot(slot, err)
+                        return
+            else:
+                # blob lost (store cleared under us): degrade to recompute
+                # — tokens already hold the full context, pages were
+                # released uncached so this is a cold re-prefill, but
+                # still correct
+                pending.swapped = False
+                if pending.handoff_import:
+                    self.telemetry.count_handoff("degraded")
         # cache-hit pages already hold the prefix KV: prefill resumes
         # at the first uncovered position.  A session's FIRST admission
         # additionally restores pinned prefix pages from the tiered store
@@ -1709,24 +1818,42 @@ class Engine:
         jnp = self._jnp
         L = pending.resume_len
         owned = self._pages_for(L)
+        # the blob's own page count may run ONE page short of owned for a
+        # disaggregation import whose prompt ended exactly on a page
+        # boundary (the finishing commit grants no next page, so the
+        # export couldn't include it) — scatter what the blob covers; the
+        # submit allocated the full row, and position L-1's KV is written
+        # by the first decode step before anything reads it
+        nblob = int(next(iter(self._jax.tree_util.tree_leaves(blob_k)))
+                    .shape[1])
+        cov = min(owned, nblob)
         # swap submits carry no prefix hashes, so every page here is
         # freshly owned by this slot — the .set below can never write a
         # shared prefix-cache page
         row = self.batcher.slot_pages(slot)
-        pages = np.ascontiguousarray(row[:owned])
+        pages = np.ascontiguousarray(row[:cov])
         self._check_epoch()  # last fence before rebinding device pools
         tree_map = self._jax.tree_util.tree_map
-        put = lambda pool, host: pool.at[:, pages].set(jnp.asarray(host))  # noqa: E731
+        put = lambda pool, host: pool.at[:, pages].set(  # noqa: E731
+            jnp.asarray(np.ascontiguousarray(host[:, :cov])))
         self.k_pool = tree_map(put, self.k_pool, blob_k)
         self.v_pool = tree_map(put, self.v_pool, blob_v)
         pending.swapped = False
-        self.telemetry.count_swap("in", nbytes)
-        if pending.span is not None:
-            pending.span.mark("resumed")
-        if self.ec.telemetry:
-            self._flight_event("swap_in", [slot],
-                               {"pages": owned, "bytes": nbytes},
-                               time.perf_counter(), "ok")
+        if pending.handoff_import:
+            if pending.span is not None:
+                pending.span.mark("handoff_import")
+            if self.ec.telemetry:
+                self._flight_event("handoff_import", [slot],
+                                   {"pages": cov, "bytes": nbytes},
+                                   time.perf_counter(), "ok")
+        else:
+            self.telemetry.count_swap("in", nbytes)
+            if pending.span is not None:
+                pending.span.mark("resumed")
+            if self.ec.telemetry:
+                self._flight_event("swap_in", [slot],
+                                   {"pages": cov, "bytes": nbytes},
+                                   time.perf_counter(), "ok")
         self._activate_decode(slot, L, owned, row)
 
     def _reap_expired_queue(self, now: float) -> bool:
@@ -1746,6 +1873,11 @@ class Engine:
                 self._requests.pop(entry.rid)
                 self._future_rid.pop(pending.future, None)
             self._sched.remove(entry.rid)
+            # a handoff-imported request parks its pulled blob in the
+            # tiered store at SUBMIT; reaping it before admission must
+            # release that budget (pre-disagg, swapped implied preempted
+            # implied first_token_at — unreachable from here)
+            self._kv.discard_swap(entry.rid)
             self._sched.reaped += 1
             self._requests_shed += 1
             did = True
@@ -2084,6 +2216,10 @@ class Engine:
         self.batcher.release(slot)
         if pending is None:
             return
+        # a deadline shed at admission can hit a swapped request whose
+        # blob was never popped (handoff imports have no first token yet):
+        # release the parked bytes — no-op for everyone else
+        self._kv.discard_swap(rid)
         if pending.failures:
             self._retrying -= 1  # no longer mid-retry: it's terminal now
         if shed:
@@ -2491,14 +2627,14 @@ class Engine:
             K = 1 + self.ec.spec_max_draft
             seed = np.full((self.ec.max_slots, K), -1, np.int32)
             for slot in decode_ready:
-                gen = self._requests[self._slot_req[slot]].generated
-                seed[slot, 0] = gen[-1] if gen else 0
+                seed[slot, 0] = self._feedback_token(
+                    self._requests[self._slot_req[slot]])
             self._dec_state = self._jnp.asarray(seed)
         else:
             toks = np.zeros((self.ec.max_slots,), np.int32)
             for slot in decode_ready:
-                gen = self._requests[self._slot_req[slot]].generated
-                toks[slot] = gen[-1] if gen else 0
+                toks[slot] = self._feedback_token(
+                    self._requests[self._slot_req[slot]])
             self._dec_state = self._jnp.asarray(toks)
         self._dec_lens_shadow = self._len_host.copy()
         self._roster_dirty = False
@@ -2898,8 +3034,8 @@ class Engine:
         K = 1 + self.ec.spec_max_draft
         tokens = np.zeros((self.ec.max_slots, K), np.int32)
         for slot in decode_ready:
-            gen = self._requests[self._slot_req[slot]].generated
-            tokens[slot, 0] = gen[-1] if gen else 0
+            tokens[slot, 0] = self._feedback_token(
+                self._requests[self._slot_req[slot]])
             d = drafts.get(slot) or []
             tokens[slot, 1:1 + len(d)] = d
         # raw host mirrors, as in _decode_tick_single — same safety
@@ -2951,6 +3087,21 @@ class Engine:
     def _pages_for(self, tokens: int) -> int:
         return (tokens + self.ec.page_size - 1) // self.ec.page_size
 
+    @staticmethod
+    def _feedback_token(pending: "Optional[_Pending]") -> int:
+        """The decode input token for a slot with no tick history: the
+        last generated token normally; for a handoff-imported request —
+        decode-ready with ZERO generated tokens — the prompt's final
+        token, which IS the prefill phase's first sampled token (the
+        decode phase folds it into the prompt)."""
+        if pending is None:
+            return 0
+        if pending.generated:
+            return pending.generated[-1]
+        if pending.handoff_import and pending.tokens:
+            return pending.tokens[-1]
+        return 0
+
     def _activate_decode(self, slot: int, plen: int, owned: int, row) -> None:
         """Prefill finished: install the slot's page row + length into the
         host mirrors, making it visible to the decode step (rows are zero —
@@ -2961,9 +3112,7 @@ class Engine:
         self._pt_host[slot, :owned] = row[:owned]
         self._len_host[slot] = plen
         pending = self._requests.get(self._slot_req.get(slot))
-        self._tok_host[slot] = (pending.generated[-1]
-                                if pending is not None and pending.generated
-                                else 0)
+        self._tok_host[slot] = self._feedback_token(pending)
         self._prefill_rows.pop(slot, None)
         self._mark_roster_change("admit")
 
@@ -3025,6 +3174,13 @@ class Engine:
         session = None
         if pending.session_id is not None:
             session = self._pin_session(slot, pending, cache_ok)
+        # disaggregation export BEFORE the mirrors zero, same reason: the
+        # prefill phase's committed pages leave through the handoff store
+        # (the pages ALSO release to the prefix cache below — a degraded
+        # decode phase that lands back here re-prefills as a cache hit)
+        handoff_rec = None
+        if pending.handoff and not cancelled:
+            handoff_rec = self._export_handoff(slot, pending, cache_ok)
         self._release_slot_state(slot)  # freed slots decode as zero adapter
         # hand the prompt's full pages to the prefix cache on the way out —
         # unless the prefill never finished (cancel mid-prefill): those pages
@@ -3049,6 +3205,8 @@ class Engine:
                        if pending.first_token_at else 0.0),
             "latency_s": now - pending.submitted_at,
         }
+        if handoff_rec is not None:
+            result["handoff"] = handoff_rec
         if pending.session_id is not None:
             # "evicted" is a COUNT, not the ids: session ids are bearer
             # capabilities (kvstore.normalize_session_id), so leaking
@@ -3068,6 +3226,93 @@ class Engine:
         pending.future.set_result(result)
         if pending.stream is not None:
             pending.stream.put((None, result))
+
+    def _export_handoff(self, slot: int, pending: _Pending,
+                        cache_ok: bool) -> dict:
+        """Disaggregated prefill phase, export half (README "Disaggregated
+        serving"): snapshot the finishing request's committed KV pages —
+        every page the slot owns, covering positions [0, L-2] where L =
+        len(context) = prompt + first token (the last token's KV is
+        written by the decode step that runs on the PULLING replica) —
+        frame them KVPG/CRC via the kvstore wire format, and register the
+        frame in the handoff store under a one-shot TTL'd handle.
+
+        Degrades, never raises: any failure returns ``{"error": ...}``
+        and the proxy falls back to the unified path (the pages still
+        release to the prefix cache, so that fallback usually re-adopts
+        them)."""
+        if not cache_ok:
+            self.telemetry.count_handoff("export_failed")
+            return {"error": "incomplete prefill"}
+        L = len(pending.context)
+        owned = min(self._pages_for(L),
+                    int(np.count_nonzero(self._pt_host[slot])))
+        if L < 2 or owned <= 0:
+            self.telemetry.count_handoff("export_failed")
+            return {"error": "nothing committed to hand off"}
+        t0 = time.perf_counter()
+        try:
+            row = np.ascontiguousarray(self._pt_host[slot, :owned])
+            tree_map = self._jax.tree_util.tree_map
+            fetch = lambda leaf: np.asarray(leaf[:, row])  # noqa: E731
+            blob = (tree_map(fetch, self.k_pool),
+                    tree_map(fetch, self.v_pool))
+            meta = {"resume_len": L, "page_size": self.ec.page_size,
+                    "pages": owned, "adapter_id": pending.adapter_id,
+                    "generated": list(pending.generated)}
+            data, nbytes, _ = pack_frame(f"handoff/{pending.rid}", blob,
+                                         meta)
+            ttl = None
+            if (self._handoff_chaos is not None
+                    and self._handoff_chaos.expire_export()):
+                ttl = 0.0  # chaos: the puller must find it expired
+            handle = self._handoffs.put(data, meta, ttl_s=ttl)
+            if handle is None:
+                self.telemetry.count_handoff("export_failed")
+                return {"error": "handoff store budget exhausted"}
+            self.telemetry.count_handoff("export")
+            if self.ec.telemetry:
+                self._flight_event(
+                    "handoff_export", [slot],
+                    {"pages": owned, "bytes": nbytes, "resume_len": L},
+                    t0, "ok")
+            return {"handle": handle, "pages": owned, "nbytes": nbytes,
+                    "resume_len": L,
+                    "ttl_s": (self.ec.handoff_ttl_s if ttl is None
+                              else ttl)}
+        except Exception as exc:  # noqa: BLE001 — export must degrade
+            self.telemetry.count_handoff("export_failed")
+            if self.ec.telemetry:
+                self._flight_event("handoff_export", [slot], None, t0,
+                                   "error",
+                                   error=f"{type(exc).__name__}: {exc}")
+            return {"error": f"{type(exc).__name__}: {exc}"}
+
+    def pull_handoff(self, handle: str,
+                     count_miss: bool = True) -> Optional[bytes]:
+        """Serve one exported KV frame to a pulling decode replica
+        (``GET /engine/kv_handoff/<handle>``).  One-shot: a second pull
+        of the same handle is refused — after a failover re-dispatch the
+        frame may already be scattered into another replica's pool, and
+        two slots must not decode from one blob.  None on refused /
+        expired / unknown handles (the puller degrades to re-prefill).
+        ``count_miss=False``: a multi-model server probing every engine
+        for the owner must not charge a miss to the ones that never
+        exported it."""
+        outcome, data = self._handoffs.pull(handle, count_miss=count_miss)
+        if outcome != "miss" or count_miss:
+            self.telemetry.count_handoff(
+                {"ok": "pull", "refused": "pull_refused",
+                 "expired": "expired", "miss": "miss"}[outcome])
+        if data is not None:
+            self.telemetry.count_handoff_bytes("out", len(data))
+        return data
+
+    def drop_handoff(self, handle: str) -> bool:
+        """Discard an exported frame that will never be pulled (the
+        prefill phase saw the generation complete on its only token) —
+        frees the bytes immediately instead of at TTL expiry."""
+        return self._handoffs.drop(handle)
 
     def _pin_session(self, slot: int, pending: _Pending,
                      cache_ok: bool) -> dict:

@@ -329,6 +329,103 @@ class StorageChaos:
             }
 
 
+# ------------------------------------------------------------- handoff scope
+
+
+@dataclasses.dataclass(frozen=True)
+class HandoffFaultConfig:
+    """Seeded fault plan for the disaggregated prefill/decode KV handoff
+    (serving/disagg.py, README "Disaggregated serving").  Frozen (rides in
+    the frozen EngineConfig as ``handoff_chaos``); all-defaults == inject
+    nothing.  ``*_on`` are 1-based pull/export ordinals (-1 = off);
+    ``*_every`` fire on every Nth (0 = off).  Every injection must leave
+    the request COMPLETED via the degraded re-prefill path with zero
+    leaked KV pages on both replicas — asserted by tests/test_disagg.py
+    and ``serving_bench --disagg``."""
+
+    seed: int = 0
+    # truncate the Nth pulled frame to half (a transfer the socket closed
+    # mid-body); the KVPG magic/length/CRC verifier must catch it
+    torn_pull_on: int = -1
+    torn_pull_every: int = 0
+    # chronically slow handoff link: sleep this long on matching pulls
+    slow_pull_s: float = 0.0
+    slow_pull_every: int = 0
+    # raise ConnectionError on the Nth pull — the decode replica's link
+    # (or the prefill replica) dying mid-pull
+    dead_link_on: int = -1
+    dead_link_every: int = 0
+    # the Nth EXPORT registers with an already-lapsed TTL, so the decode
+    # replica's pull finds the handle expired
+    expire_export_on: int = -1
+    expire_export_every: int = 0
+
+
+class HandoffChaos:
+    """Runtime half of HandoffFaultConfig: ``on_pull(data) -> data`` wraps
+    the decode replica's pulled bytes (may truncate, sleep, or raise);
+    ``expire_export()`` is consulted by the exporting engine per export
+    (True = register the handle pre-expired).  Thread-safe: HTTP handler
+    threads pull while the engine loop exports."""
+
+    def __init__(self, config: HandoffFaultConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self.pulls = 0
+        self.exports = 0
+        self.injected_torn_pulls = 0
+        self.injected_slow_pulls = 0
+        self.injected_dead_links = 0
+        self.injected_expired_exports = 0
+
+    @staticmethod
+    def _hit(n: int, on: int, every: int) -> bool:
+        return (on > 0 and n == on) or (every > 0 and n % every == 0)
+
+    def on_pull(self, data: bytes) -> bytes:
+        c = self.config
+        with self._lock:
+            self.pulls += 1
+            n = self.pulls
+            if self._hit(n, c.dead_link_on, c.dead_link_every):
+                self.injected_dead_links += 1
+                raise ConnectionError(
+                    f"injected dead handoff link (chaos, pull {n})")
+            slow = (c.slow_pull_s > 0 and c.slow_pull_every > 0
+                    and n % c.slow_pull_every == 0)
+            if slow:
+                self.injected_slow_pulls += 1
+            torn = self._hit(n, c.torn_pull_on, c.torn_pull_every)
+            if torn:
+                self.injected_torn_pulls += 1
+        if slow:
+            time.sleep(c.slow_pull_s)
+        if torn:
+            return data[:max(8, len(data) // 2)]
+        return data
+
+    def expire_export(self) -> bool:
+        c = self.config
+        with self._lock:
+            self.exports += 1
+            hit = self._hit(self.exports, c.expire_export_on,
+                            c.expire_export_every)
+            if hit:
+                self.injected_expired_exports += 1
+            return hit
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "handoff_pulls": self.pulls,
+                "handoff_exports": self.exports,
+                "injected_torn_pulls": self.injected_torn_pulls,
+                "injected_slow_pulls": self.injected_slow_pulls,
+                "injected_dead_links": self.injected_dead_links,
+                "injected_expired_exports": self.injected_expired_exports,
+            }
+
+
 # --------------------------------------------------------------- fleet scope
 
 

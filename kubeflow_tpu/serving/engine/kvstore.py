@@ -203,6 +203,62 @@ def _crc_blob(blob) -> Tuple[dict, list, int, int]:
     return spec, leaves, total, crc
 
 
+def _frame_bytes(header_fields: dict, leaves: list) -> bytes:
+    """Assemble one KVPG frame: magic | format version | header length |
+    header JSON | concatenated leaf payload.  The ONE framing routine
+    behind both the disk tier's page files and the disaggregation
+    handoff's wire format (serving/disagg.py) — torn/corrupt transfers
+    are detected by the same verifier either way."""
+    header = json.dumps(header_fields).encode()
+    return (MAGIC + struct.pack("<II", FORMAT_VERSION, len(header))
+            + header + b"".join(a.tobytes() for a in leaves))
+
+
+def pack_frame(key: str, blob, meta: dict, version: int = 1) -> tuple:
+    """Serialize a KV blob into a standalone KVPG frame ->
+    ``(data, nbytes, crc)``.  Used for over-the-wire handoff blobs; the
+    disk tier builds the identical bytes via :func:`_frame_bytes` from its
+    entry bookkeeping."""
+    spec, leaves, total, crc = _crc_blob(blob)
+    data = _frame_bytes({
+        "v": FORMAT_VERSION, "key": key, "spec": spec, "meta": dict(meta),
+        "nbytes": total, "crc": crc, "version": version,
+    }, leaves)
+    return data, total, crc
+
+
+def unpack_frame(data: bytes):
+    """Parse + VERIFY one KVPG frame -> ``(blob, header)``.  Raises
+    :class:`KVStoreCorrupt` on any verification failure — bad magic /
+    truncated header (torn transfer), payload length mismatch, CRC32
+    mismatch (bit flip), unsupported format version."""
+    if len(data) < 12 or data[:4] != MAGIC:
+        raise KVStoreCorrupt("bad magic (torn write?)")
+    ver, hlen = struct.unpack("<II", data[4:12])
+    if ver != FORMAT_VERSION:
+        raise KVStoreCorrupt(f"unsupported format version {ver}")
+    if len(data) < 12 + hlen:
+        raise KVStoreCorrupt("torn write: truncated header")
+    try:
+        header = json.loads(data[12:12 + hlen])
+    except ValueError as exc:
+        raise KVStoreCorrupt(f"corrupt header: {exc}") from exc
+    payload = data[12 + hlen:]
+    if len(payload) != header["nbytes"]:
+        raise KVStoreCorrupt(
+            f"torn write: payload {len(payload)} != {header['nbytes']}")
+    if zlib.crc32(payload) != header["crc"]:
+        raise KVStoreCorrupt("checksum mismatch (bit flip?)")
+    leaves, off = [], 0
+    for leaf_spec in _iter_array_specs(header["spec"]):
+        dt = _np_dtype(leaf_spec["dtype"])
+        n = int(np.prod(leaf_spec["shape"], dtype=np.int64)) * dt.itemsize
+        arr = np.frombuffer(payload[off:off + n], dtype=dt)
+        leaves.append(arr.reshape(leaf_spec["shape"]))
+        off += n
+    return _unflatten(header["spec"], leaves), header
+
+
 @dataclasses.dataclass
 class _Entry:
     key: str
@@ -309,12 +365,10 @@ class TieredKVStore:
         stream BEFORE the atomic rename — modeling a write the filesystem
         acknowledged but never fully persisted (the crash-consistency
         case the verifier exists for).  Caller owns disk_used accounting."""
-        header = json.dumps({
+        data = _frame_bytes({
             "v": FORMAT_VERSION, "key": e.key, "spec": spec, "meta": e.meta,
             "nbytes": e.nbytes, "crc": e.crc, "version": e.version,
-        }).encode()
-        data = (MAGIC + struct.pack("<II", FORMAT_VERSION, len(header))
-                + header + b"".join(a.tobytes() for a in leaves))
+        }, leaves)
         if self.chaos is not None:
             data = self.chaos.on_write(data)  # may truncate or raise ENOSPC
         path = self._file_for(e.key, e.version)
@@ -355,31 +409,7 @@ class TieredKVStore:
             raise KVStoreCorrupt(f"missing/unreadable file: {exc}") from exc
         if self.chaos is not None:
             data = self.chaos.on_read(data)  # may sleep or flip a bit
-        if len(data) < 12 or data[:4] != MAGIC:
-            raise KVStoreCorrupt("bad magic (torn write?)")
-        ver, hlen = struct.unpack("<II", data[4:12])
-        if ver != FORMAT_VERSION:
-            raise KVStoreCorrupt(f"unsupported format version {ver}")
-        if len(data) < 12 + hlen:
-            raise KVStoreCorrupt("torn write: truncated header")
-        try:
-            header = json.loads(data[12:12 + hlen])
-        except ValueError as exc:
-            raise KVStoreCorrupt(f"corrupt header: {exc}") from exc
-        payload = data[12 + hlen:]
-        if len(payload) != header["nbytes"]:
-            raise KVStoreCorrupt(
-                f"torn write: payload {len(payload)} != {header['nbytes']}")
-        if zlib.crc32(payload) != header["crc"]:
-            raise KVStoreCorrupt("checksum mismatch (bit flip?)")
-        leaves, off = [], 0
-        for leaf_spec in _iter_array_specs(header["spec"]):
-            dt = _np_dtype(leaf_spec["dtype"])
-            n = int(np.prod(leaf_spec["shape"], dtype=np.int64)) * dt.itemsize
-            arr = np.frombuffer(payload[off:off + n], dtype=dt)
-            leaves.append(arr.reshape(leaf_spec["shape"]))
-            off += n
-        return _unflatten(header["spec"], leaves), header
+        return unpack_frame(data)
 
     def _drop(self, e: _Entry, unlink: bool = True) -> None:
         """Remove an entry entirely, releasing both tiers' budget."""
@@ -550,14 +580,19 @@ class TieredKVStore:
 
     # ------------------------------------------------------------- swap API
 
-    def put_swap(self, rid: int, blob, nbytes: int) -> bool:
+    def put_swap(self, rid: int, blob, nbytes: int,
+                 count: bool = True) -> bool:
         """Host-tier insert for a preempted slot's KV (spilling LRU
         entries to disk for room).  False = could not fit anywhere; the
         engine falls back to drop-and-recompute.  ``nbytes`` is advisory
         (the caller's tree-size estimate); for array pytrees the
         serialized payload size is what the budgets charge.  Opaque
         (non-pytree) blobs are accepted at face value for the pre-tiering
-        HostSwapStore contract — host-resident, unspillable."""
+        HostSwapStore contract — host-resident, unspillable.
+        ``count=False`` skips the swap-traffic counters: a disaggregation
+        KV import parks its pulled blob here for the admission path to
+        scatter (engine.py), and stats must not report it as preemption
+        swap the engine never performed."""
         key = f"swap/{rid}"
         try:
             _, _, total, crc = _crc_blob(blob)
@@ -577,16 +612,18 @@ class TieredKVStore:
                 key=key, nbytes=total, crc=crc, pinned=False,
                 seq=self._seq, blob=blob, serializable=serializable)
             self.host_used += total
-            self.swapped_out += 1
-            self.bytes_out += total
+            if count:
+                self.swapped_out += 1
+                self.bytes_out += total
             self._event("host", "put")
             return True
 
-    def pop_swap(self, rid: int):
+    def pop_swap(self, rid: int, count: bool = True):
         """-> (blob, nbytes) or None; removes the entry and releases its
         budget.  A disk-resident blob is read + verified; verification
         failure returns None (the engine's existing blob-lost path
-        recomputes from the committed context)."""
+        recomputes from the committed context).  ``count=False``: the
+        handoff-import twin of ``put_swap(count=False)``."""
         key = f"swap/{rid}"
         with self._lock:
             e = self._entries.get(key)
@@ -606,8 +643,9 @@ class TieredKVStore:
                 self._event("host", "hit")
             nbytes = e.nbytes
             self._drop(e)
-            self.swapped_in += 1
-            self.bytes_in += nbytes
+            if count:
+                self.swapped_in += 1
+                self.bytes_in += nbytes
             return blob, nbytes
 
     def discard_swap(self, rid: int) -> None:

@@ -18,13 +18,16 @@ from __future__ import annotations
 
 import json
 import os
+import re
+import time
+import urllib.request
 from typing import Any, Optional
 
 from ...core import tracing
 from ..server import Model
 from ..errors import EngineError, RequestError
 from .engine import Engine, EngineConfig
-from .kvstore import normalize_session_id
+from .kvstore import KVStoreCorrupt, normalize_session_id, unpack_frame
 from .model import DecoderConfig, load_params
 from .scheduler import normalize_priority
 
@@ -121,6 +124,12 @@ def _checkout_eos_ids(model_dir: str) -> list:
     return []
 
 
+# exported-KV pull handles are secrets.token_hex(16) — exactly 32 hex
+# chars; the decode phase interpolates them into a URL, so the shape is
+# enforced at parse time (serving/disagg.py)
+_HANDOFF_HANDLE_RE = re.compile(r"[0-9a-f]{32}")
+
+
 class JetStreamModel(Model):
     """kserve-style Model serving generate() from the TPU engine."""
 
@@ -186,6 +195,13 @@ class JetStreamModel(Model):
                     from ..slo import SloConfig
 
                     kw["slo"] = SloConfig.from_json(kw["slo"])
+                if isinstance(kw.get("handoff_chaos"), dict):
+                    # disaggregation handoff chaos straight from an
+                    # engine.json (README "Disaggregated serving")
+                    from .faults import HandoffFaultConfig
+
+                    kw["handoff_chaos"] = HandoffFaultConfig(
+                        **kw["handoff_chaos"])
                 if isinstance(kw.get("kv_store"), dict):
                     # tiered KV / session durability straight from an
                     # engine.json (README "Sessions & tiered KV"): point
@@ -199,6 +215,14 @@ class JetStreamModel(Model):
                         kkw["chaos"] = StorageFaultConfig(**kkw["chaos"])
                     kw["kv_store"] = KVStoreConfig(**kkw)
                 ec = EngineConfig(**kw)
+                # disaggregation role (README "Disaggregated serving"):
+                # validate HERE with a config-level message — a pod that
+                # crash-loops on a bad engine.json should say which key
+                # and file to fix
+                if ec.role not in ("prefill", "decode", "unified"):
+                    raise ValueError(
+                        f"{path}: role={ec.role!r} must be one of "
+                        "\"prefill\" | \"decode\" | \"unified\"")
                 # speculative block (README "Speculative decoding"):
                 # validate the knob composition HERE with a config-level
                 # message — Engine's own ValueError is correct but names no
@@ -441,6 +465,55 @@ class JetStreamModel(Model):
         return (self.tokenizer.encode(prompt) or [0], max_tokens,
                 params.get("adapter"), deadline, priority, resume, session)
 
+    @staticmethod
+    def _parse_disagg_params(payload: Any):
+        """Disaggregation phase markers (README "Disaggregated serving")
+        -> ``(kv_handoff, handoff)``: ``parameters.kv_handoff`` marks the
+        PREFILL phase (generate one token, export the KV pages, return a
+        pull handle); ``parameters.handoff = {handle, source_port,
+        token_ids}`` marks the DECODE phase (pull + import the pages,
+        decode the continuation, emit the FULL output — the first token's
+        text was never delivered to the client, unlike a failover
+        resume).  Raises RequestError (-> 400) on malformed blocks."""
+        params = (payload.get("parameters") or {}) \
+            if isinstance(payload, dict) else {}
+        if not isinstance(params, dict):
+            return False, None
+        kv_handoff = bool(params.get("kv_handoff"))
+        hand = params.get("handoff")
+        if hand is None:
+            return kv_handoff, None
+        if not isinstance(hand, dict):
+            raise RequestError(f"handoff must be an object, got {hand!r}")
+        ids = hand.get("token_ids")
+        if (not isinstance(ids, list) or not ids
+                or not all(isinstance(i, int) and i >= 0 for i in ids)):
+            raise RequestError(
+                "handoff.token_ids must be a non-empty list of "
+                f"non-negative token ids, got {ids!r}")
+        handle = hand.get("handle")
+        if handle is not None and (
+                not isinstance(handle, str)
+                or not _HANDOFF_HANDLE_RE.fullmatch(handle)):
+            # handles are always secrets.token_hex(16); anything else is
+            # forged — and it gets interpolated into a localhost URL, so
+            # a free-form value would be an SSRF primitive
+            raise RequestError(f"handoff.handle must be a 32-char hex "
+                               f"token, got {handle!r}")
+        port = hand.get("source_port")
+        if port is not None and (not isinstance(port, int)
+                                 or not 0 < port < 65536):
+            raise RequestError(f"handoff.source_port must be a port "
+                               f"number, got {port!r}")
+        out = {"handle": handle, "source_port": port,
+               "token_ids": [int(i) for i in ids]}
+        for k in ("phase_ttft_s", "phase_latency_s"):
+            try:
+                out[k] = max(0.0, float(hand.get(k) or 0.0))
+            except (TypeError, ValueError):
+                out[k] = 0.0
+        return kv_handoff, out
+
     def generate(self, payload: Any, headers: Optional[dict] = None) -> Any:
         """V2 generate extension (unary): {"text_input": str, "parameters":
         {"max_tokens": N, "deadline_s": S, "priority": "interactive" |
@@ -454,6 +527,21 @@ class JetStreamModel(Model):
         (restore tier, pinned/durable flags, evictions)."""
         ids, max_tokens, adapter, deadline, priority, resume, session = \
             self._parse_generate(payload, headers)
+        kv_handoff, hand = self._parse_disagg_params(payload)
+        if kv_handoff:
+            if session is not None or resume or hand is not None:
+                raise RequestError(
+                    "kv_handoff composes with none of session_id, "
+                    "resume_token_ids or handoff")
+            return self._prefill_phase(ids, max_tokens, adapter, deadline,
+                                       priority, headers)
+        if hand is not None:
+            if resume:
+                raise RequestError(
+                    "handoff and resume_token_ids are mutually exclusive")
+            return self._decode_phase_unary(ids, max_tokens, adapter,
+                                            deadline, priority, session,
+                                            hand, headers)
         resume = resume or []
         max_new = max_tokens - len(resume)
         if resume and max_new <= 0:
@@ -485,6 +573,184 @@ class JetStreamModel(Model):
             out["trace"] = self.engine.trace(r["rid"])
         return out
 
+    # ------------------------------------ disaggregated prefill/decode
+    # (README "Disaggregated serving"): the service proxy splits eligible
+    # requests into a unary PREFILL phase on a prefill-role replica and a
+    # DECODE phase — carrying the exported-KV pull handle — on a decode
+    # replica.  Everything below degrades to a plain re-prefill on any
+    # handoff problem; under greedy decoding the degraded path re-derives
+    # the identical bytes, so disaggregation is invisible to clients.
+
+    _HANDOFF_PULL_TIMEOUT_S = 10.0
+
+    def _prefill_phase(self, ids: list, max_tokens: int, adapter, deadline,
+                       priority, headers) -> dict:
+        """``parameters.kv_handoff: true``: run the prompt through the
+        ordinary (chunked-)prefill machinery, sample exactly the first
+        token a unified engine would, export the committed KV pages, and
+        answer with the token + the one-shot pull handle.  ``complete``
+        tells the proxy no decode phase is needed (EOS on the first
+        token, or max_tokens == 1)."""
+        r = self.engine.generate(ids, 1, adapter=adapter, deadline=deadline,
+                                 priority=priority, handoff=True,
+                                 trace=self._trace_ctx(headers),
+                                 links=self._resume_link(headers))
+        toks = r["tokens"]
+        stop_ids = getattr(self.engine, "_stop_ids", frozenset())
+        complete = bool(toks and toks[-1] in stop_ids) \
+            or max_tokens <= len(toks)
+        out = {"token_ids": toks, "prompt_tokens": len(ids),
+               "max_tokens": max_tokens, "complete": complete,
+               "ttft_s": round(r["ttft_s"], 4),
+               "latency_s": round(r["latency_s"], 4)}
+        if "handoff" in r:
+            out["handoff"] = dict(r["handoff"])
+            if complete and out["handoff"].get("handle"):
+                # the generation finished on its only token: nobody will
+                # ever pull this frame — free its bytes NOW instead of
+                # pinning pool-sized state until TTL expiry
+                self.engine.drop_handoff(out["handoff"].pop("handle"))
+        if self._wants_trace(headers):
+            out["trace"] = self.engine.trace(r["rid"])
+        return out
+
+    def _handoff_import(self, hand: dict, adapter):
+        """Pull + verify the prefill replica's exported KV frame ->
+        ``(blob, nbytes, resume_len)`` for ``Engine.generate(kv_import=)``,
+        or None — degrade to re-prefill — on ANY problem: missing handle,
+        unreachable/slow/dead source, torn transfer (KVPG magic/length),
+        bit flip (CRC32), geometry/adapter/dtype mismatch with this
+        engine's pools.  The wire format IS kvstore.py's page-file
+        format, so the verifier comes for free."""
+        tele = self.engine.telemetry
+        handle, port = hand.get("handle"), hand.get("source_port")
+        if not handle or not port:
+            tele.count_handoff("degraded")
+            return None
+        chaos = getattr(self.engine, "_handoff_chaos", None)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{int(port)}/engine/kv_handoff/"
+                    f"{handle}",
+                    timeout=self._HANDOFF_PULL_TIMEOUT_S) as r:
+                data = r.read()
+            if chaos is not None:
+                data = chaos.on_pull(data)  # may truncate, sleep or raise
+            blob, header = unpack_frame(data)
+        except KVStoreCorrupt:  # torn transfer / bit flip: caught exactly
+            tele.count_handoff("degraded")
+            return None
+        except Exception:  # noqa: BLE001 — dead link, slow past timeout
+            tele.count_handoff("degraded")
+            return None
+        try:
+            meta = header.get("meta") or {}
+            ec = self.engine.ec
+            resume_len = int(meta.get("resume_len") or 0)
+            pages = -(-resume_len // ec.page_size)
+            aid = self.engine.adapters.get(adapter, 0) \
+                if adapter is not None else 0
+            if (meta.get("page_size") != ec.page_size or resume_len < 2
+                    or int(meta.get("adapter_id") or 0) != aid
+                    or not (isinstance(blob, tuple) and len(blob) == 2)):
+                raise ValueError("handoff meta mismatch")
+            import jax
+
+            for side, pool in ((blob[0], self.engine.k_pool),
+                               (blob[1], self.engine.v_pool)):
+                bl = jax.tree_util.tree_leaves(side)
+                pl = jax.tree_util.tree_leaves(pool)
+                if len(bl) != len(pl):
+                    raise ValueError("handoff blob leaf-count mismatch")
+                for b, p in zip(bl, pl):
+                    # a legitimate export covers pages or pages-1 (the
+                    # boundary prompt whose finishing commit granted no
+                    # next page); anything SHORTER would scatter partial
+                    # coverage and decode silently from garbage KV
+                    if (b.ndim != p.ndim or b.shape[0] != p.shape[0]
+                            or tuple(b.shape[2:]) != tuple(p.shape[2:])
+                            or b.dtype != p.dtype
+                            or not max(1, pages - 1) <= b.shape[1]
+                            <= pages):
+                        raise ValueError(
+                            f"handoff leaf {b.shape}/{b.dtype} does not "
+                            f"fit pool {p.shape}/{p.dtype}")
+        except Exception:  # noqa: BLE001 — degrade, never fail
+            tele.count_handoff("degraded")
+            return None
+        return blob, int(header.get("nbytes") or 0), resume_len
+
+    def _decode_phase_unary(self, ids: list, max_tokens: int, adapter,
+                            deadline, priority, session, hand: dict,
+                            headers) -> dict:
+        """Decode phase, unary: fold the prefill phase's token(s) into the
+        prompt, import the verified KV (or degrade to re-prefill), and
+        return the FULL output — handoff tokens included, since their
+        text never reached the client (unlike a failover resume)."""
+        prior = hand["token_ids"]
+        stop_ids = getattr(self.engine, "_stop_ids", frozenset())
+        max_new = max_tokens - len(prior)
+        # the client's first token came out of the PREFILL phase: its
+        # TTFT is the request's TTFT, and its wall time is part of the
+        # request's latency — a split request must not report flattering
+        # decode-only numbers (the proxy passes the phase timing through)
+        base_ttft = hand.get("phase_ttft_s", 0.0)
+        base_lat = hand.get("phase_latency_s", 0.0)
+        if max_new <= 0 or prior[-1] in stop_ids:
+            # the prefill phase already finished the generation
+            return {"text_output": self.tokenizer.decode(prior),
+                    "token_ids": list(prior), "tokens": len(prior),
+                    "prompt_tokens": len(ids), "max_tokens": max_tokens,
+                    "ttft_s": round(base_ttft, 4),
+                    "latency_s": round(base_lat, 4)}
+        t_pull = time.perf_counter()
+        imp = self._handoff_import(hand, adapter)
+        # the pull sits BETWEEN the phases: its wall time (up to the pull
+        # timeout on a slow link) belongs in the end-to-end latency too
+        base_lat += time.perf_counter() - t_pull
+        r = self.engine.generate(ids + prior, max_new, adapter=adapter,
+                                 deadline=deadline, priority=priority,
+                                 session_id=session, kv_import=imp,
+                                 trace=self._trace_ctx(headers),
+                                 links=self._resume_link(headers))
+        out_ids = list(prior) + r["tokens"]
+        out = {"text_output": self.tokenizer.decode(out_ids),
+               "token_ids": out_ids,
+               "tokens": r["num_tokens"] + len(prior),
+               "prompt_tokens": len(ids), "max_tokens": max_tokens,
+               "ttft_s": round(base_ttft if base_ttft > 0
+                               else r["ttft_s"], 4),
+               "latency_s": round(base_lat + r["latency_s"], 4)}
+        if "session" in r:
+            out["session"] = r["session"]
+        if self._wants_trace(headers):
+            out["trace"] = self.engine.trace(r["rid"])
+        return out
+
+    def _handoff_complete(self, prior: list, ids: list, max_tokens: int,
+                          hand: dict):
+        """Degenerate decode phase: the prefill phase already produced
+        every token (EOS first, or max_tokens == 1) — emit its text, then
+        the final record carrying the prefill phase's timing."""
+        full = self.tokenizer.decode(prior)
+        if full:
+            yield {"text_output": full, "token_ids": list(prior)}
+        yield {"text_output": "", "done": True, "tokens": len(prior),
+               "prompt_tokens": len(ids), "max_tokens": max_tokens,
+               "ttft_s": round(hand.get("phase_ttft_s", 0.0), 4),
+               "latency_s": round(hand.get("phase_latency_s", 0.0), 4)}
+
+    def pull_handoff(self, handle: str,
+                     count_miss: bool = True) -> Optional[bytes]:
+        """Serve one exported KV frame (GET /engine/kv_handoff/<handle>,
+        server.py).  None = unknown / expired / already pulled."""
+        if self.engine is None:
+            return None
+        try:
+            return self.engine.pull_handoff(handle, count_miss=count_miss)
+        except Exception:  # noqa: BLE001 — a pull must answer
+            return None
+
     def generate_stream(self, payload: Any, headers: Optional[dict] = None):
         """V2 generate_stream: yields {"text_output": piece} per token, then
         a final record with the run stats.
@@ -508,8 +774,39 @@ class JetStreamModel(Model):
         """
         ids, max_tokens, adapter, deadline, priority, resume, session = \
             self._parse_generate(payload, headers)
-        resume = resume or []
+        kv_handoff, hand = self._parse_disagg_params(payload)
+        if kv_handoff:
+            raise RequestError(
+                "kv_handoff is the unary prefill-phase parameter; "
+                "POST /generate")
         emit_ids = self._wants_ids(headers)
+        if hand is not None:
+            if resume:
+                raise RequestError(
+                    "handoff and resume_token_ids are mutually exclusive")
+            prior = hand["token_ids"]
+            stop_ids = getattr(self.engine, "_stop_ids", frozenset())
+            if max_tokens - len(prior) <= 0 or prior[-1] in stop_ids:
+                return self._handoff_complete(prior, ids, max_tokens, hand)
+            t_pull = time.perf_counter()
+            imp = self._handoff_import(hand, adapter)
+            pull_s = time.perf_counter() - t_pull
+            stream = self.engine.generate_stream(
+                ids + prior, max_tokens - len(prior), adapter=adapter,
+                deadline=deadline, priority=priority, session_id=session,
+                kv_import=imp, trace=self._trace_ctx(headers),
+                links=self._resume_link(headers))
+            # prior_emitted=False: handoff tokens were generated elsewhere
+            # but never DELIVERED — their text (and ids, for the failover
+            # relay) go out with the first events.  The pull's wall time
+            # joins the prefill phase's in the final record's latency.
+            return self._stream_pieces(
+                stream, ids, max_tokens,
+                with_trace=self._wants_trace(headers),
+                emit_ids=emit_ids, prior_ids=prior, prior_emitted=False,
+                phase_ttft=hand.get("phase_ttft_s", 0.0),
+                phase_latency=hand.get("phase_latency_s", 0.0) + pull_s)
+        resume = resume or []
         max_new = max_tokens - len(resume)
         if resume and max_new <= 0:
             return self._resume_complete(resume, ids, max_tokens)
@@ -548,13 +845,21 @@ class JetStreamModel(Model):
 
     def _stream_pieces(self, stream, ids: list, max_tokens: int,
                        with_trace: bool = False, emit_ids: bool = False,
-                       prior_ids: Optional[list] = None):
+                       prior_ids: Optional[list] = None,
+                       prior_emitted: bool = True,
+                       phase_ttft: float = 0.0,
+                       phase_latency: float = 0.0):
         out_ids: list[int] = list(prior_ids or [])
         base = len(out_ids)
-        # text already delivered by the PREVIOUS replica = the stable prefix
-        # of the resumed ids (the ingress relayed exactly the stable pieces)
-        emitted = self._stable_len(self.tokenizer.decode(out_ids)) if out_ids else 0
-        reported = base  # ids already carried by an emitted event
+        # prior_emitted (failover resume): text already delivered by the
+        # PREVIOUS replica = the stable prefix of the resumed ids (the
+        # ingress relayed exactly the stable pieces).  NOT prior_emitted
+        # (disaggregation handoff): the prior tokens were generated on the
+        # prefill replica but nothing has reached the client yet — their
+        # text and ids ride out with the first events.
+        emitted = (self._stable_len(self.tokenizer.decode(out_ids))
+                   if out_ids and prior_emitted else 0)
+        reported = base if prior_emitted else 0
         try:
             for item in stream:
                 if isinstance(item, dict):
@@ -564,8 +869,13 @@ class JetStreamModel(Model):
                     final = {"text_output": "", "done": True,
                              "tokens": item["num_tokens"] + base,
                              "prompt_tokens": len(ids), "max_tokens": max_tokens,
-                             "ttft_s": round(item["ttft_s"], 4),
-                             "latency_s": round(item["latency_s"], 4)}
+                             # a disaggregated decode phase folds the
+                             # prefill phase's wall time in: the client's
+                             # first token came out of THAT phase
+                             "ttft_s": round(phase_ttft if phase_ttft > 0
+                                             else item["ttft_s"], 4),
+                             "latency_s": round(phase_latency
+                                                + item["latency_s"], 4)}
                     if "session" in item:
                         final["session"] = item["session"]
                     if with_trace:

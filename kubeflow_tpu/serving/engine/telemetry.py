@@ -318,6 +318,22 @@ class EngineTelemetry:
         self.session_pins = r.counter(
             "engine_session_pins_total",
             "session pin attempts by outcome (pinned/durable/rejected)")
+        # Disaggregated prefill/decode surface (README "Disaggregated
+        # serving"): handoff lifecycle outcomes — export / export_failed
+        # on the prefill side; pull / pull_refused / expired / miss as the
+        # store answers pullers; import / degraded on the decode side
+        # (degraded = the verified-KV fast path fell back to re-prefill,
+        # which still completes the request) — and payload bytes by
+        # direction (out = frames served to pullers, in = frames imported).
+        self.kv_handoff = r.counter(
+            "engine_kv_handoff_total",
+            "disaggregation KV handoff operations by outcome "
+            "(export/export_failed/pull/pull_refused/expired/miss/"
+            "import/degraded)")
+        self.kv_handoff_bytes = r.counter(
+            "engine_kv_handoff_bytes_total",
+            "disaggregation KV handoff payload bytes by direction "
+            "(out=served to pullers, in=imported)")
         # Fleet robustness surface (ISSUE 6): the engine's health state as a
         # one-hot labeled gauge so dashboards can plot state transitions —
         # the scrape-time complement of the router's active /engine/health
@@ -410,6 +426,14 @@ class EngineTelemetry:
             if accepted:
                 self.spec_accepted_tokens.inc(accepted)
             self.spec_accept_len.observe(accepted)
+
+    def count_handoff(self, outcome: str) -> None:
+        if self.enabled:
+            self.kv_handoff.inc(outcome=outcome)
+
+    def count_handoff_bytes(self, direction: str, nbytes: int) -> None:
+        if self.enabled and nbytes:
+            self.kv_handoff_bytes.inc(nbytes, direction=direction)
 
     def count_kv_event(self, tier: str, event: str) -> None:
         if self.enabled:

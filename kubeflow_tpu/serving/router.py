@@ -46,6 +46,7 @@ from typing import Optional
 from ..core import tracing
 from ..core.api import APIServer, Obj
 from ..core.metrics import REGISTRY, merge_expositions
+from . import disagg
 from .api import GROUP, LABEL_ISVC, LABEL_REVISION
 from .controllers import (
     DEPLOYMENT_FOR_SERVICE_ANNOTATION,
@@ -169,6 +170,12 @@ class _ProxyState:
         # flight, same discipline as `refreshing` above)
         self.health: dict[int, _BackendHealth] = {}
         self.probing: set[int] = set()
+        # sticky session routing (README "Disaggregated serving"): session
+        # id -> the port whose engine pinned that session's KV.  Without
+        # this, turn N+1 load-balances like any other request and can
+        # land on a replica without the pinned pages — a silent cold
+        # restore.  LRU-capped; pruned on pod churn like `health`.
+        self.sessions: dict[str, int] = {}
         self.lock = threading.Lock()
 
 
@@ -341,7 +348,17 @@ class ServiceProxy:
         relay_timeout = float(ann.get(RELAY_TIMEOUT_ANNOTATION,
                                       self._RELAY_TIMEOUT_S))
         hedge_s = float(ann.get(HEDGE_TIMEOUT_ANNOTATION, 0.0))
-        resume = self._resume_context(handler.path, body)
+        # ONE body parse for every proxy-native consumer on this request
+        # (resume context, session stickiness, disagg classification) —
+        # multi-KB prompt bodies must not be re-decoded per concern
+        payload = None
+        if body:
+            try:
+                payload = json.loads(body)
+            except ValueError:
+                payload = None
+        resume = self._resume_context(handler.path, payload)
+        session = self._session_key(handler.headers, payload)
         sse = _SSERelay(handler)
         # distributed trace (README "Observability"): adopt the caller's
         # traceparent (this relay's root span becomes its child) or mint a
@@ -369,14 +386,35 @@ class ServiceProxy:
         backend_label = "none"
         attempt = 0
         tried: set[int] = set()
+        # disaggregated prefill/decode (README "Disaggregated serving"):
+        # when the service runs role-split replicas and this request
+        # classifies as prefill-heavy, run the PREFILL phase now (one
+        # unary hop to a prefill replica that exports the prompt's KV) and
+        # rewrite the body into the DECODE phase the retry loop below
+        # relays — restricted to decode-capable replicas.  Any prefill-
+        # phase failure falls through to the plain unified relay.
+        # Prefill-role replicas never take general traffic: every pick
+        # below prefers decode/unified roles (fall-back inside the pick
+        # keeps an all-prefill fleet serving rather than 503ing).
+        roles = ("decode", "unified")
+        if session is None and svc is not None:
+            plan = self._plan_disagg(state, svc, handler, body, payload)
+            if plan is not None:
+                decode_body = self._disagg_prefill(
+                    state, svc, handler, plan, fwd_headers, root, t0,
+                    relay_timeout)
+                if decode_body is not None:
+                    body = decode_body
         # true only for the dispatch immediately following a hedge-armed
         # stall: THAT attempt is the hedged re-dispatch ingress_hedged_total
         # counts, not the tight-timeout first attempt that armed it
         hedge_redispatch = False
 
-        def reply(code: int, data: bytes, ctype: Optional[str] = None):
+        def reply(code: int, data: bytes, ctype: Optional[str] = None,
+                  extra: Optional[dict] = None):
             handler._reply(code, data, ctype,
-                           extra={"X-Trace-Id": root.trace_id})
+                           extra={**(extra or {}),
+                                  "X-Trace-Id": root.trace_id})
 
         def note_hop(hop, backend, kind, hop_t0, outcome,
                      error: Optional[str] = None,
@@ -402,7 +440,8 @@ class ServiceProxy:
                 try:
                     backend = self._pick_backend(state, body=body,
                                                  exclude=frozenset(tried),
-                                                 svc=svc)
+                                                 svc=svc, roles=roles,
+                                                 session=session)
                 except LookupError as e:
                     status = 503
                     note_hop(root.child(), None, "pick",
@@ -465,8 +504,10 @@ class ServiceProxy:
                         ctype = r.headers.get("Content-Type") or ""
                         if ctype.startswith("text/event-stream"):
                             if resume is not None:
-                                self._relay_resumable(state, r, sse, resume,
-                                                      backend)
+                                self._relay_resumable(
+                                    state, r, sse, resume, backend,
+                                    keep_ids=self._client_wants_ids(
+                                        handler.headers))
                                 ok = True
                             else:
                                 ok = handler._stream(r, ctype)
@@ -495,7 +536,12 @@ class ServiceProxy:
                             return
                         note_hop(hop, backend, kind, hop_t0, "ok",
                                  backend_state=hop_state)
-                        reply(r.status, payload, ctype or None)
+                        # session surface headers pass through: a client
+                        # behind the fleet reads X-Session-Restore/-Pinned
+                        # exactly like one talking to a replica directly
+                        reply(r.status, payload, ctype or None,
+                              extra={k: v for k, v in r.headers.items()
+                                     if k.lower().startswith("x-session-")})
                         return
                 except urllib.error.HTTPError as e:
                     status = e.code
@@ -608,27 +654,38 @@ class ServiceProxy:
         return isinstance(cause, (TimeoutError, socket.timeout))
 
     @staticmethod
-    def _resume_context(path: str, body: Optional[bytes]):
+    def _resume_context(path: str, payload):
         """A _ResumeCtx when this request is a resumable token stream (the
-        V2 generate_stream surface with a text prompt), else None."""
+        V2 generate_stream surface with a text prompt), else None.
+        ``payload`` is the relay's one parsed copy of the request body."""
         if not path.split("?")[0].rstrip("/").endswith("/generate_stream"):
-            return None
-        if not body:
-            return None
-        try:
-            payload = json.loads(body)
-        except ValueError:
             return None
         if not isinstance(payload, dict) or not isinstance(
                 payload.get("text_input"), str):
             return None
         return _ResumeCtx(payload)
 
+    @staticmethod
+    def _client_wants_ids(headers) -> bool:
+        """True when the DOWNSTREAM client itself sent X-Stream-Resume: it
+        wants the per-event token ids too (a chained ingress, the fleet
+        bench's identity audit) — the relay then forwards them instead of
+        consuming them for its own re-admission bookkeeping."""
+        for k, v in headers.items():
+            if (k.lower() == "x-stream-resume"
+                    and str(v).strip().lower() not in ("", "0", "false",
+                                                       "no")):
+                return True
+        return False
+
     def _relay_resumable(self, state: _ProxyState, r, sse: "_SSERelay",
-                         resume: "_ResumeCtx", backend: int) -> None:
+                         resume: "_ResumeCtx", backend: int,
+                         keep_ids: bool = False) -> None:
         """Parse-and-relay one backend SSE stream, recording the token ids
         behind every relayed event into ``resume`` so a broken stream can be
-        re-admitted elsewhere.  Raises _BackendStreamError on EOF-before-
+        re-admitted elsewhere.  ``keep_ids`` forwards the ids to the client
+        as well (it asked with its own X-Stream-Resume header) instead of
+        stripping them.  Raises _BackendStreamError on EOF-before-
         done, read errors/stalls, or an in-stream backend error event;
         raises _ClientGone when the downstream client hangs up."""
         chaos = self.chaos
@@ -659,7 +716,8 @@ class ServiceProxy:
                     # event (the model server's _sse_write contract): same
                     # failover path as a dropped connection
                     raise _BackendStreamError(str(event["error"]))
-                ids = event.pop("token_ids", None)
+                ids = (event.get("token_ids") if keep_ids
+                       else event.pop("token_ids", None))
                 if ids:
                     resume.token_ids.extend(int(i) for i in ids)
                 if event.get("done"):
@@ -671,15 +729,184 @@ class ServiceProxy:
                     sse.event(event)
                     sse.finish()
                     return
-                if event.get("text_output"):
+                if event.get("text_output") or (keep_ids and ids):
                     # empty pieces exist only to carry token_ids promptly
-                    # (held-back UTF-8 tails); the client never sees them
+                    # (held-back UTF-8 tails); an id-wanting client gets
+                    # them, anyone else never sees them
                     sse.event(event)
                 if chaos is not None:
                     act = chaos.on_relay_event(backend, resume.key)
                     if act == "cut":
                         raise _BackendStreamError(
                             "chaos: injected mid-stream disconnect")
+
+    # ------------------------------------ disaggregated prefill/decode
+    # (README "Disaggregated serving"): the proxy-side orchestration of
+    # the two-phase split.  serving/disagg.py owns the policy (roles,
+    # classification, the handoff store); this is the wiring.
+
+    @staticmethod
+    def _session_key(headers, payload) -> Optional[str]:
+        """The request's session id, if any — X-Session-Id header, the V2
+        ``parameters.session_id``, or the OpenAI body field — the sticky-
+        routing key that sends turn N+1 to the replica holding turn N's
+        pinned KV.  ``payload`` is the relay's one parsed body copy."""
+        for k, v in headers.items():
+            if k.lower() == "x-session-id" and str(v).strip():
+                return str(v).strip()
+        if not isinstance(payload, dict):
+            return None
+        params = payload.get("parameters")
+        sid = params.get("session_id") if isinstance(params, dict) else None
+        if sid is None:
+            sid = payload.get("session_id")  # OpenAI surface body field
+        return str(sid) if isinstance(sid, str) and sid else None
+
+    def _plan_disagg(self, state: _ProxyState, svc: Obj, handler,
+                     body: Optional[bytes], payload) -> Optional[dict]:
+        """Decide whether THIS request splits into prefill + decode
+        phases: the service must run at least one prefill-role and one
+        decode-capable ready replica, the path/payload must classify
+        (disagg.should_disaggregate), and a prompt whose prefix-affinity
+        entry points at a warm decode-capable replica prefers that cache
+        hit over a handoff.  None = relay unified.  ``payload`` is the
+        relay's one parsed copy of ``body``."""
+        ann = svc["metadata"].get("annotations", {})
+        mode = str(ann.get(disagg.DISAGG_ANNOTATION, "auto")).lower()
+        if mode == "off" or handler.command != "POST" or payload is None:
+            return None
+        if not disagg.eligible_path(handler.path):
+            return None
+        model = disagg.model_from_path(handler.path)
+        if model is None:
+            return None
+        try:
+            min_prompt = int(float(ann.get(
+                disagg.DISAGG_MIN_PROMPT_ANNOTATION,
+                disagg.DEFAULT_MIN_PROMPT_CHARS)))
+            ratio = float(ann.get(disagg.DISAGG_RATIO_ANNOTATION,
+                                  disagg.DEFAULT_PROMPT_DECODE_RATIO))
+        except ValueError:
+            return None
+        if not disagg.should_disaggregate(payload, mode, min_prompt, ratio):
+            return None
+        pods = self._ready_pods(state.namespace,
+                                svc["spec"].get("selector") or {}, None)
+        roles_by_port = {pod_port(p): disagg.pod_role(p) for p in pods}
+        if ("prefill" not in roles_by_port.values()
+                or not any(r in ("decode", "unified")
+                           for r in roles_by_port.values())):
+            return None
+        prefix = self._payload_prefix(payload)
+        if prefix is not None:
+            with state.lock:
+                seen = state.affinity.get(prefix)
+            if (seen in roles_by_port
+                    and roles_by_port[seen] in ("decode", "unified")):
+                # this prefix's KV is plausibly cached on a decode-capable
+                # replica already: the warm re-prefill there beats paying
+                # a handoff (the whole point of the affinity map)
+                return None
+        return {"payload": payload, "model": model}
+
+    def _disagg_prefill(self, state: _ProxyState, svc: Obj, handler,
+                        plan: dict, fwd_headers: dict, root, t0: float,
+                        relay_timeout: float) -> Optional[bytes]:
+        """Run the PREFILL phase: one unary POST to a prefill-role replica
+        with ``parameters.kv_handoff``, yielding the first token and the
+        exported-KV pull handle.  Returns the DECODE-phase body for the
+        relay loop (``parameters.handoff``), or None — the degradation
+        path — when no prefill replica is routable or the phase fails;
+        the caller then relays the ORIGINAL body unified."""
+        try:
+            port = self._pick_backend(state, body=None, svc=svc,
+                                      roles=("prefill",))
+        except LookupError:
+            disagg.PLACEMENTS.inc(role="unified")
+            return None
+        hop = root.child()
+        hop_t0 = time.perf_counter()
+        pbody = copy.deepcopy(plan["payload"])
+        params = pbody.setdefault("parameters", {})
+        if not isinstance(params, dict):
+            params = pbody["parameters"] = {}
+        params.pop("handoff", None)
+        params["kv_handoff"] = True
+        hdrs = dict(fwd_headers)
+        hdrs[tracing.TRACEPARENT_HEADER] = hop.traceparent()
+        hdrs["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v2/models/{plan['model']}/generate",
+            data=json.dumps(pbody).encode(), headers=hdrs)
+
+        def hop_span(outcome: str, error: Optional[str] = None) -> None:
+            span = {"trace_id": root.trace_id, "span_id": hop.span_id,
+                    "parent_id": hop.parent_id, "component": "ingress",
+                    "name": "relay_attempt", "kind": "prefill",
+                    "backend": port, "outcome": outcome,
+                    "t_start_s": round(hop_t0 - t0, 6),
+                    "duration_s": round(time.perf_counter() - hop_t0, 6)}
+            if error is not None:
+                span["error"] = error
+            self.traces.put(root.trace_id, span)
+
+        try:
+            with urllib.request.urlopen(req, timeout=relay_timeout) as r:
+                rec = json.loads(r.read())
+            ids = rec.get("token_ids")
+            if (not isinstance(ids, list) or not ids
+                    or not all(isinstance(i, int) for i in ids)):
+                raise ValueError(f"prefill phase returned no tokens: {rec}")
+        except urllib.error.HTTPError as e:
+            # 4xx = the request itself is bad; let the unified relay
+            # surface the same error to the client.  5xx = this replica is
+            # sick; strike it and degrade.
+            self._note_backend(state, port, e.code < 500)
+            hop_span(f"status_{e.code}", f"HTTP {e.code}")
+            disagg.PLACEMENTS.inc(role="unified")
+            return None
+        except Exception as e:  # noqa: BLE001 — connect error/stall/junk
+            self._note_backend(state, port, False)
+            hop_span("connect", str(e))
+            disagg.PLACEMENTS.inc(role="unified")
+            return None
+        self._note_backend(state, port, True)
+        hop_span("ok")
+        disagg.PLACEMENTS.inc(role="prefill")
+        if not rec.get("complete"):
+            # a complete prefill phase (EOS on the only token) still runs
+            # the decode-phase hop — its handler answers the degenerate
+            # case with the right unary/SSE framing — but that hop pulls
+            # nothing and places no decode work, so it is not a decode
+            # PLACEMENT (the exporter already dropped the frame)
+            disagg.PLACEMENTS.inc(role="decode")
+        hand = rec.get("handoff") if isinstance(rec.get("handoff"), dict) \
+            else {}
+        dbody = copy.deepcopy(plan["payload"])
+        params = dbody.setdefault("parameters", {})
+        if not isinstance(params, dict):
+            params = dbody["parameters"] = {}
+        if params.get("deadline_s") is not None:
+            # the deadline budget covers the WHOLE request: the decode
+            # phase gets what the prefill phase left, not a fresh budget
+            # (a tiny floor keeps the shed on the engine's typed 504 path
+            # rather than a proxy-invented error)
+            try:
+                params["deadline_s"] = max(
+                    0.001, float(params["deadline_s"])
+                    - (time.perf_counter() - hop_t0))
+            except (TypeError, ValueError):
+                pass  # malformed deadline: the backend's 400 says so
+        params["handoff"] = {"handle": hand.get("handle"),
+                             "source_port": port,
+                             "token_ids": [int(i) for i in ids],
+                             # the client's TTFT/latency include the
+                             # prefill phase — the decode replica folds
+                             # these into its response so a split request
+                             # reports honest end-to-end numbers
+                             "phase_ttft_s": rec.get("ttft_s") or 0.0,
+                             "phase_latency_s": rec.get("latency_s") or 0.0}
+        return json.dumps(dbody).encode()
 
     # --------------------------------------- fleet observability endpoints
 
@@ -943,6 +1170,13 @@ class ServiceProxy:
             for p in list(state.health):
                 if p not in keep and p not in state.probing:
                     del state.health[p]
+            # session stickiness follows the same churn rule: a mapping
+            # whose replica is gone would pin every future turn of that
+            # session to a dead port (the pick would ignore it, but the
+            # entry would still hold LRU budget forever)
+            for sid in [s for s, p in state.sessions.items()
+                        if p not in keep]:
+                del state.sessions[sid]
             self._set_state_gauge(state)
 
     def _routable_ports(self, state: _ProxyState, ports: list[int]) -> list[int]:
@@ -970,7 +1204,9 @@ class ServiceProxy:
 
     def _pick_backend(self, state: _ProxyState, body: Optional[bytes] = None,
                       exclude: frozenset = frozenset(),
-                      svc: Optional[Obj] = None) -> int:
+                      svc: Optional[Obj] = None,
+                      roles: Optional[tuple] = None,
+                      session: Optional[str] = None) -> int:
         # the caller's relay loop passes the Service it already fetched;
         # a sub-second-stale object is fine here (annotations and selector
         # churn far slower than requests)
@@ -994,24 +1230,58 @@ class ServiceProxy:
                 time.sleep(0.05)
             if not pods:
                 raise LookupError(f"no ready backend for {state.service_name} (rev={revision})")
-        ports = [pod_port(p) for p in pods]
-        self._prune_health(state, ports, selector)
+        all_ports = [pod_port(p) for p in pods]
+        ports = all_ports
+        if roles:
+            # disaggregation role filter (README "Disaggregated serving"):
+            # restrict to replicas declaring one of ``roles`` — with a
+            # fall-back to the full set when none match, because a
+            # degraded placement beats a failed request (a fleet of only
+            # prefill replicas still serves decode traffic)
+            rp = [pod_port(p) for p in pods if disagg.pod_role(p) in roles]
+            if rp:
+                ports = rp
+        self._prune_health(state, all_ports, selector)
         self._refresh_health(state, ports)
         routable = self._routable_ports(state, ports)
+        if not routable and ports is not all_ports:
+            # the whole preferred-role pool is ejected/draining: the same
+            # degraded-placement-beats-failed-request rule applies to
+            # HEALTH as to role absence — fail over to the off-role
+            # replicas rather than 503ing while healthy capacity exists
+            self._refresh_health(state, all_ports)
+            routable = self._routable_ports(state, all_ports)
         if not routable:
             # the empty-healthy-set fail-fast path: every backend is
             # ejected (breaker open) or draining — a 503 NOW beats a
             # doomed relay attempt against a known-bad replica
             raise LookupError(
                 f"no healthy backend for {state.service_name}: "
-                f"{len(ports)} ready but all ejected/draining")
+                f"{len(all_ports)} ready but all ejected/draining")
         cand = [p for p in routable if p not in exclude] or routable
-        if len(cand) > 1:
-            port = self._pick_engine_aware(state, cand, body)
-            if port is not None:
-                return port
-        state.rr += 1
-        return cand[state.rr % len(cand)]
+        picked = None
+        if session is not None:
+            # sticky session routing: the replica that pinned this
+            # session's KV serves its next turn — but only while it is
+            # still routable and not excluded (a failover MUST move; the
+            # new replica pins the turn and the mapping follows below)
+            with state.lock:
+                sp = state.sessions.get(session)
+            if sp in cand:
+                picked = sp
+        if picked is None and len(cand) > 1:
+            picked = self._pick_engine_aware(state, cand, body)
+        if picked is None:
+            state.rr += 1
+            picked = cand[state.rr % len(cand)]
+        if session is not None:
+            with state.lock:
+                # pop-then-insert keeps live sessions at the LRU tail
+                state.sessions.pop(session, None)
+                state.sessions[session] = picked
+                while len(state.sessions) > self._SESSION_CAP:
+                    state.sessions.pop(next(iter(state.sessions)))
+        return picked
 
     # engine-aware pick (SURVEY.md §3.4 production QPS; VERDICT r2 #7): with
     # several engine replicas behind one Service, round-robin ignores that
@@ -1027,6 +1297,7 @@ class ServiceProxy:
     _ENGINELESS_TTL = 2.0
     _AFFINITY_SLACK = 1.0
     _AFFINITY_CAP = 1024  # prefix->port entries kept per proxy (LRU)
+    _SESSION_CAP = 2048   # session->port stickiness entries (LRU)
 
     def _pick_engine_aware(self, state: _ProxyState, ports: list[int],
                            body: Optional[bytes]) -> Optional[int]:
@@ -1126,6 +1397,12 @@ class ServiceProxy:
             payload = json.loads(body)
         except ValueError:
             return None
+        return ServiceProxy._payload_prefix(payload)
+
+    @staticmethod
+    def _payload_prefix(payload) -> Optional[str]:
+        """_prompt_prefix over an ALREADY-PARSED body — for callers on the
+        relay path that hold the one shared parse (``_plan_disagg``)."""
         if not isinstance(payload, dict):
             return None
         prompt = payload.get("text_input")  # V1-generate style

