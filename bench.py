@@ -645,6 +645,32 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["disagg"] = {"error": str(e)[:200]}
     try:
+        # perf-introspection sidebar: serving_bench --perf's headline
+        # (BENCH_PERF.json) — plane overhead in both scopes, the
+        # chip-pinned MFU cross-check, and the waste-attribution audits
+        # (goodput + waste == dispatched is the ledger identity)
+        pf_path = os.path.join(REPO, "BENCH_PERF.json")
+        if os.path.exists(pf_path):
+            with open(pf_path) as f:
+                pf = json.loads(f.readline())
+            out["perf"] = {
+                "overhead_p50_pct": pf.get("overhead_p50_pct"),
+                "proxy_overhead_p50_pct":
+                    pf.get("proxy", {}).get("overhead_p50_pct"),
+                "mfu_crosscheck_rel_err":
+                    pf.get("mfu_crosscheck", {}).get("rel_err"),
+                "spec_audit_pass": pf.get("spec_audit", {}).get("pass"),
+                "handoff_audit_pass":
+                    pf.get("handoff_audit", {}).get("pass"),
+                "invariant_exact":
+                    pf.get("ledger", {}).get("invariant_exact"),
+                "mfu": pf.get("ledger", {}).get("mfu"),
+                "goodput_ratio": pf.get("ledger", {}).get("goodput_ratio"),
+                "platform": pf.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["perf"] = {"error": str(e)[:200]}
+    try:
         # sessions sidebar: serving_bench --sessions's headline
         # (BENCH_SESSIONS.json) — warm-vs-cold TTFT per tier is the tiered-
         # KV payoff, the identity/leak/reconcile flags are the durability

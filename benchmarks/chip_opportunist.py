@@ -232,6 +232,20 @@ JOBS = [
                                   "--out",
                                   os.path.join(REPO, "BENCH_DISAGG.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # perf introspection on a real chip (ISSUE 11): the first drained run
+    # records platform=tpu MFU/goodput rows from the new plane — the
+    # analytical serving MFU divides by the REAL v5e peak instead of the
+    # CPU estimate, the overhead gate runs at device tick rates, and the
+    # waste-attribution audits execute against chip numerics; refreshes
+    # BENCH_PERF.json with the platform=tpu record
+    {"name": "perf_introspect_tiny",
+     "cmd": _serving_cmd("tiny", ["--perf", "--requests", "16",
+                                  "--concurrency", "4",
+                                  "--prompt-len", "64",
+                                  "--max-tokens", "16",
+                                  "--out",
+                                  os.path.join(REPO, "BENCH_PERF.json")]),
+     "timeout": 1500, "first_timeout": 900},
     # 12. multi-LoRA mixed-batch overhead on chip (r4 feature): 1b config,
     #     4 adapters round-robin vs the plain 1b row above
     {"name": "serving_1b_lora4",

@@ -91,6 +91,8 @@ from .faults import (ChaosInjector, FaultConfig, HandoffChaos,
                      HandoffFaultConfig)
 from .kvstore import (KVStoreConfig, TieredKVStore, normalize_session_id,
                       pack_frame)
+from .perf import (CacheStats, FlopsModel, PerfLedger, ProfileStore,
+                   TickTimeline, WASTE_REASONS, platform_peak_flops)
 from .scheduler import (PRIORITY_RANK, QosScheduler, QueueEntry,
                         SchedulerConfig, normalize_priority)
 from .telemetry import (EngineTelemetry, FlightRecorder, RequestSpan,
@@ -233,6 +235,24 @@ class EngineConfig:
     # block).  None = SloConfig() defaults — tracking runs whenever
     # telemetry does, so slo_attainment_ratio{class,metric} always exports
     slo: "Optional[SloConfig]" = None
+    # ---- performance introspection (README "Performance introspection") -
+    # FLOPs/MFU accounting, goodput attribution, tick-phase timeline and
+    # cache analytics (perf.py).  None = follows ``telemetry``; the bench
+    # flips it independently to measure the plane's own overhead honestly.
+    perf: Optional[bool] = None
+    # rolling window the MFU / goodput gauges derive over
+    perf_window_s: float = 60.0
+    # per-tick phase-timeline ring capacity (bounded like the flight
+    # recorder: a long soak keeps the last N ticks, not all of them)
+    perf_timeline_capacity: int = 256
+    # managed jax.profiler artifact store (POST /engine/profile): capture
+    # dirs live under profile_dir (None: ENGINE_PROFILE_DIR env, else a
+    # per-pid tempdir), capped in count AND bytes with oldest-first
+    # eviction, and removed on stop() — profiles must not accumulate
+    # across engine lifecycles
+    profile_dir: Optional[str] = None
+    profile_max_runs: int = 8
+    profile_max_bytes: int = 256 << 20
     # deterministic chaos injection (faults.py) — test/bench substrate
     chaos: Optional[FaultConfig] = None
     # ---- QoS scheduling (README "Scheduling & QoS") ---------------------
@@ -333,6 +353,13 @@ class _Pending:
     # via the swap-resume path).  Any import failure degrades to plain
     # re-prefill — this flag routes that degradation instead of _fail_slot
     handoff_import: bool = False
+    # ---- perf introspection (README "Performance introspection") -------
+    # when set, this request's NEXT prefill is recomputing work that was
+    # already done somewhere (preempt_recompute / handoff_degraded /
+    # failover_reprefill): the perf ledger charges those prefill FLOPs as
+    # waste under this reason instead of goodput.  Decode commits after
+    # the prefill are fresh work and ignore it.
+    waste_reason: "Optional[str]" = None
 
 
 class _StaleThread(BaseException):
@@ -579,6 +606,30 @@ class Engine:
         self._handoff_chaos = (HandoffChaos(engine_config.handoff_chaos)
                                if engine_config.handoff_chaos is not None
                                else None)
+        # ---- performance introspection plane (perf.py, ISSUE 11) --------
+        # analytical FLOPs model + goodput ledger (charged at dispatch,
+        # attributed at commit), per-tick phase timeline, prefix-cache
+        # analytics, and the managed profiler artifact store.  The plane
+        # follows the telemetry switch unless overridden — the bench
+        # measures its own overhead by flipping `perf` alone.
+        self._perf_on = (engine_config.perf if engine_config.perf is not None
+                         else engine_config.telemetry)
+        plat, peak = platform_peak_flops(
+            jax.default_backend(),
+            getattr(jax.devices()[0], "device_kind", ""),
+            max(1, engine_config.tensor_parallel))
+        self._fm = FlopsModel(c, lora=self._lora)
+        self.perf = PerfLedger(
+            peak, plat, window_s=engine_config.perf_window_s,
+            on_charge=(self.telemetry.count_flops if self._perf_on
+                       else None))
+        self.timeline = TickTimeline(
+            capacity=engine_config.perf_timeline_capacity)
+        self.cache_analytics = CacheStats()
+        self.profiles = ProfileStore(
+            parent=engine_config.profile_dir,
+            max_runs=engine_config.profile_max_runs,
+            max_bytes=engine_config.profile_max_bytes)
         self.flight = FlightRecorder(
             capacity=engine_config.flight_recorder_capacity,
             dump_dir=engine_config.flight_dir)
@@ -598,6 +649,9 @@ class Engine:
         self._session_spans: "dict[str, tuple[str, str]]" = {}
         self._nan_dump_tick = -1  # last tick that produced a NaN dump
         self._profiler = TickProfiler()
+        # capture completion (loop thread) closes out the ProfileStore run
+        # record: artifacts get sized, count/byte caps evict oldest-first
+        self._profiler.on_complete = self._profile_complete
         self._wd_stop = threading.Event()
         self._wd_thread: Optional[threading.Thread] = None
         # loop threads record their epoch here; state-mutation points check
@@ -679,6 +733,8 @@ class Engine:
         # anything still in flight after the hard timeout: fail, don't hang
         # (the loop is joined: an uncommitted pipeline tick is dropped with
         # its requests, never committed into a closing batcher)
+        if self._inflight is not None:
+            self._charge_dropped(self._inflight, "tick_retry")
         self._inflight = None
         self._dec_state = None
         for slot in list(self._slot_req):
@@ -689,6 +745,9 @@ class Engine:
         # deletes its page files — nothing could ever recover them; an
         # explicit disk_dir keeps the session manifest for the next engine
         self._kv.close()
+        # managed profiler artifacts die with the engine (perf.py): scratch
+        # diagnostics nothing would ever reap once the process moves on
+        self.profiles.close()
         # exported-but-unpulled handoff frames die with the engine: their
         # handles are only routable to THIS process
         self._handoffs.clear()
@@ -738,7 +797,8 @@ class Engine:
                        handoff: bool = False,
                        kv_import=None,
                        trace=None,
-                       links: Optional[list] = None) -> Future:
+                       links: Optional[list] = None,
+                       waste_hint: Optional[str] = None) -> Future:
         """Submit a prompt; the Future resolves to a result dict.
 
         ``stream``: optional queue that receives each token id as it is
@@ -774,10 +834,19 @@ class Engine:
         starts without re-prefilling.  Any import problem — budget
         rejection here, blob lost or scatter failure later — silently
         degrades to a plain (prefix-cache-assisted) re-prefill.
+        ``waste_hint``: perf-ledger attribution (README "Performance
+        introspection") — the caller knows this request's prefill
+        recomputes work already done elsewhere (``failover_reprefill``
+        for an ingress failover re-admission, ``handoff_degraded`` for a
+        disaggregation import that fell back before submit); the charged
+        prefill FLOPs land under that waste reason instead of goodput.
         Raises EngineOverloaded when the queue is at ``max_queue_depth``
         and EngineShutdown once stop() has begun."""
         if not tokens:
             raise RequestError("empty prompt")
+        if waste_hint is not None and waste_hint not in WASTE_REASONS:
+            raise RequestError(f"unknown waste_hint {waste_hint!r} "
+                               f"(known: {WASTE_REASONS})")
         prio = normalize_priority(priority)
         if session_id is not None:
             session_id = normalize_session_id(session_id)
@@ -844,6 +913,7 @@ class Engine:
                 span=span,
                 priority=prio, rank=PRIORITY_RANK[prio],
                 rid=rid, session_id=session_id, handoff=handoff,
+                waste_reason=waste_hint,
             )
             if session_id is not None:
                 self._session_active[session_id] = rid
@@ -870,6 +940,9 @@ class Engine:
                 self.telemetry.count_handoff("import")
                 self.telemetry.count_handoff_bytes("in", int(nbytes))
             else:
+                # the decode replica will re-prefill work the prefill
+                # replica already did: waste, attributed
+                pending.waste_reason = "handoff_degraded"
                 self.telemetry.count_handoff("degraded")
         # the request now waits in the HOST scheduler queue; the engine
         # loop submits it to the C++ core only when the policy admits it
@@ -912,12 +985,13 @@ class Engine:
                  priority: Optional[str] = None,
                  session_id: Optional[str] = None,
                  handoff: bool = False, kv_import=None,
-                 trace=None, links: Optional[list] = None) -> dict:
+                 trace=None, links: Optional[list] = None,
+                 waste_hint: Optional[str] = None) -> dict:
         fut = self.generate_async(tokens, max_new_tokens, adapter=adapter,
                                   deadline=deadline, priority=priority,
                                   session_id=session_id, handoff=handoff,
                                   kv_import=kv_import, trace=trace,
-                                  links=links)
+                                  links=links, waste_hint=waste_hint)
         try:
             return fut.result(timeout=timeout)
         except FutureTimeoutError:
@@ -1011,7 +1085,8 @@ class Engine:
                         session_id: Optional[str] = None,
                         kv_import=None,
                         trace=None,
-                        links: Optional[list] = None) -> Iterator:
+                        links: Optional[list] = None,
+                        waste_hint: Optional[str] = None) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
 
         The last item yielded is the same dict ``generate`` returns (so
@@ -1027,7 +1102,8 @@ class Engine:
                                   adapter=adapter, deadline=deadline,
                                   priority=priority, session_id=session_id,
                                   kv_import=kv_import,
-                                  trace=trace, links=links)
+                                  trace=trace, links=links,
+                                  waste_hint=waste_hint)
 
         def _iter():
             while True:
@@ -1105,6 +1181,65 @@ class Engine:
         pin at finish simply re-creates the entry)."""
         return self._kv.drop_session(session_id)
 
+    # ------------------------------------------------ perf introspection API
+
+    def _kv_fragmentation(self) -> tuple:
+        """(owned_pages, committed_tokens, internal-fragmentation ratio)
+        over live decode slots: 1 - tokens / (pages * page_size).  High
+        fragmentation = many part-filled last pages — the page-geometry
+        signal the fleet KV fabric's placement will weigh."""
+        owned = int(np.count_nonzero(self._pt_host))
+        toks = int(self._len_host.sum())
+        if owned <= 0:
+            return 0, toks, 0.0
+        frag = 1.0 - toks / (owned * self.ec.page_size)
+        return owned, toks, max(0.0, min(1.0, frag))
+
+    def perf_snapshot(self) -> dict:
+        """The performance-introspection snapshot (``GET /engine/perf``):
+        the FLOPs/goodput ledger with exact waste attribution, windowed
+        MFU/goodput ratios, cache analytics (hit/miss by reason, page
+        occupancy + fragmentation, per-prefix reuse), the tick-phase
+        timeline tail, and the profiler run registry."""
+        snap = self.perf.snapshot()
+        snap["enabled"] = self._perf_on
+        owned, toks, frag = self._kv_fragmentation()
+        try:
+            cs = self.batcher.cache_stats()
+            free = self.batcher.free_pages
+        except RuntimeError:  # engine stopped
+            cs, free = {}, 0
+        total = max(1, self.ec.num_pages - 1)  # page 0 is the trash page
+        snap["cache"] = {
+            **self.cache_analytics.snapshot(),
+            **cs,
+            "free_pages": free,
+            "occupancy": round((total - free) / total, 6),
+            "owned_pages": owned,
+            "committed_tokens": toks,
+            "fragmentation": round(frag, 6),
+        }
+        snap["timeline"] = self.timeline.snapshot()
+        snap["profiler"] = {
+            "active": self._profiler.active,
+            "captures": self._profiler.captures,
+            "last_error": self._profiler.last_error,
+            "runs": self.profiles.snapshot(),
+        }
+        snap["spec"] = {"proposed": self._spec_proposed,
+                        "accepted": self._spec_accepted}
+        return snap
+
+    def refresh_perf_metrics(self) -> None:
+        """Scrape-time refresh of the derived perf gauges (MFU, goodput
+        ratio, KV fragmentation) — same right-when-read discipline as the
+        KV occupancy and SLO gauges (serve.metrics_text calls this)."""
+        if not self._perf_on:
+            return
+        _, _, frag = self._kv_fragmentation()
+        self.telemetry.set_perf(self.perf.mfu(), self.perf.goodput_ratio(),
+                                frag, self.perf.platform)
+
     # ---------------------------------------------------------- tracing API
 
     def trace(self, rid: int) -> Optional[dict]:
@@ -1165,14 +1300,42 @@ class Engine:
                        if p is not None and p.span is not None else None)
         return out
 
-    def trace_n_ticks(self, n: int, trace_dir: str) -> str:
+    def trace_n_ticks(self, n: int, trace_dir: Optional[str] = None) -> str:
         """Capture a jax.profiler (XLA) trace of the next ``n`` live engine
         ticks into ``trace_dir``.  Start/stop run on the loop thread at tick
         boundaries; returns immediately — poll ``profiler_active`` (or just
-        wait) for completion.  Raises if a capture is already in flight."""
-        self._profiler.request(n, trace_dir)
+        wait) for completion.  Raises if a capture is already in flight.
+
+        ``trace_dir=None`` (the ``POST /engine/profile`` path) captures
+        into a MANAGED dir from the ProfileStore: artifacts are byte+entry
+        capped with oldest-first eviction and removed on ``stop()``.
+        Explicit dirs stay caller-owned (recorded in the run history,
+        never deleted)."""
+        if self._stopped or not self._running:
+            # a dead loop never reaches a tick boundary: arming would
+            # wedge profiler_active True forever and leak the managed dir
+            # past the stop()-time cleanup that already ran
+            raise RuntimeError("engine is not running")
+        managed = trace_dir is None
+        if managed:
+            trace_dir = self.profiles.new_dir()
+        # register BEFORE arming and carry the record THROUGH the profiler
+        # as its ctx: a capture can start/complete on the loop thread the
+        # instant request() lands, and a side field would race it
+        rec = self.profiles.begin(trace_dir, n, managed)
+        try:
+            self._profiler.request(n, trace_dir, ctx=rec)
+        except BaseException:
+            self.profiles.discard(rec)  # refused: no orphan run record
+            raise
         self._wake.set()  # an idle loop still ticks; make sure it wakes now
         return trace_dir
+
+    def _profile_complete(self, error: Optional[str], rec) -> None:
+        """TickProfiler completion hook (loop thread): size the capture's
+        artifacts and apply the store's count/byte caps."""
+        if rec is not None:
+            self.profiles.complete(rec, error=error)
 
     @property
     def profiler_active(self) -> bool:
@@ -1257,6 +1420,46 @@ class Engine:
         self._prefill_batch_hist[rows] = self._prefill_batch_hist.get(rows, 0) + 1
         self.telemetry.observe_prefill_batch(rows)
 
+    # ------------------------------------------------ perf-ledger charging
+    # (perf.py, README "Performance introspection"): analytical FLOPs are
+    # charged where the dispatch OUTCOME is known, attributed goodput or
+    # waste in the same call — goodput + waste == dispatched is the
+    # ledger's construction, not a reconciliation.
+
+    def _charge_prefill_rows(self, slots: list, lens, off: int,
+                             ok, finishing=None) -> None:
+        """One fused prefill dispatch: each row charged at its REAL
+        position count this chunk (min(chunk, plen-off); padding lanes
+        are machine work, not requested work).  A row whose request
+        carries a waste_reason (preempt/handoff/failover recompute) lands
+        under that reason; a NaN-guarded FINISHING row's work is
+        discarded -> tick_retry."""
+        C = self.ec.prefill_chunk
+        for i, slot in enumerate(slots):
+            pending = self._requests.get(self._slot_req.get(slot))
+            if pending is None:
+                continue
+            toks = max(0, min(C, int(lens[i]) - off))
+            if toks <= 0:
+                continue
+            bad = (ok is not None and not ok[i]
+                   and (finishing is None or i in finishing))
+            self.perf.charge(
+                "prefill", self._fm.prefill_row(toks, off), toks,
+                "tick_retry" if bad else pending.waste_reason)
+
+    def _charge_dropped(self, rec: dict, reason: str) -> None:
+        """A pipelined tick whose results are being discarded wholesale
+        (watchdog restart / stop): its dispatched FLOPs were real device
+        work that produced nothing — waste under ``reason``."""
+        kind = "verify" if rec.get("kind") == "spec" else "decode"
+        for slot, f in (rec.get("flops") or {}).items():
+            if isinstance(f, tuple):
+                f, k = f
+            else:
+                k = 1
+            self.perf.charge(kind, f, k, reason)
+
     def _guard_logits(self, logits, row_rids, phase: str = "decode"):
         """Chaos NaN injection + the sample-path logit guard.
 
@@ -1316,6 +1519,12 @@ class Engine:
         sampled = np.asarray(
             sample_tokens(logits, self._next_key(), self.ec.temperature))
         ok = np.asarray(ok_dev) if ok_dev is not None else None
+        if self._perf_on:
+            # charge per ROW at the real prompt length (padding lanes are
+            # not work the request asked for); a recompute prefill
+            # (preempt/handoff/failover) lands under its waste reason, a
+            # NaN-tripped row's work is discarded -> tick_retry
+            self._charge_prefill_rows(slots, lens, 0, ok)
         now = time.perf_counter()
         for i, slot in enumerate(slots):
             if ok is not None and not ok[i]:
@@ -1395,6 +1604,13 @@ class Engine:
                 sample_tokens(logits, self._next_key(), self.ec.temperature))
             ok = np.asarray(ok_dev) if ok_dev is not None else None
             now = time.perf_counter()
+        if self._perf_on:
+            # each row advances min(C, plen-off) real positions attending
+            # over `off` of history; the NaN guard only adjudicates
+            # FINISHING rows here (mid-prompt rows fail at their final
+            # chunk), so only those can charge tick_retry
+            self._charge_prefill_rows(slots, lens, off, ok,
+                                      finishing=set(finishing))
         for i, slot in enumerate(slots):
             if i not in finishing:
                 self._prefilling[slot] = off + C
@@ -1494,6 +1710,12 @@ class Engine:
         self._check_epoch()
         now = time.perf_counter()
         did_work = False
+        # per-tick phase timeline (perf.py): admit covers reap + leftover
+        # drain + chaos/pool preemption + scheduler admission; the
+        # dispatch segments cover the fused device calls (the pipelined
+        # paths add readback/commit_behind/drain sub-segments from inside)
+        tl = self.timeline if self._perf_on else None
+        tp = now
 
         # --- eager queue reaping: deadline-expired queued requests shed
         # NOW, not when they reach the admission head — they were holding
@@ -1534,6 +1756,10 @@ class Engine:
         # --- scheduler admission: drain the host queue in policy order,
         # preempting a lower-priority decode slot when the head is blocked
         did_work |= self._admit_from_scheduler()
+        if tl is not None and (did_work or self._prefilling):
+            t = time.perf_counter()
+            tl.note(self._ticks, "admit", t - tp)
+            tp = t
 
         # --- fused prefill: group prefilling slots (short prompts by
         # bucket, long/cache-resumed ones by chunk offset) and issue ONE
@@ -1578,6 +1804,10 @@ class Engine:
                            self._prefill_chunk_group, chunked[off], off,
                            shape={"rows": len(chunked[off]), "offset": off,
                                   "chunk": self.ec.prefill_chunk})
+        if tl is not None and (shorts or chunked):
+            t = time.perf_counter()
+            tl.note(self._ticks, "prefill_dispatch", t - tp)
+            tp = t
 
         # --- one decode step over slots whose prefill is complete
         # (_slot_req membership == slot active; no C snapshot needed)
@@ -1616,6 +1846,9 @@ class Engine:
                                    self._decode_tick_pipelined, decode_ready,
                                    shape={"rows": len(decode_ready),
                                           "pipelined": True})
+                if tl is not None:
+                    tl.note(self._ticks, "decode_dispatch",
+                            time.perf_counter() - tp)
                 return did_work
             # host mirrors ARE the decode view: mid-prefill slots hold
             # len 0 / trash rows by construction (_activate_decode)
@@ -1635,6 +1868,9 @@ class Engine:
                                self._decode_tick_single, decode_ready,
                                seq_lens, page_table,
                                shape={"rows": len(decode_ready)})
+            if tl is not None:
+                tl.note(self._ticks, "decode_dispatch",
+                        time.perf_counter() - tp)
         elif self._inflight is not None:
             # the roster drained to empty behind the last dispatch (every
             # row finished at commit-behind): retire the in-flight tick —
@@ -1685,6 +1921,19 @@ class Engine:
                 f"{time.perf_counter() - pending.submitted_at:.3f}s "
                 "in queue"), shed=True)
             return
+        if self._perf_on and not pending.swapped:
+            # cache analytics (perf.py): admission is the one point where
+            # requested vs granted prefix-cache pages are both known.
+            # Reuse keys on the deepest matched chain hash — a unique
+            # identity for the whole reused prefix.
+            n_lookup = min(max(0, (plen - 1) // self.ec.page_size),
+                           len(pending.page_hashes))
+            if n_lookup > 0:
+                key = (int(pending.page_hashes[cached - 1])
+                       if cached > 0 else None)
+                self.cache_analytics.note_lookup(n_lookup, cached, key)
+                self.telemetry.count_cache_pages(n_lookup,
+                                                 min(cached, n_lookup))
         if pending.swapped:
             item = self._kv.pop_swap(rid, count=not pending.handoff_import)
             if item is not None:
@@ -1702,6 +1951,7 @@ class Engine:
                         # and prefill overwrites whatever the partial
                         # scatter touched.  "Never a failed request."
                         pending.swapped = False
+                        pending.waste_reason = "handoff_degraded"
                         self.telemetry.count_handoff("degraded")
                         if self.ec.telemetry:
                             self._flight_event(
@@ -1723,7 +1973,14 @@ class Engine:
                 # still correct
                 pending.swapped = False
                 if pending.handoff_import:
+                    pending.waste_reason = "handoff_degraded"
                     self.telemetry.count_handoff("degraded")
+                else:
+                    # the cold re-prefill below recomputes positions this
+                    # engine already computed once — same attribution as
+                    # the drop-preempt path, and it matters most exactly
+                    # when swap pressure is evicting blobs
+                    pending.waste_reason = "preempt_recompute"
         # cache-hit pages already hold the prefix KV: prefill resumes
         # at the first uncovered position.  A session's FIRST admission
         # additionally restores pinned prefix pages from the tiered store
@@ -2042,6 +2299,11 @@ class Engine:
             pending.page_hashes = self._page_hashes(
                 pending.context, pending.adapter_id)
             release_hashes = pending.page_hashes[:max(0, (L - 1) // ps)]
+            # the resume re-prefill recomputes positions this engine
+            # already computed once — waste, attributed (the cache-hit
+            # share of the re-prefill is never dispatched, so only the
+            # genuinely recomputed positions get charged)
+            pending.waste_reason = "preempt_recompute"
         pending.preemptions += 1
         self._preemptions += 1
         self._reset_failures(pending)
@@ -2367,6 +2629,14 @@ class Engine:
         sampled = np.asarray(
             sample_tokens(logits, self._next_key(), self.ec.temperature))
         ok = np.asarray(ok_dev) if ok_dev is not None else None
+        if self._perf_on:
+            for slot in decode_ready:
+                if self._slot_req.get(slot) not in self._requests:
+                    continue
+                bad = ok is not None and not ok[slot]
+                self.perf.charge("decode",
+                                 self._fm.decode_row(int(seq_lens[slot])),
+                                 1, "tick_retry" if bad else None)
         for slot in decode_ready:
             if ok is not None and not ok[slot]:
                 self._fail_nan(slot, f"decode row (slot {slot})")
@@ -2430,7 +2700,11 @@ class Engine:
         if rec is None:
             return
         self._count_fence(reason)
+        t0 = time.perf_counter() if self._perf_on else 0.0
         self._commit_inflight(rec)
+        if self._perf_on:
+            self.timeline.note(self._ticks, "drain",
+                               time.perf_counter() - t0)
 
     def _discard_pipeline(self) -> None:
         """Drop pipeline state WITHOUT committing (watchdog restart / stop:
@@ -2439,6 +2713,8 @@ class Engine:
         a hung dispatch forever)."""
         if self._inflight is not None:
             self._count_fence("restart")
+            # dispatched, never committed: real device work, discarded
+            self._charge_dropped(self._inflight, "tick_retry")
         self._inflight = None
         self._dec_state = None
         self._roster_dirty = True
@@ -2453,16 +2729,37 @@ class Engine:
         if rec.get("kind") == "spec":
             self._commit_inflight_spec(rec)
             return
+        perf = self._perf_on
+        t0 = time.perf_counter() if perf else 0.0
         sampled = np.asarray(rec["sampled"])  # async copy started at dispatch
+        if perf:
+            self.timeline.note(self._ticks, "readback",
+                               time.perf_counter() - t0)
+            t0 = time.perf_counter()
+        fl = rec.get("flops") or {}
         for slot in rec["slots"]:
             rid = rec["rids"][slot]
             if self._slot_req.get(slot) != rid or rid not in self._requests:
-                continue  # finished/failed/preempted behind the dispatch
+                # finished/failed/preempted behind the dispatch: the row's
+                # device work is discarded by the rid guard
+                f = fl.get(slot)
+                if f:
+                    self.perf.charge("decode", f, 1, "pipeline_drop")
+                continue
             tok = int(sampled[slot])
             if tok < 0:  # guard encoding: -token - 1 == non-finite row
+                f = fl.get(slot)
+                if f:
+                    self.perf.charge("decode", f, 1, "tick_retry")
                 self._fail_nan(slot, f"pipelined decode row (slot {slot})")
                 continue
+            f = fl.get(slot)
+            if f:
+                self.perf.charge("decode", f, 1, None)
             self._commit(slot, tok)
+        if perf:
+            self.timeline.note(self._ticks, "commit_behind",
+                               time.perf_counter() - t0)
 
     # -------------------------------------------- pipelined speculative loop
 
@@ -2493,7 +2790,11 @@ class Engine:
         — a row finished (EOS / budget) or tripped the NaN guard, so its
         release/fail must land before the next dispatch's snapshot — with
         ``rec["fence_reason"]`` set to the postmortem-relevant label."""
+        t0 = time.perf_counter() if self._perf_on else 0.0
         packed = np.asarray(rec["packed"])
+        if self._perf_on:
+            self.timeline.note(self._ticks, "readback",
+                               time.perf_counter() - t0)
         rec["packed_np"] = packed
         reason = None
         shadow = None
@@ -2529,19 +2830,29 @@ class Engine:
         here, context append included.  A sentinel (NaN-guarded) row fails
         only its own slot, exactly like the sync verify's whole-pass
         check."""
+        perf = self._perf_on
+        t0 = time.perf_counter() if perf else 0.0
         packed = rec.get("packed_np")
         if packed is None:
             packed = np.asarray(rec["packed"])
+        fl = rec.get("flops") or {}
         for slot in rec["slots"]:
+            f_row, k_i = fl.get(slot, (0.0, 1))
             rid = rec["rids"][slot]
             if self._slot_req.get(slot) != rid or rid not in self._requests:
-                continue  # finished/failed/preempted behind the dispatch
+                # finished/failed/preempted behind the dispatch: the row's
+                # device work is discarded by the rid guard
+                if f_row:
+                    self.perf.charge("verify", f_row, k_i, "pipeline_drop")
+                continue
             pending = self._requests[rid]
             toks = rec["staged"].get(slot)
             staged = toks is not None
             if not staged:
                 toks = self._accepted_row(pending, packed[slot])
             if not toks:
+                if f_row:
+                    self.perf.charge("verify", f_row, k_i, "tick_retry")
                 rec["staged"].pop(slot, None)
                 self._fail_nan(slot, f"fused verify row (slot {slot})")
                 continue
@@ -2567,12 +2878,23 @@ class Engine:
                     # un-stage the tail so context stays exactly prompt +
                     # generated (preempt/pin snapshots read it)
                     del pending.context[-len(rest):]
+            if f_row:
+                # committed positions' share is goodput; the remainder
+                # (rejected drafts / early-EOS tail) is spec_reject
+                good = f_row * min(committed, k_i) / k_i
+                self.perf.charge("verify", good, committed, None)
+                if k_i > committed:
+                    self.perf.charge("verify", f_row - good,
+                                     k_i - committed, "spec_reject")
             # accepted draft tokens = committed minus the bonus/correction
             # token (the sync walk's per-token increment, summed)
             acc = max(0, committed - 1)
             self._spec_accepted += acc
             if d:
                 self.telemetry.observe_spec(len(d), acc)
+        if perf:
+            self.timeline.note(self._ticks, "commit_behind",
+                               time.perf_counter() - t0)
 
     def _cover_row0(self, slot: int, S: int) -> bool:
         """Commit-behind page accounting for the speculative pipeline: a
@@ -2744,10 +3066,18 @@ class Engine:
                     sampled.copy_to_host_async()
                 except Exception:  # noqa: BLE001 — best-effort prefetch
                     pass
-            prev, self._inflight = self._inflight, {
+            rec = {
                 "sampled": sampled, "slots": tuple(decode_ready),
                 "rids": {s: self._slot_req[s] for s in decode_ready},
             }
+            if self._perf_on:
+                # FLOPs priced at dispatch (the shadow lens this dispatch
+                # used), attributed at commit-behind when the outcome per
+                # row is known
+                rec["flops"] = {
+                    s: self._fm.decode_row(int(self._dec_lens_shadow[s]))
+                    for s in decode_ready}
+            prev, self._inflight = self._inflight, rec
             self._dec_state = sampled
             self._dec_lens_shadow = np.where(
                 self._dec_lens_shadow > 0, self._dec_lens_shadow + 1, 0)
@@ -2914,12 +3244,22 @@ class Engine:
                     packed.copy_to_host_async()
                 except Exception:  # noqa: BLE001 — best-effort prefetch
                     pass
-            prev2, self._inflight = prev, {
+            rec = {
                 "kind": "spec", "packed": packed,
                 "slots": tuple(decode_ready),
                 "rids": {s: self._slot_req[s] for s in decode_ready},
                 "drafts": by_slot, "staged": {},
             }
+            if self._perf_on:
+                # (flops, k) per row priced at dispatch — k = 1 committed
+                # + real drafts (padding verify lanes are not requested
+                # work); attributed goodput/spec_reject at commit-behind
+                rec["flops"] = {
+                    s: (self._fm.verify_row(int(shadow[s]),
+                                            int(dlen[s]) + 1),
+                        int(dlen[s]) + 1)
+                    for s in decode_ready}
+            prev2, self._inflight = prev, rec
             self._dec_state = packed
             if prev2 is not None:
                 # commit-behind: tick N's 1..K tokens per slot land while
@@ -3062,7 +3402,13 @@ class Engine:
         )).reshape(B, K)
         ok = np.asarray(ok_dev) if ok_dev is not None else None
         for slot in decode_ready:
+            k_i = 1 + len(drafts.get(slot) or [])
+            f_row = (self._fm.verify_row(int(seq_lens[slot]), k_i)
+                     if self._perf_on else 0.0)
             if ok is not None and not ok[slot]:
+                if self._perf_on:
+                    # the whole poisoned pass is discarded work
+                    self.perf.charge("verify", f_row, k_i, "tick_retry")
                 # any of the slot's K verify rows non-finite: fail the slot
                 # before committing anything from the poisoned pass
                 self._fail_nan(slot, f"speculative verify (slot {slot})")
@@ -3070,9 +3416,11 @@ class Engine:
             d = drafts.get(slot) or []
             self._spec_proposed += len(d)
             acc = 0
+            committed = 0
             for j in range(len(d) + 1):
                 tok = int(sampled[slot, j])
                 rc = self._commit(slot, tok)
+                committed += 1
                 if rc != 1:
                     break  # finished / truncated: slot already released
                 # logits[j+1] is only valid if the input at that row (the
@@ -3081,6 +3429,15 @@ class Engine:
                     break
                 self._spec_accepted += 1
                 acc += 1
+            if self._perf_on and f_row > 0:
+                # committed positions' share is goodput; the remainder —
+                # rejected drafts (and the tail of an early EOS) — is the
+                # speculation tax, attributed spec_reject
+                good = f_row * committed / k_i
+                self.perf.charge("verify", good, committed, None)
+                if k_i > committed:
+                    self.perf.charge("verify", f_row - good,
+                                     k_i - committed, "spec_reject")
             if d:
                 self.telemetry.observe_spec(len(d), acc)
 

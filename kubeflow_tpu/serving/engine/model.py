@@ -91,6 +91,35 @@ class DecoderConfig:
         )
         return self.vocab_size * self.d_model * 2 + self.n_layers * per_layer + self.d_model
 
+    # ------------------------------------------------ analytical FLOPs model
+    # (serving/engine/perf.py, README "Performance introspection"): matmul
+    # FLOPs only, 2*mul-adds, mirroring bench.py/bert.train_flops accounting
+    # — norms, RoPE, softmax and activation flops are noise next to the
+    # matmuls and deliberately excluded so MFU numbers compare across the
+    # repo's training and serving planes.
+
+    def matmul_flops_per_token(self) -> int:
+        """Forward matmul FLOPs for ONE token through every projection +
+        the unembed — everything except attention-score/value math (which
+        scales with context length; see ``attn_flops_per_token``).  The
+        embedding gather is a lookup, not a matmul, and counts 0."""
+        hd = self.head_dim
+        per_layer = 2 * (
+            self.d_model * self.n_heads * hd           # wq
+            + 2 * self.d_model * self.n_kv_heads * hd  # wk, wv
+            + self.n_heads * hd * self.d_model         # wo
+            + 3 * self.d_model * self.d_ff             # w1, w3, w2
+        )
+        return self.n_layers * per_layer + 2 * self.d_model * self.vocab_size
+
+    def attn_flops_per_token(self, context: int) -> int:
+        """Attention score (QK^T) + value (AV) FLOPs for one token
+        attending over ``context`` positions, all layers: 2*2*S*hd per
+        head per layer.  GQA shares K/V heads but every QUERY head still
+        does its own score/value matmuls, so n_heads (not n_kv_heads) is
+        the multiplier."""
+        return self.n_layers * 4 * self.n_heads * self.head_dim * context
+
 
 def init(key: jax.Array, config: DecoderConfig, dtype=jnp.bfloat16) -> dict:
     """Random-init params (serving benches use these; loaders overwrite)."""

@@ -336,6 +336,9 @@ class JetStreamModel(Model):
             # windows at scrape time — same "right when read" discipline
             # as the occupancy gauges above
             self.engine.telemetry.refresh_slo()
+            # perf-introspection derived gauges (README "Performance
+            # introspection"): windowed MFU/goodput + KV fragmentation
+            self.engine.refresh_perf_metrics()
         except RuntimeError:  # engine stopped
             return ""
         from ...core.metrics import add_const_labels
@@ -345,6 +348,34 @@ class JetStreamModel(Model):
         # scraper would reject wholesale
         return add_const_labels(self.engine.telemetry.render(),
                                 {"model": self.name})
+
+    def perf_snapshot(self) -> dict:
+        """The engine's performance-introspection snapshot — FLOPs/MFU
+        ledger with waste attribution, cache analytics, tick-phase
+        timeline, profiler runs — served as ``GET /engine/perf``
+        (server.py).  Empty-but-valid once the engine is gone: a perf
+        read must never 500 a replica."""
+        if self.engine is None:
+            return {"enabled": False}
+        try:
+            return self.engine.perf_snapshot()
+        except Exception:  # noqa: BLE001 — introspection must answer
+            return {"enabled": False}
+
+    def start_profile(self, ticks: int, trace_dir: Optional[str] = None) -> dict:
+        """Arm an on-demand jax.profiler capture of the next ``ticks``
+        live engine ticks (``POST /engine/profile``).  Artifacts land in
+        a MANAGED store dir (byte/entry-capped, cleaned on engine stop)
+        unless ``trace_dir`` pins them somewhere caller-owned.  Raises
+        RuntimeError (-> 409) while a capture is in flight and
+        RequestError (-> 400) on a bad tick count."""
+        if self.engine is None:
+            raise RuntimeError("no engine to profile")
+        try:
+            d = self.engine.trace_n_ticks(int(ticks), trace_dir)
+        except ValueError as e:
+            raise RequestError(str(e)) from e
+        return {"dir": d, "ticks": int(ticks), "started": True}
 
     def trace_spans(self, trace_id: str) -> dict:
         """Engine spans + flight-dump references for one distributed trace
@@ -554,7 +585,12 @@ class JetStreamModel(Model):
                                  deadline=deadline, priority=priority,
                                  session_id=session,
                                  trace=self._trace_ctx(headers),
-                                 links=self._resume_link(headers))
+                                 links=self._resume_link(headers),
+                                 # a failover re-admission re-prefills
+                                 # tokens the dead replica already
+                                 # produced: waste, attributed
+                                 waste_hint=("failover_reprefill"
+                                             if resume else None))
         # the seam slices at the STABLE prefix of the resumed text: resume
         # ids may end mid-UTF-8 sequence, whose completed decoding spans a
         # different char count than its U+FFFD placeholders (same rule as
@@ -712,7 +748,13 @@ class JetStreamModel(Model):
                                  deadline=deadline, priority=priority,
                                  session_id=session, kv_import=imp,
                                  trace=self._trace_ctx(headers),
-                                 links=self._resume_link(headers))
+                                 links=self._resume_link(headers),
+                                 # import already degraded before submit:
+                                 # the re-prefill redoes the prefill
+                                 # replica's work (engine-side failures
+                                 # after submit attribute themselves)
+                                 waste_hint=(None if imp is not None
+                                             else "handoff_degraded"))
         out_ids = list(prior) + r["tokens"]
         out = {"text_output": self.tokenizer.decode(out_ids),
                "token_ids": out_ids,
@@ -795,7 +837,9 @@ class JetStreamModel(Model):
                 ids + prior, max_tokens - len(prior), adapter=adapter,
                 deadline=deadline, priority=priority, session_id=session,
                 kv_import=imp, trace=self._trace_ctx(headers),
-                links=self._resume_link(headers))
+                links=self._resume_link(headers),
+                waste_hint=(None if imp is not None
+                            else "handoff_degraded"))
             # prior_emitted=False: handoff tokens were generated elsewhere
             # but never DELIVERED — their text (and ids, for the failover
             # relay) go out with the first events.  The pull's wall time
@@ -816,7 +860,9 @@ class JetStreamModel(Model):
                                              priority=priority,
                                              session_id=session,
                                              trace=self._trace_ctx(headers),
-                                             links=self._resume_link(headers))
+                                             links=self._resume_link(headers),
+                                             waste_hint=("failover_reprefill"
+                                                         if resume else None))
         return self._stream_pieces(stream, ids, max_tokens,
                                    with_trace=self._wants_trace(headers),
                                    emit_ids=emit_ids, prior_ids=resume)

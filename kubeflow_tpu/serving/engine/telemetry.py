@@ -360,6 +360,38 @@ class EngineTelemetry:
             "engine_trace_evictions_total",
             "request spans evicted from the bounded trace history "
             "(entry or byte budget)")
+        # Performance introspection plane (ISSUE 11, perf.py): analytical
+        # model FLOPs charged at dispatch time by kind, the waste share by
+        # attribution reason (goodput + waste == dispatched by the
+        # ledger's construction), and the scrape-time derived gauges —
+        # windowed MFU against the platform peak-FLOPs table, windowed
+        # goodput ratio, and KV internal fragmentation.  Cache analytics:
+        # prefix-cache page outcomes per admission lookup.
+        self.flops_total = r.counter(
+            "engine_model_flops_total",
+            "analytical model FLOPs charged at dispatch, by kind "
+            "(prefill/decode/verify)")
+        self.wasted_flops = r.counter(
+            "engine_wasted_flops_total",
+            "dispatched FLOPs attributed to waste, by reason "
+            "(spec_reject/preempt_recompute/handoff_degraded/"
+            "failover_reprefill/tick_retry/pipeline_drop)")
+        self.mfu_ratio = r.gauge(
+            "engine_mfu_ratio",
+            "rolling-window analytical model-FLOPs utilization vs the "
+            "platform peak (perf.platform_peak_flops), by platform label")
+        self.goodput_ratio = r.gauge(
+            "engine_goodput_ratio",
+            "rolling-window goodput FLOPs / dispatched FLOPs "
+            "(1.0 = nothing wasted)")
+        self.kv_fragmentation = r.gauge(
+            "engine_kv_fragmentation_ratio",
+            "internal fragmentation of live KV pages: 1 - committed "
+            "tokens / (owned pages * page_size), set at scrape")
+        self.prefix_cache_pages = r.counter(
+            "engine_prefix_cache_pages_total",
+            "prefix-cache page lookup outcomes at admission "
+            "(hit/miss_cold/miss_partial)")
 
     # Observe methods stay branch-cheap: one attribute check, then a dict
     # op under the metric's own lock.
@@ -399,6 +431,35 @@ class EngineTelemetry:
     def count_preemption(self, reason: str, mode: str) -> None:
         if self.enabled:
             self.preemptions.inc(reason=reason, mode=mode)
+
+    def count_flops(self, kind: str, flops: float,
+                    reason: Optional[str] = None) -> None:
+        """PerfLedger charge hook: dispatched FLOPs by kind, waste share
+        by reason.  Exposition mirrors the ledger exactly because the
+        ledger CALLS this per charge — the /metrics counters and the
+        /engine/perf snapshot can never disagree."""
+        if self.enabled:
+            self.flops_total.inc(flops, kind=kind)
+            if reason is not None:
+                self.wasted_flops.inc(flops, reason=reason)
+
+    def count_cache_pages(self, requested: int, hit: int) -> None:
+        if not self.enabled or requested <= 0:
+            return
+        if hit > 0:
+            self.prefix_cache_pages.inc(hit, outcome="hit")
+        if hit < requested:
+            outcome = "miss_partial" if hit > 0 else "miss_cold"
+            self.prefix_cache_pages.inc(requested - hit, outcome=outcome)
+
+    def set_perf(self, mfu: float, goodput: float, fragmentation: float,
+                 platform: str) -> None:
+        """Scrape-time derived gauges (serve.metrics_text refreshes them
+        alongside the KV/SLO gauges — right when read, not per tick)."""
+        if self.enabled:
+            self.mfu_ratio.set(mfu, platform=platform)
+            self.goodput_ratio.set(goodput)
+            self.kv_fragmentation.set(fragmentation)
 
     def count_swap(self, direction: str, nbytes: int) -> None:
         if self.enabled:
@@ -501,14 +562,24 @@ class TickProfiler:
         self._remaining: Optional[int] = None
         self.last_error: Optional[str] = None
         self.captures = 0
+        # called (loop thread) when a capture finishes or fails to start,
+        # as ``on_complete(error_or_None, ctx)`` where ``ctx`` is the
+        # opaque value the arming ``request`` carried — the engine's
+        # ProfileStore sizes and caps the artifacts from here.  Carrying
+        # the ctx THROUGH the profiler (instead of a side field on the
+        # engine) closes the race where a capture completes on the loop
+        # thread before the arming thread records which run it was.
+        self.on_complete = None
+        self._ctx = None
 
-    def request(self, n_ticks: int, trace_dir: str) -> None:
+    def request(self, n_ticks: int, trace_dir: str, ctx=None) -> None:
         if n_ticks <= 0:
             raise ValueError("n_ticks must be positive")
         with self._lock:
             if self._pending is not None or self._remaining is not None:
                 raise RuntimeError("a profiler capture is already in flight")
             self._pending = (n_ticks, trace_dir)
+            self._ctx = ctx
 
     @property
     def active(self) -> bool:
@@ -531,6 +602,9 @@ class TickProfiler:
             self.last_error = f"{type(e).__name__}: {e}"
             with self._lock:
                 self._remaining = None
+                ctx, self._ctx = self._ctx, None
+            if self.on_complete is not None:
+                self.on_complete(self.last_error, ctx)
 
     def on_tick_end(self, tick: int, did_work: bool) -> None:
         with self._lock:
@@ -540,15 +614,19 @@ class TickProfiler:
                 self._remaining -= 1
             if self._remaining > 0:
                 return
+        err = None
         try:
             import jax
 
             jax.profiler.stop_trace()
             self.captures += 1
         except Exception as e:  # noqa: BLE001
-            self.last_error = f"{type(e).__name__}: {e}"
+            err = self.last_error = f"{type(e).__name__}: {e}"
         finally:
             # deactivate only AFTER stop_trace has run: `active` going False
             # is the caller-visible "capture finished" signal
             with self._lock:
                 self._remaining = None
+                ctx, self._ctx = self._ctx, None
+            if self.on_complete is not None:
+                self.on_complete(err, ctx)

@@ -165,6 +165,13 @@ class _ProxyState:
         # all-distinct prompts made 2 replicas no faster than 1).
         # Insertion-ordered; capped in _pick_engine_aware.
         self.affinity: dict[str, int] = {}
+        # fleet cache view (README "Performance introspection"): replica
+        # name -> last-known cache analytics from GET /engine/perf, the
+        # read-only global cache state ROADMAP item 3's placement will
+        # consume.  Stale entries carry their age; entries for pods that
+        # left the service are PRUNED on every refresh (pod churn must
+        # not leave phantom cache capacity in the view).
+        self.cache_view: dict[str, dict] = {}
         # fleet fault tolerance: per-backend health records + the set of
         # ports some thread is actively probing outside the lock (single-
         # flight, same discipline as `refreshing` above)
@@ -241,6 +248,9 @@ class ServiceProxy:
                         return
                     if path == "/fleet/metrics":
                         proxy._serve_fleet_metrics(self, state)
+                        return
+                    if path == "/fleet/cache":
+                        proxy._serve_fleet_cache(self, state)
                         return
                 proxy._relay(self, state, body)
 
@@ -930,19 +940,24 @@ class ServiceProxy:
         return sorted(out)
 
     def _fan_out(self, pods: list, path: str) -> dict:
-        """Concurrently GET ``path`` from every replica; {name: parsed
-        body or None}.  One slow replica costs the fan-out timeout once,
-        not once per replica."""
+        """Concurrently GET ``path`` from every replica; {name: (body or
+        None, latency_s)}.  One slow replica costs the fan-out timeout
+        once, not once per replica — and its latency is REPORTED: the
+        fleet-metrics header carries per-replica scrape latency, so a
+        slow-but-alive replica is visible before it trips the health
+        FSM."""
         results: dict = {}
 
         def fetch(name: str, port: int) -> None:
+            t0 = time.perf_counter()
             try:
                 with urllib.request.urlopen(
                         f"http://127.0.0.1:{port}{path}",
                         timeout=self._FANOUT_TIMEOUT_S) as r:
-                    results[name] = r.read()
+                    body = r.read()
             except Exception:  # noqa: BLE001 — unreachable replica
-                results[name] = None
+                body = None
+            results[name] = (body, time.perf_counter() - t0)
 
         ts = [threading.Thread(target=fetch, args=(n, p)) for n, p in pods]
         for t in ts:
@@ -962,7 +977,7 @@ class ServiceProxy:
         dumps: list = []
         pods = self._service_pods(state)
         unreachable: list = []
-        for name, raw in sorted(self._fan_out(
+        for name, (raw, _lat) in sorted(self._fan_out(
                 pods, f"/engine/trace/{trace_id}").items()):
             if raw is None:
                 unreachable.append(name)
@@ -993,7 +1008,9 @@ class ServiceProxy:
         pods = self._service_pods(state)
         texts: dict = {}
         unreachable: list = []
-        for name, raw in self._fan_out(pods, "/metrics").items():
+        lat: dict = {}
+        for name, (raw, elapsed) in self._fan_out(pods, "/metrics").items():
+            lat[name] = elapsed
             if raw is None:
                 unreachable.append(name)
             else:
@@ -1002,9 +1019,74 @@ class ServiceProxy:
                   f"of {state.service_name} merged")
         if unreachable:
             header += f"; unreachable: {','.join(sorted(unreachable))}"
+        if lat:
+            # per-replica scrape latency: a SLOW (not dead) replica shows
+            # up here long before it trips the health FSM — unreachable
+            # names report the timeout they burned
+            header += "\n# scrape_seconds: " + ",".join(
+                f"{n}={lat[n]:.4f}" for n in sorted(lat))
         body = header + "\n" + merge_expositions(texts)
         handler._reply(200, body.encode(),
                        "text/plain; version=0.0.4")
+
+    def _serve_fleet_cache(self, handler, state: _ProxyState) -> None:
+        """GET /fleet/cache: the read-only per-replica fleet cache view
+        (README "Performance introspection") — every replica's
+        prefix-cache analytics (hit/miss by reason, page occupancy,
+        fragmentation, per-prefix reuse) from its ``GET /engine/perf``,
+        plus the MFU/goodput headline per replica.  A replica that fails
+        this refresh serves its LAST-KNOWN view annotated with its age
+        (a momentary scrape miss must not make a warm replica look cold
+        to a cache-aware placer); entries for pods that left the service
+        are pruned — the fleet KV fabric's placement input (ROADMAP
+        item 3), deliberately read-only here."""
+        pods = self._service_pods(state)
+        live = {n for n, _ in pods}
+        now = time.time()
+        unreachable: list = []
+        fresh: dict = {}
+        # the slim cache view (?view=cache): the full /engine/perf
+        # snapshot carries timeline tails and profiler run histories the
+        # placer never reads — fetching them per replica per poll would
+        # scale the poll cost with perf_timeline_capacity for nothing
+        for name, (raw, elapsed) in self._fan_out(
+                pods, "/engine/perf?view=cache").items():
+            rec = None
+            if raw is not None:
+                try:
+                    body = json.loads(raw)
+                    models = body.get("models") or {}
+                    rec = {"fetched_at": now, "scrape_s": round(elapsed, 4),
+                           "models": {
+                               mn: {"cache": ms.get("cache") or {},
+                                    "mfu": ms.get("mfu"),
+                                    "goodput_ratio": ms.get("goodput_ratio"),
+                                    "platform": ms.get("platform")}
+                               for mn, ms in models.items()}}
+                except ValueError:
+                    rec = None
+            if rec is not None:
+                fresh[name] = rec
+            else:
+                unreachable.append(name)
+        out = {}
+        with state.lock:  # cache_view is shared proxy state, like health
+            state.cache_view.update(fresh)
+            # pod-churn pruning: a deleted/recreated replica must not
+            # haunt the view as phantom cache capacity
+            for name in list(state.cache_view):
+                if name not in live:
+                    del state.cache_view[name]
+            for name, rec in sorted(state.cache_view.items()):
+                out[name] = {**rec,
+                             "age_s": round(now - rec["fetched_at"], 3),
+                             "stale": name in unreachable}
+        handler._reply(200, json.dumps({
+            "service": state.service_name,
+            "replicas": out,
+            "replicas_queried": [n for n, _ in pods],
+            "replicas_unreachable": sorted(unreachable),
+        }).encode())
 
     # --------------------------------------------------- backend health FSM
 

@@ -264,6 +264,32 @@ class ModelServer:
         elif path == "/engine/health":
             code, body = self._engine_health()
             h._send(code, body)
+        elif path == "/engine/perf":
+            # performance introspection (README "Performance
+            # introspection"): per-model FLOPs/MFU/goodput ledger, cache
+            # analytics, tick-phase timeline, profiler runs.  Always 200
+            # — a perf read must never take a replica down; models
+            # without a perf surface simply don't appear.  ``?view=cache``
+            # answers the slim subset the proxy's fleet cache view polls
+            # (cache block + MFU/goodput headline) — the timeline tail and
+            # profiler history would otherwise ride every poll for nothing.
+            query = h.path.partition("?")[2]
+            slim = "view=cache" in query.split("&")
+            out = {}
+            for name, m in self.models.items():
+                fn = getattr(m, "perf_snapshot", None)
+                if not callable(fn):
+                    continue
+                try:
+                    snap = fn()
+                except Exception:  # noqa: BLE001 — introspection answers
+                    snap = {"enabled": False}
+                if slim:
+                    snap = {k: snap.get(k) for k in
+                            ("enabled", "platform", "mfu", "goodput_ratio",
+                             "cache")}
+                out[name] = snap
+            h._send(200, {"models": out})
         elif path.startswith("/engine/trace/"):
             # replica-local spans for one distributed trace id: every
             # model contributes (engine-backed ones hold RequestSpans;
@@ -379,6 +405,8 @@ class ModelServer:
                 self._openai(h, chat=False)
             elif path == "/openai/v1/chat/completions":
                 self._openai(h, chat=True)
+            elif path.rstrip("/") == "/engine/profile":
+                self._engine_profile(h)
             else:
                 h._send(404, {"error": f"no route {path}"})
         except RequestError as e:
@@ -413,6 +441,45 @@ class ModelServer:
             h._send(500, {"error": f"{type(e).__name__}: {e}"})
         finally:
             self.metrics.finish(t0)
+
+    def _engine_profile(self, h) -> None:
+        """POST /engine/profile: arm an on-demand jax.profiler capture —
+        ``{"ticks": N, "model": optional, "dir": optional}`` — wrapping
+        ``Engine.trace_n_ticks``.  Artifacts land in the engine's managed
+        ProfileStore (byte/entry-capped, cleaned on stop) unless ``dir``
+        pins a caller-owned path.  409 while a capture is in flight (one
+        at a time per engine)."""
+        body = h._body() or {}
+        ticks = body.get("ticks", 8)
+        if not isinstance(ticks, int) or ticks < 1:
+            raise RequestError(
+                f"ticks must be a positive integer, got {ticks!r}")
+        trace_dir = body.get("dir")
+        if trace_dir is not None and not isinstance(trace_dir, str):
+            raise RequestError(f"dir must be a string, got {trace_dir!r}")
+        name = body.get("model")
+        if name is None:
+            capable = [n for n, m in self.models.items()
+                       if callable(getattr(m, "start_profile", None))]
+            if len(capable) != 1:
+                raise RequestError(
+                    "model required (profile-capable models: "
+                    f"{sorted(capable)})")
+            name = capable[0]
+        m = self.models.get(name)
+        if m is None or not callable(getattr(m, "start_profile", None)):
+            h._send(404, {"error": f"model {name!r} not found or not "
+                                   "profile-capable"})
+            return
+        try:
+            out = m.start_profile(ticks, trace_dir)
+        except RuntimeError as e:
+            # a capture is already in flight: conflict, retry after it
+            # completes (poll GET /engine/perf "profiler")
+            h._send(409, {"error": f"{type(e).__name__}: {e}"})
+            return
+        out["model"] = name
+        h._send(200, out)
 
     def _v1(self, h, name: str, verb: str) -> None:
         m = self.models.get(name)

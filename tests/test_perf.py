@@ -1,0 +1,594 @@
+"""Performance-introspection tests (ISSUE 11): the analytical FLOPs model
+against hand-computed counts, the goodput ledger's
+``goodput + waste == dispatched`` invariant under preemption storms and
+speculative chaos, phase-timeline ring bounds, the ``/engine/perf`` and
+``POST /engine/profile`` endpoint contracts (with the profiler artifact
+store's count/byte caps and stop-time cleanup), the proxy's fleet cache
+view pruning on pod churn, and metric exposition.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from kubeflow_tpu.core.api import APIServer
+from kubeflow_tpu.serving.api import LABEL_ISVC
+from kubeflow_tpu.serving.controllers import (POD_PORT_ANNOTATION,
+                                              PROXY_PORT_ANNOTATION)
+from kubeflow_tpu.serving.engine import Engine, EngineConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import FaultConfig
+from kubeflow_tpu.serving.engine.perf import (FlopsModel, PerfLedger,
+                                              ProfileStore, TickTimeline,
+                                              TIMELINE_PHASES, WASTE_REASONS,
+                                              platform_peak_flops)
+from kubeflow_tpu.serving.engine.scheduler import SchedulerConfig
+from kubeflow_tpu.serving.engine.serve import JetStreamModel
+from kubeflow_tpu.serving.errors import RequestError
+from kubeflow_tpu.serving.router import ServiceProxy
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.utils.net import find_free_ports
+
+pytestmark = pytest.mark.perf
+
+# vocab >= 256: the JetStream byte tokenizer addresses ids 0..255
+CFG = M.DecoderConfig(vocab_size=288, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+def _ec(**kw):
+    base = dict(max_slots=4, num_pages=128, page_size=8,
+                max_pages_per_slot=16)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def _assert_invariant(snap):
+    """goodput + attributed waste must EXACTLY equal dispatched FLOPs —
+    the acceptance criterion, checked as the ledger's own identity."""
+    dispatched = snap["dispatched_flops"]
+    accounted = snap["goodput_flops"] + sum(snap["waste_flops"].values())
+    assert accounted == pytest.approx(dispatched, rel=1e-12), (
+        f"goodput+waste != dispatched: {accounted} vs {dispatched} "
+        f"(waste: {snap['waste_flops']})")
+    assert snap["accounted_flops"] == pytest.approx(dispatched, rel=1e-12)
+    for reason in snap["waste_flops"]:
+        assert reason in WASTE_REASONS, f"unknown waste reason {reason!r}"
+
+
+# ------------------------------------------------- FLOPs model vs hand counts
+
+
+def test_flops_model_prefill_hand_count():
+    c = CFG
+    fm = FlopsModel(c)
+    hd = c.head_dim
+    # hand count: per-token matmuls (wq wk wv wo w1 w3 w2 + unembed)
+    per_layer = 2 * (c.d_model * c.n_heads * hd
+                     + 2 * c.d_model * c.n_kv_heads * hd
+                     + c.n_heads * hd * c.d_model
+                     + 3 * c.d_model * c.d_ff)
+    lin = c.n_layers * per_layer + 2 * c.d_model * c.vocab_size
+    assert fm.per_token == lin
+    # causal attention over L=5: per layer 4*n_heads*hd*sum(1..5)
+    L = 5
+    attn = c.n_layers * 4 * c.n_heads * hd * (L * (L + 1) // 2)
+    assert fm.prefill_row(L) == L * lin + attn
+    # chunk at history 3: positions 4..3+L each attend history+i
+    attn_hist = c.n_layers * 4 * c.n_heads * hd * (
+        sum(3 + i for i in range(1, L + 1)))
+    assert fm.prefill_row(L, history=3) == L * lin + attn_hist
+    assert fm.prefill_row(0) == 0.0
+
+
+def test_flops_model_decode_and_verify_hand_count():
+    c = CFG
+    fm = FlopsModel(c)
+    S = 37
+    attn = c.n_layers * 4 * c.n_heads * c.head_dim * S
+    assert fm.decode_row(S) == fm.per_token + attn
+    # fused verify: k positions at ~context S
+    assert fm.verify_row(S, 4) == 4 * fm.decode_row(S)
+
+
+def test_flops_model_lora_delta():
+    r, n_ad = 4, 3
+    import numpy as np
+
+    hd = CFG.head_dim
+    table = {"wq": {"A": np.zeros((n_ad, CFG.n_layers, CFG.d_model, r)),
+                    "B": np.zeros((n_ad, CFG.n_layers, r,
+                                   CFG.n_heads * hd))}}
+    fm = FlopsModel(CFG, lora=table)
+    delta = CFG.n_layers * 2 * r * (CFG.d_model + CFG.n_heads * hd)
+    assert fm.per_token == FlopsModel(CFG).per_token + delta
+
+
+def test_platform_peak_table(monkeypatch):
+    from kubeflow_tpu.scheduler.topology import VARIANTS
+
+    label, peak = platform_peak_flops("cpu")
+    assert label == "cpu" and peak > 0
+    label, peak = platform_peak_flops("tpu", "TPU v5 lite core", 1)
+    assert label == "tpu-v5e" and peak == VARIANTS["v5e"].flops_bf16
+    label, peak = platform_peak_flops("tpu", "TPU v5 lite core", 4)
+    assert peak == 4 * VARIANTS["v5e"].flops_bf16
+    monkeypatch.setenv("ENGINE_PEAK_FLOPS", "123.0")
+    label, peak = platform_peak_flops("cpu")
+    assert peak == 123.0 and label.endswith("!")
+
+
+# ------------------------------------------------------------- ledger units
+
+
+def test_ledger_invariant_by_construction():
+    led = PerfLedger(peak_flops=1e9, platform="cpu", window_s=60)
+    led.charge("prefill", 100.0, 10, None)
+    led.charge("decode", 50.0, 5, None)
+    led.charge("verify", 30.0, 3, "spec_reject")
+    led.charge("prefill", 20.0, 2, "preempt_recompute")
+    snap = led.snapshot()
+    assert snap["dispatched_flops"] == 200.0
+    assert snap["goodput_flops"] == 150.0
+    assert snap["waste_flops"] == {"spec_reject": 30.0,
+                                   "preempt_recompute": 20.0}
+    assert snap["accounted_flops"] == snap["dispatched_flops"]
+    assert 0.0 < snap["goodput_ratio"] < 1.0
+    assert snap["mfu"] > 0.0
+    # zero-charge and idle behavior
+    led2 = PerfLedger(1e9, "cpu")
+    assert led2.goodput_ratio() == 1.0 and led2.mfu() == 0.0
+    led2.charge("decode", 0.0, 1, None)  # no-op
+    assert led2.snapshot()["dispatched_flops"] == 0.0
+
+
+def test_timeline_ring_bounds_unit():
+    tl = TickTimeline(capacity=4)
+    for t in range(10):
+        tl.note(t, "admit", 0.001)
+        tl.note(t, "decode_dispatch", 0.002)
+        tl.note(t, "decode_dispatch", 0.003)  # repeated segments sum
+    assert len(tl) == 4
+    snap = tl.snapshot()
+    assert [r["tick"] for r in snap] == [6, 7, 8, 9]
+    assert snap[-1]["segments"]["decode_dispatch"] == pytest.approx(0.005)
+    assert snap[-1]["segments"]["admit"] == pytest.approx(0.001)
+
+
+# --------------------------------------------------- engine-level invariants
+
+
+def test_goodput_invariant_plain_run(params):
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        prompts = [[(i * 13 + j) % 255 + 1 for j in range(10 + i)]
+                   for i in range(6)]
+        futs = [eng.generate_async(p, 8) for p in prompts]
+        results = [f.result(timeout=120) for f in futs]
+        assert all(r["num_tokens"] == 8 for r in results)
+        snap = eng.perf_snapshot()
+        _assert_invariant(snap)
+        assert snap["flops_by_kind"]["prefill"] > 0
+        assert snap["flops_by_kind"]["decode"] > 0
+        # prefill goodput covers every prompt position exactly once
+        assert snap["positions_by_kind"]["prefill"] == sum(
+            len(p) for p in prompts)
+    finally:
+        eng.stop()
+
+
+def test_goodput_invariant_preemption_storm(params):
+    eng = Engine(params, CFG, _ec(
+        max_slots=2,
+        chaos=FaultConfig(seed=7, preempt_every=4),
+        scheduler=SchedulerConfig(swap_policy="recompute")))
+    eng.start()
+    try:
+        prompts = [[(i * 17 + j) % 255 + 1 for j in range(12)]
+                   for i in range(6)]
+        futs = [eng.generate_async(p, 10) for p in prompts]
+        results = [f.result(timeout=180) for f in futs]
+        assert all(r["num_tokens"] == 10 for r in results)
+        assert sum(r["preemptions"] for r in results) > 0
+        snap = eng.perf_snapshot()
+        _assert_invariant(snap)
+        # drop-preempt resumes re-prefill already-computed context: that
+        # work must land under preempt_recompute, not goodput
+        assert snap["waste_flops"].get("preempt_recompute", 0) > 0
+        assert snap["goodput_ratio"] < 1.0
+    finally:
+        eng.stop()
+
+
+def test_spec_reject_waste_matches_accept_rate(params):
+    K = 4
+    eng = Engine(params, CFG, _ec(
+        speculative="prompt_lookup", spec_max_draft=K, spec_ngram=2))
+    eng.start()
+    try:
+        # repetitive prompts so prompt-lookup drafts fire and some accept
+        base = [5, 9, 5, 9, 5, 9, 5, 9, 5, 9, 5, 9]
+        futs = [eng.generate_async(base + [i + 30], 16) for i in range(4)]
+        for f in futs:
+            f.result(timeout=180)
+        stats = eng.stats
+        snap = eng.perf_snapshot()
+        _assert_invariant(snap)
+        proposed, accepted = stats["spec_proposed"], stats["spec_accepted"]
+        assert proposed > 0
+        rejected = snap["waste_positions"].get("spec_reject", 0)
+        # per verify pass: charged k=d+1, committed=acc+1 -> rejected
+        # positions == proposed - accepted, up to one budget-cut pass per
+        # request (the final pass may commit fewer than it accepted)
+        assert abs(rejected - (proposed - accepted)) <= K * len(futs), (
+            f"spec_reject {rejected} vs proposed-accepted "
+            f"{proposed - accepted}")
+    finally:
+        eng.stop()
+
+
+def test_handoff_degraded_attribution(params):
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        prompt = [(j * 11) % 255 + 1 for j in range(20)]
+        # resume_len mismatch -> the import degrades at submit and the
+        # decode-side re-prefill is the prefill replica's work redone
+        r = eng.generate(prompt, 4, kv_import=(object(), 128, 999))
+        assert r["num_tokens"] == 4
+        snap = eng.perf_snapshot()
+        _assert_invariant(snap)
+        assert snap["waste_positions"].get("handoff_degraded") == len(prompt)
+        assert snap["waste_flops"]["handoff_degraded"] > 0
+    finally:
+        eng.stop()
+
+
+def test_waste_hint_validated(params):
+    eng = Engine(params, CFG, _ec())
+    try:
+        with pytest.raises(RequestError):
+            eng.generate_async([1, 2, 3], 2, waste_hint="bogus_reason")
+    finally:
+        eng.stop(drain=False)
+
+
+def test_failover_reprefill_hint_through_model(params):
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    m = JetStreamModel("m", engine=eng)
+    try:
+        out = m.generate({"text_input": "hello failover",
+                          "parameters": {"max_tokens": 8,
+                                         "resume_token_ids": [65, 66, 67]}})
+        assert out["tokens"] == 8
+        snap = eng.perf_snapshot()
+        _assert_invariant(snap)
+        # prompt + resume ids re-prefill under failover_reprefill
+        assert snap["waste_positions"].get("failover_reprefill", 0) \
+            == len("hello failover") + 3
+    finally:
+        eng.stop()
+
+
+def test_perf_plane_off_charges_nothing(params):
+    eng = Engine(params, CFG, _ec(perf=False))
+    eng.start()
+    try:
+        eng.generate([1, 2, 3, 4, 5, 6, 7, 8, 9], 4)
+        snap = eng.perf_snapshot()
+        assert snap["enabled"] is False
+        assert snap["dispatched_flops"] == 0.0
+        assert len(snap["timeline"]) == 0
+    finally:
+        eng.stop()
+
+
+def test_timeline_ring_bounds_engine(params):
+    eng = Engine(params, CFG, _ec(perf_timeline_capacity=8))
+    eng.start()
+    try:
+        eng.generate(list(range(1, 12)), 24)
+        assert 0 < len(eng.timeline) <= 8
+        for rec in eng.timeline.snapshot():
+            assert set(rec["segments"]) <= set(TIMELINE_PHASES)
+        # a decode-heavy run must attribute decode time
+        segs = {}
+        for rec in eng.timeline.snapshot():
+            for k, v in rec["segments"].items():
+                segs[k] = segs.get(k, 0.0) + v
+        assert segs.get("decode_dispatch", 0) > 0
+    finally:
+        eng.stop()
+
+
+# -------------------------------------------------------- endpoint contracts
+
+
+def test_engine_perf_endpoint_contract(params):
+    eng = Engine(params, CFG, _ec())
+    srv = ModelServer([JetStreamModel("m", engine=eng)])
+    srv.start()
+    try:
+        body = json.dumps({"text_input": "perf contract",
+                           "parameters": {"max_tokens": 6}}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v2/models/m/generate",
+            data=body, method="POST")).read()
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/engine/perf").read())
+        rec = snap["models"]["m"]
+        for key in ("platform", "peak_flops", "mfu", "goodput_ratio",
+                    "dispatched_flops", "goodput_flops", "waste_flops",
+                    "cache", "timeline", "profiler", "accounted_flops"):
+            assert key in rec, key
+        _assert_invariant(rec)
+        cache = rec["cache"]
+        for key in ("lookups", "hit_pages", "miss_pages", "occupancy",
+                    "fragmentation", "top_reused_prefixes", "free_pages"):
+            assert key in cache, key
+        assert 0.0 <= cache["fragmentation"] <= 1.0
+    finally:
+        eng.stop()
+        srv.stop()
+
+
+def test_profile_endpoint_contract(params, tmp_path):
+    eng = Engine(params, CFG, _ec(profile_dir=str(tmp_path / "profs")))
+    srv = ModelServer([JetStreamModel("m", engine=eng)])
+    srv.start()
+    port = srv.port
+    gen = json.dumps({"text_input": "profile me",
+                      "parameters": {"max_tokens": 4}}).encode()
+    try:
+        # bad ticks -> 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/engine/profile",
+                data=json.dumps({"ticks": 0}).encode(), method="POST"))
+        assert ei.value.code == 400
+        out = json.loads(urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/engine/profile",
+            data=json.dumps({"ticks": 2}).encode(),
+            method="POST")).read())
+        assert out["started"] and out["model"] == "m"
+        assert out["dir"].startswith(str(tmp_path / "profs"))
+        # a second capture while one is armed -> 409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/engine/profile",
+                data=json.dumps({"ticks": 2}).encode(), method="POST"))
+        assert ei.value.code == 409
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/v2/models/m/generate",
+            data=gen, method="POST")).read()
+        _wait(lambda: not eng.profiler_active, msg="profiler stop")
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/engine/perf").read())
+        prof = snap["models"]["m"]["profiler"]
+        assert prof["captures"] == 1 and not prof["active"]
+        assert prof["runs"] and prof["runs"][0]["state"] == "complete"
+        assert prof["runs"][0]["nbytes"] > 0
+        managed_dir = prof["runs"][0]["dir"]
+        assert os.path.isdir(managed_dir)
+    finally:
+        eng.stop()
+        srv.stop()
+    # stop() cleans managed capture dirs — profiles must not accumulate
+    # across engine lifecycles
+    assert not os.path.exists(managed_dir)
+
+
+def test_profile_refused_on_stopped_engine(params):
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    eng.stop()
+    # arming a capture on a dead loop would wedge profiler_active True
+    # forever and leak a managed dir past the stop()-time cleanup
+    with pytest.raises(RuntimeError):
+        eng.trace_n_ticks(2)
+    assert not eng.profiler_active
+    assert eng.profiles.snapshot() == []
+
+
+def test_profile_store_caps_and_cleanup(tmp_path):
+    store = ProfileStore(parent=str(tmp_path / "p"), max_runs=2,
+                         max_bytes=10**9)
+    dirs = []
+    for i in range(4):
+        d = store.new_dir()
+        with open(os.path.join(d, "trace.bin"), "wb") as f:
+            f.write(b"x" * 128)
+        rec = store.begin(d, 1, managed=True)
+        store.complete(rec)
+        dirs.append(d)
+    # count cap: the two oldest capture dirs are gone, newest two remain
+    assert not os.path.exists(dirs[0]) and not os.path.exists(dirs[1])
+    assert os.path.isdir(dirs[2]) and os.path.isdir(dirs[3])
+    assert len(store.runs) == 2
+    # byte cap evicts even under the count cap
+    store2 = ProfileStore(parent=str(tmp_path / "q"), max_runs=10,
+                          max_bytes=300)
+    d2 = []
+    for i in range(3):
+        d = store2.new_dir()
+        with open(os.path.join(d, "trace.bin"), "wb") as f:
+            f.write(b"y" * 200)
+        rec = store2.begin(d, 1, managed=True)
+        store2.complete(rec)
+        d2.append(d)
+    assert not os.path.exists(d2[0])
+    # explicit caller-owned dirs are recorded but never deleted
+    own = tmp_path / "mine"
+    own.mkdir()
+    rec = store2.begin(str(own), 1, managed=False)
+    store2.complete(rec)
+    store2.close()
+    assert own.is_dir()
+    assert not os.path.exists(d2[1]) and not os.path.exists(d2[2])
+
+
+# ------------------------------------------------------------ fleet surfaces
+
+
+def _mk_service(api, name, svc_port):
+    api.create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": name, "labels": {LABEL_ISVC: name},
+                     "annotations": {PROXY_PORT_ANNOTATION: str(svc_port)}},
+        "spec": {"selector": {"app": name}}})
+
+
+def _mk_pod(api, name, app, port):
+    api.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "labels": {"app": app},
+                     "annotations": {POD_PORT_ANNOTATION: str(port)}},
+        "spec": {},
+        "status": {"phase": "Running",
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+def _mk_perf_fleet(params, n):
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    svc_port = find_free_ports(1)[0]
+    _mk_service(api, "fleet", svc_port)
+    engines, servers = [], []
+    for i in range(n):
+        eng = Engine(params, CFG, _ec())
+        srv = ModelServer([JetStreamModel("fleet", "", engine=eng)], port=0)
+        srv.start()
+        _mk_pod(api, f"fleet-{i}", "fleet", srv.port)
+        engines.append(eng)
+        servers.append(srv)
+    proxy.sync()
+    return api, proxy, svc_port, engines, servers
+
+
+def test_fleet_cache_view_and_pruning_on_pod_churn(params):
+    api, proxy, svc_port, engines, servers = _mk_perf_fleet(params, 2)
+    try:
+        for srv in servers:
+            body = json.dumps({"text_input": "warm the cache",
+                               "parameters": {"max_tokens": 4}}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v2/models/fleet/generate",
+                data=body, method="POST")).read()
+        view = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{svc_port}/fleet/cache", timeout=30).read())
+        assert sorted(view["replicas"]) == ["fleet-0", "fleet-1"]
+        rec = view["replicas"]["fleet-0"]["models"]["fleet"]
+        assert "cache" in rec and "mfu" in rec and "goodput_ratio" in rec
+        assert rec["cache"]["lookups"] >= 1
+        assert not view["replicas_unreachable"]
+        # pod churn: a deleted replica must not haunt the view
+        api.delete("Pod", "fleet-1")
+        view = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{svc_port}/fleet/cache", timeout=30).read())
+        assert sorted(view["replicas"]) == ["fleet-0"]
+        # an unreachable-but-present replica serves its last-known view,
+        # marked stale with its age
+        servers[0].stop()
+        view = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{svc_port}/fleet/cache", timeout=30).read())
+        assert view["replicas_unreachable"] == ["fleet-0"]
+        assert view["replicas"]["fleet-0"]["stale"] is True
+        assert view["replicas"]["fleet-0"]["age_s"] >= 0
+    finally:
+        proxy.shutdown()
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — already stopped above
+                pass
+        for eng in engines:
+            eng.stop(drain=False)
+
+
+def test_fleet_metrics_scrape_latency_header(params):
+    api, proxy, svc_port, engines, servers = _mk_perf_fleet(params, 2)
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{svc_port}/fleet/metrics", timeout=30
+        ).read().decode()
+        lat_lines = [ln for ln in text.splitlines()
+                     if ln.startswith("# scrape_seconds: ")]
+        assert len(lat_lines) == 1
+        entries = dict(kv.split("=") for kv in
+                       lat_lines[0][len("# scrape_seconds: "):].split(","))
+        assert sorted(entries) == ["fleet-0", "fleet-1"]
+        for v in entries.values():
+            assert float(v) >= 0.0
+        # a dead replica still reports the latency it burned (the slow-vs-
+        # dead distinction the header exists for)
+        servers[1].stop()
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{svc_port}/fleet/metrics", timeout=30
+        ).read().decode()
+        assert "unreachable: fleet-1" in text.splitlines()[0]
+        assert any(ln.startswith("# scrape_seconds: ") and "fleet-1=" in ln
+                   for ln in text.splitlines())
+    finally:
+        proxy.shutdown()
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        for eng in engines:
+            eng.stop(drain=False)
+
+
+# ----------------------------------------------------------- metric exposition
+
+
+def test_perf_metric_exposition(params):
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    m = JetStreamModel("m", engine=eng)
+    try:
+        eng.generate(list(range(1, 15)), 6)
+        eng.generate(list(range(1, 15)), 6)  # cache hit -> hit outcome
+        text = m.metrics_text()
+        mfu_lines = [ln for ln in text.splitlines()
+                     if ln.startswith("engine_mfu_ratio{")]
+        assert len(mfu_lines) == 1
+        assert 'platform="' in mfu_lines[0] and 'model="m"' in mfu_lines[0]
+        assert "engine_goodput_ratio" in text
+        assert "engine_kv_fragmentation_ratio" in text
+        assert 'engine_model_flops_total{kind="prefill"' in text
+        assert 'engine_model_flops_total{kind="decode"' in text
+        assert 'engine_prefix_cache_pages_total{outcome="hit",model="m"}' \
+            in text
+        # the counters mirror the ledger exactly (same charge path)
+        snap = eng.perf_snapshot()
+        for kind, val in snap["flops_by_kind"].items():
+            if val:
+                assert eng.telemetry.flops_total.value(kind=kind) \
+                    == pytest.approx(val)
+        for reason, val in snap["waste_flops"].items():
+            assert eng.telemetry.wasted_flops.value(reason=reason) \
+                == pytest.approx(val)
+    finally:
+        eng.stop()
