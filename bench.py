@@ -645,6 +645,33 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["disagg"] = {"error": str(e)[:200]}
     try:
+        # fleet-KV-fabric sidebar: serving_bench --fabric's headline
+        # (BENCH_FABRIC.json) — cross-replica warm TTFT vs local warm is
+        # the shared-prefix-memory payoff, the fleet prefill-FLOPs ratio
+        # is the ledger-measured recompute saved, the identity/leak/chaos
+        # flags are the degradation acceptance invariants
+        fb_path = os.path.join(REPO, "BENCH_FABRIC.json")
+        if os.path.exists(fb_path):
+            with open(fb_path) as f:
+                fb = json.loads(f.readline())
+            out["fabric"] = {
+                "cold_ttft_s": fb.get("cold_ttft_s"),
+                "local_warm_ttft_s": fb.get("local_warm_ttft_s"),
+                "cross_replica_warm_ttft_s":
+                    fb.get("cross_replica_warm_ttft_s"),
+                "cross_over_local_warm_x":
+                    fb.get("cross_over_local_warm_x"),
+                "fabric_on_over_off_prefill_flops_x":
+                    fb.get("fabric_on_over_off_prefill_flops_x"),
+                "cache_placements": fb.get("cache_placements"),
+                "byte_identical": fb.get("byte_identical"),
+                "kv_pages_leaked": fb.get("kv_pages_leaked"),
+                "chaos_degraded": fb.get("chaos_degraded"),
+                "platform": fb.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["fabric"] = {"error": str(e)[:200]}
+    try:
         # perf-introspection sidebar: serving_bench --perf's headline
         # (BENCH_PERF.json) — plane overhead in both scopes, the
         # chip-pinned MFU cross-check, and the waste-attribution audits

@@ -232,6 +232,22 @@ JOBS = [
                                   "--out",
                                   os.path.join(REPO, "BENCH_DISAGG.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # fleet KV fabric on a real chip (ISSUE 12): on TPU the cold baseline
+    # pays chunked prefill at real HBM/MXU rates, so the cross-replica-
+    # warm vs local-warm vs cold TTFT triplet measures the genuine
+    # shared-prefix-memory payoff (fabric pull + page scatter vs chip
+    # prefill FLOPs), the fleet prefill-FLOPs gate runs against
+    # platform=tpu ledger rows, and the byte-identity/leak/chaos gates
+    # execute at device speed; refreshes BENCH_FABRIC.json
+    # (floor 2ms keeps the triplet separation visible even at chip
+    # prefill rates; the device step dominates when slower)
+    {"name": "serving_fabric_tiny",
+     "cmd": _serving_cmd("tiny", ["--fabric", "--fabric-requests", "8",
+                                  "--fabric-rounds", "3",
+                                  "--fabric-tick-floor", "0.002",
+                                  "--out",
+                                  os.path.join(REPO, "BENCH_FABRIC.json")]),
+     "timeout": 1500, "first_timeout": 900},
     # perf introspection on a real chip (ISSUE 11): the first drained run
     # records platform=tpu MFU/goodput rows from the new plane — the
     # analytical serving MFU divides by the REAL v5e peak instead of the

@@ -2641,6 +2641,466 @@ def _run_disagg(args, config, params, lora) -> None:
         raise SystemExit("disagg bench FAILED: " + "; ".join(failures))
 
 
+def _run_fabric(args, config, params, lora) -> None:
+    """Fleet KV fabric replay (README "Fleet KV fabric", ISSUE 12).
+
+    Three phases over a shared-prefix workload (one long "system prompt",
+    distinct tails — the million-user multi-turn shape ROADMAP item 3
+    names):
+
+      A. **TTFT triplet** (direct drive, ENGINE_TICK_FLOOR_S device-bound
+         regime): cold prefill on replica A, local-warm rerun on A
+         (device prefix cache), cross-replica warm on B (fabric pull +
+         scatter + tail prefill).  Gates: cross-replica warm TTFT <=
+         --fabric-warm-budget-x (default 1.25) x local warm, both well
+         below cold; warm outputs byte-identical across replicas (the
+         SAME chunked-offset graph on both sides, so the check is
+         strict); cold-vs-warm divergence, if any, audited tie-aware.
+      B. **Fleet replay** through the real ServiceProxy, fabric-on vs
+         fabric-off arms (3 unified replicas each, identical workload):
+         global cache-aware placement + pull hints vs the legacy
+         affinity LRU.  Gate: fabric-on fleet prefill FLOPs (the PR 11
+         ledger, summed across replicas) strictly below fabric-off —
+         spilled requests fault the prefix instead of recomputing it —
+         plus byte-identity vs the serial oracle and 0 leaked pages.
+      C. **Chaos pass**: the same replay with every fabric fault class
+         injected (torn + flipped + slow + dead-link pulls, pre-expired
+         publishes, a budget-starved replica whose publishes reject) —
+         every request must still complete on the degraded re-prefill
+         path with 0 leaks.
+
+    Results land in BENCH_FABRIC.json via --out."""
+    import concurrent.futures
+    import json as _json
+    import os as _os
+    import time as _time
+    import urllib.request as _url
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.core.api import APIServer
+    from kubeflow_tpu.serving import disagg as _disagg
+    from kubeflow_tpu.serving.api import LABEL_ISVC
+    from kubeflow_tpu.serving.controllers import (POD_PORT_ANNOTATION,
+                                                  PROXY_PORT_ANNOTATION)
+    from kubeflow_tpu.serving.engine import Engine, EngineConfig
+    from kubeflow_tpu.serving.engine.faults import FabricFaultConfig
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+    from kubeflow_tpu.serving.router import (RELAY_TIMEOUT_ANNOTATION,
+                                             ServiceProxy)
+    from kubeflow_tpu.serving.server import ModelServer
+    from kubeflow_tpu.utils.net import find_free_ports
+
+    page_size = 16
+    chunk = 128
+    mt = 8
+    # the shared prefix must exceed the largest static prefill bucket so
+    # cold prefill takes the CHUNKED path (several ticks) — that is what
+    # makes the tick-floor regime separate cold from warm TTFT the way a
+    # real chip's prefill FLOPs do
+    shared_len = max(args.prompt_len, 1200)
+    tail_len = 64
+    plen = shared_len + tail_len  # ~1264 chars -> tokens (byte tokenizer)
+    slots = 4
+    pages_per_slot = (plen + mt) // page_size + 3
+    num_pages = slots * pages_per_slot + 16
+    n_requests = args.fabric_requests
+    rng = np.random.default_rng(0)
+    letters = "abcdefghijklmnopqrstuvwxyz "
+
+    def mk_text(n, r=rng):
+        return "".join(letters[j]
+                       for j in r.integers(0, len(letters), size=n))
+
+    def ec(fabric=True, chaos=None, fabric_max_bytes=256 << 20):
+        return EngineConfig(
+            max_slots=slots, page_size=page_size, num_pages=num_pages,
+            max_pages_per_slot=pages_per_slot, prefill_chunk=chunk,
+            fabric=fabric, fabric_chaos=chaos,
+            fabric_max_bytes=fabric_max_bytes,
+            tensor_parallel=args.tensor_parallel,
+            paged_kernel=args.paged_kernel or None,
+            kv_quant=args.kv_quant, weight_quant=args.weight_quant)
+
+    def unary(port, prompt, extra_params=None, model="fabric"):
+        req = _url.Request(
+            f"http://127.0.0.1:{port}/v2/models/{model}/generate",
+            data=_json.dumps({"text_input": prompt,
+                              "parameters": {"max_tokens": mt,
+                                             **(extra_params or {})}}
+                             ).encode(),
+            headers={"Content-Type": "application/json"})
+        with _url.urlopen(req, timeout=600) as r:
+            return _json.loads(r.read())
+
+    def leak(e):
+        s = e.stats
+        return int((num_pages - 1) - s["free_pages"] - s["cached_pages"])
+
+    def tele_count(e, outcome):
+        return e.telemetry.kv_fabric.series().get(
+            (("outcome", outcome),), 0.0)
+
+    def verify_tie_aware(prompt_text, ids):
+        """--fleet-chaos's audit: every emitted token's full-forward
+        logit within tie_eps of that step's max (dup/drops miss by whole
+        logits)."""
+        import jax.numpy as _jnp
+
+        from kubeflow_tpu.serving.engine.model import forward_full
+        from kubeflow_tpu.serving.engine.serve import ByteTokenizer
+
+        toks = ByteTokenizer().encode(prompt_text)
+        for g in ids:
+            logits = np.asarray(forward_full(
+                params, config, _jnp.asarray([toks], _jnp.int32)))[0, -1]
+            if float(logits[g]) < float(logits.max()) - args.fleet_tie_eps:
+                return False
+            toks.append(g)
+        return True
+
+    # ---------------- phase A: TTFT triplet (device-bound regime) --------
+    prev_floor = _os.environ.get("ENGINE_TICK_FLOOR_S")
+    _os.environ["ENGINE_TICK_FLOOR_S"] = str(args.fabric_tick_floor)
+    rounds = []
+    warm_identical = True
+    cold_vs_warm_tie_ok = True
+    try:
+        ea = Engine(params, config, ec(), lora=lora)
+        sa = ModelServer([JetStreamModel("fabric", "", engine=ea)], port=0)
+        sa.start()
+        eb = Engine(params, config, ec(), lora=lora)
+        eb.start()
+        mb = JetStreamModel("fabric", "", engine=eb)
+        try:
+            # compile the chunked-prefill / tail / decode graphs on both
+            # replicas before timing anything
+            warm_up = mk_text(plen)
+            unary(sa.port, warm_up)
+            key0 = ea.fabric_view()[0]["key"]
+            mb.generate({"text_input": warm_up,
+                         "parameters": {"max_tokens": mt,
+                                        "fabric": {"key": key0,
+                                                   "source_port": sa.port,
+                                                   "pages": 0}}})
+            for _ in range(args.fabric_rounds):
+                prompt = mk_text(plen)
+                cold = unary(sa.port, prompt)
+                warm = unary(sa.port, prompt)
+                ent = ea.fabric_view()[0]
+                cross = mb.generate(
+                    {"text_input": prompt,
+                     "parameters": {"max_tokens": mt,
+                                    "fabric": {"key": ent["key"],
+                                               "source_port": sa.port,
+                                               "pages": ent["pages"]}}})
+                if cross.get("fabric", {}).get("restore") != "hit":
+                    raise SystemExit(
+                        f"fabric bench: cross-replica pull did not hit "
+                        f"({cross.get('fabric')})")
+                if warm["token_ids"] != cross["token_ids"]:
+                    warm_identical = False
+                if cold["token_ids"] != warm["token_ids"]:
+                    # cold ([1,chunk] from 0) and warm (offset tail) are
+                    # different graphs: bf16 near-ties may legally flip —
+                    # audit, as in --fleet-chaos
+                    if not (verify_tie_aware(prompt, cold["token_ids"])
+                            and verify_tie_aware(prompt,
+                                                 warm["token_ids"])):
+                        cold_vs_warm_tie_ok = False
+                rounds.append({"cold_ttft_s": cold["ttft_s"],
+                               "local_warm_ttft_s": warm["ttft_s"],
+                               "cross_warm_ttft_s": cross["ttft_s"]})
+            phase_a_leaks = leak(ea) + leak(eb)
+        finally:
+            sa.stop()
+            ea.stop(drain=False)
+            eb.stop(drain=False)
+    finally:
+        if prev_floor is None:
+            _os.environ.pop("ENGINE_TICK_FLOOR_S", None)
+        else:
+            _os.environ["ENGINE_TICK_FLOOR_S"] = prev_floor
+    cold_med = float(np.median([r["cold_ttft_s"] for r in rounds]))
+    local_med = float(np.median([r["local_warm_ttft_s"] for r in rounds]))
+    cross_med = float(np.median([r["cross_warm_ttft_s"] for r in rounds]))
+    # the gate ratio is the median of PER-ROUND paired ratios, not the
+    # ratio of medians: on a drifting 1-core box the local and cross
+    # samples of one round share the same load conditions, so pairing
+    # cancels the drift (the --overlap bench's established discipline)
+    cross_over_local = float(np.median(
+        [r["cross_warm_ttft_s"] / max(1e-9, r["local_warm_ttft_s"])
+         for r in rounds]))
+
+    # ---------------- phases B/C: fleet replay through the proxy ---------
+    shared = mk_text(shared_len)
+    tails = [mk_text(tail_len, np.random.default_rng(100 + i))
+             for i in range(n_requests)]
+    prompts = [shared + t for t in tails]
+
+    # serial single-engine oracle (depth-0 greedy reference)
+    oracle = {}
+    ref = Engine(params, config, ec(fabric=False), lora=lora)
+    ref_model = JetStreamModel("fabric", "", engine=ref)
+    ref.start()
+    try:
+        for pr in prompts:
+            oracle[pr] = ref_model.generate(
+                {"text_input": pr,
+                 "parameters": {"max_tokens": mt}})["token_ids"]
+    finally:
+        ref.stop(drain=False)
+
+    def build_fleet(fabric_on, chaos_plan=None, starved=None):
+        api = APIServer()
+        proxy = ServiceProxy(api)
+        svc_port = find_free_ports(1)[0]
+        api.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "fabric", "labels": {LABEL_ISVC: "fabric"},
+                         "annotations": {
+                             PROXY_PORT_ANNOTATION: str(svc_port),
+                             RELAY_TIMEOUT_ANNOTATION: "60.0",
+                             _disagg.DISAGG_ANNOTATION: "off"}},
+            "spec": {"selector": {"app": "fabric"}}})
+        engines, servers = [], []
+        for i in range(args.fabric_replicas):
+            eng = Engine(params, config, ec(
+                fabric=fabric_on,
+                chaos=(chaos_plan or {}).get(i),
+                fabric_max_bytes=(1 << 10 if starved == i
+                                  else 256 << 20)), lora=lora)
+            srv = ModelServer([JetStreamModel("fabric", "", engine=eng)],
+                              port=0)
+            srv.start()
+            api.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"fabric-{i}",
+                             "labels": {"app": "fabric"},
+                             "annotations": {POD_PORT_ANNOTATION:
+                                             str(srv.port)}},
+                "spec": {},
+                "status": {"phase": "Running",
+                           "conditions": [{"type": "Ready",
+                                           "status": "True"}]}})
+            engines.append(eng)
+            servers.append(srv)
+        proxy.sync()
+        return api, proxy, svc_port, engines, servers
+
+    def run_arm(fabric_on, chaos_plan=None, starved=None):
+        api, proxy, svc_port, engines, servers = build_fleet(
+            fabric_on, chaos_plan, starved)
+        try:
+            # compile every replica's graphs off the clock
+            for srv in servers:
+                unary(srv.port, mk_text(plen))
+            # seed: the first shared-prefix request prefills + publishes
+            seed = unary(svc_port, prompts[0])
+            # synchronous view refresh so placement sees the publish
+            # (production would rely on the background TTL refresh)
+            _url.urlopen(f"http://127.0.0.1:{svc_port}/fleet/cache",
+                         timeout=10).read()
+            with concurrent.futures.ThreadPoolExecutor(
+                    args.fabric_concurrency) as ex:
+                outs = list(ex.map(
+                    lambda pr: unary(svc_port, pr), prompts[1:]))
+            outs = [seed] + outs
+            if chaos_plan:
+                # deterministic pull-side chaos exposure: the proxy
+                # replay may legitimately place every follow-up ON an
+                # owner (no pulls at all), which would leave the
+                # torn/flip/slow/dead-link injectors unexercised — so
+                # drive one hinted request per non-owner replica
+                # directly; their FIRST pulls hit the injected ordinals
+                owner_i = next((i for i, e in enumerate(engines)
+                                if e.fabric_view()), None)
+                if owner_i is not None:
+                    ent = engines[owner_i].fabric_view()[0]
+                    for i, srv in enumerate(servers):
+                        if i == owner_i:
+                            continue
+                        o = unary(srv.port, prompts[0], extra_params={
+                            "fabric": {
+                                "key": ent["key"],
+                                "source_port": servers[owner_i].port,
+                                "pages": ent["pages"]}})
+                        if o.get("tokens") != mt:
+                            raise SystemExit(
+                                "fabric bench: chaos-arm direct pull "
+                                f"missed its token budget ({o})")
+                        if (o.get("token_ids") != oracle[prompts[0]]
+                                and not verify_tie_aware(
+                                    prompts[0], o["token_ids"])):
+                            raise SystemExit(
+                                "fabric bench: chaos-arm direct pull "
+                                "broke greedy continuity")
+            prefill_flops = sum(
+                e.perf.snapshot()["flops_by_kind"]["prefill"]
+                for e in engines)
+            stats = {
+                "outs": outs,
+                "prefill_flops": prefill_flops,
+                "fabric": [e.stats.get("fabric") for e in engines],
+                "chaos": [e.stats.get("fabric_chaos") for e in engines],
+                "hits": sum(tele_count(e, "hit") for e in engines),
+                "degraded": sum(tele_count(e, "degraded")
+                                for e in engines),
+                "leaks": sum(leak(e) for e in engines),
+            }
+            return stats
+        finally:
+            proxy.shutdown()
+            for srv in servers:
+                srv.stop()
+            for eng in engines:
+                try:
+                    eng.stop(drain=False)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def audit(arm):
+        complete = all(o.get("tokens") == mt for o in arm["outs"])
+        divergent = [
+            (pr, o["token_ids"]) for pr, o in zip(prompts, arm["outs"])
+            if o.get("token_ids") != oracle[pr]]
+        tie_ok = all(verify_tie_aware(pr, ids) for pr, ids in divergent)
+        return complete, len(divergent), tie_ok
+
+    placements0 = dict(_disagg.PLACEMENTS.series())
+    arm_on = run_arm(True)
+    cache_picks = (dict(_disagg.PLACEMENTS.series())
+                   .get((("reason", "cache"),), 0)
+                   - placements0.get((("reason", "cache"),), 0))
+    arm_off = run_arm(False)
+    # every replica's EARLY pulls inject (pulls are spread thin across
+    # the fleet, so late ordinals never fire), and the classes are spread
+    # across replicas so one pass covers them all; the last replica's
+    # store is budget-starved (publishes reject)
+    chaos_variants = [
+        FabricFaultConfig(dead_link_on=1, torn_pull_every=2),
+        FabricFaultConfig(torn_pull_on=1, flip_pull_every=2,
+                          expire_publish_every=3),
+        FabricFaultConfig(flip_pull_on=1, slow_pull_s=0.02,
+                          slow_pull_every=2),
+    ]
+    chaos_plan = {i: chaos_variants[i % len(chaos_variants)]
+                  for i in range(args.fabric_replicas)}
+    arm_chaos = run_arm(True, chaos_plan=chaos_plan,
+                        starved=args.fabric_replicas - 1)
+
+    on_ok, on_div, on_tie = audit(arm_on)
+    off_ok, off_div, off_tie = audit(arm_off)
+    ch_ok, ch_div, ch_tie = audit(arm_chaos)
+    flops_ratio = arm_on["prefill_flops"] / max(1.0,
+                                                arm_off["prefill_flops"])
+    chaos_injected = {}
+    for c in arm_chaos["chaos"]:
+        for k, v in (c or {}).items():
+            if k.startswith("injected_"):
+                chaos_injected[k] = chaos_injected.get(k, 0) + v
+    chaos_injected["budget_rejected_publishes"] = sum(
+        (f or {}).get("rejected", 0) for f in arm_chaos["fabric"])
+
+    out = {
+        "metric": f"serving_fabric_{args.config}",
+        "replicas": args.fabric_replicas,
+        "requests": n_requests,
+        "concurrency": args.fabric_concurrency,
+        "shared_prefix_chars": shared_len,
+        "tail_chars": tail_len,
+        "max_tokens": mt,
+        "page_size": page_size,
+        "prefill_chunk": chunk,
+        "tick_floor_s": args.fabric_tick_floor,
+        "ttft_rounds": rounds,
+        "cold_ttft_s": round(cold_med, 5),
+        "local_warm_ttft_s": round(local_med, 5),
+        "cross_replica_warm_ttft_s": round(cross_med, 5),
+        "cross_over_local_warm_x": round(cross_over_local, 3),
+        "warm_over_cold_x": round(max(local_med, cross_med)
+                                  / max(1e-9, cold_med), 3),
+        "warm_budget_x": args.fabric_warm_budget_x,
+        "byte_identical_warm_across_replicas": warm_identical,
+        "cold_vs_warm_tie_aware_ok": cold_vs_warm_tie_ok,
+        "fleet_prefill_flops_fabric_on": arm_on["prefill_flops"],
+        "fleet_prefill_flops_fabric_off": arm_off["prefill_flops"],
+        "fabric_on_over_off_prefill_flops_x": round(flops_ratio, 4),
+        "cache_placements": int(cache_picks),
+        "remote_hits_fabric_on": int(arm_on["hits"]),
+        "byte_identical": {
+            "fabric_on": on_ok and on_div == 0,
+            "fabric_off": off_ok and off_div == 0,
+            "chaos": ch_ok and ch_div == 0},
+        "divergent_tie_aware_verified": {
+            "fabric_on": on_tie, "fabric_off": off_tie, "chaos": ch_tie},
+        "tie_eps": args.fleet_tie_eps,
+        "kv_pages_leaked": {
+            "ttft_phase": int(phase_a_leaks),
+            "fabric_on": int(arm_on["leaks"]),
+            "fabric_off": int(arm_off["leaks"]),
+            "chaos": int(arm_chaos["leaks"])},
+        "chaos_injected": chaos_injected,
+        "chaos_hits": int(arm_chaos["hits"]),
+        "chaos_degraded": int(arm_chaos["degraded"]),
+        "fabric_stats_on": arm_on["fabric"],
+        "platform": jax.devices()[0].platform,
+        "protocol_note": (
+            "shared-prefix replay (one long system prompt, distinct "
+            "tails) over replicated engines; TTFT triplet measured "
+            "direct-drive under ENGINE_TICK_FLOOR_S (chunked cold "
+            "prefill vs warm tail, the device-bound regime); fleet "
+            "prefill FLOPs from the PR 11 ledger summed across "
+            "replicas, fabric-on (global cache-aware placement + pull "
+            "hints) vs fabric-off (legacy affinity LRU) on the "
+            "identical workload through the real proxy; oracle = "
+            "serial single engine, divergences audited tie-aware as "
+            "in --fleet-chaos"),
+    }
+    line = _json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    failures = []
+    if cross_over_local > args.fabric_warm_budget_x:
+        failures.append(
+            f"cross-replica warm TTFT {cross_med * 1e3:.1f}ms exceeds "
+            f"{args.fabric_warm_budget_x}x local warm "
+            f"{local_med * 1e3:.1f}ms (paired-median ratio "
+            f"{cross_over_local:.3f})")
+    if max(local_med, cross_med) > 0.7 * cold_med:
+        failures.append(
+            f"warm TTFT not well below cold (local {local_med * 1e3:.1f}"
+            f"ms, cross {cross_med * 1e3:.1f}ms, cold "
+            f"{cold_med * 1e3:.1f}ms)")
+    if not warm_identical:
+        failures.append("cross-replica warm output diverged from the "
+                        "local-warm oracle (same offset graph — strict)")
+    if not cold_vs_warm_tie_ok:
+        failures.append("cold-vs-warm divergence failed the tie-aware "
+                        "audit")
+    if not (on_ok and off_ok and ch_ok):
+        failures.append("a replay request missed its exact token budget")
+    if not (on_tie and off_tie and ch_tie):
+        failures.append("greedy continuity broke (dup/dropped tokens)")
+    if flops_ratio >= 1.0:
+        failures.append(
+            f"fabric-on fleet prefill FLOPs not below fabric-off "
+            f"(ratio {flops_ratio:.4f})")
+    if cache_picks + arm_on["hits"] < 1:
+        failures.append("the fabric never engaged (no cache placements, "
+                        "no remote hits)")
+    for arm_name, leaked in out["kv_pages_leaked"].items():
+        if leaked:
+            failures.append(f"{arm_name}: {leaked} KV pages leaked")
+    if not any(v for k, v in chaos_injected.items()):
+        failures.append(f"fabric chaos did not engage ({chaos_injected})")
+    if failures:
+        raise SystemExit("fabric bench FAILED: " + "; ".join(failures))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="1b", choices=["tiny", "1b", "llama3_8b"])
@@ -2749,6 +3209,33 @@ def main() -> None:
                         "(covers cross-dispatch-shape bf16 GEMM drift, "
                         "measured ~0.03 on XLA:CPU; a dup/dropped token "
                         "misses the oracle by whole logits)")
+    p.add_argument("--fabric", action="store_true",
+                   help="fleet KV fabric scenario (ISSUE 12): shared-prefix "
+                        "replay over replicated engines — TTFT triplet "
+                        "(cold / local-warm / cross-replica-warm via "
+                        "fabric pull) under ENGINE_TICK_FLOOR_S, fleet "
+                        "replay through the real proxy fabric-on vs "
+                        "fabric-off gating fleet prefill FLOPs + "
+                        "byte-identity + 0 leaks, and a fabric-chaos pass "
+                        "(torn/flip/slow/dead-link/expired/budget) "
+                        "(BENCH_FABRIC.json via --out)")
+    p.add_argument("--fabric-replicas", type=int, default=3,
+                   help="replica count for the --fabric fleet replay")
+    p.add_argument("--fabric-requests", type=int, default=12,
+                   help="shared-prefix requests per --fabric replay arm")
+    p.add_argument("--fabric-concurrency", type=int, default=6,
+                   help="client concurrency for the --fabric replay")
+    p.add_argument("--fabric-rounds", type=int, default=6,
+                   help="TTFT triplet rounds (distinct prompts) for "
+                        "--fabric; the warm gate takes the median of "
+                        "per-round paired cross/local ratios")
+    p.add_argument("--fabric-tick-floor", type=float, default=0.008,
+                   help="ENGINE_TICK_FLOOR_S for the --fabric TTFT "
+                        "triplet (device-bound simulation: chunked cold "
+                        "prefill pays one floor per chunk tick)")
+    p.add_argument("--fabric-warm-budget-x", type=float, default=1.25,
+                   help="max cross-replica warm TTFT as a multiple of "
+                        "local warm TTFT for --fabric")
     p.add_argument("--disagg", action="store_true",
                    help="disaggregated prefill/decode scenario (ISSUE 10): "
                         "role-split arm (1 prefill + 1 decode replica) vs "
@@ -2866,6 +3353,9 @@ def main() -> None:
         return
     if args.disagg:
         _run_disagg(args, config, params, lora)
+        return
+    if args.fabric:
+        _run_fabric(args, config, params, lora)
         return
     engine = Engine(
         params, config,

@@ -66,15 +66,19 @@ DISAGG_RATIO_ANNOTATION = f"{GROUP}/disagg-ratio"
 DEFAULT_MIN_PROMPT_CHARS = 64
 DEFAULT_PROMPT_DECODE_RATIO = 1.0
 
-# Placement decisions for disagg-capable services (README "Disaggregated
-# serving"): one prefill + one decode increment per split request; a
-# "unified" increment when a planned split degraded to the unified path
-# (prefill phase failed / no prefill replica routable).  Services without
-# role-split replicas never touch this counter.
+# Placement decisions the ingress makes beyond plain load balancing.
+# Disaggregation (README "Disaggregated serving"): one prefill + one
+# decode increment per split request; a "unified" increment when a
+# planned split degraded to the unified path (prefill phase failed / no
+# prefill replica routable).  Fleet KV fabric (README "Fleet KV fabric"):
+# a reason="cache" increment when global cache-aware placement landed a
+# request on the replica holding its deepest published prefix.  Services
+# without role-split replicas or fabric publishes never touch this
+# counter.
 PLACEMENTS = REGISTRY.counter(
     "ingress_placements_total",
-    "disaggregated placement decisions by role (prefill/decode, plus "
-    "unified for split requests that degraded to the unified path)")
+    "ingress placement decisions: role=prefill/decode/unified for "
+    "disaggregated splits, reason=\"cache\" for fabric cache-aware picks")
 
 
 def normalize_role(role) -> str:

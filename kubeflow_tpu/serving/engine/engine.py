@@ -86,9 +86,10 @@ from ..disagg import HandoffStore, normalize_role
 from ..errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
                       NonFiniteLogits, RequestError, SessionBusy,
                       TickFailure)
+from ..kvfabric import FabricStore, fabric_key
 from ..slo import SloConfig, SloTracker
-from .faults import (ChaosInjector, FaultConfig, HandoffChaos,
-                     HandoffFaultConfig)
+from .faults import (ChaosInjector, FabricChaos, FabricFaultConfig,
+                     FaultConfig, HandoffChaos, HandoffFaultConfig)
 from .kvstore import (KVStoreConfig, TieredKVStore, normalize_session_id,
                       pack_frame)
 from .perf import (CacheStats, FlopsModel, PerfLedger, ProfileStore,
@@ -285,6 +286,22 @@ class EngineConfig:
     # torn/slow/dead-link pulls, pre-expired exports — every one must
     # degrade to re-prefill, never fail a request
     handoff_chaos: Optional[HandoffFaultConfig] = None
+    # ---- fleet KV fabric (README "Fleet KV fabric") ---------------------
+    # when on, every finishing request's committed full-page prefix is
+    # published (keyed by its context chain hashes) into a fleet-
+    # addressable FabricStore other replicas pull from — multi-reader,
+    # TTL'd, byte-budgeted.  Off by default: publishing snapshots device
+    # pages to host per finish, a cost only shared-prefix fleets should
+    # pay.  fabric_min_pages gates tiny prefixes out (one page of shared
+    # KV is not worth a frame).
+    fabric: bool = False
+    fabric_ttl_s: float = 120.0
+    fabric_max_bytes: int = 256 << 20
+    fabric_min_pages: int = 1
+    # deterministic fabric-fault injection (faults.FabricFaultConfig):
+    # torn/flipped/slow/dead-link pulls, pre-expired publishes — every
+    # one must degrade to re-prefill, never fail a request
+    fabric_chaos: Optional[FabricFaultConfig] = None
 
 
 @dataclasses.dataclass
@@ -353,6 +370,16 @@ class _Pending:
     # via the swap-resume path).  Any import failure degrades to plain
     # re-prefill — this flag routes that degradation instead of _fail_slot
     handoff_import: bool = False
+    # ---- fleet KV fabric (README "Fleet KV fabric") --------------------
+    # a verified remote prefix frame awaiting admission: (blob, frame
+    # chain hashes, nbytes).  Held on the pending record (not the tiered
+    # store — a prefix import needs no budget and must not interact with
+    # swap accounting); the admission path scatters the hash-verified
+    # prefix pages and re-prefills only the tail.  Cleared at admission.
+    fabric_import: "Optional[tuple]" = None
+    # how the fabric import resolved — None (no import), then
+    # hit|local|degraded; reported in the result dict's "fabric" block
+    fabric_restore: "Optional[str]" = None
     # ---- perf introspection (README "Performance introspection") -------
     # when set, this request's NEXT prefill is recomputing work that was
     # already done somewhere (preempt_recompute / handoff_degraded /
@@ -606,6 +633,28 @@ class Engine:
         self._handoff_chaos = (HandoffChaos(engine_config.handoff_chaos)
                                if engine_config.handoff_chaos is not None
                                else None)
+        # ---- fleet KV fabric (README "Fleet KV fabric") ------------------
+        # published-prefix registry (multi-reader, TTL'd, byte-budgeted;
+        # served to remote pullers via GET /engine/kv_fabric/<key>) + the
+        # fabric chaos injector the pulling side's serve layer consults.
+        # fabric_fingerprinter is wired by JetStreamModel (it owns the
+        # tokenizer): tokens -> the text fingerprint ladder the router's
+        # placement scorer matches request prompts against; without it
+        # publishes carry no fps (direct pulls by key still work).
+        self._fabric = (FabricStore(ttl_s=engine_config.fabric_ttl_s,
+                                    max_bytes=engine_config.fabric_max_bytes)
+                        if engine_config.fabric else None)
+        self._fabric_chaos = (FabricChaos(engine_config.fabric_chaos)
+                              if engine_config.fabric_chaos is not None
+                              else None)
+        self.fabric_fingerprinter = None
+        # model identity stamped into every published frame (wired by
+        # JetStreamModel alongside the fingerprinter): two same-shape
+        # models can produce identical chain hashes for a shared prompt
+        # — the chain seeds on tokens + adapter, not weights — so the
+        # pulling side must match THIS too, or model A's KV scatters
+        # into model B's pool and decodes silently wrong
+        self.fabric_model_id = None
         # ---- performance introspection plane (perf.py, ISSUE 11) --------
         # analytical FLOPs model + goodput ledger (charged at dispatch,
         # attributed at commit), per-tick phase timeline, prefix-cache
@@ -749,8 +798,11 @@ class Engine:
         # diagnostics nothing would ever reap once the process moves on
         self.profiles.close()
         # exported-but-unpulled handoff frames die with the engine: their
-        # handles are only routable to THIS process
+        # handles are only routable to THIS process — and so do published
+        # fabric frames (a pull would route to this process's port)
         self._handoffs.clear()
+        if self._fabric is not None:
+            self._fabric.clear()
         self._stopped = True
         self._draining = False  # drain is over: health reports DEAD now
 
@@ -796,6 +848,7 @@ class Engine:
                        session_id: Optional[str] = None,
                        handoff: bool = False,
                        kv_import=None,
+                       fabric_import=None,
                        trace=None,
                        links: Optional[list] = None,
                        waste_hint: Optional[str] = None) -> Future:
@@ -834,6 +887,14 @@ class Engine:
         starts without re-prefilling.  Any import problem — budget
         rejection here, blob lost or scatter failure later — silently
         degrades to a plain (prefix-cache-assisted) re-prefill.
+        ``fabric_import``: fleet KV fabric prefix fault-in (README "Fleet
+        KV fabric") — a verified ``(blob, hashes, nbytes)`` remote PREFIX
+        frame: at admission the frame's chain hashes are matched against
+        this prompt's and every verified page the local device cache did
+        not already cover is scattered into the slot row; prefill resumes
+        at the first uncovered position.  Unlike ``kv_import`` the frame
+        need not cover the whole prompt.  Any mismatch or failure
+        degrades to plain re-prefill (attributed ``fabric_degraded``).
         ``waste_hint``: perf-ledger attribution (README "Performance
         introspection") — the caller knows this request's prefill
         recomputes work already done elsewhere (``failover_reprefill``
@@ -944,6 +1005,38 @@ class Engine:
                 # replica already did: waste, attributed
                 pending.waste_reason = "handoff_degraded"
                 self.telemetry.count_handoff("degraded")
+        if fabric_import is not None and kv_import is None:
+            # a verified remote prefix frame rides the pending record
+            # (not the tiered store: it is freed with the record, so no
+            # reap path can ever leak it); admission matches hashes and
+            # scatters.  Parked bytes are still BUDGETED — a burst of
+            # hinted requests against a backed-up queue must degrade to
+            # re-prefill, not accumulate unaccounted host RAM (the same
+            # rule put_swap enforces for handoff imports).  fabric_max_
+            # bytes doubles as the parking budget; the O(requests) scan
+            # runs once per HINTED submit, never on the tick loop.
+            try:
+                blob, fhashes, fnbytes = fabric_import
+                fnbytes = int(fnbytes)
+                fh = np.asarray(fhashes, np.uint64)
+                with self._lock:
+                    # check + reserve atomically: two concurrent hinted
+                    # submits must not both observe the pre-park total
+                    # and overshoot the budget together
+                    parked = sum(p.fabric_import[2]
+                                 for p in self._requests.values()
+                                 if p.fabric_import is not None)
+                    if parked + fnbytes > self.ec.fabric_max_bytes:
+                        raise MemoryError(
+                            "fabric parking budget exhausted")
+                    pending.fabric_import = (blob, fh, fnbytes)
+                self.telemetry.count_fabric("import")
+                self.telemetry.count_fabric_bytes("in", fnbytes)
+            except Exception:  # noqa: BLE001 — import must degrade
+                pending.fabric_import = None
+                pending.fabric_restore = "degraded"
+                pending.waste_reason = "fabric_degraded"
+                self.telemetry.count_fabric("degraded")
         # the request now waits in the HOST scheduler queue; the engine
         # loop submits it to the C++ core only when the policy admits it
         # (per-tick admission — the Orca iteration-level scheduling point)
@@ -984,13 +1077,14 @@ class Engine:
                  deadline: Optional[float] = None,
                  priority: Optional[str] = None,
                  session_id: Optional[str] = None,
-                 handoff: bool = False, kv_import=None,
+                 handoff: bool = False, kv_import=None, fabric_import=None,
                  trace=None, links: Optional[list] = None,
                  waste_hint: Optional[str] = None) -> dict:
         fut = self.generate_async(tokens, max_new_tokens, adapter=adapter,
                                   deadline=deadline, priority=priority,
                                   session_id=session_id, handoff=handoff,
-                                  kv_import=kv_import, trace=trace,
+                                  kv_import=kv_import,
+                                  fabric_import=fabric_import, trace=trace,
                                   links=links, waste_hint=waste_hint)
         try:
             return fut.result(timeout=timeout)
@@ -1084,6 +1178,7 @@ class Engine:
                         priority: Optional[str] = None,
                         session_id: Optional[str] = None,
                         kv_import=None,
+                        fabric_import=None,
                         trace=None,
                         links: Optional[list] = None,
                         waste_hint: Optional[str] = None) -> Iterator:
@@ -1102,6 +1197,7 @@ class Engine:
                                   adapter=adapter, deadline=deadline,
                                   priority=priority, session_id=session_id,
                                   kv_import=kv_import,
+                                  fabric_import=fabric_import,
                                   trace=trace, links=links,
                                   waste_hint=waste_hint)
 
@@ -1159,8 +1255,12 @@ class Engine:
                 "trace_history_bytes": self._trace_ring_bytes,
                 "role": self.ec.role,
                 "handoff": self._handoffs.stats(),
+                **({"fabric": self._fabric.stats()}
+                   if self._fabric is not None else {}),
                 **({"handoff_chaos": self._handoff_chaos.stats()}
                    if self._handoff_chaos is not None else {}),
+                **({"fabric_chaos": self._fabric_chaos.stats()}
+                   if self._fabric_chaos is not None else {}),
                 **({"slo": self.telemetry.slo.snapshot()}
                    if self.telemetry.slo is not None else {}),
                 **({"chaos": self._chaos.stats()} if self._chaos else {}),
@@ -1218,6 +1318,10 @@ class Engine:
             "owned_pages": owned,
             "committed_tokens": toks,
             "fragmentation": round(frag, 6),
+            # fleet KV fabric (README "Fleet KV fabric"): the published-
+            # prefix listing the router's cache-aware placement matches
+            # request fingerprints against, via /fleet/cache
+            "fabric": self.fabric_view(),
         }
         snap["timeline"] = self.timeline.snapshot()
         snap["profiler"] = {
@@ -1989,8 +2093,37 @@ class Engine:
         off = cached * self.ec.page_size
         if pending.session_id is not None and pending.session_restore is None:
             off = self._restore_session(slot, pending, cached)
+        if pending.fabric_import is not None:
+            # fleet KV fabric fault-in (README "Fleet KV fabric"): scatter
+            # whatever verified remote prefix pages the device cache and
+            # session restore did NOT already cover; prefill starts at the
+            # deepest covered position either way
+            off = max(off, self._restore_fabric(
+                slot, pending, off // self.ec.page_size))
         self._prefilling[slot] = off
         self._prefill_rows[slot] = self.batcher.slot_pages(slot)
+
+    def _scatter_prefix(self, slot: int, blob, covered: int,
+                        usable: int) -> None:
+        """Scatter a verified host KV blob's pages ``[covered, usable)``
+        into the slot's freshly-allocated page row — the ONE device-side
+        restore primitive behind session restore and fabric fault-in
+        (both verify hashes first; this is the part that rebinds pools).
+        The slot owns every page in the row, so the ``.set`` can never
+        write a shared prefix-cache page."""
+        row = self.batcher.slot_pages(slot)
+        pages = np.ascontiguousarray(row[covered:usable])
+        self._check_epoch()  # last fence before rebinding device pools
+        jnp = self._jnp
+        tree_map = self._jax.tree_util.tree_map
+
+        def put(pool, host):
+            return pool.at[:, pages].set(jnp.asarray(
+                np.ascontiguousarray(host[:, covered:usable])))
+
+        blob_k, blob_v = blob
+        self.k_pool = tree_map(put, self.k_pool, blob_k)
+        self.v_pool = tree_map(put, self.v_pool, blob_v)
 
     def _restore_session(self, slot: int, pending: _Pending,
                          cached: int) -> int:
@@ -2034,19 +2167,7 @@ class Engine:
                 pending.session_restore = "cache" if cached > 0 else "cold"
                 self.telemetry.count_session_restore(pending.session_restore)
                 return cached * ps
-            row = self.batcher.slot_pages(slot)
-            pages = np.ascontiguousarray(row[cached:usable])
-            self._check_epoch()  # last fence before rebinding device pools
-            jnp = self._jnp
-            tree_map = self._jax.tree_util.tree_map
-
-            def put(pool, host):
-                return pool.at[:, pages].set(
-                    jnp.asarray(np.ascontiguousarray(host[:, cached:usable])))
-
-            blob_k, blob_v = blob
-            self.k_pool = tree_map(put, self.k_pool, blob_k)
-            self.v_pool = tree_map(put, self.v_pool, blob_v)
+            self._scatter_prefix(slot, blob, cached, usable)
             pending.session_restore = outcome  # "host" | "disk"
             self.telemetry.count_session_restore(outcome)
             if pending.span is not None:
@@ -2065,6 +2186,72 @@ class Engine:
                                    "error",
                                    error=f"{type(exc).__name__}: {exc}")
             return cached * ps
+
+    def _restore_fabric(self, slot: int, pending: _Pending,
+                        covered: int) -> int:
+        """Fleet-fabric prefix fault-in (README "Fleet KV fabric"):
+        match the pulled frame's chain hashes against this prompt's,
+        scatter the verified pages past what the device cache (and any
+        session restore) already ``covered``, and return the prefill
+        offset in tokens.  The scatter is the session-restore pattern
+        verbatim — freshly-owned slot pages, never shared cache pages.
+
+        Degrades, never fails: a hash mismatch from page 0 (stale or
+        wrong frame — the router's text fingerprints are a heuristic,
+        THIS check is the correctness gate), a frame the local state
+        already covers, or any scatter error falls back to the plain
+        prefill offset; the recomputed prefix is fleet-level waste,
+        attributed ``fabric_degraded``.  ``pending.fabric_restore``
+        records the outcome for the result dict and
+        engine_kv_fabric_total."""
+        ps = self.ec.page_size
+        blob, fhashes, nbytes = pending.fabric_import
+        pending.fabric_import = None  # freed either way — blobs are MBs
+        t0 = time.perf_counter()
+        try:
+            own = pending.page_hashes
+            plen = len(pending.tokens)
+            limit = min(len(fhashes), len(own), max(0, (plen - 1) // ps))
+            usable = 0
+            while usable < limit and own[usable] == fhashes[usable]:
+                usable += 1
+            if usable == 0:
+                # the frame shares nothing with this prompt: the pull was
+                # wasted and the whole prefix recomputes locally
+                pending.fabric_restore = "degraded"
+                pending.waste_reason = (pending.waste_reason
+                                        or "fabric_degraded")
+                self.telemetry.count_fabric("degraded")
+                return covered * ps
+            if usable <= covered:
+                # local state (device cache / session restore) already
+                # reaches at least as deep — nothing to scatter, nothing
+                # recomputed: not a degrade, just a no-op import
+                pending.fabric_restore = "local"
+                self.telemetry.count_fabric("local")
+                return covered * ps
+            self._scatter_prefix(slot, blob, covered, usable)
+            pending.fabric_restore = "hit"
+            self.telemetry.count_fabric("hit")
+            if pending.span is not None:
+                pending.span.mark("fabric_restore")
+            if self.ec.telemetry:
+                self._flight_event(
+                    "fabric_restore", [slot],
+                    {"pages": int(usable - covered), "covered": covered,
+                     "bytes": nbytes}, t0, "ok")
+            return usable * ps
+        except _StaleThread:
+            raise
+        except Exception as exc:  # noqa: BLE001 — restore must degrade
+            pending.fabric_restore = "degraded"
+            pending.waste_reason = pending.waste_reason or "fabric_degraded"
+            self.telemetry.count_fabric("degraded")
+            if self.ec.telemetry:
+                self._flight_event("fabric_restore", [slot], None, t0,
+                                   "error",
+                                   error=f"{type(exc).__name__}: {exc}")
+            return covered * ps
 
     def _resume_swapped(self, slot: int, pending: _Pending, item) -> None:
         """Swap-in: scatter the evicted KV pages from the host store into
@@ -3538,6 +3725,12 @@ class Engine:
         handoff_rec = None
         if pending.handoff and not cancelled:
             handoff_rec = self._export_handoff(slot, pending, cache_ok)
+        # fleet-fabric publish, same before-the-mirrors-zero window: the
+        # finishing request's committed full-page prefix becomes pullable
+        # by every other replica.  Handoff prefill phases skip it — their
+        # pages already leave through the (one-shot) handoff store.
+        if self._fabric is not None and not cancelled and not pending.handoff:
+            self._publish_fabric(slot, pending, cache_ok)
         self._release_slot_state(slot)  # freed slots decode as zero adapter
         # hand the prompt's full pages to the prefix cache on the way out —
         # unless the prefill never finished (cancel mid-prefill): those pages
@@ -3564,6 +3757,8 @@ class Engine:
         }
         if handoff_rec is not None:
             result["handoff"] = handoff_rec
+        if pending.fabric_restore is not None:
+            result["fabric"] = {"restore": pending.fabric_restore}
         if pending.session_id is not None:
             # "evicted" is a COUNT, not the ids: session ids are bearer
             # capabilities (kvstore.normalize_session_id), so leaking
@@ -3644,6 +3839,99 @@ class Engine:
                                    "error",
                                    error=f"{type(exc).__name__}: {exc}")
             return {"error": f"{type(exc).__name__}: {exc}"}
+
+    def _publish_fabric(self, slot: int, pending: _Pending,
+                        cache_ok: bool) -> None:
+        """Fleet-fabric publish (README "Fleet KV fabric"): snapshot the
+        finishing request's committed FULL pages — the session-pin
+        geometry: positions [0, L-2], full pages only — frame them
+        KVPG/CRC keyed by the prefix's deepest chain hash, and register
+        the frame in the multi-reader FabricStore, where any replica can
+        pull it via ``GET /engine/kv_fabric/<key>``.  The frame's meta
+        carries the per-page chain hashes (the puller's correctness gate)
+        and the text fingerprint ladder (the router's placement key).
+
+        Degrades, never raises: a failed publish costs the FLEET a share,
+        not this request anything — the pages still release to the local
+        prefix cache right after."""
+        if not cache_ok:
+            return
+        ps = self.ec.page_size
+        L = int(self._len_host[slot])
+        covered = max(0, (L - 1) // ps)
+        covered = min(covered, int(np.count_nonzero(self._pt_host[slot])))
+        if covered < max(1, self.ec.fabric_min_pages):
+            return
+        t0 = time.perf_counter()
+        try:
+            hashes = self._page_hashes(pending.context,
+                                       pending.adapter_id)[:covered]
+            key = fabric_key(hashes[-1])
+            if self._fabric.covers(key, covered):
+                # identical prefix already published and live: skip the
+                # expensive device->host snapshot (the store check is the
+                # cheap half by design)
+                self.telemetry.count_fabric("publish_skipped")
+                return
+            fps = []
+            if self.fabric_fingerprinter is not None:
+                fps = self.fabric_fingerprinter(
+                    pending.context[:covered * ps]) or []
+            row = np.ascontiguousarray(self._pt_host[slot, :covered])
+            tree_map = self._jax.tree_util.tree_map
+            fetch = lambda leaf: np.asarray(leaf[:, row])  # noqa: E731
+            blob = (tree_map(fetch, self.k_pool),
+                    tree_map(fetch, self.v_pool))
+            meta = {"hashes": [int(h) for h in hashes], "pages": covered,
+                    "page_size": ps, "adapter_id": pending.adapter_id,
+                    "model": self.fabric_model_id, "fps": fps}
+            data, nbytes, _ = pack_frame(f"fabric/{key}", blob, meta)
+            ttl = None
+            if (self._fabric_chaos is not None
+                    and self._fabric_chaos.expire_publish()):
+                ttl = 0.0  # chaos: every later pull must find it expired
+            ok = self._fabric.publish(key, data, meta, ttl_s=ttl)
+            self.telemetry.count_fabric("publish" if ok
+                                        else "publish_failed")
+            if self.ec.telemetry:
+                self._flight_event(
+                    "fabric_publish", [slot],
+                    {"key": key, "pages": covered, "bytes": nbytes},
+                    t0, "ok" if ok else "rejected")
+        except Exception as exc:  # noqa: BLE001 — publish must degrade
+            self.telemetry.count_fabric("publish_failed")
+            if self.ec.telemetry:
+                self._flight_event("fabric_publish", [slot], None, t0,
+                                   "error",
+                                   error=f"{type(exc).__name__}: {exc}")
+
+    def pull_fabric(self, key: str,
+                    count_miss: bool = True) -> Optional[bytes]:
+        """Serve one published prefix frame to a pulling replica
+        (``GET /engine/kv_fabric/<key>``).  MULTI-READER: unlike a
+        handoff handle, a fabric key is pulled as many times as the fleet
+        wants — every reader past the first is the sharing the fabric
+        exists for.  None on expired / unknown keys (the puller degrades
+        to re-prefill).  ``count_miss=False``: a multi-model server
+        probing every engine for the owner must not charge a miss to the
+        ones that never published it."""
+        if self._fabric is None:
+            return None
+        outcome, data = self._fabric.pull(key, count_miss=count_miss)
+        if outcome != "miss" or count_miss:
+            self.telemetry.count_fabric(
+                {"ok": "pull", "expired": "expired",
+                 "miss": "miss"}[outcome])
+        if data is not None:
+            self.telemetry.count_fabric_bytes("out", len(data))
+        return data
+
+    def fabric_view(self) -> list:
+        """The placement-facing listing of this replica's live published
+        prefixes (kvfabric.FabricStore.view) — rides the cache analytics
+        block of ``GET /engine/perf`` into the proxy's ``/fleet/cache``
+        view, which is what the router's cache-aware placement scores."""
+        return self._fabric.view() if self._fabric is not None else []
 
     def pull_handoff(self, handle: str,
                      count_miss: bool = True) -> Optional[bytes]:

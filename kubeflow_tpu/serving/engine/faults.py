@@ -426,6 +426,117 @@ class HandoffChaos:
             }
 
 
+@dataclasses.dataclass(frozen=True)
+class FabricFaultConfig:
+    """Seeded fault plan for the fleet KV fabric (serving/kvfabric.py,
+    README "Fleet KV fabric").  Frozen (rides in the frozen EngineConfig
+    as ``fabric_chaos``); all-defaults == inject nothing.  ``*_on`` are
+    1-based pull/publish ordinals (-1 = off); ``*_every`` fire on every
+    Nth (0 = off).  Every injection must leave the request COMPLETED via
+    the degraded re-prefill path with zero leaked KV pages on both
+    replicas — asserted by tests/test_fabric.py and ``serving_bench
+    --fabric``."""
+
+    seed: int = 0
+    # truncate the Nth pulled frame to half (socket closed mid-body); the
+    # KVPG magic/length verifier must catch it
+    torn_pull_on: int = -1
+    torn_pull_every: int = 0
+    # flip one payload bit in the Nth pulled frame; the CRC32 must catch it
+    flip_pull_on: int = -1
+    flip_pull_every: int = 0
+    # chronically slow fabric link: sleep this long on matching pulls (a
+    # sleep past the serve layer's pull timeout degrades to re-prefill)
+    slow_pull_s: float = 0.0
+    slow_pull_every: int = 0
+    # raise ConnectionError on the Nth pull — the owner replica (or the
+    # link) dying mid-pull
+    dead_link_on: int = -1
+    dead_link_every: int = 0
+    # the Nth PUBLISH registers with an already-lapsed TTL, so a later
+    # pull finds the entry expired
+    expire_publish_on: int = -1
+    expire_publish_every: int = 0
+
+
+class FabricChaos:
+    """Runtime half of FabricFaultConfig: ``on_pull(data) -> data`` wraps
+    a pulling replica's fetched bytes (may truncate, flip a bit, sleep,
+    or raise); ``expire_publish()`` is consulted by the publishing engine
+    per publish (True = register the entry pre-expired).  Thread-safe:
+    HTTP handler threads pull while the engine loop publishes."""
+
+    def __init__(self, config: FabricFaultConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self.pulls = 0
+        self.publishes = 0
+        self.injected_torn_pulls = 0
+        self.injected_flipped_pulls = 0
+        self.injected_slow_pulls = 0
+        self.injected_dead_links = 0
+        self.injected_expired_publishes = 0
+
+    @staticmethod
+    def _hit(n: int, on: int, every: int) -> bool:
+        return (on > 0 and n == on) or (every > 0 and n % every == 0)
+
+    def on_pull(self, data: bytes) -> bytes:
+        c = self.config
+        with self._lock:
+            self.pulls += 1
+            n = self.pulls
+            if self._hit(n, c.dead_link_on, c.dead_link_every):
+                self.injected_dead_links += 1
+                raise ConnectionError(
+                    f"injected dead fabric link (chaos, pull {n})")
+            slow = (c.slow_pull_s > 0 and c.slow_pull_every > 0
+                    and n % c.slow_pull_every == 0)
+            if slow:
+                self.injected_slow_pulls += 1
+            torn = self._hit(n, c.torn_pull_on, c.torn_pull_every)
+            if torn:
+                self.injected_torn_pulls += 1
+            flip = self._hit(n, c.flip_pull_on, c.flip_pull_every)
+            if flip:
+                self.injected_flipped_pulls += 1
+        if slow:
+            time.sleep(c.slow_pull_s)
+        if torn:
+            return data[:max(8, len(data) // 2)]
+        if flip and len(data) > 16:
+            # flip a PAYLOAD bit (past magic + lengths + a header margin)
+            # so the CRC verifier — not the JSON parser — is what catches
+            # it, the bit-rot case the checksum exists for
+            out = bytearray(data)
+            out[-3] ^= 0x20
+            return bytes(out)
+        return data
+
+    def expire_publish(self) -> bool:
+        c = self.config
+        with self._lock:
+            self.publishes += 1
+            hit = self._hit(self.publishes, c.expire_publish_on,
+                            c.expire_publish_every)
+            if hit:
+                self.injected_expired_publishes += 1
+            return hit
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "fabric_pulls": self.pulls,
+                "fabric_publishes": self.publishes,
+                "injected_torn_pulls": self.injected_torn_pulls,
+                "injected_flipped_pulls": self.injected_flipped_pulls,
+                "injected_slow_pulls": self.injected_slow_pulls,
+                "injected_dead_links": self.injected_dead_links,
+                "injected_expired_publishes":
+                    self.injected_expired_publishes,
+            }
+
+
 # --------------------------------------------------------------- fleet scope
 
 

@@ -65,7 +65,8 @@ from typing import Optional
 
 # canonical waste-attribution reasons (README "Performance introspection")
 WASTE_REASONS = ("spec_reject", "preempt_recompute", "handoff_degraded",
-                 "failover_reprefill", "tick_retry", "pipeline_drop")
+                 "fabric_degraded", "failover_reprefill", "tick_retry",
+                 "pipeline_drop")
 
 # dispatch kinds the ledger buckets by
 DISPATCH_KINDS = ("prefill", "decode", "verify")
@@ -322,7 +323,11 @@ class CacheStats:
     diverged / aged out mid-prefix).  Reuse counts key on the deepest
     matched chain hash — chain hashing makes that a unique identity for
     the whole reused prefix (a popular system prompt shows up as one hot
-    key), bounded LRU so a high-cardinality workload cannot grow it."""
+    key), bounded LRU so a high-cardinality workload cannot grow it.
+    Each entry also keeps the prefix's PAGE COUNT (the deepest hit depth
+    seen under that key): the fleet KV fabric's placement scorer weighs
+    bytes saved per reuse, not just hit counts — two prefixes with equal
+    reuse but 2 vs 20 pages are very different placement prizes."""
 
     _REUSE_CAP = 512
 
@@ -331,7 +336,8 @@ class CacheStats:
         self.lookups = 0
         self.hit_pages = 0
         self.miss_pages = {"cold": 0, "partial": 0}
-        self._reuse: "collections.OrderedDict[str, int]" = \
+        # key -> [reuses, pages]; insertion order is the LRU order
+        self._reuse: "collections.OrderedDict[str, list]" = \
             collections.OrderedDict()
 
     def note_lookup(self, requested: int, hit: int,
@@ -347,20 +353,24 @@ class CacheStats:
                 self.miss_pages[reason] += requested - hit
             if hit > 0 and key is not None:
                 k = f"{int(key):016x}"
-                self._reuse[k] = self._reuse.pop(k, 0) + 1
+                rec = self._reuse.pop(k, None) or [0, 0]
+                rec[0] += 1
+                rec[1] = max(rec[1], hit)
+                self._reuse[k] = rec
                 while len(self._reuse) > self._REUSE_CAP:
                     self._reuse.popitem(last=False)
 
     def snapshot(self, top: int = 16) -> dict:
         with self._lock:
-            hot = sorted(self._reuse.items(), key=lambda kv: -kv[1])[:top]
+            hot = sorted(self._reuse.items(), key=lambda kv: -kv[1][0])[:top]
             return {
                 "lookups": self.lookups,
                 "hit_pages": self.hit_pages,
                 "miss_pages": dict(self.miss_pages),
                 "tracked_prefixes": len(self._reuse),
                 "top_reused_prefixes": [
-                    {"prefix": k, "reuses": v} for k, v in hot],
+                    {"prefix": k, "reuses": v[0], "pages": v[1]}
+                    for k, v in hot],
             }
 
 

@@ -24,6 +24,7 @@ import urllib.request
 from typing import Any, Optional
 
 from ...core import tracing
+from .. import kvfabric
 from ..server import Model
 from ..errors import EngineError, RequestError
 from .engine import Engine, EngineConfig
@@ -128,6 +129,9 @@ def _checkout_eos_ids(model_dir: str) -> list:
 # chars; the decode phase interpolates them into a URL, so the shape is
 # enforced at parse time (serving/disagg.py)
 _HANDOFF_HANDLE_RE = re.compile(r"[0-9a-f]{32}")
+# fabric keys are the 16-hex chain-hash rendering (serving/kvfabric.py);
+# same URL-interpolation rule, same SSRF guard
+_FABRIC_KEY_RE = kvfabric.KEY_RE
 
 
 class JetStreamModel(Model):
@@ -138,6 +142,27 @@ class JetStreamModel(Model):
         self.model_dir = model_dir
         self.engine = engine
         self.tokenizer = load_tokenizer(model_dir)
+        if engine is not None:
+            self._wire_fabric(engine)
+
+    def _wire_fabric(self, engine: Engine) -> None:
+        """Give the engine the tokenizer-aware fingerprint function its
+        fabric publishes need (README "Fleet KV fabric"): token prefix ->
+        decoded text -> kvfabric.fingerprints ladder, the representation
+        the router can recompute from any request body.  Exact for the
+        byte tokenizer (chars == tokens); a heuristic otherwise — a
+        mismatch costs a missed placement, never correctness (the engine
+        verifies chain hashes before scattering)."""
+        tok = self.tokenizer
+
+        def fingerprint(token_ids):
+            try:
+                return kvfabric.fingerprints(tok.decode(list(token_ids)))
+            except Exception:  # noqa: BLE001 — publishes must not fail
+                return []
+
+        engine.fabric_fingerprinter = fingerprint
+        engine.fabric_model_id = self.name
 
     def load(self) -> None:
         if self.engine is None:
@@ -202,6 +227,13 @@ class JetStreamModel(Model):
 
                     kw["handoff_chaos"] = HandoffFaultConfig(
                         **kw["handoff_chaos"])
+                if isinstance(kw.get("fabric_chaos"), dict):
+                    # fleet KV fabric chaos straight from an engine.json
+                    # (README "Fleet KV fabric")
+                    from .faults import FabricFaultConfig
+
+                    kw["fabric_chaos"] = FabricFaultConfig(
+                        **kw["fabric_chaos"])
                 if isinstance(kw.get("kv_store"), dict):
                     # tiered KV / session durability straight from an
                     # engine.json (README "Sessions & tiered KV"): point
@@ -257,6 +289,7 @@ class JetStreamModel(Model):
                     ec = dataclasses.replace(ec, eos_id=eos[0],
                                              eos_ids=tuple(eos[1:]))
             self.engine = Engine(params, config, ec, lora=lora)
+            self._wire_fabric(self.engine)
         self.engine.start()
         self.ready = True
 
@@ -545,6 +578,40 @@ class JetStreamModel(Model):
                 out[k] = 0.0
         return kv_handoff, out
 
+    @staticmethod
+    def _parse_fabric_params(payload: Any):
+        """Fleet-fabric pull hint (README "Fleet KV fabric") ->
+        ``parameters.fabric = {key, source_port, pages}`` or None.  The
+        router injects it when placement lands a request away from the
+        replica holding its deepest published prefix; the serve layer
+        pulls the frame from the owner before submitting.  Raises
+        RequestError (-> 400) on malformed blocks — the key and port
+        interpolate into a localhost URL, so shape is the SSRF guard,
+        same rule as handoff handles."""
+        params = (payload.get("parameters") or {}) \
+            if isinstance(payload, dict) else {}
+        if not isinstance(params, dict):
+            return None
+        fab = params.get("fabric")
+        if fab is None:
+            return None
+        if not isinstance(fab, dict):
+            raise RequestError(f"fabric must be an object, got {fab!r}")
+        key = fab.get("key")
+        if (not isinstance(key, str)
+                or not _FABRIC_KEY_RE.fullmatch(key)):
+            raise RequestError(f"fabric.key must be a 16-char hex chain "
+                               f"hash, got {key!r}")
+        port = fab.get("source_port")
+        if not isinstance(port, int) or not 0 < port < 65536:
+            raise RequestError(f"fabric.source_port must be a port "
+                               f"number, got {port!r}")
+        try:
+            pages = int(fab.get("pages") or 0)
+        except (TypeError, ValueError):
+            pages = 0
+        return {"key": key, "source_port": port, "pages": pages}
+
     def generate(self, payload: Any, headers: Optional[dict] = None) -> Any:
         """V2 generate extension (unary): {"text_input": str, "parameters":
         {"max_tokens": N, "deadline_s": S, "priority": "interactive" |
@@ -559,13 +626,19 @@ class JetStreamModel(Model):
         ids, max_tokens, adapter, deadline, priority, resume, session = \
             self._parse_generate(payload, headers)
         kv_handoff, hand = self._parse_disagg_params(payload)
+        fab = self._parse_fabric_params(payload)
+        if fab is not None and hand is not None:
+            # a decode phase imports the FULL prompt KV via its handoff —
+            # a prefix pull on top is contradictory, refuse loudly
+            raise RequestError(
+                "fabric and handoff are mutually exclusive")
         if kv_handoff:
             if session is not None or resume or hand is not None:
                 raise RequestError(
                     "kv_handoff composes with none of session_id, "
                     "resume_token_ids or handoff")
             return self._prefill_phase(ids, max_tokens, adapter, deadline,
-                                       priority, headers)
+                                       priority, headers, fab=fab)
         if hand is not None:
             if resume:
                 raise RequestError(
@@ -581,16 +654,31 @@ class JetStreamModel(Model):
             return {"text_output": "", "token_ids": [],
                     "tokens": len(resume), "prompt_tokens": len(ids),
                     "max_tokens": max_tokens, "ttft_s": 0.0, "latency_s": 0.0}
+        fimp, pull_s = None, 0.0
+        if fab is not None:
+            # the pull sits on the client's critical path: its wall time
+            # (up to the pull budget on a slow link) belongs in the
+            # reported TTFT and latency — the same honest-metrics rule
+            # the disaggregation handoff pull follows
+            t_pull = time.perf_counter()
+            fimp = self._fabric_import(fab, adapter)
+            pull_s = time.perf_counter() - t_pull
         r = self.engine.generate(ids + resume, max_new, adapter=adapter,
                                  deadline=deadline, priority=priority,
-                                 session_id=session,
+                                 session_id=session, fabric_import=fimp,
                                  trace=self._trace_ctx(headers),
                                  links=self._resume_link(headers),
                                  # a failover re-admission re-prefills
                                  # tokens the dead replica already
-                                 # produced: waste, attributed
+                                 # produced: waste, attributed — as is a
+                                 # fabric pull that degraded before submit
+                                 # (the prefix recomputes locally)
                                  waste_hint=("failover_reprefill"
-                                             if resume else None))
+                                             if resume else
+                                             "fabric_degraded"
+                                             if (fab is not None
+                                                 and fimp is None)
+                                             else None))
         # the seam slices at the STABLE prefix of the resumed text: resume
         # ids may end mid-UTF-8 sequence, whose completed decoding spans a
         # different char count than its U+FFFD placeholders (same rule as
@@ -602,9 +690,16 @@ class JetStreamModel(Model):
                "token_ids": r["tokens"],
                "tokens": r["num_tokens"] + len(resume),
                "prompt_tokens": len(ids), "max_tokens": max_tokens,
-               "ttft_s": round(r["ttft_s"], 4), "latency_s": round(r["latency_s"], 4)}
+               "ttft_s": round(pull_s + r["ttft_s"], 4),
+               "latency_s": round(pull_s + r["latency_s"], 4)}
         if "session" in r:
             out["session"] = r["session"]
+        if "fabric" in r:
+            out["fabric"] = r["fabric"]
+        elif fab is not None and fimp is None:
+            # the pull itself degraded (before submit): the client still
+            # sees the honest outcome, same surface as an engine-side one
+            out["fabric"] = {"restore": "degraded"}
         if self._wants_trace(headers):
             out["trace"] = self.engine.trace(r["rid"])
         return out
@@ -620,25 +715,38 @@ class JetStreamModel(Model):
     _HANDOFF_PULL_TIMEOUT_S = 10.0
 
     def _prefill_phase(self, ids: list, max_tokens: int, adapter, deadline,
-                       priority, headers) -> dict:
+                       priority, headers, fab=None) -> dict:
         """``parameters.kv_handoff: true``: run the prompt through the
         ordinary (chunked-)prefill machinery, sample exactly the first
         token a unified engine would, export the committed KV pages, and
         answer with the token + the one-shot pull handle.  ``complete``
         tells the proxy no decode phase is needed (EOS on the first
-        token, or max_tokens == 1)."""
+        token, or max_tokens == 1).  A fabric hint composes: a prefill
+        replica is exactly who profits from faulting a popular prefix in
+        before prefilling the tail (its pull time joins the phase's
+        reported TTFT/latency — this phase IS the request's TTFT)."""
+        fimp, pull_s = None, 0.0
+        if fab is not None:
+            t_pull = time.perf_counter()
+            fimp = self._fabric_import(fab, adapter)
+            pull_s = time.perf_counter() - t_pull
         r = self.engine.generate(ids, 1, adapter=adapter, deadline=deadline,
                                  priority=priority, handoff=True,
+                                 fabric_import=fimp,
                                  trace=self._trace_ctx(headers),
-                                 links=self._resume_link(headers))
+                                 links=self._resume_link(headers),
+                                 waste_hint=("fabric_degraded"
+                                             if (fab is not None
+                                                 and fimp is None)
+                                             else None))
         toks = r["tokens"]
         stop_ids = getattr(self.engine, "_stop_ids", frozenset())
         complete = bool(toks and toks[-1] in stop_ids) \
             or max_tokens <= len(toks)
         out = {"token_ids": toks, "prompt_tokens": len(ids),
                "max_tokens": max_tokens, "complete": complete,
-               "ttft_s": round(r["ttft_s"], 4),
-               "latency_s": round(r["latency_s"], 4)}
+               "ttft_s": round(pull_s + r["ttft_s"], 4),
+               "latency_s": round(pull_s + r["latency_s"], 4)}
         if "handoff" in r:
             out["handoff"] = dict(r["handoff"])
             if complete and out["handoff"].get("handle"):
@@ -715,6 +823,94 @@ class JetStreamModel(Model):
             tele.count_handoff("degraded")
             return None
         return blob, int(header.get("nbytes") or 0), resume_len
+
+    _FABRIC_PULL_TIMEOUT_S = 5.0
+
+    def _fabric_import(self, fab: dict, adapter):
+        """Pull + verify a remote replica's published prefix frame
+        (README "Fleet KV fabric") -> ``(blob, hashes, nbytes)`` for
+        ``Engine.generate(fabric_import=)``, or None — degrade to plain
+        re-prefill — on ANY problem: unreachable/slow/dead owner, torn
+        transfer (KVPG magic/length), bit flip (CRC32), geometry/adapter
+        mismatch with this engine's pools, missing chain hashes.  The
+        wire format IS kvstore.py's page-file format, so the verifier
+        comes for free; the engine re-checks the chain hashes against the
+        actual prompt before scattering a single page."""
+        tele = self.engine.telemetry
+        chaos = getattr(self.engine, "_fabric_chaos", None)
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{int(fab['source_port'])}"
+                    f"/engine/kv_fabric/{fab['key']}",
+                    timeout=self._FABRIC_PULL_TIMEOUT_S) as r:
+                data = r.read()
+            if chaos is not None:
+                data = chaos.on_pull(data)  # may truncate/flip/sleep/raise
+            blob, header = unpack_frame(data)
+            if (time.perf_counter() - t0) > self._FABRIC_PULL_TIMEOUT_S:
+                # the budget bounds the WHOLE fetch+verify, not just the
+                # socket: a chronically slow link must not hold the
+                # admission path hostage for a prefix the tail prefill
+                # could have recomputed meanwhile
+                raise TimeoutError("fabric pull overran its budget")
+        except KVStoreCorrupt:  # torn transfer / bit flip: caught exactly
+            tele.count_fabric("degraded")
+            return None
+        except Exception:  # noqa: BLE001 — dead link, slow past timeout,
+            tele.count_fabric("degraded")  # 404 (expired/evicted/unknown)
+            return None
+        try:
+            meta = header.get("meta") or {}
+            ec = self.engine.ec
+            hashes = meta.get("hashes")
+            pages = int(meta.get("pages") or 0)
+            aid = self.engine.adapters.get(adapter, 0) \
+                if adapter is not None else 0
+            if (meta.get("page_size") != ec.page_size or pages < 1
+                    or not isinstance(hashes, list) or len(hashes) < pages
+                    or int(meta.get("adapter_id") or 0) != aid
+                    # model identity: chain hashes seed on tokens, not
+                    # weights — a same-shape SIBLING model's frame would
+                    # pass every other gate and decode silently wrong
+                    or meta.get("model") != self.name
+                    or not (isinstance(blob, tuple) and len(blob) == 2)):
+                raise ValueError("fabric meta mismatch")
+            import jax
+
+            for side, pool in ((blob[0], self.engine.k_pool),
+                               (blob[1], self.engine.v_pool)):
+                bl = jax.tree_util.tree_leaves(side)
+                pl = jax.tree_util.tree_leaves(pool)
+                if len(bl) != len(pl):
+                    raise ValueError("fabric blob leaf-count mismatch")
+                for b, p in zip(bl, pl):
+                    # a prefix frame must cover exactly its declared page
+                    # count — an under-covering frame would scatter
+                    # partial KV and decode silently from garbage
+                    if (b.ndim != p.ndim or b.shape[0] != p.shape[0]
+                            or tuple(b.shape[2:]) != tuple(p.shape[2:])
+                            or b.dtype != p.dtype or b.shape[1] != pages):
+                        raise ValueError(
+                            f"fabric leaf {b.shape}/{b.dtype} does not "
+                            f"fit pool {p.shape}/{p.dtype}")
+        except Exception:  # noqa: BLE001 — degrade, never fail
+            tele.count_fabric("degraded")
+            return None
+        return blob, [int(h) for h in hashes[:pages]], \
+            int(header.get("nbytes") or 0)
+
+    def pull_fabric(self, key: str,
+                    count_miss: bool = True) -> Optional[bytes]:
+        """Serve one published prefix frame (GET /engine/kv_fabric/<key>,
+        server.py).  Multi-reader: never consumed.  None = unknown or
+        expired (the puller degrades to re-prefill)."""
+        if self.engine is None:
+            return None
+        try:
+            return self.engine.pull_fabric(key, count_miss=count_miss)
+        except Exception:  # noqa: BLE001 — a pull must answer
+            return None
 
     def _decode_phase_unary(self, ids: list, max_tokens: int, adapter,
                             deadline, priority, session, hand: dict,
@@ -817,6 +1013,10 @@ class JetStreamModel(Model):
         ids, max_tokens, adapter, deadline, priority, resume, session = \
             self._parse_generate(payload, headers)
         kv_handoff, hand = self._parse_disagg_params(payload)
+        fab = self._parse_fabric_params(payload)
+        if fab is not None and hand is not None:
+            raise RequestError(
+                "fabric and handoff are mutually exclusive")
         if kv_handoff:
             raise RequestError(
                 "kv_handoff is the unary prefill-phase parameter; "
@@ -854,18 +1054,31 @@ class JetStreamModel(Model):
         max_new = max_tokens - len(resume)
         if resume and max_new <= 0:
             return self._resume_complete(resume, ids, max_tokens)
+        fimp, pull_s = None, 0.0
+        if fab is not None:
+            # pull wall time joins the final record's TTFT/latency — the
+            # client's clock started before the pull, not after it
+            t_pull = time.perf_counter()
+            fimp = self._fabric_import(fab, adapter)
+            pull_s = time.perf_counter() - t_pull
         stream = self.engine.generate_stream(ids + resume, max_new,
                                              adapter=adapter,
                                              deadline=deadline,
                                              priority=priority,
                                              session_id=session,
+                                             fabric_import=fimp,
                                              trace=self._trace_ctx(headers),
                                              links=self._resume_link(headers),
                                              waste_hint=("failover_reprefill"
-                                                         if resume else None))
+                                                         if resume else
+                                                         "fabric_degraded"
+                                                         if (fab is not None
+                                                             and fimp is None)
+                                                         else None))
         return self._stream_pieces(stream, ids, max_tokens,
                                    with_trace=self._wants_trace(headers),
-                                   emit_ids=emit_ids, prior_ids=resume)
+                                   emit_ids=emit_ids, prior_ids=resume,
+                                   pull_s=pull_s)
 
     @staticmethod
     def _stable_len(full: str, floor: int = 0) -> int:
@@ -894,7 +1107,8 @@ class JetStreamModel(Model):
                        prior_ids: Optional[list] = None,
                        prior_emitted: bool = True,
                        phase_ttft: float = 0.0,
-                       phase_latency: float = 0.0):
+                       phase_latency: float = 0.0,
+                       pull_s: float = 0.0):
         out_ids: list[int] = list(prior_ids or [])
         base = len(out_ids)
         # prior_emitted (failover resume): text already delivered by the
@@ -917,13 +1131,18 @@ class JetStreamModel(Model):
                              "prompt_tokens": len(ids), "max_tokens": max_tokens,
                              # a disaggregated decode phase folds the
                              # prefill phase's wall time in: the client's
-                             # first token came out of THAT phase
+                             # first token came out of THAT phase.  A
+                             # fabric pull (pull_s) ran BEFORE submit, so
+                             # it shifts both TTFT and latency.
                              "ttft_s": round(phase_ttft if phase_ttft > 0
-                                             else item["ttft_s"], 4),
-                             "latency_s": round(phase_latency
+                                             else pull_s + item["ttft_s"],
+                                             4),
+                             "latency_s": round(phase_latency + pull_s
                                                 + item["latency_s"], 4)}
                     if "session" in item:
                         final["session"] = item["session"]
+                    if "fabric" in item:
+                        final["fabric"] = item["fabric"]
                     if with_trace:
                         final["trace"] = self.engine.trace(item["rid"])
                     yield final

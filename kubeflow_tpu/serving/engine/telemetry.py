@@ -334,6 +334,26 @@ class EngineTelemetry:
             "engine_kv_handoff_bytes_total",
             "disaggregation KV handoff payload bytes by direction "
             "(out=served to pullers, in=imported)")
+        # Fleet KV fabric surface (README "Fleet KV fabric"): shared-
+        # prefix lifecycle outcomes — publish / publish_skipped /
+        # publish_failed on the owner side; pull / miss / expired as the
+        # store answers remote pullers (multi-reader: no refused state);
+        # import (a placement hint accepted at submit) / hit (remote
+        # pages scattered into the local pool) / local (the device cache
+        # or session restore already covered everything the frame held) /
+        # degraded (any fabric failure fell back to plain re-prefill,
+        # which still completes the request) — and payload bytes by
+        # direction (out = frames served to pullers, in = frames
+        # imported).
+        self.kv_fabric = r.counter(
+            "engine_kv_fabric_total",
+            "fleet KV fabric operations by outcome "
+            "(publish/publish_skipped/publish_failed/pull/miss/expired/"
+            "import/hit/local/degraded)")
+        self.kv_fabric_bytes = r.counter(
+            "engine_kv_fabric_bytes_total",
+            "fleet KV fabric payload bytes by direction "
+            "(out=frames served to pullers, in=frames imported)")
         # Fleet robustness surface (ISSUE 6): the engine's health state as a
         # one-hot labeled gauge so dashboards can plot state transitions —
         # the scrape-time complement of the router's active /engine/health
@@ -375,7 +395,7 @@ class EngineTelemetry:
             "engine_wasted_flops_total",
             "dispatched FLOPs attributed to waste, by reason "
             "(spec_reject/preempt_recompute/handoff_degraded/"
-            "failover_reprefill/tick_retry/pipeline_drop)")
+            "fabric_degraded/failover_reprefill/tick_retry/pipeline_drop)")
         self.mfu_ratio = r.gauge(
             "engine_mfu_ratio",
             "rolling-window analytical model-FLOPs utilization vs the "
@@ -495,6 +515,14 @@ class EngineTelemetry:
     def count_handoff_bytes(self, direction: str, nbytes: int) -> None:
         if self.enabled and nbytes:
             self.kv_handoff_bytes.inc(nbytes, direction=direction)
+
+    def count_fabric(self, outcome: str) -> None:
+        if self.enabled:
+            self.kv_fabric.inc(outcome=outcome)
+
+    def count_fabric_bytes(self, direction: str, nbytes: int) -> None:
+        if self.enabled and nbytes:
+            self.kv_fabric_bytes.inc(nbytes, direction=direction)
 
     def count_kv_event(self, tier: str, event: str) -> None:
         if self.enabled:

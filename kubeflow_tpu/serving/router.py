@@ -46,7 +46,7 @@ from typing import Optional
 from ..core import tracing
 from ..core.api import APIServer, Obj
 from ..core.metrics import REGISTRY, merge_expositions
-from . import disagg
+from . import disagg, kvfabric
 from .api import GROUP, LABEL_ISVC, LABEL_REVISION
 from .controllers import (
     DEPLOYMENT_FOR_SERVICE_ANNOTATION,
@@ -158,20 +158,31 @@ class _ProxyState:
         # backends expose no engine gauges (non-engine runtime): cached so
         # plain round-robin services don't pay per-request scrape sweeps
         self.engineless_until = 0.0
-        # prefix affinity memory: prompt-prefix -> port it was last routed
-        # to.  Affinity only applies to prefixes SEEN here before — a
-        # never-seen prompt has no cached KV anywhere, so hashing it to a
-        # replica would just randomize load (measured r5: hash-affinity on
-        # all-distinct prompts made 2 replicas no faster than 1).
+        # LEGACY prefix affinity memory: prompt-prefix -> port it was last
+        # routed to.  Affinity only applies to prefixes SEEN here before —
+        # a never-seen prompt has no cached KV anywhere, so hashing it to
+        # a replica would just randomize load (measured r5: hash-affinity
+        # on all-distinct prompts made 2 replicas no faster than 1).
+        # Superseded by the GLOBAL cache-aware placement below whenever
+        # the fleet publishes fabric prefixes (README "Fleet KV fabric");
+        # it remains the fallback for fabric-less fleets, whose only warm
+        # state is the device-local cache this map approximates.
         # Insertion-ordered; capped in _pick_engine_aware.
         self.affinity: dict[str, int] = {}
-        # fleet cache view (README "Performance introspection"): replica
-        # name -> last-known cache analytics from GET /engine/perf, the
-        # read-only global cache state ROADMAP item 3's placement will
-        # consume.  Stale entries carry their age; entries for pods that
-        # left the service are PRUNED on every refresh (pod churn must
-        # not leave phantom cache capacity in the view).
+        # fleet cache view (README "Fleet KV fabric"): replica name ->
+        # last-known cache analytics + published fabric prefixes from
+        # GET /engine/perf?view=cache — the GLOBAL cache state the
+        # cache-aware placement scores (deepest-matched-prefix wins,
+        # load-balanced tiebreak).  Refreshed in the BACKGROUND on the
+        # request path (TTL'd, single-flight — a pick never blocks on a
+        # fleet fan-out) and synchronously by GET /fleet/cache polls.
+        # Stale entries serve their last-known state annotated with age
+        # (staleness-tolerant: a wrong placement costs one degraded pull,
+        # never correctness); entries for pods that left the service are
+        # PRUNED on every refresh.
         self.cache_view: dict[str, dict] = {}
+        self.cache_view_at = 0.0     # monotonic time of the last refresh
+        self.cache_refreshing = False  # single-flight background refresh
         # fleet fault tolerance: per-backend health records + the set of
         # ports some thread is actively probing outside the lock (single-
         # flight, same discipline as `refreshing` above)
@@ -407,14 +418,30 @@ class ServiceProxy:
         # below prefers decode/unified roles (fall-back inside the pick
         # keeps an all-prefill fleet serving rather than 503ing).
         roles = ("decode", "unified")
+        split = False
+        fabric_seen: dict = {}
         if session is None and svc is not None:
-            plan = self._plan_disagg(state, svc, handler, body, payload)
+            plan = self._plan_disagg(state, svc, handler, body, payload,
+                                     fabric_out=fabric_seen)
             if plan is not None:
                 decode_body = self._disagg_prefill(
                     state, svc, handler, plan, fwd_headers, root, t0,
                     relay_timeout)
                 if decode_body is not None:
                     body = decode_body
+                    split = True
+        # global cache-aware placement (README "Fleet KV fabric"): score
+        # the fleet's published prefixes against this prompt.  The plan
+        # steers the pick toward the deepest-matched owner; when the pick
+        # lands elsewhere (load, stickiness, failover) the relay injects
+        # a parameters.fabric pull hint so the chosen replica faults the
+        # prefix in instead of re-prefilling it.  Split requests keep
+        # their rewritten handoff body untouched; a plan the disagg
+        # classifier already computed is reused, not re-hashed.
+        fabric_plan = None
+        if svc is not None and not split:
+            fabric_plan = (fabric_seen["plan"] if "plan" in fabric_seen
+                           else self._plan_fabric(state, handler, payload))
         # true only for the dispatch immediately following a hedge-armed
         # stall: THAT attempt is the hedged re-dispatch ingress_hedged_total
         # counts, not the tight-timeout first attempt that armed it
@@ -447,11 +474,14 @@ class ServiceProxy:
 
         try:
             while True:
+                pick_note: dict = {}
                 try:
                     backend = self._pick_backend(state, body=body,
                                                  exclude=frozenset(tried),
                                                  svc=svc, roles=roles,
-                                                 session=session)
+                                                 session=session,
+                                                 fabric=fabric_plan,
+                                                 note=pick_note)
                 except LookupError as e:
                     status = 503
                     note_hop(root.child(), None, "pick",
@@ -469,6 +499,20 @@ class ServiceProxy:
                     hop_state = h_rec.state if h_rec is not None else "unknown"
                 data, hdrs = body, dict(fwd_headers)
                 hdrs[tracing.TRACEPARENT_HEADER] = hop.traceparent()
+                if fabric_plan is not None:
+                    if pick_note.get("fabric_pick") == backend:
+                        # the pick landed ON the deepest-prefix owner:
+                        # the warm device cache serves the prefix with no
+                        # pull at all — the placement win the fabric view
+                        # exists for
+                        disagg.PLACEMENTS.inc(reason="cache")
+                    else:
+                        hint = self._fabric_hint(
+                            fabric_plan, backend,
+                            pick_note.get("session_remap_from"))
+                        if hint is not None:
+                            data = self._inject_fabric(payload, hint)
+                            hdrs["Content-Type"] = "application/json"
                 if resume is not None:
                     # ask the engine surface to annotate stream events with
                     # the token ids they cover — the re-admission currency
@@ -773,14 +817,17 @@ class ServiceProxy:
         return str(sid) if isinstance(sid, str) and sid else None
 
     def _plan_disagg(self, state: _ProxyState, svc: Obj, handler,
-                     body: Optional[bytes], payload) -> Optional[dict]:
+                     body: Optional[bytes], payload,
+                     fabric_out: Optional[dict] = None) -> Optional[dict]:
         """Decide whether THIS request splits into prefill + decode
         phases: the service must run at least one prefill-role and one
         decode-capable ready replica, the path/payload must classify
         (disagg.should_disaggregate), and a prompt whose prefix-affinity
         entry points at a warm decode-capable replica prefers that cache
         hit over a handoff.  None = relay unified.  ``payload`` is the
-        relay's one parsed copy of ``body``."""
+        relay's one parsed copy of ``body``.  A computed fabric plan is
+        surfaced through ``fabric_out["plan"]`` so the relay reuses it
+        instead of re-hashing the prompt's fingerprint ladder."""
         ann = svc["metadata"].get("annotations", {})
         mode = str(ann.get(disagg.DISAGG_ANNOTATION, "auto")).lower()
         if mode == "off" or handler.command != "POST" or payload is None:
@@ -817,6 +864,16 @@ class ServiceProxy:
                 # replica already: the warm re-prefill there beats paying
                 # a handoff (the whole point of the affinity map)
                 return None
+        fplan = self._plan_fabric(state, handler, payload)
+        if fabric_out is not None:
+            fabric_out["plan"] = fplan
+        if fplan is not None and any(
+                roles_by_port.get(p) in ("decode", "unified")
+                for p in fplan["owners"]):
+            # the GLOBAL view knows a decode-capable replica published
+            # this prefix: the cache-aware pick (or a fabric pull) beats
+            # paying a fresh prefill + handoff for the same pages
+            return None
         return {"payload": payload, "model": model}
 
     def _disagg_prefill(self, state: _ProxyState, svc: Obj, handler,
@@ -1029,26 +1086,21 @@ class ServiceProxy:
         handler._reply(200, body.encode(),
                        "text/plain; version=0.0.4")
 
-    def _serve_fleet_cache(self, handler, state: _ProxyState) -> None:
-        """GET /fleet/cache: the read-only per-replica fleet cache view
-        (README "Performance introspection") — every replica's
-        prefix-cache analytics (hit/miss by reason, page occupancy,
-        fragmentation, per-prefix reuse) from its ``GET /engine/perf``,
-        plus the MFU/goodput headline per replica.  A replica that fails
-        this refresh serves its LAST-KNOWN view annotated with its age
-        (a momentary scrape miss must not make a warm replica look cold
-        to a cache-aware placer); entries for pods that left the service
-        are pruned — the fleet KV fabric's placement input (ROADMAP
-        item 3), deliberately read-only here."""
+    def _collect_cache_view(self, state: _ProxyState) -> tuple:
+        """One fleet cache-view refresh: fan out every replica's slim
+        ``GET /engine/perf?view=cache`` (the full snapshot carries
+        timeline tails and profiler histories the placer never reads),
+        fold the results into ``state.cache_view``, prune pod churn, and
+        return ``(snapshot, pods, unreachable)``.  A replica that fails
+        this refresh keeps serving its LAST-KNOWN view annotated with
+        its age — a momentary scrape miss must not make a warm replica
+        look cold to the cache-aware placer."""
         pods = self._service_pods(state)
         live = {n for n, _ in pods}
+        ports = dict(pods)
         now = time.time()
         unreachable: list = []
         fresh: dict = {}
-        # the slim cache view (?view=cache): the full /engine/perf
-        # snapshot carries timeline tails and profiler run histories the
-        # placer never reads — fetching them per replica per poll would
-        # scale the poll cost with perf_timeline_capacity for nothing
         for name, (raw, elapsed) in self._fan_out(
                 pods, "/engine/perf?view=cache").items():
             rec = None
@@ -1057,6 +1109,7 @@ class ServiceProxy:
                     body = json.loads(raw)
                     models = body.get("models") or {}
                     rec = {"fetched_at": now, "scrape_s": round(elapsed, 4),
+                           "port": ports.get(name),
                            "models": {
                                mn: {"cache": ms.get("cache") or {},
                                     "mfu": ms.get("mfu"),
@@ -1081,12 +1134,149 @@ class ServiceProxy:
                 out[name] = {**rec,
                              "age_s": round(now - rec["fetched_at"], 3),
                              "stale": name in unreachable}
+        return out, pods, unreachable
+
+    # how long a cache-view snapshot places requests before a background
+    # refresh is kicked off; staleness past the TTL is TOLERATED (the
+    # last-known view keeps placing) — a wrong placement costs one
+    # degraded pull, never correctness
+    _FABRIC_VIEW_TTL_S = 1.0
+    # load slack a deepest-prefix owner may carry over the least-loaded
+    # replica and still win the pick (same shape as _AFFINITY_SLACK but
+    # wider: a fabric hit saves whole prefill pages, not a maybe-warm
+    # device cache)
+    _FABRIC_SLACK = 2.0
+
+    def _maybe_refresh_cache_view(self, state: _ProxyState) -> None:
+        """Kick a BACKGROUND cache-view refresh when the TTL lapsed —
+        single-flight, never blocking the pick that noticed (the fan-out
+        costs up to _FANOUT_TIMEOUT_S against a sick replica, which is
+        relay-path poison; the placement meanwhile uses the last-known
+        view, exactly the staleness tolerance the degradation contract
+        pays for)."""
+        with state.lock:
+            now = time.monotonic()
+            if (state.cache_refreshing
+                    or now - state.cache_view_at < self._FABRIC_VIEW_TTL_S):
+                return
+            state.cache_refreshing = True
+
+        def refresh() -> None:
+            try:
+                self._collect_cache_view(state)
+            except Exception:  # noqa: BLE001 — a refresh must not wedge
+                pass
+            finally:
+                with state.lock:
+                    state.cache_view_at = time.monotonic()
+                    state.cache_refreshing = False
+
+        threading.Thread(target=refresh, daemon=True).start()
+
+    def _serve_fleet_cache(self, handler, state: _ProxyState) -> None:
+        """GET /fleet/cache: the read-only per-replica fleet cache view
+        (README "Fleet KV fabric") — every replica's prefix-cache
+        analytics (hit/miss by reason, page occupancy, fragmentation,
+        per-prefix reuse WITH page counts) and its published fabric
+        prefixes, plus the MFU/goodput headline.  The same snapshot the
+        router's cache-aware placement scores; polling this endpoint
+        refreshes it synchronously."""
+        out, pods, unreachable = self._collect_cache_view(state)
+        with state.lock:
+            state.cache_view_at = time.monotonic()
         handler._reply(200, json.dumps({
             "service": state.service_name,
             "replicas": out,
             "replicas_queried": [n for n, _ in pods],
             "replicas_unreachable": sorted(unreachable),
         }).encode())
+
+    # ------------------------------------- global cache-aware placement
+    # (README "Fleet KV fabric"): the fleet-scope replacement for the
+    # per-replica prefix-affinity LRU.  Every request's prompt is reduced
+    # to the kvfabric text fingerprint ladder; replicas advertise the
+    # fingerprints of their published prefixes through the cache view;
+    # the pick routes to the deepest-matched owner (load-balanced
+    # tiebreak) — and when load or stickiness places the request
+    # ELSEWHERE, the relay injects a ``parameters.fabric`` pull hint so
+    # the chosen replica faults the prefix in from the owner instead of
+    # re-prefilling it.
+
+    def _plan_fabric(self, state: _ProxyState, handler,
+                     payload) -> Optional[dict]:
+        """Score the fleet's published prefixes against this request ->
+        ``{"owners": {port: (depth_chars, key, pages)}}`` or None when
+        nothing matches (or the request is no placement candidate: not a
+        generate path, already a disagg phase, or carrying its own
+        fabric hint)."""
+        if handler.command != "POST" or not isinstance(payload, dict):
+            return None
+        if not disagg.eligible_path(handler.path):
+            return None
+        params = payload.get("parameters")
+        params = params if isinstance(params, dict) else {}
+        if (params.get("kv_handoff") or params.get("handoff") is not None
+                or params.get("fabric") is not None):
+            return None
+        text = self._payload_text(payload)
+        if not text:
+            return None
+        fps = kvfabric.fingerprints(text)
+        if not fps:
+            return None
+        self._maybe_refresh_cache_view(state)
+        with state.lock:
+            view = dict(state.cache_view)
+        owners: dict = {}
+        for rec in view.values():
+            port = rec.get("port")
+            if port is None:
+                continue
+            for ms in (rec.get("models") or {}).values():
+                for ent in (ms.get("cache") or {}).get("fabric") or ():
+                    d = kvfabric.match_depth(fps, ent.get("fps") or ())
+                    if d <= 0:
+                        continue
+                    cur = owners.get(port)
+                    pages = int(ent.get("pages") or 0)
+                    # per port keep the deepest match; page count breaks
+                    # ties (bytes saved, the satellite the reuse counters
+                    # grew page counts for)
+                    if cur is None or (d, pages) > (cur[0], cur[2]):
+                        owners[port] = (d, str(ent.get("key")), pages)
+        return {"owners": owners} if owners else None
+
+    def _fabric_hint(self, plan: dict, backend: int,
+                     remap_from: Optional[int]) -> Optional[dict]:
+        """The ``parameters.fabric`` pull hint for a request placed on
+        ``backend``: pull from the deepest owner that beats whatever
+        ``backend`` itself holds (None when backend IS the deepest —
+        nothing to pull).  A sticky-session failover remap prefers the
+        replica the session was remapped FROM: that is where the pinned
+        prefix actually lives, even when the view's fingerprint match
+        for it is shallower or stale."""
+        owners = plan["owners"]
+        own_depth = owners.get(backend, (0, "", 0))[0]
+        cand = {p: v for p, v in owners.items()
+                if p != backend and v[0] > own_depth}
+        if not cand:
+            return None
+        src = remap_from if remap_from in cand else max(
+            cand, key=lambda p: (cand[p][0], cand[p][2], -p))
+        depth, key, pages = cand[src]
+        return {"key": key, "source_port": src, "pages": pages}
+
+    @staticmethod
+    def _inject_fabric(payload: dict, hint: dict) -> bytes:
+        """Rewrite the request body with the pull hint (the relay's one
+        parsed copy stays untouched — retries against a different
+        backend re-inject their own hint)."""
+        p = copy.deepcopy(payload)
+        params = p.setdefault("parameters", {})
+        if not isinstance(params, dict):
+            params = p["parameters"] = {}
+        params["fabric"] = dict(hint)
+        return json.dumps(p).encode()
 
     # --------------------------------------------------- backend health FSM
 
@@ -1288,7 +1478,9 @@ class ServiceProxy:
                       exclude: frozenset = frozenset(),
                       svc: Optional[Obj] = None,
                       roles: Optional[tuple] = None,
-                      session: Optional[str] = None) -> int:
+                      session: Optional[str] = None,
+                      fabric: Optional[dict] = None,
+                      note: Optional[dict] = None) -> int:
         # the caller's relay loop passes the Service it already fetched;
         # a sub-second-stale object is fine here (annotations and selector
         # churn far slower than requests)
@@ -1351,8 +1543,15 @@ class ServiceProxy:
                 sp = state.sessions.get(session)
             if sp in cand:
                 picked = sp
+            elif sp is not None and note is not None:
+                # the session REMAPS: record the replica it leaves behind
+                # so the relay can route the remap through the KV fabric
+                # (the pinned prefix lives THERE — a pull beats restoring
+                # cold, and a dead old replica just degrades the pull)
+                note["session_remap_from"] = sp
         if picked is None and len(cand) > 1:
-            picked = self._pick_engine_aware(state, cand, body)
+            picked = self._pick_engine_aware(state, cand, body,
+                                             fabric=fabric, note=note)
         if picked is None:
             state.rr += 1
             picked = cand[state.rr % len(cand)]
@@ -1382,7 +1581,9 @@ class ServiceProxy:
     _SESSION_CAP = 2048   # session->port stickiness entries (LRU)
 
     def _pick_engine_aware(self, state: _ProxyState, ports: list[int],
-                           body: Optional[bytes]) -> Optional[int]:
+                           body: Optional[bytes],
+                           fabric: Optional[dict] = None,
+                           note: Optional[dict] = None) -> Optional[int]:
         from .autoscaler import scrape_metrics
 
         # Scrapes are blocking HTTP calls, so they must happen OUTSIDE the
@@ -1447,6 +1648,29 @@ class ServiceProxy:
             if not loads:
                 return None
             best = min(loads, key=lambda p: (loads[p], p))
+            if fabric is not None:
+                # GLOBAL cache-aware placement (README "Fleet KV fabric"):
+                # deepest-matched published prefix wins, load-balanced
+                # tiebreak — the fleet-scope replacement for the affinity
+                # LRU below, which only remembers where THIS proxy routed.
+                # An overloaded owner (past the slack) loses the pick; the
+                # relay then injects a pull hint instead, so the prefix
+                # still arrives warm.
+                routable_owners = {p: v for p, v in fabric["owners"].items()
+                                   if p in loads}
+                if routable_owners:
+                    maxd = max(d for d, _, _ in routable_owners.values())
+                    deepest = [p for p, (d, _, _) in routable_owners.items()
+                               if d == maxd]
+                    owner = min(deepest, key=lambda p: (loads[p], p))
+                    if loads[owner] <= loads[best] + self._FABRIC_SLACK:
+                        if note is not None:
+                            note["fabric_pick"] = owner
+                        state.pending[owner] = \
+                            state.pending.get(owner, 0) + 1
+                        return owner
+                state.pending[best] = state.pending.get(best, 0) + 1
+                return best
             # sticky-prefix affinity: ONLY for a prefix this proxy has
             # routed before (its KV pages are plausibly cached there), and
             # only while that replica is within slack of the least loaded
@@ -1482,9 +1706,10 @@ class ServiceProxy:
         return ServiceProxy._payload_prefix(payload)
 
     @staticmethod
-    def _payload_prefix(payload) -> Optional[str]:
-        """_prompt_prefix over an ALREADY-PARSED body — for callers on the
-        relay path that hold the one shared parse (``_plan_disagg``)."""
+    def _payload_text(payload) -> Optional[str]:
+        """The request's FULL prompt text out of an already-parsed body —
+        the fingerprint input for global cache-aware placement (the
+        ladder needs real depth, not the 64-char affinity prefix)."""
         if not isinstance(payload, dict):
             return None
         prompt = payload.get("text_input")  # V1-generate style
@@ -1503,7 +1728,14 @@ class ServiceProxy:
                 prompt = content if isinstance(content, str) else None
         if not isinstance(prompt, str) or not prompt:
             return None
-        return prompt[:64]
+        return prompt
+
+    @staticmethod
+    def _payload_prefix(payload) -> Optional[str]:
+        """_prompt_prefix over an ALREADY-PARSED body — for callers on the
+        relay path that hold the one shared parse (``_plan_disagg``)."""
+        text = ServiceProxy._payload_text(payload)
+        return text[:64] if text else None
 
     def _pick_revision(self, state: _ProxyState, traffic: dict[str, int]) -> Optional[str]:
         live = {r: p for r, p in traffic.items() if p > 0}

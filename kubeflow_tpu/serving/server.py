@@ -341,6 +341,43 @@ class ModelServer:
                 h.send_header("Content-Length", str(len(data)))
                 h.end_headers()
                 h.wfile.write(data)
+        elif path.startswith("/engine/kv_fabric/"):
+            # fleet KV fabric (README "Fleet KV fabric"): any replica
+            # pulls another's published prefix frame by its chain-hash
+            # key.  Raw KVPG bytes — the puller verifies magic/length/
+            # CRC; a 404 (unknown, expired, or evicted) makes it degrade
+            # to re-prefill.  MULTI-reader: unlike a handoff handle the
+            # entry survives the pull — every replica can warm from it.
+            key = path[len("/engine/kv_fabric/"):]
+            from . import kvfabric
+
+            if not kvfabric.KEY_RE.fullmatch(key):
+                # keys are 16-hex chain hashes; anything else is forged —
+                # the trust-boundary shape check kvfabric.py documents
+                h._send(404, {"error": "malformed fabric key"})
+                return
+            capable = [m for m in self.models.values()
+                       if callable(getattr(m, "pull_fabric", None))]
+            data = None
+            for m in capable:
+                try:
+                    # probing N engines for the owner must not charge a
+                    # "miss" to the N-1 that never published the key
+                    data = m.pull_fabric(key,
+                                         count_miss=len(capable) == 1)
+                except Exception:  # noqa: BLE001 — pull must answer
+                    data = None
+                if data is not None:
+                    break
+            if data is None:
+                h._send(404, {"error": "unknown, expired or evicted "
+                                       "fabric key"})
+            else:
+                h.send_response(200)
+                h.send_header("Content-Type", "application/octet-stream")
+                h.send_header("Content-Length", str(len(data)))
+                h.end_headers()
+                h.wfile.write(data)
         elif path == "/v2/health/ready":
             ready = all(m.ready for m in self.models.values())
             h._send(200 if ready else 503, {"ready": ready})

@@ -1,0 +1,654 @@
+"""Fleet KV fabric tests (ISSUE 12): shared prefix memory with global
+cache-aware placement — all on CPU, in-process.
+
+The headline contract: a prefix prefilled ONCE anywhere in the fleet is
+warm EVERYWHERE — a replica that never saw the prompt pulls the published
+KVPG frame from the owner, verifies it (CRC + chain hashes), scatters the
+covered pages, and re-prefills only the tail, producing output
+BYTE-IDENTICAL to a local run under greedy.  And EVERY fabric failure
+(torn transfer, bit flip, slow link, dead owner, expired entry, budget
+rejection, forged key) degrades to plain re-prefill with the same bytes
+and zero leaked KV pages on both replicas — never a failed request.
+"""
+
+import json
+import time
+import urllib.request
+
+import jax
+import pytest
+
+from kubeflow_tpu.core.api import APIServer
+from kubeflow_tpu.serving import disagg, kvfabric
+from kubeflow_tpu.serving.api import LABEL_ISVC
+from kubeflow_tpu.serving.controllers import (DRAINING_ANNOTATION,
+                                              POD_PORT_ANNOTATION,
+                                              PROXY_PORT_ANNOTATION)
+from kubeflow_tpu.serving.engine import Engine, EngineConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import FabricFaultConfig
+from kubeflow_tpu.serving.engine.kvstore import unpack_frame
+from kubeflow_tpu.serving.engine.serve import JetStreamModel
+from kubeflow_tpu.serving.errors import RequestError
+from kubeflow_tpu.serving.router import ServiceProxy, _ProxyState
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.utils.net import find_free_ports
+
+pytestmark = pytest.mark.fabric
+
+# vocab >= 256: the JetStream byte tokenizer addresses ids 0..255
+CFG = M.DecoderConfig(vocab_size=288, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_ff=64)
+NUM_PAGES = 96
+# a shared "system prompt" long enough for several full pages (page_size
+# 8) and several fingerprint-ladder rungs
+SHARED = "You are a helpful assistant. Answer concisely and cite. " * 2
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+def _ec(**kw):
+    base = dict(max_slots=2, page_size=8, num_pages=NUM_PAGES,
+                max_pages_per_slot=24, fabric=True)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _leak(engine) -> int:
+    s = engine.stats
+    return (NUM_PAGES - 1) - s["free_pages"] - s["cached_pages"]
+
+
+def _gen(model, prompt, mt, **params):
+    return model.generate({"text_input": prompt,
+                           "parameters": {"max_tokens": mt, **params}})
+
+
+def _fabric_count(engine, outcome) -> float:
+    return engine.telemetry.kv_fabric.series().get(
+        (("outcome", outcome),), 0.0)
+
+
+def _hint(engine, server):
+    """The pull hint for ``engine``'s most recent publish, as the router
+    would inject it."""
+    view = engine.fabric_view()
+    assert view, "nothing published"
+    return {"fabric": {"key": view[0]["key"], "source_port": server.port,
+                       "pages": view[0]["pages"]}}
+
+
+# ------------------------------------------------------------- store units
+
+
+def test_fabric_store_multi_reader_ttl_budget():
+    clock = [100.0]
+    fs = kvfabric.FabricStore(ttl_s=10.0, max_bytes=100,
+                              clock=lambda: clock[0])
+    assert fs.publish("a" * 16, b"x" * 40, {"pages": 3})
+    # MULTI-reader: every pull succeeds and leaves the entry live
+    for _ in range(3):
+        out, data = fs.pull("a" * 16)
+        assert out == "ok" and data == b"x" * 40
+    assert fs.pull("f" * 16) == ("miss", None)
+    # covers() is the publisher's cheap skip check
+    assert fs.covers("a" * 16, 3) and not fs.covers("a" * 16, 4)
+    # TTL: a pull REFRESHES the clock (hot prefixes stay live) ...
+    clock[0] += 8.0
+    assert fs.pull("a" * 16)[0] == "ok"
+    clock[0] += 8.0
+    assert fs.pull("a" * 16)[0] == "ok"
+    # ... but an unpulled entry ages out
+    clock[0] += 11.0
+    assert fs.pull("a" * 16) == ("expired", None)
+    # chaos-style pre-expired publish
+    assert fs.publish("b" * 16, b"y" * 40, {}, ttl_s=0.0)
+    clock[0] += 0.1
+    assert fs.pull("b" * 16) == ("expired", None)
+    # budget: least-recently-USED evicted first, not oldest-published
+    assert fs.publish("c" * 16, b"c" * 40, {"pages": 2})
+    assert fs.publish("d" * 16, b"d" * 40, {"pages": 2})
+    assert fs.pull("c" * 16)[0] == "ok"  # c is now hotter than d
+    assert fs.publish("e" * 16, b"e" * 40, {"pages": 2})  # evicts d
+    assert fs.pull("d" * 16) == ("miss", None)
+    assert fs.pull("c" * 16)[0] == "ok"
+    # over-budget frame refused; republish refreshes in place
+    assert not fs.publish("9" * 16, b"z" * 101, {})
+    assert fs.publish("c" * 16, b"C" * 30, {"pages": 2})
+    assert fs.pull("c" * 16)[1] == b"C" * 30
+    st = fs.stats()
+    assert st["evictions"] == 1 and st["rejected"] == 1
+    assert st["republishes"] == 1 and st["expired"] == 2
+    assert st["bytes"] == sum(e["nbytes"] for e in
+                              fs._entries.values())
+    view = fs.view()
+    assert view[0]["key"] == "c" * 16  # most-recently-used first
+
+
+def test_fingerprint_ladder_and_match_depth():
+    a = kvfabric.fingerprints("x" * 300)
+    b = kvfabric.fingerprints("x" * 300)
+    assert a == b and len(a) == 5  # rungs 16..256
+    # shared 64-char prefix, divergence after: depth stops at 64
+    c = kvfabric.fingerprints("x" * 64 + "y" * 200)
+    assert kvfabric.match_depth(a, c) == 64
+    assert kvfabric.match_depth(a, a) == 256
+    assert kvfabric.match_depth(a, []) == 0
+    assert kvfabric.match_depth(kvfabric.fingerprints("short"), a) == 0
+    # a mismatched rung ends the walk even if later rungs collide
+    weird = list(a)
+    weird[1] = "0" * 16
+    assert kvfabric.match_depth(a, weird) == 16
+    assert kvfabric.fabric_key(0x1234) == "0000000000001234"
+    assert kvfabric.KEY_RE.fullmatch(kvfabric.fabric_key(2 ** 64 - 1))
+
+
+def test_cache_stats_reuse_carries_page_counts():
+    """Satellite: per-prefix reuse entries expose PAGE counts so the
+    placement scorer can weigh bytes saved, not just hit counts."""
+    from kubeflow_tpu.serving.engine.perf import CacheStats
+
+    cs = CacheStats()
+    cs.note_lookup(12, 4, key=0xAB)
+    cs.note_lookup(12, 9, key=0xAB)   # deeper hit under the same key
+    cs.note_lookup(3, 2, key=0xCD)
+    snap = cs.snapshot()
+    top = {e["prefix"]: e for e in snap["top_reused_prefixes"]}
+    assert top[f"{0xAB:016x}"]["reuses"] == 2
+    assert top[f"{0xAB:016x}"]["pages"] == 9
+    assert top[f"{0xCD:016x}"]["pages"] == 2
+
+
+# --------------------------------------------------- publish/pull contract
+
+
+def test_publish_at_finish_and_multi_reader_pull(params):
+    ea = Engine(params, CFG, _ec())
+    sa = ModelServer([JetStreamModel("m", "", engine=ea)], port=0)
+    sa.start()
+    try:
+        ma = sa.models["m"]
+        r = _gen(ma, SHARED, 10)
+        assert r["token_ids"]
+        st = ea.stats["fabric"]
+        assert st["publishes"] == 1
+        view = ea.fabric_view()
+        assert len(view) == 1
+        ent = view[0]
+        assert ent["pages"] >= (len(SHARED) - 1) // 8
+        assert ent["fps"] == kvfabric.fingerprints(
+            SHARED[:ent["pages"] * 8])[:len(ent["fps"])]
+        # the HTTP pull endpoint serves verifiable KVPG bytes, repeatedly
+        for _ in range(2):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{sa.port}/engine/kv_fabric/"
+                    f"{ent['key']}", timeout=10) as resp:
+                data = resp.read()
+            blob, header = unpack_frame(data)
+            assert header["meta"]["pages"] == ent["pages"]
+            assert len(header["meta"]["hashes"]) == ent["pages"]
+        assert ea.stats["fabric"]["pulls"] == 2
+        # an identical prefix re-finishing skips the expensive snapshot
+        _gen(ma, SHARED, 10)
+        assert _fabric_count(ea, "publish_skipped") >= 1
+        # forged/unknown key: 404, counted as a miss
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{sa.port}/engine/kv_fabric/"
+                f"{'0' * 16}", timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        assert ea.stats["fabric"]["misses"] == 1
+        assert _leak(ea) == 0
+    finally:
+        sa.stop()
+        ea.stop(drain=False)
+
+
+def test_cross_replica_byte_identity_vs_local_warm_oracle(params):
+    """The tentpole oracle: replica B, which never saw the prompt, pulls
+    A's published prefix and produces output byte-identical to the cold
+    oracle AND to A's own local-warm rerun — while prefilling only the
+    uncovered tail (the perf ledger shows the saved positions)."""
+    eu = Engine(params, CFG, _ec(fabric=False))
+    eu.start()
+    mu = JetStreamModel("m", "", engine=eu)
+    ea = Engine(params, CFG, _ec())
+    sa = ModelServer([JetStreamModel("m", "", engine=ea)], port=0)
+    sa.start()
+    eb = Engine(params, CFG, _ec())
+    eb.start()
+    mb = JetStreamModel("m", "", engine=eb)
+    try:
+        prompt = SHARED + "Q?"
+        ref = _gen(mu, prompt, 12)                      # cold oracle
+        first = _gen(sa.models["m"], prompt, 12)        # publishes on A
+        warm = _gen(sa.models["m"], prompt, 12)         # local warm on A
+        out = _gen(mb, prompt, 12, **_hint(ea, sa))     # remote warm on B
+        assert first["token_ids"] == ref["token_ids"]
+        assert warm["token_ids"] == ref["token_ids"]
+        assert out["token_ids"] == ref["token_ids"]
+        assert out["text_output"] == ref["text_output"]
+        assert out["fabric"] == {"restore": "hit"}
+        assert _fabric_count(eb, "hit") == 1
+        # B prefilled ONLY the tail: its charged prefill positions are
+        # the prompt minus the scattered prefix pages
+        plen = len(prompt)
+        covered = ea.fabric_view()[0]["pages"] * 8
+        b_pos = eb.perf.snapshot()["positions_by_kind"]["prefill"]
+        assert b_pos == plen - min(covered, ((plen - 1) // 8) * 8)
+        assert b_pos < plen // 2
+        assert _leak(ea) == 0 and _leak(eb) == 0 and _leak(eu) == 0
+        # multi-reader: a THIRD replica pulls the same key
+        ec_ = Engine(params, CFG, _ec())
+        ec_.start()
+        mc = JetStreamModel("m", "", engine=ec_)
+        try:
+            out3 = _gen(mc, prompt, 12, **_hint(ea, sa))
+            assert out3["token_ids"] == ref["token_ids"]
+            assert out3["fabric"] == {"restore": "hit"}
+            assert _leak(ec_) == 0
+        finally:
+            ec_.stop(drain=False)
+        assert ea.stats["fabric"]["pulls"] == 2
+    finally:
+        sa.stop()
+        for e in (ea, eb, eu):
+            e.stop(drain=False)
+
+
+def test_every_fabric_fault_class_degrades_with_zero_leaks(params):
+    """torn transfer / bit flip / slow link / dead link / expired publish
+    / budget-refused publish / wrong-prompt frame: each degrades to
+    re-prefill — byte-identical output, request always completes, 0
+    leaked pages on BOTH replicas, degradation visible in
+    engine_kv_fabric_total{outcome="degraded"}."""
+    eu = Engine(params, CFG, _ec(fabric=False))
+    eu.start()
+    mu = JetStreamModel("m", "", engine=eu)
+    prompt = SHARED + "Q?"
+    ref = _gen(mu, prompt, 10)
+
+    def run_case(name, puller_chaos=None, owner_kw=None, slow_timeout=None,
+                 wrong_prompt=None):
+        ea = Engine(params, CFG, _ec(**(owner_kw or {})))
+        sa = ModelServer([JetStreamModel("m", "", engine=ea)], port=0)
+        sa.start()
+        eb = Engine(params, CFG, _ec(fabric_chaos=puller_chaos))
+        eb.start()
+        mb = JetStreamModel("m", "", engine=eb)
+        old_timeout = JetStreamModel._FABRIC_PULL_TIMEOUT_S
+        if slow_timeout is not None:
+            JetStreamModel._FABRIC_PULL_TIMEOUT_S = slow_timeout
+        try:
+            _gen(sa.models["m"], wrong_prompt or prompt, 10)
+            if ea.fabric_view():
+                hint = _hint(ea, sa)
+            else:  # budget case: nothing published — forged key
+                hint = {"fabric": {"key": "0" * 16,
+                                   "source_port": sa.port, "pages": 4}}
+            out = _gen(mb, prompt, 10, **hint)
+            assert out["token_ids"] == ref["token_ids"], name
+            assert out["text_output"] == ref["text_output"], name
+            assert out["fabric"] == {"restore": "degraded"}, (name, out)
+            assert _fabric_count(eb, "degraded") >= 1, name
+            assert _fabric_count(eb, "hit") == 0, name
+            assert _leak(ea) == 0 and _leak(eb) == 0, name
+            # the recomputed prefix is attributed fleet-level waste
+            waste = eb.perf.snapshot()["waste_flops"]
+            assert waste.get("fabric_degraded", 0) > 0, (name, waste)
+        finally:
+            JetStreamModel._FABRIC_PULL_TIMEOUT_S = old_timeout
+            sa.stop()
+            ea.stop(drain=False)
+            eb.stop(drain=False)
+
+    run_case("torn", puller_chaos=FabricFaultConfig(torn_pull_on=1))
+    run_case("flip", puller_chaos=FabricFaultConfig(flip_pull_on=1))
+    run_case("slow", puller_chaos=FabricFaultConfig(slow_pull_s=0.6,
+                                                    slow_pull_every=1),
+             slow_timeout=0.2)
+    run_case("dead_link", puller_chaos=FabricFaultConfig(dead_link_on=1))
+    run_case("expired",
+             owner_kw=dict(fabric_chaos=FabricFaultConfig(
+                 expire_publish_on=1)))
+    run_case("budget", owner_kw=dict(fabric_max_bytes=64))
+    # a frame whose chain hashes share NOTHING with the prompt: the
+    # engine-side hash gate (not the fingerprint heuristic) rejects it
+    run_case("wrong_prompt",
+             wrong_prompt="completely different text " * 4)
+
+
+def test_fabric_request_validation(params):
+    ep = Engine(params, CFG, _ec())
+    ep.start()
+    mp = JetStreamModel("m", "", engine=ep)
+    try:
+        # keys interpolate into a localhost URL: anything but the 16-hex
+        # chain-hash shape is forged (SSRF guard), ports must be ports
+        with pytest.raises(RequestError, match="hex"):
+            mp.generate({"text_input": "x", "parameters":
+                         {"fabric": {"key": "../../etc",
+                                     "source_port": 80}}})
+        with pytest.raises(RequestError, match="port"):
+            mp.generate({"text_input": "x", "parameters":
+                         {"fabric": {"key": "ab" * 8,
+                                     "source_port": 99999999}}})
+        with pytest.raises(RequestError, match="object"):
+            mp.generate({"text_input": "x",
+                         "parameters": {"fabric": "junk"}})
+        with pytest.raises(RequestError, match="mutually exclusive"):
+            mp.generate({"text_input": "x", "parameters": {
+                "fabric": {"key": "ab" * 8, "source_port": 9999},
+                "handoff": {"handle": "ab" * 16, "source_port": 9999,
+                            "token_ids": [1]}}})
+        assert _leak(ep) == 0
+    finally:
+        ep.stop(drain=False)
+
+
+def test_fabric_rejects_sibling_model_frame(params):
+    """Model identity gate: two same-shape models produce identical
+    chain hashes for a shared prompt (the chain seeds on tokens, not
+    weights), so a sibling model's frame passes every geometry check —
+    the meta model id is what stops model A's KV from scattering into
+    model B's pool and decoding silently wrong."""
+    ea = Engine(params, CFG, _ec())
+    sa = ModelServer([JetStreamModel("model-a", "", engine=ea)], port=0)
+    sa.start()
+    eb = Engine(params, CFG, _ec())
+    eb.start()
+    mb = JetStreamModel("model-b", "", engine=eb)
+    try:
+        _gen(sa.models["model-a"], SHARED, 8)
+        out = _gen(mb, SHARED, 8, **_hint(ea, sa))
+        assert out["tokens"] == 8
+        assert out["fabric"] == {"restore": "degraded"}, out
+        assert _fabric_count(eb, "hit") == 0
+        assert _leak(ea) == 0 and _leak(eb) == 0
+    finally:
+        sa.stop()
+        ea.stop(drain=False)
+        eb.stop(drain=False)
+
+
+def test_fabric_parking_budget_degrades(params):
+    """Queued fabric blobs are budgeted: past fabric_max_bytes a hinted
+    submit degrades to plain re-prefill instead of accumulating
+    unaccounted host RAM (the handoff-import parking rule)."""
+    import numpy as np
+
+    eng = Engine(params, CFG, _ec(fabric_max_bytes=64))
+    eng.start()
+    try:
+        blob = (np.zeros((1, 2, 3), np.float32),
+                np.zeros((1, 2, 3), np.float32))
+        r = eng.generate(list(range(1, 30)), 4,
+                         fabric_import=(blob, [1, 2], 100))
+        assert r["num_tokens"] == 4
+        assert _fabric_count(eng, "degraded") == 1
+        assert _fabric_count(eng, "import") == 0
+        assert eng.perf.snapshot()["waste_flops"].get(
+            "fabric_degraded", 0) > 0
+        assert _leak(eng) == 0
+    finally:
+        eng.stop(drain=False)
+
+
+# ------------------------------------------- placement scoring (router)
+
+
+class _FakeHandler:
+    command = "POST"
+    path = "/v2/models/m/generate"
+
+
+def _view_entry(port, fps, key="ab" * 8, pages=6, stale=False):
+    return {"fetched_at": time.time(), "port": port, "stale": stale,
+            "models": {"m": {"cache": {"fabric": [
+                {"key": key, "pages": pages, "nbytes": pages * 512,
+                 "fps": fps}]}}}}
+
+
+def test_placement_scoring_units():
+    """_plan_fabric + _fabric_hint: deepest-matched prefix wins, page
+    count breaks depth ties, a session remap prefers its old replica,
+    and a STALE view entry still places (staleness-tolerant — a wrong
+    hint costs one degraded pull)."""
+    proxy = ServiceProxy(APIServer())
+    state = _ProxyState("svc", "default")
+    state.cache_view_at = time.monotonic()  # suppress background refresh
+    text = "s" * 200 + " tail"
+    fps = kvfabric.fingerprints(text)
+    state.cache_view = {
+        # depth 128 (matches rungs 16..128, diverges at 256 which the
+        # shallow copy never reaches)
+        "r1": _view_entry(9001, fps[:4], key="11" * 8, pages=4),
+        # depth 64 only, but STALE — still a candidate
+        "r2": _view_entry(9002, fps[:3], key="22" * 8, pages=9,
+                          stale=True),
+        # no overlap at all
+        "r3": _view_entry(9003, kvfabric.fingerprints("other " * 40),
+                          key="33" * 8),
+    }
+    payload = {"text_input": text, "parameters": {"max_tokens": 8}}
+    plan = proxy._plan_fabric(state, _FakeHandler, payload)
+    assert plan is not None
+    assert set(plan["owners"]) == {9001, 9002}
+    assert plan["owners"][9001][0] == 128
+    assert plan["owners"][9002][0] == 64
+    # placed on a non-owner: hint pulls from the DEEPEST owner
+    hint = proxy._fabric_hint(plan, backend=9003, remap_from=None)
+    assert hint == {"key": "11" * 8, "source_port": 9001, "pages": 4}
+    # placed on the deepest owner itself: nothing to pull
+    assert proxy._fabric_hint(plan, 9001, None) is None
+    # placed on a SHALLOWER owner: the deeper copy is still worth a pull
+    assert proxy._fabric_hint(plan, 9002, None)["source_port"] == 9001
+    # session remap: the old replica wins even when its match is
+    # shallower — the pinned prefix actually lives there
+    hint = proxy._fabric_hint(plan, 9003, remap_from=9002)
+    assert hint == {"key": "22" * 8, "source_port": 9002, "pages": 9}
+    # no fabric hint for requests already carrying one, or disagg phases
+    assert proxy._plan_fabric(state, _FakeHandler, {
+        "text_input": text, "parameters": {
+            "fabric": {"key": "ab" * 8, "source_port": 1}}}) is None
+    assert proxy._plan_fabric(state, _FakeHandler, {
+        "text_input": text,
+        "parameters": {"kv_handoff": True}}) is None
+    # no published match -> None (legacy affinity path takes over)
+    state.cache_view = {}
+    assert proxy._plan_fabric(state, _FakeHandler, payload) is None
+
+
+# --------------------------------------------------- proxy fleet (e2e)
+
+
+def _mk_fleet(params, n, **ec_kw):
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    svc_port = find_free_ports(1)[0]
+    api.create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "fleet", "labels": {LABEL_ISVC: "fleet"},
+                     "annotations": {PROXY_PORT_ANNOTATION: str(svc_port)}},
+        "spec": {"selector": {"app": "fleet"}}})
+    engines, servers = [], []
+    for i in range(n):
+        eng = Engine(params, CFG, _ec(**ec_kw))
+        srv = ModelServer([JetStreamModel("fleet", "", engine=eng)], port=0)
+        srv.start()
+        api.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"fleet-{i}", "labels": {"app": "fleet"},
+                         "annotations": {POD_PORT_ANNOTATION:
+                                         str(srv.port)}},
+            "spec": {},
+            "status": {"phase": "Running",
+                       "conditions": [{"type": "Ready",
+                                       "status": "True"}]}})
+        engines.append(eng)
+        servers.append(srv)
+    proxy.sync()
+    return api, proxy, svc_port, engines, servers
+
+
+def _teardown(proxy, engines, servers):
+    proxy.shutdown()
+    for srv in servers:
+        srv.stop()
+    for eng in engines:
+        try:
+            eng.stop(drain=False)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _post(port, path, body, headers=None, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def test_global_cache_aware_placement_e2e(params):
+    """Through the real proxy: the first shared-prefix request publishes;
+    after a /fleet/cache refresh, follow-ups either land ON the owner
+    (ingress_placements_total{reason="cache"}) or pull the prefix from
+    it — and every placement's output is byte-identical to the oracle."""
+    eu = Engine(params, CFG, _ec(fabric=False))
+    eu.start()
+    mu = JetStreamModel("fleet", "", engine=eu)
+    api, proxy, svc_port, engines, servers = _mk_fleet(params, 3)
+    try:
+        code, r1, _ = _post(svc_port, "/v2/models/fleet/generate",
+                            {"text_input": SHARED + "Q1?",
+                             "parameters": {"max_tokens": 8}})
+        assert code == 200
+        # synchronous view refresh (what the bench's poller does too)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{svc_port}/fleet/cache",
+                timeout=10) as r:
+            view = json.loads(r.read())
+        published = [n for n, rec in view["replicas"].items()
+                     if (rec["models"]["fleet"]["cache"] or {})
+                     .get("fabric")]
+        assert len(published) == 1
+        before = dict(disagg.PLACEMENTS.series())
+        outs = []
+        for i in range(2, 8):
+            code, out, _ = _post(svc_port, "/v2/models/fleet/generate",
+                                 {"text_input": SHARED + f"Q{i}?",
+                                  "parameters": {"max_tokens": 8}})
+            assert code == 200
+            outs.append(out)
+        delta = {k: v - before.get(k, 0)
+                 for k, v in disagg.PLACEMENTS.series().items()}
+        cache_picks = delta.get((("reason", "cache"),), 0)
+        remote_hits = sum(_fabric_count(e, "hit") for e in engines)
+        # every follow-up was served warm one way or the other
+        assert cache_picks + remote_hits >= len(outs) - 1, \
+            (delta, remote_hits)
+        assert cache_picks >= 1
+        for i, out in enumerate(outs, start=2):
+            ref = _gen(mu, SHARED + f"Q{i}?", 8)
+            assert out["token_ids"] == ref["token_ids"], i
+        for eng in engines:
+            assert _leak(eng) == 0
+    finally:
+        _teardown(proxy, engines, servers)
+        eu.stop(drain=False)
+
+
+def test_session_failover_remap_pulls_pinned_prefix(params):
+    """Satellite: a sticky session whose replica drains REMAPS — and the
+    remap routes through the fabric, so the new replica pulls the pinned
+    prefix from the draining owner instead of restoring cold from
+    scratch.  With the owner actually DEAD the pull degrades and the
+    turn still completes (stale-view fallback)."""
+    api, proxy, svc_port, engines, servers = _mk_fleet(params, 2)
+    try:
+        t1_prompt = SHARED + " turn one."
+        code, t1, _ = _post(svc_port, "/v2/models/fleet/generate",
+                            {"text_input": t1_prompt,
+                             "parameters": {"max_tokens": 8}},
+                            headers={"X-Session-Id": "conv-1"})
+        assert code == 200 and t1["session"]["pinned"]
+        pinner = next(i for i, e in enumerate(engines) if e.sessions())
+        # the pinned turn also published its prefix into the fabric
+        assert engines[pinner].fabric_view()
+        # refresh the proxy's view so placement knows the owner
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{svc_port}/fleet/cache", timeout=10).read()
+        # drain the pinning pod: _ready_pods excludes it (remap), but the
+        # server stays up — exactly the scale-down drain scenario
+        api.patch("Pod", f"fleet-{pinner}",
+                  {"metadata": {"annotations": {DRAINING_ANNOTATION: "1"}}})
+        t2_prompt = t1_prompt + t1["text_output"] + " and then"
+        code, t2, _ = _post(svc_port, "/v2/models/fleet/generate",
+                            {"text_input": t2_prompt,
+                             "parameters": {"max_tokens": 6}},
+                            headers={"X-Session-Id": "conv-1"})
+        assert code == 200
+        survivor = engines[1 - pinner]
+        # the session itself restored cold on the new replica (its pin
+        # lives on the drained one) — but the FABRIC warmed the prefix
+        assert t2["session"]["restore"] == "cold"
+        assert t2["fabric"] == {"restore": "hit"}, t2
+        assert _fabric_count(survivor, "hit") == 1
+        assert len(survivor.sessions()) == 1  # new turn pinned here
+        assert _leak(engines[0]) == 0 and _leak(engines[1]) == 0
+
+        # owner DEAD: the pull degrades, the turn completes regardless
+        servers[pinner].stop()
+        engines[pinner].stop(drain=False)
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{svc_port}/fleet/cache", timeout=10).read()
+        t3_prompt = t2_prompt + t2["text_output"] + " more"
+        code, t3, _ = _post(svc_port, "/v2/models/fleet/generate",
+                            {"text_input": t3_prompt,
+                             "parameters": {"max_tokens": 4}},
+                            headers={"X-Session-Id": "conv-1"})
+        assert code == 200 and t3["token_ids"]
+        assert _leak(survivor) == 0
+    finally:
+        _teardown(proxy, engines, servers)
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_fabric_metrics_registered(params):
+    from kubeflow_tpu.core.metrics import REGISTRY
+    from kubeflow_tpu.serving.engine.telemetry import EngineTelemetry
+
+    names = set(EngineTelemetry(enabled=True).registry.names())
+    assert "engine_kv_fabric_total" in names
+    assert "engine_kv_fabric_bytes_total" in names
+    assert "ingress_placements_total" in REGISTRY.names()
+    ea = Engine(params, CFG, _ec())
+    sa = ModelServer([JetStreamModel("m", "", engine=ea)], port=0)
+    sa.start()
+    eb = Engine(params, CFG, _ec())
+    eb.start()
+    mb = JetStreamModel("m", "", engine=eb)
+    try:
+        _gen(sa.models["m"], SHARED, 6)
+        _gen(mb, SHARED, 6, **_hint(ea, sa))
+        ta = sa.models["m"].metrics_text()
+        assert 'engine_kv_fabric_total{outcome="publish",model="m"}' in ta
+        assert 'engine_kv_fabric_total{outcome="pull",model="m"}' in ta
+        assert ('engine_kv_fabric_bytes_total{direction="out",model="m"}'
+                in ta)
+        tb = mb.metrics_text()
+        assert 'engine_kv_fabric_total{outcome="hit",model="m"}' in tb
+        assert ('engine_kv_fabric_bytes_total{direction="in",model="m"}'
+                in tb)
+    finally:
+        sa.stop()
+        ea.stop(drain=False)
+        eb.stop(drain=False)
