@@ -672,6 +672,30 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["fabric"] = {"error": str(e)[:200]}
     try:
+        # incident-plane sidebar: serving_bench --incidents's headline
+        # (BENCH_INCIDENTS.json) — the taxonomy replay verdict (one
+        # correctly-classified incident per injected fault class), the
+        # clean-run zero-incident gate, and the detector overhead
+        inc_path = os.path.join(REPO, "BENCH_INCIDENTS.json")
+        if os.path.exists(inc_path):
+            with open(inc_path) as f:
+                inc = json.loads(f.readline())
+            scen = inc.get("scenarios") or {}
+            out["incidents"] = {
+                "taxonomy_pass": inc.get("taxonomy_pass"),
+                "causes_validated": sorted(
+                    k for k, v in scen.items()
+                    if v.get("incidents") == 1
+                    and v.get("cause") == v.get("expected")),
+                "clean_run_incidents":
+                    inc.get("clean", {}).get("incidents"),
+                "overhead_p50_pct": inc.get("overhead_p50_pct"),
+                "overhead_budget_pct": inc.get("overhead_budget_pct"),
+                "platform": inc.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["incidents"] = {"error": str(e)[:200]}
+    try:
         # perf-introspection sidebar: serving_bench --perf's headline
         # (BENCH_PERF.json) — plane overhead in both scopes, the
         # chip-pinned MFU cross-check, and the waste-attribution audits

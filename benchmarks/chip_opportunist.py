@@ -254,6 +254,21 @@ JOBS = [
     # CPU estimate, the overhead gate runs at device tick rates, and the
     # waste-attribution audits execute against chip numerics; refreshes
     # BENCH_PERF.json with the platform=tpu record
+    # incident plane on a real chip (ISSUE 13): the taxonomy replay's
+    # fault scenarios run against genuine device dispatch timing (the
+    # watchdog/tick-overrun windows, chunked-prefill interference and
+    # burn crossings all ride real step times instead of CPU simulation),
+    # and the detector-overhead gate measures the feed()-only hot-path
+    # claim at chip tick rates; refreshes BENCH_INCIDENTS.json
+    {"name": "serving_incidents_tiny",
+     "cmd": _serving_cmd("tiny", ["--incidents", "--requests", "16",
+                                  "--concurrency", "4",
+                                  "--prompt-len", "64",
+                                  "--max-tokens", "16",
+                                  "--out",
+                                  os.path.join(REPO,
+                                               "BENCH_INCIDENTS.json")]),
+     "timeout": 1500, "first_timeout": 900},
     {"name": "perf_introspect_tiny",
      "cmd": _serving_cmd("tiny", ["--perf", "--requests", "16",
                                   "--concurrency", "4",

@@ -3101,6 +3101,288 @@ def _run_fabric(args, config, params, lora) -> None:
         raise SystemExit("fabric bench FAILED: " + "; ".join(failures))
 
 
+def _run_incidents(args, config, params, lora) -> None:
+    """Incident-plane bench (ISSUE 13, README "Incident plane"): the
+    chaos harness as the validator, three gates:
+
+      1. fault replay — one scenario per root-cause taxonomy entry
+         (replica_death / prefill_interference / storage_degradation /
+         handoff_degradation / fabric_degradation / capacity), each
+         injecting exactly one fault burst into a fresh engine: EXACTLY
+         one incident must open, classified with the expected cause,
+         citing >= 1 live (resolvable) trace id and a READABLE
+         flight-recorder dump, with a round-trippable bundle on disk.
+      2. the false-positive gate — a clean ``--requests``-request run
+         with the plane ON (tick-overrun budget armed, operator-sane SLO
+         targets) must open ZERO incidents.
+      3. overhead — the plane ON vs OFF on the identical clean workload,
+         alternating passes after a shared warmup: p50 penalty must stay
+         under ``--incidents-budget`` percent (the plane is feed()-only
+         on hot paths; this measures that claim).
+
+    Results land in BENCH_INCIDENTS.json via --out."""
+    import json as _json
+    import os as _os
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.serving.engine import Engine, EngineConfig
+    from kubeflow_tpu.serving.engine.faults import (FaultConfig,
+                                                    StorageFaultConfig)
+    from kubeflow_tpu.serving.engine.kvstore import KVStoreConfig
+    from kubeflow_tpu.serving.errors import EngineOverloaded
+    from kubeflow_tpu.serving.slo import SloConfig
+
+    rng = np.random.default_rng(0)
+    failures: list = []
+    # operator-sane targets for this box: a closed-loop bench burst
+    # against sub-second interactive targets would be a REAL burn, and
+    # the clean arm must measure the machinery, not the workload
+    generous = SloConfig(targets=tuple(
+        (c, m, 600.0) for c in ("interactive", "batch", "best_effort")
+        for m in ("ttft", "tpot", "queue_wait")))
+
+    def _ec(**kw):
+        base = dict(max_slots=4, num_pages=256, page_size=32,
+                    max_pages_per_slot=32, slo=generous,
+                    incidents=True, incident_debounce_s=0.4,
+                    incident_resolve_s=0.8, incident_poll_s=0.02)
+        base.update(kw)
+        return EngineConfig(**base)
+
+    def _prompt(n):
+        return rng.integers(1, config.vocab_size, size=n).tolist()
+
+    def _await_resolved(eng, timeout=30.0):
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < timeout:
+            incs = eng.incident_list()
+            if incs and all(i["state"] == "resolved" for i in incs):
+                return incs
+            _time.sleep(0.05)
+        return eng.incident_list()
+
+    def _check(name, expected_cause, incs) -> dict:
+        rec = {"incidents": len(incs),
+               "cause": incs[0]["cause"] if incs else None,
+               "expected": expected_cause}
+        if len(incs) != 1:
+            failures.append(f"{name}: {len(incs)} incidents (want 1): "
+                            f"{[i['cause'] for i in incs]}")
+            return rec
+        inc = incs[0]
+        rec.update(detector=inc["detector"],
+                   symptoms=len(inc["symptoms"]),
+                   state=inc["state"],
+                   trace_ids=len(inc["evidence"]["trace_ids"]))
+        if inc["cause"] != expected_cause:
+            failures.append(f"{name}: classified {inc['cause']}, "
+                            f"expected {expected_cause} "
+                            f"({inc['classification']['rule']})")
+        if not inc["evidence"]["trace_ids"]:
+            failures.append(f"{name}: incident cites no trace ids")
+        dump = inc["evidence"]["flight_dump"]
+        try:
+            with open(dump) as f:
+                _json.loads(f.readline())
+            rec["flight_dump_readable"] = True
+        except Exception as e:  # noqa: BLE001
+            rec["flight_dump_readable"] = False
+            failures.append(f"{name}: flight dump unreadable: {e}")
+        try:
+            with open(inc["bundle_path"]) as f:
+                disk = _json.load(f)
+            rec["bundle_roundtrip"] = (disk["id"] == inc["id"]
+                                       and disk["cause"] == inc["cause"])
+        except Exception as e:  # noqa: BLE001
+            rec["bundle_roundtrip"] = False
+            failures.append(f"{name}: bundle unreadable: {e}")
+        if rec.get("bundle_roundtrip") is False:
+            failures.append(f"{name}: bundle does not round-trip")
+        return rec
+
+    scenarios: dict = {}
+
+    # ---- replica_death: injected loop death, watchdog supervises -------
+    eng = Engine(params, config, _ec(
+        watchdog_interval_s=0.1, hang_timeout_s=0.5,
+        chaos=FaultConfig(seed=0, die_on_tick=3)))
+    eng.start()
+    try:
+        eng.generate(_prompt(8), 8, timeout=120)
+    except Exception:  # noqa: BLE001 — the victim request fails, by design
+        pass
+    scenarios["replica_death"] = _check(
+        "replica_death", "replica_death", _await_resolved(eng))
+    eng.stop()
+
+    # ---- prefill_interference: decode TPOT burns while a long chunked
+    # prefill occupies the loop (the Sarathi-Serve signature).  The tick
+    # floor widens each chunk tick so the burn crossing (min-samples'th
+    # TPOT commit) reliably lands while the prefill backlog is live.
+    slo = SloConfig.from_json({
+        "targets": {"interactive": {"tpot": 0.000001}},
+        "windows": [60], "burn_threshold": {"interactive": 2.0},
+        "burn_min_samples": 8})
+    chunks = 12
+    long_prompt = _prompt(chunks * 256)
+    _os.environ["ENGINE_TICK_FLOOR_S"] = "0.005"
+    try:
+        eng = Engine(params, config, _ec(
+            slo=slo, max_slots=2, num_pages=2 * chunks * 8 + 64,
+            max_pages_per_slot=chunks * 8 + 8))
+        futs = [eng.generate_async(_prompt(8), 48),
+                eng.generate_async(long_prompt, 4)]
+        eng.start()
+        for f in futs:
+            f.result(timeout=600)
+        scenarios["prefill_interference"] = _check(
+            "prefill_interference", "prefill_interference",
+            _await_resolved(eng))
+        eng.stop()
+    finally:
+        del _os.environ["ENGINE_TICK_FLOOR_S"]
+
+    # ---- storage_degradation: bit-flipping disk tier corrupts the
+    # pinned session; the warm turn degrades to recompute ---------------
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        eng = Engine(params, config, _ec(
+            kv_store=KVStoreConfig(
+                host_max_bytes=0, disk_dir=_os.path.join(td, "kv"),
+                chaos=StorageFaultConfig(seed=0, bit_flip_every=1))))
+        eng.start()
+        p1 = _prompt(64)
+        r1 = eng.generate(p1, 12, session_id="s1", timeout=300)
+        r2 = eng.generate(p1 + r1["tokens"], 8, session_id="s1",
+                          timeout=300)
+        if r2["session"]["restore"] != "degraded":
+            failures.append("storage scenario: restore was "
+                            f"{r2['session']['restore']}, not degraded")
+        scenarios["storage_degradation"] = _check(
+            "storage_degradation", "storage_degradation",
+            _await_resolved(eng))
+        eng.stop()
+
+    # ---- handoff_degradation: an import whose resume_len disagrees
+    # with the prompt degrades at submit (engine-side backstop) ----------
+    eng = Engine(params, config, _ec())
+    eng.start()
+    r = eng.generate(_prompt(8), 8, timeout=300,
+                     kv_import=(b"bogus", 5, 999))
+    if not r["tokens"]:
+        failures.append("handoff scenario: degraded request produced "
+                        "no tokens")
+    scenarios["handoff_degradation"] = _check(
+        "handoff_degradation", "handoff_degradation",
+        _await_resolved(eng))
+    eng.stop()
+
+    # ---- fabric_degradation: a pulled frame sharing no chain hash
+    # with the prompt degrades at admission ------------------------------
+    eng = Engine(params, config, _ec())
+    eng.start()
+    bogus = np.asarray([7, 9], np.uint64)
+    r = eng.generate(_prompt(80), 8, timeout=300,
+                     fabric_import=(("k", "v"), bogus, 128))
+    if r.get("fabric", {}).get("restore") != "degraded":
+        failures.append("fabric scenario: import did not degrade")
+    scenarios["fabric_degradation"] = _check(
+        "fabric_degradation", "fabric_degradation", _await_resolved(eng))
+    eng.stop()
+
+    # ---- capacity: admission rejections at the queue bound -------------
+    eng = Engine(params, config, _ec(max_queue_depth=1))
+    fut = eng.generate_async(_prompt(8), 8)
+    rejections = 0
+    for _ in range(5):
+        try:
+            eng.generate_async(_prompt(8), 8)
+        except EngineOverloaded:
+            rejections += 1
+    eng.start()
+    fut.result(timeout=300)
+    scenarios["capacity"] = _check(
+        "capacity", "capacity", _await_resolved(eng))
+    scenarios["capacity"]["rejections"] = rejections
+    eng.stop()
+
+    # ---- clean arm + overhead ------------------------------------------
+    page_size = 32
+    prompts = [_prompt(args.prompt_len) for _ in range(args.requests)]
+
+    def clean_pass(incidents_on: bool):
+        eng = Engine(params, config, EngineConfig(
+            max_slots=args.concurrency, page_size=page_size,
+            num_pages=1024,
+            max_pages_per_slot=(args.prompt_len + args.max_tokens)
+            // page_size + 2,
+            slo=generous, incidents=incidents_on,
+            incident_tick_overrun_s=30.0), lora=lora)
+        eng.start()
+        eng.generate(prompts[0][:8], 2)  # compile warmup
+        futs = [eng.generate_async(p, args.max_tokens) for p in prompts]
+        results = [f.result(timeout=1800) for f in futs]
+        lat = np.array([r["latency_s"] for r in results])
+        _time.sleep(0.1)  # a few poll cycles before reading the verdict
+        n_incidents = len(eng.incident_list())
+        firings = (eng.stats.get("incidents", {}).get("firings", 0)
+                   if incidents_on else 0)
+        eng.stop()
+        return float(np.percentile(lat, 50)), n_incidents, firings
+
+    clean_pass(True)  # shared warmup: both modes share jit shapes
+    p50s = {True: [], False: []}
+    clean_incidents = 0
+    clean_firings = 0
+    for mode in (False, True, False, True):
+        p50, n_inc, firings = clean_pass(mode)
+        p50s[mode].append(p50)
+        if mode:
+            clean_incidents += n_inc
+            clean_firings += firings
+    p50_off, p50_on = min(p50s[False]), min(p50s[True])
+    overhead_pct = (p50_on - p50_off) / p50_off * 100.0
+    if clean_incidents:
+        failures.append(f"clean arm opened {clean_incidents} incidents "
+                        "(want 0)")
+    if overhead_pct > args.incidents_budget:
+        failures.append(f"detector overhead {overhead_pct:.2f}% p50 > "
+                        f"{args.incidents_budget}% budget")
+
+    out = {
+        "metric": f"incident_plane_{args.config}",
+        "scenarios": scenarios,
+        "taxonomy_pass": not any(
+            f for f in failures
+            if not f.startswith(("clean arm", "detector overhead"))),
+        "clean": {"requests": args.requests * 2,
+                  "incidents": clean_incidents,
+                  "detector_firings": clean_firings},
+        "overhead_p50_pct": round(overhead_pct, 2),
+        "incidents_off_p50_s": round(p50_off, 4),
+        "incidents_on_p50_s": round(p50_on, 4),
+        "overhead_budget_pct": args.incidents_budget,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "param_count": config.param_count(),
+        "platform": jax.devices()[0].platform,
+        "protocol_note": "fault scenarios one-fresh-engine each; "
+                         "overhead = alternating on/off x2 after shared "
+                         "warmup, best-of p50s",
+    }
+    line = _json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if failures:
+        raise SystemExit("incidents bench FAILED: " + "; ".join(failures))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="1b", choices=["tiny", "1b", "llama3_8b"])
@@ -3267,6 +3549,18 @@ def main() -> None:
                         "analytical-MFU cross-check vs BENCH_r05, and the "
                         "waste-attribution audits; writes BENCH_PERF.json "
                         "via --out")
+    p.add_argument("--incidents", action="store_true",
+                   help="incident-plane bench (README 'Incident plane'): "
+                        "one fault scenario per root-cause taxonomy "
+                        "entry, each gating exactly-one-correctly-"
+                        "classified incident citing a live trace + "
+                        "readable flight dump; clean run gates zero "
+                        "incidents; detector overhead gated vs an "
+                        "incidents-off arm (BENCH_INCIDENTS.json via "
+                        "--out)")
+    p.add_argument("--incidents-budget", type=float, default=2.0,
+                   help="max p50 latency overhead (percent) of the "
+                        "incident plane vs the incidents-off arm")
     p.add_argument("--perf-budget", type=float, default=5.0,
                    help="max perf-plane p50 overhead percent (both scopes)")
     p.add_argument("--obs-budget", type=float, default=5.0,
@@ -3338,6 +3632,9 @@ def main() -> None:
         return
     if args.perf:
         _run_perf(args, config, params, lora)
+        return
+    if args.incidents:
+        _run_incidents(args, config, params, lora)
         return
     if args.overlap:
         _run_overlap(args, config, params, lora)

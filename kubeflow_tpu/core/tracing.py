@@ -112,8 +112,13 @@ class TraceStore:
     Budgeted in BOTH entries (distinct traces) and bytes (sum of span
     sizes): a fleet soak with many short traces hits the entry cap, a few
     huge traces (long retries, deep session chains) hit the byte cap.
-    Whole traces evict oldest-insertion-first — a half-evicted trace would
-    assemble into a tree that silently lies about what happened.  A trace
+    Whole traces evict least-recently-WRITTEN-first (every ``put`` touches
+    its trace to the back): insertion-order eviction made a long-lived
+    trace that keeps receiving spans — a multi-turn session, a mid-stream
+    failover, exactly the traces an incident bundle cites — the "oldest"
+    entry, evicted while still actively written, while idle one-shot
+    traces survived behind it.  Whole traces, never spans — a half-evicted
+    trace would assemble into a tree that silently lies.  A trace
     STILL BEING WRITTEN when it was evicted (another thread's long stream
     under churn) re-creates with a synthetic ``evicted_history`` marker
     span, so the partial tree reads as "history truncated", never as "one
@@ -141,6 +146,11 @@ class TraceStore:
         evicted = 0
         with self._lock:
             spans = self._traces.get(trace_id)
+            if spans is not None:
+                # LRU by last write: an actively-written trace moves to
+                # the back so the eviction loop's next(iter(...)) finds
+                # the trace that stopped receiving spans longest ago
+                self._traces[trace_id] = self._traces.pop(trace_id)
             if spans is None:
                 spans = self._traces[trace_id] = []
                 self._sizes[trace_id] = 0

@@ -25,8 +25,11 @@ def install(api: APIServer, manager, runtimes: bool = True):
     manager.add(DeploymentReconciler(api), owns=("Pod",))
     manager.add(InferenceServiceReconciler(api), owns=("Deployment",))
     manager.add(InferenceGraphReconciler(api))
-    autoscaler = ConcurrencyAutoscaler(api)
-    manager.add_ticker(autoscaler.sync)
     proxy = ServiceProxy(api)
+    # incident plane (README "Incident plane"): the autoscaler feeds its
+    # flap detector into the proxy's per-service incident managers and
+    # reads their open-incident state as a scale-down veto
+    autoscaler = ConcurrencyAutoscaler(api, incidents=proxy.incident_view())
+    manager.add_ticker(autoscaler.sync)
     manager.add_ticker(proxy.sync)
     return Router(api), proxy

@@ -24,6 +24,7 @@ ANNOTATION) are exiting and count toward neither capacity nor load.
 
 from __future__ import annotations
 
+import collections
 import math
 import re
 import time
@@ -69,6 +70,14 @@ STALE_SAMPLE_WINDOW_S = 2.0
 # degraded retry), but a terminally dead engine on a still-ready pod must
 # not pin the fleet size forever — past this window scaling resumes
 UNHEALTHY_VETO_WINDOW_S = 30.0
+# ---- incident plane (README "Incident plane") ----------------------------
+# flap detection: this many scale-DIRECTION flips inside the window feeds a
+# ``flap`` event into the incident manager (classified "capacity" — an
+# oscillating scaler is a capacity-control fault, and the postmortem bundle
+# cites the scale history a responder otherwise greps logs for); edge-
+# triggered once per window so a sustained oscillation is one incident
+FLAP_WINDOW_S = 10.0
+FLAP_FLIPS = 3
 
 # slo_attainment_ratio{class="...",metric="...",model="..."} sample keys in
 # a scraped exposition (the engine registry's per-class SLO gauges,
@@ -108,9 +117,19 @@ def scrape_metrics(port: int, timeout: float = DEFAULT_SCRAPE_TIMEOUT_S) -> Opti
 
 class ConcurrencyAutoscaler:
     def __init__(self, api: APIServer,
-                 scrape_timeout: float = DEFAULT_SCRAPE_TIMEOUT_S):
+                 scrape_timeout: float = DEFAULT_SCRAPE_TIMEOUT_S,
+                 incidents=None):
         self.api = api
         self.scrape_timeout = scrape_timeout
+        # incident plane (README "Incident plane"), both directions:
+        # the scaler FEEDS flap events into this manager (usually the
+        # service proxy's ingress-scope one), and READS its open-incident
+        # state — scale-down is vetoed while any incident is open, the
+        # same "missing/bad data must not shrink capacity" posture as the
+        # unscraped and unhealthy vetoes.  None = plane off.
+        self.incidents = incidents
+        self._scale_dirs: dict[str, collections.deque] = {}
+        self._flap_fired: dict[str, float] = {}
         # per-deployment uid: time the current lower desired value was first seen
         self._downscale_since: dict[str, tuple[int, float]] = {}
         self._last_traffic: dict[str, float] = {}
@@ -150,6 +169,12 @@ class ConcurrencyAutoscaler:
         for uid in list(self._slo_view):
             if uid not in deploy_uids:
                 del self._slo_view[uid]
+        # flap-detector state follows the same churn rule: a recreated
+        # deployment gets a fresh uid, and dead uids must not accumulate
+        for uid in list(self._scale_dirs):
+            if uid not in deploy_uids:
+                del self._scale_dirs[uid]
+                self._flap_fired.pop(uid, None)
         return changed
 
     def _autoscale(self, deploy: Obj, ann: dict) -> bool:
@@ -261,6 +286,16 @@ class ConcurrencyAutoscaler:
             self._downscale_since.pop(uid, None)
             return False
 
+        if (self.incidents is not None and desired < current
+                and self.incidents.open_count() > 0):
+            # an OPEN incident means the fleet is mid-fault (failover
+            # burst, degradation storm, burn): shrinking capacity while
+            # the story is still unfolding is how outages compound.
+            # Incidents auto-resolve after their quiet window, so this
+            # veto cannot pin the fleet size forever.
+            self._downscale_since.pop(uid, None)
+            return False
+
         if unhealthy:
             # any UNHEALTHY replica means the fleet's real capacity is
             # below its replica count — shrinking it further would cut
@@ -311,7 +346,30 @@ class ConcurrencyAutoscaler:
         yet."""
         return {uid: dict(v) for uid, v in self._slo_view.items()}
 
+    def _note_scale(self, uid: str, name: str, direction: int) -> None:
+        """Flap detector: record the scale direction and feed a ``flap``
+        incident event when the direction flips FLAP_FLIPS times inside
+        FLAP_WINDOW_S (up/down/up thrash — the autoscaler fighting
+        itself or an oscillating load signal)."""
+        now = time.monotonic()
+        dq = self._scale_dirs.setdefault(uid,
+                                         collections.deque(maxlen=16))
+        dq.append((now, direction))
+        recent = [d for t, d in dq if now - t <= FLAP_WINDOW_S]
+        flips = sum(1 for a, b in zip(recent, recent[1:]) if a != b)
+        if (flips >= FLAP_FLIPS
+                and now - self._flap_fired.get(uid, -1e9) > FLAP_WINDOW_S):
+            self._flap_fired[uid] = now
+            if self.incidents is not None:
+                self.incidents.feed("flap", deployment=name, flips=flips,
+                                    window_s=FLAP_WINDOW_S, trace_ids=[])
+
     def _scale(self, deploy: Obj, replicas: int, zero: bool) -> bool:
+        current = int(deploy["spec"].get("replicas", 1))
+        if replicas != current:
+            self._note_scale(deploy["metadata"]["uid"],
+                             deploy["metadata"]["name"],
+                             1 if replicas > current else -1)
         ann_patch = {SCALED_TO_ZERO_ANNOTATION: "true" if zero else None}
         self.api.patch(
             "Deployment",

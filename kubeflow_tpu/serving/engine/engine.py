@@ -86,6 +86,7 @@ from ..disagg import HandoffStore, normalize_role
 from ..errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
                       NonFiniteLogits, RequestError, SessionBusy,
                       TickFailure)
+from ..incidents import IncidentConfig, IncidentManager, engine_detectors
 from ..kvfabric import FabricStore, fabric_key
 from ..slo import SloConfig, SloTracker
 from .faults import (ChaosInjector, FabricChaos, FabricFaultConfig,
@@ -302,6 +303,31 @@ class EngineConfig:
     # torn/flipped/slow/dead-link pulls, pre-expired publishes — every
     # one must degrade to re-prefill, never fail a request
     fabric_chaos: Optional[FabricFaultConfig] = None
+    # ---- incident plane (README "Incident plane") -----------------------
+    # background fault-detection + evidence-correlation manager
+    # (serving/incidents.py): watchdog trips, tick-deadline overruns,
+    # NaN-guard trips, storage/handoff/fabric degradation outcomes,
+    # SLO burn-threshold crossings and admission rejections open
+    # classified postmortem bundles served as GET /engine/incidents.
+    # Off by default: the manager runs a polling thread per engine — a
+    # cost only deployments that want self-diagnosis should pay (the
+    # raw signals are all exported regardless).
+    incidents: bool = False
+    # where postmortem bundles land (None: <tmpdir>/engine_incidents)
+    incident_dir: Optional[str] = None
+    # cascading symptoms within this window of an open incident's LAST
+    # symptom coalesce into its causal chain instead of alert-storming
+    incident_debounce_s: float = 5.0
+    # this much symptom-free quiet resolves an open incident (must be
+    # >= debounce or one burst could bridge straight through resolution)
+    incident_resolve_s: float = 15.0
+    # incident-manager processing/polling cadence (the SLO burn detector
+    # reads rolling windows nothing events on)
+    incident_poll_s: float = 0.25
+    # a WORK tick slower than this feeds a tick_overrun signal (0 = off;
+    # the watchdog hang detector still covers the pathological case —
+    # this catches the chronic-slow-tick regime below hang_timeout_s)
+    incident_tick_overrun_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -697,6 +723,30 @@ class Engine:
         # _trace_dumps by _TRACE_REF_CAP)
         self._session_spans: "dict[str, tuple[str, str]]" = {}
         self._nan_dump_tick = -1  # last tick that produced a NaN dump
+        # ---- incident plane (serving/incidents.py, README "Incident
+        # plane") --------------------------------------------------------
+        # background fault correlator: hot paths only ever feed() it (an
+        # O(1) append); detection, evidence snapshots, classification and
+        # bundle writes run on ITS thread, never the loop's.  The burn
+        # detector is a poller (rolling-window burn rates are computed,
+        # not evented); _burn_above edge-triggers it per (class, metric).
+        self.incidents: Optional[IncidentManager] = None
+        self._burn_above: set = set()
+        if engine_config.incidents:
+            self.incidents = IncidentManager(
+                scope="engine",
+                config=IncidentConfig(
+                    debounce_s=engine_config.incident_debounce_s,
+                    resolve_s=engine_config.incident_resolve_s,
+                    poll_interval_s=engine_config.incident_poll_s,
+                    bundle_dir=engine_config.incident_dir),
+                detectors=engine_detectors(),
+                evidence=self._incident_evidence,
+                dump=self._incident_dump,
+                on_firing=self.telemetry.count_incident_firing,
+                on_resolve=self.telemetry.count_incident,
+                on_open_count=self.telemetry.set_incidents_open)
+            self.incidents.add_poller(self._incident_poll)
         self._profiler = TickProfiler()
         # capture completion (loop thread) closes out the ProfileStore run
         # record: artifacts get sized, count/byte caps evict oldest-first
@@ -731,6 +781,8 @@ class Engine:
             self._wd_thread = threading.Thread(target=self._watchdog,
                                                daemon=True)
             self._wd_thread.start()
+        if self.incidents is not None:
+            self.incidents.start()  # idempotent, like this method
 
     def begin_drain(self) -> None:
         """Enter DRAINING without stopping: new submissions are refused with
@@ -789,6 +841,11 @@ class Engine:
         for slot in list(self._slot_req):
             self._fail_slot(slot, EngineShutdown("engine stopped"))
         self._fail_unassigned(EngineShutdown("engine stopped"))
+        # retire the incident manager BEFORE the batcher closes: its final
+        # processing pass may snapshot evidence through self.stats, which
+        # reads the C core
+        if self.incidents is not None:
+            self.incidents.stop()
         self.batcher.close()
         # release the tiered KV store: an ephemeral (auto-tempdir) store
         # deletes its page files — nothing could ever recover them; an
@@ -928,6 +985,16 @@ class Engine:
         depth = len(self._sched) + self.batcher.queue_depth
         if self.ec.max_queue_depth > 0 and depth >= self.ec.max_queue_depth:
             self._requests_rejected += 1
+            if self.incidents is not None:
+                # capacity signal (README "Incident plane"): admission-
+                # queue growth past the bound with no replica-health
+                # evidence is the classifier's "capacity" shape; a
+                # rejection storm coalesces into one incident inside the
+                # debounce window.  Trace-id sampling only happens with
+                # the plane ON — a plane-off rejection must stay free.
+                self.incidents.feed("queue_growth", queue_depth=depth,
+                                    rejected=1,
+                                    trace_ids=self._live_trace_ids())
             raise EngineOverloaded(
                 f"queue depth {depth} >= "
                 f"max_queue_depth {self.ec.max_queue_depth}")
@@ -1005,6 +1072,7 @@ class Engine:
                 # replica already did: waste, attributed
                 pending.waste_reason = "handoff_degraded"
                 self.telemetry.count_handoff("degraded")
+                self._note_degradation("handoff", "park_failed", pending)
         if fabric_import is not None and kv_import is None:
             # a verified remote prefix frame rides the pending record
             # (not the tiered store: it is freed with the record, so no
@@ -1037,6 +1105,14 @@ class Engine:
                 pending.fabric_restore = "degraded"
                 pending.waste_reason = "fabric_degraded"
                 self.telemetry.count_fabric("degraded")
+                self._note_degradation("fabric", "park_failed", pending)
+        if waste_hint in ("handoff_degraded", "fabric_degraded"):
+            # the serve layer degraded the import BEFORE submit (pull
+            # failed verification/timeout): same incident signal as an
+            # engine-side degrade — the fault story must not depend on
+            # WHERE along the pull path the fault landed
+            self._note_degradation(waste_hint.split("_", 1)[0],
+                                   "pre_submit", pending)
         # the request now waits in the HOST scheduler queue; the engine
         # loop submits it to the C++ core only when the policy admits it
         # (per-tick admission — the Orca iteration-level scheduling point)
@@ -1263,6 +1339,8 @@ class Engine:
                    if self._fabric_chaos is not None else {}),
                 **({"slo": self.telemetry.slo.snapshot()}
                    if self.telemetry.slo is not None else {}),
+                **({"incidents": self.incidents.stats()}
+                   if self.incidents is not None else {}),
                 **({"chaos": self._chaos.stats()} if self._chaos else {}),
                 **self.batcher.cache_stats(),
             }
@@ -1280,6 +1358,161 @@ class Engine:
         False if no such session.  In-flight turns are unaffected (their
         pin at finish simply re-creates the entry)."""
         return self._kv.drop_session(session_id)
+
+    # ------------------------------------------------ incident plane API
+
+    def incident_list(self) -> list:
+        """Incidents this engine's manager holds (open first), served as
+        ``GET /engine/incidents``.  Empty when the plane is off."""
+        return self.incidents.list() if self.incidents is not None else []
+
+    def incident_get(self, incident_id: str) -> Optional[dict]:
+        return (self.incidents.get(incident_id)
+                if self.incidents is not None else None)
+
+    def incident_open_count(self) -> int:
+        return (self.incidents.open_count()
+                if self.incidents is not None else 0)
+
+    def _incident_event(self, kind: str, **attrs) -> None:
+        """The ONE incident-plane call the hot paths make: O(1) append
+        into the manager's intake deque, no-op when the plane is off."""
+        if self.incidents is not None:
+            self.incidents.feed(kind, **attrs)
+
+    def _note_degradation(self, source: str, outcome: str,
+                          pending: "Optional[_Pending]" = None) -> None:
+        """Degradation-outcome signal (README "Incident plane"): a
+        storage-fault recompute, handoff re-prefill, or fabric degraded
+        pull completed the request the slow way.  One call per degraded
+        request at the site that counted the telemetry outcome."""
+        if self.incidents is None:
+            return
+        tids = ([pending.span.trace_id]
+                if pending is not None and pending.span is not None else [])
+        self.incidents.feed("degradation", source=source, outcome=outcome,
+                            rid=getattr(pending, "rid", None),
+                            trace_ids=tids)
+
+    def _live_trace_ids(self, cap: int = 4) -> list:
+        """Trace ids of a few live requests — the correlation evidence
+        for signals that concern the ENGINE rather than one request
+        (burn crossings, queue pressure).  Falls back to the most recent
+        ARCHIVED spans when nothing is in flight: a burn detected just
+        after the offending burst drained must still cite resolvable
+        traces (``/engine/trace/<id>`` serves the history ring too).
+        Best-effort: called from the manager/caller threads, never worth
+        blocking the loop over."""
+        if not self.ec.telemetry:
+            return []  # no spans exist to find: don't scan for them
+        out: list = []
+        try:
+            with self._lock:
+                # bounded iteration, never a full copy of the request
+                # table: a rejection storm calls this at exactly the
+                # moment the table is at its largest
+                for p in self._requests.values():
+                    if p.span is not None:
+                        out.append(p.span.trace_id)
+                        if len(out) >= cap:
+                            break
+                if not out:
+                    for s in reversed(self._trace_ring.values()):
+                        out.append(s.trace_id)
+                        if len(out) >= cap:
+                            break
+            return out
+        except Exception:  # noqa: BLE001 — evidence is best-effort
+            return out
+
+    def _incident_evidence(self) -> dict:
+        """Evidence snapshot for a newly opened incident (manager
+        thread): the metrics window, the health state, and the SLO burn
+        series — the correlated cross-signal view a responder otherwise
+        stitches together by hand."""
+        out: dict = {}
+        try:
+            s = self.stats
+            out["metrics"] = {k: s.get(k) for k in (
+                "active_slots", "queue_depth", "free_pages", "ticks",
+                "ticks_failed", "requests_shed", "requests_rejected",
+                "requests_failed", "nan_rows", "restarts", "preemptions")}
+            if "slo" in s:
+                out["slo"] = s["slo"]
+        except Exception:  # noqa: BLE001 — engine may be stopping
+            pass
+        try:
+            out["health"] = self.health()
+        except Exception:  # noqa: BLE001
+            pass
+        return out
+
+    def _incident_dump(self, first_event: dict) -> Optional[str]:
+        """Flight-recorder dump for a new incident: reuse the dump the
+        triggering signal already produced (watchdog/NaN paths dump at
+        the fault site — the recorder's capped lifetime dump budget must
+        not be spent twice per fault), else force one now while the ring
+        still holds the faulting ticks."""
+        path = first_event.get("dump")
+        if path:
+            return path
+        return self.flight.dump(
+            "incident_open",
+            extra={"kind": first_event.get("kind"),
+                   "trace_ids": list(first_event.get("trace_ids") or ())})
+
+    def _incident_poll(self) -> None:
+        """SLO burn-threshold detector (manager thread): edge-triggered
+        per (class, metric) against the tracker's OWN snapshot — the same
+        burn values and thresholds ``/fleet/incidents`` evidence and
+        ``Engine.stats['slo']`` report, one source of truth.  Re-arms at
+        half the threshold so a rate hovering at the line doesn't flap."""
+        slo = self.telemetry.slo
+        if slo is None:
+            return
+        try:
+            snap = slo.snapshot()
+        except Exception:  # noqa: BLE001
+            return
+        seen: set = set()
+        for cls, metrics in snap.items():
+            for metric, rec in metrics.items():
+                thr = rec.get("burn_threshold")
+                burn = (rec.get("burn") or {}).get(rec.get("burn_window"))
+                key = (cls, metric)
+                seen.add(key)
+                if burn is None or (thr and burn < thr * 0.5):
+                    # re-arm BEFORE any other gate: a burn that cooled
+                    # off (or drained below the sample floor) must be
+                    # detectable again next episode
+                    self._burn_above.discard(key)
+                    continue
+                if (rec.get("burn_samples") or 0) \
+                        < (rec.get("burn_min_samples") or 0):
+                    # statistical floor: burn over a handful of samples
+                    # (one cold-compile miss out of five) must not page
+                    continue
+                if thr and burn >= thr and key not in self._burn_above:
+                    self._burn_above.add(key)
+                    try:
+                        queue_depth = (len(self._sched)
+                                       + self.batcher.queue_depth)
+                    except Exception:  # noqa: BLE001
+                        queue_depth = 0
+                    self._incident_event(
+                        "slo_burn", cls=cls, metric=metric,
+                        burn=round(burn, 3), threshold=thr,
+                        window=rec.get("burn_window"),
+                        queue_depth=queue_depth,
+                        # the Sarathi-Serve discriminator: slots
+                        # mid-chunked-prefill while decode burns
+                        prefill_active=len(self._prefilling),
+                        trace_ids=self._live_trace_ids())
+        # a series whose samples aged out of EVERY window vanishes from
+        # the snapshot entirely — the latch must re-arm then too, or the
+        # first burn of an engine's lifetime would be the only one the
+        # plane ever detects after a full-drain quiet gap
+        self._burn_above &= seen
 
     # ------------------------------------------------ perf introspection API
 
@@ -1763,7 +1996,10 @@ class Engine:
                 if self._epoch != epoch:
                     return  # supervisor replaced us while we were stalled
             obs = self.ec.telemetry
-            tick_t0 = time.perf_counter() if (tick_floor or obs) else 0.0
+            overrun_s = self.ec.incident_tick_overrun_s \
+                if self.incidents is not None else 0.0
+            tick_t0 = time.perf_counter() \
+                if (tick_floor or obs or overrun_s > 0) else 0.0
             self._ticks += 1
             self._last_tick_ts = time.monotonic()
             self._profiler.on_tick_start(self._ticks)
@@ -1796,6 +2032,17 @@ class Engine:
                 # tick-duration histogram: work ticks only — idle 20ms waits
                 # would swamp the distribution with scheduler noise
                 self.telemetry.observe_tick(time.perf_counter() - tick_t0)
+            if overrun_s > 0 and did_work:
+                # tick-deadline overrun (README "Incident plane"): a WORK
+                # tick past the configured budget is the chronic-slowness
+                # signal below the watchdog's hang threshold
+                dur = time.perf_counter() - tick_t0
+                if dur > overrun_s:
+                    self._incident_event(
+                        "tick_overrun", duration_s=round(dur, 4),
+                        threshold_s=overrun_s,
+                        trace_ids=self._slot_trace_ids(
+                            list(self._slot_req)))
             if did_work and tick_floor:
                 pad = tick_floor - (time.perf_counter() - tick_t0)
                 if pad > 0:
@@ -2057,6 +2304,8 @@ class Engine:
                         pending.swapped = False
                         pending.waste_reason = "handoff_degraded"
                         self.telemetry.count_handoff("degraded")
+                        self._note_degradation("handoff", "scatter_failed",
+                                               pending)
                         if self.ec.telemetry:
                             self._flight_event(
                                 "handoff_import", [slot], None,
@@ -2079,6 +2328,7 @@ class Engine:
                 if pending.handoff_import:
                     pending.waste_reason = "handoff_degraded"
                     self.telemetry.count_handoff("degraded")
+                    self._note_degradation("handoff", "blob_lost", pending)
                 else:
                     # the cold re-prefill below recomputes positions this
                     # engine already computed once — same attribution as
@@ -2148,6 +2398,11 @@ class Engine:
                 pending.session_restore = ("degraded" if outcome == "corrupt"
                                            else "cold")
                 self.telemetry.count_session_restore(pending.session_restore)
+                if pending.session_restore == "degraded":
+                    # the store HAD the session but verification failed
+                    # (torn write / bit flip / missing file): the
+                    # storage-fault signal; a plain miss is not one
+                    self._note_degradation("storage", outcome, pending)
                 return cached * ps
             blob, nbytes, meta = payload
             stored = np.asarray(meta.get("hashes", ()), np.uint64)
@@ -2181,6 +2436,7 @@ class Engine:
         except Exception as exc:  # noqa: BLE001 — restore must degrade
             pending.session_restore = "degraded"
             self.telemetry.count_session_restore("degraded")
+            self._note_degradation("storage", "restore_error", pending)
             if self.ec.telemetry:
                 self._flight_event("session_restore", [slot], None, t0,
                                    "error",
@@ -2222,6 +2478,7 @@ class Engine:
                 pending.waste_reason = (pending.waste_reason
                                         or "fabric_degraded")
                 self.telemetry.count_fabric("degraded")
+                self._note_degradation("fabric", "hash_mismatch", pending)
                 return covered * ps
             if usable <= covered:
                 # local state (device cache / session restore) already
@@ -2247,6 +2504,7 @@ class Engine:
             pending.fabric_restore = "degraded"
             pending.waste_reason = pending.waste_reason or "fabric_degraded"
             self.telemetry.count_fabric("degraded")
+            self._note_degradation("fabric", "restore_error", pending)
             if self.ec.telemetry:
                 self._flight_event("fabric_restore", [slot], None, t0,
                                    "error",
@@ -2604,6 +2862,8 @@ class Engine:
         near-identical postmortems."""
         self._nan_rows += 1
         self._mark_roster_change("nan")  # before the release's "finish"
+        tids: list = []
+        path = None
         if self.ec.telemetry:
             tids = self._slot_trace_ids([slot])
             self._flight_event("nan_guard", [slot], None,
@@ -2617,6 +2877,14 @@ class Engine:
                            "trace_ids": tids,
                            "where": where, "tick": self._ticks})
                 self._note_dump(path, tids)
+        # incident signal: classifies "unknown" on its own (a lone NaN is
+        # numeric divergence, not a taxonomy shape) but joins the causal
+        # chain when a bigger incident is open; carries the dump so an
+        # incident it OPENS cites this postmortem instead of forcing a
+        # second one
+        self._incident_event("nan_guard", where=where,
+                             rid=self._slot_req.get(slot),
+                             trace_ids=tids, dump=path)
         self._fail_slot(slot, NonFiniteLogits(
             f"non-finite logits in {where}"))
 
@@ -2736,6 +3004,8 @@ class Engine:
         # production deployment escalates a repeat offender to process
         # restart.  Loop DEATH (the common case) has no such window.
         self._epoch += 1
+        tids: list = []
+        dump_path = None
         if self.ec.telemetry:
             # the postmortem the flight recorder exists for: what the loop
             # was doing when the watchdog had to step in.  Best-effort
@@ -2756,13 +3026,20 @@ class Engine:
                                trace_ids=tids,
                                shape=None, duration_s=0.0,
                                outcome="supervise", error=reason)
-            path = self.flight.dump(
+            dump_path = self.flight.dump(
                 "watchdog_" + ("restart" if self.ec.watchdog_restart
                                else "halt"),
                 extra={"detail": reason, "tick": self._ticks,
                        "trace_ids": tids,
                        "epoch": self._epoch, "restarts": self._restarts})
-            self._note_dump(path, tids)
+            self._note_dump(dump_path, tids)
+        # incident signal (README "Incident plane"): a watchdog trip IS
+        # the engine-local replica death — the classifier's strongest
+        # evidence.  Carries the dump just written so the incident cites
+        # it instead of burning a second recorder slot.
+        self._incident_event("watchdog", detail=reason,
+                             restart=self.ec.watchdog_restart,
+                             trace_ids=tids, dump=dump_path)
         err = TickFailure(f"engine {reason}; request abandoned by supervisor")
         # drop (never commit) the in-flight pipeline tick: its requests are
         # being failed wholesale, and a readback here — on the watchdog

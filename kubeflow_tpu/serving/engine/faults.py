@@ -58,6 +58,14 @@ fire one-shot callbacks, cuts break the relay connection while the replica
 survives.  The failover + re-admission machinery (router.py) must then
 keep every stream byte-identical — asserted by ``tests/test_fleet.py`` and
 ``serving_bench --fleet-chaos``.
+
+Incident plane (README "Incident plane"): every chaos class this module
+can inject has an EXPECTED root-cause classification in the incident
+plane's taxonomy — ``EXPECTED_INCIDENT_CAUSES`` below is that contract,
+consumed by ``tests/test_incidents.py`` and ``serving_bench --incidents``
+(one correctly-classified incident per injected fault burst, zero on a
+clean run).  A new injector class added here must name its expected cause
+here too, or the chaos-replay validator cannot gate it.
 """
 
 from __future__ import annotations
@@ -72,6 +80,40 @@ import numpy as np
 
 class ChaosDispatchError(RuntimeError):
     """An injected dispatch failure (stands in for a thrown prefill/decode)."""
+
+
+# Chaos class -> the root cause the incident plane must name for it
+# (serving/incidents.py CAUSES).  Keys are "<scope>:<class>" so the fleet
+# "slow" replica and the storage "slow" disk stay distinct entries.
+EXPECTED_INCIDENT_CAUSES = {
+    # fleet scope (FleetFaultConfig): the ingress sees failover retries /
+    # breaker opens for every one of these
+    "fleet:kill": "replica_death",
+    "fleet:hang": "replica_death",
+    "fleet:slow": "replica_death",
+    "fleet:cut": "replica_death",
+    # engine scope: loop death / hang is the engine-local replica death
+    "engine:die_on_tick": "replica_death",
+    "engine:slow_tick": "replica_death",
+    # storage scope (StorageFaultConfig): every verification failure
+    # degrades a session restore to recompute
+    "storage:torn_write": "storage_degradation",
+    "storage:bit_flip": "storage_degradation",
+    "storage:enospc": "storage_degradation",
+    # handoff scope (HandoffFaultConfig): every pull/export fault
+    # degrades the disaggregated import to re-prefill
+    "handoff:torn_pull": "handoff_degradation",
+    "handoff:slow_pull": "handoff_degradation",
+    "handoff:dead_link": "handoff_degradation",
+    "handoff:expired_export": "handoff_degradation",
+    # fabric scope (FabricFaultConfig): every pull/publish fault degrades
+    # the prefix fault-in to plain re-prefill
+    "fabric:torn_pull": "fabric_degradation",
+    "fabric:flip_pull": "fabric_degradation",
+    "fabric:slow_pull": "fabric_degradation",
+    "fabric:dead_link": "fabric_degradation",
+    "fabric:expired_publish": "fabric_degradation",
+}
 
 
 class ChaosThreadDeath(BaseException):

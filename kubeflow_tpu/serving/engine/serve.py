@@ -369,6 +369,10 @@ class JetStreamModel(Model):
             # windows at scrape time — same "right when read" discipline
             # as the occupancy gauges above
             self.engine.telemetry.refresh_slo()
+            # incident plane (README "Incident plane"): open-incident
+            # gauge refreshed right-when-read like the rest
+            self.engine.telemetry.set_incidents_open(
+                self.engine.incident_open_count())
             # perf-introspection derived gauges (README "Performance
             # introspection"): windowed MFU/goodput + KV fragmentation
             self.engine.refresh_perf_metrics()
@@ -420,6 +424,29 @@ class JetStreamModel(Model):
             return self.engine.trace_by_id(trace_id)
         except Exception:  # noqa: BLE001 — a debug read must answer
             return {"trace_id": trace_id, "spans": [], "flight_dumps": []}
+
+    def incident_list(self) -> list:
+        """Classified incidents this engine's incident plane holds — the
+        replica-local half of ``GET /engine/incidents`` (README "Incident
+        plane"); ``GET /fleet/incidents`` merges these fleet-wide.  Empty
+        when the plane is off or the engine is gone: an incident read
+        must never take a replica down."""
+        if self.engine is None:
+            return []
+        try:
+            return self.engine.incident_list()
+        except Exception:  # noqa: BLE001 — a debug read must answer
+            return []
+
+    def incident_get(self, incident_id: str):
+        """One incident by id (``GET /engine/incidents/<id>``); None when
+        unknown here — it may live on another replica."""
+        if self.engine is None:
+            return None
+        try:
+            return self.engine.incident_get(incident_id)
+        except Exception:  # noqa: BLE001 — a debug read must answer
+            return None
 
     @staticmethod
     def _wants_trace(headers: Optional[dict]) -> bool:

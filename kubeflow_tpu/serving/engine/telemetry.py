@@ -412,6 +412,27 @@ class EngineTelemetry:
             "engine_prefix_cache_pages_total",
             "prefix-cache page lookup outcomes at admission "
             "(hit/miss_cold/miss_partial)")
+        # Incident plane (README "Incident plane", serving/incidents.py):
+        # open incidents right now (set at scrape, right-when-read like
+        # the KV gauges), terminal incident count by FINAL classified
+        # root cause (counted at resolution, the engine_requests_total
+        # terminal-outcome analogy), and raw detector firings (a coalesced
+        # burst fires many times but opens ONE incident — the ratio is
+        # the debounce working).  The router registers the same three
+        # names in the shared core registry for its ingress-scope manager.
+        self.incidents_open = r.gauge(
+            "incidents_open",
+            "open (unresolved) incidents held by this component's "
+            "incident manager")
+        self.incidents_total = r.counter(
+            "incidents_total",
+            "resolved incidents by classified root cause "
+            "(replica_death/prefill_interference/storage_degradation/"
+            "handoff_degradation/fabric_degradation/capacity/unknown)")
+        self.incident_firings = r.counter(
+            "incident_detector_firings_total",
+            "incident detector firings by detector (many firings "
+            "coalesce into one incident inside the debounce window)")
 
     # Observe methods stay branch-cheap: one attribute check, then a dict
     # op under the metric's own lock.
@@ -535,6 +556,18 @@ class EngineTelemetry:
     def count_session_pin(self, outcome: str) -> None:
         if self.enabled:
             self.session_pins.inc(outcome=outcome)
+
+    def count_incident_firing(self, detector: str) -> None:
+        if self.enabled:
+            self.incident_firings.inc(detector=detector)
+
+    def count_incident(self, cause: str) -> None:
+        if self.enabled:
+            self.incidents_total.inc(cause=cause)
+
+    def set_incidents_open(self, n: int) -> None:
+        if self.enabled:
+            self.incidents_open.set(n)
 
     def set_kv_store_bytes(self, host: int, disk: int) -> None:
         if self.enabled:

@@ -33,6 +33,7 @@ duplicated or dropped tokens.
 
 from __future__ import annotations
 
+import collections
 import copy
 import json
 import random
@@ -47,6 +48,7 @@ from ..core import tracing
 from ..core.api import APIServer, Obj
 from ..core.metrics import REGISTRY, merge_expositions
 from . import disagg, kvfabric
+from . import incidents as incidents_mod
 from .api import GROUP, LABEL_ISVC, LABEL_REVISION
 from .controllers import (
     DEPLOYMENT_FOR_SERVICE_ANNOTATION,
@@ -108,6 +110,22 @@ INGRESS_BACKEND_STATE = REGISTRY.gauge(
 INGRESS_TRACE_EVICTIONS = REGISTRY.counter(
     "ingress_trace_evictions_total",
     "relay traces evicted from the proxy's bounded trace store")
+# Incident plane, ingress scope (README "Incident plane"): the service
+# proxy runs one incident manager per service — failover retries,
+# circuit-breaker opens, and autoscaler flapping feed its detectors, and
+# GET /fleet/incidents merges its incidents with every replica's
+# /engine/incidents.  Same three series the engine registers in its own
+# registry (one metric contract, two scopes).
+INCIDENTS_OPEN = REGISTRY.gauge(
+    "incidents_open",
+    "open (unresolved) incidents held by this component's incident "
+    "manager")
+INCIDENTS_TOTAL = REGISTRY.counter(
+    "incidents_total",
+    "resolved incidents by classified root cause")
+INCIDENT_FIRINGS = REGISTRY.counter(
+    "incident_detector_firings_total",
+    "incident detector firings by detector")
 
 # health states a backend can occupy; terminal routing decision per state:
 # healthy/suspect route, probation routes only as a fallback set, ejected
@@ -194,6 +212,15 @@ class _ProxyState:
         # land on a replica without the pinned pages — a silent cold
         # restore.  LRU-capped; pruned on pod churn like `health`.
         self.sessions: dict[str, int] = {}
+        # incident plane (README "Incident plane"): per-service ingress
+        # incident manager (wired by ServiceProxy._start — it needs the
+        # proxy's hooks) + the health-FSM transition log its evidence
+        # snapshots cite.  The log is diffed into existence by
+        # _set_state_gauge, the one funnel every transition already
+        # passes through.
+        self.incidents = None
+        self.health_log: collections.deque = collections.deque(maxlen=256)
+        self.health_last: dict[int, str] = {}
         self.lock = threading.Lock()
 
 
@@ -203,6 +230,9 @@ class ServiceProxy:
     def __init__(self, api: APIServer):
         self.api = api
         self._servers: dict[tuple[str, str], ThreadingHTTPServer] = {}
+        # per-service proxy state, kept alongside the listener so _stop
+        # can retire the state's incident manager with its server
+        self._states: dict[tuple[str, str], _ProxyState] = {}
         # optional fleet chaos hooks (faults.FleetChaos): the resumable
         # relay reports every relayed token event so seeded kill/hang/cut
         # injections fire at exact token counts (bench/test substrate)
@@ -235,6 +265,19 @@ class ServiceProxy:
         proxy = self
         ns, name = key
         state = _ProxyState(name, ns)
+        # ingress incident manager (README "Incident plane"): event-driven
+        # only — failover retries, breaker opens and autoscaler flap
+        # reports feed it; a clean fleet pays one idle wait per poll
+        # interval and nothing on any request path
+        state.incidents = incidents_mod.IncidentManager(
+            scope=f"ingress:{name}",
+            detectors=incidents_mod.ingress_detectors(),
+            evidence=lambda s=state: proxy._ingress_evidence(s),
+            on_firing=lambda d: INCIDENT_FIRINGS.inc(detector=d),
+            on_resolve=lambda c: INCIDENTS_TOTAL.inc(cause=c),
+            on_open_count=lambda n, s=state: INCIDENTS_OPEN.set(
+                n, service=s.service_name))
+        state.incidents.start()
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -262,6 +305,14 @@ class ServiceProxy:
                         return
                     if path == "/fleet/cache":
                         proxy._serve_fleet_cache(self, state)
+                        return
+                    if path == "/fleet/incidents":
+                        proxy._serve_fleet_incidents(self, state)
+                        return
+                    if path.startswith("/fleet/incidents/"):
+                        proxy._serve_fleet_incident(
+                            self, state,
+                            path[len("/fleet/incidents/"):])
                         return
                 proxy._relay(self, state, body)
 
@@ -333,13 +384,17 @@ class ServiceProxy:
         server.daemon_threads = True
         threading.Thread(target=server.serve_forever, kwargs={"poll_interval": 0.05}, daemon=True).start()
         self._servers[key] = server
+        self._states[key] = state
 
     def _stop(self, key: tuple[str, str]) -> None:
         server = self._servers.pop(key)
+        state = self._states.pop(key, None)
 
         def close():
             server.shutdown()
             server.server_close()  # release the listening socket, not just the loop
+            if state is not None and state.incidents is not None:
+                state.incidents.stop()
 
         threading.Thread(target=close, daemon=True).start()
 
@@ -664,6 +719,16 @@ class ServiceProxy:
                 tried.add(backend)
                 prev_failed_hop = hop.span_id
                 INGRESS_RETRIES.inc(service=state.service_name, reason=reason)
+                if state.incidents is not None:
+                    # failover incident signal (README "Incident plane"):
+                    # one event per failed attempt — a kill/hang/cut burst
+                    # coalesces into one incident citing this trace, and
+                    # the re-admission (resume) rides the same chain
+                    state.incidents.feed(
+                        "failover", service=state.service_name,
+                        backend=backend, reason=reason,
+                        resume=bool(resume is not None and resume.token_ids),
+                        trace_ids=[root.trace_id])
                 if not sse.started:
                     # jittered exponential backoff — but never while a live
                     # client stream is waiting on its continuation
@@ -1191,6 +1256,75 @@ class ServiceProxy:
             "replicas_unreachable": sorted(unreachable),
         }).encode())
 
+    # ------------------------------------------- fleet incident endpoints
+    # (README "Incident plane"): the proxy's own ingress-scope incidents
+    # merged with every replica's GET /engine/incidents — the same
+    # fan-out-and-merge shape as /fleet/metrics.  Two replicas reporting
+    # the same fault (both ends of one failover) dedupe on shared trace
+    # evidence, so a fleet-wide fault reads as ONE incident with every
+    # origin listed, not an alert per replica.
+
+    def _collect_fleet_incidents(self, state: _ProxyState) -> tuple:
+        """(merged incident list, pods, unreachable) across the proxy's
+        own manager and every replica's /engine/incidents."""
+        entries = []
+        if state.incidents is not None:
+            for inc in state.incidents.list():
+                entries.append(("ingress", inc))
+        pods = self._service_pods(state)
+        unreachable: list = []
+        for name, (raw, _lat) in sorted(self._fan_out(
+                pods, "/engine/incidents").items()):
+            if raw is None:
+                unreachable.append(name)
+                continue
+            try:
+                body = json.loads(raw)
+            except ValueError:
+                unreachable.append(name)
+                continue
+            for inc in body.get("incidents") or ():
+                entries.append((name, inc))
+        merged = incidents_mod.merge_fleet_incidents(entries)
+        return merged, pods, unreachable
+
+    def _serve_fleet_incidents(self, handler, state: _ProxyState) -> None:
+        """GET /fleet/incidents: the fleet-wide classified incident list,
+        open first, newest last — ingress incidents (failover bursts,
+        breaker opens, autoscaler flap) next to every replica's engine
+        incidents, deduped on shared trace evidence."""
+        merged, pods, unreachable = self._collect_fleet_incidents(state)
+        merged.sort(key=lambda i: (i.get("state") != "open",
+                                   i.get("opened_wall") or 0.0))
+        handler._reply(200, json.dumps({
+            "service": state.service_name,
+            "incidents": merged,
+            "open": sum(1 for i in merged if i.get("state") == "open"),
+            "replicas_queried": [n for n, _ in pods],
+            "replicas_unreachable": sorted(unreachable),
+        }, default=str).encode())
+
+    def _serve_fleet_incident(self, handler, state: _ProxyState,
+                              incident_id: str) -> None:
+        """GET /fleet/incidents/<id>: one incident's postmortem as the
+        responder's timeline (detector firing -> evidence refs ->
+        classification -> resolution), found on whichever component
+        holds it; merged ids resolve to their merged entry."""
+        merged, _pods, unreachable = self._collect_fleet_incidents(state)
+        found = next(
+            (m for m in merged
+             if m.get("id") == incident_id
+             or incident_id in (m.get("merged_ids") or ())), None)
+        if found is None:
+            handler._reply(404, json.dumps(
+                {"error": "unknown incident id",
+                 "replicas_unreachable": sorted(unreachable)}).encode())
+            return
+        handler._reply(200, json.dumps({
+            "incident": found,
+            "timeline": incidents_mod.timeline(found),
+        }, default=str).encode())
+
     # ------------------------------------- global cache-aware placement
     # (README "Fleet KV fabric"): the fleet-scope replacement for the
     # per-replica prefix-affinity LRU.  Every request's prompt is reduced
@@ -1304,12 +1438,13 @@ class ServiceProxy:
             else:
                 h.fails += 1
                 if h.state == "probation" or h.fails >= self._FAIL_THRESHOLD:
-                    self._eject(state, h)
+                    self._eject(state, h, port)
                 elif h.state == "healthy":
                     h.state = "suspect"
             self._set_state_gauge(state)
 
-    def _eject(self, state: _ProxyState, h: _BackendHealth) -> None:
+    def _eject(self, state: _ProxyState, h: _BackendHealth,
+               port: Optional[int] = None) -> None:
         """Open the breaker (caller holds state.lock): route nothing to this
         backend until the backoff lapses, then probation."""
         h.state = "ejected"
@@ -1318,11 +1453,30 @@ class ServiceProxy:
         h.ejections += 1
         h.fails = 0
         INGRESS_EJECTIONS.inc(service=state.service_name)
+        if state.incidents is not None:
+            # breaker-open incident signal (README "Incident plane"):
+            # feed() is an O(1) append, safe under state.lock
+            state.incidents.feed("breaker_open",
+                                 service=state.service_name,
+                                 backend=port, trace_ids=[])
 
     def _set_state_gauge(self, state: _ProxyState) -> None:
         counts = {s: 0 for s in _BACKEND_STATES}
-        for h in state.health.values():
+        now = time.time()
+        for port, h in state.health.items():
             counts[h.state] = counts.get(h.state, 0) + 1
+            # health-FSM transition log (README "Incident plane"): every
+            # transition batch already funnels through this gauge refresh
+            # (caller holds state.lock), so diffing here records the log
+            # without touching any individual transition site
+            prev = state.health_last.get(port)
+            if prev != h.state:
+                state.health_last[port] = h.state
+                state.health_log.append(
+                    {"wall": round(now, 3), "backend": port,
+                     "from": prev, "to": h.state})
+        for port in [p for p in state.health_last if p not in state.health]:
+            del state.health_last[port]
         for s, n in counts.items():
             INGRESS_BACKEND_STATE.set(n, service=state.service_name, state=s)
 
@@ -1411,12 +1565,12 @@ class ServiceProxy:
                 elif res == "dead":
                     # a DEAD engine needs no three strikes
                     if h.state != "ejected":
-                        self._eject(state, h)
+                        self._eject(state, h, p)
                 else:  # "fail": passive-style strike
                     h.fails += 1
                     if (h.state == "probation"
                             or h.fails >= self._FAIL_THRESHOLD):
-                        self._eject(state, h)
+                        self._eject(state, h, p)
                     elif h.state == "healthy":
                         h.state = "suspect"
             self._set_state_gauge(state)
@@ -1794,9 +1948,68 @@ class ServiceProxy:
                     ns,
                 )
 
+    def _ingress_evidence(self, state: _ProxyState) -> dict:
+        """Evidence snapshot for a newly opened ingress incident (manager
+        thread).  Takes state.lock like every other shared-proxy-state
+        reader: an unlocked iteration would race pod-churn mutation and
+        — because the manager swallows evidence errors — silently write
+        bundles with NO health log exactly when churn is the story."""
+        with state.lock:
+            return {"health_log": list(state.health_log)[-32:],
+                    "backends": {str(p): h.state
+                                 for p, h in state.health.items()}}
+
+    def incident_view(self) -> "_ProxyIncidentView":
+        """The autoscaler's handle into the ingress incident plane
+        (README "Incident plane"): manager-shaped — ``open_count()``
+        across every service's manager (the scale-down veto input) and
+        ``feed()`` routing a flap event to the manager of the service
+        that owns the flapping deployment."""
+        return _ProxyIncidentView(self)
+
     def shutdown(self) -> None:
         for key in list(self._servers):
             self._stop(key)
+
+
+class _ProxyIncidentView:
+    """Aggregate facade over a ServiceProxy's per-service incident
+    managers, so components that see the FLEET (the autoscaler) and not
+    one service can still read and feed the plane."""
+
+    def __init__(self, proxy: ServiceProxy):
+        self._proxy = proxy
+
+    def open_count(self) -> int:
+        return sum(s.incidents.open_count()
+                   for s in list(self._proxy._states.values())
+                   if s.incidents is not None)
+
+    def feed(self, kind: str, **attrs) -> None:
+        """Route to the service owning ``attrs['deployment']`` (Services
+        list their Deployments under the controllers' deployments
+        annotation); an unowned or unnamed event lands on every
+        manager — better a duplicate symptom than a dropped one."""
+        deployment = attrs.get("deployment")
+        targets = []
+        for state in list(self._proxy._states.values()):
+            if state.incidents is None:
+                continue
+            if deployment is not None:
+                svc = self._proxy._get_service(state)
+                ann = (svc or {}).get("metadata", {}) \
+                    .get("annotations", {})
+                try:
+                    owned = json.loads(
+                        ann.get(DEPLOYMENT_FOR_SERVICE_ANNOTATION, "[]"))
+                except ValueError:
+                    owned = []
+                if deployment in owned:
+                    targets = [state]
+                    break
+            targets.append(state)
+        for state in targets:
+            state.incidents.feed(kind, **attrs)
 
 
 class _ResumeCtx:

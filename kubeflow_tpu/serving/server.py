@@ -311,6 +311,52 @@ class ModelServer:
                 dumps.extend(rec.get("flight_dumps") or ())
             h._send(200, {"trace_id": tid, "spans": spans,
                           "flight_dumps": dumps})
+        elif path == "/engine/incidents":
+            # incident plane (README "Incident plane"): every model's
+            # classified incidents, open first.  Always 200 — an
+            # incident read must never take a replica down; models
+            # without an incident surface simply contribute nothing.
+            out = []
+            for name, m in self.models.items():
+                fn = getattr(m, "incident_list", None)
+                if not callable(fn):
+                    continue
+                try:
+                    incs = fn() or []
+                except Exception:  # noqa: BLE001 — debug read answers
+                    continue
+                out.extend({**inc, "model": name} for inc in incs)
+            out.sort(key=lambda i: (i.get("state") != "open",
+                                    i.get("opened_wall") or 0.0))
+            h._send(200, {"incidents": out,
+                          "open": sum(1 for i in out
+                                      if i.get("state") == "open")})
+        elif path.startswith("/engine/incidents/"):
+            # one incident's postmortem, rendered as the responder's
+            # timeline (detector firing -> evidence refs ->
+            # classification -> resolution); 404 when no model holds the
+            # id — it may live on another replica (the fleet endpoint
+            # fans out).
+            iid = path[len("/engine/incidents/"):]
+            found = None
+            for name, m in self.models.items():
+                fn = getattr(m, "incident_get", None)
+                if not callable(fn):
+                    continue
+                try:
+                    inc = fn(iid)
+                except Exception:  # noqa: BLE001 — debug read answers
+                    inc = None
+                if inc is not None:
+                    found = {**inc, "model": name}
+                    break
+            if found is None:
+                h._send(404, {"error": "unknown incident id"})
+            else:
+                from .incidents import timeline
+
+                h._send(200, {"incident": found,
+                              "timeline": timeline(found)})
         elif path.startswith("/engine/kv_handoff/"):
             # disaggregated serving (README "Disaggregated serving"): a
             # decode replica pulls a prefill replica's exported KV frame

@@ -54,6 +54,13 @@ DEFAULT_TARGETS = (
     ("best_effort", "queue_wait", 120.0),
 )
 
+# Burn-rate level above which the incident plane's slo_burn detector
+# fires for a class with no explicit ``burn_threshold`` (README "Incident
+# plane").  10x the sustainable rate means ~10% of requests are missing
+# their target at a 0.99 objective — paging territory, not a blip; a
+# healthy engine burns ~0 so clean runs never cross it.
+DEFAULT_BURN_THRESHOLD = 10.0
+
 
 @dataclasses.dataclass(frozen=True)
 class SloConfig:
@@ -66,6 +73,19 @@ class SloConfig:
     targets: tuple = DEFAULT_TARGETS
     objective: float = 0.99
     windows: tuple = (60.0, 600.0)
+    # incident-plane burn detection (README "Incident plane"): per-class
+    # (class, threshold) / (class, window_seconds) pairs — the burn level
+    # that opens an incident and the rolling window it is read over.
+    # Classes absent here use DEFAULT_BURN_THRESHOLD over the SHORTEST
+    # configured window (fast detection; the multi-window gauges still
+    # export every window for dashboards).
+    burn_thresholds: tuple = ()
+    burn_windows: tuple = ()
+    # minimum in-window samples before the burn detector may fire: burn
+    # computed over a handful of requests is statistically meaningless
+    # (one cold-compile TTFT miss out of 5 reads as burn 20) and must
+    # not page anyone
+    burn_min_samples: int = 10
     # per-series sample cap: bounds memory on QPS spikes; attainment over a
     # window whose samples overflowed the cap is computed over what's kept
     # (the newest), which biases toward recent behavior — the right bias
@@ -76,15 +96,21 @@ class SloConfig:
     def from_json(cls, raw: dict) -> "SloConfig":
         """Build from an engine.json ``slo`` block:
         ``{"targets": {"interactive": {"ttft": 0.5, ...}, ...},
-           "objective": 0.99, "windows": [60, 600]}``.
+           "objective": 0.99, "windows": [60, 600],
+           "burn_threshold": {"interactive": 4.0, ...},
+           "burn_window": {"interactive": 60, ...}}``.
         Classes/metrics omitted from ``targets`` keep their defaults;
-        a target of null/<=0 drops that series entirely."""
+        a target of null/<=0 drops that series entirely.
+        ``burn_threshold``/``burn_window`` configure the incident plane's
+        per-class burn detector (README "Incident plane") with the same
+        unknown-class validation as ``targets`` — a typo'd class would
+        otherwise leave the default threshold silently in force."""
+        # deferred: engine.engine imports this module at load time, so
+        # a top-level scheduler import would be circular
+        from .engine.scheduler import PRIORITY_CLASSES
         kw: dict = {}
         tgt = raw.get("targets")
         if isinstance(tgt, dict):
-            # deferred: engine.engine imports this module at load time, so
-            # a top-level scheduler import would be circular
-            from .engine.scheduler import PRIORITY_CLASSES
             merged = {(c, m): t for c, m, t in DEFAULT_TARGETS}
             for cls_name, metrics in tgt.items():
                 if cls_name not in PRIORITY_CLASSES:
@@ -125,6 +151,44 @@ class SloConfig:
                 # engine loop thread; 0 would silently drop every sample
                 raise ValueError(f"slo max_samples must be >= 1, got {ms}")
             kw["max_samples"] = ms
+        bt = raw.get("burn_threshold")
+        if isinstance(bt, dict):
+            pairs = []
+            for cls_name, thr in bt.items():
+                if cls_name not in PRIORITY_CLASSES:
+                    raise ValueError(
+                        f"unknown burn_threshold priority class "
+                        f"{cls_name!r} (known: {PRIORITY_CLASSES})")
+                if float(thr) <= 0:
+                    raise ValueError(
+                        f"burn_threshold for {cls_name!r} must be > 0, "
+                        f"got {thr}")
+                pairs.append((cls_name, float(thr)))
+            kw["burn_thresholds"] = tuple(sorted(pairs))
+        bw = raw.get("burn_window")
+        if isinstance(bw, dict):
+            windows = kw.get("windows", cls.windows)
+            pairs = []
+            for cls_name, w in bw.items():
+                if cls_name not in PRIORITY_CLASSES:
+                    raise ValueError(
+                        f"unknown burn_window priority class "
+                        f"{cls_name!r} (known: {PRIORITY_CLASSES})")
+                if float(w) not in windows:
+                    # burn is only computed over the configured rolling
+                    # windows; a detector window nothing computes would
+                    # silently never fire
+                    raise ValueError(
+                        f"burn_window {w} for {cls_name!r} is not one of "
+                        f"the configured windows {tuple(windows)}")
+                pairs.append((cls_name, float(w)))
+            kw["burn_windows"] = tuple(sorted(pairs))
+        if "burn_min_samples" in raw:
+            bms = int(raw["burn_min_samples"])
+            if bms < 1:
+                raise ValueError(
+                    f"burn_min_samples must be >= 1, got {bms}")
+            kw["burn_min_samples"] = bms
         return cls(**kw)
 
 
@@ -139,12 +203,25 @@ class SloTracker:
     def __init__(self, config: Optional[SloConfig] = None):
         self.config = config or SloConfig()
         self._targets = {(c, m): float(t) for c, m, t in self.config.targets}
+        self._burn_thresholds = dict(self.config.burn_thresholds)
+        self._burn_windows = dict(self.config.burn_windows)
         self._series: dict[tuple, collections.deque] = {}
         self._lock = threading.Lock()
         self._max_window = max(self.config.windows)
 
     def target(self, cls: str, metric: str) -> Optional[float]:
         return self._targets.get((cls, metric))
+
+    def burn_threshold(self, cls: str) -> float:
+        """The burn level above which the incident plane's slo_burn
+        detector fires for this class (README "Incident plane")."""
+        return self._burn_thresholds.get(cls, DEFAULT_BURN_THRESHOLD)
+
+    def burn_window(self, cls: str) -> float:
+        """The rolling window the burn detector reads for this class —
+        the SHORTEST configured window unless overridden (detection wants
+        the fast window; dashboards still get every window's gauge)."""
+        return self._burn_windows.get(cls, min(self.config.windows))
 
     def observe(self, cls: str, metric: str, value: float,
                 now: Optional[float] = None) -> None:
@@ -186,6 +263,25 @@ class SloTracker:
                 met += ok
         return met / n if n else None
 
+    def window_samples(self, cls: str, metric: str,
+                       window: Optional[float] = None,
+                       now: Optional[float] = None) -> int:
+        """In-window observation count — the burn detector's evidence
+        floor (``burn_min_samples``)."""
+        window = self._max_window if window is None else float(window)
+        t = time.monotonic() if now is None else now
+        cutoff = t - window
+        with self._lock:
+            dq = self._series.get((cls, metric))
+            if not dq:
+                return 0
+            n = 0
+            for ts, _ok in reversed(dq):
+                if ts < cutoff:
+                    break
+                n += 1
+        return n
+
     def burn_rate(self, cls: str, metric: str, window: float,
                   now: Optional[float] = None) -> Optional[float]:
         """(1 - attainment) / (1 - objective): 0 = no errors, 1 = burning
@@ -223,9 +319,13 @@ class SloTracker:
                     burn_gauge.remove(**wl)
 
     def snapshot(self, now: Optional[float] = None) -> dict:
-        """Nested read-only view for Engine.stats and the autoscaler:
-        {class: {metric: {"attainment": x, "target_s": t,
-        "burn": {window: rate}}}}."""
+        """Nested read-only view for Engine.stats, the autoscaler, and
+        the incident plane's burn detector + ``/fleet/incidents``
+        evidence view (README "Incident plane" — one source of truth:
+        the detector fires on exactly the burn values and thresholds
+        this snapshot reports): {class: {metric: {"attainment": x,
+        "target_s": t, "burn": {window: rate}, "burn_threshold": thr,
+        "burn_window": "60s"}}}."""
         with self._lock:
             keys = list(self._series)
         out: dict = {}
@@ -235,6 +335,11 @@ class SloTracker:
                 continue
             rec = {"attainment": round(att, 4),
                    "target_s": self._targets[(cls, metric)],
+                   "burn_threshold": self.burn_threshold(cls),
+                   "burn_window": f"{self.burn_window(cls):g}s",
+                   "burn_samples": self.window_samples(
+                       cls, metric, self.burn_window(cls), now=now),
+                   "burn_min_samples": self.config.burn_min_samples,
                    "burn": {}}
             for w in self.config.windows:
                 br = self.burn_rate(cls, metric, w, now=now)

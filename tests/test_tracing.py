@@ -92,6 +92,38 @@ def test_trace_store_entry_and_byte_budgets():
                for t in (f"b{i}" for i in range(10)))
 
 
+def test_trace_store_lru_keeps_actively_written_trace():
+    """Eviction is LRU by LAST WRITE, not insertion order (ISSUE 13
+    satellite): a long-lived trace that keeps receiving spans — a
+    multi-turn session, a mid-stream failover, exactly the traces an
+    incident bundle cites — must survive a budget squeeze that evicts
+    idle traces inserted AFTER it."""
+    store = TraceStore(max_traces=3, max_bytes=10_000_000)
+    store.put("live", {"span_id": "s0"})
+    store.put("idle1", {"span_id": "s1"})
+    store.put("idle2", {"span_id": "s2"})
+    # the live trace keeps receiving spans: every put touches it to the
+    # back of the eviction order
+    for i in range(3):
+        store.put("live", {"span_id": f"s0-{i}"})
+    # squeeze: two fresh traces evict two victims — under insertion-order
+    # eviction "live" (the oldest insert) would be the first casualty
+    store.put("new1", {"span_id": "n1"})
+    store.put("new2", {"span_id": "n2"})
+    assert len(store.get("live")) == 4          # survived, whole
+    assert store.get("idle1") == []             # idle ones paid instead
+    assert store.get("idle2") == []
+    # byte-budget squeeze obeys the same order: the actively-written
+    # trace outlives idle traces even when IT holds the most bytes
+    store2 = TraceStore(max_traces=100, max_bytes=600)
+    store2.put("live", {"span_id": "a", "pad": "x" * 60})
+    for i in range(3):
+        store2.put(f"idle{i}", {"span_id": f"i{i}", "pad": "x" * 60})
+        store2.put("live", {"span_id": f"a{i}", "pad": "x" * 60})
+    assert len(store2.get("live")) == 4
+    assert store2.stats()["bytes"] <= 600
+
+
 def test_build_tree_nests_by_parent():
     spans = [
         {"span_id": "root", "parent_id": None, "t_start_s": 0.0},
