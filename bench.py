@@ -722,6 +722,31 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["perf"] = {"error": str(e)[:200]}
     try:
+        # overload-storm sidebar: serving_bench --storm's headline
+        # (BENCH_STORM.json) — admitted-traffic SLO attainment under a
+        # 2x-sustainable storm, goodput retained vs the controller-off
+        # arm's timeout churn, zero admitted queue deaths, and the
+        # controller's nominal-load overhead
+        st_path = os.path.join(REPO, "BENCH_STORM.json")
+        if os.path.exists(st_path):
+            with open(st_path) as f:
+                st = json.loads(f.readline())
+            on = st.get("controller_on") or {}
+            out["storm"] = {
+                "storm_pass": st.get("storm_pass"),
+                "capacity_rps": st.get("capacity_rps"),
+                "storm_x_sustainable": st.get("storm_x_sustainable"),
+                "attainment": on.get("attainment"),
+                "shed_429": on.get("shed_429"),
+                "timeouts_504_on": on.get("timeouts_504"),
+                "goodput_on_over_off_x":
+                    st.get("goodput_on_over_off_x"),
+                "overhead_p50_pct": st.get("overhead_p50_pct"),
+                "platform": st.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["storm"] = {"error": str(e)[:200]}
+    try:
         # sessions sidebar: serving_bench --sessions's headline
         # (BENCH_SESSIONS.json) — warm-vs-cold TTFT per tier is the tiered-
         # KV payoff, the identity/leak/reconcile flags are the durability

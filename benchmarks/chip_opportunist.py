@@ -269,6 +269,19 @@ JOBS = [
                                   os.path.join(REPO,
                                                "BENCH_INCIDENTS.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # overload-control storm on a real chip (README "Overload control"):
+    # the capacity calibration, the AIMD limiter's convergence and the
+    # brownout thresholds all ride real device step times instead of the
+    # CPU tick-floor simulation — a small floor keeps the storm schedule
+    # spanning many ticks at chip rates; refreshes BENCH_STORM.json with
+    # the platform=tpu record
+    {"name": "serving_storm_tiny",
+     "cmd": _serving_cmd("tiny", ["--storm", "--storm-duration", "3",
+                                  "--storm-replicas", "2",
+                                  "--storm-tick-floor", "0.002",
+                                  "--out",
+                                  os.path.join(REPO, "BENCH_STORM.json")]),
+     "timeout": 1500, "first_timeout": 900},
     {"name": "perf_introspect_tiny",
      "cmd": _serving_cmd("tiny", ["--perf", "--requests", "16",
                                   "--concurrency", "4",

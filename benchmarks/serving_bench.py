@@ -3383,6 +3383,498 @@ def _run_incidents(args, config, params, lora) -> None:
         raise SystemExit("incidents bench FAILED: " + "; ".join(failures))
 
 
+def _run_storm(args, config, params, lora) -> None:
+    """Traffic-storm macro-bench (README "Overload control"; ROADMAP item
+    5's diurnal/bursty traffic replay).  The IDENTICAL seeded
+    StormFaultConfig schedule — diurnal baseline x Poisson bursts,
+    heavy-tailed lognormal prompt lengths, Zipf tenant skew — drives the
+    real ServiceProxy over engine replicas at ~``--storm-x`` times the
+    MEASURED sustainable rate, controller-ON (overload annotation) vs
+    controller-OFF:
+
+      * ON gates: per-class SLO attainment >= 0.9 for ADMITTED traffic,
+        ZERO admitted requests dying of engine-queue deadline expiry
+        (504s / engine sheds), every refusal a 429 WITH Retry-After
+        (never a hang), goodput >= ``--storm-goodput-x`` times the OFF
+        arm's.
+      * OFF arm: the same storm with no controller — expected to
+        collapse into timeout churn (deadline sheds after the queueing
+        work was already spent).
+      * overhead: controller-on vs -off p50 at NOMINAL load (0.5x
+        sustainable), alternating x2, gated <= ``--storm-budget``%.
+
+    ENGINE_TICK_FLOOR_S simulates the device-bound regime on the CPU box
+    (same discipline as --disagg/--fabric).  Results land in
+    BENCH_STORM.json via --out."""
+    import concurrent.futures
+    import json as _json
+    import os as _os
+    import threading
+    import time as _time
+    import urllib.error
+    import urllib.request as _url
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.core.api import APIServer
+    from kubeflow_tpu.serving.api import LABEL_ISVC
+    from kubeflow_tpu.serving.controllers import (POD_PORT_ANNOTATION,
+                                                  PROXY_PORT_ANNOTATION)
+    from kubeflow_tpu.serving.engine import Engine, EngineConfig
+    from kubeflow_tpu.serving.engine.faults import (StormFaultConfig,
+                                                    storm_schedule)
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+    from kubeflow_tpu.serving.router import (OVERLOAD_ANNOTATION,
+                                             RELAY_TIMEOUT_ANNOTATION,
+                                             ServiceProxy)
+    from kubeflow_tpu.serving.server import ModelServer
+    from kubeflow_tpu.utils.net import find_free_ports
+
+    # persistent compile cache (the tests' conftest discipline): the
+    # storm builds 12+ fresh engines across its arms, and a cold prefill-
+    # bucket compile BLOCKS an engine loop mid-storm — real queue waits
+    # balloon, the burn signal fires, and the bench would measure XLA
+    # compile stalls instead of admission control
+    cache_dir = _os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), ".jax_cache"))
+    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES",
+                           "-1")
+    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                           "0.5")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:  # noqa: BLE001 — cache is an optimization
+        pass
+
+    n_rep = args.storm_replicas
+    slots = 2
+    page_size = 16
+    mt = 12
+    max_plen = 192
+    # the storm's heavy-tailed prompt lengths QUANTIZE to this warm set:
+    # every arm warms exactly these prefill buckets on every replica, so
+    # no first-hit compile stalls an engine mid-storm (the tail still
+    # reaches 4x the median — the heavy-tail pressure survives rounding)
+    warm_plens = (32, 64, 128, 192)
+    pages_per_slot = (max_plen + 2 * mt) // page_size + 2
+    # headroom so the OFF arm's queue growth cannot exhaust the pool:
+    # the collapse under test is TIME (deadline churn), not memory
+    num_pages = 2 * slots * pages_per_slot + 16
+    failures: list = []
+    # per-class engine deadline == the class's SLO target on full latency
+    class_deadline = {"interactive": 3.0, "batch": 8.0,
+                      "best_effort": 15.0}
+    # engine SLO targets sized to the deadlines above (not the sub-second
+    # defaults): the AIMD trips on worst-replica burn, so burn must mean
+    # "deadlines are threatened", not "any queueing at all" — with the
+    # defaults a healthy limiter-bound queue reads as a full-scale burn
+    # and the limiter starves itself to the floor
+    # SHORT rolling window: burn must track CURRENT conditions or a
+    # 5-second transient at storm open latches a 60s-window burn for the
+    # whole run and the AIMD limiter can never additively recover
+    from kubeflow_tpu.serving.slo import SloConfig
+    slo_cfg = SloConfig(targets=tuple(
+        (c, m, {"ttft": class_deadline[c] * 0.6,
+                "queue_wait": class_deadline[c] * 0.4,
+                "tpot": 0.5}[m])
+        for c in ("interactive", "batch", "best_effort")
+        for m in ("ttft", "tpot", "queue_wait")),
+        windows=(3.0,))
+
+    prev_floor = _os.environ.get("ENGINE_TICK_FLOOR_S")
+    _os.environ["ENGINE_TICK_FLOOR_S"] = str(args.storm_tick_floor)
+
+    def build(controller_on: bool):
+        api = APIServer()
+        proxy = ServiceProxy(api)
+        svc_port = find_free_ports(1)[0]
+        ann = {PROXY_PORT_ANNOTATION: str(svc_port),
+               RELAY_TIMEOUT_ANNOTATION: "60.0"}
+        if controller_on:
+            # limit starts at 3x the fleet's slot count (healthy-bound
+            # queueing); the floor is the slot count itself — AIMD may
+            # converge but never starve below hardware parallelism.  The
+            # overload trip is the worst-replica SLO burn the engines
+            # export by default (queue_wait/ttft targets).
+            ann[OVERLOAD_ANNOTATION] = _json.dumps({
+                "limit": 2 * slots * n_rep,
+                "min_limit": slots * n_rep,
+                "rate": 0.0, "adjust_interval_s": 0.25,
+                # gentle additive growth: the default +1 per interval
+                # overshoots a 4-slot fleet inside the first second of
+                # the storm, and every overshoot costs a queue-wait
+                # transient the admitted requests pay for
+                "add_step": 0.5,
+                "brownout": True, "brownout_max_tokens": mt,
+                "seed": 0})
+        api.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "storm", "labels": {LABEL_ISVC: "storm"},
+                         "annotations": ann},
+            "spec": {"selector": {"app": "storm"}}})
+        engines, servers = [], []
+        for i in range(n_rep):
+            # bounded admission queue — the production posture the ISSUE
+            # motivates: without the ingress controller, a storm against
+            # the bound becomes EngineOverloaded 503 churn (plus router
+            # retry re-picks), which is exactly the waste the
+            # shed-at-ingress decision exists to save
+            ec = EngineConfig(max_slots=slots, page_size=page_size,
+                              num_pages=num_pages,
+                              max_pages_per_slot=pages_per_slot,
+                              max_queue_depth=2 * slots,
+                              slo=slo_cfg)
+            eng = Engine(params, config, ec, lora=lora)
+            srv = ModelServer([JetStreamModel("storm", "", engine=eng)],
+                              port=0)
+            srv.start()
+            api.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"storm-{i}",
+                             "labels": {"app": "storm"},
+                             "annotations": {POD_PORT_ANNOTATION:
+                                             str(srv.port)}},
+                "spec": {},
+                "status": {"phase": "Running",
+                           "conditions": [{"type": "Ready",
+                                           "status": "True"}]}})
+            engines.append(eng)
+            servers.append(srv)
+        proxy.sync()
+        return api, proxy, svc_port, engines, servers
+
+    def teardown(proxy, engines, servers):
+        proxy.shutdown()
+        for srv in servers:
+            srv.stop()
+        for eng in engines:
+            try:
+                eng.stop(drain=False)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def unary(port, text, params_extra=None, headers=None, timeout=120):
+        body = {"text_input": text,
+                "parameters": {"max_tokens": mt, **(params_extra or {})}}
+        req = _url.Request(
+            f"http://127.0.0.1:{port}/v2/models/storm/generate",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
+        t0 = _time.perf_counter()
+        try:
+            with _url.urlopen(req, timeout=timeout) as r:
+                try:
+                    toks = int(_json.loads(r.read()).get("tokens") or 0)
+                except ValueError:
+                    toks = 0
+                return (r.status, dict(r.headers),
+                        _time.perf_counter() - t0, toks)
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code, dict(e.headers), _time.perf_counter() - t0, 0
+        except Exception:  # noqa: BLE001 — socket reset under churn
+            # a connection-level failure must not kill the fire thread:
+            # an unanswered slot would misreport as "a shed request hung"
+            return 599, {}, _time.perf_counter() - t0, 0
+
+    def warm(servers):
+        """Compile every storm-reachable prefill shape on every replica —
+        single-row AND fused two-row dispatches per bucket (concurrent
+        admits fuse, and a fused [2, L] shape is its own XLA program) —
+        cheap after the first-ever run via the persistent cache.  A cold
+        compile mid-storm would block the engine loop and read as
+        queueing."""
+        for srv in servers:
+            for plen in warm_plens:
+                unary(srv.port, "a" * plen)
+                with concurrent.futures.ThreadPoolExecutor(2) as ex:
+                    list(ex.map(lambda ch: unary(srv.port, ch * plen),
+                                ("b", "c")))
+
+    def qlen(n: int) -> int:
+        """Quantize a storm prompt length UP to the warmed bucket set."""
+        return next((w for w in warm_plens if n <= w), warm_plens[-1])
+
+    # ---- calibration: the fleet's sustainable closed-loop rate -----------
+    api, proxy, svc_port, engines, servers = build(False)
+    try:
+        warm(servers)
+        # SATURATED closed-loop throughput: enough client concurrency to
+        # keep every slot busy with a full admission pipeline behind it —
+        # an undersubscribed calibration would understate capacity and
+        # turn the "2x sustainable" storm into a sustainable one
+        n_cal = 8 * slots * n_rep
+        t0 = _time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(
+                4 * slots * n_rep) as ex:
+            list(ex.map(lambda i: unary(svc_port, "a" * 48),
+                        range(n_cal)))
+        capacity_rps = n_cal / (_time.perf_counter() - t0)
+    finally:
+        teardown(proxy, engines, servers)
+
+    storm_qps = args.storm_x * capacity_rps
+    storm_cfg = StormFaultConfig(
+        seed=11, duration_s=args.storm_duration, base_qps=storm_qps,
+        diurnal_period_s=2 * args.storm_duration, diurnal_depth=0.3,
+        burst_every_s=args.storm_duration / 3.0,
+        burst_len_s=args.storm_duration / 10.0, burst_x=2.0,
+        tenants=4, tenant_skew=1.2, prompt_len_median=48,
+        prompt_len_sigma=0.6, prompt_len_max=max_plen, max_tokens=mt)
+    storm = storm_schedule(storm_cfg)
+
+    def drive(svc_port, schedule, time_scale=1.0):
+        """Open-loop replay: one thread per arrival at its schedule
+        offset.  Every request is ANSWERED (a hang would park a thread
+        past the join timeout and fail the arm)."""
+        results = []
+        lock = threading.Lock()
+
+        letters = "defghijklmnopqrstuvwxyz"
+
+        def fire(i, arr):
+            # content-distinct per arrival (identical prompts would all
+            # be prefix-cache hits — an unrealistically free prefill),
+            # length quantized to the warmed bucket set
+            n = qlen(arr.prompt_len)
+            text = "".join(letters[(i * 31 + j * 7) % len(letters)]
+                           for j in range(n))
+            # real storm clients RETRY ambiguous 5xx outcomes (honoring
+            # Retry-After) — the "retry work" the ISSUE names as waste:
+            # against an uncontrolled fleet the retries multiply the
+            # offered load; against the controller they never happen
+            # (sheds are a terminal, typed 429).  The request's SLO
+            # clock spans ALL attempts.
+            t_first = _time.perf_counter()
+            attempts = 0
+            while True:
+                st, hdrs, _dt1, toks = unary(
+                    svc_port, text,
+                    params_extra={"priority": arr.priority,
+                                  "deadline_s":
+                                      class_deadline[arr.priority]},
+                    headers={"X-Tenant-Id": arr.tenant})
+                attempts += 1
+                if st < 500 or attempts >= 3:
+                    break
+                try:
+                    ra = float(hdrs.get("Retry-After") or 0.5)
+                except (TypeError, ValueError):
+                    ra = 0.5
+                _time.sleep(min(max(ra, 0.1), 2.0))
+            dt = _time.perf_counter() - t_first
+            with lock:
+                results.append((arr, st, hdrs, dt, toks, attempts))
+
+        t0 = _time.monotonic()
+        threads = []
+        for i, arr in enumerate(schedule):
+            delay = t0 + arr.t_s * time_scale - _time.monotonic()
+            if delay > 0:
+                _time.sleep(delay)
+            th = threading.Thread(target=fire, args=(i, arr))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=240)
+        return results
+
+    def storm_arm(controller_on: bool) -> dict:
+        api, proxy, svc_port, engines, servers = build(controller_on)
+        try:
+            warm(servers)
+            results = drive(svc_port, storm)
+            answered = len(results)
+            by_class: dict = {}
+            goodput_tokens = 0
+            delivered_tokens = 0
+            shed = []
+            t504 = 0
+            e503 = 0
+            attempts_total = 0
+            for arr, st, hdrs, dt, toks, attempts in results:
+                attempts_total += attempts
+                if st == 429:
+                    shed.append((arr, hdrs))
+                    continue
+                if st == 504:
+                    t504 += 1
+                if st in (502, 503):
+                    e503 += 1
+                rec = by_class.setdefault(arr.priority,
+                                          {"admitted": 0, "met": 0})
+                rec["admitted"] += 1
+                if st == 200:
+                    delivered_tokens += toks
+                    if dt <= class_deadline[arr.priority]:
+                        rec["met"] += 1
+                        goodput_tokens += toks
+            att = {c: round(r["met"] / r["admitted"], 4)
+                   for c, r in sorted(by_class.items()) if r["admitted"]}
+            adm_by = {c: r["admitted"] for c, r in sorted(by_class.items())}
+            shed_ra_ok = all(
+                float(h.get("Retry-After") or 0) > 0 for _, h in shed)
+            eng_shed = sum(e.stats["requests_shed"] for e in engines)
+            eng_rej = sum(e.stats["requests_rejected"] for e in engines)
+            incidents = []
+            if controller_on:
+                state = next(iter(proxy._states.values()))
+                deadline = _time.monotonic() + 8.0
+                while _time.monotonic() < deadline:
+                    incidents = [i for i in state.incidents.list()
+                                 if i["cause"] == "capacity"]
+                    if incidents:
+                        break
+                    _time.sleep(0.2)
+            snap = None
+            if controller_on:
+                st8 = next(iter(proxy._states.values()))
+                if st8.overload is not None:
+                    snap = st8.overload.snapshot()
+            return {
+                "offered": len(storm), "answered": answered,
+                "shed_429": len(shed), "shed_retry_after_ok": shed_ra_ok,
+                "timeouts_504": t504,
+                "errors_5xx": e503,
+                "client_attempts": attempts_total,
+                "engine_deadline_sheds": eng_shed,
+                "engine_rejections": eng_rej,
+                "attainment": att,
+                "admitted_by_class": adm_by,
+                "goodput_tokens_in_deadline": goodput_tokens,
+                "delivered_tokens": delivered_tokens,
+                # of the work the fleet DID, how much was worth doing —
+                # an engine is work-conserving, so absolute goodput
+                # converges to capacity in both arms; the collapse shows
+                # up as delivered tokens whose requests already blew
+                # their deadlines (generated-past-deadline waste)
+                "goodput_ratio": round(
+                    goodput_tokens / max(1, delivered_tokens), 4),
+                "capacity_incidents": len(incidents),
+                "overload": snap,
+            }
+        finally:
+            teardown(proxy, engines, servers)
+
+    on = storm_arm(True)
+    off = storm_arm(False)
+
+    # ---- gates -----------------------------------------------------------
+    if on["answered"] != len(storm):
+        failures.append(f"controller-on arm answered {on['answered']}/"
+                        f"{len(storm)} (a shed request hung)")
+    if on["timeouts_504"] or on["engine_deadline_sheds"]:
+        failures.append(
+            f"admitted requests died in engine queues with the "
+            f"controller ON: {on['timeouts_504']} 504s, "
+            f"{on['engine_deadline_sheds']} engine sheds")
+    if not on["shed_429"]:
+        failures.append("the storm never shed — controller inert at "
+                        f"{args.storm_x}x sustainable load")
+    if not on["shed_retry_after_ok"]:
+        failures.append("a 429 was missing its Retry-After header")
+    low = {c: a for c, a in on["attainment"].items()
+           if a < 0.9 and on["admitted_by_class"].get(c, 0) >= 5}
+    if low:
+        failures.append(f"controller-on admitted-traffic attainment "
+                        f"below 0.9: {low}")
+    goodput_x = on["goodput_ratio"] / max(1e-9, off["goodput_ratio"])
+    if goodput_x < args.storm_goodput_x:
+        failures.append(
+            f"goodput retained (in-deadline/delivered) "
+            f"{on['goodput_ratio']:.3f} vs off-arm "
+            f"{off['goodput_ratio']:.3f} = {goodput_x:.2f}x < "
+            f"{args.storm_goodput_x}x")
+    if on["capacity_incidents"] != 1:
+        failures.append(f"storm produced {on['capacity_incidents']} "
+                        "capacity incidents (want exactly 1)")
+
+    # ---- controller overhead at NOMINAL load -----------------------------
+    # CLOSED-LOOP serial requests (the --incidents discipline): the
+    # controller's per-admission cost is a bucket refill + a few deque
+    # reads, and an open-loop thread-per-arrival driver measures GIL
+    # scheduling jitter (sigma ~6% p50 on this box) instead of it
+    def nominal_p50(on_arm: bool) -> float:
+        api, proxy, svc_port, engines, servers = build(on_arm)
+        try:
+            warm(servers)
+            lats = []
+            for i in range(40):
+                st, _, dt, _ = unary(
+                    svc_port, "n" * warm_plens[i % 2],
+                    params_extra={"priority": "interactive",
+                                  "deadline_s": 60.0},
+                    headers={"X-Tenant-Id": f"t{i % 2}"})
+                if st == 200:
+                    lats.append(dt)
+            return float(np.percentile(lats, 50))
+        finally:
+            teardown(proxy, engines, servers)
+
+    # alternating off/on arms x3, BEST-OF p50s per mode (the --incidents
+    # overhead discipline): per-arm p50s swing several percent with host
+    # scheduling noise on this box, but each mode's minimum converges to
+    # its true floor — and the controller's per-admission cost (a bucket
+    # refill + a few deque reads) is what separates the floors
+    p50s = {True: [], False: []}
+    for on_arm in (False, True) * 3:
+        p50s[on_arm].append(nominal_p50(on_arm))
+    p50_off, p50_on = min(p50s[False]), min(p50s[True])
+    overhead_pct = (p50_on - p50_off) / p50_off * 100.0
+    if overhead_pct > args.storm_budget:
+        failures.append(f"controller overhead {overhead_pct:.2f}% p50 at "
+                        f"nominal load > {args.storm_budget}% budget")
+
+    if prev_floor is None:
+        _os.environ.pop("ENGINE_TICK_FLOOR_S", None)
+    else:
+        _os.environ["ENGINE_TICK_FLOOR_S"] = prev_floor
+
+    out = {
+        "metric": f"overload_storm_{args.config}",
+        "capacity_rps": round(capacity_rps, 2),
+        "storm_qps": round(storm_qps, 2),
+        "storm_x_sustainable": args.storm_x,
+        "requests": len(storm),
+        "controller_on": on,
+        "controller_off": off,
+        # ratio of per-arm goodput RATIOS (in-deadline tokens / delivered
+        # tokens): how much more of the fleet's work was worth doing
+        "goodput_on_over_off_x": round(goodput_x, 3),
+        "overhead_p50_pct": round(overhead_pct, 2),
+        "overhead_budget_pct": args.storm_budget,
+        "nominal_p50_off_s": round(p50_off, 4),
+        "nominal_p50_on_s": round(p50_on, 4),
+        "replicas": n_rep,
+        "tick_floor_s": args.storm_tick_floor,
+        "param_count": config.param_count(),
+        "platform": jax.devices()[0].platform,
+        "storm_pass": not failures,
+        "protocol_note": ("open-loop seeded storm replay (identical "
+                          "schedule both arms) at storm_x x measured "
+                          "saturated closed-loop capacity; clients "
+                          "retry 5xx honoring Retry-After (<= 3 "
+                          "attempts) — the retry-churn waste an "
+                          "uncontrolled fleet invites; attainment = "
+                          "completed within the class deadline / "
+                          "admitted; goodput_ratio = in-deadline "
+                          "tokens / delivered tokens (work worth "
+                          "doing / work done); overhead = alternating "
+                          "on/off x3 at 0.5x capacity, best-of p50s"),
+    }
+    line = _json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if failures:
+        raise SystemExit("storm bench FAILED: " + "; ".join(failures))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="1b", choices=["tiny", "1b", "llama3_8b"])
@@ -3561,6 +4053,32 @@ def main() -> None:
     p.add_argument("--incidents-budget", type=float, default=2.0,
                    help="max p50 latency overhead (percent) of the "
                         "incident plane vs the incidents-off arm")
+    p.add_argument("--storm", action="store_true",
+                   help="traffic-storm macro-bench (README 'Overload "
+                        "control'; ROADMAP item 5's diurnal/bursty "
+                        "replay): the identical seeded StormFaultConfig "
+                        "schedule at ~2x measured sustainable load "
+                        "through the real proxy, overload-controller-on "
+                        "vs -off; gates admitted-traffic SLO attainment "
+                        ">= 0.9 per class, zero admitted engine-queue "
+                        "deadline expiries, 429+Retry-After on every "
+                        "shed, goodput >= --storm-goodput-x vs the off "
+                        "arm, and controller overhead <= --storm-budget "
+                        "at nominal load (BENCH_STORM.json via --out)")
+    p.add_argument("--storm-duration", type=float, default=6.0,
+                   help="storm replay duration in seconds per arm")
+    p.add_argument("--storm-x", type=float, default=2.0,
+                   help="storm load as a multiple of measured capacity")
+    p.add_argument("--storm-goodput-x", type=float, default=1.5,
+                   help="min goodput ratio controller-on / controller-off")
+    p.add_argument("--storm-budget", type=float, default=2.0,
+                   help="max controller p50 overhead percent at nominal "
+                        "(0.5x capacity) load")
+    p.add_argument("--storm-replicas", type=int, default=2,
+                   help="engine replica count for --storm")
+    p.add_argument("--storm-tick-floor", type=float, default=0.005,
+                   help="ENGINE_TICK_FLOOR_S for --storm (device-bound "
+                        "regime simulation on CPU)")
     p.add_argument("--perf-budget", type=float, default=5.0,
                    help="max perf-plane p50 overhead percent (both scopes)")
     p.add_argument("--obs-budget", type=float, default=5.0,
@@ -3635,6 +4153,9 @@ def main() -> None:
         return
     if args.incidents:
         _run_incidents(args, config, params, lora)
+        return
+    if args.storm:
+        _run_storm(args, config, params, lora)
         return
     if args.overlap:
         _run_overlap(args, config, params, lora)

@@ -147,6 +147,15 @@ class ConcurrencyAutoscaler:
         # replicas} — the SLO signal plane, read-only for now (see
         # _SLO_SAMPLE_RE); surfaced via slo_view()
         self._slo_view: dict[str, dict] = {}
+        # deployment uid -> {pod uid: last engine_requests_rejected
+        # total}: a GROWING count means the pool is refusing admissions
+        # (EngineOverloaded / ingress shedding downstream of it) — demand
+        # the inflight gauge cannot see, because refused requests never
+        # become inflight.  Tracked PER POD so a pod dropping out of one
+        # scrape and back in (timeout blip — exactly when the fleet is
+        # loaded) doesn't read its whole cumulative history as fresh
+        # growth and ratchet replicas up.  README "Overload control".
+        self._rejected_last: dict[str, dict] = {}
 
     def sync(self) -> bool:
         changed = False
@@ -175,6 +184,9 @@ class ConcurrencyAutoscaler:
             if uid not in deploy_uids:
                 del self._scale_dirs[uid]
                 self._flap_fired.pop(uid, None)
+        for uid in list(self._rejected_last):
+            if uid not in deploy_uids:
+                del self._rejected_last[uid]
         return changed
 
     def _autoscale(self, deploy: Obj, ann: dict) -> bool:
@@ -195,6 +207,7 @@ class ConcurrencyAutoscaler:
         ready = 0
         unscraped = 0
         unhealthy = 0
+        rejected_by_pod: dict = {}
         slo_worst: dict = {}
         last_traffic = self._last_traffic.get(uid, 0.0)
         now_mono = time.monotonic()
@@ -227,6 +240,8 @@ class ConcurrencyAutoscaler:
             # prompts, so HTTP inflight alone under-reports engine backlog
             engine_load += (m.get("engine_queue_depth", 0.0)
                             + m.get("engine_active_slots", 0.0))
+            if "engine_requests_rejected" in m:
+                rejected_by_pod[pod_uid] = m["engine_requests_rejected"]
             # engine health surface: a ready pod whose engine is not
             # SERVING (watchdog-dead, degraded-restarting) is not SLO-safe
             # capacity — it vetoes scale-down below
@@ -251,6 +266,23 @@ class ConcurrencyAutoscaler:
         desired = math.ceil(effective / target) if effective > 0 else 0
         desired = max(desired, min_r, 0)
         desired = min(desired, max_r)
+
+        # overload-pressure actuator (README "Overload control"): growing
+        # engine_requests_rejected totals mean admissions are being
+        # REFUSED — demand the inflight/backlog gauges structurally
+        # under-report (a rejected request never becomes load).  Growth
+        # is judged per pod against that pod's OWN last reading, so a
+        # pod absent from one scrape (timeout blip — exactly when the
+        # fleet is loaded) contributes nothing when it returns instead
+        # of replaying its whole cumulative history as fresh growth.
+        # One replica per sync, same damped shape as the SLO actuator;
+        # the counters going quiet hand control straight back.
+        prev_rejected = self._rejected_last.get(uid, {})
+        self._rejected_last[uid] = rejected_by_pod
+        if any(total > prev_rejected[p]
+               for p, total in rejected_by_pod.items()
+               if p in prev_rejected):
+            desired = max(desired, min(current + 1, max_r))
 
         # SLO actuator (opt-in): worst-replica attainment of the pool's
         # role metric below the objective raises desired one replica above

@@ -413,6 +413,13 @@ class _Pending:
     # waste under this reason instead of goodput.  Decode commits after
     # the prefill are fresh work and ignore it.
     waste_reason: "Optional[str]" = None
+    # ---- ingress brownout (README "Overload control") ------------------
+    # degradation stage the ingress admitted this request under: >= 2
+    # disables speculation drafting for it (verify dispatches are the
+    # first quality-not-availability cost to drop under load), >= 3
+    # additionally defers the fleet-fabric publish at finish (publishing
+    # snapshots device pages to host — deferrable work by definition)
+    brownout: int = 0
 
 
 class _StaleThread(BaseException):
@@ -908,7 +915,8 @@ class Engine:
                        fabric_import=None,
                        trace=None,
                        links: Optional[list] = None,
-                       waste_hint: Optional[str] = None) -> Future:
+                       waste_hint: Optional[str] = None,
+                       brownout: int = 0) -> Future:
         """Submit a prompt; the Future resolves to a result dict.
 
         ``stream``: optional queue that receives each token id as it is
@@ -958,6 +966,10 @@ class Engine:
         for an ingress failover re-admission, ``handoff_degraded`` for a
         disaggregation import that fell back before submit); the charged
         prefill FLOPs land under that waste reason instead of goodput.
+        ``brownout``: ingress degradation stage (README "Overload
+        control") — 0 = normal; >= 2 disables speculation drafting for
+        this request; >= 3 additionally defers the fleet-fabric publish
+        at finish.  Quality degrades, never correctness.
         Raises EngineOverloaded when the queue is at ``max_queue_depth``
         and EngineShutdown once stop() has begun."""
         if not tokens:
@@ -995,9 +1007,18 @@ class Engine:
                 self.incidents.feed("queue_growth", queue_depth=depth,
                                     rejected=1,
                                     trace_ids=self._live_trace_ids())
-            raise EngineOverloaded(
+            exc = EngineOverloaded(
                 f"queue depth {depth} >= "
                 f"max_queue_depth {self.ec.max_queue_depth}")
+            # load-proportional retry hint (README "Overload control"):
+            # the deeper the queue relative to the slots draining it,
+            # the longer a client should back off.  The HTTP layer
+            # surfaces it as Retry-After; the ingress retry loop honors
+            # it (jittered) instead of re-pick hammering the next
+            # replica.
+            exc.retry_after_s = round(min(
+                10.0, 0.25 + 0.1 * depth / max(1, self.ec.max_slots)), 3)
+            raise exc
         if deadline is None:
             deadline = self.ec.default_deadline_s
         aid = 0
@@ -1042,6 +1063,7 @@ class Engine:
                 priority=prio, rank=PRIORITY_RANK[prio],
                 rid=rid, session_id=session_id, handoff=handoff,
                 waste_reason=waste_hint,
+                brownout=max(0, min(3, int(brownout))),
             )
             if session_id is not None:
                 self._session_active[session_id] = rid
@@ -1155,13 +1177,15 @@ class Engine:
                  session_id: Optional[str] = None,
                  handoff: bool = False, kv_import=None, fabric_import=None,
                  trace=None, links: Optional[list] = None,
-                 waste_hint: Optional[str] = None) -> dict:
+                 waste_hint: Optional[str] = None,
+                 brownout: int = 0) -> dict:
         fut = self.generate_async(tokens, max_new_tokens, adapter=adapter,
                                   deadline=deadline, priority=priority,
                                   session_id=session_id, handoff=handoff,
                                   kv_import=kv_import,
                                   fabric_import=fabric_import, trace=trace,
-                                  links=links, waste_hint=waste_hint)
+                                  links=links, waste_hint=waste_hint,
+                                  brownout=brownout)
         try:
             return fut.result(timeout=timeout)
         except FutureTimeoutError:
@@ -1257,7 +1281,8 @@ class Engine:
                         fabric_import=None,
                         trace=None,
                         links: Optional[list] = None,
-                        waste_hint: Optional[str] = None) -> Iterator:
+                        waste_hint: Optional[str] = None,
+                        brownout: int = 0) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
 
         The last item yielded is the same dict ``generate`` returns (so
@@ -1275,7 +1300,8 @@ class Engine:
                                   kv_import=kv_import,
                                   fabric_import=fabric_import,
                                   trace=trace, links=links,
-                                  waste_hint=waste_hint)
+                                  waste_hint=waste_hint,
+                                  brownout=brownout)
 
         def _iter():
             while True:
@@ -3785,6 +3811,13 @@ class Engine:
             owned = int(np.count_nonzero(self._pt_host[slot]))
         room = owned * ps - seq_len
         pending = self._requests[self._slot_req[slot]]
+        if pending.brownout >= 2:
+            # ingress brownout stage 2+ (README "Overload control"):
+            # speculation spends K-wide verify dispatches to buy latency —
+            # exactly the quality-not-availability spend a browned-out
+            # service sheds first.  No draft = the plain single-token
+            # step, byte-identical output, just slower.
+            return []
         if gen_count is None:
             gen_count = len(pending.generated)
         budget = pending.max_new_tokens - gen_count - 1
@@ -4007,7 +4040,15 @@ class Engine:
         # by every other replica.  Handoff prefill phases skip it — their
         # pages already leave through the (one-shot) handoff store.
         if self._fabric is not None and not cancelled and not pending.handoff:
-            self._publish_fabric(slot, pending, cache_ok)
+            if pending.brownout >= 3:
+                # ingress brownout stage 3 (README "Overload control"):
+                # publishing snapshots device pages to host — deferrable
+                # work by definition; under a storm the pages still reach
+                # the local prefix cache below, only the FLEET misses out
+                # until pressure recedes
+                self.telemetry.count_fabric("publish_deferred")
+            else:
+                self._publish_fabric(slot, pending, cache_ok)
         self._release_slot_state(slot)  # freed slots decode as zero adapter
         # hand the prompt's full pages to the prefix cache on the way out —
         # unless the prefill never finished (cancel mid-prefill): those pages

@@ -606,6 +606,36 @@ class JetStreamModel(Model):
         return kv_handoff, out
 
     @staticmethod
+    def _parse_brownout(payload: Any) -> int:
+        """Ingress brownout stage (README "Overload control") ->
+        ``parameters.brownout`` as an int in [0, 3].  The service proxy
+        injects it when its overload controller is in a brownout; the
+        engine then degrades quality for this request (stage >= 2: no
+        speculation drafting; stage 3: fabric publish deferred).  Raises
+        RequestError (-> 400) on junk — a malformed stage must not
+        silently serve at full quality mid-storm."""
+        params = (payload.get("parameters") or {}) \
+            if isinstance(payload, dict) else {}
+        if not isinstance(params, dict):
+            return 0
+        stage = params.get("brownout")
+        if stage is None and isinstance(payload, dict):
+            # V1 predict / OpenAI bodies carry the marker top-level (the
+            # ingress rewrites them there; those surfaces have no
+            # parameters block of their own)
+            stage = payload.get("brownout")
+        if stage is None:
+            return 0
+        if isinstance(stage, bool) or not isinstance(stage, int) \
+                or not 0 <= stage <= 3:
+            # bool subclasses int: "brownout": true must be the loud 400
+            # the docstring promises, not a silent stage 1
+            raise RequestError(
+                f"brownout must be an integer stage in [0, 3], "
+                f"got {stage!r}")
+        return stage
+
+    @staticmethod
     def _parse_fabric_params(payload: Any):
         """Fleet-fabric pull hint (README "Fleet KV fabric") ->
         ``parameters.fabric = {key, source_port, pages}`` or None.  The
@@ -654,6 +684,9 @@ class JetStreamModel(Model):
             self._parse_generate(payload, headers)
         kv_handoff, hand = self._parse_disagg_params(payload)
         fab = self._parse_fabric_params(payload)
+        brownout = self._parse_brownout(payload)
+        if brownout:
+            self.engine.telemetry.count_brownout(brownout)
         if fab is not None and hand is not None:
             # a decode phase imports the FULL prompt KV via its handoff —
             # a prefix pull on top is contradictory, refuse loudly
@@ -665,14 +698,16 @@ class JetStreamModel(Model):
                     "kv_handoff composes with none of session_id, "
                     "resume_token_ids or handoff")
             return self._prefill_phase(ids, max_tokens, adapter, deadline,
-                                       priority, headers, fab=fab)
+                                       priority, headers, fab=fab,
+                                       brownout=brownout)
         if hand is not None:
             if resume:
                 raise RequestError(
                     "handoff and resume_token_ids are mutually exclusive")
             return self._decode_phase_unary(ids, max_tokens, adapter,
                                             deadline, priority, session,
-                                            hand, headers)
+                                            hand, headers,
+                                            brownout=brownout)
         resume = resume or []
         max_new = max_tokens - len(resume)
         if resume and max_new <= 0:
@@ -695,6 +730,7 @@ class JetStreamModel(Model):
                                  session_id=session, fabric_import=fimp,
                                  trace=self._trace_ctx(headers),
                                  links=self._resume_link(headers),
+                                 brownout=brownout,
                                  # a failover re-admission re-prefills
                                  # tokens the dead replica already
                                  # produced: waste, attributed — as is a
@@ -742,7 +778,8 @@ class JetStreamModel(Model):
     _HANDOFF_PULL_TIMEOUT_S = 10.0
 
     def _prefill_phase(self, ids: list, max_tokens: int, adapter, deadline,
-                       priority, headers, fab=None) -> dict:
+                       priority, headers, fab=None,
+                       brownout: int = 0) -> dict:
         """``parameters.kv_handoff: true``: run the prompt through the
         ordinary (chunked-)prefill machinery, sample exactly the first
         token a unified engine would, export the committed KV pages, and
@@ -762,6 +799,7 @@ class JetStreamModel(Model):
                                  fabric_import=fimp,
                                  trace=self._trace_ctx(headers),
                                  links=self._resume_link(headers),
+                                 brownout=brownout,
                                  waste_hint=("fabric_degraded"
                                              if (fab is not None
                                                  and fimp is None)
@@ -941,7 +979,7 @@ class JetStreamModel(Model):
 
     def _decode_phase_unary(self, ids: list, max_tokens: int, adapter,
                             deadline, priority, session, hand: dict,
-                            headers) -> dict:
+                            headers, brownout: int = 0) -> dict:
         """Decode phase, unary: fold the prefill phase's token(s) into the
         prompt, import the verified KV (or degrade to re-prefill), and
         return the FULL output — handoff tokens included, since their
@@ -972,6 +1010,7 @@ class JetStreamModel(Model):
                                  session_id=session, kv_import=imp,
                                  trace=self._trace_ctx(headers),
                                  links=self._resume_link(headers),
+                                 brownout=brownout,
                                  # import already degraded before submit:
                                  # the re-prefill redoes the prefill
                                  # replica's work (engine-side failures
@@ -1041,6 +1080,9 @@ class JetStreamModel(Model):
             self._parse_generate(payload, headers)
         kv_handoff, hand = self._parse_disagg_params(payload)
         fab = self._parse_fabric_params(payload)
+        brownout = self._parse_brownout(payload)
+        if brownout:
+            self.engine.telemetry.count_brownout(brownout)
         if fab is not None and hand is not None:
             raise RequestError(
                 "fabric and handoff are mutually exclusive")
@@ -1064,7 +1106,7 @@ class JetStreamModel(Model):
                 ids + prior, max_tokens - len(prior), adapter=adapter,
                 deadline=deadline, priority=priority, session_id=session,
                 kv_import=imp, trace=self._trace_ctx(headers),
-                links=self._resume_link(headers),
+                links=self._resume_link(headers), brownout=brownout,
                 waste_hint=(None if imp is not None
                             else "handoff_degraded"))
             # prior_emitted=False: handoff tokens were generated elsewhere
@@ -1096,6 +1138,7 @@ class JetStreamModel(Model):
                                              fabric_import=fimp,
                                              trace=self._trace_ctx(headers),
                                              links=self._resume_link(headers),
+                                             brownout=brownout,
                                              waste_hint=("failover_reprefill"
                                                          if resume else
                                                          "fabric_degraded"
@@ -1197,6 +1240,12 @@ class JetStreamModel(Model):
     def predict(self, payload: Any, headers: Optional[dict] = None) -> Any:
         instances = payload.get("instances", []) if isinstance(payload, dict) else payload
         header_prio = self._header_priority(headers)
+        # ingress brownout (README "Overload control"): the V1 surface
+        # carries the stage top-level; every instance in the batch
+        # degrades together
+        brownout = self._parse_brownout(payload)
+        if brownout:
+            self.engine.telemetry.count_brownout(brownout)
         # validate every adapter name / priority BEFORE submitting anything:
         # a bad value mid-loop would 500 the whole request while already-
         # submitted generations burn slots with nobody reading their futures
@@ -1235,7 +1284,8 @@ class JetStreamModel(Model):
             futures.append(self.engine.generate_async(ids, max_tokens,
                                                       adapter=adapter,
                                                       deadline=deadline,
-                                                      priority=priority))
+                                                      priority=priority,
+                                                      brownout=brownout))
         out = []
         for fut in futures:
             try:

@@ -348,8 +348,8 @@ class EngineTelemetry:
         self.kv_fabric = r.counter(
             "engine_kv_fabric_total",
             "fleet KV fabric operations by outcome "
-            "(publish/publish_skipped/publish_failed/pull/miss/expired/"
-            "import/hit/local/degraded)")
+            "(publish/publish_skipped/publish_failed/publish_deferred/"
+            "pull/miss/expired/import/hit/local/degraded)")
         self.kv_fabric_bytes = r.counter(
             "engine_kv_fabric_bytes_total",
             "fleet KV fabric payload bytes by direction "
@@ -433,6 +433,14 @@ class EngineTelemetry:
             "incident_detector_firings_total",
             "incident detector firings by detector (many firings "
             "coalesce into one incident inside the debounce window)")
+        # Overload control (README "Overload control", serving/overload.py):
+        # requests this engine served under an ingress brownout stage —
+        # the engine-side receipt that degraded-quality admission is
+        # actually reaching the hot loop (stage 2 disables speculation
+        # drafting, stage 3 defers fabric publishes).
+        self.brownout_requests = r.counter(
+            "engine_brownout_requests_total",
+            "requests served under an ingress brownout stage, by stage")
 
     # Observe methods stay branch-cheap: one attribute check, then a dict
     # op under the metric's own lock.
@@ -556,6 +564,10 @@ class EngineTelemetry:
     def count_session_pin(self, outcome: str) -> None:
         if self.enabled:
             self.session_pins.inc(outcome=outcome)
+
+    def count_brownout(self, stage: int) -> None:
+        if self.enabled and stage > 0:
+            self.brownout_requests.inc(stage=str(stage))
 
     def count_incident_firing(self, detector: str) -> None:
         if self.enabled:

@@ -45,9 +45,10 @@ Root-cause taxonomy (``CAUSES``):
                          handoff chaos)
   fabric_degradation   — fleet-fabric prefix pulls falling back to
                          re-prefill
-  capacity             — admission-queue pressure (EngineOverloaded
-                         rejections, autoscaler flapping) with healthy
-                         replicas
+  capacity             — admission pressure with healthy replicas:
+                         EngineOverloaded rejections, ingress overload
+                         shedding / brownout stages (overload.py),
+                         autoscaler flapping
   unknown              — the honest fallback: signals that match no rule
                          (a lone tick overrun, a lone NaN trip)
 
@@ -77,7 +78,7 @@ CAUSES = ("replica_death", "prefill_interference", "storage_degradation",
 # can cite the live traces the fault touched)
 EVENT_KINDS = ("watchdog", "tick_overrun", "nan_guard", "degradation",
                "slo_burn", "queue_growth", "failover", "breaker_open",
-               "flap")
+               "flap", "shed", "brownout")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,6 +131,15 @@ def ingress_detectors() -> list:
         Detector("failover", ("failover",)),
         Detector("circuit_breaker", ("breaker_open",)),
         Detector("autoscaler_flap", ("flap",)),
+        # overload control (README "Overload control"): the ingress
+        # admission controller's aggregated shed bursts, brownout stage
+        # transitions, and relayed engine BACKPRESSURE (503+Retry-After
+        # — capacity evidence, not replica death) — the ingress-scope
+        # twin of the engine's admission_pressure detector.  Self-
+        # resolving by construction: shed events stop when the storm
+        # does, and the quiet window closes the capacity incident.
+        Detector("admission_pressure", ("shed", "brownout",
+                                        "queue_growth")),
     ]
 
 
@@ -168,10 +178,12 @@ def classify(symptoms: list) -> tuple:
         return ("prefill_interference",
                 "decode TPOT burning its budget while a prefill backlog "
                 "is live (Sarathi-Serve signature)")
-    if "queue_growth" in by_kind or "flap" in by_kind:
+    if any(k in by_kind for k in ("queue_growth", "flap", "shed",
+                                  "brownout")):
         return ("capacity",
-                "admission-queue pressure / scaling oscillation with no "
-                "replica-health evidence")
+                "admission pressure (queue growth / ingress shedding / "
+                "brownout / scaling oscillation) with no replica-health "
+                "evidence")
     return ("unknown", "no classification rule matched the evidence shape")
 
 

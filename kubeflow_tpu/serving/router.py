@@ -49,6 +49,7 @@ from ..core.api import APIServer, Obj
 from ..core.metrics import REGISTRY, merge_expositions
 from . import disagg, kvfabric
 from . import incidents as incidents_mod
+from . import overload as overload_mod
 from .api import GROUP, LABEL_ISVC, LABEL_REVISION
 from .controllers import (
     DEPLOYMENT_FOR_SERVICE_ANNOTATION,
@@ -71,6 +72,12 @@ ACTIVATION_TIMEOUT = 30.0
 RELAY_TIMEOUT_ANNOTATION = f"{GROUP}/relay-timeout"
 HEDGE_TIMEOUT_ANNOTATION = f"{GROUP}/hedge-timeout"
 RETRY_BUDGET_ANNOTATION = f"{GROUP}/retry-budget"
+# Overload control (README "Overload control"): per-Service annotation
+# whose value is "on" (defaults) or a JSON overload.OverloadConfig
+# object — per-tenant token-bucket quotas, the AIMD concurrency limiter,
+# deadline early-rejection and staged brownout all hang off it.  Absent
+# or "off" = legacy behavior (every request relays).
+OVERLOAD_ANNOTATION = f"{GROUP}/overload"
 
 # Ingress-side observability (shared core registry, rendered by
 # core.metrics.serve): per-backend relay counts by status class and the
@@ -110,6 +117,24 @@ INGRESS_BACKEND_STATE = REGISTRY.gauge(
 INGRESS_TRACE_EVICTIONS = REGISTRY.counter(
     "ingress_trace_evictions_total",
     "relay traces evicted from the proxy's bounded trace store")
+# Overload-control surface (README "Overload control"): requests refused
+# at the ingress by class and reason (quota/concurrency/deadline — every
+# one answered 429 + Retry-After, never relayed to die in an engine
+# queue), per-tenant token-bucket levels, and the current brownout stage
+# (0 = normal service; 1-3 degrade quality before availability).
+INGRESS_SHED = REGISTRY.counter(
+    "ingress_shed_total",
+    "requests shed at the ingress by the overload controller, by "
+    "service, priority class and reason (quota/concurrency/deadline)")
+INGRESS_TENANT_TOKENS = REGISTRY.gauge(
+    "ingress_tenant_tokens",
+    "per-tenant admission token-bucket level (refills at the tenant's "
+    "weighted fair share of the service's admission rate)")
+INGRESS_BROWNOUT = REGISTRY.gauge(
+    "ingress_brownout_stage",
+    "current brownout degradation stage per service (0 = normal; "
+    "1 = max_tokens clamped; 2 = + speculation/fabric placement off; "
+    "3 = + fabric publishes deferred)")
 # Incident plane, ingress scope (README "Incident plane"): the service
 # proxy runs one incident manager per service — failover retries,
 # circuit-breaker opens, and autoscaler flapping feed its detectors, and
@@ -221,6 +246,12 @@ class _ProxyState:
         self.incidents = None
         self.health_log: collections.deque = collections.deque(maxlen=256)
         self.health_last: dict[int, str] = {}
+        # overload control (README "Overload control"): the service's
+        # admission controller, built lazily from the overload annotation
+        # (overload_key caches the raw annotation string so a rebuild
+        # happens only when the operator actually changes it)
+        self.overload = None
+        self.overload_key: Optional[str] = None
         self.lock = threading.Lock()
 
 
@@ -433,100 +464,135 @@ class ServiceProxy:
                 payload = json.loads(body)
             except ValueError:
                 payload = None
-        resume = self._resume_context(handler.path, payload)
-        session = self._session_key(handler.headers, payload)
-        sse = _SSERelay(handler)
-        # distributed trace (README "Observability"): adopt the caller's
-        # traceparent (this relay's root span becomes its child) or mint a
-        # fresh trace; every attempt below is a child hop of the root.
-        # The inbound header is stripped from the forwarded set — each
-        # attempt re-stamps its OWN hop context.
-        inbound = tracing.parse_traceparent(
-            handler.headers.get(tracing.TRACEPARENT_HEADER))
-        root = inbound.child() if inbound is not None \
-            else tracing.TraceContext.mint()
-        sse.trace_id = root.trace_id
-        handler._trace_id = root.trace_id
-        prev_failed_hop: Optional[str] = None
-        hop_by_hop = {"host", "content-length", "connection", "keep-alive",
-                      "transfer-encoding", "upgrade", "te", "trailers",
-                      # internal signaling headers the relay mints itself:
-                      # forwarding a client's copy would let it forge
-                      # failover (resumed_from) edges into traces
-                      tracing.TRACEPARENT_HEADER, "x-resume-from"}
-        fwd_headers = {k: v for k, v in handler.headers.items()
-                       if k.lower() not in hop_by_hop}
-        fwd_headers.setdefault("Content-Type", "application/json")
-        t0 = time.perf_counter()
-        status = 502
-        backend_label = "none"
-        attempt = 0
-        tried: set[int] = set()
-        # disaggregated prefill/decode (README "Disaggregated serving"):
-        # when the service runs role-split replicas and this request
-        # classifies as prefill-heavy, run the PREFILL phase now (one
-        # unary hop to a prefill replica that exports the prompt's KV) and
-        # rewrite the body into the DECODE phase the retry loop below
-        # relays — restricted to decode-capable replicas.  Any prefill-
-        # phase failure falls through to the plain unified relay.
-        # Prefill-role replicas never take general traffic: every pick
-        # below prefers decode/unified roles (fall-back inside the pick
-        # keeps an all-prefill fleet serving rather than 503ing).
-        roles = ("decode", "unified")
-        split = False
-        fabric_seen: dict = {}
-        if session is None and svc is not None:
-            plan = self._plan_disagg(state, svc, handler, body, payload,
-                                     fabric_out=fabric_seen)
-            if plan is not None:
-                decode_body = self._disagg_prefill(
-                    state, svc, handler, plan, fwd_headers, root, t0,
-                    relay_timeout)
-                if decode_body is not None:
-                    body = decode_body
-                    split = True
-        # global cache-aware placement (README "Fleet KV fabric"): score
-        # the fleet's published prefixes against this prompt.  The plan
-        # steers the pick toward the deepest-matched owner; when the pick
-        # lands elsewhere (load, stickiness, failover) the relay injects
-        # a parameters.fabric pull hint so the chosen replica faults the
-        # prefix in instead of re-prefilling it.  Split requests keep
-        # their rewritten handoff body untouched; a plan the disagg
-        # classifier already computed is reused, not re-hashed.
-        fabric_plan = None
-        if svc is not None and not split:
-            fabric_plan = (fabric_seen["plan"] if "plan" in fabric_seen
-                           else self._plan_fabric(state, handler, payload))
-        # true only for the dispatch immediately following a hedge-armed
-        # stall: THAT attempt is the hedged re-dispatch ingress_hedged_total
-        # counts, not the tight-timeout first attempt that armed it
-        hedge_redispatch = False
+        # ---- overload control (README "Overload control"): the shed-at-
+        # ingress decision runs BEFORE any relay/placement work — a
+        # refused request costs one bucket refill and a 429, not a relay,
+        # a queue slot and a prefill.  Admitted requests may come back
+        # browned out: the body is rewritten (max_tokens clamp, engine
+        # brownout stage) before the resume/session machinery snapshots it.
+        ov = self._overload_for(state, svc)
+        decision = None
+        ov_ttfb: Optional[float] = None
+        saw_backpressure = False  # an ENGINE 503+Retry-After was relayed
+        if ov is not None and handler.command == "POST":
+            decision = self._admit_overload(state, ov, handler, payload)
+            if not decision.admitted:
+                return  # _admit_overload answered the 429
+        try:
+            # everything between admission and the relay loop runs under
+            # the same release guarantee as the loop's finally: the
+            # inflight slot taken at admission must not leak if any
+            # pre-relay step throws (leaked slots ratchet the AIMD count
+            # up until the service sheds everything with 'concurrency')
+            if (decision is not None and decision.stage >= 1
+                    and isinstance(payload, dict)):
+                body, payload = self._apply_brownout(
+                    payload, decision.stage, ov.config)
+            resume = self._resume_context(handler.path, payload)
+            session = self._session_key(handler.headers, payload)
+            sse = _SSERelay(handler)
+            # distributed trace (README "Observability"): adopt the caller's
+            # traceparent (this relay's root span becomes its child) or mint a
+            # fresh trace; every attempt below is a child hop of the root.
+            # The inbound header is stripped from the forwarded set — each
+            # attempt re-stamps its OWN hop context.
+            inbound = tracing.parse_traceparent(
+                handler.headers.get(tracing.TRACEPARENT_HEADER))
+            root = inbound.child() if inbound is not None \
+                else tracing.TraceContext.mint()
+            sse.trace_id = root.trace_id
+            handler._trace_id = root.trace_id
+            prev_failed_hop: Optional[str] = None
+            hop_by_hop = {"host", "content-length", "connection", "keep-alive",
+                          "transfer-encoding", "upgrade", "te", "trailers",
+                          # internal signaling headers the relay mints itself:
+                          # forwarding a client's copy would let it forge
+                          # failover (resumed_from) edges into traces
+                          tracing.TRACEPARENT_HEADER, "x-resume-from"}
+            fwd_headers = {k: v for k, v in handler.headers.items()
+                           if k.lower() not in hop_by_hop}
+            fwd_headers.setdefault("Content-Type", "application/json")
+            t0 = time.perf_counter()
+            status = 502
+            backend_label = "none"
+            attempt = 0
+            tried: set[int] = set()
+            # disaggregated prefill/decode (README "Disaggregated serving"):
+            # when the service runs role-split replicas and this request
+            # classifies as prefill-heavy, run the PREFILL phase now (one
+            # unary hop to a prefill replica that exports the prompt's KV) and
+            # rewrite the body into the DECODE phase the retry loop below
+            # relays — restricted to decode-capable replicas.  Any prefill-
+            # phase failure falls through to the plain unified relay.
+            # Prefill-role replicas never take general traffic: every pick
+            # below prefers decode/unified roles (fall-back inside the pick
+            # keeps an all-prefill fleet serving rather than 503ing).
+            roles = ("decode", "unified")
+            split = False
+            fabric_seen: dict = {}
+            # brownout stage >= 2 sheds the ingress OPTIMIZATIONS first: the
+            # disagg split and the fabric placement both fan out extra work
+            # (prefill hops, view scoring, pulls) to buy latency — exactly
+            # the quality spend that goes before availability does
+            browned_out = decision is not None and decision.stage >= 2
+            if session is None and svc is not None and not browned_out:
+                plan = self._plan_disagg(state, svc, handler, body, payload,
+                                         fabric_out=fabric_seen)
+                if plan is not None:
+                    decode_body = self._disagg_prefill(
+                        state, svc, handler, plan, fwd_headers, root, t0,
+                        relay_timeout)
+                    if decode_body is not None:
+                        body = decode_body
+                        split = True
+            # global cache-aware placement (README "Fleet KV fabric"): score
+            # the fleet's published prefixes against this prompt.  The plan
+            # steers the pick toward the deepest-matched owner; when the pick
+            # lands elsewhere (load, stickiness, failover) the relay injects
+            # a parameters.fabric pull hint so the chosen replica faults the
+            # prefix in instead of re-prefilling it.  Split requests keep
+            # their rewritten handoff body untouched; a plan the disagg
+            # classifier already computed is reused, not re-hashed.
+            fabric_plan = None
+            if svc is not None and not split and not browned_out:
+                fabric_plan = (fabric_seen["plan"] if "plan" in fabric_seen
+                               else self._plan_fabric(state, handler, payload))
+            # true only for the dispatch immediately following a hedge-armed
+            # stall: THAT attempt is the hedged re-dispatch ingress_hedged_total
+            # counts, not the tight-timeout first attempt that armed it
+            hedge_redispatch = False
 
-        def reply(code: int, data: bytes, ctype: Optional[str] = None,
-                  extra: Optional[dict] = None):
-            handler._reply(code, data, ctype,
-                           extra={**(extra or {}),
-                                  "X-Trace-Id": root.trace_id})
+            def reply(code: int, data: bytes, ctype: Optional[str] = None,
+                      extra: Optional[dict] = None):
+                handler._reply(code, data, ctype,
+                               extra={**(extra or {}),
+                                      "X-Trace-Id": root.trace_id})
 
-        def note_hop(hop, backend, kind, hop_t0, outcome,
-                     error: Optional[str] = None,
-                     backend_state: Optional[str] = None) -> None:
-            span = {"trace_id": root.trace_id, "span_id": hop.span_id,
-                    "parent_id": hop.parent_id, "component": "ingress",
-                    "name": "relay_attempt", "attempt": attempt,
-                    "kind": kind, "backend": backend,
-                    "backend_state": backend_state, "outcome": outcome,
-                    "t_start_s": round(hop_t0 - t0, 6),
-                    "duration_s": round(time.perf_counter() - hop_t0, 6)}
-            if error is not None:
-                span["error"] = error
-            if prev_failed_hop is not None:
-                # the hop this one picks up from: retries reference the
-                # failed attempt; stream re-admissions are the satellite's
-                # "resumed_from" edge in the assembled tree
-                span["resumed_from"] = prev_failed_hop
-            self.traces.put(root.trace_id, span)
+            def note_hop(hop, backend, kind, hop_t0, outcome,
+                         error: Optional[str] = None,
+                         backend_state: Optional[str] = None) -> None:
+                span = {"trace_id": root.trace_id, "span_id": hop.span_id,
+                        "parent_id": hop.parent_id, "component": "ingress",
+                        "name": "relay_attempt", "attempt": attempt,
+                        "kind": kind, "backend": backend,
+                        "backend_state": backend_state, "outcome": outcome,
+                        "t_start_s": round(hop_t0 - t0, 6),
+                        "duration_s": round(time.perf_counter() - hop_t0, 6)}
+                if error is not None:
+                    span["error"] = error
+                if prev_failed_hop is not None:
+                    # the hop this one picks up from: retries reference the
+                    # failed attempt; stream re-admissions are the satellite's
+                    # "resumed_from" edge in the assembled tree
+                    span["resumed_from"] = prev_failed_hop
+                self.traces.put(root.trace_id, span)
 
+        except BaseException:
+            if ov is not None and decision is not None:
+                ov.release(decision, ok=False, ttfb_s=None,
+                           now=time.monotonic())
+                decision = None
+            raise
         try:
             while True:
                 pick_note: dict = {}
@@ -606,6 +672,7 @@ class ServiceProxy:
                         else "hedge" if hedge_redispatch else "relay")
                 hedge_redispatch = False
                 reason = None
+                retry_hint: Optional[float] = None
                 try:
                     with urllib.request.urlopen(
                             req, timeout=attempt_timeout) as r:
@@ -613,10 +680,15 @@ class ServiceProxy:
                         ctype = r.headers.get("Content-Type") or ""
                         if ctype.startswith("text/event-stream"):
                             if resume is not None:
+                                def _set_ttfb(v: float) -> None:
+                                    nonlocal ov_ttfb
+                                    ov_ttfb = v
                                 self._relay_resumable(
                                     state, r, sse, resume, backend,
                                     keep_ids=self._client_wants_ids(
-                                        handler.headers))
+                                        handler.headers),
+                                    on_ttfb=(_set_ttfb if decision
+                                             is not None else None))
                                 ok = True
                             else:
                                 ok = handler._stream(r, ctype)
@@ -626,6 +698,15 @@ class ServiceProxy:
                                      backend_state=hop_state)
                             return
                         payload = r.read()
+                        if decision is not None:
+                            # queue+TTFT feedback for the overload
+                            # controller's deadline estimator (the
+                            # engine's X-TTFT-S response surface)
+                            try:
+                                ov_ttfb = float(
+                                    r.headers.get("X-TTFT-S") or "")
+                            except ValueError:
+                                ov_ttfb = None
                         self._note_backend(state, backend, True)
                         if sse.started:
                             # a RESUMED stream landed on a backend that
@@ -654,7 +735,14 @@ class ServiceProxy:
                         return
                 except urllib.error.HTTPError as e:
                     status = e.code
-                    if e.code < 500:  # client fault: the backend is fine
+                    # 504 = the ENGINE shed this request's deadline
+                    # (DeadlineExceeded): the replica is healthy and the
+                    # request's time budget is spent — a failover retry
+                    # would restart the deadline on another replica and
+                    # double the queueing work exactly when the fleet is
+                    # drowning (waste amplification), so it reports
+                    # terminal like a client fault, with no health strike
+                    if e.code < 500 or e.code == 504:
                         self._note_backend(state, backend, True)
                         note_hop(hop, backend, kind, hop_t0,
                                  f"status_{e.code}",
@@ -666,7 +754,25 @@ class ServiceProxy:
                             reply(e.code, e.read(),
                                   e.headers.get("Content-Type"))
                         return
-                    self._note_backend(state, backend, False)
+                    try:
+                        # engine-side backpressure names its own backoff
+                        # (README "Overload control"): honor it below
+                        # instead of immediately re-pick hammering the
+                        # next replica with the same doomed burst
+                        retry_hint = float(
+                            e.headers.get("Retry-After") or "")
+                    except (TypeError, ValueError):
+                        retry_hint = None
+                    # a 503 WITH Retry-After is typed BACKPRESSURE
+                    # (EngineOverloaded): the replica is full, not
+                    # broken — no health strike (breaker opens would
+                    # amplify the storm by shrinking the routable set),
+                    # and the incident evidence is capacity-shaped, not
+                    # replica death
+                    backpressure = (e.code == 503
+                                    and retry_hint is not None)
+                    saw_backpressure = saw_backpressure or backpressure
+                    self._note_backend(state, backend, ok=backpressure)
                     note_hop(hop, backend, kind, hop_t0, "status_5xx",
                              f"HTTP {e.code}", backend_state=hop_state)
                     if attempt >= budget:
@@ -678,7 +784,8 @@ class ServiceProxy:
                             reply(e.code, e.read(),
                                   e.headers.get("Content-Type"))
                         return
-                    reason = "status_5xx"
+                    reason = "backpressure" if backpressure \
+                        else "status_5xx"
                 except _ClientGone as e:
                     note_hop(hop, backend, kind, hop_t0, "client_gone",
                              str(e), backend_state=hop_state)
@@ -723,9 +830,13 @@ class ServiceProxy:
                     # failover incident signal (README "Incident plane"):
                     # one event per failed attempt — a kill/hang/cut burst
                     # coalesces into one incident citing this trace, and
-                    # the re-admission (resume) rides the same chain
+                    # the re-admission (resume) rides the same chain.
+                    # Typed backpressure is CAPACITY evidence, not
+                    # replica death — feeding it as failover would let
+                    # one engine 503 reclassify a whole storm incident.
                     state.incidents.feed(
-                        "failover", service=state.service_name,
+                        "queue_growth" if reason == "backpressure"
+                        else "failover", service=state.service_name,
                         backend=backend, reason=reason,
                         resume=bool(resume is not None and resume.token_ids),
                         trace_ids=[root.trace_id])
@@ -734,8 +845,28 @@ class ServiceProxy:
                     # client stream is waiting on its continuation
                     delay = min(self._BACKOFF_MAX_S,
                                 self._BACKOFF_BASE_S * (2 ** (attempt - 1)))
-                    time.sleep(random.uniform(0, delay))
+                    if retry_hint is not None and retry_hint > 0:
+                        # the backend's Retry-After wins (capped so one
+                        # replica's generous hint can't stall the relay
+                        # past the breaker's own timescale), jittered so
+                        # a shed burst doesn't re-arrive in lockstep
+                        delay = min(self._BACKOFF_MAX_S,
+                                    max(delay, retry_hint))
+                        time.sleep(delay * random.uniform(0.5, 1.0))
+                    else:
+                        time.sleep(random.uniform(0, delay))
         finally:
+            if ov is not None and decision is not None and decision.admitted:
+                # free the inflight slot + feed the AIMD signals: TYPED
+                # engine backpressure (503+Retry-After) that leaked
+                # through means the limiter let too much past — direct
+                # overload evidence.  A bare 503 is NOT: the ingress'
+                # own no-backend reply and a draining replica's refusal
+                # must not drive the AIMD into brownout on an idle fleet.
+                ov.release(decision, ok=status < 500, ttfb_s=ov_ttfb,
+                           now=time.monotonic(),
+                           engine_overloaded=saw_backpressure)
+                self._drain_overload_events(state, ov)
             # latency covers the full relay (SSE: the whole stream, across
             # every failover attempt)
             INGRESS_LATENCY.observe(time.perf_counter() - t0,
@@ -799,7 +930,7 @@ class ServiceProxy:
 
     def _relay_resumable(self, state: _ProxyState, r, sse: "_SSERelay",
                          resume: "_ResumeCtx", backend: int,
-                         keep_ids: bool = False) -> None:
+                         keep_ids: bool = False, on_ttfb=None) -> None:
         """Parse-and-relay one backend SSE stream, recording the token ids
         behind every relayed event into ``resume`` so a broken stream can be
         re-admitted elsewhere.  ``keep_ids`` forwards the ids to the client
@@ -840,6 +971,14 @@ class ServiceProxy:
                 if ids:
                     resume.token_ids.extend(int(i) for i in ids)
                 if event.get("done"):
+                    if on_ttfb is not None and isinstance(
+                            event.get("ttft_s"), (int, float)):
+                        # the stream's final record carries the engine's
+                        # queue+TTFT — the overload controller's deadline
+                        # estimator feeds from it (the plain passthrough
+                        # relay never parses events, so SSE-only fleets
+                        # without resume contexts stay unsampled)
+                        on_ttfb(float(event["ttft_s"]))
                     if resume.token_ids and "tokens" in event:
                         # across failovers the LAST backend only knows its
                         # continuation; the ingress knows the whole run
@@ -858,6 +997,232 @@ class ServiceProxy:
                     if act == "cut":
                         raise _BackendStreamError(
                             "chaos: injected mid-stream disconnect")
+
+    # --------------------------------------------------- overload control
+    # (README "Overload control"): the ingress admission layer.  The
+    # controller (serving/overload.py) owns the policy — per-tenant
+    # weighted quotas, the AIMD concurrency limit, deadline early-reject,
+    # staged brownout; this is the wiring: annotation parsing, the 429
+    # surface, brownout body rewrites, metric/incident feeds.
+
+    def _overload_for(self, state: _ProxyState,
+                      svc: Optional[Obj]) -> Optional[object]:
+        """The service's overload controller, built (and cached) from the
+        overload annotation.  Absent/off/unparseable = None — admission
+        control is opt-in, and a bad config disables shedding rather than
+        shedding on garbage thresholds."""
+        if svc is None:
+            return state.overload
+        raw = svc["metadata"].get("annotations", {}).get(
+            OVERLOAD_ANNOTATION)
+        key = None if raw is None else str(raw)
+        with state.lock:
+            if key == state.overload_key:
+                return state.overload
+        ctrl = None
+        if key is not None and key.strip().lower() not in ("", "off",
+                                                           "false", "0"):
+            try:
+                if key.strip().lower() in ("on", "true", "1"):
+                    cfg = overload_mod.OverloadConfig()
+                else:
+                    cfg = overload_mod.OverloadConfig.from_json(
+                        json.loads(key))
+                ctrl = overload_mod.OverloadController(cfg)
+            except (ValueError, TypeError):
+                ctrl = None  # misconfigured: fail open, not closed
+        with state.lock:
+            state.overload_key = key
+            state.overload = ctrl
+        INGRESS_BROWNOUT.set(0, service=state.service_name)
+        return ctrl
+
+    def _admit_overload(self, state: _ProxyState, ov, handler, payload):
+        """Run one POST through the admission gates; on refusal, answer
+        the 429 (Retry-After header + machine-readable body) HERE so the
+        relay path stays linear.  Returns the Decision either way."""
+        decision = ov.admit(
+            tenant=self._tenant_key(handler.headers, payload),
+            cls=self._overload_class(handler.headers, payload),
+            cost=self._overload_cost(payload),
+            deadline_s=self._overload_deadline(payload),
+            now=time.monotonic())
+        svc_label = state.service_name
+        INGRESS_BROWNOUT.set(decision.stage, service=svc_label)
+        if decision.tokens_left is not None:
+            INGRESS_TENANT_TOKENS.set(decision.tokens_left,
+                                      service=svc_label,
+                                      tenant=decision.tenant)
+        self._drain_overload_events(state, ov)
+        if decision.admitted:
+            return decision
+        INGRESS_SHED.inc(service=svc_label, reason=decision.reason,
+                         **{"class": decision.cls})
+        body = json.dumps({
+            "error": f"overloaded: {decision.detail or decision.reason}",
+            "reason": decision.reason,
+            "retry_after_s": decision.retry_after_s,
+            "tenant": decision.tenant,
+            "class": decision.cls,
+            "brownout_stage": decision.stage,
+        }).encode()
+        try:
+            handler._reply(429, body,
+                           extra={"Retry-After":
+                                  f"{decision.retry_after_s:g}"})
+        except Exception:  # noqa: BLE001 — client gone before the 429
+            handler.close_connection = True
+        return decision
+
+    def _drain_overload_events(self, state: _ProxyState, ov) -> None:
+        """Feed the controller's aggregated shed/brownout events into the
+        service's incident manager — the self-resolving ``capacity``
+        evidence source (README "Incident plane"): a storm reads as ONE
+        classified incident citing shed counts and brownout stages."""
+        for t in ov.drain_pruned_tenants():
+            # the controller pruned this tenant's bucket: drop its gauge
+            # series too, or a unique-X-Tenant-Id storm grows the metric
+            # registry one series per tenant forever
+            INGRESS_TENANT_TOKENS.remove(service=state.service_name,
+                                         tenant=t)
+        if state.incidents is None:
+            return
+        for ev in ov.drain_events():
+            state.incidents.feed(ev.pop("kind"),
+                                 service=state.service_name, **ev)
+
+    @staticmethod
+    def _tenant_key(headers, payload) -> Optional[str]:
+        """The request's tenant — ``X-Tenant-Id`` header, a top-level
+        ``tenant`` body field, or ``parameters.tenant``; None lands on
+        the default tenant (legacy traffic keeps working, it just
+        shares one bucket)."""
+        for k, v in headers.items():
+            if k.lower() == "x-tenant-id" and str(v).strip():
+                return str(v).strip()
+        if isinstance(payload, dict):
+            t = payload.get("tenant")
+            if t is None:
+                params = payload.get("parameters")
+                if isinstance(params, dict):
+                    t = params.get("tenant")
+            if isinstance(t, str) and t:
+                return t
+        return None
+
+    @staticmethod
+    def _overload_class(headers, payload) -> Optional[str]:
+        """The request's SLO/priority class for shed ordering —
+        ``parameters.priority`` / a top-level ``priority`` wins over the
+        ``X-Priority`` header; junk falls back to the default class at
+        the controller (the backend's 400 names the real error)."""
+        if isinstance(payload, dict):
+            params = payload.get("parameters")
+            p = params.get("priority") if isinstance(params, dict) else None
+            if p is None:
+                p = payload.get("priority")
+            if isinstance(p, str) and p:
+                return p
+        for k, v in headers.items():
+            if k.lower() == "x-priority" and str(v).strip():
+                return str(v).strip()
+        return None
+
+    @staticmethod
+    def _overload_cost(payload) -> float:
+        """Token-bucket cost estimate: ~prompt tokens + requested output
+        tokens.  The proxy has no tokenizer, so chars/4 approximates the
+        prompt — quotas need proportionality, not token-exactness.  V1
+        predict batches charge PER INSTANCE: one HTTP request fanning
+        out into N engine submissions must not cost the same as one tiny
+        generate, or batching becomes a quota bypass."""
+        if isinstance(payload, dict) \
+                and isinstance(payload.get("instances"), list):
+            total = 0.0
+            for inst in payload["instances"]:
+                if isinstance(inst, dict):
+                    prompt = inst.get("prompt")
+                    n = len(prompt) if isinstance(prompt, str) else 0
+                    try:
+                        mt = max(1, int(inst.get("max_tokens", 32)))
+                    except (TypeError, ValueError):
+                        mt = 32
+                else:
+                    n = len(inst) if isinstance(inst, str) else 0
+                    mt = 32
+                total += max(1.0, n / 4.0) + mt
+            return max(1.0, total)
+        text = ServiceProxy._payload_text(payload) or ""
+        mt = 32
+        if isinstance(payload, dict):
+            params = payload.get("parameters")
+            raw = params.get("max_tokens") \
+                if isinstance(params, dict) else None
+            if raw is None:
+                raw = payload.get("max_tokens")
+            try:
+                mt = max(1, int(raw)) if raw is not None else 32
+            except (TypeError, ValueError):
+                mt = 32
+        return max(1.0, len(text) / 4.0) + mt
+
+    @staticmethod
+    def _overload_deadline(payload) -> Optional[float]:
+        if not isinstance(payload, dict):
+            return None
+        params = payload.get("parameters")
+        dl = params.get("deadline_s") if isinstance(params, dict) else None
+        try:
+            return float(dl) if dl is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    @staticmethod
+    def _apply_brownout(payload: dict, stage: int, cfg) -> tuple:
+        """Rewrite an admitted request's body for brownout ``stage``:
+        clamp the requested output budget (stage >= 1) and carry the
+        stage to the engine (stage >= 2: ``parameters.brownout`` —
+        speculation drafting off there, fabric publish deferred at 3).
+        Returns ``(body_bytes, payload)``; the original payload object is
+        never mutated (retries re-derive from the rewritten copy)."""
+        p = copy.deepcopy(payload)
+        clamp = int(cfg.brownout_max_tokens)
+        params = p.get("parameters")
+        if not isinstance(params, dict) and isinstance(
+                p.get("text_input"), str):
+            # V2 generate with no parameters block: the engine default
+            # (32) may still exceed the clamp, and stage >= 2 needs a
+            # place to carry the engine-side brownout marker
+            params = p["parameters"] = {}
+        if isinstance(params, dict):
+            try:
+                cur = int(params.get("max_tokens", 32))
+            except (TypeError, ValueError):
+                cur = 32
+            params["max_tokens"] = min(cur, clamp)
+            if stage >= 2:
+                params["brownout"] = int(stage)
+        if isinstance(p.get("max_tokens"), int):
+            # OpenAI surface carries max_tokens at the top level
+            p["max_tokens"] = min(p["max_tokens"], clamp)
+        if stage >= 2 and not isinstance(p.get("parameters"), dict) \
+                and (isinstance(p.get("prompt"), str)
+                     or isinstance(p.get("messages"), list)):
+            # OpenAI-shaped body: the server's _openai handler forwards a
+            # top-level ``brownout`` into the engine parameters — without
+            # it, stage >= 2 would clamp tokens but leave speculation and
+            # fabric publishes running for exactly this surface
+            p["brownout"] = int(stage)
+        if isinstance(p.get("instances"), list):
+            # V1 predict: per-instance budgets + the whole batch's
+            # engine marker top-level (serve.predict reads it there)
+            for inst in p["instances"]:
+                if isinstance(inst, dict) \
+                        and isinstance(inst.get("max_tokens"), int):
+                    inst["max_tokens"] = min(inst["max_tokens"], clamp)
+            if stage >= 2:
+                p["brownout"] = int(stage)
+        return json.dumps(p).encode(), p
 
     # ------------------------------------ disaggregated prefill/decode
     # (README "Disaggregated serving"): the proxy-side orchestration of
@@ -1784,6 +2149,15 @@ class ServiceProxy:
                     load = (m["engine_queue_depth"]
                             + m.get("engine_active_slots", 0.0))
                     state.loads[port] = (now, load)
+                    if state.overload is not None:
+                        # worst-replica SLO burn feed for the overload
+                        # controller's AIMD signal: the scrape this pick
+                        # already paid for carries the SloTracker's
+                        # exported slo_burn_rate series — no extra fan-out
+                        burns = [v for k, v in m.items()
+                                 if k.startswith("slo_burn_rate{")]
+                        if burns:
+                            state.overload.note_burn(port, max(burns), now)
                     # subtract the snapshot, don't zero: picks that landed on
                     # this port WHILE the scrape ran are in neither the
                     # scraped gauges nor (after a reset) pending — zeroing
@@ -1955,9 +2329,21 @@ class ServiceProxy:
         — because the manager swallows evidence errors — silently write
         bundles with NO health log exactly when churn is the story."""
         with state.lock:
-            return {"health_log": list(state.health_log)[-32:],
-                    "backends": {str(p): h.state
-                                 for p, h in state.health.items()}}
+            out = {"health_log": list(state.health_log)[-32:],
+                   "backends": {str(p): h.state
+                                for p, h in state.health.items()}}
+            ov = state.overload
+        if ov is not None:
+            # capacity incidents cite the overload story (README
+            # "Overload control"): shed counts by class/reason, brownout
+            # stage, the live AIMD limit, per-tenant pressure — the
+            # controller snapshot takes its OWN lock, so it runs outside
+            # state.lock (no nested-lock ordering to get wrong)
+            try:
+                out["overload"] = ov.snapshot()
+            except Exception:  # noqa: BLE001 — evidence is best-effort
+                pass
+        return out
 
     def incident_view(self) -> "_ProxyIncidentView":
         """The autoscaler's handle into the ingress incident plane

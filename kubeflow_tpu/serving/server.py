@@ -505,8 +505,12 @@ class ModelServer:
                 h._send(400, {"error": f"{type(e).__name__}: {e}"})
         except DeadlineExceeded as e:
             # request shed before its first token: the gateway timeout code,
-            # so clients/routers distinguish "too slow" from "broken"
-            h._send(504, {"error": f"{type(e).__name__}: {e}"})
+            # so clients/routers distinguish "too slow" from "broken".
+            # The machine-readable reason lets the storm bench (and any
+            # accounting ingress) count queue-deadline churn without
+            # string-matching the message.
+            h._send(504, {"error": f"{type(e).__name__}: {e}",
+                          "reason": "deadline"})
         except SessionBusy as e:
             # a session's turns are strictly serial: a second concurrent
             # turn conflicts with the in-flight one — 409, retry after it
@@ -518,8 +522,24 @@ class ModelServer:
             else:
                 h._send(409, {"error": f"{type(e).__name__}: {e}"})
         except (EngineOverloaded, EngineShutdown) as e:
-            # backpressure / drain: retryable against another replica
-            h._send(503, {"error": f"{type(e).__name__}: {e}"})
+            # backpressure / drain: retryable against another replica.
+            # Retry-After (README "Overload control"): the engine attaches
+            # a load-proportional hint at the raise site — the ingress
+            # retry loop honors it with jitter instead of immediately
+            # hammering the next replica, and a direct client reads the
+            # same machine-readable surface the ingress 429s carry.
+            # ONLY EngineOverloaded carries the header: the router types
+            # a 503-with-Retry-After as "full, not broken" (no health
+            # strike), and a DRAINING/stopped replica is the opposite —
+            # its 503s must keep walking the health FSM toward ejection.
+            overloaded = isinstance(e, EngineOverloaded)
+            ra = float(getattr(e, "retry_after_s", 1.0) or 1.0)
+            h._send(503, {"error": f"{type(e).__name__}: {e}",
+                          "reason": ("engine_overloaded" if overloaded
+                                     else "engine_shutdown"),
+                          "retry_after_s": ra},
+                    extra_headers=({"Retry-After": f"{ra:g}"}
+                                   if overloaded else None))
         except Exception as e:  # noqa: BLE001 — server must answer
             h._send(500, {"error": f"{type(e).__name__}: {e}"})
         finally:
@@ -600,7 +620,14 @@ class ModelServer:
             out = verb(body, headers)
             out = dict(out) if isinstance(out, dict) else {"text_output": out}
             out.setdefault("model_name", name)
-            h._send(200, out, extra_headers=_session_headers(out))
+            extra = _session_headers(out) or {}
+            if isinstance(out.get("ttft_s"), (int, float)):
+                # queue+TTFT feedback for the ingress overload controller
+                # (README "Overload control"): the deadline early-reject
+                # estimator reads this header instead of re-parsing every
+                # relayed response body
+                extra["X-TTFT-S"] = f"{out['ttft_s']:.4f}"
+            h._send(200, out, extra_headers=extra or None)
             return
         gen = verb(body, headers)
         self._sse_write(
@@ -724,7 +751,13 @@ class ModelServer:
                                   "priority": body.get("priority"),
                                   # conversation pinning passthrough (the
                                   # model layer falls back to X-Session-Id)
-                                  "session_id": body.get("session_id")}}
+                                  "session_id": body.get("session_id"),
+                                  # ingress brownout passthrough (README
+                                  # "Overload control"): the overload
+                                  # controller marks OpenAI bodies at the
+                                  # top level; the model layer validates
+                                  # the stage
+                                  "brownout": body.get("brownout")}}
         headers = dict(h.headers.items())
         oid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:24]}"
         obj = "chat.completion" if chat else "text_completion"

@@ -192,6 +192,56 @@ class SloConfig:
         return cls(**kw)
 
 
+class RollingLatency:
+    """Rolling (timestamp, value) window with cheap quantile/floor reads —
+    the latency-VALUE companion to SloTracker's met/missed booleans.
+
+    The overload controller (serving/overload.py) uses it two ways: the
+    per-class p50 of observed queue+TTFT is the deadline early-reject
+    estimator, and p50-vs-rolling-floor is the queue-wait gradient in the
+    AIMD overload signal.  O(1) amortized observe (append + stale trim);
+    reads are O(in-window samples), called at the controller's amortized
+    adjust cadence, not per request.  NOT thread-safe — callers hold
+    their own lock (the controller's admission lock already serializes
+    every touch)."""
+
+    __slots__ = ("window_s", "max_samples", "_dq")
+
+    def __init__(self, window_s: float = 30.0, max_samples: int = 1024):
+        self.window_s = float(window_s)
+        self.max_samples = max_samples
+        self._dq: collections.deque = collections.deque(maxlen=max_samples)
+
+    def observe(self, value: float, now: float) -> None:
+        self._dq.append((now, float(value)))
+        cutoff = now - self.window_s
+        while self._dq and self._dq[0][0] < cutoff:
+            self._dq.popleft()
+
+    def _in_window(self, now: float, window: Optional[float]) -> list:
+        cutoff = now - (self.window_s if window is None else float(window))
+        return [v for t, v in self._dq if t >= cutoff]
+
+    def count(self, now: float, window: Optional[float] = None) -> int:
+        return len(self._in_window(now, window))
+
+    def quantile(self, q: float, now: float,
+                 window: Optional[float] = None) -> Optional[float]:
+        """The q-quantile of in-window values (None when empty)."""
+        vals = sorted(self._in_window(now, window))
+        if not vals:
+            return None
+        i = min(len(vals) - 1, max(0, int(q * len(vals))))
+        return vals[i]
+
+    def minimum(self, now: float,
+                window: Optional[float] = None) -> Optional[float]:
+        """The in-window floor — the gradient baseline: what this series
+        looks like when nothing is queueing."""
+        vals = self._in_window(now, window)
+        return min(vals) if vals else None
+
+
 class SloTracker:
     """Rolling per-(class, metric) attainment over the configured windows.
 

@@ -86,12 +86,28 @@ def _wait(pred, timeout=30.0, msg="condition"):
 
 
 def _wait_resolved(eng, n=1, timeout=30.0):
-    """Wait until exactly ``n`` incidents exist and all are resolved."""
+    """Wait until exactly ``n`` incidents exist and all are resolved —
+    including the RESOLUTION REWRITE of their on-disk bundles: the
+    manager flips state under its lock and rewrites the bundle after,
+    so a reader racing that gap would diff an open bundle against a
+    resolved incident (flaked under suite load)."""
     _wait(lambda: len(eng.incident_list()) >= n, timeout=timeout,
           msg=f"{n} incident(s)")
     _wait(lambda: all(i["state"] == "resolved"
                       for i in eng.incident_list()),
           timeout=timeout, msg="incident resolution")
+
+    def bundles_current():
+        for i in eng.incident_list():
+            p = i.get("bundle_path")
+            if not p or not os.path.exists(p):
+                return False
+            with open(p) as f:
+                if json.load(f).get("state") != i["state"]:
+                    return False
+        return True
+
+    _wait(bundles_current, timeout=timeout, msg="bundle rewrite")
     return eng.incident_list()
 
 
@@ -243,6 +259,11 @@ _SHAPES = {
                           "outcome": "pre_submit"}],
     "fabric:expired_publish": [{"kind": "degradation", "source": "fabric",
                                 "outcome": "pre_submit"}],
+    # traffic storm: the ingress overload controller's aggregated shed
+    # bursts + brownout stage transitions (README "Overload control")
+    "storm:overload": [{"kind": "shed", "reason": "concurrency",
+                        "shed": 5, "stage": 1},
+                       {"kind": "brownout", "stage": 2, "from_stage": 1}],
 }
 
 
