@@ -794,6 +794,23 @@ def main() -> None:
             }
     except Exception as e:  # sidebar only — never sink the bench line
         out["fleet"] = {"error": str(e)[:200]}
+    try:
+        # static-analysis sidebar: graftlint over the live tree, run
+        # in-process (README "Static analysis") — per-rule counts must
+        # stay zero, suppression/baseline totals show the enforcement
+        # surface, analyzer wall time pins the < 10s budget
+        from kubeflow_tpu.tools.graftlint import analyze as _graftlint
+        _rep = _graftlint()
+        out["lint"] = {
+            "files": _rep.files_analyzed,
+            "unsuppressed": len(_rep.unsuppressed),
+            "by_rule": _rep.counts(),
+            "suppressed": sum(1 for f in _rep.findings if f.suppressed),
+            "baselined": sum(1 for f in _rep.findings if f.baselined),
+            "elapsed_s": round(_rep.elapsed_s, 3),
+        }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["lint"] = {"error": str(e)[:200]}
     print(json.dumps(out))
 
 

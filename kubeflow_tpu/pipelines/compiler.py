@@ -455,9 +455,12 @@ class Compiler:
             "deploymentSpec": {"executors": executors},
         }
         if output_path:
-            with open(output_path, "w") as f:
+            # tmp+os.replace: compiled IR is a durable artifact other
+            # tooling loads (graftlint atomic-write)
+            with open(output_path + ".tmp", "w") as f:
                 json.dump(ir, f, indent=2, sort_keys=True)
                 f.write("\n")
+            os.replace(output_path + ".tmp", output_path)
         return ir
 
 

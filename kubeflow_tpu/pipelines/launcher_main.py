@@ -73,8 +73,12 @@ def run(workspace: str) -> int:
             store.put(a.uri, a.path)
         outputs["artifactMetadata"][aname] = a.metadata
 
-    with open(os.path.join(workspace, "outputs.json"), "w") as f:
+    # tmp+os.replace: the workflow controller polls for this file — it
+    # must never observe a half-written doc (graftlint atomic-write)
+    out_path = os.path.join(workspace, "outputs.json")
+    with open(out_path + ".tmp", "w") as f:
         json.dump(outputs, f)
+    os.replace(out_path + ".tmp", out_path)
     return 0
 
 

@@ -438,8 +438,13 @@ class WorkflowController:
             "outputParameters": sorted(out_param_defs),
             "storeRoot": self.store.root,
         }
-        with open(os.path.join(workspace, "task.json"), "w") as f:
+        # tmp+os.replace: the launcher subprocess reads this back — a torn
+        # write would crash the task with an unreadable doc (graftlint
+        # atomic-write)
+        task_path = os.path.join(workspace, "task.json")
+        with open(task_path + ".tmp", "w") as f:
             json.dump(task_doc, f)
+        os.replace(task_path + ".tmp", task_path)
 
         pod_name = f"{wf['metadata']['name']}-{tname}-r{node['retries']}"
         pod = self._pod(wf, tname, tspec, pod_name, workspace)

@@ -591,7 +591,7 @@ class Engine:
         self._sample_calls = 0
         # O(1) cancel: future -> rid, maintained at submit/finish so a
         # cancel storm never scans _requests under the lock
-        self._future_rid: dict[Future, int] = {}
+        self._future_rid: dict[Future, int] = {}  # guarded-by: _lock
         # prefill batching counters (stats): fused dispatches issued, total
         # prompt rows they carried, and a batch-size histogram
         self._prefill_dispatches = 0
@@ -619,7 +619,7 @@ class Engine:
         # session id -> rid of its one queued/in-flight turn: a session's
         # KV timeline is serial, so a second concurrent turn is refused
         # with SessionBusy (HTTP 409).  Guarded by self._lock.
-        self._session_active: dict[str, int] = {}
+        self._session_active: dict[str, int] = {}  # guarded-by: _lock
         # ---- fault tolerance state --------------------------------------
         self._chaos = (ChaosInjector(engine_config.chaos)
                        if engine_config.chaos is not None else None)
@@ -715,20 +715,20 @@ class Engine:
         self.flight = FlightRecorder(
             capacity=engine_config.flight_recorder_capacity,
             dump_dir=engine_config.flight_dir)
-        self._trace_ring: "dict[int, RequestSpan]" = {}
+        self._trace_ring: "dict[int, RequestSpan]" = {}  # guarded-by: _lock
         # retained-size accounting for the trace ring (trace_history_bytes
         # budget; sizes cached per rid so evict decrements exactly what
         # archive charged)
         self._trace_ring_bytes = 0
-        self._trace_sizes: dict[int, int] = {}
+        self._trace_sizes: dict[int, int] = {}  # guarded-by: _lock
         # trace id -> flight-recorder dump paths referencing it (bounded):
         # a failover postmortem finds the dying replica's flight dump from
         # the assembled trace tree instead of grepping the flight dir
-        self._trace_dumps: "dict[str, list[str]]" = {}
+        self._trace_dumps: "dict[str, list[str]]" = {}  # guarded-by: _lock
         # session id -> (trace_id, span_id) of its most recent terminal
         # turn, so turn N+1's span links turn N (bounded alongside
         # _trace_dumps by _TRACE_REF_CAP)
-        self._session_spans: "dict[str, tuple[str, str]]" = {}
+        self._session_spans: "dict[str, tuple[str, str]]" = {}  # guarded-by: _lock
         self._nan_dump_tick = -1  # last tick that produced a NaN dump
         # ---- incident plane (serving/incidents.py, README "Incident
         # plane") --------------------------------------------------------

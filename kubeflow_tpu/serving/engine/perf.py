@@ -199,7 +199,7 @@ class PerfLedger:
         # charge storm cannot grow the deque faster than reads trim it
         self._window: collections.deque = collections.deque(maxlen=4096)
 
-    def charge(self, kind: str, flops: float, positions: int = 0,
+    def charge(self, kind: str, flops: float, positions: int = 0,  # graftlint: hot-path
                reason: Optional[str] = None) -> None:
         if flops <= 0:
             return

@@ -174,7 +174,7 @@ class FlightRecorder:
                                          "engine_flightrec"))
         self.last_dump_path: Optional[str] = None
 
-    def record(self, **event) -> None:
+    def record(self, **event) -> None:  # graftlint: hot-path
         with self._lock:
             self._seq += 1
             event["seq"] = self._seq
@@ -203,6 +203,10 @@ class FlightRecorder:
             # not mask what triggered the dump)
             header = {**(extra or {}), "reason": reason,
                       "wall_time": time.time(), "events": len(events)}
+            # graftlint: disable=atomic-write -- postmortem ring dump:
+            # one-shot JSONL into a fresh per-pid path nothing reads
+            # back programmatically; a torn tail is still a readable
+            # prefix and the OSError path refunds the dump slot
             with open(path, "w") as f:
                 f.write(json.dumps(header) + "\n")
                 for e in events:

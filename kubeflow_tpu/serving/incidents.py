@@ -326,7 +326,7 @@ class IncidentManager:
 
     # ------------------------------------------------------------ producers
 
-    def feed(self, kind: str, **attrs) -> None:
+    def feed(self, kind: str, **attrs) -> None:  # graftlint: hot-path
         """Signal intake — the ONLY incident-plane call any hot path ever
         makes: one deque append plus an event set.  Never raises."""
         try:

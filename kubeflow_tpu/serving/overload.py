@@ -286,7 +286,7 @@ class OverloadController:
 
     # ---------------------------------------------------------- admission
 
-    def admit(self, tenant: Optional[str], cls: Optional[str], cost: float,
+    def admit(self, tenant: Optional[str], cls: Optional[str], cost: float,  # graftlint: hot-path
               deadline_s: Optional[float], now: float) -> Decision:
         """The one hot-path entry: refill this tenant's bucket, run the
         three refusal gates (quota -> concurrency -> deadline), and
@@ -360,7 +360,7 @@ class OverloadController:
             return Decision(admitted=True, stage=self.stage,
                             tenant=tenant, cls=cls, tokens_left=level)
 
-    def release(self, decision: Decision, ok: bool,
+    def release(self, decision: Decision, ok: bool,  # graftlint: hot-path
                 ttfb_s: Optional[float], now: float,
                 engine_overloaded: bool = False) -> None:
         """Finish one admitted request: free the inflight slot, feed the

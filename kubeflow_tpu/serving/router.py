@@ -185,19 +185,19 @@ class _ProxyState:
         self.service_name = service_name
         self.namespace = namespace
         self.rr = 0
-        self.split_key: Optional[str] = None
-        self.credits: dict[str, int] = {}
+        self.split_key: Optional[str] = None  # guarded-by: lock
+        self.credits: dict[str, int] = {}  # guarded-by: lock
         # engine-aware routing: port -> (scraped_at, load) with a short TTL,
         # plus in-flight deltas so back-to-back requests don't pile onto the
         # replica whose scrape is momentarily stale
         # port -> (scraped_at, load | None): None = negative cache (replica
         # unreachable at scraped_at) so back-to-back requests don't re-eat
         # the scrape timeout inline until the TTL expires
-        self.loads: dict[int, tuple[float, Optional[float]]] = {}
-        self.pending: dict[int, int] = {}
+        self.loads: dict[int, tuple[float, Optional[float]]] = {}  # guarded-by: lock
+        self.pending: dict[int, int] = {}  # guarded-by: lock
         # ports some thread is currently scraping OUTSIDE the lock — other
         # threads must not block on (or duplicate) that network call
-        self.refreshing: set[int] = set()
+        self.refreshing: set[int] = set()  # guarded-by: lock
         # backends expose no engine gauges (non-engine runtime): cached so
         # plain round-robin services don't pay per-request scrape sweeps
         self.engineless_until = 0.0
@@ -211,7 +211,7 @@ class _ProxyState:
         # it remains the fallback for fabric-less fleets, whose only warm
         # state is the device-local cache this map approximates.
         # Insertion-ordered; capped in _pick_engine_aware.
-        self.affinity: dict[str, int] = {}
+        self.affinity: dict[str, int] = {}  # guarded-by: lock
         # fleet cache view (README "Fleet KV fabric"): replica name ->
         # last-known cache analytics + published fabric prefixes from
         # GET /engine/perf?view=cache — the GLOBAL cache state the
@@ -223,20 +223,20 @@ class _ProxyState:
         # (staleness-tolerant: a wrong placement costs one degraded pull,
         # never correctness); entries for pods that left the service are
         # PRUNED on every refresh.
-        self.cache_view: dict[str, dict] = {}
+        self.cache_view: dict[str, dict] = {}  # guarded-by: lock
         self.cache_view_at = 0.0     # monotonic time of the last refresh
         self.cache_refreshing = False  # single-flight background refresh
         # fleet fault tolerance: per-backend health records + the set of
         # ports some thread is actively probing outside the lock (single-
         # flight, same discipline as `refreshing` above)
-        self.health: dict[int, _BackendHealth] = {}
-        self.probing: set[int] = set()
+        self.health: dict[int, _BackendHealth] = {}  # guarded-by: lock
+        self.probing: set[int] = set()  # guarded-by: lock
         # sticky session routing (README "Disaggregated serving"): session
         # id -> the port whose engine pinned that session's KV.  Without
         # this, turn N+1 load-balances like any other request and can
         # land on a replica without the pinned pages — a silent cold
         # restore.  LRU-capped; pruned on pod churn like `health`.
-        self.sessions: dict[str, int] = {}
+        self.sessions: dict[str, int] = {}  # guarded-by: lock
         # incident plane (README "Incident plane"): per-service ingress
         # incident manager (wired by ServiceProxy._start — it needs the
         # proxy's hooks) + the health-FSM transition log its evidence
@@ -244,8 +244,8 @@ class _ProxyState:
         # _set_state_gauge, the one funnel every transition already
         # passes through.
         self.incidents = None
-        self.health_log: collections.deque = collections.deque(maxlen=256)
-        self.health_last: dict[int, str] = {}
+        self.health_log: collections.deque = collections.deque(maxlen=256)  # guarded-by: lock
+        self.health_last: dict[int, str] = {}  # guarded-by: lock
         # overload control (README "Overload control"): the service's
         # admission controller, built lazily from the overload annotation
         # (overload_key caches the raw annotation string so a rebuild
@@ -475,7 +475,7 @@ class ServiceProxy:
         ov_ttfb: Optional[float] = None
         saw_backpressure = False  # an ENGINE 503+Retry-After was relayed
         if ov is not None and handler.command == "POST":
-            decision = self._admit_overload(state, ov, handler, payload)
+            decision = self._admit_overload(state, ov, handler, payload)  # graftlint: acquires=inflight-slot
             if not decision.admitted:
                 return  # _admit_overload answered the 429
         try:
@@ -863,7 +863,7 @@ class ServiceProxy:
                 # overload evidence.  A bare 503 is NOT: the ingress'
                 # own no-backend reply and a draining replica's refusal
                 # must not drive the AIMD into brownout on an idle fleet.
-                ov.release(decision, ok=status < 500, ttfb_s=ov_ttfb,
+                ov.release(decision, ok=status < 500, ttfb_s=ov_ttfb,  # graftlint: releases=inflight-slot
                            now=time.monotonic(),
                            engine_overloaded=saw_backpressure)
                 self._drain_overload_events(state, ov)
@@ -1589,7 +1589,7 @@ class ServiceProxy:
             if (state.cache_refreshing
                     or now - state.cache_view_at < self._FABRIC_VIEW_TTL_S):
                 return
-            state.cache_refreshing = True
+            state.cache_refreshing = True  # graftlint: acquires=view-refresh
 
         def refresh() -> None:
             try:
@@ -1599,7 +1599,7 @@ class ServiceProxy:
             finally:
                 with state.lock:
                     state.cache_view_at = time.monotonic()
-                    state.cache_refreshing = False
+                    state.cache_refreshing = False  # graftlint: releases=view-refresh
 
         threading.Thread(target=refresh, daemon=True).start()
 
@@ -1825,7 +1825,7 @@ class ServiceProxy:
                                  service=state.service_name,
                                  backend=port, trace_ids=[])
 
-    def _set_state_gauge(self, state: _ProxyState) -> None:
+    def _set_state_gauge(self, state: _ProxyState) -> None:  # graftlint: holds-lock=lock
         counts = {s: 0 for s in _BACKEND_STATES}
         now = time.time()
         for port, h in state.health.items():
@@ -1881,64 +1881,74 @@ class ServiceProxy:
                 h = state.health.setdefault(p, _BackendHealth())
                 if (now - h.probed_at >= self._HEALTH_TTL
                         and p not in state.probing):
-                    state.probing.add(p)
+                    state.probing.add(p)  # graftlint: acquires=probe-claim
                     claimed.append(p)
         if not claimed:
             return
-        if len(claimed) == 1:
-            results = {claimed[0]: self._probe_engine_health(claimed[0])}
-        else:
-            # probe independently-failing backends concurrently: serial
-            # probing would charge the one claiming request up to
-            # N x _PROBE_TIMEOUT_S of latency before its relay starts
-            results = {}
+        results: dict[int, str] = {}
+        try:
+            if len(claimed) == 1:
+                results[claimed[0]] = self._probe_engine_health(claimed[0])
+            else:
+                # probe independently-failing backends concurrently: serial
+                # probing would charge the one claiming request up to
+                # N x _PROBE_TIMEOUT_S of latency before its relay starts
+                def probe(p=None):
+                    results[p] = self._probe_engine_health(p)
 
-            def probe(p=None):
-                results[p] = self._probe_engine_health(p)
-
-            ts = [threading.Thread(target=probe, kwargs={"p": p})
-                  for p in claimed]
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join()
-        with state.lock:
-            now = time.monotonic()
-            for p in claimed:
-                state.probing.discard(p)
-                h = state.health.setdefault(p, _BackendHealth())
-                h.probed_at = now
-                res = results[p]
-                if res == "ok":
-                    # a passing probe confirms the ENGINE is alive; it does
-                    # not erase passive strikes (a backend can report
-                    # SERVING while 500ing requests) and never reopens a
-                    # live breaker — ejection timing is the breaker's.
-                    # It heals probation (the half-open trial) and undoes
-                    # a drain that was cancelled.
-                    if h.state == "probation":
-                        h.state = "healthy"
+                ts = [threading.Thread(target=probe, kwargs={"p": p})
+                      for p in claimed]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+        finally:
+            # claimed ports MUST leave `probing` even if a probe (or a
+            # thread spawn) throws — a port stranded in the claim set is
+            # never probed again, freezing its health record forever
+            # (found by graftlint release-guarantee).  Release and
+            # write-back share ONE locked block: dropping the lock
+            # between them would let another request re-claim and
+            # re-probe a port whose probed_at was still unwritten.
+            with state.lock:
+                now = time.monotonic()
+                for p in claimed:
+                    state.probing.discard(p)  # graftlint: releases=probe-claim
+                    h = state.health.setdefault(p, _BackendHealth())
+                    h.probed_at = now
+                    if p not in results:
+                        continue  # probe never ran: retried next TTL
+                    res = results[p]
+                    if res == "ok":
+                        # a passing probe confirms the ENGINE is alive; it
+                        # does not erase passive strikes (a backend can
+                        # report SERVING while 500ing requests) and never
+                        # reopens a live breaker — ejection timing is the
+                        # breaker's.  It heals probation (the half-open
+                        # trial) and undoes a drain that was cancelled.
+                        if h.state == "probation":
+                            h.state = "healthy"
+                            h.fails = 0
+                            h.ejections = 0
+                        elif h.state == "draining":
+                            h.state = "healthy"
+                    elif res == "draining":
+                        # drain is an orderly goodbye, not a failure: stop
+                        # routing but charge no breaker strikes
+                        h.state = "draining"
                         h.fails = 0
-                        h.ejections = 0
-                    elif h.state == "draining":
-                        h.state = "healthy"
-                elif res == "draining":
-                    # drain is an orderly goodbye, not a failure: stop
-                    # routing but charge no breaker strikes
-                    h.state = "draining"
-                    h.fails = 0
-                elif res == "dead":
-                    # a DEAD engine needs no three strikes
-                    if h.state != "ejected":
-                        self._eject(state, h, p)
-                else:  # "fail": passive-style strike
-                    h.fails += 1
-                    if (h.state == "probation"
-                            or h.fails >= self._FAIL_THRESHOLD):
-                        self._eject(state, h, p)
-                    elif h.state == "healthy":
-                        h.state = "suspect"
-            self._set_state_gauge(state)
+                    elif res == "dead":
+                        # a DEAD engine needs no three strikes
+                        if h.state != "ejected":
+                            self._eject(state, h, p)
+                    else:  # "fail": passive-style strike
+                        h.fails += 1
+                        if (h.state == "probation"
+                                or h.fails >= self._FAIL_THRESHOLD):
+                            self._eject(state, h, p)
+                        elif h.state == "healthy":
+                            h.state = "suspect"
+                self._set_state_gauge(state)
 
     def _prune_health(self, state: _ProxyState, ports: list[int],
                       selector: dict) -> None:
@@ -2123,7 +2133,7 @@ class ServiceProxy:
                 ts_load = state.loads.get(port)
                 if ((ts_load is None or now - ts_load[0] >= self._LOAD_TTL)
                         and port not in state.refreshing):
-                    state.refreshing.add(port)
+                    state.refreshing.add(port)  # graftlint: acquires=load-claim
                     claimed[port] = state.pending.get(port, 0)
         scraped: dict[int, Optional[dict]] = {}
         engineless = False
@@ -2136,7 +2146,7 @@ class ServiceProxy:
             with state.lock:
                 now = time.monotonic()
                 for port in claimed:
-                    state.refreshing.discard(port)
+                    state.refreshing.discard(port)  # graftlint: releases=load-claim
                     m = scraped.get(port)
                     if m is None:
                         # negative cache: unreachable replicas are excluded
@@ -2270,16 +2280,21 @@ class ServiceProxy:
         if not live:
             return None  # no split recorded: any revision
         # smooth weighted round-robin (nginx algorithm): deterministic AND
-        # interleaved, so a 20% canary sees ~1-in-5 requests from the start
+        # interleaved, so a 20% canary sees ~1-in-5 requests from the start.
+        # Under state.lock: concurrent handler threads otherwise lose
+        # credit increments (skewing the split) and can KeyError when a
+        # traffic change swaps the credits dict mid-update (found by
+        # graftlint lock-discipline)
         key = json.dumps(live, sort_keys=True)
-        if state.split_key != key:
-            state.split_key = key
-            state.credits = {r: 0 for r in live}
-        total = sum(live.values())
-        for r, w in live.items():
-            state.credits[r] += w
-        best = max(sorted(live), key=lambda r: state.credits[r])
-        state.credits[best] -= total
+        with state.lock:
+            if state.split_key != key:
+                state.split_key = key
+                state.credits = {r: 0 for r in live}
+            total = sum(live.values())
+            for r, w in live.items():
+                state.credits[r] += w
+            best = max(sorted(live), key=lambda r: state.credits[r])
+            state.credits[best] -= total
         return best
 
     def _ready_pods(self, ns: str, selector: dict, revision: Optional[str]) -> list[Obj]:

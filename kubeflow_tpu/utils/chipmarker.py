@@ -28,8 +28,11 @@ def write_marker(marker_path: str, src_path: str, extra: dict | None = None) -> 
            "kernel_sha": source_sha(src_path)}
     if extra:
         rec.update(extra)
-    with open(marker_path, "w") as f:
+    # tmp+os.replace: marker_valid() reads this back across runs — a torn
+    # marker silently re-queues chip validation (graftlint atomic-write)
+    with open(marker_path + ".tmp", "w") as f:
         json.dump(rec, f, indent=1)
+    os.replace(marker_path + ".tmp", marker_path)
 
 
 def marker_valid(marker_path: str, src_path: str) -> bool:

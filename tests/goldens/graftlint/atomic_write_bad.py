@@ -1,0 +1,6 @@
+import json
+
+
+def save_state(path, state):
+    with open(path, "w") as f:
+        json.dump(state, f)

@@ -1,0 +1,7 @@
+class Router:
+    def __init__(self):
+        self.per_tenant_credit: dict = {}
+
+    def note(self, tenant):
+        self.per_tenant_credit[tenant] = \
+            self.per_tenant_credit.get(tenant, 0) + 1

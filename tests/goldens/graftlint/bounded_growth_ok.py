@@ -1,0 +1,11 @@
+class Router:
+    def __init__(self):
+        self.per_tenant_credit: dict = {}
+
+    def note(self, tenant):
+        self.per_tenant_credit[tenant] = \
+            self.per_tenant_credit.get(tenant, 0) + 1
+
+    def prune(self, live):
+        for t in [t for t in self.per_tenant_credit if t not in live]:
+            self.per_tenant_credit.pop(t)

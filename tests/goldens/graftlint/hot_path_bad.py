@@ -1,0 +1,13 @@
+import json
+import threading
+
+LOCK = threading.Lock()
+TABLE: dict = {}
+
+
+def observe(raw):  # graftlint: hot-path
+    body = json.loads(raw)
+    with LOCK:
+        for k, v in TABLE.items():
+            body[k] = v
+    return body

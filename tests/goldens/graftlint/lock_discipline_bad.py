@@ -1,0 +1,14 @@
+import threading
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows: dict = {}  # guarded-by: _lock
+
+    def put(self, k, v):
+        with self._lock:
+            self._rows[k] = v
+
+    def get(self, k):
+        return self._rows.get(k)

@@ -1,0 +1,8 @@
+from .registry import REGISTRY
+
+TOKENS = REGISTRY.gauge("tenant_tokens", "per-tenant bucket level")
+
+
+def on_admit(tenant, level):
+    TOKENS.set(level, tenant=tenant)
+    PHANTOM.inc(tenant=tenant)

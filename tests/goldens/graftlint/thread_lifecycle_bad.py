@@ -1,0 +1,10 @@
+import threading
+
+
+class Poller:
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass

@@ -1,0 +1,21 @@
+import threading
+
+
+class Poller:
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self._t.join(timeout=5)
+
+    def _run(self):
+        pass
+
+
+def fan_out(jobs):
+    ts = [threading.Thread(target=j) for j in jobs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()

@@ -1,0 +1,243 @@
+"""graftlint tests (ISSUE 15, kubeflow_tpu/tools/graftlint/).
+
+Coverage per the satellite list:
+
+  * one golden fixture PAIR per rule — a violating snippet that must
+    fire and a clean sibling that must not (tests/goldens/graftlint/);
+  * suppression semantics: a reasoned '# graftlint: disable=... -- why'
+    silences exactly its rule; a reasonless one is itself a finding;
+  * baseline semantics: fingerprints written by write_baseline mask
+    existing findings but NOT new instances, and survive line drift;
+  * JSON output schema (the machine surface bench.py's sidebar reads);
+  * the ZERO-FINDINGS GATE over the live kubeflow_tpu/ tree — the
+    tier-1 enforcement point for every invariant the rules encode —
+    plus the < 10s analyzer wall-time budget;
+  * the import-time budget regression test: the router import a POD
+    subprocess pays stays under budget, pinning the PR 14 cold-start
+    fix independently of the import-weight rule;
+  * CLI exit codes (0 clean / 1 findings).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.tools.graftlint import (ALL_RULES, analyze,
+                                          default_root, rule_table,
+                                          write_baseline)
+
+pytestmark = pytest.mark.analysis
+
+GOLDENS = os.path.join(os.path.dirname(__file__), "goldens", "graftlint")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# rule -> (bad fixture, expected minimum findings, ok fixture)
+FIXTURE_PAIRS = {
+    "lock-discipline": ("lock_discipline_bad.py", 1, "lock_discipline_ok.py"),
+    "release-guarantee": ("release_guarantee_bad.py", 1,
+                          "release_guarantee_ok.py"),
+    "hot-path": ("hot_path_bad.py", 2, "hot_path_ok.py"),
+    "bounded-growth": ("bounded_growth_bad.py", 1, "bounded_growth_ok.py"),
+    "atomic-write": ("atomic_write_bad.py", 1, "atomic_write_ok.py"),
+    "metric-hygiene": ("metric_hygiene_bad.py", 2, "metric_hygiene_ok.py"),
+    "thread-lifecycle": ("thread_lifecycle_bad.py", 1,
+                         "thread_lifecycle_ok.py"),
+}
+
+
+def _run(path, **kw):
+    return analyze(paths=[os.path.join(GOLDENS, path)], use_baseline=False,
+                   **kw)
+
+
+# ------------------------------------------------------------- rule fixtures
+
+@pytest.mark.parametrize("rule", sorted(FIXTURE_PAIRS))
+def test_rule_fires_on_violating_fixture(rule):
+    bad, n, _ = FIXTURE_PAIRS[rule]
+    found = [f for f in _run(bad).unsuppressed if f.rule == rule]
+    assert len(found) >= n, f"{rule} missed its violating fixture"
+    for f in found:
+        assert f.line > 0 and f.message and f.fingerprint
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURE_PAIRS))
+def test_rule_passes_clean_fixture(rule):
+    _, _, ok = FIXTURE_PAIRS[rule]
+    found = [f for f in _run(ok).unsuppressed if f.rule == rule]
+    assert found == [], f"{rule} false-positived on its clean fixture"
+
+
+def test_import_weight_pair():
+    """The import-weight rule needs a package tree: a fake kubeflow_tpu
+    whose router chain pulls numpy at module scope fires; the sibling
+    module doing the lazy function-scope import never enters the graph
+    as a violation."""
+    root = os.path.join(GOLDENS, "import_tree", "kubeflow_tpu")
+    r = analyze(root=root, use_baseline=False)
+    hits = [f for f in r.unsuppressed if f.rule == "import-weight"]
+    assert len(hits) == 1
+    assert hits[0].path.endswith("helper.py")
+    assert "numpy" in hits[0].message
+    assert "router" in hits[0].message  # the witness chain names the root
+    assert not any(f.path.endswith("lazy_ok.py") for f in r.unsuppressed)
+
+
+# ------------------------------------------------- suppressions and baseline
+
+def test_reasoned_suppression_silences_and_counts():
+    r = _run("suppressed_ok.py")
+    assert r.unsuppressed == []
+    sup = [f for f in r.findings if f.suppressed]
+    assert len(sup) == 1 and sup[0].rule == "atomic-write"
+
+
+def test_reasonless_suppression_is_a_finding():
+    r = _run("suppression_noreason_bad.py")
+    rules = {f.rule for f in r.unsuppressed}
+    # the naked disable does NOT suppress, and is flagged itself
+    assert "suppression-syntax" in rules
+    assert "atomic-write" in rules
+
+
+def test_baseline_masks_old_not_new(tmp_path):
+    """Fingerprints are (rule, path, source line, occurrence) — so the
+    baseline masks the grandfathered write in a file but NOT a second,
+    textually identical one added later to the same file."""
+    src = open(os.path.join(GOLDENS, "atomic_write_bad.py")).read()
+    bad = tmp_path / "state.py"
+    bad.write_text(src)
+    r1 = analyze(paths=[str(bad)], use_baseline=False)
+    assert r1.unsuppressed
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), r1.unsuppressed)
+    r2 = analyze(paths=[str(bad)], baseline_path=str(bl))
+    assert r2.unsuppressed == []
+    assert any(f.baselined for f in r2.findings)
+    # append a SECOND bare write (same source text, occurrence index 1)
+    bad.write_text(src + "\n\ndef save_more(path, state):\n"
+                   "    with open(path, \"w\") as f:\n"
+                   "        json.dump([state], f)\n")
+    r3 = analyze(paths=[str(bad)], baseline_path=str(bl))
+    live = [f for f in r3.unsuppressed if f.rule == "atomic-write"]
+    assert len(live) == 1  # the old one is baselined, the new one is not
+
+
+def test_baseline_fingerprint_survives_line_drift(tmp_path):
+    src = open(os.path.join(GOLDENS, "atomic_write_bad.py")).read()
+    bad = tmp_path / "state.py"
+    bad.write_text(src)
+    r1 = analyze(paths=[str(bad)], use_baseline=False)
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), r1.unsuppressed)
+    # shift every line down: same content and path, new line numbers
+    bad.write_text("# a new leading comment\n# another\n" + src)
+    r2 = analyze(paths=[str(bad)], baseline_path=str(bl))
+    assert [f for f in r2.unsuppressed if f.rule == "atomic-write"] == []
+
+
+# ------------------------------------------------------------- JSON contract
+
+def test_json_report_schema():
+    r = _run("atomic_write_bad.py")
+    d = r.to_dict()
+    assert d["version"] == 1
+    assert d["files_analyzed"] == 1
+    assert isinstance(d["elapsed_s"], float)
+    assert d["counts"]["unsuppressed"] == len(d["findings"]) > 0
+    f = d["findings"][0]
+    assert set(f) == {"rule", "path", "line", "message", "fingerprint",
+                      "suppressed", "baselined"}
+    json.dumps(d)  # round-trips
+
+
+def test_rule_table_covers_all_rules():
+    rows = rule_table()
+    assert {r[0] for r in rows} == {cls.name for cls in ALL_RULES}
+    for name, invariant, history in rows:
+        assert invariant and history, f"{name} missing docs"
+
+
+def test_readme_rule_table_conformance():
+    """The README 'Static analysis' rule table and the registry pin each
+    other (the test_metrics_conformance pattern): every registered rule
+    is documented, every documented rule exists."""
+    readme = open(os.path.join(REPO, "README.md")).read()
+    start = readme.index("## Static analysis")
+    section = readme[start:readme.index("\n## ", start + 1)]
+    documented = set(re.findall(r"^\| `([\w\-]+)` \|", section,
+                                flags=re.MULTILINE))
+    registered = {cls.name for cls in ALL_RULES}
+    assert registered - documented == set(), \
+        "rules missing from the README table"
+    assert documented - registered == set(), \
+        "README documents rules the registry does not have"
+
+
+# ------------------------------------------------------------ the live gate
+
+def test_live_tree_zero_findings_under_budget():
+    """THE tier-1 gate: graftlint over all of kubeflow_tpu/ — zero
+    unsuppressed findings, zero parse errors, < 10s wall."""
+    r = analyze()
+    assert r.parse_errors == []
+    assert r.files_analyzed > 100
+    msgs = [f.render() for f in r.unsuppressed]
+    assert msgs == [], "graftlint findings in the live tree:\n" + \
+        "\n".join(msgs)
+    assert r.elapsed_s < 10.0, f"analyzer took {r.elapsed_s:.1f}s"
+
+
+def test_live_tree_suppressions_all_carry_reasons():
+    """Reasonless suppressions surface as suppression-syntax findings,
+    which the gate above fails — this pins the count explicitly so a
+    suppression sneaking in without a reason names THIS contract."""
+    r = analyze()
+    assert [f for f in r.findings
+            if f.rule == "suppression-syntax"] == []
+
+
+def test_cli_exit_codes():
+    env = {**os.environ, "PYTHONPATH": REPO}
+    ok = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.tools.graftlint", "--json"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    out = json.loads(ok.stdout)
+    assert out["counts"]["unsuppressed"] == 0
+    bad = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.tools.graftlint",
+         os.path.join(GOLDENS, "atomic_write_bad.py")],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert bad.returncode == 1
+
+
+# ------------------------------------------------------ import-time budget
+
+ROUTER_IMPORT_BUDGET_S = 1.0  # measured 0.30s; the PR 14 regression hit
+#                               1.26s and blew the 1.5s activation grace
+
+
+def test_router_import_time_budget():
+    """Subprocess wall-clock of the exact import every POD pays at
+    scale-from-zero.  Best-of-3 damps box-load noise; the budget sits
+    3x above today's measurement and below the historical regression."""
+    best = min(_timed_router_import() for _ in range(3))
+    assert best < ROUTER_IMPORT_BUDGET_S, (
+        f"import kubeflow_tpu.serving.router took {best:.2f}s — heavy "
+        f"imports are leaking onto the POD import chain (see the "
+        f"graftlint import-weight rule)")
+
+
+def _timed_router_import() -> float:
+    t0 = time.perf_counter()
+    subprocess.run(
+        [sys.executable, "-c", "import kubeflow_tpu.serving.router"],
+        check=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+    return time.perf_counter() - t0
