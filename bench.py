@@ -672,6 +672,35 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["fabric"] = {"error": str(e)[:200]}
     try:
+        # mesh-sharded data-plane sidebar: serving_bench --sharded's
+        # headline (BENCH_SHARDED.json) — per-degree byte-identity vs the
+        # TP=1 oracle, the gather-free snapshot audit (largest per-shard
+        # host block over unified pool bytes), handoff match/reshard
+        # engagement, fabric cross-degree hits, leaks, per-mesh MFU rows
+        # under their xN-suffixed TP-honest labels
+        sh_path = os.path.join(REPO, "BENCH_SHARDED.json")
+        if os.path.exists(sh_path):
+            with open(sh_path) as f:
+                sh = json.loads(f.readline())
+            aud = sh.get("snapshot_audit") or {}
+            out["sharded"] = {
+                "degrees": sh.get("degrees"),
+                "byte_identical": sh.get("byte_identical"),
+                "gather_free": aud.get("gather_free"),
+                "max_shard_over_unified": {
+                    k: v.get("max_shard_over_unified")
+                    for k, v in aud.items() if isinstance(v, dict)},
+                "handoff": sh.get("handoff"),
+                "fabric_hits": (sh.get("fabric") or {}).get("hits"),
+                "kv_pages_leaked": sh.get("kv_pages_leaked"),
+                "mfu_by_mesh": {
+                    r.get("platform"): r.get("mfu")
+                    for r in sh.get("mfu_rows") or []},
+                "platform": sh.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["sharded"] = {"error": str(e)[:200]}
+    try:
         # incident-plane sidebar: serving_bench --incidents's headline
         # (BENCH_INCIDENTS.json) — the taxonomy replay verdict (one
         # correctly-classified incident per injected fault class), the

@@ -248,6 +248,19 @@ JOBS = [
                                   "--out",
                                   os.path.join(REPO, "BENCH_FABRIC.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # mesh-sharded KV data plane (ISSUE 16): the gate ALWAYS forces the
+    # 8-virtual-device CPU host (it is a data-plane correctness/bytes
+    # audit, not a throughput measure — TP=2/TP=4 meshes must exist even
+    # on a single-chip box), so running it from the chip loop just keeps
+    # BENCH_SHARDED.json fresh alongside the chip artifacts: per-degree
+    # byte-identity vs the TP=1 oracle, the gather-free per-shard
+    # snapshot audit, handoff match+reshard and fabric cross-degree
+    # roundtrips, per-mesh TP-honest MFU rows
+    {"name": "serving_sharded_tiny",
+     "cmd": _serving_cmd("tiny", ["--sharded", "--out",
+                                  os.path.join(REPO,
+                                               "BENCH_SHARDED.json")]),
+     "timeout": 1200, "first_timeout": 900},
     # perf introspection on a real chip (ISSUE 11): the first drained run
     # records platform=tpu MFU/goodput rows from the new plane — the
     # analytical serving MFU divides by the REAL v5e peak instead of the

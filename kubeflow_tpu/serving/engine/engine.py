@@ -91,8 +91,9 @@ from ..kvfabric import FabricStore, fabric_key
 from ..slo import SloConfig, SloTracker
 from .faults import (ChaosInjector, FabricChaos, FabricFaultConfig,
                      FaultConfig, HandoffChaos, HandoffFaultConfig)
-from .kvstore import (KVStoreConfig, TieredKVStore, normalize_session_id,
-                      pack_frame)
+from .kvstore import (KVStoreConfig, TieredKVStore, blob_degree,
+                      normalize_session_id, pack_frame, pack_sharded_frame,
+                      reshard_blob)
 from .perf import (CacheStats, FlopsModel, PerfLedger, ProfileStore,
                    TickTimeline, WASTE_REASONS, platform_peak_flops)
 from .scheduler import (PRIORITY_RANK, QosScheduler, QueueEntry,
@@ -2379,10 +2380,80 @@ class Engine:
         self._prefilling[slot] = off
         self._prefill_rows[slot] = self.batcher.slot_pages(slot)
 
+    def _snapshot_pages(self, pages: np.ndarray) -> tuple:
+        """Host snapshot of the pools' ``pages`` -> ``(blob, nbytes)`` —
+        the ONE device->host primitive behind swap park, session pin,
+        handoff export and fabric publish.  TP=1 returns the legacy
+        unified ``(k, v)`` tuple.  TP>1 returns a per-shard LIST of
+        ``(k, v)`` pytrees in kv-head order: each shard's pages snapshot
+        from that shard's OWN addressable data, so the device->host copy
+        moves one shard's bytes per chip and no pool-sized gathered
+        buffer (and no cross-chip collective) ever materializes."""
+        tree = self._jax.tree_util
+        if self._mesh is None:
+            fetch = lambda leaf: np.asarray(leaf[:, pages])  # noqa: E731
+            blob = (tree.tree_map(fetch, self.k_pool),
+                    tree.tree_map(fetch, self.v_pool))
+            return blob, sum(leaf.nbytes for leaf in tree.tree_leaves(blob))
+        from .sharding import snapshot_shards
+
+        tp = self.ec.tensor_parallel
+        k_leaves, k_def = tree.tree_flatten(self.k_pool)
+        v_leaves, v_def = tree.tree_flatten(self.v_pool)
+        k_blocks = [snapshot_shards(leaf, pages) for leaf in k_leaves]
+        v_blocks = [snapshot_shards(leaf, pages) for leaf in v_leaves]
+        blob = [(k_def.unflatten([b[i] for b in k_blocks]),
+                 v_def.unflatten([b[i] for b in v_blocks]))
+                for i in range(tp)]
+        nbytes = sum(leaf.nbytes for leaf in tree.tree_leaves(blob))
+        self.telemetry.count_kv_shard_bytes("export", nbytes)
+        return blob, nbytes
+
+    def _scatter_pages(self, pages: np.ndarray, blob, lo: int,
+                       hi: int) -> None:
+        """Write a host KV blob's page range ``[lo, hi)`` into the pools
+        at device ``pages`` — the ONE host->device primitive behind every
+        restore.  Layout contract: a blob whose mesh degree matches this
+        engine scatters shard-to-shard (each block device_puts straight
+        to its shard); a mismatched degree is resharded host-side first —
+        the EXPLICIT slow path, counted under engine_kv_reshard_total,
+        never silent garbage."""
+        tree = self._jax.tree_util
+        tp = 1 if self._mesh is None else self.ec.tensor_parallel
+        if blob_degree(blob) != tp:
+            blob = reshard_blob(blob, tp)
+            self.telemetry.count_reshard("reshard")
+        elif tp > 1:
+            self.telemetry.count_reshard("match")
+        if tp == 1:
+            if isinstance(blob, list):  # degree-1 shard list: unwrap
+                blob = blob[0]
+            jnp = self._jnp
+            put = lambda pool, host: pool.at[:, pages].set(  # noqa: E731
+                jnp.asarray(np.ascontiguousarray(host[:, lo:hi])))
+            blob_k, blob_v = blob
+            self.k_pool = tree.tree_map(put, self.k_pool, blob_k)
+            self.v_pool = tree.tree_map(put, self.v_pool, blob_v)
+            return
+        from .sharding import scatter_shards
+
+        k_host = [tree.tree_flatten(shard[0])[0] for shard in blob]
+        v_host = [tree.tree_flatten(shard[1])[0] for shard in blob]
+        nbytes = 0
+        for pool_attr, host in (("k_pool", k_host), ("v_pool", v_host)):
+            leaves, treedef = tree.tree_flatten(getattr(self, pool_attr))
+            out = []
+            for li, leaf in enumerate(leaves):
+                blocks = [host[s][li][:, lo:hi] for s in range(tp)]
+                nbytes += sum(b.nbytes for b in blocks)
+                out.append(scatter_shards(leaf, pages, blocks, self._mesh))
+            setattr(self, pool_attr, treedef.unflatten(out))
+        self.telemetry.count_kv_shard_bytes("restore", nbytes)
+
     def _scatter_prefix(self, slot: int, blob, covered: int,
                         usable: int) -> None:
         """Scatter a verified host KV blob's pages ``[covered, usable)``
-        into the slot's freshly-allocated page row — the ONE device-side
+        into the slot's freshly-allocated page row — the device-side
         restore primitive behind session restore and fabric fault-in
         (both verify hashes first; this is the part that rebinds pools).
         The slot owns every page in the row, so the ``.set`` can never
@@ -2390,16 +2461,7 @@ class Engine:
         row = self.batcher.slot_pages(slot)
         pages = np.ascontiguousarray(row[covered:usable])
         self._check_epoch()  # last fence before rebinding device pools
-        jnp = self._jnp
-        tree_map = self._jax.tree_util.tree_map
-
-        def put(pool, host):
-            return pool.at[:, pages].set(jnp.asarray(
-                np.ascontiguousarray(host[:, covered:usable])))
-
-        blob_k, blob_v = blob
-        self.k_pool = tree_map(put, self.k_pool, blob_k)
-        self.v_pool = tree_map(put, self.v_pool, blob_v)
+        self._scatter_pages(pages, blob, covered, usable)
 
     def _restore_session(self, slot: int, pending: _Pending,
                          cached: int) -> int:
@@ -2542,8 +2604,7 @@ class Engine:
         the slot's freshly allocated pages and rebind the host mirrors —
         the slot rejoins decode exactly where it left off (seq_len, page
         row, last committed token), byte-identical under greedy."""
-        (blob_k, blob_v), nbytes = item
-        jnp = self._jnp
+        blob, nbytes = item
         L = pending.resume_len
         owned = self._pages_for(L)
         # the blob's own page count may run ONE page short of owned for a
@@ -2552,20 +2613,17 @@ class Engine:
         # export couldn't include it) — scatter what the blob covers; the
         # submit allocated the full row, and position L-1's KV is written
         # by the first decode step before anything reads it
-        nblob = int(next(iter(self._jax.tree_util.tree_leaves(blob_k)))
+        first_k = blob[0][0] if isinstance(blob, list) else blob[0]
+        nblob = int(next(iter(self._jax.tree_util.tree_leaves(first_k)))
                     .shape[1])
         cov = min(owned, nblob)
         # swap submits carry no prefix hashes, so every page here is
-        # freshly owned by this slot — the .set below can never write a
+        # freshly owned by this slot — the scatter can never write a
         # shared prefix-cache page
         row = self.batcher.slot_pages(slot)
         pages = np.ascontiguousarray(row[:cov])
         self._check_epoch()  # last fence before rebinding device pools
-        tree_map = self._jax.tree_util.tree_map
-        put = lambda pool, host: pool.at[:, pages].set(  # noqa: E731
-            jnp.asarray(np.ascontiguousarray(host[:, :cov])))
-        self.k_pool = tree_map(put, self.k_pool, blob_k)
-        self.v_pool = tree_map(put, self.v_pool, blob_v)
+        self._scatter_pages(pages, blob, 0, cov)
         pending.swapped = False
         if pending.handoff_import:
             if pending.span is not None:
@@ -2746,12 +2804,7 @@ class Engine:
         nbytes = 0
         if mode == "swap" and owned > 0:
             pages = np.ascontiguousarray(row)
-            tree_map = self._jax.tree_util.tree_map
-            fetch = lambda leaf: np.asarray(leaf[:, pages])  # noqa: E731
-            blob = (tree_map(fetch, self.k_pool),
-                    tree_map(fetch, self.v_pool))
-            nbytes = sum(leaf.nbytes for leaf in
-                         self._jax.tree_util.tree_leaves(blob))
+            blob, nbytes = self._snapshot_pages(pages)
             if self._kv.put_swap(rid, blob, nbytes):
                 self.telemetry.count_swap("out", nbytes)
             else:
@@ -4123,15 +4176,19 @@ class Engine:
         t0 = time.perf_counter()
         try:
             row = np.ascontiguousarray(self._pt_host[slot, :owned])
-            tree_map = self._jax.tree_util.tree_map
-            fetch = lambda leaf: np.asarray(leaf[:, row])  # noqa: E731
-            blob = (tree_map(fetch, self.k_pool),
-                    tree_map(fetch, self.v_pool))
+            blob, _ = self._snapshot_pages(row)
             meta = {"resume_len": L, "page_size": self.ec.page_size,
                     "pages": owned, "adapter_id": pending.adapter_id,
                     "generated": list(pending.generated)}
-            data, nbytes, _ = pack_frame(f"handoff/{pending.rid}", blob,
-                                         meta)
+            if self._mesh is not None:
+                # shard-native wire frame: per-sub-frame CRCs, degree in
+                # meta so the importer can verify layout compatibility
+                meta["tp"] = self.ec.tensor_parallel
+                data, nbytes, _ = pack_sharded_frame(
+                    f"handoff/{pending.rid}", blob, meta)
+            else:
+                data, nbytes, _ = pack_frame(f"handoff/{pending.rid}",
+                                             blob, meta)
             ttl = None
             if (self._handoff_chaos is not None
                     and self._handoff_chaos.expire_export()):
@@ -4196,14 +4253,16 @@ class Engine:
                 fps = self.fabric_fingerprinter(
                     pending.context[:covered * ps]) or []
             row = np.ascontiguousarray(self._pt_host[slot, :covered])
-            tree_map = self._jax.tree_util.tree_map
-            fetch = lambda leaf: np.asarray(leaf[:, row])  # noqa: E731
-            blob = (tree_map(fetch, self.k_pool),
-                    tree_map(fetch, self.v_pool))
+            blob, _ = self._snapshot_pages(row)
             meta = {"hashes": [int(h) for h in hashes], "pages": covered,
                     "page_size": ps, "adapter_id": pending.adapter_id,
                     "model": self.fabric_model_id, "fps": fps}
-            data, nbytes, _ = pack_frame(f"fabric/{key}", blob, meta)
+            if self._mesh is not None:
+                meta["tp"] = self.ec.tensor_parallel
+                data, nbytes, _ = pack_sharded_frame(f"fabric/{key}",
+                                                     blob, meta)
+            else:
+                data, nbytes, _ = pack_frame(f"fabric/{key}", blob, meta)
             ttl = None
             if (self._fabric_chaos is not None
                     and self._fabric_chaos.expire_publish()):
@@ -4299,18 +4358,18 @@ class Engine:
         t0 = time.perf_counter()
         try:
             row = np.ascontiguousarray(self._pt_host[slot, :covered])
-            tree_map = self._jax.tree_util.tree_map
-            fetch = lambda leaf: np.asarray(leaf[:, row])  # noqa: E731
-            blob = (tree_map(fetch, self.k_pool),
-                    tree_map(fetch, self.v_pool))
-            nbytes = sum(leaf.nbytes for leaf in
-                         self._jax.tree_util.tree_leaves(blob))
+            blob, nbytes = self._snapshot_pages(row)
             hashes = self._page_hashes(pending.context,
                                        pending.adapter_id)[:covered]
             meta = {"hashes": [int(h) for h in hashes],
                     "context_len": len(pending.context),
                     "adapter_id": pending.adapter_id,
                     "pages": covered}
+            if self._mesh is not None:
+                # per-shard list blobs flatten natively into the store's
+                # version-1 page files; the degree rides in meta so a
+                # restore at another degree reshards explicitly
+                meta["tp"] = self.ec.tensor_parallel
             res = self._kv.pin_session(sid, blob, nbytes, meta)
         except Exception as exc:  # noqa: BLE001 — pin must not fail the turn
             self.telemetry.count_session_pin("rejected")

@@ -71,6 +71,8 @@ here too, or the chaos-replay validator cannot gate it.
 from __future__ import annotations
 
 import dataclasses
+import json
+import struct
 import threading
 import time
 from typing import Optional, Tuple
@@ -106,6 +108,12 @@ EXPECTED_INCIDENT_CAUSES = {
     "handoff:slow_pull": "handoff_degradation",
     "handoff:dead_link": "handoff_degradation",
     "handoff:expired_export": "handoff_degradation",
+    # sharded-frame chaos (README "Sharded serving"): ONE corrupted
+    # sub-frame of a tensor-parallel frame degrades the whole import —
+    # exactly like a torn unified frame, caught by the per-shard verifier
+    "handoff:shard_torn_pull": "handoff_degradation",
+    "handoff:shard_flip_pull": "handoff_degradation",
+    "handoff:shard_drop_pull": "handoff_degradation",
     # fabric scope (FabricFaultConfig): every pull/publish fault degrades
     # the prefix fault-in to plain re-prefill
     "fabric:torn_pull": "fabric_degradation",
@@ -113,6 +121,9 @@ EXPECTED_INCIDENT_CAUSES = {
     "fabric:slow_pull": "fabric_degradation",
     "fabric:dead_link": "fabric_degradation",
     "fabric:expired_publish": "fabric_degradation",
+    "fabric:shard_torn_pull": "fabric_degradation",
+    "fabric:shard_flip_pull": "fabric_degradation",
+    "fabric:shard_drop_pull": "fabric_degradation",
     # storm scope (StormFaultConfig): a traffic storm against the ingress
     # overload controller surfaces as aggregated shed bursts + brownout
     # stage transitions — ONE self-resolving capacity incident, not an
@@ -379,6 +390,52 @@ class StorageChaos:
 # ------------------------------------------------------------- handoff scope
 
 
+def _shard_regions(data: bytes) -> list:
+    """``(offset, length)`` of each sub-frame in a version-2 sharded KVPG
+    frame; ``[]`` for legacy frames and torn streams.  A minimal local
+    parser of the outer header's shard table — kvstore.py imports this
+    module, so the real parser cannot be imported here — used by the
+    shard-level injectors to corrupt exactly ONE sub-frame while leaving
+    the outer stream length intact (so only the per-shard verifier, not
+    the outer length check, can catch it)."""
+    if len(data) < 12 or data[:4] != b"KVPG":
+        return []
+    ver, hlen = struct.unpack("<II", data[4:12])
+    if ver != 2 or len(data) < 12 + hlen:
+        return []
+    try:
+        shards = json.loads(data[12:12 + hlen]).get("shards") or []
+    except (ValueError, AttributeError):
+        return []
+    out, off = [], 12 + hlen
+    for n in shards:
+        out.append((off, int(n)))
+        off += int(n)
+    return out if out and off <= len(data) else []
+
+
+def _corrupt_shard(data: bytes, n: int, torn: bool, flip: bool,
+                   drop: bool) -> bytes:
+    """Corrupt one sub-frame of a sharded frame (pull ordinal ``n`` picks
+    which, deterministically): torn zeroes the tail half (its length/CRC
+    verifier fails exactly like a torn unified frame), flip flips one
+    payload bit (CRC32 catches it), drop zeroes the whole sub-frame (its
+    magic fails).  Legacy frames pass through untouched — the unified
+    injectors cover those."""
+    regions = _shard_regions(data)
+    if not regions:
+        return data
+    off, ln = regions[n % len(regions)]
+    out = bytearray(data)
+    if torn:
+        out[off + ln // 2:off + ln] = bytes(ln - ln // 2)
+    elif flip:
+        out[off + ln - 3] ^= 0x20
+    elif drop:
+        out[off:off + ln] = bytes(ln)
+    return bytes(out)
+
+
 @dataclasses.dataclass(frozen=True)
 class HandoffFaultConfig:
     """Seeded fault plan for the disaggregated prefill/decode KV handoff
@@ -406,6 +463,17 @@ class HandoffFaultConfig:
     # replica's pull finds the handle expired
     expire_export_on: int = -1
     expire_export_every: int = 0
+    # sharded frames (README "Sharded serving"): corrupt ONE sub-frame of
+    # the Nth pulled version-2 frame — torn (tail half zeroed), flipped
+    # (one payload bit), or dropped (whole sub-frame zeroed) — leaving
+    # the outer stream intact, so ONLY the per-shard verifier can catch
+    # it; legacy frames pass through untouched
+    shard_torn_pull_on: int = -1
+    shard_torn_pull_every: int = 0
+    shard_flip_pull_on: int = -1
+    shard_flip_pull_every: int = 0
+    shard_drop_pull_on: int = -1
+    shard_drop_pull_every: int = 0
 
 
 class HandoffChaos:
@@ -424,6 +492,7 @@ class HandoffChaos:
         self.injected_slow_pulls = 0
         self.injected_dead_links = 0
         self.injected_expired_exports = 0
+        self.injected_shard_faults = 0
 
     @staticmethod
     def _hit(n: int, on: int, every: int) -> bool:
@@ -445,10 +514,20 @@ class HandoffChaos:
             torn = self._hit(n, c.torn_pull_on, c.torn_pull_every)
             if torn:
                 self.injected_torn_pulls += 1
+            s_torn = self._hit(n, c.shard_torn_pull_on,
+                               c.shard_torn_pull_every)
+            s_flip = self._hit(n, c.shard_flip_pull_on,
+                               c.shard_flip_pull_every)
+            s_drop = self._hit(n, c.shard_drop_pull_on,
+                               c.shard_drop_pull_every)
+            if s_torn or s_flip or s_drop:
+                self.injected_shard_faults += 1
         if slow:
             time.sleep(c.slow_pull_s)
         if torn:
             return data[:max(8, len(data) // 2)]
+        if s_torn or s_flip or s_drop:
+            return _corrupt_shard(data, n, s_torn, s_flip, s_drop)
         return data
 
     def expire_export(self) -> bool:
@@ -470,6 +549,7 @@ class HandoffChaos:
                 "injected_slow_pulls": self.injected_slow_pulls,
                 "injected_dead_links": self.injected_dead_links,
                 "injected_expired_exports": self.injected_expired_exports,
+                "injected_shard_faults": self.injected_shard_faults,
             }
 
 
@@ -504,6 +584,16 @@ class FabricFaultConfig:
     # pull finds the entry expired
     expire_publish_on: int = -1
     expire_publish_every: int = 0
+    # sharded frames (README "Sharded serving"): corrupt ONE sub-frame of
+    # the Nth pulled version-2 frame — torn / flipped / dropped — leaving
+    # the outer stream intact, so ONLY the per-shard verifier can catch
+    # it; legacy frames pass through untouched
+    shard_torn_pull_on: int = -1
+    shard_torn_pull_every: int = 0
+    shard_flip_pull_on: int = -1
+    shard_flip_pull_every: int = 0
+    shard_drop_pull_on: int = -1
+    shard_drop_pull_every: int = 0
 
 
 class FabricChaos:
@@ -523,6 +613,7 @@ class FabricChaos:
         self.injected_slow_pulls = 0
         self.injected_dead_links = 0
         self.injected_expired_publishes = 0
+        self.injected_shard_faults = 0
 
     @staticmethod
     def _hit(n: int, on: int, every: int) -> bool:
@@ -547,10 +638,20 @@ class FabricChaos:
             flip = self._hit(n, c.flip_pull_on, c.flip_pull_every)
             if flip:
                 self.injected_flipped_pulls += 1
+            s_torn = self._hit(n, c.shard_torn_pull_on,
+                               c.shard_torn_pull_every)
+            s_flip = self._hit(n, c.shard_flip_pull_on,
+                               c.shard_flip_pull_every)
+            s_drop = self._hit(n, c.shard_drop_pull_on,
+                               c.shard_drop_pull_every)
+            if s_torn or s_flip or s_drop:
+                self.injected_shard_faults += 1
         if slow:
             time.sleep(c.slow_pull_s)
         if torn:
             return data[:max(8, len(data) // 2)]
+        if s_torn or s_flip or s_drop:
+            return _corrupt_shard(data, n, s_torn, s_flip, s_drop)
         if flip and len(data) > 16:
             # flip a PAYLOAD bit (past magic + lengths + a header margin)
             # so the CRC verifier — not the JSON parser — is what catches
@@ -581,6 +682,7 @@ class FabricChaos:
                 "injected_dead_links": self.injected_dead_links,
                 "injected_expired_publishes":
                     self.injected_expired_publishes,
+                "injected_shard_faults": self.injected_shard_faults,
             }
 
 

@@ -94,9 +94,16 @@ def platform_peak_flops(backend: str, device_kind: str = "",
     per-chip bf16 peaks the training bench divides by, so a
     chip_opportunist drain gets serving MFU rows consistent with the
     mfu_sweep rows for free); unknown TPU kinds fall back to v5e rather
-    than refusing to serve.  ``ENGINE_PEAK_FLOPS`` overrides the value
-    (label gains a ``!`` so a doctored denominator is visible in every
-    snapshot)."""
+    than refusing to serve.  A tensor-parallel engine passes its mesh
+    degree as ``n_devices``: the TPU peak multiplies per chip (N chips of
+    silicon really do offer N× the FLOPs — charging a TP=4 engine against
+    one chip's peak would report 4× the honest MFU) and the label gains
+    an ``xN`` suffix so per-mesh rows are distinguishable in snapshots.
+    The CPU fallback keeps the HOST-wide estimate un-multiplied — the
+    forced multi-device CPU mesh is virtual, every "device" shares the
+    same cores — but still annotates the degree.  ``ENGINE_PEAK_FLOPS``
+    overrides the value (label gains a ``!`` so a doctored denominator is
+    visible in every snapshot)."""
     env = os.environ.get("ENGINE_PEAK_FLOPS")
     if backend == "tpu":
         from ...scheduler.topology import VARIANTS, variant_for_device_kind
@@ -110,6 +117,8 @@ def platform_peak_flops(backend: str, device_kind: str = "",
     else:
         label = backend or "cpu"
         peak = _cpu_peak_estimate()
+    if n_devices > 1:
+        label += f"x{n_devices}"
     if env:
         try:
             peak = float(env)

@@ -278,6 +278,33 @@ class JetStreamModel(Model):
                             f"{path}: spec_max_draft and spec_ngram must be "
                             f">= 1 (got {ec.spec_max_draft}, "
                             f"{ec.spec_ngram})")
+                # tensor parallelism (README "Sharded serving"): validate
+                # HERE with a config-level message — Engine/sharding raise
+                # correct ValueErrors but name no file, and a pod that
+                # crash-loops on a bad engine.json should say which key
+                # and file to fix
+                tp = ec.tensor_parallel
+                if not isinstance(tp, int) or tp < 1:
+                    raise ValueError(
+                        f"{path}: tensor_parallel={tp!r} must be an "
+                        "integer >= 1")
+                if tp > 1:
+                    import jax
+
+                    if config.n_kv_heads % tp or config.n_heads % tp:
+                        raise ValueError(
+                            f"{path}: tensor_parallel={tp} must divide "
+                            f"n_heads={config.n_heads} and "
+                            f"n_kv_heads={config.n_kv_heads}")
+                    if config.d_ff % tp:
+                        raise ValueError(
+                            f"{path}: tensor_parallel={tp} must divide "
+                            f"d_ff={config.d_ff}")
+                    if len(jax.devices()) < tp:
+                        raise ValueError(
+                            f"{path}: tensor_parallel={tp} needs {tp} "
+                            f"devices, have {len(jax.devices())} — "
+                            "refusing to silently serve at a lower degree")
                 # an operator's explicit eos_id — INCLUDING -1 "never stop
                 # early" — must win over the checkout's declaration
                 eos_explicit = "eos_id" in raw or "eos_ids" in raw
@@ -860,34 +887,58 @@ class JetStreamModel(Model):
             aid = self.engine.adapters.get(adapter, 0) \
                 if adapter is not None else 0
             if (meta.get("page_size") != ec.page_size or resume_len < 2
-                    or int(meta.get("adapter_id") or 0) != aid
-                    or not (isinstance(blob, tuple) and len(blob) == 2)):
+                    or int(meta.get("adapter_id") or 0) != aid):
                 raise ValueError("handoff meta mismatch")
-            import jax
-
-            for side, pool in ((blob[0], self.engine.k_pool),
-                               (blob[1], self.engine.v_pool)):
-                bl = jax.tree_util.tree_leaves(side)
-                pl = jax.tree_util.tree_leaves(pool)
-                if len(bl) != len(pl):
-                    raise ValueError("handoff blob leaf-count mismatch")
-                for b, p in zip(bl, pl):
-                    # a legitimate export covers pages or pages-1 (the
-                    # boundary prompt whose finishing commit granted no
-                    # next page); anything SHORTER would scatter partial
-                    # coverage and decode silently from garbage KV
-                    if (b.ndim != p.ndim or b.shape[0] != p.shape[0]
-                            or tuple(b.shape[2:]) != tuple(p.shape[2:])
-                            or b.dtype != p.dtype
-                            or not max(1, pages - 1) <= b.shape[1]
-                            <= pages):
-                        raise ValueError(
-                            f"handoff leaf {b.shape}/{b.dtype} does not "
-                            f"fit pool {p.shape}/{p.dtype}")
+            # a legitimate export covers pages or pages-1 (the boundary
+            # prompt whose finishing commit granted no next page);
+            # anything SHORTER would scatter partial coverage and decode
+            # silently from garbage KV
+            self._verify_kv_layout(blob, meta, max(1, pages - 1), pages)
         except Exception:  # noqa: BLE001 — degrade, never fail
             tele.count_handoff("degraded")
             return None
         return blob, int(header.get("nbytes") or 0), resume_len
+
+    def _verify_kv_layout(self, blob, meta: dict, min_pages: int,
+                          max_pages: int) -> None:
+        """Degree-aware KV frame geometry gate, shared by the handoff and
+        fabric importers (README "Sharded serving").  A version-2 frame
+        arrives as a LIST of per-shard ``(k, v)`` pytrees; a legacy frame
+        as one unified tuple.  Every shard is checked against the
+        engine's pools — whose leaf shapes are GLOBAL at TP>1 — using the
+        FRAME's own degree, so a matching-degree frame scatters
+        shard-to-shard, a mismatched-but-consistent one reshards
+        host-side (the engine's explicit counted slow path), and a frame
+        that fits neither layout is refused here, never silent garbage.
+        Raises ValueError on any mismatch."""
+        import jax
+
+        shards = blob if isinstance(blob, list) else [blob]
+        degree = len(shards)
+        if int(meta.get("tp") or 1) != degree:
+            raise ValueError(
+                f"frame degree {degree} != declared tp {meta.get('tp')}")
+        for shard in shards:
+            if not (isinstance(shard, tuple) and len(shard) == 2):
+                raise ValueError("frame blob is not a (k, v) pair")
+            for side, pool in ((shard[0], self.engine.k_pool),
+                               (shard[1], self.engine.v_pool)):
+                bl = jax.tree_util.tree_leaves(side)
+                pl = jax.tree_util.tree_leaves(pool)
+                if len(bl) != len(pl):
+                    raise ValueError("frame blob leaf-count mismatch")
+                for b, p in zip(bl, pl):
+                    # each shard carries 1/degree of the kv-head axis
+                    # (axis 2); every other dim must match the pool
+                    if (b.ndim != p.ndim or b.shape[0] != p.shape[0]
+                            or b.shape[2] * degree != p.shape[2]
+                            or tuple(b.shape[3:]) != tuple(p.shape[3:])
+                            or b.dtype != p.dtype
+                            or not min_pages <= b.shape[1] <= max_pages):
+                        raise ValueError(
+                            f"frame leaf {b.shape}/{b.dtype} (degree "
+                            f"{degree}) does not fit pool "
+                            f"{p.shape}/{p.dtype}")
 
     _FABRIC_PULL_TIMEOUT_S = 5.0
 
@@ -938,27 +989,12 @@ class JetStreamModel(Model):
                     # model identity: chain hashes seed on tokens, not
                     # weights — a same-shape SIBLING model's frame would
                     # pass every other gate and decode silently wrong
-                    or meta.get("model") != self.name
-                    or not (isinstance(blob, tuple) and len(blob) == 2)):
+                    or meta.get("model") != self.name):
                 raise ValueError("fabric meta mismatch")
-            import jax
-
-            for side, pool in ((blob[0], self.engine.k_pool),
-                               (blob[1], self.engine.v_pool)):
-                bl = jax.tree_util.tree_leaves(side)
-                pl = jax.tree_util.tree_leaves(pool)
-                if len(bl) != len(pl):
-                    raise ValueError("fabric blob leaf-count mismatch")
-                for b, p in zip(bl, pl):
-                    # a prefix frame must cover exactly its declared page
-                    # count — an under-covering frame would scatter
-                    # partial KV and decode silently from garbage
-                    if (b.ndim != p.ndim or b.shape[0] != p.shape[0]
-                            or tuple(b.shape[2:]) != tuple(p.shape[2:])
-                            or b.dtype != p.dtype or b.shape[1] != pages):
-                        raise ValueError(
-                            f"fabric leaf {b.shape}/{b.dtype} does not "
-                            f"fit pool {p.shape}/{p.dtype}")
+            # a prefix frame must cover exactly its declared page count —
+            # an under-covering frame would scatter partial KV and decode
+            # silently from garbage
+            self._verify_kv_layout(blob, meta, pages, pages)
         except Exception:  # noqa: BLE001 — degrade, never fail
             tele.count_fabric("degraded")
             return None

@@ -25,6 +25,7 @@ Layout (the standard Megatron split, expressed as shardings):
 from __future__ import annotations
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .model import DecoderConfig
@@ -117,3 +118,45 @@ def alloc_pool(shape: tuple, mesh: Mesh, dtype=None, quant=None):
         lambda: jnp.zeros(shape, dtype),
         out_shardings=NamedSharding(mesh, POOL_SPEC),
     )()
+
+
+# ------------------------------------------- shard-native snapshot / scatter
+#
+# The KV data plane (engine session save/restore, swap park, handoff export,
+# fabric publish) moves pool pages host<->device through these three
+# primitives.  The contract: a TP-N pool is snapshotted as N per-shard host
+# blocks — each the shard's OWN addressable bytes, 1/N of the kv-head axis —
+# and restored shard-to-shard.  No pool-sized gathered buffer ever
+# materializes on host, and no cross-chip collective runs (each transfer is
+# chip<->host for that chip's heads only).
+
+
+def shard_order(leaf) -> list:
+    """The pool leaf's addressable shards ordered by kv-head slice start —
+    shard i of a sharded KVPG frame is always the i-th block of the kv-head
+    axis, independent of device enumeration order."""
+    return sorted(leaf.addressable_shards,
+                  key=lambda s: s.index[2].start or 0)
+
+
+def snapshot_shards(leaf, pages) -> list:
+    """Per-shard host snapshot of ``pages`` (axis 1) -> list of numpy
+    blocks, one per shard in kv-head order.  The page gather runs on each
+    shard's device over its local heads; only the selected pages of that
+    shard cross to host."""
+    return [np.asarray(s.data[:, pages]) for s in shard_order(leaf)]
+
+
+def scatter_shards(leaf, pages, blocks, mesh):
+    """Scatter per-shard host ``blocks`` into the sharded pool leaf at
+    ``pages`` (axis 1), shard-to-shard.  Each block is device_put to its own
+    shard's device and written into that shard's local pages; the global
+    array is reassembled from the per-device pieces
+    (make_array_from_single_device_arrays matches arrays to shard positions
+    by committed device, so list order is free)."""
+    arrs = []
+    for s, block in zip(shard_order(leaf), blocks):
+        host = np.ascontiguousarray(block)
+        arrs.append(s.data.at[:, pages].set(jax.device_put(host, s.device)))
+    return jax.make_array_from_single_device_arrays(
+        leaf.shape, NamedSharding(mesh, POOL_SPEC), arrs)

@@ -358,6 +358,20 @@ class EngineTelemetry:
             "engine_kv_fabric_bytes_total",
             "fleet KV fabric payload bytes by direction "
             "(out=frames served to pullers, in=frames imported)")
+        # Mesh-sharded KV data plane (ISSUE 16): host bytes moved through
+        # the per-shard snapshot/scatter path — each shard's OWN
+        # addressable bytes, never a gathered pool — and the layout
+        # outcome of every sharded restore (match = degree-aligned
+        # shard-to-shard scatter; reshard = the explicit host-side
+        # cross-degree slow path).
+        self.kv_shard_bytes = r.counter(
+            "engine_kv_shard_bytes_total",
+            "per-shard KV snapshot/scatter host bytes by direction "
+            "(export=device->host shard blocks, restore=host->device)")
+        self.kv_reshard = r.counter(
+            "engine_kv_reshard_total",
+            "sharded KV restore layout outcomes (match=degree-aligned "
+            "shard-to-shard, reshard=host-side cross-degree conversion)")
         # Fleet robustness surface (ISSUE 6): the engine's health state as a
         # one-hot labeled gauge so dashboards can plot state transitions —
         # the scrape-time complement of the router's active /engine/health
@@ -556,6 +570,14 @@ class EngineTelemetry:
     def count_fabric_bytes(self, direction: str, nbytes: int) -> None:
         if self.enabled and nbytes:
             self.kv_fabric_bytes.inc(nbytes, direction=direction)
+
+    def count_kv_shard_bytes(self, direction: str, nbytes: int) -> None:
+        if self.enabled and nbytes:
+            self.kv_shard_bytes.inc(nbytes, direction=direction)
+
+    def count_reshard(self, outcome: str) -> None:
+        if self.enabled:
+            self.kv_reshard.inc(outcome=outcome)
 
     def count_kv_event(self, tier: str, event: str) -> None:
         if self.enabled:

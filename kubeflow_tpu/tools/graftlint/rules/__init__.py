@@ -1,7 +1,8 @@
-"""Rule registry: the eight invariants distilled from the repo's own
+"""Rule registry: the nine invariants distilled from the repo's own
 review history (see each rule's ``history`` for the bug it encodes)."""
 
 from .atomic import AtomicWriteRule
+from .gather_ban import GatherBanRule
 from .growth import BoundedGrowthRule
 from .hotpath import HotPathRule
 from .imports import ImportWeightRule
@@ -14,6 +15,7 @@ ALL_RULES = [
     ReleaseGuaranteeRule,
     ImportWeightRule,
     HotPathRule,
+    GatherBanRule,
     BoundedGrowthRule,
     AtomicWriteRule,
     MetricHygieneRule,

@@ -1,0 +1,82 @@
+"""gather-ban: hot-path functions never gather a mesh-sharded KV pool to
+host.
+
+Opt-in via '# graftlint: hot-path' on (or directly above) the def line —
+the same marker hot-path reads.  Flags ``jax.device_get(...)`` of
+anything, and ``np.asarray(...)`` / ``numpy.asarray(...)`` whose argument
+expression names a pool (contains "pool", e.g. ``self.k_pool``,
+``pool[:, pages]``) — the exact shape of the pre-ISSUE-16 snapshot
+regression, where one ``np.asarray(leaf[:, pages])`` over a
+tensor-parallel pool implied an all-gather of pool-sized KV through host
+RAM.  The shard-native path (sharding.snapshot_shards) reads
+``shard.data`` instead, which this rule deliberately does not match.
+Heuristic by design: per-shard helpers name their locals ``block`` /
+``shard``; anything called "pool" inside a hot-path function is the
+engine's device pool.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Context, Finding, Rule, SourceFile, _HOT_RE, expr_text
+
+# full gathers of any argument — device_get IS the gather primitive
+_GATHER_CALLS = ("jax.device_get",)
+# host-copy calls that gather when aimed at a pool
+_ASARRAY_CALLS = ("np.asarray", "numpy.asarray")
+
+
+def _names_pool(node) -> bool:
+    """True when any name inside the argument expression names a pool —
+    walks the whole subtree so subscripted forms (``self.k_pool[:, p]``)
+    match, not just bare dotted chains."""
+    for sub in ast.walk(node):
+        t = expr_text(sub)
+        if t and "pool" in t.lower():
+            return True
+    return False
+
+
+class GatherBanRule(Rule):
+    name = "gather-ban"
+    invariant = ("functions marked '# graftlint: hot-path' never call "
+                 "jax.device_get, and never np.asarray a mesh-sharded "
+                 "pool — snapshot per shard (sharding.snapshot_shards) "
+                 "so host copies move one shard's bytes, not the pool's")
+    history = ("ISSUE 16: every KV snapshot path (swap park, session pin, "
+               "handoff export, fabric publish) gathered the full pool to "
+               "host via np.asarray(leaf[:, pages]) — at TP=N that is an "
+               "all-gather of N chips' KV through one host buffer; the "
+               "sharded data plane moves per-shard addressable bytes only")
+
+    def check(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            marked = sf.directive_near(node.lineno, _HOT_RE) or any(
+                sf.directive_near(d.lineno, _HOT_RE)
+                for d in node.decorator_list)
+            if not marked:
+                continue
+            yield from self._check_body(sf, node)
+
+    def _check_body(self, sf: SourceFile, fn) -> Iterable[Finding]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            t = expr_text(node.func)
+            if t in _GATHER_CALLS:
+                yield Finding(
+                    self.name, sf.rel, node.lineno,
+                    f"hot-path function '{fn.name}' calls {t}() — a full "
+                    f"device->host gather; snapshot per shard instead")
+            elif t in _ASARRAY_CALLS and node.args \
+                    and _names_pool(node.args[0]):
+                yield Finding(
+                    self.name, sf.rel, node.lineno,
+                    f"hot-path function '{fn.name}' calls {t}() on a "
+                    f"pool — on a mesh-sharded pool this gathers every "
+                    f"chip's KV through host RAM; use "
+                    f"sharding.snapshot_shards to move one shard's bytes")

@@ -1,0 +1,15 @@
+import jax
+import numpy as np
+
+
+class Engine:
+    k_pool = None
+    v_pool = None
+
+    def export_pages(self, pages):  # graftlint: hot-path
+        # shard-native: each block is one shard's own addressable bytes
+        return [np.asarray(s.data[:, pages])
+                for s in self.k_pool.addressable_shards]
+
+    def debug_dump(self):  # cold path: no marker, gathers are fine
+        return (np.asarray(self.k_pool), jax.device_get(self.v_pool))

@@ -222,9 +222,14 @@ def test_pool_exhaustion_truncates_like_sync(params):
 
     def run(depth):
         eng = Engine(params, CFG, _ec(pipeline_depth=depth, **kw))
+        # enqueue BEFORE start(): the loop then admits both rows in its
+        # first tick (one fused prefill, fixed slot order) — submitting
+        # after start() races the submitter thread against the tick loop,
+        # and whichever row prefills first shifts the whole page-allocation
+        # pattern, flipping WHICH row OOM-truncates between the two runs
+        futs = [eng.generate_async(p, 48) for p in PROMPTS[:2]]
         eng.start()
         try:
-            futs = [eng.generate_async(p, 48) for p in PROMPTS[:2]]
             res = [f.result(timeout=180) for f in futs]
             stats = eng.stats
             return [(r["tokens"], r["truncated"]) for r in res], stats

@@ -338,6 +338,13 @@ def test_complete_prefill_drops_frame_and_reaped_import_releases(params):
     ed.start()
     try:
         hog = ed.generate_async([1, 2, 3], 64)  # holds the only slot
+        # wait until the hog actually HOLDS the slot: submitted in the
+        # same admission tick, the import's 0.05s deadline wins the EDF
+        # tie-break and it runs instead of expiring in the queue
+        t0 = time.monotonic()
+        while ed.stats["active_slots"] == 0 and time.monotonic() - t0 < 30:
+            time.sleep(0.005)
+        assert ed.stats["active_slots"] == 1
         blob = (np.zeros((1, 2, 3), np.float32),
                 np.zeros((1, 2, 3), np.float32))
         tokens = list(range(1, 12))

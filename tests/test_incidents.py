@@ -248,6 +248,17 @@ _SHAPES = {
     "handoff:expired_export": [{"kind": "degradation",
                                 "source": "handoff",
                                 "outcome": "pre_submit"}],
+    # sharded-frame chaos: ONE corrupted sub-frame fails the per-shard
+    # verifier and degrades exactly like a torn unified frame
+    "handoff:shard_torn_pull": [{"kind": "degradation",
+                                 "source": "handoff",
+                                 "outcome": "pre_submit"}],
+    "handoff:shard_flip_pull": [{"kind": "degradation",
+                                 "source": "handoff",
+                                 "outcome": "pre_submit"}],
+    "handoff:shard_drop_pull": [{"kind": "degradation",
+                                 "source": "handoff",
+                                 "outcome": "pre_submit"}],
     # fabric chaos: prefix pulls degrade to plain re-prefill
     "fabric:torn_pull": [{"kind": "degradation", "source": "fabric",
                           "outcome": "pre_submit"}],
@@ -258,6 +269,12 @@ _SHAPES = {
     "fabric:dead_link": [{"kind": "degradation", "source": "fabric",
                           "outcome": "pre_submit"}],
     "fabric:expired_publish": [{"kind": "degradation", "source": "fabric",
+                                "outcome": "pre_submit"}],
+    "fabric:shard_torn_pull": [{"kind": "degradation", "source": "fabric",
+                                "outcome": "pre_submit"}],
+    "fabric:shard_flip_pull": [{"kind": "degradation", "source": "fabric",
+                                "outcome": "pre_submit"}],
+    "fabric:shard_drop_pull": [{"kind": "degradation", "source": "fabric",
                                 "outcome": "pre_submit"}],
     # traffic storm: the ingress overload controller's aggregated shed
     # bursts + brownout stage transitions (README "Overload control")

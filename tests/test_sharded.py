@@ -1,0 +1,640 @@
+"""Mesh-sharded KV data plane tests (ISSUE 16): shard-native KVPG
+frames, gather-free snapshot/scatter, and TP-honest serving — all on the
+forced 8-device CPU mesh (conftest.py), in-process.
+
+The headline contract: every KV movement path — session save/restore,
+swap-preempt park, disaggregation handoff, fabric publish/pull — run at
+tensor_parallel > 1 produces output BYTE-IDENTICAL to the TP=1 oracle,
+moving only per-shard addressable bytes (engine_kv_shard_bytes_total);
+a frame whose mesh degree matches the importer scatters shard-to-shard,
+a mismatched degree reshards host-side as an EXPLICIT counted slow path
+(engine_kv_reshard_total{outcome}), and every shard-level fault class
+(torn / flipped / dropped single sub-frame) degrades exactly like
+today's torn unified frame: byte-identical output, 0 leaked pages.
+Degree-1 frames keep the version-1 wire layout byte for byte, so
+pre-ISSUE-16 on-disk sessions and fabric frames still restore.
+"""
+
+import glob
+import json
+import os
+import struct
+import zlib
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.engine import Engine, EngineConfig, KVStoreConfig
+from kubeflow_tpu.serving.engine import faults
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import (FabricFaultConfig,
+                                                FaultConfig,
+                                                HandoffFaultConfig)
+from kubeflow_tpu.serving.engine.kvstore import (FORMAT_VERSION, MAGIC,
+                                                 SHARDED_FORMAT_VERSION,
+                                                 KVStoreCorrupt, blob_degree,
+                                                 pack_frame,
+                                                 pack_sharded_frame,
+                                                 reshard_blob, unpack_frame)
+from kubeflow_tpu.serving.engine.perf import platform_peak_flops
+from kubeflow_tpu.serving.engine.scheduler import SchedulerConfig
+from kubeflow_tpu.serving.engine.serve import JetStreamModel
+from kubeflow_tpu.serving.server import ModelServer
+
+pytestmark = pytest.mark.sharded
+
+# vocab >= 256 (byte tokenizer); 4 kv-heads so the pool shards at TP=2
+# AND TP=4 on the 8-device host (TP=4 -> one kv-head per device)
+CFG = M.DecoderConfig(vocab_size=288, d_model=32, n_layers=1, n_heads=4,
+                      n_kv_heads=4, d_ff=64)
+PAGE = 8
+NUM_PAGES = 96
+PROMPT_IDS = [(i * 13) % (CFG.vocab_size - 1) + 1 for i in range(20)]
+TURN2_EXTRA = [5, 6, 7, 8, 9]
+TURN3_EXTRA = [11, 12, 13]
+PROMPT_TXT = "the quick brown fox jumps over the lazy dog"
+SHARED = "You are a helpful assistant. Answer concisely and cite. " * 2
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+def _ec(**kw):
+    base = dict(max_slots=2, page_size=PAGE, num_pages=NUM_PAGES,
+                max_pages_per_slot=24)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _leak(engine) -> int:
+    s = engine.stats
+    return (NUM_PAGES - 1) - s["free_pages"] - s["cached_pages"]
+
+
+def _gen(model, prompt, mt, **params):
+    return model.generate({"text_input": prompt,
+                           "parameters": {"max_tokens": mt, **params}})
+
+
+def _shard_bytes(engine, direction) -> float:
+    return engine.telemetry.kv_shard_bytes.series().get(
+        (("direction", direction),), 0.0)
+
+
+def _reshard_count(engine, outcome) -> float:
+    return engine.telemetry.kv_reshard.series().get(
+        (("outcome", outcome),), 0.0)
+
+
+def _degraded_handoffs(engine) -> float:
+    return engine.telemetry.kv_handoff.series().get(
+        (("outcome", "degraded"),), 0.0)
+
+
+def _fabric_count(engine, outcome) -> float:
+    return engine.telemetry.kv_fabric.series().get(
+        (("outcome", outcome),), 0.0)
+
+
+def _handoff_params(pre, source_port):
+    return {"handoff": {"handle": (pre.get("handoff") or {}).get("handle"),
+                        "source_port": source_port,
+                        "token_ids": pre["token_ids"]}}
+
+
+def _hint(engine, server):
+    view = engine.fabric_view()
+    assert view, "nothing published"
+    return {"fabric": {"key": view[0]["key"], "source_port": server.port,
+                       "pages": view[0]["pages"]}}
+
+
+def _mk_shard_blobs(degree, heads=4, pages=3, quant=False, seed=0):
+    """Per-shard (k, v) pytrees shaped like pool page snapshots
+    [L, pages, heads/degree, page, hd], in kv-head order."""
+    rng = np.random.default_rng(seed)
+    per = heads // degree
+    out = []
+    for _ in range(degree):
+        k = rng.standard_normal((1, pages, per, PAGE, 4)).astype(np.float32)
+        v = rng.standard_normal((1, pages, per, PAGE, 4)).astype(np.float32)
+        if quant:
+            k = {"q": (k * 10).astype(np.int8),
+                 "s": np.abs(rng.standard_normal(
+                     (1, pages, per, PAGE, 1))).astype(np.float32)}
+            v = {"q": (v * 10).astype(np.int8),
+                 "s": np.abs(rng.standard_normal(
+                     (1, pages, per, PAGE, 1))).astype(np.float32)}
+        out.append((k, v))
+    return out
+
+
+def _tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ------------------------------------------------------- sharded frame units
+
+
+def test_sharded_frame_roundtrip_and_header():
+    blobs = _mk_shard_blobs(2)
+    data, nbytes, crc = pack_sharded_frame(
+        "handoff/1", blobs, {"resume_len": 9, "tp": 2})
+    assert data[:4] == MAGIC
+    assert struct.unpack("<I", data[4:8])[0] == SHARDED_FORMAT_VERSION
+    out, header = unpack_frame(data)
+    assert isinstance(out, list) and blob_degree(out) == 2
+    _tree_equal(out, blobs)
+    assert header["meta"]["tp"] == 2
+    assert header["meta"]["resume_len"] == 9
+    assert header["nbytes"] == nbytes == sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(blobs))
+    assert len(header["shards"]) == 2
+    assert crc == zlib.crc32(data[12 + struct.unpack(
+        "<I", data[8:12])[0]:])
+    # quantized ({"q","s"} pytree) shards survive the same framing
+    qblobs = _mk_shard_blobs(4, quant=True)
+    qdata, _, _ = pack_sharded_frame("fabric/abc", qblobs, {"pages": 3})
+    qout, qheader = unpack_frame(qdata)
+    assert blob_degree(qout) == 4
+    _tree_equal(qout, qblobs)
+
+
+def test_sharded_frame_shard_level_corruption_caught():
+    """Per-shard integrity: a torn / flipped / zeroed single sub-frame
+    fails ITS verifier with a shard-scoped error — the exact corruption
+    the chaos plane (faults._corrupt_shard) injects on pulls — and an
+    outer-stream truncation is caught by the shard-length table."""
+    data, _, _ = pack_sharded_frame(
+        "handoff/7", _mk_shard_blobs(2), {"resume_len": 4})
+    regions = faults._shard_regions(data)
+    assert len(regions) == 2
+    # legacy v1 frames have no shard regions: shard chaos passes them by
+    v1, _, _ = pack_frame("x", _mk_shard_blobs(1)[0], {})
+    assert faults._shard_regions(v1) == []
+    for kind in ("torn", "flip", "drop"):
+        bad = faults._corrupt_shard(data, 1, kind == "torn", kind == "flip",
+                                    kind == "drop")
+        assert len(bad) == len(data), kind  # stream length intact
+        with pytest.raises(KVStoreCorrupt, match="shard"):
+            unpack_frame(bad)
+    with pytest.raises(KVStoreCorrupt):
+        unpack_frame(data[: len(data) - 5])  # torn outer stream
+    with pytest.raises(KVStoreCorrupt):
+        unpack_frame(data[: len(data) // 3])  # torn mid-table
+
+
+def test_reshard_blob_exact_across_degrees():
+    """Host-side resharding is exact: 4 -> 2 -> 1 -> 4 round-trips bit
+    for bit (pure reindexing on the kv-head axis, no arithmetic), for
+    plain and int8-quantized pools; a non-divisible degree refuses."""
+    blobs4 = _mk_shard_blobs(4)
+    uni = reshard_blob(blobs4, 1)
+    assert blob_degree(uni) == 1 and isinstance(uni, tuple)
+    assert uni[0].shape[2] == 4  # kv-head axis reassembled
+    two = reshard_blob(uni, 2)
+    assert blob_degree(two) == 2
+    _tree_equal(reshard_blob(two, 4), blobs4)
+    # quantized: q and s leaves both ride the kv-head axis
+    q4 = _mk_shard_blobs(4, quant=True)
+    _tree_equal(reshard_blob(reshard_blob(q4, 2), 4), q4)
+    with pytest.raises(ValueError):
+        reshard_blob(uni, 3)  # 4 kv-heads do not split 3 ways
+
+
+def test_degree1_wire_format_byte_identical_to_legacy():
+    """Satellite: the version-1 frame layout is pinned BYTE FOR BYTE
+    against a hand-assembled legacy frame — pre-ISSUE-16 on-disk session
+    page files and fabric frames must keep restoring, and degree-1
+    engines must keep writing bytes a pre-ISSUE-16 reader can verify."""
+    rng = np.random.default_rng(3)
+    k = rng.standard_normal((1, 2, 4, PAGE, 4)).astype(np.float32)
+    v = rng.standard_normal((1, 2, 4, PAGE, 4)).astype(np.float32)
+    meta = {"resume_len": 9, "page_size": PAGE}
+    # the legacy layout, assembled by hand exactly as the pre-ISSUE-16
+    # writer did: magic | u32 1 | u32 header_len | header JSON | payload
+    spec = {"t": "t", "v": [
+        {"t": "a", "dtype": "float32", "shape": list(k.shape), "i": 0},
+        {"t": "a", "dtype": "float32", "shape": list(v.shape), "i": 1}]}
+    payload = k.tobytes() + v.tobytes()
+    crc = zlib.crc32(v.tobytes(), zlib.crc32(k.tobytes()))
+    header = json.dumps({
+        "v": 1, "key": "session/s/3", "spec": spec, "meta": meta,
+        "nbytes": len(payload), "crc": crc, "version": 1}).encode()
+    legacy = (MAGIC + struct.pack("<II", 1, len(header)) + header + payload)
+    assert FORMAT_VERSION == 1
+    data, nbytes, _ = pack_frame("session/s/3", (k, v), meta)
+    assert data == legacy  # byte-for-byte
+    blob, hdr = unpack_frame(legacy)  # and old bytes still restore
+    _tree_equal(blob, (k, v))
+    assert hdr["meta"] == meta and hdr["nbytes"] == nbytes
+
+
+def test_tp1_session_disk_frames_stay_legacy(params, tmp_path):
+    """A degree-1 engine's durable session writes version-1 page files
+    with no "tp" meta key — bytes a pre-ISSUE-16 engine restores."""
+    eng = Engine(params, CFG, _ec(max_slots=4, kv_store=KVStoreConfig(
+        host_max_bytes=0, disk_dir=str(tmp_path / "kv"))))
+    eng.start()
+    try:
+        r1 = eng.generate(PROMPT_IDS, 10, session_id="s")
+        assert r1["session"]["durable"]
+    finally:
+        eng.stop()
+    files = glob.glob(str(tmp_path / "kv" / "**" / "*.kvpg"),
+                      recursive=True)
+    assert files
+    for path in files:
+        with open(path, "rb") as f:
+            raw = f.read()
+        assert raw[:4] == MAGIC
+        assert struct.unpack("<I", raw[4:8])[0] == FORMAT_VERSION
+        _, header = unpack_frame(raw)
+        assert "tp" not in header["meta"], path
+
+
+# --------------------------------------------- TP sessions: save/restore
+
+
+@pytest.fixture(scope="module")
+def cold(params):
+    """The TP=1 uninterrupted oracle: each turn run cold on a plain
+    engine — the byte-identity reference for every TP degree below."""
+    eng = Engine(params, CFG, _ec(max_slots=4))
+    eng.start()
+    try:
+        r1 = eng.generate(PROMPT_IDS, 10)
+        ctx2 = PROMPT_IDS + r1["tokens"] + TURN2_EXTRA
+        r2 = eng.generate(ctx2, 10)
+        ctx3 = ctx2 + r2["tokens"] + TURN3_EXTRA
+        r3 = eng.generate(ctx3, 10)
+        return {"t1": r1["tokens"], "ctx2": ctx2, "t2": r2["tokens"],
+                "ctx3": ctx3, "t3": r3["tokens"]}
+    finally:
+        eng.stop()
+
+
+def _leaked(eng) -> int:
+    s = eng.stats
+    return (eng.ec.num_pages - 1) - s["free_pages"] - s["cached_pages"]
+
+
+@pytest.mark.parametrize("tp,depth", [(2, 0), (2, 1), (4, 1)])
+def test_tp_session_save_restore_byte_identical(params, cold, tmp_path,
+                                                tp, depth):
+    """Session turns at TP>1 — pin snapshots each shard's OWN pages
+    (engine_kv_shard_bytes_total{direction="export"}), the warm turn
+    scatters shard-to-shard — emit the TP=1 oracle's exact bytes at
+    pipeline depth 0 and 1, with 0 leaked pages."""
+    eng = Engine(params, CFG, _ec(
+        max_slots=4, tensor_parallel=tp, pipeline_depth=depth,
+        kv_store=KVStoreConfig(disk_dir=str(tmp_path / "kv"))))
+    eng.start()
+    try:
+        r1 = eng.generate(PROMPT_IDS, 10, session_id="s")
+        assert r1["tokens"] == cold["t1"]
+        assert r1["session"]["pinned"] and r1["session"]["durable"]
+        r2 = eng.generate(cold["ctx2"], 10, session_id="s")
+        assert r2["tokens"] == cold["t2"]  # byte-identical to TP=1 cold
+        assert r2["session"]["restore"] == "host"
+        assert _shard_bytes(eng, "export") > 0
+        assert _shard_bytes(eng, "restore") > 0
+        # matching degree never pays the reshard slow path
+        assert _reshard_count(eng, "reshard") == 0
+        assert _reshard_count(eng, "match") >= 1
+        assert _leaked(eng) == 0
+    finally:
+        eng.stop()
+
+
+def test_cross_degree_session_restart_resharded(params, cold, tmp_path):
+    """A session pinned at TP=2 restores on a TP=4 restart and again on
+    a plain unified restart — byte-identically, through the EXPLICIT
+    counted host-side reshard (engine_kv_reshard_total{outcome=
+    "reshard"}), never silent garbage."""
+    kv = KVStoreConfig(disk_dir=str(tmp_path / "kv"))
+    e1 = Engine(params, CFG, _ec(max_slots=4, tensor_parallel=2,
+                                 kv_store=kv))
+    e1.start()
+    try:
+        r1 = e1.generate(PROMPT_IDS, 10, session_id="s")
+        assert r1["tokens"] == cold["t1"] and r1["session"]["durable"]
+    finally:
+        e1.stop()
+    # the durable frame records its degree; list blobs persist natively
+    files = glob.glob(str(tmp_path / "kv" / "**" / "*.kvpg"),
+                      recursive=True)
+    metas = []
+    for path in files:
+        with open(path, "rb") as f:
+            metas.append(unpack_frame(f.read())[1]["meta"])
+    assert any(m.get("tp") == 2 for m in metas)
+
+    e2 = Engine(params, CFG, _ec(max_slots=4, tensor_parallel=4,
+                                 kv_store=kv))
+    assert "s" in e2.sessions()  # manifest replayed before any touch
+    e2.start()
+    try:
+        r2 = e2.generate(cold["ctx2"], 10, session_id="s")
+        assert r2["tokens"] == cold["t2"]
+        assert r2["session"]["restore"] == "disk"
+        assert _reshard_count(e2, "reshard") >= 1
+        assert _leaked(e2) == 0
+    finally:
+        e2.stop()
+
+    e3 = Engine(params, CFG, _ec(max_slots=4, kv_store=kv))  # unified
+    e3.start()
+    try:
+        r3 = e3.generate(cold["ctx3"], 10, session_id="s")
+        assert r3["tokens"] == cold["t3"]
+        assert r3["session"]["restore"] == "disk"
+        assert _reshard_count(e3, "reshard") >= 1
+        assert _leaked(e3) == 0
+    finally:
+        e3.stop()
+
+
+def test_tp_swap_preempt_byte_identical_zero_leaks(params):
+    """Chaos preemption storm at TP=2 with forced swap: every parked
+    blob is a per-shard snapshot (no gathered pool on host), every
+    resume scatters shard-to-shard, and every request's bytes match the
+    calm TP=1 run — swap store drained, 0 leaked pages."""
+    prompts = [[(i * 7 + j * 13) % (CFG.vocab_size - 1) + 1
+                for j in range(6 + i)] for i in range(4)]
+
+    def run_all(eng):
+        futs = [eng.generate_async(p, 20, priority="batch")
+                for p in prompts]
+        return [f.result(timeout=300) for f in futs]
+
+    eng = Engine(params, CFG, _ec(max_slots=4))
+    eng.start()
+    try:
+        baseline = run_all(eng)
+    finally:
+        eng.stop()
+
+    eng = Engine(params, CFG, _ec(
+        max_slots=4, tensor_parallel=2,
+        chaos=FaultConfig(preempt_every=5),
+        scheduler=SchedulerConfig(swap_policy="swap", swap_min_tokens=8)))
+    eng.start()
+    try:
+        stormed = run_all(eng)
+        for base, got in zip(baseline, stormed):
+            assert got["tokens"] == base["tokens"]  # byte-identical
+        s = eng.stats
+        assert s["preemptions"] > 0 and s["swapped_out"] > 0
+        assert s["swapped_in"] == s["swapped_out"]
+        assert s["swap_used_bytes"] == 0  # every parked blob restored
+        assert _shard_bytes(eng, "export") > 0
+        assert _shard_bytes(eng, "restore") > 0
+        assert _leaked(eng) == 0
+    finally:
+        eng.stop()
+
+
+# -------------------------------------------------- TP handoff (disagg)
+
+
+def _pair(params, ptp, dtp, prefill_chaos=None, decode_chaos=None, **dkw):
+    ep = Engine(params, CFG, _ec(role="prefill", tensor_parallel=ptp,
+                                 handoff_chaos=prefill_chaos))
+    sp = ModelServer([JetStreamModel("m", "", engine=ep)], port=0)
+    sp.start()
+    ed = Engine(params, CFG, _ec(role="decode", tensor_parallel=dtp,
+                                 handoff_chaos=decode_chaos, **dkw))
+    ed.start()
+    md = JetStreamModel("m", "", engine=ed)
+    return ep, sp, ed, md
+
+
+def test_tp_handoff_cross_degree_byte_identity(params):
+    """Prefill->decode handoff across mesh degrees: TP=2 -> TP=2 imports
+    shard-to-shard ("match"); TP=2 -> unified, unified -> TP=2 and
+    TP=2 -> TP=4 reshard host-side (counted) — every combination
+    byte-identical to the unified TP=1 oracle, with the decode replica
+    never re-prefilling and 0 leaked pages on both sides."""
+    eu = Engine(params, CFG, _ec())
+    eu.start()
+    mu = JetStreamModel("m", "", engine=eu)
+    try:
+        ref = _gen(mu, PROMPT_TXT, 12)
+        # (prefill tp, decode tp, expected import outcome); the matching
+        # pair also runs at pipeline depth 0 — the sync scheduler drives
+        # the same scatter
+        cases = [(2, 2, "match", {"pipeline_depth": 0}),
+                 (2, 2, "match", {}),
+                 (2, 1, "reshard", {}),
+                 (1, 2, "reshard", {}),
+                 (2, 4, "reshard", {})]
+        for ptp, dtp, outcome, dkw in cases:
+            tag = (ptp, dtp, dkw)
+            ep, sp, ed, md = _pair(params, ptp, dtp, **dkw)
+            try:
+                pre = _gen(sp.models["m"], PROMPT_TXT, 12, kv_handoff=True)
+                assert pre["handoff"].get("handle"), tag
+                out = _gen(md, PROMPT_TXT, 12,
+                           **_handoff_params(pre, sp.port))
+                assert out["token_ids"] == ref["token_ids"], tag
+                assert out["text_output"] == ref["text_output"], tag
+                assert ed.stats["prefill_dispatches"] == 0, \
+                    f"{tag}: decode replica re-prefilled"
+                assert _reshard_count(ed, outcome) >= 1, tag
+                if ptp > 1:  # export moved per-shard bytes only
+                    assert _shard_bytes(ep, "export") > 0, tag
+                assert _leak(ep) == 0 and _leak(ed) == 0, tag
+            finally:
+                sp.stop()
+                ep.stop(drain=False)
+                ed.stop(drain=False)
+    finally:
+        eu.stop(drain=False)
+
+
+def test_shard_chaos_handoff_degrades_with_zero_leaks(params):
+    """A torn / flipped / dropped SINGLE sub-frame on the handoff pull
+    degrades exactly like a torn unified frame: re-prefill, byte-
+    identical output, degradation counted, 0 leaked pages on BOTH
+    replicas."""
+    eu = Engine(params, CFG, _ec())
+    eu.start()
+    mu = JetStreamModel("m", "", engine=eu)
+    try:
+        ref = _gen(mu, PROMPT_TXT, 10)
+        cases = {
+            "shard_torn": HandoffFaultConfig(shard_torn_pull_on=1),
+            "shard_flip": HandoffFaultConfig(shard_flip_pull_on=1),
+            "shard_drop": HandoffFaultConfig(shard_drop_pull_on=1),
+        }
+        for name, chaos in cases.items():
+            ep, sp, ed, md = _pair(params, 2, 2, decode_chaos=chaos)
+            try:
+                pre = _gen(sp.models["m"], PROMPT_TXT, 10, kv_handoff=True)
+                out = _gen(md, PROMPT_TXT, 10,
+                           **_handoff_params(pre, sp.port))
+                assert out["token_ids"] == ref["token_ids"], name
+                assert out["tokens"] == 10, name
+                assert _degraded_handoffs(ed) >= 1, name
+                assert ed._handoff_chaos.stats()[
+                    "injected_shard_faults"] >= 1, name
+                assert _leak(ep) == 0 and _leak(ed) == 0, name
+            finally:
+                sp.stop()
+                ep.stop(drain=False)
+                ed.stop(drain=False)
+    finally:
+        eu.stop(drain=False)
+
+
+# ----------------------------------------------------- TP fabric pulls
+
+
+def test_tp_fabric_publish_pull_cross_degree(params):
+    """A prefix published by a TP=2 replica (per-shard snapshot, no
+    gathered pool) fault-in on TP=2, TP=4 and unified pullers — each
+    byte-identical to the TP=1 cold oracle, matching degree scattering
+    shard-to-shard, mismatched degrees through the counted reshard."""
+    eu = Engine(params, CFG, _ec(fabric=False))
+    eu.start()
+    mu = JetStreamModel("m", "", engine=eu)
+    prompt = SHARED + "Q?"
+    ref = _gen(mu, prompt, 12)
+    ea = Engine(params, CFG, _ec(fabric=True, tensor_parallel=2))
+    sa = ModelServer([JetStreamModel("m", "", engine=ea)], port=0)
+    sa.start()
+    try:
+        first = _gen(sa.models["m"], prompt, 12)
+        assert first["token_ids"] == ref["token_ids"]
+        assert ea.stats["fabric"]["publishes"] == 1
+        assert _shard_bytes(ea, "export") > 0
+        for dtp, outcome in ((2, "match"), (4, "reshard"), (1, "reshard")):
+            eb = Engine(params, CFG, _ec(fabric=True, tensor_parallel=dtp))
+            eb.start()
+            mb = JetStreamModel("m", "", engine=eb)
+            try:
+                out = _gen(mb, prompt, 12, **_hint(ea, sa))
+                assert out["token_ids"] == ref["token_ids"], dtp
+                assert out["fabric"] == {"restore": "hit"}, dtp
+                assert _fabric_count(eb, "hit") == 1, dtp
+                assert _reshard_count(eb, outcome) >= 1, dtp
+                assert _leak(eb) == 0, dtp
+            finally:
+                eb.stop(drain=False)
+        assert ea.stats["fabric"]["pulls"] == 3
+        assert _leak(ea) == 0
+    finally:
+        sa.stop()
+        ea.stop(drain=False)
+        eu.stop(drain=False)
+
+
+def test_shard_chaos_fabric_degrades_with_zero_leaks(params):
+    """Shard-level corruption on the fabric pull degrades to plain
+    re-prefill: byte-identical output, engine_kv_fabric_total{outcome=
+    "degraded"}, no hit, 0 leaked pages on both replicas."""
+    eu = Engine(params, CFG, _ec(fabric=False))
+    eu.start()
+    mu = JetStreamModel("m", "", engine=eu)
+    prompt = SHARED + "Q?"
+    ref = _gen(mu, prompt, 10)
+    try:
+        cases = {
+            "shard_torn": FabricFaultConfig(shard_torn_pull_on=1),
+            "shard_flip": FabricFaultConfig(shard_flip_pull_on=1),
+            "shard_drop": FabricFaultConfig(shard_drop_pull_on=1),
+        }
+        for name, chaos in cases.items():
+            ea = Engine(params, CFG, _ec(fabric=True, tensor_parallel=2))
+            sa = ModelServer([JetStreamModel("m", "", engine=ea)], port=0)
+            sa.start()
+            eb = Engine(params, CFG, _ec(fabric=True, tensor_parallel=2,
+                                         fabric_chaos=chaos))
+            eb.start()
+            mb = JetStreamModel("m", "", engine=eb)
+            try:
+                _gen(sa.models["m"], prompt, 10)
+                out = _gen(mb, prompt, 10, **_hint(ea, sa))
+                assert out["token_ids"] == ref["token_ids"], name
+                assert out["fabric"] == {"restore": "degraded"}, (name, out)
+                assert _fabric_count(eb, "degraded") >= 1, name
+                assert _fabric_count(eb, "hit") == 0, name
+                assert eb._fabric_chaos.stats()[
+                    "injected_shard_faults"] >= 1, name
+                assert _leak(ea) == 0 and _leak(eb) == 0, name
+            finally:
+                sa.stop()
+                ea.stop(drain=False)
+                eb.stop(drain=False)
+    finally:
+        eu.stop(drain=False)
+
+
+# ------------------------------------------------- config surface + MFU
+
+
+def test_engine_json_tensor_parallel_validation(tmp_path):
+    """engine.json tensor_parallel misconfigurations fail at load with a
+    message naming the FILE and the constraint (the role/speculative
+    validation pattern) — including refusing to silently serve at a
+    lower degree than requested."""
+    base_cfg = {"vocab_size": 64, "d_model": 32, "n_layers": 1,
+                "n_heads": 4, "n_kv_heads": 4, "d_ff": 64}
+    base_ec = {"max_slots": 2, "num_pages": 32, "page_size": 8}
+
+    def mk(name, cfgj, ecj):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "config.json").write_text(json.dumps(cfgj))
+        (d / "engine.json").write_text(json.dumps(ecj))
+        return str(d)
+
+    cases = [
+        ("zero", base_cfg, {**base_ec, "tensor_parallel": 0},
+         r"engine\.json: tensor_parallel=0 must be an integer >= 1"),
+        ("heads", base_cfg, {**base_ec, "tensor_parallel": 3},
+         r"tensor_parallel=3 must divide n_heads=4 and n_kv_heads=4"),
+        ("dff", {**base_cfg, "d_ff": 66}, {**base_ec, "tensor_parallel": 4},
+         r"tensor_parallel=4 must divide d_ff=66"),
+        ("devices", {**base_cfg, "n_heads": 16, "n_kv_heads": 16,
+                     "d_model": 64},
+         {**base_ec, "tensor_parallel": 16},
+         r"needs 16 devices, have \d+ — refusing to silently serve"),
+    ]
+    for name, cfgj, ecj, pattern in cases:
+        m = JetStreamModel("t", mk(name, cfgj, ecj))
+        with pytest.raises(ValueError, match=pattern):
+            m.load()
+    # and a valid degree really serves sharded
+    m = JetStreamModel("t", mk("good", base_cfg,
+                               {**base_ec, "tensor_parallel": 2}))
+    m.load()
+    try:
+        assert m.engine._mesh is not None
+        assert m.engine.ec.tensor_parallel == 2
+    finally:
+        m.engine.stop()
+
+
+def test_per_mesh_peak_flops_label_and_honesty():
+    """TP-honest MFU denominators: a TP=N TPU engine charges against N
+    chips' peak (N chips really are N× the silicon) under an xN-suffixed
+    label; the CPU fallback keeps the HOST-wide estimate un-multiplied —
+    the forced multi-device CPU mesh is virtual — but still annotates
+    the degree so per-mesh rows stay distinguishable."""
+    l1, f1 = platform_peak_flops("cpu", "", 1)
+    l4, f4 = platform_peak_flops("cpu", "", 4)
+    assert l4 == l1 + "x4"
+    assert f4 == f1  # virtual devices share the same cores
+    t1, p1 = platform_peak_flops("tpu", "TPU v5e", 1)
+    t4, p4 = platform_peak_flops("tpu", "TPU v5e", 4)
+    assert t1 == "tpu-v5e" and t4 == "tpu-v5ex4"
+    assert p4 == 4 * p1
