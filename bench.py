@@ -776,6 +776,37 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["storm"] = {"error": str(e)[:200]}
     try:
+        # campaign sidebar: serving_bench --campaign's headline
+        # (BENCH_CAMPAIGN.json) — the zero-human chaos campaign: every
+        # taxonomy class classified and closed with a named remediation
+        # (or explicit needs_human), arbitration held live (zero spec
+        # patches from the remediator thread), quarantines probe-lifted,
+        # and the on-arm's per-class attainment vs the unremediated arm
+        ca_path = os.path.join(REPO, "BENCH_CAMPAIGN.json")
+        if os.path.exists(ca_path):
+            with open(ca_path) as f:
+                ca = json.loads(f.readline())
+            on = ca.get("remediation_on") or {}
+            off = ca.get("remediation_off") or {}
+            out["campaign"] = {
+                "campaign_pass": ca.get("campaign_pass"),
+                "incidents_by_cause": on.get("incidents_by_cause"),
+                "bundles_closed_with_remediation":
+                    on.get("bundles_closed_with_remediation"),
+                "incidents_on": on.get("incidents"),
+                "human_actions": on.get("human_actions"),
+                "escalations": on.get("escalations"),
+                "remediator_spec_patches":
+                    on.get("remediator_spec_patches"),
+                "replicas_final": on.get("replicas_final"),
+                "quarantine_lifts": on.get("quarantine_lifts"),
+                "attainment_on": on.get("attainment"),
+                "attainment_off": off.get("attainment"),
+                "platform": ca.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["campaign"] = {"error": str(e)[:200]}
+    try:
         # sessions sidebar: serving_bench --sessions's headline
         # (BENCH_SESSIONS.json) — warm-vs-cold TTFT per tier is the tiered-
         # KV payoff, the identity/leak/reconcile flags are the durability

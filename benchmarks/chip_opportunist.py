@@ -295,6 +295,20 @@ JOBS = [
                                   "--out",
                                   os.path.join(REPO, "BENCH_STORM.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # zero-human chaos campaign on a real chip (README "Self-driving
+    # fleet"): the seeded storm + per-class fault timeline rides real
+    # device step times, so the remediation rails (cooldowns, arbitration
+    # with the live autoscaler, quarantine probes) race real latencies
+    # instead of the CPU tick-floor simulation; refreshes
+    # BENCH_CAMPAIGN.json with the platform=tpu record
+    {"name": "serving_campaign_tiny",
+     "cmd": _serving_cmd("tiny", ["--campaign", "--campaign-duration", "4",
+                                  "--campaign-replicas", "2",
+                                  "--campaign-tick-floor", "0.002",
+                                  "--out",
+                                  os.path.join(REPO,
+                                               "BENCH_CAMPAIGN.json")]),
+     "timeout": 1500, "first_timeout": 900},
     {"name": "perf_introspect_tiny",
      "cmd": _serving_cmd("tiny", ["--perf", "--requests", "16",
                                   "--concurrency", "4",

@@ -27,6 +27,7 @@ from __future__ import annotations
 import collections
 import math
 import re
+import threading
 import time
 import urllib.request
 from typing import Optional
@@ -78,6 +79,12 @@ UNHEALTHY_VETO_WINDOW_S = 30.0
 # triggered once per window so a sustained oscillation is one incident
 FLAP_WINDOW_S = 10.0
 FLAP_FLIPS = 3
+# how long the open-incident scale-down veto may hold before scaling
+# resumes anyway (README "Self-driving fleet"): the veto protects
+# capacity through a fault story, but an incident nobody can remediate
+# (and that refuses to resolve) must not pin the fleet size forever —
+# the same bounded-veto posture as UNHEALTHY_VETO_WINDOW_S
+INCIDENT_VETO_MAX_HOLD_S = 60.0
 
 # slo_attainment_ratio{class="...",metric="...",model="..."} sample keys in
 # a scraped exposition (the engine registry's per-class SLO gauges,
@@ -156,12 +163,27 @@ class ConcurrencyAutoscaler:
         # loaded) doesn't read its whole cumulative history as fresh
         # growth and ratchet replicas up.  README "Overload control".
         self._rejected_last: dict[str, dict] = {}
+        # ---- single-writer arbitration (README "Self-driving fleet"):
+        # the remediator PROPOSES replica floors here (remediator.py);
+        # _autoscale folds unexpired proposals into desired exactly like
+        # the rejected-counter and SLO actuators, and _scale() stays the
+        # ONLY writer of spec.replicas — the two controllers can never
+        # duel.  Proposals expire (TTL) and are pruned per sync, so a
+        # dead remediator cannot pin fleet size.  Written from the
+        # remediator thread, read on the sync thread.
+        self._prop_lock = threading.Lock()
+        self._proposals: dict = {}  # guarded-by: _prop_lock
+        # deployment uid -> monotonic time the open-incident veto first
+        # held (bounds the veto at INCIDENT_VETO_MAX_HOLD_S)
+        self._incident_hold_since: dict[str, float] = {}
 
     def sync(self) -> bool:
         changed = False
         self._live_uids = set()
         deploy_uids = set()
+        live_names = set()
         for deploy in self.api.list("Deployment"):
+            live_names.add(deploy["metadata"]["name"])
             ann = deploy["metadata"].get("annotations", {})
             if TARGET_CONCURRENCY_ANNOTATION not in ann:
                 continue
@@ -187,7 +209,51 @@ class ConcurrencyAutoscaler:
         for uid in list(self._rejected_last):
             if uid not in deploy_uids:
                 del self._rejected_last[uid]
+        for uid in list(self._incident_hold_since):
+            if uid not in deploy_uids:
+                del self._incident_hold_since[uid]
+        # drop expired / orphaned remediation proposals
+        now_mono = time.monotonic()
+        with self._prop_lock:
+            for name in list(self._proposals):
+                if (self._proposals[name][1] <= now_mono
+                        or name not in live_names):
+                    del self._proposals[name]
         return changed
+
+    # ---- remediation proposals (single-writer arbitration) ----------------
+
+    def propose_floor(self, deployment: str, replicas: int,
+                      ttl_s: float = 30.0, reason: str = "") -> None:
+        """The remediator's ONLY way to move replica counts: propose a
+        floor for one Deployment.  The next sync folds it into desired
+        (never above maxReplicas, never below what load already wants)
+        and ``_scale()`` — this class — applies it; the proposal expires
+        after ``ttl_s``.  Idempotent per deployment: the newest proposal
+        wins."""
+        with self._prop_lock:
+            self._proposals[str(deployment)] = (
+                int(replicas), time.monotonic() + float(ttl_s),
+                str(reason))
+
+    def proposals(self) -> dict:
+        """Unexpired remediation proposals, for status surfaces:
+        ``{deployment: {"floor": n, "expires_in_s": t, "reason": r}}``."""
+        now = time.monotonic()
+        with self._prop_lock:
+            return {name: {"floor": floor,
+                           "expires_in_s": round(exp - now, 3),
+                           "reason": reason}
+                    for name, (floor, exp, reason)
+                    in self._proposals.items() if exp > now}
+
+    def _proposal_floor(self, deployment: str) -> Optional[int]:
+        now = time.monotonic()
+        with self._prop_lock:
+            prop = self._proposals.get(deployment)
+            if prop is None or prop[1] <= now:
+                return None
+            return prop[0]
 
     def _autoscale(self, deploy: Obj, ann: dict) -> bool:
         target = max(1.0, float(ann[TARGET_CONCURRENCY_ANNOTATION]))
@@ -307,6 +373,15 @@ class ConcurrencyAutoscaler:
                 slo_violated = True
                 desired = max(desired, min(current + 1, max_r))
 
+        # remediation proposal fold (single-writer arbitration, README
+        # "Self-driving fleet"): an unexpired floor proposed by the
+        # remediator raises desired — clamped to maxReplicas, never
+        # lowered below what load already wants — and the _scale() call
+        # below remains the ONLY spec.replicas writer in the fleet
+        prop = self._proposal_floor(deploy["metadata"]["name"])
+        if prop is not None:
+            desired = max(desired, min(prop, max_r))
+
         if desired > current:
             self._downscale_since.pop(uid, None)
             return self._scale(deploy, desired, zero=False)
@@ -318,15 +393,27 @@ class ConcurrencyAutoscaler:
             self._downscale_since.pop(uid, None)
             return False
 
-        if (self.incidents is not None and desired < current
-                and self.incidents.open_count() > 0):
+        if self.incidents is not None and desired < current:
             # an OPEN incident means the fleet is mid-fault (failover
             # burst, degradation storm, burn): shrinking capacity while
             # the story is still unfolding is how outages compound.
-            # Incidents auto-resolve after their quiet window, so this
-            # veto cannot pin the fleet size forever.
-            self._downscale_since.pop(uid, None)
-            return False
+            # Refined by the remediation plane (README "Self-driving
+            # fleet"): only incidents with NO remediation in flight
+            # veto — one whose playbook is already executing is being
+            # handled, and holding capacity hostage to it would fight
+            # the very remediation fixing it.  The veto is also bounded
+            # at INCIDENT_VETO_MAX_HOLD_S: incidents auto-resolve after
+            # their quiet window, but a pathologically re-firing one
+            # must not pin the fleet size forever.
+            count = getattr(self.incidents, "unremediated_open_count",
+                            self.incidents.open_count)
+            if count() > 0:
+                first = self._incident_hold_since.setdefault(uid, now)
+                if now - first < INCIDENT_VETO_MAX_HOLD_S:
+                    self._downscale_since.pop(uid, None)
+                    return False
+            else:
+                self._incident_hold_since.pop(uid, None)
 
         if unhealthy:
             # any UNHEALTHY replica means the fleet's real capacity is

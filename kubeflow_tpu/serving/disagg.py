@@ -180,6 +180,12 @@ class HandoffStore:
         # total so the eviction loop never re-sums the whole store
         self._entries: dict = {}
         self._used = 0
+        # tier quarantine (README "Self-driving fleet"): while set, the
+        # store refuses new exports and answers pulls as misses — the
+        # engine's existing degradation contract (unified-path fallback /
+        # re-prefill) becomes the tier's serving mode until lifted
+        self._quarantined = False
+        self.quarantine_refusals = 0
         self.exports = 0
         self.pulls = 0
         self.refused = 0      # second pull of a consumed handle
@@ -201,6 +207,9 @@ class HandoffStore:
         now = self._clock()
         n = len(data)
         with self._lock:
+            if self._quarantined:
+                self.quarantine_refusals += 1
+                return None
             self._sweep_locked(now)
             if n > self.max_bytes:
                 return None
@@ -228,6 +237,11 @@ class HandoffStore:
         must not inflate the stores that simply don't own it."""
         now = self._clock()
         with self._lock:
+            if self._quarantined:
+                # quarantined tier: every pull reads as a miss (stable
+                # outcome vocabulary) and the decode side re-prefills
+                self.quarantine_refusals += 1
+                return "miss", None
             e = self._entries.get(handle)
             if e is not None and e["expires"] <= now:
                 self._used -= e["nbytes"]
@@ -271,6 +285,17 @@ class HandoffStore:
             self._entries.clear()
             self._used = 0
 
+    def set_quarantined(self, quarantined: bool) -> None:
+        """Tier quarantine switch (remediator.TierQuarantine enforcer):
+        pending exports stay resident — a lift resumes pulls without
+        losing frames exported just before the quarantine."""
+        with self._lock:
+            self._quarantined = bool(quarantined)
+
+    def quarantined(self) -> bool:
+        with self._lock:
+            return self._quarantined
+
     def stats(self) -> dict:
         with self._lock:
             live = [e for e in self._entries.values()
@@ -284,4 +309,6 @@ class HandoffStore:
                 "expired": self.expired,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                "quarantined": self._quarantined,
+                "quarantine_refusals": self.quarantine_refusals,
             }

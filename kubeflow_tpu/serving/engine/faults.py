@@ -131,6 +131,31 @@ EXPECTED_INCIDENT_CAUSES = {
     "storm:overload": "capacity",
 }
 
+# Root cause -> the remediation playbook the self-driving fleet runs for
+# it (serving/remediator.py CAUSE_PLAYBOOK — kept as a LITERAL here, not
+# an import: faults.py is engine-side and must not pull the serving
+# control plane; tests/test_remediation.py pins the two tables equal).
+_CAUSE_PLAYBOOK = {
+    "replica_death": "replace_replica",
+    "prefill_interference": "split_roles",
+    "capacity": "prescale",
+    "storage_degradation": "quarantine_tier",
+    "handoff_degradation": "quarantine_tier",
+    "fabric_degradation": "quarantine_tier",
+    "unknown": "observe",
+}
+
+# Chaos class -> {cause, playbook}: the full expected-remediation
+# contract (README "Self-driving fleet").  A new injector class must
+# declare not just what NAMES it (EXPECTED_INCIDENT_CAUSES) but what
+# FIXES it — consumed by tests/test_remediation.py and the chaos-
+# campaign bench (``serving_bench --campaign``), which gates on every
+# fired class ending in its named playbook with zero human actions.
+EXPECTED_REMEDIATIONS = {
+    key: {"cause": cause, "playbook": _CAUSE_PLAYBOOK[cause]}
+    for key, cause in EXPECTED_INCIDENT_CAUSES.items()
+}
+
 
 class ChaosThreadDeath(BaseException):
     """Injected loop-thread death; BaseException so isolation boundaries

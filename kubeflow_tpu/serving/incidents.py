@@ -228,7 +228,8 @@ class IncidentConfig:
 
 def timeline(incident: dict) -> list:
     """Render one incident as the responder's timeline: detector firing →
-    evidence refs → classification → (symptoms …) → resolution.  Served
+    evidence refs → classification → (symptoms …) → remediation
+    decisions → resolution.  Served
     by ``GET /fleet/incidents/<id>`` and ``GET /engine/incidents/<id>``."""
     rows = []
     symptoms = incident.get("symptoms") or []
@@ -250,6 +251,12 @@ def timeline(incident: dict) -> list:
     for s in symptoms[1:]:
         rows.append({"t_s": s.get("t_s"), "step": "symptom",
                      "detector": s.get("detector"), "kind": s.get("kind")})
+    rem = incident.get("remediation") or {}
+    for a in rem.get("actions") or ():
+        rows.append({"t_s": a.get("t_s"), "step": "remediation",
+                     "playbook": a.get("playbook"),
+                     "outcome": a.get("outcome"),
+                     "dry_run": bool(a.get("dry_run"))})
     if incident.get("state") == "resolved":
         rows.append({"t_s": incident.get("duration_s"), "step": "resolved",
                      "reason": (incident.get("resolution") or {})
@@ -316,6 +323,11 @@ class IncidentManager:
             collections.OrderedDict()
         self._bundle_paths: list = []
         self._pollers: list = []
+        # remediation subscribers (README "Self-driving fleet"): called
+        # on the manager thread with a DEEP COPY of each newly opened or
+        # resolving incident — a remediator must never write through to
+        # the live dict except via annotate_remediation()
+        self._subscribers: list = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -341,6 +353,25 @@ class IncidentManager:
         manager thread (the SLO burn detector reads rolling windows that
         nothing events on).  Pollers call ``feed()`` themselves."""
         self._pollers.append(fn)
+
+    def subscribe(self, fn: Callable[[dict], None]) -> None:
+        """Register an incident subscriber (the remediation controller,
+        remediator.py): called on the MANAGER thread with a deep copy of
+        each incident when it opens and when it resolves.  Subscribers
+        must be O(1) (enqueue + wake) — they run inside the correlation
+        pass."""
+        self._subscribers.append(fn)
+
+    def _notify(self, inc: dict) -> None:
+        if not self._subscribers:
+            return
+        snap = copy.deepcopy({k: v for k, v in inc.items()
+                              if not k.startswith("_")})
+        for fn in self._subscribers:
+            try:
+                fn(snap)
+            except Exception:  # noqa: BLE001 — a subscriber must not
+                pass           # crash the incident plane
 
     # ------------------------------------------------------------ lifecycle
 
@@ -398,6 +429,54 @@ class IncidentManager:
         with self._lock:
             return sum(1 for i in self._incidents.values()
                        if i.get("state") == "open")
+
+    def unremediated_open_count(self) -> int:
+        """Open incidents with NO remediation in flight — the
+        autoscaler's scale-down veto input (README "Self-driving
+        fleet"): an incident whose playbook is already executing (or
+        that was explicitly escalated to a human) must not pin fleet
+        size; one nobody has answered still does."""
+        with self._lock:
+            return sum(
+                1 for i in self._incidents.values()
+                if i.get("state") == "open"
+                and (i.get("remediation") or {}).get("status")
+                not in ("in_flight", "escalated"))
+
+    # ------------------------------------------------------ remediation
+
+    # per-incident remediation action cap: the flap guard escalates long
+    # before this, so the cap only defends the bundle size against a
+    # misbehaving annotator
+    MAX_REMEDIATION_ACTIONS = 16
+
+    def annotate_remediation(self, incident_id: str, action: dict,
+                             status: Optional[str] = None) -> bool:
+        """Record one remediation decision into the incident it answers
+        (remediator.py calls this for every playbook outcome, dry-run
+        included) and re-write its bundle: the postmortem timeline reads
+        detector → classification → remediation → resolution.  False
+        when the incident is not held here — the fleet-merge path probes
+        every manager and only the origin accepts."""
+        with self._lock:
+            inc = self._incidents.get(incident_id)
+            if inc is None:
+                return False
+            rem = inc.setdefault("remediation",
+                                 {"playbook": None, "status": "none",
+                                  "actions": []})
+            entry = {k: v for k, v in action.items()}
+            entry.setdefault("t_s", round(time.monotonic()
+                                          - inc["_opened_t"], 4))
+            if len(rem["actions"]) < self.MAX_REMEDIATION_ACTIONS:
+                rem["actions"].append(entry)
+            else:
+                rem["actions_dropped"] = rem.get("actions_dropped", 0) + 1
+            rem["playbook"] = action.get("playbook") or rem["playbook"]
+            if status is not None:
+                rem["status"] = status
+        self._write_bundle(inc)
+        return True
 
     def stats(self) -> dict:
         with self._lock:
@@ -516,6 +595,7 @@ class IncidentManager:
         self._write_bundle(inc)
         if self.on_open_count is not None:
             self.on_open_count(self.open_count())
+        self._notify(inc)
 
     def _resolve_quiet(self, now: float) -> None:
         resolved = []
@@ -536,6 +616,7 @@ class IncidentManager:
             self._write_bundle(inc)
             if self.on_resolve is not None:
                 self.on_resolve(inc["cause"])
+            self._notify(inc)
         if resolved and self.on_open_count is not None:
             self.on_open_count(self.open_count())
 

@@ -127,6 +127,12 @@ class FabricStore:
         self._entries: dict = {}
         self._used = 0
         self._seq = 0  # LRU clock (monotonic touch counter)
+        # tier quarantine (README "Self-driving fleet"): while set, the
+        # store refuses publishes and answers every pull as a miss — the
+        # callers' existing degradation contract (local re-prefill)
+        # becomes the tier's serving mode until the probe lifts it
+        self._quarantined = False
+        self.quarantine_refusals = 0
         self.publishes = 0
         self.republishes = 0   # publish of a key already present (refresh)
         self.rejected = 0      # budget could not fit the frame
@@ -152,6 +158,9 @@ class FabricStore:
         n = len(data)
         ttl = self.ttl_s if ttl_s is None else float(ttl_s)
         with self._lock:
+            if self._quarantined:
+                self.quarantine_refusals += 1
+                return False
             self._sweep_locked(now)
             if n > self.max_bytes:
                 self.rejected += 1
@@ -192,9 +201,22 @@ class FabricStore:
         ``pages`` pages — the publisher's cheap skip check (snapshotting
         device pages per finish is the expensive half, not this)."""
         with self._lock:
+            if self._quarantined:
+                return False
             e = self._entries.get(key)
             return (e is not None and e["expires"] > self._clock()
                     and int(e["meta"].get("pages") or 0) >= pages)
+
+    def set_quarantined(self, quarantined: bool) -> None:
+        """Tier quarantine switch (remediator.TierQuarantine enforcer):
+        entries stay resident — serving resumes the moment the health
+        probe lifts the quarantine, no re-publish storm needed."""
+        with self._lock:
+            self._quarantined = bool(quarantined)
+
+    def quarantined(self) -> bool:
+        with self._lock:
+            return self._quarantined
 
     def pull(self, key: str, count_miss: bool = True):
         """-> (outcome, data|None): outcome in {"ok", "expired", "miss"}.
@@ -205,6 +227,12 @@ class FabricStore:
         inflate the stores that never published it."""
         now = self._clock()
         with self._lock:
+            if self._quarantined:
+                # a quarantined tier answers every pull as a miss: the
+                # puller's existing contract degrades it to re-prefill,
+                # and the outcome vocabulary stays stable for callers
+                self.quarantine_refusals += 1
+                return "miss", None
             e = self._entries.get(key)
             if e is None:
                 if count_miss:
@@ -243,6 +271,9 @@ class FabricStore:
         counts, and the text fingerprint ladder the router matches on."""
         now = self._clock()
         with self._lock:
+            if self._quarantined:
+                return []  # stop advertising: placement must not score
+                # a tier that will refuse the pull
             live = [(k, e) for k, e in self._entries.items()
                     if e["expires"] > now]
             live.sort(key=lambda ke: -ke[1]["touched"])
@@ -266,4 +297,6 @@ class FabricStore:
                 "misses": self.misses,
                 "expired": self.expired,
                 "evictions": self.evictions,
+                "quarantined": self._quarantined,
+                "quarantine_refusals": self.quarantine_refusals,
             }

@@ -273,6 +273,24 @@ class ServiceProxy:
         self.traces = tracing.TraceStore(
             max_traces=512, max_bytes=2_000_000,
             on_evict=lambda n: INGRESS_TRACE_EVICTIONS.inc(n))
+        # self-driving fleet (README "Self-driving fleet"): the attached
+        # FleetRemediator (attach_remediator) — its TierQuarantine gates
+        # fabric/handoff placement below, and GET /fleet/remediation
+        # serves its action log.  None = remediation plane off.
+        self.remediator = None
+        self.quarantine = None
+
+    def attach_remediator(self, remediator) -> None:
+        """Wire the remediation controller (remediator.FleetRemediator):
+        every existing service's incident manager is attached (new ones
+        attach in ``_start``), and the remediator's tier quarantine
+        becomes the placement gate ``_plan_fabric``/``_plan_disagg``
+        consult."""
+        self.remediator = remediator
+        self.quarantine = getattr(remediator, "quarantine", None)
+        for state in list(self._states.values()):
+            if state.incidents is not None:
+                remediator.attach(state.incidents)
 
     def sync(self) -> bool:
         changed = False
@@ -309,6 +327,10 @@ class ServiceProxy:
             on_open_count=lambda n, s=state: INCIDENTS_OPEN.set(
                 n, service=s.service_name))
         state.incidents.start()
+        if self.remediator is not None:
+            # a service started after attach_remediator still gets its
+            # incidents remediated (attach is idempotent per manager)
+            self.remediator.attach(state.incidents)
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -344,6 +366,9 @@ class ServiceProxy:
                         proxy._serve_fleet_incident(
                             self, state,
                             path[len("/fleet/incidents/"):])
+                        return
+                    if path == "/fleet/remediation":
+                        proxy._serve_fleet_remediation(self, state)
                         return
                 proxy._relay(self, state, body)
 
@@ -1262,6 +1287,12 @@ class ServiceProxy:
         mode = str(ann.get(disagg.DISAGG_ANNOTATION, "auto")).lower()
         if mode == "off" or handler.command != "POST" or payload is None:
             return None
+        q = self.quarantine
+        if q is not None and q.active("handoff"):
+            # handoff tier quarantined (README "Self-driving fleet"):
+            # no prefill/decode splits are planned — requests relay
+            # unified (degraded-local) until the probe lifts it
+            return None
         if not disagg.eligible_path(handler.path):
             return None
         model = disagg.model_from_path(handler.path)
@@ -1690,6 +1721,22 @@ class ServiceProxy:
             "timeline": incidents_mod.timeline(found),
         }, default=str).encode())
 
+    def _serve_fleet_remediation(self, handler, state: _ProxyState) -> None:
+        """GET /fleet/remediation: the self-driving fleet's action log —
+        every playbook decision (dry-run included), quarantine state,
+        escalations, and the autoscaler floor proposals currently in
+        flight (README "Self-driving fleet")."""
+        rem = self.remediator
+        if rem is None:
+            handler._reply(404, json.dumps(
+                {"error": "no remediator attached"}).encode())
+            return
+        body = rem.status()
+        asc = getattr(rem, "autoscaler", None)
+        if asc is not None and hasattr(asc, "proposals"):
+            body["proposals"] = asc.proposals()
+        handler._reply(200, json.dumps(body, default=str).encode())
+
     # ------------------------------------- global cache-aware placement
     # (README "Fleet KV fabric"): the fleet-scope replacement for the
     # per-replica prefix-affinity LRU.  Every request's prompt is reduced
@@ -1709,6 +1756,12 @@ class ServiceProxy:
         generate path, already a disagg phase, or carrying its own
         fabric hint)."""
         if handler.command != "POST" or not isinstance(payload, dict):
+            return None
+        q = self.quarantine
+        if q is not None and q.active("fabric"):
+            # fabric tier quarantined (README "Self-driving fleet"):
+            # no remote-prefix placement, no pull hints — every request
+            # serves degraded-local until the health probe lifts it
             return None
         if not disagg.eligible_path(handler.path):
             return None
@@ -2385,6 +2438,20 @@ class _ProxyIncidentView:
         return sum(s.incidents.open_count()
                    for s in list(self._proxy._states.values())
                    if s.incidents is not None)
+
+    def unremediated_open_count(self) -> int:
+        """Open incidents with no remediation in flight, across every
+        service — the autoscaler's refined scale-down veto input (README
+        "Self-driving fleet")."""
+        total = 0
+        for s in list(self._proxy._states.values()):
+            mgr = s.incidents
+            if mgr is None:
+                continue
+            count = getattr(mgr, "unremediated_open_count",
+                            mgr.open_count)
+            total += count()
+        return total
 
     def feed(self, kind: str, **attrs) -> None:
         """Route to the service owning ``attrs['deployment']`` (Services

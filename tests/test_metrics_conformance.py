@@ -4,9 +4,10 @@ versa — the table had drifted across seven PRs of new counters, and a
 dashboard built from stale docs silently graphs nothing.
 
 Scope: the serving observability namespaces (``engine_*``, ``ingress_*``,
-``slo_*``, and the incident-plane ``incident*`` series — registered
-identically in the engine registry and the core registry's ingress scope)
-that live in a Registry the test can enumerate.  The flat
+``slo_*``, the incident-plane ``incident*`` series — registered
+identically in the engine registry and the core registry's ingress scope
+— and the self-driving fleet's ``remediation_*`` series) that live in a
+Registry the test can enumerate.  The flat
 ``extra_metrics`` gauges (engine_queue_depth & co) are a scrape-surface,
 not registry metrics, and stay out of scope — as do the controller/
 training-operator counters, which predate the serving plane.
@@ -22,11 +23,12 @@ pytestmark = pytest.mark.obs
 README = Path(__file__).resolve().parent.parent / "README.md"
 
 # serving-observability namespaces under conformance
-_SCOPE = re.compile(r"^(engine_|ingress_|slo_|incident)")
+_SCOPE = re.compile(r"^(engine_|ingress_|slo_|incident|remediation_)")
 
 
 def registered_names() -> set:
     from kubeflow_tpu.core.metrics import REGISTRY
+    from kubeflow_tpu.serving import remediator  # noqa: F401 — remediation_*
     from kubeflow_tpu.serving import router  # noqa: F401 — registers ingress_*
     from kubeflow_tpu.serving.engine.telemetry import EngineTelemetry
 

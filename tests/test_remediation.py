@@ -1,0 +1,780 @@
+"""Self-driving fleet tests (README "Self-driving fleet",
+serving/remediator.py).
+
+Coverage per the ISSUE 17 satellite list:
+
+  * the ``faults.EXPECTED_REMEDIATIONS`` contract: every chaos class maps
+    chaos -> cause -> playbook, in lockstep with the incident taxonomy
+    and the remediator's own ``CAUSE_PLAYBOOK`` table;
+  * per-playbook rails with explicit clocks: cooldown defers (then
+    retries), the global rate budget throttles, starvation past
+    ``defer_max`` escalates, the flap guard escalates the same
+    (cause, target) to needs_human and stays sticky, dry-run annotates
+    the full plan with ZERO actuator calls;
+  * single-writer arbitration: the remediator never patches
+    ``spec.replicas`` — it proposes floors, the autoscaler's next sync
+    applies them exactly once (no double-scale), and proposals TTL out;
+  * quarantine round trips: probe-streak-gated un-quarantine (one bad
+    probe resets the streak), FabricStore/HandoffStore enforcement
+    (refused publishes/pulls while quarantined, resident entries serve
+    again after the lift);
+  * the refined scale-down veto: only UNREMEDIATED open incidents veto,
+    in-flight/escalated remediation releases it, and the veto is bounded
+    by ``INCIDENT_VETO_MAX_HOLD_S``;
+  * predictive prescale: the seeded storm envelope is forecast
+    deterministically, the floor is proposed BEFORE the burst trips, and
+    an unchanged forecast is never re-proposed;
+  * e2e, one-fault -> one-incident -> one-action -> one-closed-bundle
+    for every taxonomy cause (explicit-clock managers for the per-cause
+    battery; a real ServiceProxy + failover storm for the ingress path,
+    GET /fleet/remediation included).
+"""
+
+import copy
+import json
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.core.api import APIServer
+from kubeflow_tpu.serving import incidents as I
+from kubeflow_tpu.serving import remediator as R
+from kubeflow_tpu.serving.api import (LABEL_ISVC,
+                                      TARGET_CONCURRENCY_ANNOTATION)
+from kubeflow_tpu.serving.autoscaler import ConcurrencyAutoscaler
+from kubeflow_tpu.serving.controllers import (
+    DEPLOYMENT_FOR_SERVICE_ANNOTATION, POD_PORT_ANNOTATION,
+    PROXY_PORT_ANNOTATION)
+from kubeflow_tpu.serving.disagg import (DISAGG_ANNOTATION, ROLE_ANNOTATION,
+                                         HandoffStore, pod_role)
+from kubeflow_tpu.serving.engine.faults import (EXPECTED_INCIDENT_CAUSES,
+                                                EXPECTED_REMEDIATIONS,
+                                                StormFaultConfig)
+from kubeflow_tpu.serving.kvfabric import FabricStore
+
+pytestmark = pytest.mark.remediation
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+# --------------------------------------------------------------- test doubles
+
+
+class _StubMgr:
+    """An incident source the rails tests drive with synthetic incident
+    dicts — annotations are recorded, never re-clocked, so the tests own
+    every timestamp."""
+
+    def __init__(self, *incidents):
+        self.incidents = list(incidents)
+        self.annotations = []  # (incident_id, action, status)
+
+    def list(self):
+        return [copy.deepcopy(i) for i in self.incidents]
+
+    def annotate_remediation(self, incident_id, action, status=None):
+        if not any(i["id"] == incident_id for i in self.incidents):
+            return False
+        self.annotations.append((incident_id, dict(action), status))
+        return True
+
+
+class _AscSpy:
+    """Records floor proposals; never scales anything."""
+
+    def __init__(self):
+        self.calls = []  # (deployment, floor)
+
+    def propose_floor(self, deployment, replicas, ttl_s=30.0, reason=""):
+        self.calls.append((deployment, int(replicas)))
+
+    def proposals(self):
+        return {}
+
+
+def _inc(inc_id, cause, scope="ingress:svc", symptoms=()):
+    return {"id": inc_id, "state": "open", "cause": cause, "scope": scope,
+            "symptoms": list(symptoms)}
+
+
+def _deployment(name="d", replicas=2, target="4"):
+    return {"apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name,
+                         "annotations": {
+                             TARGET_CONCURRENCY_ANNOTATION: target}},
+            "spec": {"replicas": replicas,
+                     "selector": {"matchLabels": {"app": name}},
+                     "template": {"metadata": {"labels": {"app": name}},
+                                  "spec": {"containers": [
+                                      {"name": "c", "command": ["x"]}]}}}}
+
+
+def _service(name="svc", deployments=("d",), extra_ann=None):
+    ann = {DEPLOYMENT_FOR_SERVICE_ANNOTATION: json.dumps(list(deployments))}
+    ann.update(extra_ann or {})
+    return {"apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": name, "annotations": ann,
+                         "labels": {LABEL_ISVC: name}},
+            "spec": {"selector": {"app": name}}}
+
+
+def _pod(name, role=None, ready=True, port=None):
+    ann = {}
+    if role is not None:
+        ann[ROLE_ANNOTATION] = role
+    if port is not None:
+        ann[POD_PORT_ANNOTATION] = str(port)
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "labels": {"app": "svc"},
+                         "annotations": ann},
+            "spec": {},
+            "status": {"phase": "Running",
+                       "conditions": [{"type": "Ready",
+                                       "status": "True" if ready
+                                       else "False"}]}}
+
+
+def _api_with(*objs):
+    api = APIServer()
+    for o in objs:
+        api.create(o)
+    return api
+
+
+def _cfg(**kw):
+    base = dict(cooldown_s=0.0, rate_budget=100, rate_window_s=60.0,
+                flap_max=100, flap_window_s=60.0)
+    base.update(kw)
+    return R.RemediatorConfig(**base)
+
+
+# ----------------------------------------------------------------- contract
+
+
+def test_expected_remediations_contract():
+    """chaos class -> cause -> playbook, one table, no drift: every
+    chaos class the repo can inject names the cause the incident plane
+    classifies AND the playbook the remediator runs for it."""
+    assert set(EXPECTED_REMEDIATIONS) == set(EXPECTED_INCIDENT_CAUSES)
+    for key, spec in EXPECTED_REMEDIATIONS.items():
+        assert spec["cause"] == EXPECTED_INCIDENT_CAUSES[key]
+        assert spec["playbook"] == R.CAUSE_PLAYBOOK[spec["cause"]], key
+        assert spec["playbook"] in R.PLAYBOOKS
+    # the playbook table covers the incident taxonomy exactly
+    assert set(R.CAUSE_PLAYBOOK) == set(I.CAUSES)
+
+
+# ------------------------------------------------------------------- rails
+
+
+def test_cooldown_defers_then_executes():
+    """Two same-playbook incidents in one pass: the second waits out the
+    per-playbook cooldown, then the rescan retries and executes it —
+    deferred, never dropped."""
+    mgr = _StubMgr(_inc("a", "capacity", scope="ingress:s1"),
+                   _inc("b", "capacity", scope="ingress:s2"))
+    asc = _AscSpy()
+    r = R.FleetRemediator(api=_api_with(_deployment()), autoscaler=asc,
+                          config=_cfg(cooldown_s=5.0))
+    r.attach(mgr)
+    r._process(1000.0)
+    assert len(asc.calls) == 1  # a executed, b cooling
+    r._process(1004.0)
+    assert len(asc.calls) == 1  # still inside the cooldown
+    r._process(1006.0)
+    assert len(asc.calls) == 2  # cooldown over -> b executed
+    # b's bundle named the PLANNED action while it waited (an incident
+    # may self-resolve mid-deferral; its postmortem must not be empty)
+    assert [s for _, _, s in mgr.annotations] \
+        == ["in_flight", "deferred", "in_flight"]
+    assert mgr.annotations[1][1]["playbook"] == "prescale"
+
+
+def test_rate_budget_throttles_across_playbooks():
+    """At most rate_budget executed actions per window, globally."""
+    mgr = _StubMgr(*[_inc(f"i{k}", "capacity", scope=f"ingress:s{k}")
+                     for k in range(3)])
+    asc = _AscSpy()
+    r = R.FleetRemediator(api=_api_with(_deployment()), autoscaler=asc,
+                          config=_cfg(rate_budget=2))
+    r.attach(mgr)
+    r._process(2000.0)
+    assert len(asc.calls) == 2  # budget spent; third deferred
+    r._process(2001.0)
+    assert len(asc.calls) == 2  # window still open
+    r._process(2070.0)          # window rolled off
+    assert len(asc.calls) == 3
+
+
+def test_starved_incident_escalates_past_defer_max():
+    """A budget that never frees must not leave the bundle silently
+    open: past defer_max deferrals the incident escalates."""
+    mgr = _StubMgr(_inc("a", "capacity"))
+    r = R.FleetRemediator(api=_api_with(_deployment()),
+                          autoscaler=_AscSpy(),
+                          config=_cfg(rate_budget=0, defer_max=2))
+    r.attach(mgr)
+    r._process(0.0)  # first deferral marks the planned action
+    assert [s for _, _, s in mgr.annotations] == ["deferred"]
+    assert mgr.annotations[0][1]["playbook"] == "prescale"
+    r._process(1.0)  # repeat deferrals stay silent
+    assert len(mgr.annotations) == 1
+    r._process(2.0)  # deferrals exceed defer_max
+    assert len(mgr.annotations) == 2
+    _, action, status = mgr.annotations[-1]
+    assert status == "escalated"
+    assert action["playbook"] == "needs_human"
+    assert "starved" in action["detail"]["reason"]
+
+
+def test_flap_guard_escalates_and_sticks():
+    """The same (cause, target) remediated flap_max times inside the
+    window escalates to needs_human instead of oscillating, stays
+    escalated for the window, and resumes after it rolls off."""
+    asc = _AscSpy()
+    mgr = _StubMgr()
+    r = R.FleetRemediator(api=_api_with(_deployment()), autoscaler=asc,
+                          config=_cfg(flap_max=2))
+    r.attach(mgr)
+    esc0 = R.INCIDENTS_ESCALATED.value(cause="capacity")
+    for k, t in ((1, 0.0), (2, 1.0)):
+        mgr.incidents.append(_inc(f"i{k}", "capacity", scope="ingress:s1"))
+        r._process(t)
+    assert len(asc.calls) == 2
+    mgr.incidents.append(_inc("i3", "capacity", scope="ingress:s1"))
+    r._process(2.0)
+    assert len(asc.calls) == 2  # escalated, not executed
+    assert r.escalations == 1
+    assert R.INCIDENTS_ESCALATED.value(cause="capacity") == esc0 + 1
+    _, action, status = mgr.annotations[-1]
+    assert (status, action["playbook"]) == ("escalated", "needs_human")
+    # sticky inside the window: the next incident on the key escalates too
+    mgr.incidents.append(_inc("i4", "capacity", scope="ingress:s1"))
+    r._process(3.0)
+    assert r.escalations == 2 and len(asc.calls) == 2
+    # the window rolls off -> the playbook runs again
+    mgr.incidents.append(_inc("i5", "capacity", scope="ingress:s1"))
+    r._process(70.0)
+    assert len(asc.calls) == 3
+
+
+def test_dry_run_annotates_with_zero_actuator_calls():
+    """Dry-run resolves the full plan for every playbook — floors, role
+    flips, quarantine target — and makes ZERO actuator calls; the bundle
+    log reads exactly like a live run."""
+    api = _api_with(_deployment(),
+                    _service(extra_ann={DISAGG_ANNOTATION: "auto"}),
+                    _pod("p0"), _pod("p1"))
+    patches = []
+    orig = api.patch
+    api.patch = lambda *a, **k: (patches.append(a[0]), orig(*a, **k))[1]
+    asc = _AscSpy()
+    mgr = _StubMgr(_inc("c1", "capacity"),
+                   _inc("c2", "prefill_interference", scope="engine:m"),
+                   _inc("c3", "storage_degradation"))
+    r = R.FleetRemediator(api=api, autoscaler=asc,
+                          config=_cfg(dry_run=True))
+    r.attach(mgr)
+    dry0 = R.REMEDIATION_ACTIONS.value(playbook="prescale",
+                                       outcome="dry_run")
+    r._process(100.0)
+    assert asc.calls == []
+    assert patches == []
+    assert r.quarantine.list() == {}
+    assert len(mgr.annotations) == 3
+    for _, action, status in mgr.annotations:
+        assert status == "dry_run"
+        assert action["outcome"] == "dry_run"
+        assert action["dry_run"] is True
+    by_id = {i: a for i, a, _ in mgr.annotations}
+    assert by_id["c1"]["detail"]["proposals"][0]["proposed_floor"] == 3
+    assert [f["role"] for f in by_id["c2"]["detail"]["flips"]] \
+        == ["prefill", "decode"]
+    assert by_id["c3"]["detail"]["tier"] == "storage"
+    assert R.REMEDIATION_ACTIONS.value(
+        playbook="prescale", outcome="dry_run") == dry0 + 1
+    # the rails advanced: a second pass re-runs nothing
+    r._process(101.0)
+    assert len(mgr.annotations) == 3
+
+
+# ------------------------------------------------------------- arbitration
+
+
+def test_arbitration_remediator_never_writes_replicas(monkeypatch):
+    """Single-writer: the remediator only PROPOSES; the autoscaler's
+    sync applies the floor exactly once — never a second time for the
+    same proposal — and _scale() stays the only spec.replicas writer."""
+    api = _api_with(
+        _deployment(replicas=1),
+        _service(),
+    )
+    asc = ConcurrencyAutoscaler(api)
+    mgr = _StubMgr(_inc("rd", "replica_death", symptoms=[
+        {"kind": "breaker_open", "backend": "127.0.0.1:9"}]))
+    r = R.FleetRemediator(api=api, autoscaler=asc, config=_cfg())
+    r.attach(mgr)
+    patched_kinds = []
+    orig = api.patch
+    api.patch = lambda *a, **k: (patched_kinds.append(a[0]),
+                                 orig(*a, **k))[1]
+    r._process(1000.0)
+    # the remediator touched NOTHING — no Deployment (or any) patches
+    assert patched_kinds == []
+    assert asc.proposals()["d"]["floor"] == 2
+    assert "replace_replica" in asc.proposals()["d"]["reason"]
+    assert mgr.annotations[0][1]["detail"]["ejected_backends"] \
+        == ["127.0.0.1:9"]
+    # the autoscaler applies it, once
+    assert asc.sync()
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 2
+    assert patched_kinds.count("Deployment") == 1
+    # a second sync with the same standing proposal does NOT double-scale
+    asc.sync()
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 2
+    assert patched_kinds.count("Deployment") == 1
+
+
+def test_arbitration_proposals_expire():
+    """A dead remediator cannot pin fleet size: proposals TTL out and
+    the sync prunes them."""
+    api = _api_with(_deployment(replicas=2))
+    asc = ConcurrencyAutoscaler(api)
+    asc.propose_floor("d", 3, ttl_s=0.01)
+    time.sleep(0.05)
+    assert asc.proposals() == {}
+    asc.sync()
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 2
+
+
+def test_proposal_clamped_to_max_replicas():
+    """A proposed floor above maxReplicas is clamped, never applied
+    raw (default max is 3)."""
+    api = _api_with(_deployment(replicas=1))
+    asc = ConcurrencyAutoscaler(api)
+    asc.propose_floor("d", 50, ttl_s=30.0)
+    asc.sync()
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 3
+
+
+# -------------------------------------------------------------- quarantine
+
+
+def test_quarantine_probe_streak_gates_unquarantine():
+    """healthy_probes CONSECUTIVE healthy reads lift; one bad probe
+    resets the streak."""
+    mgr = _StubMgr(_inc("q1", "storage_degradation"))
+    r = R.FleetRemediator(config=_cfg(probe_interval_s=0.0,
+                                      healthy_probes=2))
+    r.attach(mgr)
+    reads = [True, False, True, True]
+    enforced = []
+    r.quarantine.register("storage", enforce=enforced.append,
+                          probe=lambda: reads.pop(0))
+    r._process(0.0)   # quarantine + probe: healthy (streak 1)
+    assert r.quarantine.active("storage")
+    assert enforced == [True]
+    assert R.REMEDIATION_QUARANTINED.value(tier="storage") == 1.0
+    r._process(1.0)   # unhealthy -> streak resets to 0
+    r._process(2.0)   # healthy (streak 1)
+    assert r.quarantine.active("storage")
+    r._process(3.0)   # healthy (streak 2) -> lift
+    assert not r.quarantine.active("storage")
+    assert enforced == [True, False]
+    assert R.REMEDIATION_QUARANTINED.value(tier="storage") == 0.0
+    lifted = [a for a in r.status()["actions"] if a["outcome"] == "lifted"]
+    assert len(lifted) == 1 and lifted[0]["target"] == "storage"
+
+
+def test_fabric_store_quarantine_enforcement():
+    """A quarantined FabricStore refuses publishes, answers every pull
+    as the CLOSED-vocabulary 'miss', hides coverage and its view — and
+    serves resident entries again the moment the quarantine lifts."""
+    fs = FabricStore()
+    assert fs.publish("k", b"frame", {"pages": 2})
+    fs.set_quarantined(True)
+    assert fs.quarantined()
+    assert fs.pull("k") == ("miss", None)
+    assert not fs.publish("k2", b"x", {"pages": 1})
+    assert not fs.covers("k", 1)
+    assert fs.view() == []
+    assert fs.quarantine_refusals == 2
+    assert fs.stats()["quarantined"] is True
+    fs.set_quarantined(False)
+    outcome, data = fs.pull("k")
+    assert (outcome, data) == ("ok", b"frame")  # entry stayed resident
+
+
+def test_handoff_store_quarantine_enforcement():
+    hs = HandoffStore()
+    handle = hs.put(b"kv", {"pages": 1})
+    assert handle is not None
+    hs.set_quarantined(True)
+    assert hs.pull(handle) == ("miss", None)
+    assert hs.put(b"kv2", {"pages": 1}) is None
+    assert hs.quarantine_refusals == 2
+    hs.set_quarantined(False)
+    outcome, data = hs.pull(handle)
+    assert (outcome, data) == ("ok", b"kv")  # exported frame survived
+
+
+# -------------------------------------------------------- scale-down veto
+
+
+class _VetoMgr:
+    """Stub exposing BOTH counts: open incidents whose remediation is in
+    flight keep open_count high while unremediated_open_count drops."""
+
+    def __init__(self, unremediated=1):
+        self.unremediated = unremediated
+
+    def open_count(self):
+        return 1
+
+    def unremediated_open_count(self):
+        return self.unremediated
+
+    def feed(self, *a, **k):
+        pass
+
+
+def test_scale_down_veto_releases_when_remediation_in_flight(monkeypatch):
+    from kubeflow_tpu.serving import autoscaler as asc_mod
+
+    mgr = _VetoMgr(unremediated=1)
+    api = _api_with(_deployment(replicas=3))
+    a = ConcurrencyAutoscaler(api, incidents=mgr)
+    monkeypatch.setattr(asc_mod, "SCALE_DOWN_WINDOW", 0.0)
+    # an unremediated open incident vetoes every shrink
+    for _ in range(3):
+        assert not a.sync()
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 3
+    # its playbook goes in-flight (open_count stays 1!) -> veto released
+    mgr.unremediated = 0
+    a.sync()                 # arms the (zeroed) stability window
+    assert a.sync()
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 1
+
+
+def test_scale_down_veto_bounded_by_max_hold(monkeypatch):
+    """An incident nobody can remediate (and that refuses to resolve)
+    must not pin the fleet size past INCIDENT_VETO_MAX_HOLD_S."""
+    from kubeflow_tpu.serving import autoscaler as asc_mod
+
+    mgr = _VetoMgr(unremediated=1)  # never remediated, never resolves
+    api = _api_with(_deployment(replicas=3))
+    a = ConcurrencyAutoscaler(api, incidents=mgr)
+    monkeypatch.setattr(asc_mod, "SCALE_DOWN_WINDOW", 0.0)
+    monkeypatch.setattr(asc_mod, "INCIDENT_VETO_MAX_HOLD_S", 0.0)
+    a.sync()                 # hold expired instantly -> window arms
+    assert a.sync()
+    assert api.get("Deployment", "d")["spec"]["replicas"] == 1
+
+
+def test_unremediated_open_count_statuses(tmp_path):
+    """The real manager's refined count: dry_run/observing/none still
+    veto (nobody is ACTING), in_flight and escalated do not."""
+    mgr = I.IncidentManager(
+        "t", I.IncidentConfig(bundle_dir=str(tmp_path)),
+        detectors=I.engine_detectors())
+    mgr.feed("watchdog", detail="died", trace_ids=[])
+    mgr._process(time.monotonic())
+    inc_id = mgr.list()[0]["id"]
+    assert mgr.open_count() == 1
+    assert mgr.unremediated_open_count() == 1
+    action = {"playbook": "replace_replica", "outcome": "dry_run"}
+    assert mgr.annotate_remediation(inc_id, action, status="dry_run")
+    assert mgr.unremediated_open_count() == 1  # annotated, not acted on
+    mgr.annotate_remediation(inc_id, action, status="in_flight")
+    assert mgr.unremediated_open_count() == 0
+    mgr.annotate_remediation(inc_id, action, status="escalated")
+    assert mgr.unremediated_open_count() == 0  # a human owns it now
+    assert mgr.open_count() == 1
+    assert not mgr.annotate_remediation("inc-nope", action)
+
+
+# ------------------------------------------------------ predictive prescale
+
+
+def test_forecast_proposes_before_the_burst():
+    """The seeded storm envelope is deterministic, so the remediator
+    proposes the burst's floor BEFORE the burst trips — and never
+    re-proposes an unchanged forecast."""
+    storm = StormFaultConfig(duration_s=100.0, base_qps=4.0,
+                             diurnal_period_s=0.0, diurnal_depth=0.0,
+                             burst_every_s=10.0, burst_len_s=2.0,
+                             burst_x=3.0)
+    # the envelope itself: flat 4 qps, x3 inside [k*10, k*10+2)
+    assert R.storm_rate_qps(storm, 5.0) == 4.0
+    assert R.storm_rate_qps(storm, 10.5) == 12.0
+    assert R.forecast_peak_qps(storm, 3.0, 2.0) == 4.0
+    assert R.forecast_peak_qps(storm, 8.75, 2.0) == 12.0
+    asc = _AscSpy()
+    r = R.FleetRemediator(autoscaler=asc, config=_cfg(
+        forecast_horizon_s=2.0, forecast_headroom=1.2))
+    r.set_forecast(storm, per_replica_qps=2.0, deployment="d", t0=1000.0)
+    r._process(1003.0)   # quiet stretch: ceil(4 * 1.2 / 2) = 3
+    assert asc.calls == [("d", 3)]
+    r._process(1007.0)   # forecast unchanged -> no re-proposal
+    assert asc.calls == [("d", 3)]
+    r._process(1008.75)  # burst at t=10 enters the horizon -> pre-scale
+    assert asc.calls == [("d", 3), ("d", 8)]  # ceil(12 * 1.2 / 2) = 8
+    proposed = [a for a in r.status()["actions"]
+                if a["outcome"] == "proposed"]
+    assert [a["detail"]["proposed_floor"] for a in proposed] == [3, 8]
+    assert proposed[-1]["detail"]["t_s"] < 10.0  # before the burst
+    r.clear_forecast()
+    r._process(1009.0)
+    assert len(asc.calls) == 2
+    assert r.status()["forecast_armed"] is False
+
+
+# ------------------------------------- e2e: one closed bundle per cause
+
+
+def _live_mgr(tmp_path, scope, detectors):
+    return I.IncidentManager(
+        scope, I.IncidentConfig(debounce_s=0.1, resolve_s=0.1,
+                                bundle_dir=str(tmp_path)),
+        detectors=detectors)
+
+
+def _assert_closed_bundle(mgr, tmp_path, inc_id, playbook):
+    """The postmortem contract: the incident resolved, its bundle names
+    the remediation, and the timeline reads detector -> classification
+    -> remediation -> resolution."""
+    mgr._process(time.monotonic() + 0.3)  # quiet window -> resolve
+    inc = mgr.get(inc_id)
+    assert inc["state"] == "resolved"
+    assert inc["remediation"]["playbook"] == playbook
+    steps = [row["step"] for row in I.timeline(inc)]
+    assert steps.index("classified") < steps.index("remediation") \
+        < steps.index("resolved")
+    bundles = [json.loads(p.read_text())
+               for p in Path(tmp_path).glob("*.json")]
+    mine = [b for b in bundles if b.get("id") == inc_id]
+    assert mine and mine[0]["remediation"]["playbook"] == playbook
+    assert mine[0]["state"] == "resolved"
+
+
+def test_e2e_replica_death_replaces_replica(tmp_path):
+    mgr = _live_mgr(tmp_path, "ingress:svc", I.ingress_detectors())
+    asc = _AscSpy()
+    r = R.FleetRemediator(api=_api_with(_deployment(), _service()),
+                          autoscaler=asc, config=_cfg())
+    r.attach(mgr)
+    mgr.feed("breaker_open", backend="127.0.0.1:9", trace_ids=[])
+    mgr._process(time.monotonic())
+    inc = mgr.list()[0]
+    assert inc["cause"] == "replica_death"
+    r._process(time.monotonic())
+    assert asc.calls == [("d", 3)]  # current 2 + prewarm_extra 1
+    rem = mgr.get(inc["id"])["remediation"]
+    assert rem["status"] == "in_flight"
+    assert rem["actions"][0]["detail"]["ejected_backends"] \
+        == ["127.0.0.1:9"]
+    _assert_closed_bundle(mgr, tmp_path, inc["id"], "replace_replica")
+
+
+def test_e2e_prefill_interference_splits_roles(tmp_path):
+    mgr = _live_mgr(tmp_path, "engine:m", I.engine_detectors())
+    api = _api_with(_service(extra_ann={DISAGG_ANNOTATION: "auto"}),
+                    _pod("p0"), _pod("p1"), _pod("p2"))
+    r = R.FleetRemediator(api=api, config=_cfg())
+    r.attach(mgr)
+    mgr.feed("slo_burn", metric="tpot", class_name="interactive",
+             prefill_active=2, trace_ids=[])
+    mgr._process(time.monotonic())
+    inc = mgr.list()[0]
+    assert inc["cause"] == "prefill_interference"
+    r._process(time.monotonic())
+    # the two lowest-named unified pods flipped to a prefill/decode pair
+    assert pod_role(api.get("Pod", "p0")) == "prefill"
+    assert pod_role(api.get("Pod", "p1")) == "decode"
+    assert pod_role(api.get("Pod", "p2")) == "unified"
+    _assert_closed_bundle(mgr, tmp_path, inc["id"], "split_roles")
+
+
+def test_e2e_split_roles_keeps_last_unified_replica(tmp_path):
+    """One unified replica left: flipping it would leave no pool able to
+    serve the complementary phase — the playbook refuses."""
+    mgr = _live_mgr(tmp_path, "engine:m", I.engine_detectors())
+    api = _api_with(_service(extra_ann={DISAGG_ANNOTATION: "auto"}),
+                    _pod("p0"), _pod("p1", role="decode"))
+    r = R.FleetRemediator(api=api, config=_cfg())
+    r.attach(mgr)
+    mgr.feed("slo_burn", metric="tpot", prefill_active=1, trace_ids=[])
+    mgr._process(time.monotonic())
+    inc_id = mgr.list()[0]["id"]
+    r._process(time.monotonic())
+    assert pod_role(api.get("Pod", "p0")) == "unified"  # untouched
+    rem = mgr.get(inc_id)["remediation"]
+    assert rem["status"] == "failed"
+    assert rem["actions"][0]["outcome"] == "skipped"
+
+
+def test_e2e_split_roles_refuses_without_disagg_routing(tmp_path):
+    """No Service routes the disagg split: prefill-role pods would take
+    no traffic at all, so flipping roles only shrinks the unified pool.
+    The playbook refuses and says why (the --campaign bench measured
+    exactly this regression before the guard existed)."""
+    mgr = _live_mgr(tmp_path, "engine:m", I.engine_detectors())
+    api = _api_with(_service(), _pod("p0"), _pod("p1"))  # disagg off
+    r = R.FleetRemediator(api=api, config=_cfg())
+    r.attach(mgr)
+    mgr.feed("slo_burn", metric="tpot", prefill_active=1, trace_ids=[])
+    mgr._process(time.monotonic())
+    inc_id = mgr.list()[0]["id"]
+    r._process(time.monotonic())
+    assert pod_role(api.get("Pod", "p0")) == "unified"  # untouched
+    rem = mgr.get(inc_id)["remediation"]
+    assert rem["status"] == "failed"
+    assert rem["actions"][0]["outcome"] == "skipped"
+    assert "disagg" in rem["actions"][0]["detail"]["reason"]
+
+
+def test_e2e_capacity_prescales(tmp_path):
+    mgr = _live_mgr(tmp_path, "ingress:svc", I.ingress_detectors())
+    asc = _AscSpy()
+    r = R.FleetRemediator(api=_api_with(_deployment(), _service()),
+                          autoscaler=asc, config=_cfg())
+    r.attach(mgr)
+    mgr.feed("shed", class_name="batch", shed=7, trace_ids=[])
+    mgr._process(time.monotonic())
+    inc = mgr.list()[0]
+    assert inc["cause"] == "capacity"
+    r._process(time.monotonic())
+    assert asc.calls == [("d", 3)]  # current 2 + 1
+    _assert_closed_bundle(mgr, tmp_path, inc["id"], "prescale")
+
+
+@pytest.mark.parametrize("source", ["storage", "handoff", "fabric"])
+def test_e2e_degradation_quarantines_and_lifts(tmp_path, source):
+    """degradation -> quarantine -> incident resolves -> the DEFAULT
+    probe (tier cause quiet across attached managers) lifts it after
+    healthy_probes consecutive reads."""
+    mgr = _live_mgr(tmp_path, "engine:m", I.engine_detectors())
+    r = R.FleetRemediator(config=_cfg(probe_interval_s=0.0,
+                                      healthy_probes=2))
+    r.attach(mgr)
+    mgr.feed("degradation", source=source, outcome="recompute",
+             trace_ids=[])
+    mgr._process(time.monotonic())
+    inc = mgr.list()[0]
+    assert inc["cause"] == f"{source}_degradation"
+    now = time.monotonic()
+    r._process(now)  # quarantine + first probe (incident open: unhealthy)
+    assert r.quarantine.active(source)
+    r._process(now + 1)
+    assert r.quarantine.active(source)  # still open -> streak stays 0
+    _assert_closed_bundle(mgr, tmp_path, inc["id"], "quarantine_tier")
+    r._process(now + 2)  # quiet: healthy 1
+    assert r.quarantine.active(source)
+    r._process(now + 3)  # healthy 2 -> lift
+    assert not r.quarantine.active(source)
+    lifted = [a for a in r.status()["actions"]
+              if a["outcome"] == "lifted"]
+    assert lifted and lifted[-1]["target"] == source
+
+
+def test_e2e_unknown_cause_observes(tmp_path):
+    """A cause no rule names gets watched, not 'fixed': observe
+    annotates and touches nothing."""
+    mgr = _live_mgr(tmp_path, "engine:m", I.engine_detectors())
+    asc = _AscSpy()
+    r = R.FleetRemediator(api=_api_with(_deployment()), autoscaler=asc,
+                          config=_cfg())
+    r.attach(mgr)
+    mgr.feed("nan_guard", detail="nan in logits", trace_ids=[])
+    mgr._process(time.monotonic())
+    inc = mgr.list()[0]
+    assert inc["cause"] == "unknown"
+    r._process(time.monotonic())
+    assert asc.calls == []
+    assert r.quarantine.list() == {}
+    assert mgr.get(inc["id"])["remediation"]["status"] == "observing"
+    _assert_closed_bundle(mgr, tmp_path, inc["id"], "observe")
+
+
+def test_fleet_remediation_endpoint_over_failover():
+    """End to end through the real service proxy with live threads: a
+    500ing backend drives failover -> replica_death; the attached
+    remediator proposes a pre-warm floor (annotating the live bundle),
+    the autoscaler applies it, and GET /fleet/remediation serves the
+    action log + the in-flight proposals.  Zero human actions."""
+    from kubeflow_tpu.serving.router import ServiceProxy
+    from kubeflow_tpu.serving.server import Model, ModelServer
+    from kubeflow_tpu.utils.net import find_free_ports
+
+    class _Echo(Model):
+        def load(self):
+            self.ready = True
+
+        def predict(self, payload, headers=None):
+            return {"predictions": payload.get("instances", [])}
+
+    class _Failing(Model):
+        def load(self):
+            self.ready = True
+
+        def predict(self, payload, headers=None):
+            raise RuntimeError("boom")
+
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    asc = ConcurrencyAutoscaler(api)
+    rem = R.FleetRemediator(api=api, autoscaler=asc)
+    proxy.attach_remediator(rem)
+    srv_bad = ModelServer([_Failing("m")], port=0)
+    srv_ok = ModelServer([_Echo("m")], port=0)
+    srv_bad.start()
+    srv_ok.start()
+    svc_port = find_free_ports(1)[0]
+    try:
+        api.create(_service(extra_ann={
+            PROXY_PORT_ANNOTATION: str(svc_port)}))
+        api.create(_deployment(replicas=1))
+        for i, port in enumerate((srv_bad.port, srv_ok.port)):
+            api.create(_pod(f"svc-{i}", port=port))
+        proxy.sync()
+        rem.start()
+        for i in range(6):  # RR hits the 500ing backend -> failovers
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{svc_port}/v1/models/m:predict",
+                data=json.dumps({"instances": [i]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+        _wait(lambda: rem.status()["actions"], timeout=10.0,
+              msg="remediation action")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{svc_port}/fleet/remediation",
+                timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert body["human_actions"] == 0
+        acts = [a for a in body["actions"]
+                if a["playbook"] == "replace_replica"]
+        assert acts and acts[0]["outcome"] == "executed"
+        assert body["proposals"]["d"]["floor"] == 2
+        # the incident bundle carries the decision
+        mgr = proxy._states[("default", "svc")].incidents
+        open_incs = [i for i in mgr.list() if i["state"] == "open"]
+        assert open_incs[0]["cause"] == "replica_death"
+        assert open_incs[0]["remediation"]["status"] == "in_flight"
+        # arbitration, live: the autoscaler (not the remediator) scales
+        asc.sync()
+        assert api.get("Deployment", "d")["spec"]["replicas"] == 2
+    finally:
+        rem.stop()
+        proxy.shutdown()
+        srv_bad.stop()
+        srv_ok.stop()

@@ -13,6 +13,7 @@ The hard-path continuity matrix (ISSUE 8 satellite):
 """
 
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -572,8 +573,16 @@ def test_unary_retries_are_distinct_child_spans():
         # both are children of the same relay root (distinct siblings)
         assert hops[0]["parent_id"] == hops[1]["parent_id"]
         assert hops[1]["resumed_from"] == hops[0]["span_id"]
-        roots = [s for s in proxy.traces.get(ctx.trace_id)
-                 if s.get("name") == "request"]
+        # the root span is deliberately written AFTER the response is
+        # flushed ("root span last" in the relay's finally) — give the
+        # handler thread a bounded window to land it
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            roots = [s for s in proxy.traces.get(ctx.trace_id)
+                     if s.get("name") == "request"]
+            if roots:
+                break
+            time.sleep(0.01)
         assert len(roots) == 1 and roots[0]["attempts"] == 2
         # adopted inbound context: the relay root is OUR child
         assert roots[0]["parent_id"] == ctx.span_id
