@@ -776,6 +776,30 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["storm"] = {"error": str(e)[:200]}
     try:
+        # latency-attribution sidebar: serving_bench --waterfall's
+        # headline (BENCH_WATERFALL.json) — attribution coverage (p95
+        # unaccounted fraction through the real proxy), the per-request
+        # proxy-overhead p50 in µs (ROADMAP item 6, measured), and the
+        # read-path cost gate
+        wfp = os.path.join(REPO, "BENCH_WATERFALL.json")
+        if os.path.exists(wfp):
+            with open(wfp) as f:
+                wrec = json.loads(f.readline())
+            out["waterfall"] = {
+                "waterfall_pass": wrec.get("pass"),
+                "segment_sum_violations":
+                    len(wrec.get("segment_sum_violations") or ()),
+                "unaccounted_p95_pct": wrec.get("unaccounted_p95_pct"),
+                "proxy_overhead_p50_us":
+                    wrec.get("proxy_overhead_p50_us"),
+                "assembly_overhead_p50_pct":
+                    wrec.get("assembly_overhead_p50_pct"),
+                "latency_classes": wrec.get("latency_classes"),
+                "platform": wrec.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["waterfall"] = {"error": str(e)[:200]}
+    try:
         # campaign sidebar: serving_bench --campaign's headline
         # (BENCH_CAMPAIGN.json) — the zero-human chaos campaign: every
         # taxonomy class classified and closed with a named remediation

@@ -309,6 +309,20 @@ JOBS = [
                                   os.path.join(REPO,
                                                "BENCH_CAMPAIGN.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # latency-attribution coverage on a real chip (README "Latency
+    # attribution"): device step times replace the CPU tick floor, so
+    # the unaccounted bound and the µs-scale proxy-overhead histogram
+    # measure real serving gaps; refreshes BENCH_WATERFALL.json with
+    # the platform=tpu record
+    {"name": "serving_waterfall_tiny",
+     "cmd": _serving_cmd("tiny", ["--waterfall", "--requests", "16",
+                                  "--concurrency", "4",
+                                  "--prompt-len", "64",
+                                  "--max-tokens", "16",
+                                  "--out",
+                                  os.path.join(REPO,
+                                               "BENCH_WATERFALL.json")]),
+     "timeout": 1500, "first_timeout": 900},
     {"name": "perf_introspect_tiny",
      "cmd": _serving_cmd("tiny", ["--perf", "--requests", "16",
                                   "--concurrency", "4",

@@ -698,6 +698,238 @@ def _run_obs(args, config, params, lora) -> None:
             f"slo={fleet['slo_series_exported']}")
 
 
+def _run_waterfall(args, config, params, lora) -> None:
+    """Latency-attribution bench (ISSUE 18, README "Latency
+    attribution"): one 2-replica telemetry-ON fleet behind the real
+    ServiceProxy, two phases.
+
+    Part 1 — coverage: a mixed unary replay (short + 4x prompts,
+    closed-loop), then EVERY request's ``/fleet/trace/<id>/waterfall``
+    is assembled and gated: segment sum == wall on all of them, and
+    the p95 ``unaccounted_s`` fraction stays under
+    ``--waterfall-unaccounted-pct``.  The per-request
+    ``proxy_overhead_s`` p50 (ROADMAP item 6's "proxy-added latency in
+    µs", measured, not inferred) and a ``/fleet/latency`` class-budget
+    sample are the headline numbers.
+
+    Part 2 — cost: alternating quiet/polled batch pairs on the SAME
+    fleet — polled batches run a background reader hammering the
+    waterfall + latency endpoints while requests relay.  The median
+    per-pair p50 delta must stay under ``--waterfall-budget``:
+    assembly is read-path only and must not perturb serving.  (Pairing
+    cancels host-latency drift — the --obs estimator discipline.)
+    """
+    import concurrent.futures
+    import json as _json
+    import threading
+    import time as _time
+    import urllib.request as _url
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.core.api import APIServer
+    from kubeflow_tpu.serving.api import LABEL_ISVC
+    from kubeflow_tpu.serving.controllers import (POD_PORT_ANNOTATION,
+                                                  PROXY_PORT_ANNOTATION)
+    from kubeflow_tpu.serving.engine import Engine, EngineConfig
+    from kubeflow_tpu.serving.engine.serve import JetStreamModel
+    from kubeflow_tpu.serving.router import (RELAY_TIMEOUT_ANNOTATION,
+                                             ServiceProxy)
+    from kubeflow_tpu.serving.server import ModelServer
+    from kubeflow_tpu.utils.net import find_free_ports
+
+    n_rep = 2
+    page_size = 16
+    mt = args.max_tokens
+    pages_per_slot = (4 * args.prompt_len + 2 * mt) // page_size + 2
+    num_pages = max(64, args.concurrency * pages_per_slot + 8)
+    rng = np.random.default_rng(0)
+    letters = "abcdefghijklmnopqrstuvwxyz "
+    prompts = []
+    for i in range(args.requests):
+        ln = args.prompt_len * (4 if i % 4 == 3 else 1)  # mixed replay
+        prompts.append("".join(
+            letters[j] for j in rng.integers(0, len(letters), size=ln)))
+
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    svc_port = find_free_ports(1)[0]
+    api.create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "wffleet", "labels": {LABEL_ISVC: "wffleet"},
+                     "annotations": {PROXY_PORT_ANNOTATION: str(svc_port),
+                                     RELAY_TIMEOUT_ANNOTATION: "30.0"}},
+        "spec": {"selector": {"app": "wffleet"}}})
+    engines, servers = [], []
+    for i in range(n_rep):
+        ec = EngineConfig(
+            max_slots=args.concurrency, page_size=page_size,
+            num_pages=num_pages, max_pages_per_slot=pages_per_slot,
+            trace_history=max(512, 4 * args.requests),
+            trace_history_bytes=64_000_000)
+        eng = Engine(params, config, ec, lora=lora)
+        srv = ModelServer([JetStreamModel("wffleet", "", engine=eng)],
+                          port=0)
+        srv.start()
+        api.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"wffleet-{i}", "labels": {"app": "wffleet"},
+                         "annotations": {POD_PORT_ANNOTATION:
+                                         str(srv.port)}},
+            "spec": {},
+            "status": {"phase": "Running",
+                       "conditions": [{"type": "Ready",
+                                       "status": "True"}]}})
+        engines.append(eng)
+        servers.append(srv)
+    proxy.sync()
+
+    def unary(port: int, prompt: str):
+        req = _url.Request(
+            f"http://127.0.0.1:{port}/v2/models/wffleet/generate",
+            data=_json.dumps({"text_input": prompt,
+                              "parameters": {"max_tokens": mt}}).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = _time.perf_counter()
+        with _url.urlopen(req, timeout=300) as r:
+            r.read()
+            return r.headers.get("X-Trace-Id"), _time.perf_counter() - t0
+
+    def get_json(port: int, path: str):
+        with _url.urlopen(f"http://127.0.0.1:{port}{path}",
+                          timeout=30) as r:
+            return _json.loads(r.read())
+
+    try:
+        for srv in servers:  # compile both prompt buckets on each replica
+            unary(srv.port, prompts[0])
+            unary(srv.port, prompts[0] * 4)
+
+        # ---- part 1: coverage --------------------------------------------
+        with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
+            replay = list(ex.map(lambda pr: unary(svc_port, pr), prompts))
+        sum_violations = []
+        unacc_fracs, overheads, walls = [], [], []
+        for tid, _dt in replay:
+            wf = get_json(svc_port, f"/fleet/trace/{tid}/waterfall")
+            total = sum(s["dur_s"] for s in wf["segments"])
+            if abs(total - wf["wall_s"]) > 1e-6:
+                sum_violations.append(tid)
+            walls.append(wf["wall_s"])
+            unacc_fracs.append(wf["unaccounted_s"] / wf["wall_s"]
+                               if wf["wall_s"] else 0.0)
+            overheads.append(wf["proxy_overhead_s"])
+        unacc_p95_pct = float(np.percentile(unacc_fracs, 95)) * 100.0
+        latency_view = get_json(svc_port, "/fleet/latency")
+
+        # ---- part 2: cost of the read path -------------------------------
+        tids = [t for t, _ in replay if t]
+        p50s = {True: [], False: []}
+        for polled in (False, True) * 6:
+            stop = threading.Event()
+            reader = None
+            if polled:
+                # 0.5s cadence — the --obs poller discipline: far above
+                # any real debugging/dashboard read rate; faster polling
+                # on the 1-core box measures GIL collisions between the
+                # fan-out JSON reads and the relay, not the plane
+                def poll():
+                    i = 0
+                    while not stop.wait(0.5):
+                        try:
+                            get_json(svc_port, "/fleet/trace/"
+                                     f"{tids[i % len(tids)]}/waterfall")
+                            get_json(svc_port, "/fleet/latency")
+                        except Exception:  # noqa: BLE001
+                            pass
+                        i += 1
+                reader = threading.Thread(target=poll, daemon=True)
+                reader.start()
+            try:
+                with concurrent.futures.ThreadPoolExecutor(
+                        args.concurrency) as ex:
+                    lats = [f.result()[1] for f in [
+                        ex.submit(unary, svc_port, pr)
+                        for pr in prompts]]
+            finally:
+                stop.set()
+                if reader is not None:
+                    reader.join()
+            p50s[polled].append(float(np.percentile(lats, 50)))
+        pair_pcts = sorted((on_ - off_) / off_ * 100.0
+                           for off_, on_ in zip(p50s[False], p50s[True]))
+        overhead_pct = float(np.median(pair_pcts))
+    finally:
+        proxy.shutdown()
+        for srv in servers:
+            srv.stop()
+        for eng in engines:
+            try:
+                eng.stop(drain=False)
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+
+    classes = {
+        cls: {"n": b["n"], "ttft_p50_s": b["ttft_p50_s"],
+              "ttft_p95_s": b["ttft_p95_s"],
+              "dominant": max(b["segments"].items(),
+                              key=lambda kv: kv[1]["p95_s"])[0]
+              if b["segments"] else None}
+        for cls, b in (latency_view.get("classes") or {}).items()}
+    ok = (not sum_violations
+          and unacc_p95_pct < args.waterfall_unaccounted_pct
+          and overhead_pct < args.waterfall_budget)
+    out = {
+        "metric": f"latency_attribution_{args.config}",
+        "replicas": n_rep,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len,
+        "max_tokens": mt,
+        "segment_sum_violations": sum_violations,
+        "unaccounted_p95_pct": round(unacc_p95_pct, 3),
+        "unaccounted_budget_pct": args.waterfall_unaccounted_pct,
+        "wall_p50_s": round(float(np.percentile(walls, 50)), 4),
+        "proxy_overhead_p50_us": round(
+            float(np.percentile(overheads, 50)) * 1e6, 1),
+        "proxy_overhead_p95_us": round(
+            float(np.percentile(overheads, 95)) * 1e6, 1),
+        "assembly_overhead_p50_pct": round(overhead_pct, 2),
+        "assembly_budget_pct": args.waterfall_budget,
+        "latency_classes": classes,
+        "deadline_crosscheck": latency_view.get("deadline_crosscheck"),
+        "pass": ok,
+        "platform": jax.devices()[0].platform,
+        "protocol_note": "unary mixed replay (1x/4x prompts) through the "
+                         "ServiceProxy; every request's fleet waterfall "
+                         "assembled and gated sum==wall + p95 unaccounted "
+                         "fraction; proxy_overhead_s is the per-request "
+                         "ingress wall minus engine-attributed wall; cost "
+                         "phase = 6 alternating quiet/polled batch pairs "
+                         "on one fleet (0.5s waterfall+latency read "
+                         "cadence, the --obs poller discipline), median "
+                         "per-pair p50 delta",
+    }
+    line = _json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if sum_violations:
+        raise SystemExit(
+            f"segment-sum violation on {len(sum_violations)} waterfalls: "
+            f"{sum_violations[:5]}")
+    if unacc_p95_pct >= args.waterfall_unaccounted_pct:
+        raise SystemExit(
+            f"unaccounted p95 {unacc_p95_pct:.2f}% of wall exceeds "
+            f"{args.waterfall_unaccounted_pct}% budget")
+    if overhead_pct >= args.waterfall_budget:
+        raise SystemExit(
+            f"attribution read-path overhead p50 {overhead_pct:.2f}% "
+            f"exceeds {args.waterfall_budget}% budget")
+
+
 def _run_overlap(args, config, params, lora) -> None:
     """Pipelined-decode overlap scenario (ISSUE 5): the same simultaneous-
     arrival decode workload run with ``pipeline_depth`` 0 (sync oracle) and
@@ -4933,6 +5165,20 @@ def main() -> None:
                         "workload with the observability layer on vs off; "
                         "asserts p50 overhead < --obs-budget and writes "
                         "BENCH_OBS.json via --out")
+    p.add_argument("--waterfall", action="store_true",
+                   help="latency-attribution bench (README 'Latency "
+                        "attribution'): mixed unary replay through the "
+                        "real proxy, every request's fleet waterfall "
+                        "gated sum==wall + bounded unaccounted, "
+                        "per-request proxy-overhead p50 in µs, "
+                        "/fleet/latency class budgets, and a read-path "
+                        "cost gate (BENCH_WATERFALL.json via --out)")
+    p.add_argument("--waterfall-unaccounted-pct", type=float, default=5.0,
+                   help="max p95 unaccounted_s as a percent of wall "
+                        "across the --waterfall replay's waterfalls")
+    p.add_argument("--waterfall-budget", type=float, default=2.0,
+                   help="max p50 serving-latency delta (percent) the "
+                        "--waterfall read-path poller may add")
     p.add_argument("--perf", action="store_true",
                    help="perf-introspection bench (ISSUE 11): plane "
                         "overhead gate (engine-local + behind the proxy), "
@@ -5076,6 +5322,9 @@ def main() -> None:
         return
     if args.obs:
         _run_obs(args, config, params, lora)
+        return
+    if args.waterfall:
+        _run_waterfall(args, config, params, lora)
         return
     if args.perf:
         _run_perf(args, config, params, lora)

@@ -89,6 +89,7 @@ from ..errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
 from ..incidents import IncidentConfig, IncidentManager, engine_detectors
 from ..kvfabric import FabricStore, fabric_key
 from ..slo import SloConfig, SloTracker
+from .. import waterfall as waterfall_mod
 from .faults import (ChaosInjector, FabricChaos, FabricFaultConfig,
                      FaultConfig, HandoffChaos, HandoffFaultConfig)
 from .kvstore import (KVStoreConfig, TieredKVStore, blob_degree,
@@ -917,7 +918,8 @@ class Engine:
                        trace=None,
                        links: Optional[list] = None,
                        waste_hint: Optional[str] = None,
-                       brownout: int = 0) -> Future:
+                       brownout: int = 0,
+                       pre_hints: Optional[dict] = None) -> Future:
         """Submit a prompt; the Future resolves to a result dict.
 
         ``stream``: optional queue that receives each token id as it is
@@ -971,6 +973,11 @@ class Engine:
         control") — 0 = normal; >= 2 disables speculation drafting for
         this request; >= 3 additionally defers the fleet-fabric publish
         at finish.  Quality degrades, never correctness.
+        ``pre_hints``: latency-attribution walls the serve layer spent
+        on this request BEFORE submit (``{"fabric_pull": s}`` /
+        ``{"handoff_import": s}`` — README "Latency attribution"); they
+        ride the request's span so the waterfall can attribute the relay
+        hop's lead-in instead of leaving it unaccounted.
         Raises EngineOverloaded when the queue is at ``max_queue_depth``
         and EngineShutdown once stop() has begun."""
         if not tokens:
@@ -1046,7 +1053,14 @@ class Engine:
             self._next_id += 1
             span = None
             if self.ec.telemetry:
-                span = RequestSpan(rid, trace=trace, links=links)
+                span = RequestSpan(rid, trace=trace, links=links, cls=prio)
+                if pre_hints:
+                    # serve-layer walls spent on this request BEFORE the
+                    # span's clock started (fabric/handoff pulls): the
+                    # fleet waterfall carves them out of the relay hop's
+                    # lead-in (waterfall.PRE_HINT_SEGMENTS)
+                    for k, v in pre_hints.items():
+                        span.hint(f"pre_{k}", float(v))
                 if session_id is not None:
                     prev = self._session_spans.get(session_id)
                     if prev is not None:
@@ -1179,14 +1193,15 @@ class Engine:
                  handoff: bool = False, kv_import=None, fabric_import=None,
                  trace=None, links: Optional[list] = None,
                  waste_hint: Optional[str] = None,
-                 brownout: int = 0) -> dict:
+                 brownout: int = 0,
+                 pre_hints: Optional[dict] = None) -> dict:
         fut = self.generate_async(tokens, max_new_tokens, adapter=adapter,
                                   deadline=deadline, priority=priority,
                                   session_id=session_id, handoff=handoff,
                                   kv_import=kv_import,
                                   fabric_import=fabric_import, trace=trace,
                                   links=links, waste_hint=waste_hint,
-                                  brownout=brownout)
+                                  brownout=brownout, pre_hints=pre_hints)
         try:
             return fut.result(timeout=timeout)
         except FutureTimeoutError:
@@ -1283,7 +1298,8 @@ class Engine:
                         trace=None,
                         links: Optional[list] = None,
                         waste_hint: Optional[str] = None,
-                        brownout: int = 0) -> Iterator:
+                        brownout: int = 0,
+                        pre_hints: Optional[dict] = None) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
 
         The last item yielded is the same dict ``generate`` returns (so
@@ -1302,7 +1318,7 @@ class Engine:
                                   fabric_import=fabric_import,
                                   trace=trace, links=links,
                                   waste_hint=waste_hint,
-                                  brownout=brownout)
+                                  brownout=brownout, pre_hints=pre_hints)
 
         def _iter():
             while True:
@@ -1534,6 +1550,11 @@ class Engine:
                         # the Sarathi-Serve discriminator: slots
                         # mid-chunked-prefill while decode burns
                         prefill_active=len(self._prefilling),
+                        # waterfall-backed attribution (ISSUE 18): the
+                        # segment dominating the burning class's TTFT
+                        # budget — quantitative backing for the
+                        # prefill_interference classification
+                        dominant_segment=self._dominant_segment(cls),
                         trace_ids=self._live_trace_ids())
         # a series whose samples aged out of EVERY window vanishes from
         # the snapshot entirely — the latch must re-arm then too, or the
@@ -1637,6 +1658,66 @@ class Engine:
         return {"trace_id": trace_id,
                 "spans": [s.to_dict() for s in spans],
                 "flight_dumps": dumps}
+
+    # ------------------------------------------- latency attribution plane
+
+    def waterfall(self, rid: int) -> Optional[dict]:
+        """Engine-local latency waterfall for one request id (README
+        "Latency attribution", ``GET /engine/waterfall/<rid>``): the
+        span's phase marks partitioned into attributed segments whose
+        sum equals the span wall by construction, the spec-verify carve,
+        and the critical path against the pipelined loop's overlapped
+        host phases.  None when telemetry is off or the rid aged out —
+        assembly runs on the caller's (handler) thread, never the loop."""
+        with self._lock:
+            pending = self._requests.get(rid)
+            span = pending.span if pending is not None \
+                else self._trace_ring.get(rid)
+        if span is None:
+            return None
+        t0 = span.events[0][1]
+        t_end = span.events[-1][1]
+        overlays = waterfall_mod.overlays_from_timeline(
+            self.timeline.snapshot(last=128), t0, t_end)
+        return waterfall_mod.build_engine_waterfall(span.to_dict(),
+                                                    overlays=overlays)
+
+    # recent archived spans per latency_budget() read: enough for stable
+    # per-class p95s, bounded so the under-lock ref copy stays cheap
+    _BUDGET_SCAN_CAP = 512
+
+    def latency_budget(self) -> dict:
+        """Per-class latency-budget samples from the recent span history
+        (``GET /engine/latency`` — the replica-local half; the service
+        proxy merges samples fleet-wide and computes the quantiles).
+        Returns ``{"classes": {...}, "samples": {cls: [...]}}``; empty
+        when telemetry is off.  O(recent history), caller thread only."""
+        if not self.ec.telemetry:
+            return {"classes": {}, "samples": {}}
+        with self._lock:
+            spans = list(self._trace_ring.values())[-self._BUDGET_SCAN_CAP:]
+        by_cls: dict = {}
+        for span in spans:
+            sample = waterfall_mod.span_budget_sample(span.to_dict())
+            if sample is None:
+                continue
+            bucket = by_cls.setdefault(sample.pop("cls"), [])
+            bucket.append(sample)
+            if len(bucket) > waterfall_mod.BUDGET_SAMPLE_CAP:
+                bucket.pop(0)
+        return {"classes": waterfall_mod.class_budgets(by_cls),
+                "samples": by_cls}
+
+    def _dominant_segment(self, cls: str) -> Optional[dict]:
+        """The segment dominating ``cls``'s recent TTFT budget — the
+        waterfall-backed evidence an SLO-burn incident cites (manager
+        thread, bounded scan, best-effort)."""
+        try:
+            samples = self.latency_budget()["samples"].get(cls)
+            return waterfall_mod.dominant_segment(samples) \
+                if samples else None
+        except Exception:  # noqa: BLE001 — evidence is best-effort
+            return None
 
     def _note_dump(self, path: Optional[str], trace_ids) -> None:
         """Remember which traces a flight dump concerns, so the assembled
@@ -2858,7 +2939,7 @@ class Engine:
 
     # ------------------------------------------------------ fault handling
 
-    def _isolated(self, phase: str, slots: list, fn, *args,
+    def _isolated(self, phase: str, slots: list, fn, *args,  # graftlint: hot-path
                   shape: Optional[dict] = None) -> bool:
         """Isolation boundary around one tick phase: an exception fails only
         ``slots`` (the offending group), and only after the per-request
@@ -2879,6 +2960,17 @@ class Engine:
             fn(*args)
             if obs:
                 self._flight_event(phase, slots, shape, t0, "ok")
+                # latency attribution (ISSUE 18): accumulate this
+                # dispatch's wall onto each participant's span — the
+                # waterfall's spec_verify carve and the pipelined-decode
+                # host/device split read these totals off the hot path.
+                # Loop-thread only, O(1) per slot, same cost class as the
+                # flight event above.
+                dur = time.perf_counter() - t0
+                for s in slots:
+                    p = self._requests.get(self._slot_req.get(s))
+                    if p is not None and p.span is not None:
+                        p.span.hint(phase, dur)
             return True
         except Exception as exc:  # noqa: BLE001 — the boundary's whole job
             if obs:

@@ -475,6 +475,30 @@ class JetStreamModel(Model):
         except Exception:  # noqa: BLE001 — a debug read must answer
             return None
 
+    def waterfall(self, rid):
+        """Latency waterfall for one engine request id — the
+        replica-local half of ``GET /engine/waterfall/<rid>`` (README
+        "Latency attribution").  None when the rid is unknown here or
+        the plane is off; an attribution read must never 500."""
+        if self.engine is None:
+            return None
+        try:
+            return self.engine.waterfall(int(rid))
+        except Exception:  # noqa: BLE001 — a debug read must answer
+            return None
+
+    def latency_budget(self) -> dict:
+        """Per-SLO-class latency budget samples from this replica's
+        trace ring — the replica-local half of ``GET /fleet/latency``
+        (the proxy merges across replicas and computes fleet
+        quantiles).  Empty-but-valid when the plane is off."""
+        if self.engine is None:
+            return {"classes": {}, "samples": {}}
+        try:
+            return self.engine.latency_budget()
+        except Exception:  # noqa: BLE001 — a debug read must answer
+            return {"classes": {}, "samples": {}}
+
     @staticmethod
     def _wants_trace(headers: Optional[dict]) -> bool:
         """Opt-in request tracing: any truthy ``X-Request-Trace`` header."""
@@ -758,6 +782,8 @@ class JetStreamModel(Model):
                                  trace=self._trace_ctx(headers),
                                  links=self._resume_link(headers),
                                  brownout=brownout,
+                                 pre_hints=({"fabric_pull": pull_s}
+                                            if pull_s > 0 else None),
                                  # a failover re-admission re-prefills
                                  # tokens the dead replica already
                                  # produced: waste, attributed — as is a
@@ -827,6 +853,8 @@ class JetStreamModel(Model):
                                  trace=self._trace_ctx(headers),
                                  links=self._resume_link(headers),
                                  brownout=brownout,
+                                 pre_hints=({"fabric_pull": pull_s}
+                                            if pull_s > 0 else None),
                                  waste_hint=("fabric_degraded"
                                              if (fab is not None
                                                  and fimp is None)
@@ -1040,13 +1068,16 @@ class JetStreamModel(Model):
         imp = self._handoff_import(hand, adapter)
         # the pull sits BETWEEN the phases: its wall time (up to the pull
         # timeout on a slow link) belongs in the end-to-end latency too
-        base_lat += time.perf_counter() - t_pull
+        pull_s = time.perf_counter() - t_pull
+        base_lat += pull_s
         r = self.engine.generate(ids + prior, max_new, adapter=adapter,
                                  deadline=deadline, priority=priority,
                                  session_id=session, kv_import=imp,
                                  trace=self._trace_ctx(headers),
                                  links=self._resume_link(headers),
                                  brownout=brownout,
+                                 pre_hints=({"handoff_import": pull_s}
+                                            if pull_s > 0 else None),
                                  # import already degraded before submit:
                                  # the re-prefill redoes the prefill
                                  # replica's work (engine-side failures
@@ -1143,6 +1174,8 @@ class JetStreamModel(Model):
                 deadline=deadline, priority=priority, session_id=session,
                 kv_import=imp, trace=self._trace_ctx(headers),
                 links=self._resume_link(headers), brownout=brownout,
+                pre_hints=({"handoff_import": pull_s}
+                           if pull_s > 0 else None),
                 waste_hint=(None if imp is not None
                             else "handoff_degraded"))
             # prior_emitted=False: handoff tokens were generated elsewhere
@@ -1175,6 +1208,9 @@ class JetStreamModel(Model):
                                              trace=self._trace_ctx(headers),
                                              links=self._resume_link(headers),
                                              brownout=brownout,
+                                             pre_hints=(
+                                                 {"fabric_pull": pull_s}
+                                                 if pull_s > 0 else None),
                                              waste_hint=("failover_reprefill"
                                                          if resume else
                                                          "fabric_degraded"

@@ -64,6 +64,14 @@ GAP_BUCKETS_S = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
 # Buckets reach the largest spec_max_draft anyone configures in practice.
 SPEC_ACCEPT_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16)
 
+# Proxy-overhead histogram (ISSUE 18): wall the serving stack adds
+# around the engine.  The floor reaches 10 µs — ROADMAP item 6 wants the
+# proxy-added number in µs, and a wire-speed ingress refactor would be
+# invisible under ms-scale buckets.
+PROXY_OVERHEAD_BUCKETS_S = (0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
+                            0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                            0.05, 0.1, 0.25, 1.0)
+
 # terminal span phases (everything else is a lifecycle waypoint)
 TERMINAL_PHASES = ("done", "shed", "failed", "cancelled")
 
@@ -85,12 +93,19 @@ class RequestSpan:
     connect spans across trace boundaries: a failover re-admission links
     the failed relay hop (``resumed_from``), a session's turn N+1 links
     turn N (``session_prev``).
+
+    Latency attribution (ISSUE 18): ``hints`` accumulates per-request
+    attribution seconds the phase marks alone cannot carry — the verify
+    share of each decode dispatch, the serve-layer fabric/handoff pull
+    walls measured before the span existed (``pre_*``).  ``cls`` is the
+    request's priority class, the fleet latency-budget bucket key.
     """
 
     __slots__ = ("rid", "events", "outcome", "trace_id", "span_id",
-                 "parent_id", "links")
+                 "parent_id", "links", "hints", "cls")
 
-    def __init__(self, rid: int, trace=None, links=None):
+    def __init__(self, rid: int, trace=None, links=None,
+                 cls: Optional[str] = None):
         self.rid = rid
         self.events: list = [("queued", time.perf_counter())]
         self.outcome: Optional[str] = None
@@ -102,6 +117,17 @@ class RequestSpan:
             self.parent_id = None
         self.span_id = tracing.new_span_id()
         self.links: list = list(links or ())
+        self.hints: Optional[dict] = None
+        self.cls = cls
+
+    def hint(self, name: str, dur_s: float) -> None:  # graftlint: hot-path
+        """Accumulate attribution seconds under ``name`` — O(1) dict
+        upsert, called from the engine loop per dispatch (waterfall.py
+        reads the total at assembly time, off the hot path)."""
+        h = self.hints
+        if h is None:
+            h = self.hints = {}
+        h[name] = h.get(name, 0.0) + dur_s
 
     def mark(self, phase: str) -> float:
         t = time.perf_counter()
@@ -134,6 +160,10 @@ class RequestSpan:
         }
         if self.links:
             out["links"] = [dict(l) for l in self.links]
+        if self.cls is not None:
+            out["cls"] = self.cls
+        if self.hints:
+            out["hints"] = {k: round(v, 6) for k, v in self.hints.items()}
         by = {}
         for p, ts in events:  # first occurrence wins
             by.setdefault(p, ts)
@@ -152,7 +182,8 @@ class RequestSpan:
         accounting unit.  Deliberately a cheap closed form (not a real
         serialization): the budget needs proportionality, not precision,
         and this runs on every archive."""
-        return 160 + 48 * len(self.events) + 96 * len(self.links)
+        return (160 + 48 * len(self.events) + 96 * len(self.links)
+                + 72 * len(self.hints or ()))
 
 
 class FlightRecorder:
@@ -459,6 +490,20 @@ class EngineTelemetry:
         self.brownout_requests = r.counter(
             "engine_brownout_requests_total",
             "requests served under an ingress brownout stage, by stage")
+        # Latency attribution plane (ISSUE 18, serving/waterfall.py):
+        # wall the serving stack ADDED around the engine — here the
+        # model-server scope (HTTP handling + tokenize/detokenize +
+        # serve-layer pulls around one engine run, observed per unary
+        # request in server.py).  The router registers the same name in
+        # the shared core registry for its ingress scope (relay wall
+        # minus engine-attributed wall — ROADMAP item 6's "proxy-added
+        # latency in µs", measured per-request, not inferred from paired
+        # benches).  One metric contract, two scopes, like incidents.
+        self.proxy_overhead = r.histogram(
+            "ingress_proxy_overhead_seconds",
+            "serving-stack wall added around the engine per request "
+            "(engine scope: model server; ingress scope: service proxy)",
+            PROXY_OVERHEAD_BUCKETS_S)
 
     # Observe methods stay branch-cheap: one attribute check, then a dict
     # op under the metric's own lock.
@@ -487,6 +532,10 @@ class EngineTelemetry:
     def count_trace_evictions(self, n: int) -> None:
         if self.enabled and n:
             self.trace_evictions.inc(n)
+
+    def observe_proxy_overhead(self, s: float) -> None:
+        if self.enabled:
+            self.proxy_overhead.observe(s)
 
     def refresh_slo(self) -> None:
         """Recompute the SLO gauges from the tracker's rolling windows —

@@ -342,8 +342,12 @@ class IncidentManager:
         """Signal intake — the ONLY incident-plane call any hot path ever
         makes: one deque append plus an event set.  Never raises."""
         try:
+            # the wall stamp IS the payload here (incident timestamps
+            # humans read), not timing arithmetic; durations use the
+            # monotonic stamp beside it
+            wall = time.time()  # graftlint: disable=hot-path -- payload stamp, not timing
             self._events.append({"kind": kind, "t": time.monotonic(),
-                                 "wall": time.time(), **attrs})
+                                 "wall": wall, **attrs})
             self._wake.set()
         except Exception:  # noqa: BLE001 — pragma: no cover (defensive)
             pass

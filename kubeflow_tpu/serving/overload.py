@@ -599,6 +599,14 @@ class OverloadController:
                 "shed_by": {f"{cls}:{reason}": n
                             for (cls, reason), n
                             in sorted(self.shed_by.items())},
+                # the deadline gate's per-class queue+TTFT p50 — the
+                # latency attribution plane cross-checks this against
+                # its waterfall-derived figure (GET /fleet/latency);
+                # the two measure the same quantity independently
+                "deadline_p50": {cls: round(p50, 6)
+                                 for cls, (n, p50)
+                                 in sorted(self._p50_cache.items())
+                                 if p50 is not None},
                 "tenants": {
                     t: {"admitted": self.tenant_admitted.get(t, 0),
                         "shed": self.tenant_shed.get(t, 0),

@@ -50,6 +50,7 @@ from ..core.metrics import REGISTRY, merge_expositions
 from . import disagg, kvfabric
 from . import incidents as incidents_mod
 from . import overload as overload_mod
+from . import waterfall as waterfall_mod
 from .api import GROUP, LABEL_ISVC, LABEL_REVISION
 from .controllers import (
     DEPLOYMENT_FOR_SERVICE_ANNOTATION,
@@ -130,6 +131,19 @@ INGRESS_TENANT_TOKENS = REGISTRY.gauge(
     "ingress_tenant_tokens",
     "per-tenant admission token-bucket level (refills at the tenant's "
     "weighted fair share of the service's admission rate)")
+# Latency attribution, ingress scope (README "Latency attribution"): the
+# per-request proxy-added wall — ingress hop wall minus the engine-
+# reported wall (X-Engine-Wall-S on unary relays; the final stream
+# event's latency_s on resumable streams) — the ROADMAP "proxy-added
+# latency in µs" number measured per request, not inferred from paired
+# benches.  Engine scope registers the same name in the model server's
+# registry (serve-layer wall minus engine wall); conformance pins both.
+INGRESS_PROXY_OVERHEAD = REGISTRY.histogram(
+    "ingress_proxy_overhead_seconds",
+    "serving-stack wall added around the engine per request (engine "
+    "scope: model server; ingress scope: service proxy)",
+    buckets=(0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
+             0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0))
 INGRESS_BROWNOUT = REGISTRY.gauge(
     "ingress_brownout_stage",
     "current brownout degradation stage per service (0 = normal; "
@@ -226,6 +240,14 @@ class _ProxyState:
         self.cache_view: dict[str, dict] = {}  # guarded-by: lock
         self.cache_view_at = 0.0     # monotonic time of the last refresh
         self.cache_refreshing = False  # single-flight background refresh
+        # fleet latency view (README "Latency attribution"): merged
+        # per-class budget samples from every replica's GET
+        # /engine/latency, refreshed on the same TTL'd single-flight
+        # background cadence as the cache view (a /fleet/latency poll
+        # serves the last-known view, never blocks on the fan-out)
+        self.latency_view: dict = {}  # guarded-by: lock
+        self.latency_view_at = 0.0   # monotonic time of the last refresh
+        self.latency_refreshing = False  # single-flight background refresh
         # fleet fault tolerance: per-backend health records + the set of
         # ports some thread is actively probing outside the lock (single-
         # flight, same discipline as `refreshing` above)
@@ -353,6 +375,21 @@ class ServiceProxy:
                         proxy._serve_trace(self, state,
                                            path[len("/debug/trace/"):])
                         return
+                    if path.startswith("/fleet/trace/"):
+                        # /fleet/trace/<id> is /debug/trace/<id> under its
+                        # fleet-surface name; the /waterfall suffix asks
+                        # for the assembled latency attribution instead
+                        # of the raw span tree
+                        rest = path[len("/fleet/trace/"):]
+                        if rest.endswith("/waterfall"):
+                            proxy._serve_fleet_waterfall(
+                                self, state, rest[:-len("/waterfall")])
+                        else:
+                            proxy._serve_trace(self, state, rest)
+                        return
+                    if path == "/fleet/latency":
+                        proxy._serve_fleet_latency(self, state)
+                        return
                     if path == "/fleet/metrics":
                         proxy._serve_fleet_metrics(self, state)
                         return
@@ -473,6 +510,12 @@ class ServiceProxy:
         been written to the client, and a streamed one re-admits with its
         relayed token ids so the continuation picks up exactly where the
         dead backend stopped."""
+        # waterfall pre-segments (README "Latency attribution"): the two
+        # marks below bracket proxy work that happens BEFORE the relay
+        # clock t0 starts — json parse and the admission/setup gate —
+        # so the assembled waterfall's wall telescopes t_entry -> t_parse
+        # -> t0 -> end with no untimed seam
+        t_entry = time.perf_counter()
         svc = self._get_service(state)
         ann = (svc or {}).get("metadata", {}).get("annotations", {})
         budget = int(float(ann.get(RETRY_BUDGET_ANNOTATION,
@@ -489,6 +532,7 @@ class ServiceProxy:
                 payload = json.loads(body)
             except ValueError:
                 payload = None
+        t_parse = time.perf_counter()
         # ---- overload control (README "Overload control"): the shed-at-
         # ingress decision runs BEFORE any relay/placement work — a
         # refused request costs one bucket refill and a 429, not a relay,
@@ -538,6 +582,16 @@ class ServiceProxy:
                            if k.lower() not in hop_by_hop}
             fwd_headers.setdefault("Content-Type", "application/json")
             t0 = time.perf_counter()
+            # admission covers the overload gate plus the pre-relay setup
+            # (brownout rewrite, resume/session context, trace mint) —
+            # everything between the parse mark and the relay clock
+            pre_s = {"ingress_parse": round(t_parse - t_entry, 6),
+                     "admission": round(t0 - t_parse, 6)}
+            # engine-attributed wall for THIS request, read from the
+            # winning hop (unary: X-Engine-Wall-S header; resumable
+            # stream: the final event's latency_s) — the per-request
+            # proxy-overhead sample is ingress wall minus this
+            eng_wall: Optional[float] = None
             status = 502
             backend_label = "none"
             attempt = 0
@@ -708,12 +762,17 @@ class ServiceProxy:
                                 def _set_ttfb(v: float) -> None:
                                     nonlocal ov_ttfb
                                     ov_ttfb = v
+
+                                def _set_eng_wall(v: float) -> None:
+                                    nonlocal eng_wall
+                                    eng_wall = v
                                 self._relay_resumable(
                                     state, r, sse, resume, backend,
                                     keep_ids=self._client_wants_ids(
                                         handler.headers),
                                     on_ttfb=(_set_ttfb if decision
-                                             is not None else None))
+                                             is not None else None),
+                                    on_engine_wall=_set_eng_wall)
                                 ok = True
                             else:
                                 ok = handler._stream(r, ctype)
@@ -723,6 +782,11 @@ class ServiceProxy:
                                      backend_state=hop_state)
                             return
                         payload = r.read()
+                        try:
+                            eng_wall = float(
+                                r.headers.get("X-Engine-Wall-S") or "")
+                        except ValueError:
+                            eng_wall = None
                         if decision is not None:
                             # queue+TTFT feedback for the overload
                             # controller's deadline estimator (the
@@ -899,13 +963,25 @@ class ServiceProxy:
             INGRESS_REQUESTS.inc(service=state.service_name,
                                  backend=backend_label,
                                  code=f"{status // 100}xx")
-            # root span last: the hop spans are its children in the tree
+            if eng_wall is not None:
+                # ingress scope of ingress_proxy_overhead_seconds: the
+                # full proxy wall (entry to reply, parse + admission +
+                # relay) minus the engine-reported wall — clipped at 0
+                # because the two clocks are different processes
+                INGRESS_PROXY_OVERHEAD.observe(
+                    max(0.0, time.perf_counter() - t_entry - eng_wall),
+                    service=state.service_name)
+            # root span last: the hop spans are its children in the tree.
+            # pre_s carries the pre-relay segments; the waterfall wall is
+            # sum(pre_s) + duration_s, telescoped with no untimed seam.
             self.traces.put(root.trace_id, {
                 "trace_id": root.trace_id, "span_id": root.span_id,
                 "parent_id": root.parent_id, "component": "ingress",
                 "name": "request", "service": state.service_name,
                 "path": handler.path, "method": handler.command,
                 "status": status, "attempts": attempt + 1,
+                "pre_s": pre_s,
+                "engine_wall_s": eng_wall,
                 "t_start_s": 0.0,
                 "duration_s": round(time.perf_counter() - t0, 6)})
 
@@ -955,7 +1031,8 @@ class ServiceProxy:
 
     def _relay_resumable(self, state: _ProxyState, r, sse: "_SSERelay",
                          resume: "_ResumeCtx", backend: int,
-                         keep_ids: bool = False, on_ttfb=None) -> None:
+                         keep_ids: bool = False, on_ttfb=None,
+                         on_engine_wall=None) -> None:
         """Parse-and-relay one backend SSE stream, recording the token ids
         behind every relayed event into ``resume`` so a broken stream can be
         re-admitted elsewhere.  ``keep_ids`` forwards the ids to the client
@@ -1004,6 +1081,12 @@ class ServiceProxy:
                         # relay never parses events, so SSE-only fleets
                         # without resume contexts stay unsampled)
                         on_ttfb(float(event["ttft_s"]))
+                    if on_engine_wall is not None and isinstance(
+                            event.get("latency_s"), (int, float)):
+                        # engine-attributed wall for the waterfall's
+                        # per-request proxy-overhead sample — same final
+                        # record, same passthrough caveat as ttft_s
+                        on_engine_wall(float(event["latency_s"]))
                     if resume.token_ids and "tokens" in event:
                         # across failovers the LAST backend only knows its
                         # continuation; the ingress knows the whole run
@@ -1484,13 +1567,13 @@ class ServiceProxy:
             t.join()
         return results
 
-    def _serve_trace(self, handler, state: _ProxyState,
-                     trace_id: str) -> None:
-        """GET /debug/trace/<id>: the assembled end-to-end trace — this
-        proxy's relay hop spans plus every replica's engine spans
-        (GET /engine/trace/<id> fan-out), nested into the hop tree, with
-        the flight-recorder dumps any replica recorded for this trace."""
-        trace_id = trace_id.strip().lower()
+    def _collect_trace(self, state: _ProxyState, trace_id: str) -> tuple:
+        """One assembled distributed trace: this proxy's relay hop spans
+        plus every replica's engine spans (GET /engine/trace/<id>
+        fan-out), deduped on (trace_id, span_id) and ordered by
+        skew-adjusted start time — a failover request's two engine spans
+        read in causal order, not scrape order.  Returns ``(spans,
+        dumps, pods, unreachable)``."""
         spans = [dict(s) for s in self.traces.get(trace_id)]
         dumps: list = []
         pods = self._service_pods(state)
@@ -1511,12 +1594,45 @@ class ServiceProxy:
                 spans.append(s)
             for p in rec.get("flight_dumps") or ():
                 dumps.append({"replica": name, "path": p})
+        spans = waterfall_mod.order_spans(waterfall_mod.dedupe_spans(spans))
+        return spans, dumps, pods, unreachable
+
+    def _serve_trace(self, handler, state: _ProxyState,
+                     trace_id: str) -> None:
+        """GET /debug/trace/<id> (alias /fleet/trace/<id>): the assembled
+        end-to-end trace, nested into the hop tree, with the
+        flight-recorder dumps any replica recorded for this trace."""
+        trace_id = trace_id.strip().lower()
+        spans, dumps, pods, unreachable = self._collect_trace(
+            state, trace_id)
         body = {"trace_id": trace_id, "spans": spans,
                 "tree": tracing.build_tree(spans),
                 "flight_dumps": dumps,
                 "replicas_queried": [n for n, _ in pods],
                 "replicas_unreachable": unreachable}
         handler._reply(200 if spans else 404, json.dumps(body).encode())
+
+    def _serve_fleet_waterfall(self, handler, state: _ProxyState,
+                               trace_id: str) -> None:
+        """GET /fleet/trace/<id>/waterfall: the trace assembled into one
+        end-to-end latency waterfall on the ingress clock (README
+        "Latency attribution") — parse/admission/placement, failed hops
+        as failover + retry_gap, each successful hop's engine partition
+        placed via the per-backend clock-offset estimate.  404 when the
+        trace is unknown or has no ingress root span to anchor a wall."""
+        trace_id = trace_id.strip().lower()
+        spans, _dumps, pods, unreachable = self._collect_trace(
+            state, trace_id)
+        wf = waterfall_mod.build_fleet_waterfall(
+            {"trace_id": trace_id, "spans": spans}) if spans else None
+        if wf is None:
+            handler._reply(404, json.dumps(
+                {"error": "no ingress root span for trace",
+                 "trace_id": trace_id}).encode())
+            return
+        wf["replicas_queried"] = [n for n, _ in pods]
+        wf["replicas_unreachable"] = unreachable
+        handler._reply(200, json.dumps(wf).encode())
 
     def _serve_fleet_metrics(self, handler, state: _ProxyState) -> None:
         """GET /fleet/metrics: every replica's /metrics merged into one
@@ -1651,6 +1767,107 @@ class ServiceProxy:
             "replicas_queried": [n for n, _ in pods],
             "replicas_unreachable": sorted(unreachable),
         }).encode())
+
+    # ------------------------------------------- fleet latency endpoint
+    # (README "Latency attribution"): per-SLO-class TTFT budget
+    # breakdowns merged from every replica's GET /engine/latency — raw
+    # budget samples merge exactly where per-replica quantiles would
+    # not.  Same staleness-tolerant TTL'd single-flight background
+    # refresh as the cache view: a poll serves the last-known view and
+    # kicks the refresh, never blocking on a fan-out against a sick
+    # replica.
+
+    _LATENCY_VIEW_TTL_S = _FABRIC_VIEW_TTL_S
+
+    def _collect_latency_view(self, state: _ProxyState) -> dict:
+        """One fleet latency-view refresh: fan out every replica's
+        ``GET /engine/latency``, merge the per-class budget samples, and
+        fold the computed class budgets into ``state.latency_view``."""
+        pods = self._service_pods(state)
+        unreachable: list = []
+        payloads: list = []
+        for name, (raw, _lat) in sorted(self._fan_out(
+                pods, "/engine/latency").items()):
+            if raw is None:
+                unreachable.append(name)
+                continue
+            try:
+                body = json.loads(raw)
+            except ValueError:
+                unreachable.append(name)
+                continue
+            for rec in (body.get("models") or {}).values():
+                if isinstance(rec, dict):
+                    payloads.append(rec)
+        samples = waterfall_mod.merge_budget_samples(payloads)
+        view = {"service": state.service_name,
+                "classes": waterfall_mod.class_budgets(samples),
+                "replicas_queried": [n for n, _ in pods],
+                "replicas_unreachable": sorted(unreachable)}
+        with state.lock:  # latency_view is shared proxy state
+            state.latency_view = view
+            state.latency_view_at = time.monotonic()
+        return view
+
+    def _maybe_refresh_latency_view(self, state: _ProxyState) -> None:
+        """Kick a BACKGROUND latency-view refresh when the TTL lapsed —
+        single-flight, never blocking the poll that noticed (same
+        discipline as _maybe_refresh_cache_view)."""
+        with state.lock:
+            now = time.monotonic()
+            if (state.latency_refreshing
+                    or now - state.latency_view_at
+                    < self._LATENCY_VIEW_TTL_S):
+                return
+            state.latency_refreshing = True  # graftlint: acquires=latency-refresh
+
+        def refresh() -> None:
+            try:
+                self._collect_latency_view(state)
+            except Exception:  # noqa: BLE001 — a refresh must not wedge
+                pass
+            finally:
+                with state.lock:
+                    state.latency_view_at = time.monotonic()
+                    state.latency_refreshing = False  # graftlint: releases=latency-refresh
+
+        threading.Thread(target=refresh, daemon=True).start()
+
+    def _serve_fleet_latency(self, handler, state: _ProxyState) -> None:
+        """GET /fleet/latency: per-SLO-class p50/p95 TTFT budget
+        breakdowns (what fraction of interactive p95 TTFT is queue vs
+        prefill vs pull), plus the cross-check of the overload deadline
+        estimator's per-class queue+TTFT p50 against the
+        waterfall-derived figure — two independent measurements of the
+        same quantity; a gap is a calibration bug in one of them."""
+        with state.lock:
+            view = dict(state.latency_view)
+        if not view:
+            # first poll: there is no last-known view to tolerate
+            # staleness with — collect synchronously once
+            view = self._collect_latency_view(state)
+        else:
+            self._maybe_refresh_latency_view(state)
+        ov = self._overload_for(state, self._get_service(state))
+        if ov is not None:
+            try:
+                deadline = (ov.snapshot() or {}).get("deadline_p50") or {}
+            except Exception:  # noqa: BLE001 — a debug read must answer
+                deadline = {}
+            cross = {}
+            for cls, budget in (view.get("classes") or {}).items():
+                o = deadline.get(cls)
+                w = budget.get("ttft_p50_s")
+                if isinstance(o, (int, float)):
+                    cross[cls] = {
+                        "overload_p50_s": round(float(o), 6),
+                        "waterfall_p50_s": w,
+                        "delta_s": (round(float(o) - w, 6)
+                                    if isinstance(w, (int, float))
+                                    else None)}
+            if cross:
+                view = {**view, "deadline_crosscheck": cross}
+        handler._reply(200, json.dumps(view).encode())
 
     # ------------------------------------------- fleet incident endpoints
     # (README "Incident plane"): the proxy's own ingress-scope incidents

@@ -357,6 +357,44 @@ class ModelServer:
 
                 h._send(200, {"incident": found,
                               "timeline": timeline(found)})
+        elif path.startswith("/engine/waterfall/"):
+            # latency attribution (README "Latency attribution"): one
+            # request's end-to-end waterfall of non-overlapping
+            # segments, assembled read-time from the trace ring.  404
+            # when no model knows the rid — the fleet endpoint joins
+            # across replicas by trace id instead.
+            rid = path[len("/engine/waterfall/"):].strip()
+            found = None
+            if rid.isdigit():
+                for name, m in self.models.items():
+                    fn = getattr(m, "waterfall", None)
+                    if not callable(fn):
+                        continue
+                    try:
+                        wf = fn(rid)
+                    except Exception:  # noqa: BLE001 — debug read answers
+                        wf = None
+                    if wf is not None:
+                        found = {**wf, "model": name}
+                        break
+            if found is None:
+                h._send(404, {"error": "unknown request id"})
+            else:
+                h._send(200, found)
+        elif path == "/engine/latency":
+            # replica-local latency budget samples per SLO class — the
+            # half the proxy's /fleet/latency view merges.  Always 200;
+            # models without the plane contribute nothing.
+            out = {}
+            for name, m in self.models.items():
+                fn = getattr(m, "latency_budget", None)
+                if not callable(fn):
+                    continue
+                try:
+                    out[name] = fn() or {"classes": {}, "samples": {}}
+                except Exception:  # noqa: BLE001 — debug read answers
+                    continue
+            h._send(200, {"models": out})
         elif path.startswith("/engine/kv_handoff/"):
             # disaggregated serving (README "Disaggregated serving"): a
             # decode replica pulls a prefill replica's exported KV frame
@@ -617,6 +655,7 @@ class ModelServer:
         body = h._body()
         headers = dict(h.headers.items())
         if not stream:
+            t0 = time.perf_counter()
             out = verb(body, headers)
             out = dict(out) if isinstance(out, dict) else {"text_output": out}
             out.setdefault("model_name", name)
@@ -627,6 +666,20 @@ class ModelServer:
                 # estimator reads this header instead of re-parsing every
                 # relayed response body
                 extra["X-TTFT-S"] = f"{out['ttft_s']:.4f}"
+            if isinstance(out.get("latency_s"), (int, float)):
+                # engine-attributed wall for the ingress waterfall
+                # assembler (README "Latency attribution"): the proxy
+                # subtracts this from its own hop wall to get
+                # per-request proxy overhead without a second scrape
+                extra["X-Engine-Wall-S"] = f"{out['latency_s']:.6f}"
+                eng = getattr(m, "engine", None)
+                tel = getattr(eng, "telemetry", None)
+                if tel is not None:
+                    # model-server scope of ingress_proxy_overhead_seconds:
+                    # serve-layer wall minus the engine-reported wall
+                    tel.observe_proxy_overhead(max(
+                        0.0,
+                        time.perf_counter() - t0 - float(out["latency_s"])))
             h._send(200, out, extra_headers=extra or None)
             return
         gen = verb(body, headers)

@@ -19,14 +19,16 @@ BANNED_CALLS = {
     "json.dump": "serialize off the hot path",
     "copy.deepcopy": "deep copies are O(object graph)",
     "sorted": "sorting is O(n log n) — keep a cache or a heap",
+    "time.time": "wall clock skews under NTP steps — hot-path timing "
+                 "uses time.perf_counter",
 }
 
 
 class HotPathRule(Rule):
     name = "hot-path"
     invariant = ("functions marked '# graftlint: hot-path' never call "
-                 "json.loads/json.dumps/copy.deepcopy/sorted and never "
-                 "iterate a collection under a lock")
+                 "json.loads/json.dumps/copy.deepcopy/sorted/time.time "
+                 "and never iterate a collection under a lock")
     history = ("PR 14 review: the deadline gate sorted the rolling latency "
                "window per admission under the controller lock — the "
                "module's stated O(1) discipline, made true by a p50 cache "

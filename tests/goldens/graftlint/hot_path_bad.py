@@ -1,5 +1,6 @@
 import json
 import threading
+import time
 
 LOCK = threading.Lock()
 TABLE: dict = {}
@@ -7,6 +8,7 @@ TABLE: dict = {}
 
 def observe(raw):  # graftlint: hot-path
     body = json.loads(raw)
+    body["at"] = time.time()
     with LOCK:
         for k, v in TABLE.items():
             body[k] = v

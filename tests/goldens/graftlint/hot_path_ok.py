@@ -1,10 +1,12 @@
 import threading
+import time
 
 LOCK = threading.Lock()
 TABLE: dict = {}
 
 
 def observe(body):  # graftlint: hot-path
+    body["at"] = time.perf_counter()
     with LOCK:
         cached = TABLE.get(body.get("k"))
     return cached

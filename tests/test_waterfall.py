@@ -1,0 +1,553 @@
+"""Latency attribution plane tests (ISSUE 18): the waterfall assembler.
+
+The load-bearing invariant everywhere: **segment sum == wall by
+construction** — on every request shape (clean, chunked prefill, spec
+verify, preempt/resume, failover retry, fabric/handoff pulls, shed,
+failed), any remainder lands in an explicit ``unaccounted`` segment and
+is bounded.  Clock-offset estimation is unit-tested with explicit
+clocks including negative skew; the critical path subtracts overlapped
+work; the fleet waterfall and the per-class budget endpoint run through
+the real service proxy; and the plane costs nothing when telemetry is
+off.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from kubeflow_tpu.core.api import APIServer
+from kubeflow_tpu.serving import waterfall as wf
+from kubeflow_tpu.serving.api import LABEL_ISVC
+from kubeflow_tpu.serving.controllers import (POD_PORT_ANNOTATION,
+                                              PROXY_PORT_ANNOTATION)
+from kubeflow_tpu.serving.engine import Engine, EngineConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.serve import JetStreamModel
+from kubeflow_tpu.serving.router import (RELAY_TIMEOUT_ANNOTATION,
+                                         ServiceProxy)
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.utils.net import find_free_ports
+
+pytestmark = pytest.mark.waterfall
+
+# vocab >= 256: the JetStream byte tokenizer addresses ids 0..255
+CFG = M.DecoderConfig(vocab_size=288, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+def _ec(**kw):
+    base = dict(max_slots=4, num_pages=96, page_size=8,
+                max_pages_per_slot=24)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _sum_ok(out, tol=1e-6):
+    """The invariant, asserted everywhere: segments partition the wall."""
+    total = sum(s["dur_s"] for s in out["segments"])
+    assert abs(total - out["wall_s"]) < tol, (total, out["wall_s"])
+    assert all(s["dur_s"] >= 0 for s in out["segments"])
+
+
+def _span(events, rid=7, cls="interactive", hints=None, **extra):
+    """Synthetic engine RequestSpan.to_dict shape from (phase, t) pairs."""
+    out = {"rid": rid, "component": "engine", "trace_id": "t" * 32,
+           "span_id": "s" * 16, "parent_id": None, "cls": cls,
+           "outcome": next((p for p, _ in events
+                            if p in ("done", "shed", "failed", "cancelled")),
+                           None),
+           "events": [{"phase": p, "t_s": t} for p, t in events]}
+    if hints:
+        out["hints"] = dict(hints)
+    out.update(extra)
+    return out
+
+
+# ------------------------------------------------------------- seal units
+
+
+def test_seal_partitions_gaps_overlaps_and_clips():
+    segs, over = wf.seal([(0.0, 0.1, "a", None),      # clean
+                          (0.05, 0.2, "b", None),     # overlaps a's tail
+                          (0.3, 0.4, "c", None),      # gap before
+                          (0.35, 0.38, "d", None),    # fully inside c
+                          (0.9, 1.5, "e", None)],     # clipped at wall
+                         1.0)
+    assert abs(sum(s["dur_s"] for s in segs) - 1.0) < 1e-12
+    # a kept, b's overlap clipped, the two gaps became explicit
+    # unaccounted segments, d fully swallowed, e clipped at the wall
+    assert [s["name"] for s in segs] == [
+        "a", "b", "unaccounted", "c", "unaccounted", "e"]
+    # clipped parts are reported as overlapped work, not dropped
+    reasons = {o["name"]: o["reason"] for o in over}
+    assert reasons["b"] == "overlap" and reasons["d"] == "overlap"
+
+
+def test_seal_empty_and_zero_wall():
+    segs, over = wf.seal([], 0.5)
+    assert segs == [{"name": "unaccounted", "start_s": 0.0, "dur_s": 0.5}]
+    assert over == []
+    segs, over = wf.seal([(0.0, 0.1, "a", None)], 0.0)
+    assert sum(s["dur_s"] for s in segs) == 0.0
+
+
+def test_seal_last_seam_closes_exactly():
+    # float noise at the tail must not leave a dangling sliver
+    segs, _ = wf.seal([(0.0, 0.3333333333, "a", None),
+                       (0.3333333333, 0.9999999999, "b", None)], 1.0)
+    assert abs(sum(s["dur_s"] for s in segs) - 1.0) < 1e-12
+
+
+# ------------------------------------------- engine partition, every shape
+
+
+CLEAN = [("queued", 0.0), ("admitted", 0.01), ("prefill", 0.05),
+         ("first_token", 0.06), ("done", 0.2)]
+CHUNKED = [("queued", 0.0), ("admitted", 0.02), ("prefill", 0.05),
+           ("prefill", 0.09), ("prefill", 0.12), ("first_token", 0.13),
+           ("done", 0.3)]
+PREEMPT = [("queued", 0.0), ("admitted", 0.01), ("prefill", 0.04),
+           ("first_token", 0.05), ("preempted", 0.1), ("readmitted", 0.15),
+           ("resumed", 0.17), ("done", 0.3)]
+SHED = [("queued", 0.0), ("shed", 0.08)]
+FAILED = [("queued", 0.0), ("admitted", 0.01), ("prefill", 0.05),
+          ("failed", 0.07)]
+CANCELLED = [("queued", 0.0), ("admitted", 0.01), ("prefill", 0.03),
+             ("first_token", 0.04), ("cancelled", 0.09)]
+FABRIC = [("queued", 0.0), ("admitted", 0.01), ("fabric_restore", 0.06),
+          ("prefill", 0.09), ("first_token", 0.1), ("done", 0.2)]
+HANDOFF = [("queued", 0.0), ("admitted", 0.01), ("handoff_import", 0.05),
+           ("first_token", 0.06), ("done", 0.15)]
+SESSION = [("queued", 0.0), ("admitted", 0.01), ("session_restore", 0.04),
+           ("prefill", 0.07), ("first_token", 0.08), ("done", 0.2)]
+
+
+@pytest.mark.parametrize("events,expect", [
+    (CLEAN, {"engine_queue", "prefill", "decode"}),
+    (CHUNKED, {"engine_queue", "prefill", "decode"}),
+    (PREEMPT, {"engine_queue", "prefill", "decode", "preempt_restore"}),
+    (SHED, {"engine_queue"}),
+    (FAILED, {"engine_queue", "prefill"}),
+    (CANCELLED, {"engine_queue", "prefill", "decode"}),
+    (FABRIC, {"engine_queue", "fabric_pull", "prefill", "decode"}),
+    (HANDOFF, {"engine_queue", "handoff_import", "decode"}),
+    (SESSION, {"engine_queue", "session_restore", "prefill", "decode"}),
+])
+def test_engine_waterfall_sum_equals_wall_every_shape(events, expect):
+    out = wf.build_engine_waterfall(_span(events))
+    _sum_ok(out, tol=1e-9)
+    assert out["wall_s"] == events[-1][1]
+    names = {s["name"] for s in out["segments"]}
+    assert expect <= names, (expect, names)
+    # the engine partition is contiguous by construction: no gaps
+    assert out["unaccounted_s"] == 0.0
+    # every emitted segment name is in the documented glossary
+    assert names <= set(wf.SEGMENTS)
+
+
+def test_chunked_prefill_gets_per_chunk_segments():
+    out = wf.build_engine_waterfall(_span(CHUNKED))
+    chunks = [s for s in out["segments"] if s["name"] == "prefill"]
+    # three dispatched chunks + the chunk ending at first_token
+    assert [c.get("chunk") for c in chunks] == [0, 1, 2, 3]
+
+
+def test_spec_verify_carved_from_decode_keeps_partition_exact():
+    span = _span(CLEAN, hints={"verify": 0.05})
+    out = wf.build_engine_waterfall(span)
+    _sum_ok(out, tol=1e-9)
+    t = out["totals"]
+    assert abs(t["spec_verify"] - 0.05) < 1e-9
+    # carve came OUT of decode: decode + verify == the original gap
+    assert abs(t["decode"] + t["spec_verify"] - 0.14) < 1e-9
+    # oversized hint is clamped: the partition can never exceed the wall
+    out2 = wf.build_engine_waterfall(_span(CLEAN, hints={"verify": 99.0}))
+    _sum_ok(out2, tol=1e-9)
+    assert out2["totals"]["spec_verify"] <= 0.14 + 1e-9
+
+
+def test_pre_submit_pull_hints_ride_alongside_not_inside():
+    span = _span(CLEAN, hints={"pre_fabric_pull": 0.02})
+    out = wf.build_engine_waterfall(span)
+    _sum_ok(out, tol=1e-9)  # the engine axis is untouched
+    assert out["pre_s"] == {"fabric_pull": 0.02}
+
+
+def test_non_monotonic_marks_clamp_never_negative():
+    events = [("queued", 0.0), ("admitted", 0.05), ("prefill", 0.04),
+              ("done", 0.1)]
+    out = wf.build_engine_waterfall(_span(events))
+    _sum_ok(out, tol=1e-9)
+
+
+# ------------------------------------------------------------ clock offset
+
+
+def test_clock_offset_bracketing_regime():
+    # hop [10.0, 10.5] brackets an 0.4 s engine span: the 0.1 s residual
+    # splits evenly, so engine zero sits at 10.05 on the ingress clock
+    off, residual = wf.estimate_offset(10.0, 0.5, 0.4)
+    assert abs(off - 10.05) < 1e-12
+    assert abs(residual - 0.1) < 1e-12
+
+
+def test_clock_offset_negative_skew():
+    # engine reports MORE wall than the hop observed (clock drift or an
+    # early hop close): pin to hop start, surface the negative residual
+    off, residual = wf.estimate_offset(10.0, 0.3, 0.4)
+    assert off == 10.0
+    assert residual < 0 and abs(residual + 0.1) < 1e-12
+
+
+def test_fleet_waterfall_negative_skew_still_partitions():
+    root = {"component": "ingress", "name": "request", "trace_id": "t",
+            "span_id": "r", "parent_id": None, "status": 200,
+            "t_start_s": 0.0, "duration_s": 0.3,
+            "pre_s": {"ingress_parse": 0.001, "admission": 0.002}}
+    hop = {"component": "ingress", "name": "relay_attempt", "trace_id": "t",
+           "span_id": "h1", "parent_id": "r", "outcome": "ok",
+           "backend": 9000, "kind": "relay",
+           "t_start_s": 0.0, "duration_s": 0.25}
+    eng = _span([("queued", 0.0), ("admitted", 0.01), ("prefill", 0.1),
+                 ("first_token", 0.12), ("done", 0.4)],  # wall > hop dur
+                parent_id="h1")
+    out = wf.build_fleet_waterfall(
+        {"trace_id": "t", "spans": [root, hop, eng]})
+    _sum_ok(out)
+    assert out["clock_offsets"]["9000"]["residual_s"] < 0
+    # the overrun was clipped into overlapped work, not silently absorbed
+    assert any(o["reason"] in ("overlap", "beyond_wall")
+               for o in out.get("overlapped", ()))
+
+
+# ---------------------------------------------------------- critical path
+
+
+def test_critical_path_subtracts_overlapped_decode_work():
+    segs, _ = wf.seal([(0.0, 0.2, "prefill", None),
+                       (0.2, 1.0, "decode", None)], 1.0)
+    overlays = [{"name": "pipeline_drain", "start_s": 0.3, "dur_s": 0.1},
+                {"name": "pipeline_readback", "start_s": 0.35,
+                 "dur_s": 0.15}]  # merged union: [0.3, 0.5] -> 0.2 hidden
+    cp = wf.critical_path(segs, overlays, 1.0)
+    assert abs(cp["hidden_s"] - 0.2) < 1e-9
+    assert abs(cp["critical_path_s"] - 0.8) < 1e-9
+    assert cp["path"] == ["prefill", "decode"]
+
+
+def test_critical_path_without_overlap_is_the_wall():
+    segs, _ = wf.seal([(0.0, 1.0, "decode", None)], 1.0)
+    cp = wf.critical_path(segs, [], 1.0)
+    assert cp["critical_path_s"] == 1.0 and cp["hidden_s"] == 0.0
+
+
+def test_overlays_from_timeline_windows_and_converts_clock():
+    records = [{"tick": 1, "t_s": 100.5,
+                "segments": {"drain": 0.01, "readback": 0.02,
+                             "dispatch": 0.5}},      # dispatch: not overlap
+               {"tick": 2, "t_s": 200.0,
+                "segments": {"drain": 0.01}}]        # outside the window
+    out = wf.overlays_from_timeline(records, t0=100.0, t_end=101.0)
+    assert [o["name"] for o in out] == ["pipeline_drain",
+                                       "pipeline_readback"]
+    assert out[0]["start_s"] == 0.5  # absolute 100.5 -> span-relative
+
+
+# ------------------------------------------------- trace hygiene (fleet)
+
+
+def test_dedupe_spans_on_trace_and_span_id():
+    a = {"trace_id": "t", "span_id": "x", "v": 1}
+    b = {"trace_id": "t", "span_id": "x", "v": 2}   # double-scraped copy
+    c = {"trace_id": "t", "span_id": "y"}
+    d = {"trace_id": "t", "span_id": None}          # id-less: always kept
+    out = wf.dedupe_spans([a, b, c, d, dict(d)])
+    assert [s.get("span_id") for s in out] == ["x", "y", None, None]
+    assert out[0]["v"] == 1  # first occurrence wins
+
+
+def test_order_spans_causal_across_skewed_replicas():
+    hop1 = {"component": "ingress", "name": "relay_attempt", "span_id": "h1",
+            "t_start_s": 0.0, "duration_s": 0.1, "outcome": "connect"}
+    hop2 = {"component": "ingress", "name": "relay_attempt", "span_id": "h2",
+            "t_start_s": 0.15, "duration_s": 0.3, "outcome": "ok"}
+    e2 = _span([("queued", 0.0), ("done", 0.2)], parent_id="h2")
+    e1 = _span([("queued", 0.0), ("done", 0.05)], parent_id="h1")
+    root = {"component": "ingress", "name": "request", "span_id": "r",
+            "t_start_s": 0.0, "duration_s": 0.5}
+    # scrape order: second replica's span first
+    out = wf.order_spans([e2, hop2, e1, hop1, root])
+    engine_order = [s["parent_id"] for s in out
+                    if s.get("component") == "engine"]
+    assert engine_order == ["h1", "h2"]  # causal, not scrape, order
+    adj = {s["parent_id"]: s["t_start_adj_s"] for s in out
+           if s.get("component") == "engine"}
+    # each engine zero lands inside its parent hop's bracket
+    assert 0.0 <= adj["h1"] <= 0.1
+    assert 0.15 <= adj["h2"] <= 0.45
+
+
+# --------------------------------------------------- fleet waterfall units
+
+
+def _failover_trace():
+    root = {"component": "ingress", "name": "request", "trace_id": "t",
+            "span_id": "r", "parent_id": None, "status": 200,
+            "t_start_s": 0.0, "duration_s": 1.0,
+            "pre_s": {"ingress_parse": 0.004, "admission": 0.006}}
+    dead = {"component": "ingress", "name": "relay_attempt", "trace_id": "t",
+            "span_id": "h1", "parent_id": "r", "outcome": "connect",
+            "error": "boom", "backend": 9000, "kind": "relay",
+            "t_start_s": 0.01, "duration_s": 0.1}
+    ok = {"component": "ingress", "name": "relay_attempt", "trace_id": "t",
+          "span_id": "h2", "parent_id": "r", "outcome": "ok",
+          "backend": 9001, "kind": "relay",
+          "t_start_s": 0.2, "duration_s": 0.7}
+    eng = _span([("queued", 0.0), ("admitted", 0.02), ("prefill", 0.2),
+                 ("first_token", 0.22), ("done", 0.6)], parent_id="h2",
+                replica="fleet-1", hints={"pre_fabric_pull": 0.01})
+    return {"trace_id": "t", "spans": [root, dead, ok, eng]}
+
+
+def test_fleet_waterfall_failover_shape():
+    out = wf.build_fleet_waterfall(_failover_trace())
+    _sum_ok(out)
+    assert abs(out["wall_s"] - 1.01) < 1e-9  # pre_s + root duration
+    t = out["totals"]
+    assert abs(t["ingress_parse"] - 0.004) < 1e-9
+    assert abs(t["admission"] - 0.006) < 1e-9
+    # the dead attempt is explicit failover wall; the backoff between the
+    # attempts is an explicit retry_gap
+    assert abs(t["failover"] - 0.1) < 1e-9
+    assert abs(t["retry_gap"] - 0.09) < 1e-9
+    # engine sub-segments are placed on the ingress axis, marked skewed
+    eng_segs = [s for s in out["segments"] if s.get("skew_adjusted")]
+    assert eng_segs and {"engine_queue", "prefill",
+                         "decode"} <= {s["name"] for s in eng_segs}
+    # the serve-layer pull hint was carved out of the hop lead-in
+    assert any(s["name"] == "fabric_pull" and s.get("pre_submit")
+               for s in out["segments"])
+    # per-backend clock evidence rides the waterfall
+    assert out["clock_offsets"]["fleet-1"]["residual_s"] > 0
+    assert out["attempts"] == 2
+    # proxy overhead = wall minus every engine-attributed second
+    assert abs(out["proxy_overhead_s"] - (1.01 - 0.6)) < 1e-6
+    assert out["unaccounted_s"] < 0.05 * out["wall_s"]
+
+
+def test_fleet_waterfall_opaque_hop_and_missing_root():
+    assert wf.build_fleet_waterfall({"spans": []}) is None
+    # a successful hop with no engine span stays honest: relay_backend
+    spans = [{"component": "ingress", "name": "request", "span_id": "r",
+              "trace_id": "t", "status": 200, "t_start_s": 0.0,
+              "duration_s": 0.5, "pre_s": {}},
+             {"component": "ingress", "name": "relay_attempt",
+              "span_id": "h", "parent_id": "r", "outcome": "ok",
+              "backend": 9000, "kind": "relay",
+              "t_start_s": 0.0, "duration_s": 0.5}]
+    out = wf.build_fleet_waterfall({"trace_id": "t", "spans": spans})
+    _sum_ok(out)
+    assert out["totals"].get("relay_backend") == 0.5
+    assert out["proxy_overhead_s"] == 0.5  # nothing engine-attributed
+
+
+# ------------------------------------------------------------ budgets units
+
+
+def test_budget_sample_clips_segments_to_ttft_window():
+    span = _span(CLEAN, ttft_s=0.06)
+    s = wf.span_budget_sample(span)
+    assert s["cls"] == "interactive" and s["ttft_s"] == 0.06
+    # decode happens after first_token: not part of the TTFT budget
+    assert "decode" not in s["segments"]
+    assert abs(s["segments"]["engine_queue"] - 0.01) < 1e-9
+    assert abs(s["segments"]["prefill"] - 0.05) < 1e-9
+    # pre-submit pulls ARE client-visible TTFT: added on top
+    s2 = wf.span_budget_sample(_span(CLEAN, ttft_s=0.06,
+                                     hints={"pre_fabric_pull": 0.04}))
+    assert abs(s2["ttft_s"] - 0.1) < 1e-9
+    assert abs(s2["segments"]["fabric_pull"] - 0.04) < 1e-9
+
+
+def test_class_budgets_and_dominant_segment():
+    samples = [{"cls": "interactive", "ttft_s": 0.1, "wall_s": 0.3,
+                "segments": {"engine_queue": 0.07, "prefill": 0.03}}
+               for _ in range(10)]
+    budgets = wf.class_budgets({"interactive": [dict(s) for s in samples]})
+    b = budgets["interactive"]
+    assert b["n"] == 10 and abs(b["ttft_p95_s"] - 0.1) < 1e-9
+    assert abs(b["segments"]["engine_queue"]["frac_of_p95_ttft"] - 0.7) < 1e-3
+    dom = wf.dominant_segment([dict(s) for s in samples])
+    assert dom["segment"] == "engine_queue" and dom["n"] == 10
+
+
+def test_merge_budget_samples_bounded():
+    payloads = [{"samples": {"batch": [{"ttft_s": 0.1, "wall_s": 0.1,
+                                        "segments": {}}] * 2000}}]
+    merged = wf.merge_budget_samples(payloads)
+    assert len(merged["batch"]) == wf.BUDGET_SAMPLE_CAP * 4
+
+
+def test_quantile_interpolates():
+    assert wf.quantile([], 0.5) is None
+    assert wf.quantile([3.0], 0.95) == 3.0
+    assert abs(wf.quantile([1.0, 2.0, 3.0, 4.0], 0.5) - 2.5) < 1e-12
+
+
+# ------------------------------------------------- engine integration (CPU)
+
+
+PROMPT_IDS = [(i * 13 + 7) % 255 + 1 for i in range(6)]
+
+
+def test_engine_waterfall_real_request_and_budget(params):
+    eng = Engine(params, CFG, _ec())
+    eng.start()
+    try:
+        r = eng.generate(PROMPT_IDS, 6)
+        out = eng.waterfall(r["rid"])
+        assert out is not None
+        _sum_ok(out)
+        assert out["outcome"] == "done"
+        assert out["unaccounted_s"] == 0.0
+        names = {s["name"] for s in out["segments"]}
+        assert "prefill" in names and "decode" in names
+        assert names <= set(wf.SEGMENTS)
+        assert "critical_path" in out
+        # unknown rid: None, never a throw
+        assert eng.waterfall(10 ** 9) is None
+        budget = eng.latency_budget()
+        assert budget["samples"], budget
+        cls, samples = next(iter(budget["samples"].items()))
+        assert samples[0]["ttft_s"] > 0
+        assert budget["classes"][cls]["ttft_p95_s"] > 0
+    finally:
+        eng.stop(drain=False)
+
+
+def test_waterfall_plane_off_costs_nothing(params):
+    eng = Engine(params, CFG, _ec(telemetry=False))
+    eng.start()
+    try:
+        # pre_hints on a telemetry-off engine: accepted, dropped, free
+        r = eng.generate(PROMPT_IDS, 4, pre_hints={"fabric_pull": 0.01})
+        assert eng.waterfall(r["rid"]) is None
+        assert eng.latency_budget() == {"classes": {}, "samples": {}}
+    finally:
+        eng.stop(drain=False)
+
+
+# --------------------------------------------- e2e through the real proxy
+
+
+def _post_hdrs(port, path, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers.items())
+
+
+def _get_json(port, path, timeout=30):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_fleet_waterfall_and_latency_through_real_proxy(params):
+    api = APIServer()
+    proxy = ServiceProxy(api)
+    svc_port = find_free_ports(1)[0]
+    api.create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "fleet", "labels": {LABEL_ISVC: "fleet"},
+                     "annotations": {PROXY_PORT_ANNOTATION: str(svc_port),
+                                     RELAY_TIMEOUT_ANNOTATION: "5.0"}},
+        "spec": {"selector": {"app": "fleet"}}})
+    eng = Engine(params, CFG, _ec())
+    srv = ModelServer([JetStreamModel("fleet", "", engine=eng)], port=0)
+    srv.start()
+    api.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "fleet-0", "labels": {"app": "fleet"},
+                     "annotations": {POD_PORT_ANNOTATION: str(srv.port)}},
+        "spec": {},
+        "status": {"phase": "Running",
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+    proxy.sync()
+    try:
+        # warm the compile caches off the measured request — and pin the
+        # header the unary relay reads the engine wall from
+        _, _, whdrs = _post_hdrs(
+            srv.port, "/v2/models/fleet/generate",
+            {"text_input": "warm up", "parameters": {"max_tokens": 4}})
+        assert "X-Engine-Wall-S" in whdrs
+        body = {"text_input": "the quick brown fox",
+                "parameters": {"max_tokens": 8}}
+        code, out, hdrs = _post_hdrs(svc_port,
+                                     "/v2/models/fleet/generate", body)
+        assert code == 200
+        tid = hdrs.get("X-Trace-Id")
+        assert tid
+
+        # --- assembled trace: deduped, causally ordered
+        code, tr = _get_json(svc_port, f"/fleet/trace/{tid}")
+        assert code == 200
+        keys = [(s.get("trace_id"), s.get("span_id")) for s in tr["spans"]]
+        assert len(keys) == len(set(keys))  # no double-scraped spans
+        assert any(s.get("component") == "engine"
+                   and "t_start_adj_s" in s for s in tr["spans"])
+
+        # --- end-to-end waterfall on the ingress clock
+        code, wfo = _get_json(svc_port, f"/fleet/trace/{tid}/waterfall")
+        assert code == 200, wfo
+        _sum_ok(wfo)
+        names = {s["name"] for s in wfo["segments"]}
+        assert {"ingress_parse", "admission"} <= names
+        assert any(s.get("skew_adjusted") for s in wfo["segments"])
+        assert names <= set(wf.SEGMENTS)
+        assert wfo["clock_offsets"]
+        assert wfo["proxy_overhead_s"] >= 0
+        # attribution coverage on a clean request: nearly nothing escapes
+        assert wfo["unaccounted_s"] <= 0.05 * wfo["wall_s"] + 0.005, wfo
+
+        # unknown trace: 404, not an empty 200
+        code, _ = _get_json(svc_port, "/fleet/trace/" + "0" * 32
+                            + "/waterfall")
+        assert code == 404
+
+        # --- replica-local waterfall by rid (via the trace's engine span)
+        eng_span = next(s for s in tr["spans"]
+                        if s.get("component") == "engine")
+        code, ew = _get_json(srv.port,
+                             f"/engine/waterfall/{eng_span['rid']}")
+        assert code == 200
+        _sum_ok(ew)
+        code, _ = _get_json(srv.port, "/engine/waterfall/999999999")
+        assert code == 404
+
+        # --- per-class fleet budget through the proxy
+        for _ in range(3):
+            _post_hdrs(svc_port, "/v2/models/fleet/generate", body)
+        code, lat = _get_json(svc_port, "/fleet/latency")
+        assert code == 200
+        assert lat["classes"], lat
+        cls = next(iter(lat["classes"].values()))
+        assert cls["ttft_p95_s"] >= cls["ttft_p50_s"] > 0
+        assert cls["segments"]  # the budget breakdown, not just a number
+        assert lat["replicas_queried"] == ["fleet-0"]
+    finally:
+        proxy.shutdown()
+        srv.stop()
+        eng.stop(drain=False)
