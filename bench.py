@@ -800,6 +800,36 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["waterfall"] = {"error": str(e)[:200]}
     try:
+        # structured-output sidebar: serving_bench --constrain's headline
+        # (BENCH_CONSTRAIN.json) — the mask's share of tick wall vs its
+        # budget (the one extra masked-logits op is the whole device
+        # cost), the byte-identity + automaton-replay validity flags, the
+        # 0-invalid-outputs chaos verdict, and the corrupt-cache CRC
+        # recompile gate
+        cn_path = os.path.join(REPO, "BENCH_CONSTRAIN.json")
+        if os.path.exists(cn_path):
+            with open(cn_path) as f:
+                cn = json.loads(f.readline())
+            out["constrain"] = {
+                "mask_tick_overhead_pct":
+                    cn.get("mask_tick_overhead_pct"),
+                "mask_tick_overhead_budget_pct":
+                    cn.get("mask_tick_overhead_budget_pct"),
+                "byte_identical_all_legal":
+                    cn.get("byte_identical_all_legal"),
+                "forced_outputs_grammar_valid":
+                    cn.get("forced_outputs_grammar_valid"),
+                "chaos_invalid_outputs":
+                    cn.get("chaos", {}).get("invalid_outputs"),
+                "chaos_stalled": cn.get("chaos", {}).get("stalled"),
+                "registry_corrupt_cache_recompiles_ok":
+                    cn.get("registry_corrupt_cache_recompiles_ok"),
+                "kv_pages_leaked": cn.get("kv_pages_leaked"),
+                "platform": cn.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["constrain"] = {"error": str(e)[:200]}
+    try:
         # campaign sidebar: serving_bench --campaign's headline
         # (BENCH_CAMPAIGN.json) — the zero-human chaos campaign: every
         # taxonomy class classified and closed with a named remediation

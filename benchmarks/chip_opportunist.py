@@ -323,6 +323,19 @@ JOBS = [
                                   os.path.join(REPO,
                                                "BENCH_WATERFALL.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # structured-output mask overhead on a real chip (README "Structured
+    # output"): the host automaton advance overlaps real device steps,
+    # so the engine_grammar_mask_seconds share of tick wall measures the
+    # true off-critical-path cost instead of the 1-core serial floor;
+    # refreshes BENCH_CONSTRAIN.json with the platform=tpu record
+    {"name": "serving_constrain_tiny",
+     "cmd": _serving_cmd("tiny", ["--constrain", "--concurrency", "4",
+                                  "--prompt-len", "32",
+                                  "--max-tokens", "32",
+                                  "--out",
+                                  os.path.join(REPO,
+                                               "BENCH_CONSTRAIN.json")]),
+     "timeout": 1500, "first_timeout": 900},
     {"name": "perf_introspect_tiny",
      "cmd": _serving_cmd("tiny", ["--perf", "--requests", "16",
                                   "--concurrency", "4",

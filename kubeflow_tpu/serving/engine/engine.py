@@ -90,8 +90,10 @@ from ..incidents import IncidentConfig, IncidentManager, engine_detectors
 from ..kvfabric import FabricStore, fabric_key
 from ..slo import SloConfig, SloTracker
 from .. import waterfall as waterfall_mod
-from .faults import (ChaosInjector, FabricChaos, FabricFaultConfig,
-                     FaultConfig, HandoffChaos, HandoffFaultConfig)
+from ..constrain import ConstraintStall
+from .faults import (ChaosInjector, ConstrainChaos, ConstrainFaultConfig,
+                     FabricChaos, FabricFaultConfig, FaultConfig,
+                     HandoffChaos, HandoffFaultConfig)
 from .kvstore import (KVStoreConfig, TieredKVStore, blob_degree,
                       normalize_session_id, pack_frame, pack_sharded_frame,
                       reshard_blob)
@@ -305,6 +307,13 @@ class EngineConfig:
     # torn/flipped/slow/dead-link pulls, pre-expired publishes — every
     # one must degrade to re-prefill, never fail a request
     fabric_chaos: Optional[FabricFaultConfig] = None
+    # ---- structured output (README "Structured output") -----------------
+    # deterministic constrained-decoding fault injection
+    # (faults.ConstrainFaultConfig): corrupted token-map cache reads must
+    # degrade to a counted re-compile (never an invalid output); forced
+    # zero-legal-token masks must fail ONLY the stalled slot and feed the
+    # incident plane's constraint_stall detector
+    constrain_chaos: Optional[ConstrainFaultConfig] = None
     # ---- incident plane (README "Incident plane") -----------------------
     # background fault-detection + evidence-correlation manager
     # (serving/incidents.py): watchdog trips, tick-deadline overruns,
@@ -422,6 +431,16 @@ class _Pending:
     # additionally defers the fleet-fabric publish at finish (publishing
     # snapshots device pages to host — deferrable work by definition)
     brownout: int = 0
+    # ---- structured output (README "Structured output") ----------------
+    # grammar constraint (serving/constrain.py GrammarConstraint) gating
+    # every token this request samples; None = unconstrained.  The
+    # automaton advances exactly once per committed token (in _commit),
+    # host-side, off the device critical path; preemption snapshots its
+    # configuration set so resume restores it byte-exact, like KV.
+    constrain: "Optional[object]" = None
+    # automaton snapshot taken at preemption (GrammarConstraint.snapshot
+    # dict); restored + cleared when the request is re-admitted
+    constrain_snap: "Optional[dict]" = None
 
 
 class _StaleThread(BaseException):
@@ -682,6 +701,16 @@ class Engine:
         self._fabric_chaos = (FabricChaos(engine_config.fabric_chaos)
                               if engine_config.fabric_chaos is not None
                               else None)
+        # ---- structured output (README "Structured output") --------------
+        # constrained-decoding chaos (zero-legal-mask forcing consulted by
+        # _build_grammar_masks; the registry's cache-read corruption hook
+        # is wired by serve.py, which owns the ConstrainRegistry) plus the
+        # subsystem's loop-side counters
+        self._constrain_chaos = (ConstrainChaos(engine_config.constrain_chaos)
+                                 if engine_config.constrain_chaos is not None
+                                 else None)
+        self._constrained_requests = 0
+        self._constraint_stalls = 0
         self.fabric_fingerprinter = None
         # model identity stamped into every published frame (wired by
         # JetStreamModel alongside the fingerprinter): two same-shape
@@ -919,7 +948,8 @@ class Engine:
                        links: Optional[list] = None,
                        waste_hint: Optional[str] = None,
                        brownout: int = 0,
-                       pre_hints: Optional[dict] = None) -> Future:
+                       pre_hints: Optional[dict] = None,
+                       constrain=None) -> Future:
         """Submit a prompt; the Future resolves to a result dict.
 
         ``stream``: optional queue that receives each token id as it is
@@ -978,6 +1008,14 @@ class Engine:
         ``{"handoff_import": s}`` — README "Latency attribution"); they
         ride the request's span so the waterfall can attribute the relay
         hop's lead-in instead of leaving it unaccounted.
+        ``constrain``: a ``serving.constrain.GrammarConstraint`` (README
+        "Structured output") gating every sampled token of this request —
+        built per request by the serve layer from its registry (grammar
+        compile + tokenizer map both happen OFF the tick loop, at
+        admission).  The engine advances it once per committed token and
+        ships its legal-token mask into the fused sampler as one extra
+        masked-logits op; the constraint's token table must be mapped for
+        THIS model's vocab or the submit raises.
         Raises EngineOverloaded when the queue is at ``max_queue_depth``
         and EngineShutdown once stop() has begun."""
         if not tokens:
@@ -988,6 +1026,15 @@ class Engine:
         prio = normalize_priority(priority)
         if session_id is not None:
             session_id = normalize_session_id(session_id)
+        if constrain is not None:
+            tv = getattr(getattr(constrain, "table", None), "vocab_size", None)
+            if tv != self.config.vocab_size:
+                # a mask sized for another vocab would silently mis-gate
+                # every token — the one constraint shape bug admission
+                # CAN catch cheaply, so it must
+                raise RequestError(
+                    f"constraint token table maps vocab {tv}, model vocab "
+                    f"is {self.config.vocab_size}")
         if self._draining or self._stopped:
             # fast-path: also keeps the overload check below from touching
             # a closed batcher (RuntimeError) after stop(); the locked
@@ -1079,7 +1126,10 @@ class Engine:
                 rid=rid, session_id=session_id, handoff=handoff,
                 waste_reason=waste_hint,
                 brownout=max(0, min(3, int(brownout))),
+                constrain=constrain,
             )
+            if constrain is not None:
+                self._constrained_requests += 1
             if session_id is not None:
                 self._session_active[session_id] = rid
             self._future_rid[fut] = rid
@@ -1194,14 +1244,16 @@ class Engine:
                  trace=None, links: Optional[list] = None,
                  waste_hint: Optional[str] = None,
                  brownout: int = 0,
-                 pre_hints: Optional[dict] = None) -> dict:
+                 pre_hints: Optional[dict] = None,
+                 constrain=None) -> dict:
         fut = self.generate_async(tokens, max_new_tokens, adapter=adapter,
                                   deadline=deadline, priority=priority,
                                   session_id=session_id, handoff=handoff,
                                   kv_import=kv_import,
                                   fabric_import=fabric_import, trace=trace,
                                   links=links, waste_hint=waste_hint,
-                                  brownout=brownout, pre_hints=pre_hints)
+                                  brownout=brownout, pre_hints=pre_hints,
+                                  constrain=constrain)
         try:
             return fut.result(timeout=timeout)
         except FutureTimeoutError:
@@ -1299,7 +1351,8 @@ class Engine:
                         links: Optional[list] = None,
                         waste_hint: Optional[str] = None,
                         brownout: int = 0,
-                        pre_hints: Optional[dict] = None) -> Iterator:
+                        pre_hints: Optional[dict] = None,
+                        constrain=None) -> Iterator:
         """Yield token ids as they are committed, then a final result dict.
 
         The last item yielded is the same dict ``generate`` returns (so
@@ -1318,7 +1371,8 @@ class Engine:
                                   fabric_import=fabric_import,
                                   trace=trace, links=links,
                                   waste_hint=waste_hint,
-                                  brownout=brownout, pre_hints=pre_hints)
+                                  brownout=brownout, pre_hints=pre_hints,
+                                  constrain=constrain)
 
         def _iter():
             while True:
@@ -1370,6 +1424,8 @@ class Engine:
                 "requests_failed": self._requests_failed,
                 "nan_rows": self._nan_rows,
                 "restarts": self._restarts,
+                "constrained_requests": self._constrained_requests,
+                "constraint_stalls": self._constraint_stalls,
                 "trace_history_entries": len(self._trace_ring),
                 "trace_history_bytes": self._trace_ring_bytes,
                 "role": self.ec.role,
@@ -1380,6 +1436,8 @@ class Engine:
                    if self._handoff_chaos is not None else {}),
                 **({"fabric_chaos": self._fabric_chaos.stats()}
                    if self._fabric_chaos is not None else {}),
+                **({"constrain_chaos": self._constrain_chaos.stats()}
+                   if self._constrain_chaos is not None else {}),
                 **({"slo": self.telemetry.slo.snapshot()}
                    if self.telemetry.slo is not None else {}),
                 **({"incidents": self.incidents.stats()}
@@ -1961,6 +2019,11 @@ class Engine:
             self.k_pool, self.v_pool, pk, pv, jnp.asarray(rows))
         logits, ok_dev = self._guard_logits(
             logits, [self._slot_req[s] for s in slots], phase="prefill")
+        cmask, cstall, cdone = self._prefill_masks(slots)
+        if cmask is not None:
+            # first-token grammar mask, AFTER the guard read raw logits
+            logits = jnp.where(jnp.asarray(cmask), logits,
+                               jnp.float32(-1e30))
         sampled = np.asarray(
             sample_tokens(logits, self._next_key(), self.ec.temperature))
         ok = np.asarray(ok_dev) if ok_dev is not None else None
@@ -1975,13 +2038,70 @@ class Engine:
             if ok is not None and not ok[i]:
                 self._fail_nan(slot, "prefill sample row")
                 continue
+            if i in cstall:
+                self._fail_constraint_stall(slot)
+                continue
             pending = self._requests[self._slot_req[slot]]
             del self._prefilling[slot]
-            self._mark_first_token(pending, now)
             plen = int(lens[i])
+            if i in cdone:
+                # a recompute-resumed automaton already at a closed
+                # grammar: nothing may follow — finish with the kept
+                # tokens instead of sampling (outcome "valid")
+                self._activate_decode(slot, plen, self._pages_for(plen),
+                                      self._prefill_rows[slot])
+                self._finish(slot, self._slot_req[slot], truncated=False)
+                continue
+            self._mark_first_token(pending, now)
             self._activate_decode(slot, plen, self._pages_for(plen),
                                   self._prefill_rows[slot])
             self._commit(slot, int(sampled[i]))
+
+    def _prefill_masks(self, slots: list, only=None) -> tuple:  # graftlint: hot-path
+        """First-token grammar masks for one fused prefill sample, in ROW
+        order (``mask[i]`` gates ``slots[i]`` — README "Structured
+        output"); the prompt never advances the automaton, so a fresh
+        request masks from the grammar's start state and a recompute
+        resume from its restored snapshot.  ``only`` restricts the build
+        to those row indices (the chunked group's finishing rows — mid-
+        prompt rows don't sample, so their walks would be pure waste).
+        Returns ``(mask_or_None, stalled_rows, closed_rows)``: stalled
+        rows (non-accepting, zero legal tokens) keep an all-True mask and
+        the caller fails them; closed rows (a restored automaton already
+        at a complete utterance with nothing allowed to follow) finish
+        gracefully with their kept tokens instead of committing."""
+        mask = None
+        stall = set()
+        done = set()
+        t0 = time.perf_counter()
+        for i, slot in enumerate(slots):
+            if only is not None and i not in only:
+                continue
+            pending = self._requests.get(self._slot_req.get(slot))
+            if pending is None or pending.constrain is None:
+                continue
+            ts = time.perf_counter()
+            row = self._grammar_row(pending.constrain)
+            forced = (self._constrain_chaos is not None
+                      and self._constrain_chaos.stall_mask())
+            if forced:
+                row = np.zeros_like(row)
+            if pending.span is not None:
+                pending.span.hint("grammar_advance",
+                                  time.perf_counter() - ts)
+            if not row.any():
+                if not forced and pending.constrain.accepting():
+                    done.add(i)
+                else:
+                    stall.add(i)
+                continue
+            if mask is None:
+                mask = np.ones((len(slots), self.config.vocab_size),
+                               np.bool_)
+            mask[i, :] = row
+        if mask is not None or stall or done:
+            self.telemetry.observe_grammar_mask(time.perf_counter() - t0)
+        return mask, stall, done
 
     def _mark_first_token(self, pending: "_Pending", now: float) -> None:
         if pending.first_token_at:
@@ -2040,9 +2160,16 @@ class Engine:
         self._count_prefill(B)
         finishing = [i for i in range(B) if off + C >= int(lens[i])]
         ok = None
+        cstall = set()
+        cdone = set()
         if finishing:
             logits, ok_dev = self._guard_logits(
                 logits, [self._slot_req[s] for s in slots], phase="prefill")
+            cmask, cstall, cdone = self._prefill_masks(
+                slots, only=set(finishing))
+            if cmask is not None:
+                logits = jnp.where(jnp.asarray(cmask), logits,
+                                   jnp.float32(-1e30))
             # rows mid-prompt get sampled too (greedy ignores the key; their
             # values are simply unused) — still one blocking transfer total
             sampled = np.asarray(
@@ -2067,10 +2194,20 @@ class Engine:
             if ok is not None and not ok[i]:
                 self._fail_nan(slot, "chunked-prefill sample row")
                 continue
+            if i in cstall:
+                self._fail_constraint_stall(slot)
+                continue
             pending = self._requests[self._slot_req[slot]]
             del self._prefilling[slot]
-            self._mark_first_token(pending, now)
             plen = int(lens[i])
+            if i in cdone:
+                # closed restored automaton — same graceful finish as the
+                # short-prefill group
+                self._activate_decode(slot, plen, self._pages_for(plen),
+                                      table_rows[slot])
+                self._finish(slot, self._slot_req[slot], truncated=False)
+                continue
+            self._mark_first_token(pending, now)
             self._activate_decode(slot, plen, self._pages_for(plen),
                                   table_rows[slot])
             self._commit(slot, int(sampled[i]))
@@ -2288,7 +2425,27 @@ class Engine:
                              cancelled=True)
         if decode_ready:
             did_work = True
-            if self._pipe_depth > 0:
+            # --- structured output (README "Structured output"): grammar
+            # masks are only valid relative to the automaton state AFTER
+            # the last committed token, so a constrained tick must never
+            # dispatch over an uncommitted in-flight token.  Fence first
+            # (the "constrain" fence — pipelining depth is the price of
+            # validity; the mask itself still rides the FUSED dispatch),
+            # then build this tick's mask.  The drain's commits may
+            # finish/fail rows; a detected stall fails its slot here.
+            gmask = None
+            if any(self._constraint_for(s) is not None
+                   for s in decode_ready):
+                if self._inflight is not None:
+                    self._drain_pipeline("constrain")
+                    decode_ready = self._ready_now()
+                if decode_ready:
+                    decode_ready, gmask = self._build_grammar_masks(
+                        decode_ready)
+                if not decode_ready:
+                    return did_work
+            if self._pipe_depth > 0 and not (gmask is not None
+                                             and self._spec is not None):
                 if self._spec is not None:
                     # speculative ticks no longer force the sync loop: the
                     # fused verify dispatch (ISSUE 9) keeps drafts on the
@@ -2303,14 +2460,20 @@ class Engine:
                 else:
                     self._isolated("decode", decode_ready,
                                    self._decode_tick_pipelined, decode_ready,
+                                   gmask,
                                    shape={"rows": len(decode_ready),
-                                          "pipelined": True})
+                                          "pipelined": True,
+                                          "constrained": gmask is not None})
                 if tl is not None:
                     tl.note(self._ticks, "decode_dispatch",
                             time.perf_counter() - tp)
                 return did_work
             # host mirrors ARE the decode view: mid-prefill slots hold
-            # len 0 / trash rows by construction (_activate_decode)
+            # len 0 / trash rows by construction (_activate_decode).
+            # Constrained speculative ticks land here at EVERY depth: the
+            # verify mask composes with this tick's drafts (position j's
+            # rows assume drafts 0..j-1 accepted), which only the sync
+            # draft walk has in hand before dispatch.
             seq_lens = self._len_host
             page_table = self._pt_host
             drafts = {slot: self._draft_for(slot, seq_lens[slot])
@@ -2318,15 +2481,17 @@ class Engine:
             if any(drafts.values()):
                 self._isolated("decode", decode_ready,
                                self._decode_tick_speculative, decode_ready,
-                               drafts, seq_lens, page_table,
+                               drafts, seq_lens, page_table, gmask,
                                shape={"rows": len(decode_ready),
                                       "speculative": True,
+                                      "constrained": gmask is not None,
                                       "k": 1 + self.ec.spec_max_draft})
             else:
                 self._isolated("decode", decode_ready,
                                self._decode_tick_single, decode_ready,
-                               seq_lens, page_table,
-                               shape={"rows": len(decode_ready)})
+                               seq_lens, page_table, gmask,
+                               shape={"rows": len(decode_ready),
+                                      "constrained": gmask is not None})
             if tl is not None:
                 tl.note(self._ticks, "decode_dispatch",
                         time.perf_counter() - tp)
@@ -2370,6 +2535,13 @@ class Engine:
             self._finish(slot, rid, truncated=False,
                          cancelled=True, cache_ok=False)
             return
+        if pending.constrain_snap is not None:
+            # preempt-resume (README "Structured output"): restore the
+            # automaton byte-exact from its preemption snapshot BEFORE any
+            # first-token mask is built — swap-resume and drop-recompute
+            # both sample their next token from exactly this state
+            pending.constrain.restore(pending.constrain_snap)
+            pending.constrain_snap = None
         if (pending.deadline is not None and not pending.first_token_at
                 and time.perf_counter() > pending.deadline):
             # deadline expired while queued: shed before spending any
@@ -2909,6 +3081,12 @@ class Engine:
             # share of the re-prefill is never dispatched, so only the
             # genuinely recomputed positions get charged)
             pending.waste_reason = "preempt_recompute"
+        if pending.constrain is not None:
+            # the automaton state rides the slot like KV (README
+            # "Structured output"): the "preempt" drain above landed every
+            # committed token's advance, so this snapshot covers the full
+            # committed generation; re-admission restores it byte-exact
+            pending.constrain_snap = pending.constrain.snapshot()
         pending.preemptions += 1
         self._preemptions += 1
         self._reset_failures(pending)
@@ -3058,6 +3236,106 @@ class Engine:
                              trace_ids=tids, dump=path)
         self._fail_slot(slot, NonFiniteLogits(
             f"non-finite logits in {where}"))
+
+    # ------------------------------------- structured output (constrain.py)
+
+    def _constraint_for(self, slot: int) -> "Optional[object]":
+        p = self._requests.get(self._slot_req.get(slot))
+        return p.constrain if p is not None else None
+
+    def _grammar_row(self, c) -> "np.ndarray":  # graftlint: hot-path
+        """One automaton state's legal-token mask for the NEXT sampled
+        token: the trie-walk token mask with the stop ids composed from
+        acceptance — eos is legal exactly when the generated text so far
+        is a complete grammar-valid utterance, and once the grammar can
+        only END, eos is the sole legal token (the mask FORCES termination
+        instead of sampling garbage past a closed grammar)."""
+        row = c.token_mask()
+        acc = c.accepting()
+        for t in self._stop_ids:
+            if 0 <= t < row.shape[0]:
+                row[t] = acc
+        return row
+
+    def _build_grammar_masks(self, slots: list) -> tuple:  # graftlint: hot-path
+        """Build this tick's [max_slots, V] boolean token mask from each
+        constrained slot's automaton (host-side — JetStream's orchestration
+        stays off the device critical path; the device only sees one extra
+        where() in the fused sampler).  Unconstrained rows stay all-True,
+        so their sampling is bit-identical to an unmasked dispatch.
+
+        MUST run with no dispatch in flight (the _tick "constrain" fence
+        guarantees it): a mask is only valid relative to the automaton
+        state AFTER the last committed token.
+
+        Two zero-legal-row cases, told apart by acceptance: a CLOSED
+        grammar (accepting, nothing may follow — e.g. no eos id
+        configured to express "stop") finishes the slot gracefully with
+        the tokens it has (outcome "valid"); a non-accepting empty row —
+        chaos-forced or a real compile/token-map bug — fails ONLY that
+        slot (ConstraintStall + the incident plane's constraint_stall
+        signal).  Both drop the slot from the returned ready list.
+        Returns ``(surviving_slots, mask)`` with mask None when no
+        surviving slot is constrained."""
+        t0 = time.perf_counter()
+        mask = None
+        stalled = []
+        closed = []
+        for slot in slots:
+            rid = self._slot_req.get(slot)
+            pending = self._requests.get(rid) if rid is not None else None
+            if pending is None or pending.constrain is None:
+                continue
+            ts = time.perf_counter()
+            row = self._grammar_row(pending.constrain)
+            forced = (self._constrain_chaos is not None
+                      and self._constrain_chaos.stall_mask())
+            if forced:
+                row = np.zeros_like(row)
+            if pending.span is not None:
+                # per-request share of this tick's automaton wall — the
+                # waterfall's grammar_advance segment reads these totals
+                pending.span.hint("grammar_advance",
+                                  time.perf_counter() - ts)
+            if not row.any():
+                if not forced and pending.constrain.accepting():
+                    closed.append(slot)
+                else:
+                    stalled.append(slot)
+                continue
+            if mask is None:
+                mask = np.ones((self.ec.max_slots, self.config.vocab_size),
+                               np.bool_)
+            mask[slot, :] = row
+        self.telemetry.observe_grammar_mask(time.perf_counter() - t0)
+        for slot in closed:
+            self._finish(slot, self._slot_req[slot], truncated=False)
+        for slot in stalled:
+            self._fail_constraint_stall(slot)
+        if stalled or closed:
+            gone = set(stalled) | set(closed)
+            slots = [s for s in slots if s not in gone]
+        return slots, mask
+
+    def _fail_constraint_stall(self, slot: int) -> None:
+        """A constrained slot's mask has zero legal tokens: a grammar
+        compile or token-map bug — NEVER the client's fault (their spec
+        compiled and passed admission validation).  Fail ONLY this slot
+        with ConstraintStall, count the outcome, and feed the incident
+        plane's constraint_stall detector (faults.py pins the chaos class
+        -> cause -> playbook contract)."""
+        self._constraint_stalls += 1
+        self.telemetry.count_constrain("stall")
+        tids: list = []
+        if self.ec.telemetry:
+            tids = self._slot_trace_ids([slot])
+            self._flight_event("constraint_stall", [slot], None,
+                               time.perf_counter(), "stall",
+                               error="zero legal tokens under grammar mask")
+        self._incident_event("constraint_stall",
+                             rid=self._slot_req.get(slot), trace_ids=tids)
+        self._fail_slot(slot, ConstraintStall(
+            "constrained decode reached a state with zero legal tokens"))
 
     def _check_epoch(self) -> None:
         """Die (via _StaleThread, uncatchable by the isolation boundaries)
@@ -3236,7 +3514,8 @@ class Engine:
         else:
             self._running = False
 
-    def _decode_tick_single(self, decode_ready, seq_lens, page_table) -> None:
+    def _decode_tick_single(self, decode_ready, seq_lens, page_table,
+                            gmask=None) -> None:
         # _tok_host is maintained by _commit/_activate_decode (steady-state
         # ticks no longer rebuild it with a Python pass over all slots);
         # inactive/prefilling rows stay 0 via _release_slot_state
@@ -3261,6 +3540,14 @@ class Engine:
         )
         self._dispatch_mark = (self._ticks, time.perf_counter())
         logits, ok_dev = self._guard_logits(logits, self._row_rids())
+        if gmask is not None:
+            # the one extra masked-logits op (README "Structured output"):
+            # ordered AFTER the guard reads the raw logits, so a poisoned
+            # row still trips the NaN guard — masking must never hide a
+            # non-finite dispatch behind a finite -1e30 floor
+            jnp = self._jnp
+            logits = jnp.where(jnp.asarray(gmask), logits,
+                               jnp.float32(-1e30))
         sampled = np.asarray(
             sample_tokens(logits, self._next_key(), self.ec.temperature))
         ok = np.asarray(ok_dev) if ok_dev is not None else None
@@ -3636,11 +3923,18 @@ class Engine:
                 return False
         return True
 
-    def _decode_tick_pipelined(self, decode_ready) -> None:
+    def _decode_tick_pipelined(self, decode_ready, gmask=None) -> None:
         """One pipelined decode tick: fence if the roster changed, reserve
         lookahead pages, dispatch the fused step (device consumes its own
         previous output), start the async token readback, then commit the
-        PREVIOUS tick's tokens while this one runs on device."""
+        PREVIOUS tick's tokens while this one runs on device.
+
+        ``gmask`` (README "Structured output"): [max_slots, V] grammar mask
+        shipped into the fused sampler as its ``token_mask`` — the one
+        extra masked-logits op, no new jit signature.  Constrained ticks
+        arrive here with the pipeline already drained (_tick's "constrain"
+        fence), so the mask is exact for the token THIS dispatch samples;
+        the commit lands at the next tick's fence."""
         self._check_epoch()  # a superseded thread must not touch pipeline
         try:
             if self._roster_dirty or self._dec_state is None:
@@ -3666,7 +3960,7 @@ class Engine:
                 if not decode_ready:
                     return
                 self._decode_tick_single(decode_ready, self._len_host,
-                                         self._pt_host)
+                                         self._pt_host, gmask)
                 return
             tok_dev = self._dec_state
             # per-dispatch page-table snapshot: commit-behind mutates
@@ -3692,6 +3986,7 @@ class Engine:
                 lora_params=self._lora,
                 adapter_ids=(np.array(self._aid_host)
                              if self._lora is not None else None),
+                token_mask=gmask,
             )
             self._dispatch_mark = (self._ticks, time.perf_counter())
             if self._async_readback:
@@ -3976,8 +4271,39 @@ class Engine:
             if p >= 0:
                 self._pt_host[slot, owned] = p
                 room += ps
-        return self._lookup_draft(pending,
-                                  min(self.ec.spec_max_draft, room, budget))
+        draft = self._lookup_draft(pending,
+                                   min(self.ec.spec_max_draft, room, budget))
+        if draft and pending.constrain is not None:
+            draft = self._legal_draft_prefix(pending, draft)
+        return draft
+
+    def _legal_draft_prefix(self, pending: "_Pending", draft: list) -> list:
+        """Truncate a prompt-lookup draft at the first token the grammar
+        rejects, walking an automaton CLONE (README "Structured output" —
+        the request's own automaton only ever advances at _commit).  A
+        known-illegal draft position would burn a verify lane on a
+        guaranteed grammar rejection; truncating keeps every rejected
+        draft that DOES reach verify a genuine model disagreement, charged
+        to the existing spec_reject waste bucket.  Stop ids also end the
+        draft — the commit walk terminates there regardless."""
+        ts = time.perf_counter()
+        walker = pending.constrain.clone()
+        keep = 0
+        for t in draft:
+            if int(t) in self._stop_ids or not walker.advance(int(t)):
+                break
+            if not self._grammar_row(walker).any():
+                # the grammar CLOSED behind this token (zero legal rows —
+                # e.g. a complete utterance with no eos id configured):
+                # keep the closing token OUT of the draft so no verify
+                # position ever samples from an all-False mask.  It
+                # arrives through the regular sampled path instead, and
+                # the next tick's mask build finishes the slot.
+                break
+            keep += 1
+        if pending.span is not None:
+            pending.span.hint("grammar_advance", time.perf_counter() - ts)
+        return draft[:keep]
 
     def _lookup_draft(self, pending: _Pending, limit: int) -> list:
         """The prompt-lookup index walk shared by the sync and pipelined
@@ -4007,12 +4333,21 @@ class Engine:
         return ctx[i + n:i + n + limit]
 
     def _decode_tick_speculative(self, decode_ready, drafts, seq_lens,
-                                 page_table) -> None:
+                                 page_table, gmask=None) -> None:
         """One verify pass over [last token + drafts] for every ready slot;
         commit the longest draft prefix matching greedy argmax plus the one
         bonus token the final logit row yields (lossless vs token-by-token).
         Rejected draft KV stays masked and is overwritten by the next tick's
-        row-0 write before anything reads it."""
+        row-0 write before anything reads it.
+
+        ``gmask`` (README "Structured output"): the [max_slots, V] position-0
+        grammar mask; expanded here into the [max_slots, K, V] verify mask by
+        walking an automaton CLONE over each slot's drafts — position j's
+        rows assume drafts 0..j-1 accepted, exactly the state the commit
+        walk is in when it reads logits[j].  Draft tokens the grammar
+        rejects were already truncated by _draft_for, so rejected-draft
+        waste stays charged to the existing spec_reject bucket, never to a
+        grammar disagreement."""
         K = 1 + self.ec.spec_max_draft
         tokens = np.zeros((self.ec.max_slots, K), np.int32)
         for slot in decode_ready:
@@ -4020,6 +4355,30 @@ class Engine:
                 self._requests[self._slot_req[slot]])
             d = drafts.get(slot) or []
             tokens[slot, 1:1 + len(d)] = d
+        vmask = None
+        if gmask is not None:
+            tm = time.perf_counter()
+            vmask = np.ones((self.ec.max_slots, K, self.config.vocab_size),
+                            np.bool_)
+            for slot in decode_ready:
+                pending = self._requests.get(self._slot_req.get(slot))
+                if pending is None or pending.constrain is None:
+                    continue
+                ts = time.perf_counter()
+                vmask[slot, 0, :] = gmask[slot]
+                walker = pending.constrain.clone()
+                for j, t in enumerate(drafts.get(slot) or []):
+                    if not walker.advance(int(t)):
+                        # only reachable from a dead-end state (_draft_for
+                        # already truncated illegal drafts); the preceding
+                        # position's mask forbids continuing, so the commit
+                        # walk can never read the rows left all-True here
+                        break
+                    vmask[slot, j + 1, :] = self._grammar_row(walker)
+                if pending.span is not None:
+                    pending.span.hint("grammar_advance",
+                                      time.perf_counter() - ts)
+            self.telemetry.observe_grammar_mask(time.perf_counter() - tm)
         # raw host mirrors, as in _decode_tick_single — same safety
         # invariant: the blocking sample_tokens fence below precedes every
         # mirror mutation, so the (possibly aliased) buffers are stable
@@ -4038,6 +4397,12 @@ class Engine:
         self._dispatch_mark = (self._ticks, time.perf_counter())
         logits, ok_dev = self._guard_logits(logits, self._row_rids(),
                                             phase="verify")
+        if vmask is not None:
+            # one extra masked-logits op, AFTER the guard read the raw
+            # logits (a poisoned verify pass must still trip the guard)
+            jnp = self._jnp
+            logits = jnp.where(jnp.asarray(vmask), logits,
+                               jnp.float32(-1e30))
         B, _, V = logits.shape
         sampled = np.asarray(sample_tokens(
             logits.reshape(B * K, V), self._next_key(), self.ec.temperature,
@@ -4125,6 +4490,17 @@ class Engine:
         rid = self._slot_req[slot]
         pending = self._requests[rid]
         self._reset_failures(pending)  # consecutive cap: progress resets it
+        if pending.constrain is not None and token not in self._stop_ids:
+            # THE automaton-advance point (README "Structured output"):
+            # exactly once per committed token, every commit path (sync,
+            # pipelined commit-behind, spec walk, prefill first token)
+            # funnels here.  The mask already forced legality, so a failed
+            # advance is a mask/automaton disagreement — the stall bug
+            # class; fail the slot BEFORE the token reaches the stream or
+            # the result (an illegal byte must never leave the engine).
+            if not pending.constrain.advance(int(token)):
+                self._fail_constraint_stall(slot)
+                return 0
         if self.ec.telemetry:
             now = time.perf_counter()
             if pending.last_token_at:
@@ -4238,6 +4614,24 @@ class Engine:
             err = (session or {}).get("error") or (session or {}).get("reason")
             if err:
                 result["session"]["error"] = err
+        if pending.constrain is not None:
+            # structured-output receipt (README "Structured output"):
+            # "valid" == the automaton ACCEPTS the full generation (a
+            # complete grammar-valid utterance); anything else — budget
+            # cut, OOM truncation, client cancel — left a legal-but-
+            # incomplete prefix and reports "truncated".  The serve layer
+            # turns "valid" into the parsed json/tool_call payload.
+            c = pending.constrain
+            outcome = "valid" if c.accepting() else "truncated"
+            self.telemetry.count_constrain(outcome)
+            result["constrain"] = {
+                "kind": c.kind,
+                "outcome": outcome,
+                "n_tokens": c.n_tokens,
+                "n_bytes": c.n_bytes,
+            }
+            if c.tool_name is not None:
+                result["constrain"]["tool"] = c.tool_name
         pending.future.set_result(result)
         if pending.stream is not None:
             pending.stream.put((None, result))

@@ -708,7 +708,7 @@ def decode_step_sample(params, config: DecoderConfig, tokens, seq_lens,
                        page_table, k_pool, v_pool, key, poison=None,
                        temperature: float = 0.0, guard: bool = True,
                        paged: bool = False, mesh=None,
-                       lora_params=None, adapter_ids=None):
+                       lora_params=None, adapter_ids=None, token_mask=None):
     """Decode step with sampling and the NaN guard fused into ONE dispatch
     — the pipelined engine loop's tick body.
 
@@ -737,31 +737,50 @@ def decode_step_sample(params, config: DecoderConfig, tokens, seq_lens,
     core, argmax matches ``sample_tokens``, and finite(min) & finite(max)
     over a row is exactly ``isfinite(row).all()`` (jnp.min/max propagate
     NaN, and any infinity surfaces at one of the extremes).
+
+    ``token_mask`` ([B, V] bool or None) constrains sampling to
+    grammar-legal tokens — ONE extra masked-logits op on the existing
+    signature (None and array are two specializations of the same jit
+    function, not a new entry point), see ``_sample_core``.
     """
     return _sample_core(params, config, tokens, seq_lens, page_table,
                         k_pool, v_pool, key, poison, temperature, guard,
-                        paged, mesh, lora_params, adapter_ids)
+                        paged, mesh, lora_params, adapter_ids,
+                        token_mask=token_mask)
 
 
 def _sample_core(params, config, tokens, seq_lens, page_table, k_pool,
                  v_pool, key, poison, temperature, guard, paged, mesh,
-                 lora_params, adapter_ids):
+                 lora_params, adapter_ids, token_mask=None):
     """Shared trace body of the fused single-token step —
     ``decode_step_sample`` and ``decode_step_sample_packed`` both inline
     this, so the plain pipelined loop and the speculative loop's no-draft
-    tick can never drift numerically."""
+    tick can never drift numerically.
+
+    ``token_mask`` ([B, V] bool or None) is the grammar-constrained
+    decoding mask: illegal tokens are overwritten with the finite
+    ``-1e30`` (the ``_attn`` masking idiom — NEVER -inf, which would turn
+    a fully-masked row into a spurious guard trip) so sampling can only
+    pick a grammar-legal token.  The NaN guard reads the RAW (post-poison,
+    PRE-mask) logits: masking must never hide an injected/NaN row behind
+    the -1e30 floor, and byte-identity with the unconstrained run holds
+    whenever the raw argmax is itself legal (argmax over masked logits ==
+    raw argmax in that case — the mask only removes, never reorders)."""
     logits, k_pool, v_pool = _decode_core(
         params, config, jnp.maximum(tokens, 0), seq_lens, page_table,
         k_pool, v_pool, paged=paged, mesh=mesh, lora_params=lora_params,
         adapter_ids=adapter_ids)
     if poison is not None:
         logits = jnp.where(poison[:, None], jnp.float32(jnp.nan), logits)
+    raw = logits
+    if token_mask is not None:
+        logits = jnp.where(token_mask, logits, jnp.float32(-1e30))
     # the SAME sampler the sync loop dispatches (inlines under this jit):
     # an edit to sample_tokens can never split the two paths' numerics
     sampled = sample_tokens(logits, key, temperature)
     if guard:
-        ok = (jnp.isfinite(jnp.min(logits, axis=-1))
-              & jnp.isfinite(jnp.max(logits, axis=-1)))
+        ok = (jnp.isfinite(jnp.min(raw, axis=-1))
+              & jnp.isfinite(jnp.max(raw, axis=-1)))
         sampled = jnp.where(ok, sampled, -sampled - 1)
     return sampled, k_pool, v_pool
 
@@ -774,7 +793,8 @@ def decode_step_sample_packed(params, config: DecoderConfig, prev_packed,
                               seq_lens, page_table, k_pool, v_pool, key,
                               poison=None, temperature: float = 0.0,
                               guard: bool = True, paged: bool = False,
-                              mesh=None, lora_params=None, adapter_ids=None):
+                              mesh=None, lora_params=None, adapter_ids=None,
+                              token_mask=None):
     """No-draft tick of the pipelined speculative loop: the fused
     single-token step (same ``_sample_core`` trace as
     ``decode_step_sample``) wearing ``decode_step_verify_sample``'s packed
@@ -791,7 +811,8 @@ def decode_step_sample_packed(params, config: DecoderConfig, prev_packed,
         prev_packed, jnp.maximum(n_prev - 1, 0)[:, None], axis=1)[:, 0]
     sampled, k_pool, v_pool = _sample_core(
         params, config, tok0, seq_lens, page_table, k_pool, v_pool, key,
-        poison, temperature, guard, paged, mesh, lora_params, adapter_ids)
+        poison, temperature, guard, paged, mesh, lora_params, adapter_ids,
+        token_mask=token_mask)
     packed = jnp.concatenate(
         [sampled[:, None], jnp.full((B, K - 1), -1, jnp.int32)], axis=1)
     return packed, k_pool, v_pool
@@ -900,7 +921,8 @@ def decode_step_verify_sample(params, config: DecoderConfig, prev_packed,
                               k_pool, v_pool, key, poison=None,
                               temperature: float = 0.0, guard: bool = True,
                               paged: bool = False, mesh=None,
-                              lora_params=None, adapter_ids=None):
+                              lora_params=None, adapter_ids=None,
+                              token_mask=None):
     """Speculative verify with longest-prefix accept/reject, sampling and
     the NaN guard fused into ONE dispatch — the pipelined engine loop's
     speculative tick body (the K-token sibling of ``decode_step_sample``,
@@ -960,6 +982,15 @@ def decode_step_verify_sample(params, config: DecoderConfig, prev_packed,
     if poison is not None:
         logits = jnp.where(poison[:, None, None], jnp.float32(jnp.nan),
                            logits)
+    raw = logits
+    # grammar mask [B, K, V]: position j's legal set assumes drafts 0..j-1
+    # were accepted (the host builds it by walking a clone of the slot's
+    # automaton over the draft tokens), so the bonus/correction token at
+    # the first rejected position is masked by exactly the right state.
+    # Finite -1e30, and the guard below reads RAW — same contract as
+    # ``_sample_core``.
+    if token_mask is not None:
+        logits = jnp.where(token_mask, logits, jnp.float32(-1e30))
     V = logits.shape[-1]
     # the SAME sampler both sync paths dispatch (inlines under this jit):
     # an edit to sample_tokens can never split the paths' numerics
@@ -977,8 +1008,8 @@ def decode_step_verify_sample(params, config: DecoderConfig, prev_packed,
     if guard:
         # finite(min) & finite(max) over a row's K*V logits is exactly
         # isfinite(row).all() — same identity decode_step_sample documents
-        ok = (jnp.isfinite(jnp.min(logits, axis=(1, 2)))
-              & jnp.isfinite(jnp.max(logits, axis=(1, 2))))
+        ok = (jnp.isfinite(jnp.min(raw, axis=(1, 2)))
+              & jnp.isfinite(jnp.max(raw, axis=(1, 2))))
         packed = jnp.where(ok[:, None], packed, jnp.int32(-1))
     return packed, k_pool, v_pool
 

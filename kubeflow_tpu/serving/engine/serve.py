@@ -25,6 +25,7 @@ from typing import Any, Optional
 
 from ...core import tracing
 from .. import kvfabric
+from ..constrain import ConstrainRegistry, GrammarError
 from ..server import Model
 from ..errors import EngineError, RequestError
 from .engine import Engine, EngineConfig
@@ -142,6 +143,10 @@ class JetStreamModel(Model):
         self.model_dir = model_dir
         self.engine = engine
         self.tokenizer = load_tokenizer(model_dir)
+        # structured output (README "Structured output"): spec -> automaton
+        # compilation is memoized here; built lazily so unconstrained
+        # deployments never pay the vocab walk
+        self._constrain_reg: Optional[ConstrainRegistry] = None
         if engine is not None:
             self._wire_fabric(engine)
 
@@ -234,6 +239,15 @@ class JetStreamModel(Model):
 
                     kw["fabric_chaos"] = FabricFaultConfig(
                         **kw["fabric_chaos"])
+                if isinstance(kw.get("constrain_chaos"), dict):
+                    # structured-output chaos straight from an engine.json
+                    # (README "Structured output"): cache corruption must
+                    # degrade to a counted re-compile, stalls to a failed
+                    # slot — never an invalid output
+                    from .faults import ConstrainFaultConfig
+
+                    kw["constrain_chaos"] = ConstrainFaultConfig(
+                        **kw["constrain_chaos"])
                 if isinstance(kw.get("kv_store"), dict):
                     # tiered KV / session durability straight from an
                     # engine.json (README "Sessions & tiered KV"): point
@@ -720,6 +734,74 @@ class JetStreamModel(Model):
             pages = 0
         return {"key": key, "source_port": port, "pages": pages}
 
+    # ------------------------------------------------- structured output
+    # (README "Structured output"): parameters.constrain = {"schema": {...}}
+    # | {"grammar": "..."} | {"format": "json"} | {"tool": {name,
+    # parameters}}.  The spec compiles HERE, at admission — a bad schema is
+    # a 400 with the compiler's message, never an engine-side fault — with
+    # the same unknown-key strictness engine.json blocks get.
+
+    def _constrain_registry(self) -> ConstrainRegistry:
+        if self._constrain_reg is None:
+            cache = (os.path.join(self.model_dir, ".constrain")
+                     if self.model_dir else None)
+            # the ENGINE owns the chaos plane: the registry must consult
+            # the same injector so corrupt-cache campaigns show up in the
+            # engine's chaos ledger
+            chaos = getattr(self.engine, "_constrain_chaos", None)
+            self._constrain_reg = ConstrainRegistry(cache_dir=cache,
+                                                    chaos=chaos)
+        return self._constrain_reg
+
+    def _build_constraint(self, spec):
+        """Compile + tokenizer-map one request's spec (both memoized).
+        RequestError (-> 400) on any compile problem; a corrupt token-map
+        cache surfaces as a counted ``recompile`` outcome, never a fault."""
+        reg = self._constrain_registry()
+        before = reg.stats()["table_cache_recompiles"]
+        try:
+            c = reg.constraint(spec, self.tokenizer)
+        except GrammarError as e:
+            raise RequestError(str(e)) from None
+        recompiles = reg.stats()["table_cache_recompiles"] - before
+        tel = getattr(self.engine, "telemetry", None)
+        if tel is not None:
+            for _ in range(recompiles):
+                tel.count_constrain("recompile")
+        return c
+
+    def _parse_constrain(self, payload: Any):
+        params = (payload.get("parameters") or {}) \
+            if isinstance(payload, dict) else {}
+        if not isinstance(params, dict):
+            return None
+        spec = params.get("constrain")
+        if spec is None:
+            return None
+        return self._build_constraint(spec)
+
+    @staticmethod
+    def _structured_fields(rec: dict, text: str) -> dict:
+        """The parsed structured payload a grammar-valid completion earns:
+        ``json`` for schema/json kinds, ``tool_call`` for tool kind.
+        Empty for truncated outputs — a legal PREFIX is not a sentence,
+        and clients must see the difference loudly."""
+        if not isinstance(rec, dict) or rec.get("outcome") != "valid":
+            return {}
+        kind = rec.get("kind")
+        if kind in ("schema", "json"):
+            try:
+                return {"json": json.loads(text)}
+            except ValueError:
+                return {}
+        if kind == "tool":
+            try:
+                return {"tool_call": {"name": rec.get("tool"),
+                                      "arguments": json.loads(text)}}
+            except ValueError:
+                return {}
+        return {}
+
     def generate(self, payload: Any, headers: Optional[dict] = None) -> Any:
         """V2 generate extension (unary): {"text_input": str, "parameters":
         {"max_tokens": N, "deadline_s": S, "priority": "interactive" |
@@ -736,8 +818,22 @@ class JetStreamModel(Model):
         kv_handoff, hand = self._parse_disagg_params(payload)
         fab = self._parse_fabric_params(payload)
         brownout = self._parse_brownout(payload)
+        constrain = self._parse_constrain(payload)
         if brownout:
             self.engine.telemetry.count_brownout(brownout)
+        if constrain is not None:
+            if kv_handoff or hand is not None:
+                # the automaton state would have to ride the KV handoff
+                # between replicas — not wired; refuse loudly rather than
+                # serve an unconstrained decode phase
+                raise RequestError("constrain does not compose with "
+                                   "disaggregated phases (kv_handoff/"
+                                   "handoff)")
+            if resume:
+                raise RequestError(
+                    "constrain and resume_token_ids are mutually "
+                    "exclusive — resumed tokens never advanced this "
+                    "automaton")
         if fab is not None and hand is not None:
             # a decode phase imports the FULL prompt KV via its handoff —
             # a prefix pull on top is contradictory, refuse loudly
@@ -781,7 +877,7 @@ class JetStreamModel(Model):
                                  session_id=session, fabric_import=fimp,
                                  trace=self._trace_ctx(headers),
                                  links=self._resume_link(headers),
-                                 brownout=brownout,
+                                 brownout=brownout, constrain=constrain,
                                  pre_hints=({"fabric_pull": pull_s}
                                             if pull_s > 0 else None),
                                  # a failover re-admission re-prefills
@@ -808,6 +904,10 @@ class JetStreamModel(Model):
                "prompt_tokens": len(ids), "max_tokens": max_tokens,
                "ttft_s": round(pull_s + r["ttft_s"], 4),
                "latency_s": round(pull_s + r["latency_s"], 4)}
+        if "constrain" in r:
+            out["constrain"] = r["constrain"]
+            out.update(self._structured_fields(r["constrain"],
+                                               out["text_output"]))
         if "session" in r:
             out["session"] = r["session"]
         if "fabric" in r:
@@ -1148,8 +1248,19 @@ class JetStreamModel(Model):
         kv_handoff, hand = self._parse_disagg_params(payload)
         fab = self._parse_fabric_params(payload)
         brownout = self._parse_brownout(payload)
+        constrain = self._parse_constrain(payload)
         if brownout:
             self.engine.telemetry.count_brownout(brownout)
+        if constrain is not None:
+            if kv_handoff or hand is not None:
+                raise RequestError("constrain does not compose with "
+                                   "disaggregated phases (kv_handoff/"
+                                   "handoff)")
+            if resume:
+                raise RequestError(
+                    "constrain and resume_token_ids are mutually "
+                    "exclusive — resumed tokens never advanced this "
+                    "automaton")
         if fab is not None and hand is not None:
             raise RequestError(
                 "fabric and handoff are mutually exclusive")
@@ -1208,6 +1319,7 @@ class JetStreamModel(Model):
                                              trace=self._trace_ctx(headers),
                                              links=self._resume_link(headers),
                                              brownout=brownout,
+                                             constrain=constrain,
                                              pre_hints=(
                                                  {"fabric_pull": pull_s}
                                                  if pull_s > 0 else None),
@@ -1268,6 +1380,19 @@ class JetStreamModel(Model):
                     full = self.tokenizer.decode(out_ids)
                     if len(full) > emitted:  # flush held-back tail
                         yield {"text_output": full[emitted:]}
+                    if "constrain" in item:
+                        # structured SSE event (README "Structured
+                        # output"): a grammar-valid completion re-emits
+                        # the whole utterance PARSED, as its own typed
+                        # event, before the final record — so tool
+                        # dispatchers never re-assemble text pieces
+                        sf = self._structured_fields(item["constrain"],
+                                                     full)
+                        if sf:
+                            ev = {"text_output": "",
+                                  "event": next(iter(sf))}
+                            ev.update(sf)
+                            yield ev
                     final = {"text_output": "", "done": True,
                              "tokens": item["num_tokens"] + base,
                              "prompt_tokens": len(ids), "max_tokens": max_tokens,
@@ -1281,6 +1406,8 @@ class JetStreamModel(Model):
                                              4),
                              "latency_s": round(phase_latency + pull_s
                                                 + item["latency_s"], 4)}
+                    if "constrain" in item:
+                        final["constrain"] = item["constrain"]
                     if "session" in item:
                         final["session"] = item["session"]
                     if "fabric" in item:
@@ -1336,8 +1463,14 @@ class JetStreamModel(Model):
             pr = inst.get("priority") if isinstance(inst, dict) else None
             if pr is not None or header_prio is not None:
                 normalize_priority(pr if pr is not None else header_prio)
+            spec = inst.get("constrain") if isinstance(inst, dict) else None
+            if spec is not None:
+                # compile-validate EVERY spec before submitting anything —
+                # same all-or-nothing rule as adapters/priorities above
+                self._build_constraint(spec)
         futures = []
         for inst in instances:
+            constrain = None
             if isinstance(inst, str):
                 prompt, max_tokens = inst, 32
                 adapter = deadline = None
@@ -1352,12 +1485,18 @@ class JetStreamModel(Model):
                 priority = inst.get("priority")
                 if priority is None:
                     priority = header_prio
+                spec = inst.get("constrain")
+                if spec is not None:
+                    # a FRESH automaton per instance (grammar + table come
+                    # memoized from the validation pass above)
+                    constrain = self._build_constraint(spec)
             ids = self.tokenizer.encode(prompt) or [0]
             futures.append(self.engine.generate_async(ids, max_tokens,
                                                       adapter=adapter,
                                                       deadline=deadline,
                                                       priority=priority,
-                                                      brownout=brownout))
+                                                      brownout=brownout,
+                                                      constrain=constrain))
         out = []
         for fut in futures:
             try:
@@ -1369,14 +1508,17 @@ class JetStreamModel(Model):
                 # abandoned mid-batch holding slots nobody reads
                 out.append({"error": f"{type(e).__name__}: {e}"})
                 continue
-            out.append(
-                {
-                    "text": self.tokenizer.decode(r["tokens"]),
-                    "token_ids": r["tokens"],
-                    "tokens": r["num_tokens"],
-                    "ttft_s": round(r["ttft_s"], 4),
-                    "latency_s": round(r["latency_s"], 4),
-                    "truncated": r["truncated"],
-                }
-            )
+            entry = {
+                "text": self.tokenizer.decode(r["tokens"]),
+                "token_ids": r["tokens"],
+                "tokens": r["num_tokens"],
+                "ttft_s": round(r["ttft_s"], 4),
+                "latency_s": round(r["latency_s"], 4),
+                "truncated": r["truncated"],
+            }
+            if "constrain" in r:
+                entry["constrain"] = r["constrain"]
+                entry.update(self._structured_fields(r["constrain"],
+                                                     entry["text"]))
+            out.append(entry)
         return out

@@ -504,6 +504,24 @@ class EngineTelemetry:
             "serving-stack wall added around the engine per request "
             "(engine scope: model server; ingress scope: service proxy)",
             PROXY_OVERHEAD_BUCKETS_S)
+        # Structured output (README "Structured output"): constrained
+        # requests by terminal outcome — "valid" (finished with the
+        # automaton accepting), "truncated" (max_new_tokens/deadline cut
+        # generation mid-grammar; the emitted prefix is still legal),
+        # "stall" (zero legal tokens — engine bug, the slot failed),
+        # "recompile" (a corrupted token-map cache degraded to a counted
+        # rebuild; the request itself still lands in another outcome) —
+        # and per-tick host wall spent building grammar masks (automaton
+        # advance + trie walk; the waterfall's grammar_advance segment is
+        # the per-request view of the same cost).
+        self.constrained_requests = r.counter(
+            "engine_constrained_requests_total",
+            "constrained (grammar/schema) requests, by terminal outcome")
+        self.grammar_mask = r.histogram(
+            "engine_grammar_mask_seconds",
+            "host wall per tick spent advancing grammar automata and "
+            "building token masks for constrained slots",
+            PROXY_OVERHEAD_BUCKETS_S)
 
     # Observe methods stay branch-cheap: one attribute check, then a dict
     # op under the metric's own lock.
@@ -643,6 +661,14 @@ class EngineTelemetry:
     def count_brownout(self, stage: int) -> None:
         if self.enabled and stage > 0:
             self.brownout_requests.inc(stage=str(stage))
+
+    def count_constrain(self, outcome: str) -> None:
+        if self.enabled:
+            self.constrained_requests.inc(outcome=outcome)
+
+    def observe_grammar_mask(self, s: float) -> None:
+        if self.enabled:
+            self.grammar_mask.observe(s)
 
     def count_incident_firing(self, detector: str) -> None:
         if self.enabled:

@@ -71,14 +71,14 @@ from typing import Callable, Optional
 
 CAUSES = ("replica_death", "prefill_interference", "storage_degradation",
           "handoff_degradation", "fabric_degradation", "capacity",
-          "unknown")
+          "constraint_stall", "unknown")
 
 # signal event kinds producers may feed (attrs by kind are documented at
 # the feed sites; every event SHOULD carry ``trace_ids`` so the bundle
 # can cite the live traces the fault touched)
 EVENT_KINDS = ("watchdog", "tick_overrun", "nan_guard", "degradation",
                "slo_burn", "queue_growth", "failover", "breaker_open",
-               "flap", "shed", "brownout")
+               "flap", "shed", "brownout", "constraint_stall")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,6 +120,10 @@ def engine_detectors() -> list:
                  lambda e: e.get("source") == "fabric"),
         Detector("slo_burn", ("slo_burn",)),
         Detector("admission_pressure", ("queue_growth",)),
+        # constrained decoding (README "Structured output"): a mask with
+        # zero legal tokens is an engine-side compile/mapping bug — the
+        # client's schema already passed admission validation
+        Detector("constraint_stall", ("constraint_stall",)),
     ]
 
 
@@ -157,6 +161,14 @@ def classify(symptoms: list) -> tuple:
         return ("replica_death",
                 "watchdog/failover/breaker evidence: the replica (or its "
                 "loop thread) stopped serving")
+    if "constraint_stall" in by_kind:
+        # precedence over degradation/capacity shapes: a stall burst can
+        # drag a failure-cap shed storm behind it, and the stall is what
+        # the responder pages on (a code bug, not load)
+        return ("constraint_stall",
+                "a constrained slot's automaton reached a state with zero "
+                "legal tokens — a grammar compile or token-map bug, never "
+                "the client's fault")
     sources = [s.get("source") for s in by_kind.get("degradation", ())]
     if sources:
         # the dominant degradation source names the cause: one chaos

@@ -88,6 +88,16 @@ BROWNOUT_STAGES = {
 }
 MAX_BROWNOUT_STAGE = 3
 
+# What brownout must NEVER degrade, at any stage (README "Structured
+# output"): the grammar mask of a constrained request.  Brownout sheds
+# OPTIMIZATIONS — drafting, placement, publishes — and clamps budgets; a
+# clamped constrained request ends "truncated" (a legal prefix), still
+# never an invalid byte.  Dropping the mask would turn load into SILENT
+# CONTRACT VIOLATIONS — a tool-call consumer cannot tell overload-shaped
+# garbage from a model bug.  tests/test_constrain.py pins this list
+# against the engine's behavior; extend it rather than special-casing.
+BROWNOUT_NEVER_DEGRADES = ("grammar_mask",)
+
 
 @dataclasses.dataclass(frozen=True)
 class OverloadConfig:

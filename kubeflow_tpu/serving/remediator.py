@@ -95,6 +95,10 @@ CAUSE_PLAYBOOK = {
     "storage_degradation": "quarantine_tier",
     "handoff_degradation": "quarantine_tier",
     "fabric_degradation": "quarantine_tier",
+    # constrained-decoding stall (README "Structured output"): a grammar
+    # compile / token-map bug needs a code fix, not an actuator — the
+    # playbook keeps the bundle open for a human, it does not auto-heal
+    "constraint_stall": "observe",
     "unknown": "observe",
 }
 PLAYBOOKS = ("replace_replica", "split_roles", "prescale",

@@ -301,6 +301,11 @@ class ServiceProxy:
         # serves its action log.  None = remediation plane off.
         self.remediator = None
         self.quarantine = None
+        # structured output (README "Structured output"): ingress-side
+        # spec validation registry — grammar compiles are memoized per
+        # distinct spec, so the admission check is a dict hit for every
+        # request after a tenant's first
+        self._constrain_reg = None
 
     def attach_remediator(self, remediator) -> None:
         """Wire the remediation controller (remediator.FleetRemediator):
@@ -533,6 +538,14 @@ class ServiceProxy:
             except ValueError:
                 payload = None
         t_parse = time.perf_counter()
+        # ---- structured-output admission (README "Structured output"):
+        # a malformed constrain spec 400s HERE, before it costs an
+        # admission token, a relay hop or a backend compile — the same
+        # compiler the serve layer runs, so ingress and engine can never
+        # disagree about what is well-formed
+        if (handler.command == "POST"
+                and self._validate_constrain(handler, payload)):
+            return
         # ---- overload control (README "Overload control"): the shed-at-
         # ingress decision runs BEFORE any relay/placement work — a
         # refused request costs one bucket refill and a 429, not a relay,
@@ -1144,6 +1157,31 @@ class ServiceProxy:
             state.overload = ctrl
         INGRESS_BROWNOUT.set(0, service=state.service_name)
         return ctrl
+
+    def _validate_constrain(self, handler, payload) -> bool:
+        """Compile-validate ``parameters.constrain`` at ingress; True when
+        the 400 was already answered.  Compiles the GRAMMAR only (the
+        token map is the replica's, tied to its tokenizer) through a
+        memoized registry, so the steady-state cost is one dict lookup."""
+        if not isinstance(payload, dict):
+            return False
+        params = payload.get("parameters")
+        spec = params.get("constrain") if isinstance(params, dict) else None
+        if spec is None:
+            return False
+        from .constrain import ConstrainRegistry, GrammarError
+
+        if self._constrain_reg is None:
+            self._constrain_reg = ConstrainRegistry()
+        try:
+            self._constrain_reg.grammar_for(spec)
+            return False
+        except GrammarError as e:
+            try:
+                handler._reply(400, json.dumps({"error": str(e)}).encode())
+            except Exception:  # noqa: BLE001 — client gone before the 400
+                handler.close_connection = True
+            return True
 
     def _admit_overload(self, state: _ProxyState, ov, handler, payload):
         """Run one POST through the admission gates; on refusal, answer

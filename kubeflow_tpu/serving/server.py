@@ -25,6 +25,70 @@ from .errors import (DeadlineExceeded, EngineOverloaded, EngineShutdown,
                      RequestError, SessionBusy)
 
 
+def openai_constrain_spec(body: dict) -> Optional[dict]:
+    """OpenAI structured-output surface -> a ``parameters.constrain``
+    spec (README "Structured output"), or None when the request asks for
+    free-form text.
+
+    ``response_format: {"type": "json_object"}`` -> ``{"format": "json"}``;
+    ``{"type": "json_schema", "json_schema": {"schema": {...}}}`` ->
+    ``{"schema": {...}}``; a single function in ``tools`` with
+    ``tool_choice`` forcing it (``"required"`` or the by-name form) ->
+    ``{"tool": {...}}``.  Anything malformed raises ValueError — the
+    caller renders it as the surface's 400, the same admission-time
+    strictness the native ``constrain`` parameter gets."""
+    rf = body.get("response_format")
+    tools = body.get("tools")
+    choice = body.get("tool_choice")
+    if rf is not None:
+        if not isinstance(rf, dict) or "type" not in rf:
+            raise ValueError("response_format must be an object with a "
+                             "\"type\" field")
+        t = rf.get("type")
+        if t == "text":
+            return None
+        if t == "json_object":
+            return {"format": "json"}
+        if t == "json_schema":
+            js = rf.get("json_schema")
+            if not isinstance(js, dict) or not isinstance(
+                    js.get("schema"), dict):
+                raise ValueError("response_format.json_schema.schema must "
+                                 "be a schema object")
+            return {"schema": js["schema"]}
+        raise ValueError(f"response_format.type {t!r} not supported "
+                         "(text | json_object | json_schema)")
+    if not choice or choice == "none" or not isinstance(tools, list):
+        return None
+    fns = [t.get("function") for t in tools
+           if isinstance(t, dict) and t.get("type") == "function"
+           and isinstance(t.get("function"), dict)]
+    name = None
+    if isinstance(choice, dict):
+        name = (choice.get("function") or {}).get("name") \
+            if choice.get("type") == "function" else None
+        if not name:
+            raise ValueError("tool_choice object must name a function")
+    elif choice == "required":
+        if len(fns) != 1:
+            raise ValueError("tool_choice \"required\" needs exactly one "
+                             "tool to constrain against; name one with "
+                             "the function form")
+        name = fns[0].get("name")
+    elif choice == "auto":
+        return None  # the model may answer free-form: nothing to force
+    else:
+        raise ValueError(f"tool_choice {choice!r} not supported")
+    fn = next((f for f in fns if f.get("name") == name), None)
+    if fn is None:
+        raise ValueError(f"tool_choice names unknown function {name!r}")
+    params = fn.get("parameters")
+    if not isinstance(params, dict):
+        raise ValueError(f"tool {name!r} has no parameters schema to "
+                         "constrain against")
+    return {"tool": {"name": name, "parameters": params}}
+
+
 class Model:
     """Base model: override load/predict (and optionally pre/postprocess).
 
@@ -794,6 +858,15 @@ class ModelServer:
             bad_request(f"max_tokens must be a positive integer, "
                         f"got {max_tokens!r}")
             return
+        try:
+            # structured output (README "Structured output"): the OpenAI
+            # response_format / forced-tool surface rewrites into the
+            # native constrain parameter; the model layer compiles it at
+            # admission and 400s bad schemas
+            constrain = openai_constrain_spec(body)
+        except ValueError as e:
+            bad_request(str(e))
+            return
         payload = {"text_input": prompt,
                    "parameters": {"max_tokens": max_tokens,
                                   "adapter": adapter,
@@ -810,7 +883,8 @@ class ModelServer:
                                   # controller marks OpenAI bodies at the
                                   # top level; the model layer validates
                                   # the stage
-                                  "brownout": body.get("brownout")}}
+                                  "brownout": body.get("brownout"),
+                                  "constrain": constrain}}
         headers = dict(h.headers.items())
         oid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:24]}"
         obj = "chat.completion" if chat else "text_completion"
@@ -820,11 +894,27 @@ class ModelServer:
             # both keys 0>=0 would mislabel every response "length"
             finish = ("length" if "tokens" in out and "max_tokens" in out
                       and out["tokens"] >= out["max_tokens"] else "stop")
-            choice = ({"index": 0, "message": {"role": "assistant",
-                                               "content": out["text_output"]},
-                       "finish_reason": finish} if chat else
-                      {"index": 0, "text": out["text_output"],
-                       "finish_reason": finish})
+            tc = out.get("tool_call")
+            if chat and isinstance(tc, dict):
+                # forced tool call: render the OpenAI tool_calls message
+                # (arguments are a JSON STRING per the OpenAI wire shape)
+                msg = {"role": "assistant", "content": None,
+                       "tool_calls": [{
+                           "id": f"call_{uuid.uuid4().hex[:24]}",
+                           "type": "function",
+                           "function": {
+                               "name": tc.get("name"),
+                               "arguments": json.dumps(
+                                   tc.get("arguments"))}}]}
+                choice = {"index": 0, "message": msg,
+                          "finish_reason": "tool_calls"}
+            else:
+                choice = ({"index": 0,
+                           "message": {"role": "assistant",
+                                       "content": out["text_output"]},
+                           "finish_reason": finish} if chat else
+                          {"index": 0, "text": out["text_output"],
+                           "finish_reason": finish})
             h._send(200, {
                 "id": oid, "object": obj, "created": int(time.time()),
                 "model": name, "choices": [choice],

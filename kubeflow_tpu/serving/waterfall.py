@@ -55,6 +55,8 @@ SEGMENTS = {
     "prefill": "one prefill chunk dispatch (per-chunk segments)",
     "decode": "token generation after first_token (minus carve-outs)",
     "spec_verify": "speculative verify dispatches carved out of decode",
+    "grammar_advance": "host-side automaton advance + token-mask build "
+                       "for constrained decoding, carved out of decode",
     "preempt_restore": "swap-resume KV restore after a preemption",
     "stream_flush": "backend-to-client response relay after the engine "
                     "span ended (SSE flush, headers, proxy bookkeeping)",
@@ -224,12 +226,20 @@ def engine_segments(span: dict) -> tuple:
                      "fabric_restore", "handoff_import", "resumed"):
             saw_work = True
     wall = prev_t
-    # ---- spec_verify carve: split each decode interval so its tail
-    # holds this request's share of the verify-dispatch wall
-    verify = float(hints.pop("verify", 0.0) or 0.0)
-    decode_total = sum(e - s for s, e, n, _ in intervals if n == "decode")
-    if verify > _EPS and decode_total > _EPS:
-        frac = min(1.0, verify / decode_total)
+    # ---- decode carve-outs: split each decode interval so its tail
+    # holds this request's share of (a) the verify-dispatch wall and
+    # (b) the grammar-automaton wall (README "Structured output") —
+    # carved SEQUENTIALLY, each from what decode time remains, and
+    # clamped there, so the partition stays exact even when the hints
+    # also accumulated outside decode (a prefill-tick mask build)
+    for hint, seg in (("verify", "spec_verify"),
+                      ("grammar_advance", "grammar_advance")):
+        amount = float(hints.pop(hint, 0.0) or 0.0)
+        decode_total = sum(e - s for s, e, n, _ in intervals
+                           if n == "decode")
+        if amount <= _EPS or decode_total <= _EPS:
+            continue
+        frac = min(1.0, amount / decode_total)
         carved: list = []
         for s, e, n, meta in intervals:
             if n != "decode":
@@ -238,8 +248,7 @@ def engine_segments(span: dict) -> tuple:
             cut = e - (e - s) * frac
             if cut - s > _EPS:
                 carved.append((s, cut, "decode", meta))
-            carved.append((cut, e, "spec_verify",
-                           {"carved_from": "decode"}))
+            carved.append((cut, e, seg, {"carved_from": "decode"}))
         intervals = carved
     pre_s = {PRE_HINT_SEGMENTS[k]: round(float(v), 9)
              for k, v in hints.items()

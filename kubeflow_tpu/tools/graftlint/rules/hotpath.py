@@ -21,6 +21,23 @@ BANNED_CALLS = {
     "sorted": "sorting is O(n log n) — keep a cache or a heap",
     "time.time": "wall clock skews under NTP steps — hot-path timing "
                  "uses time.perf_counter",
+    # grammar/regex compilation is admission-time work (README
+    # "Structured output"): the per-tick mask path walks PRE-compiled
+    # automata; a compile here would stall every slot in the batch
+    "re.compile": "pattern compilation is O(pattern) with a global lock "
+                  "on the cache — compile at module scope",
+    "compile_grammar": "grammar compilation belongs at admission — the "
+                       "tick path only walks compiled automata",
+    "compile_json_schema": "schema compilation belongs at admission — "
+                           "the tick path only walks compiled automata",
+    "compile_spec": "spec compilation belongs at admission — the tick "
+                    "path only walks compiled automata",
+    "constrain.compile_grammar": "grammar compilation belongs at "
+                                 "admission — the tick path only walks "
+                                 "compiled automata",
+    "constrain.compile_spec": "spec compilation belongs at admission — "
+                              "the tick path only walks compiled "
+                              "automata",
 }
 
 

@@ -1,4 +1,5 @@
 import json
+import re
 import threading
 import time
 
@@ -9,7 +10,9 @@ TABLE: dict = {}
 def observe(raw):  # graftlint: hot-path
     body = json.loads(raw)
     body["at"] = time.time()
+    pat = re.compile(body.get("filter", ".*"))
     with LOCK:
         for k, v in TABLE.items():
-            body[k] = v
+            if pat.match(k):
+                body[k] = v
     return body

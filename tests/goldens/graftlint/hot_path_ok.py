@@ -1,12 +1,17 @@
+import re
 import threading
 import time
 
 LOCK = threading.Lock()
 TABLE: dict = {}
+KEY_PAT = re.compile(r"[a-z_]+")  # compiled once, outside any hot path
 
 
 def observe(body):  # graftlint: hot-path
     body["at"] = time.perf_counter()
+    key = body.get("k")
+    if key is None or not KEY_PAT.match(key):
+        return None
     with LOCK:
-        cached = TABLE.get(body.get("k"))
+        cached = TABLE.get(key)
     return cached

@@ -1,0 +1,698 @@
+"""Structured output tests (ISSUE 19, serving/constrain.py + the engine's
+fused mask path): grammar-constrained decoding as a static-shape boolean
+mask over the vocabulary.
+
+The contract under test, layer by layer:
+
+  * the byte-level pushdown automaton — hand-built EBNF grammars,
+    JSON-Schema compilation, ``token_mask`` correctness against the
+    brute-force legal-token oracle (mask[t] == "advance(t) succeeds on a
+    clone"), O(1) clone independence, and byte-exact snapshot/restore
+    with CRC guards;
+  * the registry — token maps built once per vocab, disk-cached with a
+    payload CRC, and a corrupted cache degrading to a COUNTED re-compile
+    that is byte-identical to a cold build (never an invalid map);
+  * the engine — the byte-identity oracle (constrained output identical
+    to unconstrained whenever the unconstrained output complies, and
+    grammar-valid always) across pipeline depth {0,1} x speculation
+    {off,on}, closed-grammar graceful finish without an eos id, eos
+    composition, automaton snapshots riding preempt/resume like KV,
+    brownout stage 2 dropping drafts but NEVER the mask, and the seeded
+    constrain chaos classes (forced zero-legal-token masks fail ONLY the
+    victim with ConstraintStall + a constraint_stall incident; every
+    surviving output stays grammar-valid — 0 invalid outputs);
+  * the serve/ingress surface — schema validated at admission (400 with
+    the compiler's message), structured ``json``/``tool_call`` response
+    fields and SSE events, the OpenAI response_format/tool_choice
+    mapping, and the two new metrics' exposition;
+  * the cross-module pins — brownout never degrades the mask
+    (overload.BROWNOUT_NEVER_DEGRADES) and the chaos -> cause ->
+    playbook taxonomy rows for constraint_stall.
+"""
+
+import json
+import re as re_mod
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving import overload
+from kubeflow_tpu.serving.constrain import (ConstrainRegistry,
+                                            ConstraintStall,
+                                            GrammarConstraint, GrammarError,
+                                            TokenTable, compile_grammar,
+                                            compile_json_schema, compile_spec,
+                                            json_grammar)
+from kubeflow_tpu.serving.engine import Engine, EngineConfig
+from kubeflow_tpu.serving.engine import model as M
+from kubeflow_tpu.serving.engine.faults import (EXPECTED_INCIDENT_CAUSES,
+                                                ConstrainChaos,
+                                                ConstrainFaultConfig,
+                                                FaultConfig)
+from kubeflow_tpu.serving.engine.serve import ByteTokenizer, JetStreamModel
+from kubeflow_tpu.serving.errors import RequestError
+from kubeflow_tpu.serving.incidents import CAUSES
+from kubeflow_tpu.serving.remediator import CAUSE_PLAYBOOK
+from kubeflow_tpu.serving.server import openai_constrain_spec
+
+pytestmark = pytest.mark.constrain
+
+CFG = M.DecoderConfig(vocab_size=101, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128)
+# small vocab => prompt-lookup drafts genuinely get accepted (the
+# test_spec_pipeline rationale), which the spec-composition tests need
+CFG_ACC = M.DecoderConfig(vocab_size=13, d_model=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, d_ff=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def params_acc():
+    return M.init(jax.random.PRNGKey(0), CFG_ACC)
+
+
+# one-byte token tables matching the test model vocabs: token id i <-> the
+# single byte i, so grammars talk about bytes and tests talk about tokens
+TABLE101 = TokenTable([bytes([i]) for i in range(101)])
+TABLE13 = TokenTable([bytes([i]) for i in range(13)])
+TABLE256 = TokenTable([bytes([i]) for i in range(256)])
+
+# every token legal forever: the identity-oracle grammar (\x64 == 100)
+ALL_LEGAL_101 = r"start ::= [\x00-\x64]* ;"
+ALL_LEGAL_13 = r"start ::= [\x00-\x0c]* ;"
+AB_C = 'start ::= "ab" ("ab")* "c" ;'  # bytes 97/98 then a closing 99
+
+ALL_VOCAB = list(range(1, CFG.vocab_size))
+PROMPTS = [ALL_VOCAB, [7, 3, 9, 5] * 6,
+           [(i * 13 + 7) % (CFG.vocab_size - 1) + 1 for i in range(9)]]
+
+
+def _con(text: str, table=TABLE101) -> GrammarConstraint:
+    return GrammarConstraint(compile_grammar(text), table)
+
+
+def _walk(grammar, data: bytes):
+    """Feed bytes one at a time through a byte table; returns the
+    constraint after the last byte that advanced, plus success."""
+    c = GrammarConstraint(grammar, TABLE256)
+    for b in data:
+        if not c.advance(b):
+            return c, False
+    return c, True
+
+
+def _accepts(grammar, data: bytes) -> bool:
+    c, ok = _walk(grammar, data)
+    return ok and c.accepting()
+
+
+def _assert_mask_matches_oracle(c: GrammarConstraint):
+    """The core mask contract: mask[t] is True exactly when advancing a
+    CLONE by token t succeeds."""
+    mask = c.token_mask()
+    for tid in range(c.table.vocab_size):
+        assert bool(mask[tid]) == c.clone().advance(tid), (
+            f"mask[{tid}]={bool(mask[tid])} disagrees with advance()")
+
+
+# ------------------------------------------------------- automaton units
+
+
+def test_literal_grammar_walk_and_masks():
+    c = _con(AB_C)
+    assert not c.accepting()
+    m0 = c.token_mask()
+    assert m0[97] and not m0[98] and not m0[99]  # only 'a' opens
+    assert c.advance(97) and c.advance(98)
+    m2 = c.token_mask()
+    assert m2[97] and m2[99] and not m2[98]  # another "ab", or close
+    # an illegal token leaves the state UNCHANGED
+    before = c.configs
+    assert not c.advance(98)
+    assert c.configs is before and c.n_tokens == 2
+    assert c.advance(99)
+    assert c.accepting()
+    assert not c.token_mask().any()  # closed: zero legal continuations
+    assert c.n_tokens == 3 and c.n_bytes == 3
+
+
+def test_mask_matches_brute_force_oracle_along_random_paths():
+    """Every step of a random legal walk, for three structurally distinct
+    grammars: mask bit == clone-advance legality for EVERY token id."""
+    rng = np.random.default_rng(7)
+    grammars = [compile_grammar(AB_C),
+                compile_grammar('start ::= [a-d]+ ("," [a-d]+)* ;'),
+                json_grammar()]
+    for g in grammars:
+        c = GrammarConstraint(g, TABLE101)
+        for _ in range(12):
+            _assert_mask_matches_oracle(c)
+            legal = np.flatnonzero(c.token_mask())
+            if len(legal) == 0:
+                break
+            assert c.advance(int(rng.choice(legal)))
+
+
+def test_mask_oracle_on_multibyte_token_table():
+    """Trie DFS with shared prefixes: multi-byte tokens (including ids
+    that are prefixes of other ids) still mask exactly per the oracle."""
+    toks = [b"", b"a", b"ab", b"abc", b"b", b"c", b"ca", b"abab", b"x"]
+    table = TokenTable(toks)
+    c = GrammarConstraint(compile_grammar(AB_C), table)
+    assert table.skipped == 1  # the empty token never enters the trie
+    for _ in range(6):  # a few (ab) extensions, multi-byte tokens included
+        _assert_mask_matches_oracle(c)
+        legal = np.flatnonzero(c.token_mask())
+        assert len(legal) > 0
+        assert c.advance(int(legal[0]))
+    assert c.advance(toks.index(b"ab")) and c.advance(toks.index(b"c"))
+    _assert_mask_matches_oracle(c)
+    assert c.accepting() and not c.token_mask().any()
+
+
+def test_grammar_syntax_errors():
+    for bad in ("start: 'a' ;",        # lark-style colon is not EBNF
+                "start ::= 'a ;",      # unterminated string
+                "start ::= [] ;",      # empty class
+                "start ::= ('a' ;",    # unclosed group
+                "start ::= nope ;",    # undefined nonterminal
+                "start ::= [z-a] ;"):  # inverted range
+        with pytest.raises(GrammarError):
+            compile_grammar(bad)
+
+
+def test_class_escapes_and_negation():
+    g = compile_grammar(r"start ::= [^\x00-\x60] '\n' ;")
+    assert _accepts(g, b"z\n")
+    assert not _accepts(g, b"A\n")  # 0x41 is inside the negated range
+    assert not _accepts(g, b"z")
+
+
+def test_json_format_grammar():
+    g = json_grammar()
+    for ok in (b"null", b"true", b"-12.5e3", b'"a\\nb"', b"[1,2,[]]",
+               b'{"k":{"v":[true,null]},"z":""}'):
+        assert _accepts(g, ok), ok
+    for bad in (b"nul", b"[1,]", b"{k:1}", b"01"):
+        assert not _accepts(g, bad), bad
+    # a legal PREFIX advances but does not accept
+    c, ok = _walk(g, b'{"k":')
+    assert ok and not c.accepting()
+
+
+def test_json_schema_compilation_and_validity():
+    g = compile_json_schema({
+        "type": "object",
+        "properties": {"ok": {"type": "boolean"},
+                       "tags": {"type": "array", "items": {"type": "string"},
+                                "minItems": 1, "maxItems": 2}},
+        "required": ["ok", "tags"]})
+    assert _accepts(g, b'{"ok":true,"tags":["a"]}')
+    assert _accepts(g, b'{"ok":false,"tags":["a","b"]}')
+    assert not _accepts(g, b'{"ok":1,"tags":["a"]}')       # wrong type
+    assert not _accepts(g, b'{"ok":true,"tags":[]}')        # under minItems
+    assert not _accepts(g, b'{"ok":true,"tags":["a","b","c"]}')  # over max
+    with pytest.raises(GrammarError, match="unsupported schema key"):
+        compile_json_schema({"type": "object", "additionalProperties": False})
+    with pytest.raises(GrammarError, match="required"):
+        compile_json_schema({"type": "object", "properties": {},
+                             "required": ["ghost"]})
+
+
+def test_compile_spec_strictness():
+    g, kind, tool = compile_spec({"format": "json"})
+    assert kind == "json" and tool is None
+    g, kind, tool = compile_spec({"grammar": AB_C})
+    assert kind == "grammar"
+    g, kind, tool = compile_spec({"schema": {"const": 5}})
+    assert kind == "schema"
+    g, kind, tool = compile_spec(
+        {"tool": {"name": "f", "parameters": {"const": {"q": 1}}}})
+    assert kind == "tool" and tool == "f"
+    for bad in ({}, {"format": "xml"}, {"grammar": AB_C, "format": "json"},
+                {"mystery": 1}, {"grammar": 7}, {"schema": []},
+                {"tool": {"name": "f"}}, {"tool": {"parameters": {}}},
+                {"tool": {"name": "f", "parameters": {}, "x": 1}}, "nope"):
+        with pytest.raises(GrammarError):
+            compile_spec(bad)
+
+
+def test_clone_is_independent():
+    c = _con(AB_C)
+    assert c.advance(97)
+    d = c.clone()
+    assert d.advance(98) and d.n_tokens == 2
+    assert c.n_tokens == 1 and not c.token_mask()[99]
+    assert (d.token_mask() != c.token_mask()).any()
+
+
+def test_snapshot_restore_byte_exact():
+    c = _con(AB_C)
+    for t in (97, 98, 97):
+        assert c.advance(t)
+    snap = c.snapshot()
+    json.dumps(snap)  # JSON-safe: rides session tiers cross-process
+    fresh = _con(AB_C)
+    fresh.restore(snap)
+    assert fresh.n_tokens == 3 and fresh.n_bytes == 3
+    np.testing.assert_array_equal(fresh.token_mask(), c.token_mask())
+    assert fresh.accepting() == c.accepting()
+    # the restored automaton continues exactly where the original would
+    assert fresh.advance(98) and fresh.advance(99) and fresh.accepting()
+    # CRC guards: a snapshot never silently resumes under the wrong
+    # grammar or token map
+    with pytest.raises(GrammarError, match="grammar crc"):
+        _con(ALL_LEGAL_101).restore(snap)
+    with pytest.raises(GrammarError, match="token-table crc"):
+        _con(AB_C, TABLE256).restore(snap)
+    with pytest.raises(GrammarError, match="version"):
+        _con(AB_C).restore({"v": 2})
+
+
+# ------------------------------------------------------------ the registry
+
+
+def test_registry_table_cache_and_corrupt_read_recompiles(tmp_path):
+    tok = ByteTokenizer()
+    cache = str(tmp_path / "constrain")
+    r1 = ConstrainRegistry(cache_dir=cache)
+    t1 = r1.table_for(tok)
+    assert r1.table_for(tok) is t1  # in-memory identity
+    assert r1.stats()["table_builds"] == 1
+    # a second process hits the disk artifact instead of rebuilding
+    r2 = ConstrainRegistry(cache_dir=cache)
+    t2 = r2.table_for(tok)
+    assert r2.stats() == {**r2.stats(), "table_cache_hits": 1,
+                          "table_builds": 0}
+    assert t2.crc == t1.crc and t2.token_bytes == t1.token_bytes
+    # chaos flips one payload byte of the cache READ: the CRC gate turns
+    # it into a COUNTED re-compile, byte-identical to a cold build —
+    # never an invalid token map
+    chaos = ConstrainChaos(ConstrainFaultConfig(seed=3, corrupt_cache_every=1))
+    r3 = ConstrainRegistry(cache_dir=cache, chaos=chaos)
+    t3 = r3.table_for(tok)
+    s3 = r3.stats()
+    assert s3["table_cache_recompiles"] == 1 and s3["table_builds"] == 1
+    assert chaos.stats()["injected_corrupt_reads"] == 1
+    assert t3.crc == t1.crc and t3.token_bytes == t1.token_bytes
+
+
+def test_registry_grammar_memoization_and_limits(tmp_path):
+    r = ConstrainRegistry(cache_dir=str(tmp_path))
+    spec = {"grammar": AB_C}
+    g1 = r.grammar_for(spec)
+    assert r.grammar_for(dict(spec)) is g1  # keyed by canonical JSON
+    s = r.stats()
+    assert s["grammar_compiles"] == 1 and s["grammar_cache_hits"] == 1
+    with pytest.raises(GrammarError, match="JSON-encodable"):
+        r.grammar_for({"grammar": b"bytes"})
+    c = r.constraint(spec, ByteTokenizer())
+    assert isinstance(c, GrammarConstraint) and c.kind == "grammar"
+    assert c.table.vocab_size == 256
+
+
+# ------------------------------------------------- engine: identity oracle
+
+
+def _run(params, cfg, ec, prompts, make_con=None, n_tokens=10, brownout=0):
+    eng = Engine(params, cfg, ec)
+    eng.start()
+    try:
+        futs = [eng.generate_async(
+            p, n_tokens, brownout=brownout,
+            constrain=make_con() if make_con is not None else None)
+            for p in prompts]
+        out = []
+        for f in futs:
+            try:
+                out.append(f.result(timeout=180))
+            except Exception as e:  # noqa: BLE001 — chaos arms expect stalls
+                out.append(e)
+        return out, eng.stats
+    finally:
+        eng.stop()
+
+
+def _text(tokens) -> str:
+    return "".join(chr(t) for t in tokens)
+
+
+def test_all_legal_mask_is_byte_identical_to_unconstrained(params):
+    """THE byte-identity oracle: under a grammar the unconstrained output
+    already complies with, the mask changes NOTHING — token-for-token
+    identical across pipeline depth {0,1} x speculation {off,on}."""
+    plain, _ = _run(params, CFG, EngineConfig(
+        max_slots=4, num_pages=128, page_size=8, max_pages_per_slot=16,
+        pipeline_depth=0), PROMPTS)
+    want = [r["tokens"] for r in plain]
+    for depth in (0, 1):
+        for spec in (None, "prompt_lookup"):
+            ec = EngineConfig(
+                max_slots=4, num_pages=128, page_size=8,
+                max_pages_per_slot=16, pipeline_depth=depth,
+                speculative=spec, spec_ngram=1, spec_max_draft=4)
+            got, stats = _run(params, CFG, ec, PROMPTS,
+                              make_con=lambda: _con(ALL_LEGAL_101))
+            assert [r["tokens"] for r in got] == want, (depth, spec)
+            assert all(r["constrain"]["outcome"] == "valid" for r in got)
+            assert stats["constrained_requests"] == len(PROMPTS)
+            assert stats["constraint_stalls"] == 0
+            assert (stats["free_pages"] + stats["cached_pages"]
+                    == 128 - 1), stats
+
+
+def test_forcing_grammar_closed_graceful_finish_without_eos(params):
+    """A closed grammar on an engine with NO eos id finishes the slot
+    gracefully at the exact grammar boundary — never a stall, never a
+    budget-truncation."""
+    ec = EngineConfig(max_slots=2, num_pages=64, page_size=8,
+                      max_pages_per_slot=16)
+    out, stats = _run(params, CFG, ec, [[5, 6, 7]],
+                      make_con=lambda: _con('start ::= "abc" ;'),
+                      n_tokens=9)
+    r = out[0]
+    assert r["tokens"] == [97, 98, 99]  # ord("abc")
+    assert r["constrain"] == {"kind": "grammar", "outcome": "valid",
+                              "n_tokens": 3, "n_bytes": 3}
+    assert not r["truncated"] and stats["constraint_stalls"] == 0
+
+
+def test_eos_composes_with_closed_grammar(params):
+    """With stop ids configured, a closed grammar makes eos the ONLY
+    legal token — the sampled eos terminates exactly like any eos."""
+    ec = EngineConfig(max_slots=2, num_pages=64, page_size=8,
+                      max_pages_per_slot=16, eos_ids=(100,))
+    out, _ = _run(params, CFG, ec, [[5, 6, 7]],
+                  make_con=lambda: _con('start ::= "abc" ;'), n_tokens=9)
+    assert out[0]["tokens"] == [97, 98, 99, 100]
+    assert out[0]["constrain"]["outcome"] == "valid"
+    assert out[0]["constrain"]["n_tokens"] == 3  # stop ids never advance
+
+
+def test_grammar_valid_always_and_truncation_reports(params):
+    """The other half of the oracle: when the mask DOES bite, every
+    output is a legal sentence prefix — complete iff outcome=="valid"."""
+    ec = EngineConfig(max_slots=4, num_pages=128, page_size=8,
+                      max_pages_per_slot=16)
+    out, _ = _run(params, CFG, ec, PROMPTS, make_con=lambda: _con(AB_C),
+                  n_tokens=8)
+    g = compile_grammar(AB_C)
+    for r in out:
+        c, ok = _walk(g, _text(r["tokens"]).encode("latin-1"))
+        assert ok, "constrained output is not even a legal prefix"
+        assert (r["constrain"]["outcome"] == "valid") == c.accepting()
+        if r["constrain"]["outcome"] == "valid":
+            assert re_mod.fullmatch(r"(ab)+c", _text(r["tokens"]))
+
+
+def test_spec_drafts_verified_against_automaton(params_acc):
+    """Speculation composes: with REAL draft acceptance (small vocab) the
+    constrained spec run is byte-identical to the unconstrained plain run
+    under the all-legal grammar, and a forcing grammar still yields the
+    exact forced string with drafting live."""
+    prompts = [list(range(1, CFG_ACC.vocab_size)), [1, 2, 3, 4] * 4]
+    plain, _ = _run(params_acc, CFG_ACC, EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        pipeline_depth=0), prompts, n_tokens=24)
+    spec_ec = EngineConfig(
+        max_slots=2, num_pages=64, page_size=8, max_pages_per_slot=16,
+        pipeline_depth=1, speculative="prompt_lookup", spec_ngram=1,
+        spec_max_draft=4)
+    got, stats = _run(params_acc, CFG_ACC, spec_ec, prompts,
+                      make_con=lambda: _con(ALL_LEGAL_13, TABLE13),
+                      n_tokens=24)
+    assert [r["tokens"] for r in got] == [r["tokens"] for r in plain]
+    assert stats["spec_proposed"] > 0
+    forced, _ = _run(params_acc, CFG_ACC, spec_ec, [prompts[0]],
+                     make_con=lambda: GrammarConstraint(
+                         compile_grammar('start ::= "\\x01\\x02\\x03" ;'),
+                         TABLE13), n_tokens=24)
+    assert forced[0]["tokens"] == [1, 2, 3]
+    assert forced[0]["constrain"]["outcome"] == "valid"
+
+
+def test_automaton_snapshot_rides_preempt_resume(params):
+    """A preemption storm (chaos preempt_every) swaps constrained slots
+    out and back: the automaton snapshot restores byte-exact alongside
+    the KV, so outputs match the storm-free constrained run."""
+    base = dict(max_slots=4, num_pages=128, page_size=8,
+                max_pages_per_slot=16)
+    calm, _ = _run(params, CFG, EngineConfig(**base), PROMPTS,
+                   make_con=lambda: _con(ALL_LEGAL_101), n_tokens=12)
+    storm, stats = _run(params, CFG, EngineConfig(
+        **base, chaos=FaultConfig(preempt_every=5)), PROMPTS,
+        make_con=lambda: _con(ALL_LEGAL_101), n_tokens=12)
+    assert stats["preemptions"] > 0
+    assert [r["tokens"] for r in storm] == [r["tokens"] for r in calm]
+    assert all(r["constrain"]["outcome"] == "valid" for r in storm)
+
+
+def test_brownout_stage2_drops_drafts_never_the_mask(params_acc):
+    """The degradation contract (overload.BROWNOUT_NEVER_DEGRADES):
+    brownout stage 2 turns speculation off for the request but the
+    grammar mask stays — output unchanged, zero drafts proposed."""
+    ec = EngineConfig(max_slots=2, num_pages=64, page_size=8,
+                      max_pages_per_slot=16, pipeline_depth=1,
+                      speculative="prompt_lookup", spec_ngram=1,
+                      spec_max_draft=4)
+    prompts = [list(range(1, CFG_ACC.vocab_size))]
+    hot, s_hot = _run(params_acc, CFG_ACC, ec, prompts,
+                      make_con=lambda: _con(ALL_LEGAL_13, TABLE13),
+                      n_tokens=24)
+    assert s_hot["spec_proposed"] > 0
+    cool, s_cool = _run(params_acc, CFG_ACC, ec, prompts,
+                        make_con=lambda: _con(ALL_LEGAL_13, TABLE13),
+                        n_tokens=24, brownout=2)
+    assert s_cool["spec_proposed"] == 0
+    assert cool[0]["tokens"] == hot[0]["tokens"]  # mask + identity intact
+    assert cool[0]["constrain"]["outcome"] == "valid"
+
+
+def test_brownout_never_degrades_pin():
+    assert "grammar_mask" in overload.BROWNOUT_NEVER_DEGRADES
+
+
+# --------------------------------------------------- engine: chaos + faults
+
+
+def test_forced_stall_fails_only_victim_with_incident(params):
+    """constrain chaos stall_on: the victim fails with ConstraintStall,
+    the unconstrained neighbor is untouched, and the incident plane
+    classifies the event as constraint_stall."""
+    import time as _t
+    ec = EngineConfig(max_slots=2, num_pages=64, page_size=8,
+                      max_pages_per_slot=16,
+                      constrain_chaos=ConstrainFaultConfig(stall_on=1),
+                      incidents=True, incident_debounce_s=0.2,
+                      incident_resolve_s=0.5, incident_poll_s=0.05)
+    eng = Engine(params, CFG, ec)
+    eng.start()
+    try:
+        victim = eng.generate_async([5, 6, 7], 8, constrain=_con(AB_C))
+        bystander = eng.generate_async([8, 9, 10], 8)
+        with pytest.raises(ConstraintStall, match="zero legal tokens"):
+            victim.result(timeout=60)
+        assert len(bystander.result(timeout=60)["tokens"]) == 8
+        stats = eng.stats
+        assert stats["constraint_stalls"] == 1
+        assert stats["constrain_chaos"]["injected_stalls"] == 1
+        t0 = _t.monotonic()
+        while _t.monotonic() - t0 < 30:
+            if any(i["cause"] == "constraint_stall"
+                   for i in eng.incident_list()):
+                break
+            _t.sleep(0.05)
+        assert any(i["cause"] == "constraint_stall"
+                   for i in eng.incident_list())
+    finally:
+        eng.stop()
+
+
+def test_seeded_stall_chaos_zero_invalid_outputs(params):
+    """stall_every across a batch of constrained requests: every failure
+    is a counted ConstraintStall and every SURVIVING output is fully
+    grammar-valid — the chaos arm's 0-invalid-outputs gate."""
+    ec = EngineConfig(max_slots=4, num_pages=128, page_size=8,
+                      max_pages_per_slot=16,
+                      constrain_chaos=ConstrainFaultConfig(seed=11,
+                                                           stall_every=9))
+    out, stats = _run(params, CFG, ec, PROMPTS + [[4, 4, 8] * 3],
+                      make_con=lambda: _con(AB_C), n_tokens=8)
+    failed = [r for r in out if isinstance(r, Exception)]
+    lived = [r for r in out if not isinstance(r, Exception)]
+    assert failed and all(isinstance(e, ConstraintStall) for e in failed)
+    assert stats["constraint_stalls"] == len(failed)
+    assert stats["constrain_chaos"]["injected_stalls"] >= len(failed)
+    g = compile_grammar(AB_C)
+    for r in lived:
+        _, ok = _walk(g, _text(r["tokens"]).encode("latin-1"))
+        assert ok, "chaos arm emitted a grammar-invalid token"
+
+
+def test_vocab_mismatch_rejected_at_admission(params):
+    eng = Engine(params, CFG, EngineConfig(max_slots=2, num_pages=64,
+                                           page_size=8,
+                                           max_pages_per_slot=16))
+    eng.start()
+    try:
+        with pytest.raises(RequestError, match="vocab"):
+            eng.generate_async([1, 2], 4, constrain=_con(AB_C, TABLE256))
+    finally:
+        eng.stop()
+
+
+def test_taxonomy_rows_for_constraint_stall():
+    assert EXPECTED_INCIDENT_CAUSES["constrain:stall"] == "constraint_stall"
+    assert "constraint_stall" in CAUSES
+    assert CAUSE_PLAYBOOK["constraint_stall"] == "observe"
+
+
+def test_waterfall_carves_grammar_advance(params):
+    """The latency-attribution satellite: a constrained request's
+    waterfall carries a grammar_advance segment carved out of decode,
+    and the partition invariant (sum == wall) holds with it present."""
+    eng = Engine(params, CFG, EngineConfig(max_slots=2, num_pages=64,
+                                           page_size=8,
+                                           max_pages_per_slot=16))
+    eng.start()
+    try:
+        r = eng.generate_async([5, 6, 7], 8,
+                               constrain=_con(ALL_LEGAL_101)).result(
+                                   timeout=120)
+        wf = eng.waterfall(r["rid"])
+        assert wf is not None
+        assert "grammar_advance" in {s["name"] for s in wf["segments"]}
+        total = sum(s["dur_s"] for s in wf["segments"])
+        assert total == pytest.approx(wf["wall_s"], abs=1e-6)
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------- serve + ingress
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    d = tmp_path_factory.mktemp("llm")
+    (d / "config.json").write_text(json.dumps(
+        {"vocab_size": 256, "d_model": 32, "n_layers": 1, "n_heads": 2,
+         "n_kv_heads": 1, "d_ff": 64}))
+    (d / "engine.json").write_text(json.dumps(
+        {"max_slots": 2, "num_pages": 64, "page_size": 8}))
+    m = JetStreamModel("llm", str(d))
+    m.load()
+    yield m
+    m.engine.stop()
+
+
+def test_serve_schema_yields_structured_json_field(served):
+    out = served.generate({"text_input": "q", "parameters": {
+        "max_tokens": 16, "constrain": {"schema": {"const": "ok"}}}})
+    assert out["text_output"] == '"ok"'
+    assert out["json"] == "ok"
+    rec = out["constrain"]
+    assert rec["kind"] == "schema" and rec["outcome"] == "valid"
+
+
+def test_serve_tool_call_field(served):
+    out = served.generate({"text_input": "q", "parameters": {
+        "max_tokens": 24, "constrain": {"tool": {
+            "name": "lookup",
+            "parameters": {"const": {"q": "hi"}}}}}})
+    assert out["tool_call"] == {"name": "lookup", "arguments": {"q": "hi"}}
+    assert out["constrain"]["tool"] == "lookup"
+    assert out["constrain"]["outcome"] == "valid"
+
+
+def test_serve_grammar_kind_has_no_parse_field(served):
+    out = served.generate({"text_input": "q", "parameters": {
+        "max_tokens": 8, "constrain": {"grammar": 'start ::= "abc" ;'}}})
+    assert out["text_output"] == "abc"
+    assert "json" not in out and "tool_call" not in out
+    assert out["constrain"]["outcome"] == "valid"
+
+
+def test_serve_admission_rejections(served):
+    with pytest.raises(RequestError, match="exactly one of"):
+        served.generate({"text_input": "q", "parameters": {
+            "constrain": {"schema": {"const": 1}, "format": "json"}}})
+    with pytest.raises(RequestError, match="unexpected character"):
+        served.generate({"text_input": "q", "parameters": {
+            "constrain": {"grammar": "start: 'a'"}}})
+    with pytest.raises(RequestError, match="mutually exclusive"):
+        served.generate({"text_input": "q", "parameters": {
+            "constrain": {"format": "json"},
+            "resume_token_ids": [1, 2, 3]}})
+
+
+def test_serve_stream_emits_structured_event(served):
+    pieces = list(served.generate_stream({"text_input": "q", "parameters": {
+        "max_tokens": 16, "constrain": {"schema": {"const": "ok"}}}}))
+    final = pieces[-1]
+    assert final["constrain"]["outcome"] == "valid"
+    ev = [p for p in pieces if p.get("event") == "json"]
+    assert len(ev) == 1 and ev[0]["json"] == "ok"
+    assert ev[0]["text_output"] == ""
+    assert "".join(p.get("text_output", "") for p in pieces[:-1]) == '"ok"'
+
+
+def test_serve_predict_per_instance_constraints(served):
+    out = served.predict({"instances": [
+        {"prompt": "q", "max_tokens": 16,
+         "constrain": {"schema": {"const": "ok"}}},
+        {"prompt": "r", "max_tokens": 4}]})
+    assert out[0]["json"] == "ok"
+    assert out[0]["constrain"]["outcome"] == "valid"
+    assert "constrain" not in out[1]
+
+
+def test_metrics_exposition(served):
+    served.generate({"text_input": "q", "parameters": {
+        "max_tokens": 16, "constrain": {"schema": {"const": "ok"}}}})
+    text = served.engine.telemetry.render()
+    assert 'engine_constrained_requests_total{outcome="valid"}' in text
+    assert "engine_grammar_mask_seconds" in text
+
+
+# ------------------------------------------------------ the OpenAI surface
+
+
+def test_openai_constrain_spec_mapping():
+    assert openai_constrain_spec({}) is None
+    assert openai_constrain_spec(
+        {"response_format": {"type": "text"}}) is None
+    assert openai_constrain_spec(
+        {"response_format": {"type": "json_object"}}) == {"format": "json"}
+    schema = {"type": "object", "properties": {"a": {"type": "integer"}},
+              "required": ["a"]}
+    assert openai_constrain_spec(
+        {"response_format": {"type": "json_schema",
+                             "json_schema": {"schema": schema}}}
+    ) == {"schema": schema}
+    tools = [{"type": "function",
+              "function": {"name": "f", "parameters": schema}}]
+    want = {"tool": {"name": "f", "parameters": schema}}
+    assert openai_constrain_spec(
+        {"tools": tools, "tool_choice": "required"}) == want
+    assert openai_constrain_spec(
+        {"tools": tools,
+         "tool_choice": {"type": "function",
+                         "function": {"name": "f"}}}) == want
+    assert openai_constrain_spec(
+        {"tools": tools, "tool_choice": "auto"}) is None
+    assert openai_constrain_spec(
+        {"tools": tools, "tool_choice": "none"}) is None
+    for bad in ({"response_format": {"type": "xml"}},
+                {"response_format": "json"},
+                {"tools": tools, "tool_choice": "maybe"},
+                {"tools": tools,
+                 "tool_choice": {"type": "function",
+                                 "function": {"name": "ghost"}}},
+                {"tools": tools + tools, "tool_choice": "required"}):
+        with pytest.raises(ValueError):
+            openai_constrain_spec(bad)

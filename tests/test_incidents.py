@@ -281,6 +281,11 @@ _SHAPES = {
     "storm:overload": [{"kind": "shed", "reason": "concurrency",
                         "shed": 5, "stage": 1},
                        {"kind": "brownout", "stage": 2, "from_stage": 1}],
+    # constrain chaos: a forced-empty mask row stalls the automaton —
+    # classified over any shed storm it drags behind it (a code bug,
+    # never load; README "Structured output")
+    "constrain:stall": [{"kind": "constraint_stall",
+                         "error": "zero legal tokens under grammar mask"}],
 }
 
 
