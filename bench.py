@@ -800,6 +800,35 @@ def main() -> None:
     except Exception as e:  # sidebar only — never sink the bench line
         out["waterfall"] = {"error": str(e)[:200]}
     try:
+        # ingress data-plane sidebar: serving_bench --ingress's headline
+        # (BENCH_INGRESS.json) — saturated relay capacity of the event-
+        # loop core vs the legacy thread-per-connection core at equal
+        # goodput, the sequential all-warm per-request proxy overhead vs
+        # the committed old-core pin, and SSE passthrough byte-identity
+        ig_path = os.path.join(REPO, "BENCH_INGRESS.json")
+        if os.path.exists(ig_path):
+            with open(ig_path) as f:
+                ig = json.loads(f.readline())
+            cap = ig.get("capacity") or {}
+            ov = ig.get("overhead") or {}
+            out["ingress"] = {
+                "ingress_pass": ig.get("pass"),
+                "capacity_speedup_x": cap.get("speedup_x"),
+                "evloop_rps": (cap.get("evloop") or {}).get("rps"),
+                "legacy_rps": (cap.get("legacy") or {}).get("rps"),
+                "goodput_equal": cap.get("goodput_equal"),
+                "proxy_overhead_p50_us": ov.get("proxy_overhead_p50_us"),
+                "overhead_improvement_x": ov.get("improvement_x"),
+                "same_box_legacy_p50_us":
+                    ov.get("same_box_legacy_p50_us"),
+                "sse_byte_identical":
+                    (ig.get("sse_passthrough") or {}).get(
+                        "byte_identical"),
+                "platform": ig.get("platform"),
+            }
+    except Exception as e:  # sidebar only — never sink the bench line
+        out["ingress"] = {"error": str(e)[:200]}
+    try:
         # structured-output sidebar: serving_bench --constrain's headline
         # (BENCH_CONSTRAIN.json) — the mask's share of tick wall vs its
         # budget (the one extra masked-logits op is the whole device

@@ -323,6 +323,21 @@ JOBS = [
                                   os.path.join(REPO,
                                                "BENCH_WATERFALL.json")]),
      "timeout": 1500, "first_timeout": 900},
+    # ingress data-plane capacity on a real chip (README "Ingress data
+    # plane"): part 1's scripted-backend capacity race is CPU-bound
+    # either way, but part 2's per-request proxy overhead rides real
+    # engine replays, so the pooled-transport + passthrough savings are
+    # measured against chip-speed decode instead of the CPU simulation;
+    # refreshes BENCH_INGRESS.json with the platform=tpu record
+    {"name": "serving_ingress_tiny",
+     "cmd": _serving_cmd("tiny", ["--ingress", "--requests", "12",
+                                  "--concurrency", "4",
+                                  "--prompt-len", "32",
+                                  "--max-tokens", "8",
+                                  "--out",
+                                  os.path.join(REPO,
+                                               "BENCH_INGRESS.json")]),
+     "timeout": 1500, "first_timeout": 900},
     # structured-output mask overhead on a real chip (README "Structured
     # output"): the host automaton advance overlaps real device steps,
     # so the engine_grammar_mask_seconds share of tick wall measures the

@@ -240,6 +240,16 @@ class APIServer:
         self._rv += 1
         return str(self._rv)
 
+    def store_version(self) -> int:
+        """Monotonic write counter: bumps on every create/update/status
+        write/delete.  Read-heavy callers (the ingress relay path reads
+        the Service and ready-pod list per request) key snapshot caches
+        on this — an unchanged version guarantees list()/get() would
+        return byte-identical objects, so the per-call deepcopy can be
+        skipped entirely."""
+        with self._lock:
+            return self._rv
+
     def _key(self, crd: CRD, meta: dict) -> tuple:
         ns = meta.get("namespace", "default") if crd.namespaced else ""
         return (ns, meta["name"])
@@ -374,6 +384,10 @@ class APIServer:
                 raise NotFound(f"{kind} {key[0]}/{key[1]} not found")
             uid = obj["metadata"]["uid"]
             del self._objects[kind][key]
+            # deletions must advance the store version too, or
+            # store_version()-keyed snapshot caches would keep serving
+            # the deleted object
+            self._next_rv()
             self._notify(WatchEvent(WatchEvent.DELETED, copy.deepcopy(obj)), kind)
             # ownerReference cascade (synchronous "background" GC)
             self._cascade_delete(uid)

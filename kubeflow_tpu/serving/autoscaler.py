@@ -33,6 +33,7 @@ import urllib.request
 from typing import Optional
 
 from ..core.api import APIServer, Obj
+from . import transport
 from .api import (
     GROUP,
     MAX_REPLICAS_ANNOTATION,
@@ -103,8 +104,10 @@ _SLO_SAMPLE_RE = re.compile(
 
 def scrape_metrics(port: int, timeout: float = DEFAULT_SCRAPE_TIMEOUT_S) -> Optional[dict]:
     try:
-        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=timeout) as r:
-            text = r.read().decode()
+        # pooled keepalive scrape (README "Ingress data plane"): the
+        # load/health scrape loops reuse one persistent socket per
+        # replica instead of a TCP dial per poll
+        text = transport.get(port, "/metrics", timeout=timeout).decode()
     except Exception:  # noqa: BLE001
         return None
     out = {}

@@ -941,8 +941,12 @@ class FleetChaos:
     """Runtime half of FleetFaultConfig: owns per-backend token counters,
     fires one-shot kill/hang callbacks at exact token counts, and decides
     which relayed streams get their connection cut.  Thread-safe (relay
-    handler threads feed it concurrently); callbacks run on their own
-    thread so a blocking ``Engine.stop`` never stalls a live relay."""
+    workers feed it concurrently); callbacks run SYNCHRONOUSLY in the
+    relay that crossed the threshold — "kill after N tokens" is a causal
+    ordering contract (token N+1 must not be relayed before the fault
+    lands), and a detached thread loses that race on a fast data plane.
+    Callbacks must therefore be bounded (``Engine.stop(drain=False)``,
+    ``arm_slow``), which every harness callback is."""
 
     def __init__(self, config: FleetFaultConfig):
         self.config = config
@@ -1005,7 +1009,7 @@ class FleetChaos:
                 self._cut_done.add(stream_key)
                 self.streams_cut += 1
         if cb is not None:
-            threading.Thread(target=cb, daemon=True).start()
+            cb()
         return "cut" if cut else None
 
     def stats(self) -> dict:

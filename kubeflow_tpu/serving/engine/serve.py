@@ -24,7 +24,7 @@ import urllib.request
 from typing import Any, Optional
 
 from ...core import tracing
-from .. import kvfabric
+from .. import kvfabric, transport
 from ..constrain import ConstrainRegistry, GrammarError
 from ..server import Model
 from ..errors import EngineError, RequestError
@@ -993,11 +993,11 @@ class JetStreamModel(Model):
             return None
         chaos = getattr(self.engine, "_handoff_chaos", None)
         try:
-            with urllib.request.urlopen(
-                    f"http://127.0.0.1:{int(port)}/engine/kv_handoff/"
-                    f"{handle}",
-                    timeout=self._HANDOFF_PULL_TIMEOUT_S) as r:
-                data = r.read()
+            # pooled keepalive pull (README "Ingress data plane"): KVPG
+            # binary frames ride the same persistent sockets as relays
+            data = transport.get(
+                int(port), f"/engine/kv_handoff/{handle}",
+                timeout=self._HANDOFF_PULL_TIMEOUT_S)
             if chaos is not None:
                 data = chaos.on_pull(data)  # may truncate, sleep or raise
             blob, header = unpack_frame(data)
@@ -1084,11 +1084,11 @@ class JetStreamModel(Model):
         chaos = getattr(self.engine, "_fabric_chaos", None)
         t0 = time.perf_counter()
         try:
-            with urllib.request.urlopen(
-                    f"http://127.0.0.1:{int(fab['source_port'])}"
-                    f"/engine/kv_fabric/{fab['key']}",
-                    timeout=self._FABRIC_PULL_TIMEOUT_S) as r:
-                data = r.read()
+            # pooled keepalive pull: fabric prefix frames reuse the same
+            # per-owner persistent socket across admissions
+            data = transport.get(
+                int(fab["source_port"]), f"/engine/kv_fabric/{fab['key']}",
+                timeout=self._FABRIC_PULL_TIMEOUT_S)
             if chaos is not None:
                 data = chaos.on_pull(data)  # may truncate/flip/sleep/raise
             blob, header = unpack_frame(data)

@@ -47,7 +47,8 @@ from typing import Optional
 from ..core import tracing
 from ..core.api import APIServer, Obj
 from ..core.metrics import REGISTRY, merge_expositions
-from . import disagg, kvfabric
+from . import disagg, ingress_core, kvfabric
+from . import transport
 from . import incidents as incidents_mod
 from . import overload as overload_mod
 from . import waterfall as waterfall_mod
@@ -277,6 +278,47 @@ class _ProxyState:
         self.lock = threading.Lock()
 
 
+class _ApiSnapshotCache:
+    """Store-version-gated read cache for the relay hot path.
+
+    ``api.list``/``api.get`` deepcopy every object on every call; the
+    relay used to pay that per request for a pod list that changes
+    maybe once a minute.  Entries are valid only for the exact
+    ``APIServer.store_version()`` they were built at — any write to
+    the store (create/update/status/delete) bumps the version and
+    drops the whole cache, so a hit is indistinguishable from an
+    uncached read.
+
+    Contract: returned objects are SHARED across requests — callers
+    treat them as read-only (the relay only ever reads them; the
+    per-call deepcopies were pure waste).
+    """
+
+    _MISS = object()
+
+    def __init__(self, api: APIServer):
+        self._api = api
+        self._lock = threading.Lock()
+        self._version = -1
+        self._entries: dict = {}
+
+    def cached(self, key, build):
+        v = self._api.store_version()
+        with self._lock:
+            if v != self._version:
+                self._entries.clear()
+                self._version = v
+            else:
+                hit = self._entries.get(key, self._MISS)
+                if hit is not self._MISS:
+                    return hit
+        value = build()  # outside the lock: builds may take the API lock
+        with self._lock:
+            if self._version == v:
+                self._entries[key] = value
+        return value
+
+
 class ServiceProxy:
     """Manages one HTTP listener per serving Service. Run .sync() as a ticker."""
 
@@ -306,6 +348,14 @@ class ServiceProxy:
         # distinct spec, so the admission check is a dict hit for every
         # request after a tenant's first
         self._constrain_reg = None
+        # hot-path read cache (README "Ingress data plane"): the relay
+        # reads the Service object and the ready-pod list per request,
+        # and api.get/list deepcopy every object per call — at wire
+        # speed that deepcopy storm was the single largest CPU item on
+        # the relay path.  Snapshots are keyed by api.store_version(),
+        # so any store write invalidates everything and callers see
+        # exactly what an uncached read would return.
+        self._snap = _ApiSnapshotCache(api)
 
     def attach_remediator(self, remediator) -> None:
         """Wire the remediation controller (remediator.FleetRemediator):
@@ -359,130 +409,213 @@ class ServiceProxy:
             # incidents remediated (attach is idempotent per manager)
             self.remediator.attach(state.incidents)
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
+        if transport.legacy_core():
+            # Seed data plane (bench comparison arm): thread-per-connection
+            # server, fresh backend dial per attempt (the transport module
+            # disables pooling in this mode).
+            class Handler(BaseHTTPRequestHandler):
+                protocol_version = "HTTP/1.1"
 
-            def log_message(self, *a):
-                pass
+                def log_message(self, *a):
+                    pass
 
-            def _forward(self):
-                # the body is always drained, even for the proxy-native
-                # GETs below: unread Content-Length bytes would be parsed
-                # as the NEXT request line on this keep-alive connection
-                n = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(n) if n else None
-                path = self.path.split("?")[0].rstrip("/")
-                if self.command == "GET":
-                    # proxy-native debug/aggregation surface (ISSUE 8):
-                    # these answer FROM the proxy (fanning out underneath)
-                    # instead of relaying to one backend
-                    if path.startswith("/debug/trace/"):
-                        proxy._serve_trace(self, state,
-                                           path[len("/debug/trace/"):])
-                        return
-                    if path.startswith("/fleet/trace/"):
-                        # /fleet/trace/<id> is /debug/trace/<id> under its
-                        # fleet-surface name; the /waterfall suffix asks
-                        # for the assembled latency attribution instead
-                        # of the raw span tree
-                        rest = path[len("/fleet/trace/"):]
-                        if rest.endswith("/waterfall"):
-                            proxy._serve_fleet_waterfall(
-                                self, state, rest[:-len("/waterfall")])
-                        else:
-                            proxy._serve_trace(self, state, rest)
-                        return
-                    if path == "/fleet/latency":
-                        proxy._serve_fleet_latency(self, state)
-                        return
-                    if path == "/fleet/metrics":
-                        proxy._serve_fleet_metrics(self, state)
-                        return
-                    if path == "/fleet/cache":
-                        proxy._serve_fleet_cache(self, state)
-                        return
-                    if path == "/fleet/incidents":
-                        proxy._serve_fleet_incidents(self, state)
-                        return
-                    if path.startswith("/fleet/incidents/"):
-                        proxy._serve_fleet_incident(
-                            self, state,
-                            path[len("/fleet/incidents/"):])
-                        return
-                    if path == "/fleet/remediation":
-                        proxy._serve_fleet_remediation(self, state)
-                        return
-                proxy._relay(self, state, body)
+                def _forward(self):
+                    proxy._handle_request(self, state)
 
-            def _stream(self, r, ctype: str) -> bool:
-                # non-resumable SSE passthrough (OpenAI surface, transformer
-                # chains): relay chunks as they arrive — buffering r.read()
-                # would hold every token until the generation finished.
-                # Once any response byte is on the wire nothing may bubble
-                # out of here: _forward's caller would write a SECOND HTTP
-                # response into the body (same invariant as the model
-                # server's _sse_write), so even the header writes live
-                # inside the try (a client can hang up before them too).
-                # Returns False when the BACKEND failed mid-stream (the
-                # caller charges the failure detector a strike).
-                backend_ok = True
-                try:
-                    self.send_response(r.status)
-                    self.send_header("Content-Type", ctype)
-                    self.send_header("Cache-Control", "no-cache")
-                    self.send_header("Transfer-Encoding", "chunked")
-                    if getattr(self, "_trace_id", None):
-                        # the client's handle into GET /debug/trace/<id>
-                        self.send_header("X-Trace-Id", self._trace_id)
-                    self.end_headers()
-                except Exception:  # noqa: BLE001 — client gone pre-headers
-                    self.close_connection = True
-                    return backend_ok
-                try:
-                    while True:
-                        try:
-                            chunk = r.read1(65536)  # whatever backend flushed
-                        except Exception as e:  # noqa: BLE001 — incl. stalls
-                            # backend died mid-stream but the CLIENT side is
-                            # intact: a silent truncation would look like a
-                            # clean close, so emit a terminal structured
-                            # error event before finishing the framing
-                            backend_ok = False
-                            err = json.dumps({"error": f"backend: {e}",
-                                              "done": True}).encode()
-                            self._chunk(b"data: " + err + b"\n\n")
-                            break
-                        if not chunk:
-                            break
-                        self._chunk(chunk)
-                    self.wfile.write(b"0\r\n\r\n")
+                def _chunk(self, data: bytes) -> None:
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
                     self.wfile.flush()
-                except Exception:  # noqa: BLE001 — client hung up mid-stream
-                    self.close_connection = True
-                return backend_ok
 
-            def _chunk(self, data: bytes) -> None:
-                self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
-                self.wfile.flush()
+                def _reply(self, code: int, data: bytes,
+                           ctype: Optional[str] = "application/json",
+                           extra: Optional[dict] = None):
+                    self.send_response(code)
+                    self.send_header("Content-Type",
+                                     ctype or "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    for k, v in (extra or {}).items():
+                        self.send_header(k, str(v))
+                    self.end_headers()
+                    self.wfile.write(data)
 
-            def _reply(self, code: int, data: bytes,
-                       ctype: Optional[str] = "application/json",
-                       extra: Optional[dict] = None):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype or "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                for k, v in (extra or {}).items():
-                    self.send_header(k, str(v))
-                self.end_headers()
-                self.wfile.write(data)
+                do_GET = do_POST = do_PUT = do_DELETE = _forward
 
-            do_GET = do_POST = do_PUT = do_DELETE = _forward
-
-        server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        server.daemon_threads = True
-        threading.Thread(target=server.serve_forever, kwargs={"poll_interval": 0.05}, daemon=True).start()
+            server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+            server.daemon_threads = True
+            threading.Thread(target=server.serve_forever,
+                             kwargs={"poll_interval": 0.05},
+                             daemon=True).start()
+        else:
+            # Event-loop data plane (README "Ingress data plane"): one
+            # selectors readiness loop owns accept/framing/keep-alive; a
+            # fixed worker set runs the admission pipeline + relay state
+            # machine per framed request.
+            server = ingress_core.IngressServer(
+                ("127.0.0.1", port),
+                lambda conn: proxy._handle_request(conn, state))
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
         self._servers[key] = server
         self._states[key] = state
+
+    def _handle_request(self, handler, state: "_ProxyState") -> None:
+        """Route one framed request: proxy-native GET surfaces answer from
+        the proxy itself; everything else relays.  ``handler`` is either
+        the legacy BaseHTTPRequestHandler or an ingress_core.Conn — both
+        expose the same command/path/headers/rfile/_reply surface."""
+        # the body is always drained, even for the proxy-native
+        # GETs below: unread Content-Length bytes would be parsed
+        # as the NEXT request line on this keep-alive connection
+        n = int(handler.headers.get("Content-Length") or 0)
+        body = handler.rfile.read(n) if n else None
+        path = handler.path.split("?")[0].rstrip("/")
+        if handler.command == "GET":
+            # proxy-native debug/aggregation surface (ISSUE 8):
+            # these answer FROM the proxy (fanning out underneath)
+            # instead of relaying to one backend
+            if path.startswith("/debug/trace/"):
+                self._serve_trace(handler, state,
+                                  path[len("/debug/trace/"):])
+                return
+            if path.startswith("/fleet/trace/"):
+                # /fleet/trace/<id> is /debug/trace/<id> under its
+                # fleet-surface name; the /waterfall suffix asks
+                # for the assembled latency attribution instead
+                # of the raw span tree
+                rest = path[len("/fleet/trace/"):]
+                if rest.endswith("/waterfall"):
+                    self._serve_fleet_waterfall(
+                        handler, state, rest[:-len("/waterfall")])
+                else:
+                    self._serve_trace(handler, state, rest)
+                return
+            if path == "/fleet/latency":
+                self._serve_fleet_latency(handler, state)
+                return
+            if path == "/fleet/metrics":
+                self._serve_fleet_metrics(handler, state)
+                return
+            if path == "/fleet/cache":
+                self._serve_fleet_cache(handler, state)
+                return
+            if path == "/fleet/incidents":
+                self._serve_fleet_incidents(handler, state)
+                return
+            if path.startswith("/fleet/incidents/"):
+                self._serve_fleet_incident(
+                    handler, state,
+                    path[len("/fleet/incidents/"):])
+                return
+            if path == "/fleet/remediation":
+                self._serve_fleet_remediation(handler, state)
+                return
+        self._relay(handler, state, body)
+
+    def _relay_stream(self, handler, r, ctype: str) -> bool:
+        """Relay a non-resumable SSE response to the client; True unless
+        the BACKEND failed mid-stream (the caller charges the strike).
+
+        Two arms, identical payload bytes on the wire:
+
+        - zero-copy passthrough (event-loop core): the backend's SSE is
+          close-delimited raw bytes (the model server's ``_sse_write``
+          sends ``Connection: close`` and no framing), so when nothing
+          needs rewriting the proxy answers with the same close-delimited
+          framing and forwards ``read1`` buffers untouched — no decode,
+          no re-chunking, no per-event work.
+        - chunked reframe (legacy core): the seed path — the same bytes
+          re-framed as chunked transfer coding.
+        """
+        if isinstance(handler, ingress_core.Conn):
+            return self._stream_passthrough(handler, r, ctype)
+        return self._stream_reframe(handler, r, ctype)
+
+    @staticmethod
+    def _stream_passthrough(handler, r, ctype: str) -> bool:
+        # Once any response byte is on the wire nothing may bubble out of
+        # here (a second HTTP response would land in the body); a backend
+        # death mid-splice emits the same terminal structured error event
+        # as the reframing arm, then closes — and the connection always
+        # closes afterward because close-delimited framing has no
+        # end-of-body marker.
+        backend_ok = True
+        try:
+            handler.send_response(r.status)
+            handler.send_header("Content-Type", ctype)
+            handler.send_header("Cache-Control", "no-cache")
+            handler.send_header("Connection", "close")
+            if getattr(handler, "_trace_id", None):
+                handler.send_header("X-Trace-Id", handler._trace_id)
+            handler.end_headers()
+        except Exception:  # noqa: BLE001 — client gone pre-headers
+            handler.close_connection = True
+            return backend_ok
+        try:
+            while True:
+                try:
+                    chunk = r.read1(65536)  # whatever the backend flushed
+                except Exception as e:  # noqa: BLE001 — incl. stalls
+                    backend_ok = False
+                    err = json.dumps({"error": f"backend: {e}",
+                                      "done": True}).encode()
+                    handler.wfile.write(b"data: " + err + b"\n\n")
+                    break
+                if not chunk:
+                    break
+                handler.wfile.write(chunk)
+            handler.wfile.flush()
+        except Exception:  # noqa: BLE001 — client hung up mid-stream
+            pass
+        handler.close_connection = True
+        return backend_ok
+
+    @staticmethod
+    def _stream_reframe(handler, r, ctype: str) -> bool:
+        # non-resumable SSE relay (OpenAI surface, transformer
+        # chains): relay chunks as they arrive — buffering r.read()
+        # would hold every token until the generation finished.
+        # Once any response byte is on the wire nothing may bubble
+        # out of here: the relay's caller would write a SECOND HTTP
+        # response into the body (same invariant as the model
+        # server's _sse_write), so even the header writes live
+        # inside the try (a client can hang up before them too).
+        # Returns False when the BACKEND failed mid-stream (the
+        # caller charges the failure detector a strike).
+        backend_ok = True
+        try:
+            handler.send_response(r.status)
+            handler.send_header("Content-Type", ctype)
+            handler.send_header("Cache-Control", "no-cache")
+            handler.send_header("Transfer-Encoding", "chunked")
+            if getattr(handler, "_trace_id", None):
+                # the client's handle into GET /debug/trace/<id>
+                handler.send_header("X-Trace-Id", handler._trace_id)
+            handler.end_headers()
+        except Exception:  # noqa: BLE001 — client gone pre-headers
+            handler.close_connection = True
+            return backend_ok
+        try:
+            while True:
+                try:
+                    chunk = r.read1(65536)  # whatever backend flushed
+                except Exception as e:  # noqa: BLE001 — incl. stalls
+                    # backend died mid-stream but the CLIENT side is
+                    # intact: a silent truncation would look like a
+                    # clean close, so emit a terminal structured
+                    # error event before finishing the framing
+                    backend_ok = False
+                    err = json.dumps({"error": f"backend: {e}",
+                                      "done": True}).encode()
+                    handler._chunk(b"data: " + err + b"\n\n")
+                    break
+                if not chunk:
+                    break
+                handler._chunk(chunk)
+            handler.wfile.write(b"0\r\n\r\n")
+            handler.wfile.flush()
+        except Exception:  # noqa: BLE001 — client hung up mid-stream
+            handler.close_connection = True
+        return backend_ok
 
     def _stop(self, key: tuple[str, str]) -> None:
         server = self._servers.pop(key)
@@ -505,8 +638,11 @@ class ServiceProxy:
     _BACKOFF_MAX_S = 2.0
 
     def _get_service(self, state: _ProxyState) -> Optional[Obj]:
-        return self.api.try_get("Service", state.service_name,
-                                state.namespace)
+        # snapshot-cached (read-only contract, see _ApiSnapshotCache)
+        return self._snap.cached(
+            ("Service", state.namespace, state.service_name),
+            lambda: self.api.try_get("Service", state.service_name,
+                                     state.namespace))
 
     def _relay(self, handler, state: _ProxyState, body: Optional[bytes]) -> None:
         """One client request end to end: pick → attempt → (on failure)
@@ -662,7 +798,8 @@ class ServiceProxy:
 
             def note_hop(hop, backend, kind, hop_t0, outcome,
                          error: Optional[str] = None,
-                         backend_state: Optional[str] = None) -> None:
+                         backend_state: Optional[str] = None,
+                         timing: Optional[dict] = None) -> None:
                 span = {"trace_id": root.trace_id, "span_id": hop.span_id,
                         "parent_id": hop.parent_id, "component": "ingress",
                         "name": "relay_attempt", "attempt": attempt,
@@ -670,6 +807,18 @@ class ServiceProxy:
                         "backend_state": backend_state, "outcome": outcome,
                         "t_start_s": round(hop_t0 - t0, 6),
                         "duration_s": round(time.perf_counter() - hop_t0, 6)}
+                if timing is not None:
+                    # pooled-transport sub-segments (README "Ingress data
+                    # plane"): the waterfall assembler carves pool_wait/
+                    # connect/first_byte out of this hop's lead-in
+                    span["transport"] = {
+                        "outcome": timing.get("outcome"),
+                        "pool_wait_s": round(
+                            float(timing.get("pool_wait_s") or 0.0), 9),
+                        "connect_s": round(
+                            float(timing.get("connect_s") or 0.0), 9),
+                        "first_byte_s": round(
+                            float(timing.get("first_byte_s") or 0.0), 9)}
                 if error is not None:
                     span["error"] = error
                 if prev_failed_hop is not None:
@@ -738,17 +887,14 @@ class ServiceProxy:
                             # assembled tree shows the continuation
                             # hanging off the attempt that died
                             hdrs["X-Resume-From"] = prev_failed_hop
-                req = urllib.request.Request(
-                    f"http://127.0.0.1:{backend}{handler.path}",
-                    data=data, method=handler.command, headers=hdrs)
                 # relay timeout = per-read backend silence (the stall
                 # detector), NOT total request time; it must exceed any
                 # client-side budget or the ingress would 502 slow-but-
                 # alive generations.  A hedge timeout, when configured,
                 # tightens only the first non-streamed attempt.
                 attempt_timeout = relay_timeout
-                # never hedge a request that will stream: urlopen's timeout
-                # persists as the per-read socket timeout for the WHOLE
+                # never hedge a request that will stream: the transport's
+                # timeout persists as the per-read socket timeout for the WHOLE
                 # relay, so a tight hedge cap would kill healthy slow
                 # streams mid-generation.  The path check covers EVERY
                 # generate_stream request (string-body ones have no resume
@@ -766,8 +912,15 @@ class ServiceProxy:
                 reason = None
                 retry_hint: Optional[float] = None
                 try:
-                    with urllib.request.urlopen(
-                            req, timeout=attempt_timeout) as r:
+                    # pooled keepalive transport (README "Ingress data
+                    # plane"): no TCP dial per attempt — the pool hands
+                    # back a warm socket or dials fresh, and ≥400 raises
+                    # the same urllib HTTPError envelope the branches
+                    # below were built against
+                    with transport.request(
+                            handler.command, backend, handler.path,
+                            body=data, headers=hdrs,
+                            timeout=attempt_timeout) as r:
                         status = r.status
                         ctype = r.headers.get("Content-Type") or ""
                         if ctype.startswith("text/event-stream"):
@@ -788,11 +941,12 @@ class ServiceProxy:
                                     on_engine_wall=_set_eng_wall)
                                 ok = True
                             else:
-                                ok = handler._stream(r, ctype)
+                                ok = self._relay_stream(handler, r, ctype)
                             self._note_backend(state, backend, ok)
                             note_hop(hop, backend, kind, hop_t0,
                                      "ok" if ok else "stream_error",
-                                     backend_state=hop_state)
+                                     backend_state=hop_state,
+                                     timing=getattr(r, "timing", None))
                             return
                         payload = r.read()
                         try:
@@ -827,7 +981,8 @@ class ServiceProxy:
                                 f"response ({r.status}, {ctype or '?'})")
                             return
                         note_hop(hop, backend, kind, hop_t0, "ok",
-                                 backend_state=hop_state)
+                                 backend_state=hop_state,
+                                 timing=getattr(r, "timing", None))
                         # session surface headers pass through: a client
                         # behind the fleet reads X-Session-Restore/-Pinned
                         # exactly like one talking to a replica directly
@@ -1484,9 +1639,6 @@ class ServiceProxy:
         hdrs = dict(fwd_headers)
         hdrs[tracing.TRACEPARENT_HEADER] = hop.traceparent()
         hdrs["Content-Type"] = "application/json"
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{port}/v2/models/{plan['model']}/generate",
-            data=json.dumps(pbody).encode(), headers=hdrs)
 
         def hop_span(outcome: str, error: Optional[str] = None) -> None:
             span = {"trace_id": root.trace_id, "span_id": hop.span_id,
@@ -1500,7 +1652,11 @@ class ServiceProxy:
             self.traces.put(root.trace_id, span)
 
         try:
-            with urllib.request.urlopen(req, timeout=relay_timeout) as r:
+            with transport.request(
+                    "POST", port,
+                    f"/v2/models/{plan['model']}/generate",
+                    body=json.dumps(pbody).encode(), headers=hdrs,
+                    timeout=relay_timeout) as r:
                 rec = json.loads(r.read())
             ids = rec.get("token_ids")
             if (not isinstance(ids, list) or not ids
@@ -1590,10 +1746,10 @@ class ServiceProxy:
         def fetch(name: str, port: int) -> None:
             t0 = time.perf_counter()
             try:
-                with urllib.request.urlopen(
-                        f"http://127.0.0.1:{port}{path}",
-                        timeout=self._FANOUT_TIMEOUT_S) as r:
-                    body = r.read()
+                # pooled keepalive scrape: fleet fan-outs ride the same
+                # persistent sockets as relay attempts
+                body = transport.get(port, path,
+                                     timeout=self._FANOUT_TIMEOUT_S)
             except Exception:  # noqa: BLE001 — unreachable replica
                 body = None
             results[name] = (body, time.perf_counter() - t0)
@@ -2158,9 +2314,8 @@ class ServiceProxy:
         without the route (non-engine runtimes) count as ok — readiness
         probes already cover them."""
         try:
-            with urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/engine/health",
-                    timeout=self._PROBE_TIMEOUT_S) as r:
+            with transport.request("GET", port, "/engine/health",
+                                   timeout=self._PROBE_TIMEOUT_S) as r:
                 payload = json.loads(r.read())
         except urllib.error.HTTPError as e:
             if e.code == 404:
@@ -2609,6 +2764,14 @@ class ServiceProxy:
         sel = dict(selector)
         if revision is not None:
             sel[LABEL_REVISION] = revision
+        # snapshot-cached per (ns, selector, revision): readiness and the
+        # draining annotation live on the pod objects, so any transition
+        # is a store write and invalidates the cache
+        return self._snap.cached(
+            ("ready-pods", ns, tuple(sorted(sel.items())), revision),
+            lambda: self._list_ready_pods(ns, sel))
+
+    def _list_ready_pods(self, ns: str, sel: dict) -> list[Obj]:
         pods = [
             p
             for p in self.api.list("Pod", namespace=ns, label_selector=sel)

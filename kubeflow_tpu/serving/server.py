@@ -15,6 +15,7 @@ queue-proxy metrics.
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import uuid
 import time
@@ -180,6 +181,9 @@ class ModelServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # the ingress holds keepalive connections to this server;
+            # Nagle + delayed-ACK stalls ~40ms per response otherwise
+            disable_nagle_algorithm = True
 
             def log_message(self, *a):  # quiet
                 pass
@@ -209,8 +213,25 @@ class ModelServer:
             def do_POST(self):
                 server._handle_post(self)
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
-        self.httpd.daemon_threads = True
+        class Srv(ThreadingHTTPServer):
+            daemon_threads = True
+
+            # track accepted sockets so stop() can sever live keep-alive
+            # connections: with the ingress' pooled transport, a replica
+            # that merely closed its LISTENER would keep answering on
+            # already-pooled sockets — "stopped" must mean process-death
+            # semantics (every connection dies), or dead replicas stay
+            # reachable forever
+            def process_request(self, request, client_address):
+                self._live_conns.add(request)
+                super().process_request(request, client_address)
+
+            def close_request(self, request):
+                self._live_conns.discard(request)
+                super().close_request(request)
+
+        self.httpd = Srv((host, port), Handler)
+        self.httpd._live_conns = set()
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -229,6 +250,18 @@ class ModelServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        # sever live keep-alive connections (see Srv.process_request):
+        # handler threads blocked in readline wake with EOF and exit
+        for sock in list(self.httpd._live_conns):
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self.httpd._live_conns.clear()
 
     # ------------------------------------------------------------- handlers
 

@@ -47,7 +47,15 @@ SEGMENTS = {
                  "scoring, pick — between admission and the first hop "
                  "(and between hops after a successful phase hop)",
     "relay_connect": "ingress-side half of a relay hop the engine span "
-                     "does not cover: connect + request write",
+                     "does not cover: connect + request write (the part "
+                     "transport timing could not attribute further)",
+    "pool_wait": "waiting for a pooled backend connection checkout "
+                 "(transport-measured; carved off the relay lead-in)",
+    "connect": "fresh backend dial + request write when the pool had no "
+               "warm connection (transport-measured; zero on reuse)",
+    "first_byte": "request sent to first response byte on an opaque hop "
+                  "(no engine span) — backend queue+compute the ingress "
+                  "can only see as time-to-first-byte",
     "engine_queue": "submit to slot admission (includes preempt re-queue)",
     "session_restore": "tiered-store session KV restore before prefill",
     "fabric_pull": "fleet KV fabric prefix pull + verified scatter",
@@ -447,6 +455,23 @@ def build_fleet_waterfall(trace: dict) -> Optional[dict]:
     hops = [s for s in spans if s.get("component") == "ingress"
             and s.get("name") == "relay_attempt"]
 
+    def _carve_transport(h0, budget, hop, meta,
+                         names=("pool_wait", "connect")):
+        """Split the head of a relay lead-in using the hop's transport
+        timing (``pool_wait_s``/``connect_s``/``first_byte_s`` measured
+        by the pooled transport, serving/transport.py).  Returns
+        ``(intervals, consumed)``; legacy-core hops carry no timing and
+        consume nothing, keeping the whole lead in relay_connect."""
+        tr = hop.get("transport") or {}
+        out, cur = [], h0
+        for name in names:
+            dur = min(float(tr.get(name + "_s") or 0.0),
+                      budget - (cur - h0))
+            if dur > _EPS:
+                out.append((cur, cur + dur, name, dict(meta)))
+                cur += dur
+        return out, cur - h0
+
     intervals: list = []
     overlays: list = []
     cursor = 0.0
@@ -477,7 +502,16 @@ def build_fleet_waterfall(trace: dict) -> Optional[dict]:
         else:
             eng = engines.get(hop.get("span_id"))
             if eng is None:
-                intervals.append((h0, h1, "relay_backend", meta))
+                # opaque hop: transport timing is the only attribution
+                # available — pool_wait/connect/first_byte off the head,
+                # the remainder stays relay_backend
+                carved, used = _carve_transport(
+                    h0, h1 - h0, hop, meta,
+                    names=("pool_wait", "connect", "first_byte"))
+                intervals.extend(carved)
+                if h1 - (h0 + used) > _EPS:
+                    intervals.append((h0 + used, h1, "relay_backend",
+                                      meta))
             else:
                 ewall = _engine_wall(eng)
                 off, residual = estimate_offset(h0, h1 - h0, ewall)
@@ -490,8 +524,14 @@ def build_fleet_waterfall(trace: dict) -> Optional[dict]:
                 # serve-layer pulls happened inside the lead-in, right
                 # before submit: carve them off its tail
                 pull = min(lead, sum(pre_hints.values()))
-                if lead - pull > _EPS:
-                    intervals.append((h0, h0 + lead - pull,
+                # transport-measured checkout/dial time carves the head
+                # of the lead-in; what neither the transport nor the
+                # pre-submit hints explain stays relay_connect
+                carved, used = _carve_transport(h0, lead - pull, hop,
+                                                meta)
+                intervals.extend(carved)
+                if lead - pull - used > _EPS:
+                    intervals.append((h0 + used, h0 + lead - pull,
                                       "relay_connect", dict(meta)))
                 pc = h0 + lead - pull
                 for pname, pdur in pre_hints.items():

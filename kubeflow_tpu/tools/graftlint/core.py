@@ -28,6 +28,7 @@ _DISABLE_RE = re.compile(
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([\w]+)")
 _HOLDS_RE = re.compile(r"#\s*graftlint:\s*holds-lock=([\w]+)")
 _HOT_RE = re.compile(r"#\s*graftlint:\s*hot-path\b")
+_EVLOOP_RE = re.compile(r"#\s*graftlint:\s*event-loop\b")
 _ACQ_RE = re.compile(r"#\s*graftlint:\s*acquires=([\w\-]+)")
 _REL_RE = re.compile(r"#\s*graftlint:\s*releases=([\w\-]+)")
 

@@ -1,7 +1,8 @@
-"""Rule registry: the nine invariants distilled from the repo's own
+"""Rule registry: the ten invariants distilled from the repo's own
 review history (see each rule's ``history`` for the bug it encodes)."""
 
 from .atomic import AtomicWriteRule
+from .eventloop import EventLoopRule
 from .gather_ban import GatherBanRule
 from .growth import BoundedGrowthRule
 from .hotpath import HotPathRule
@@ -15,6 +16,7 @@ ALL_RULES = [
     ReleaseGuaranteeRule,
     ImportWeightRule,
     HotPathRule,
+    EventLoopRule,
     GatherBanRule,
     BoundedGrowthRule,
     AtomicWriteRule,

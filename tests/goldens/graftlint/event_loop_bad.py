@@ -1,0 +1,14 @@
+import json
+import time
+import urllib.request
+
+
+# graftlint: event-loop
+def on_readable(state):
+    data = state.sock.recv(65536)  # blocking recv: no BlockingIOError guard
+    if not data:
+        return None
+    body = json.loads(data)  # body parsing on the loop thread
+    if body.get("retry"):
+        time.sleep(0.05)  # sleeps the whole loop
+    return urllib.request.urlopen(body["url"])  # sync dial+read on the loop

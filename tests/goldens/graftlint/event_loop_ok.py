@@ -1,0 +1,29 @@
+import selectors
+
+SEL = selectors.DefaultSelector()
+
+
+# graftlint: event-loop
+def on_readable(state, work_queue):
+    try:
+        data = state.sock.recv(65536)
+    except (BlockingIOError, InterruptedError):
+        return
+    except OSError:
+        SEL.unregister(state.sock)
+        return
+    if not data:
+        SEL.unregister(state.sock)
+        return
+    state.buf += data
+    # framing only: parsing and backend I/O happen on the worker pool
+    idx = state.buf.find(b"\r\n\r\n")
+    if idx >= 0:
+        work_queue.put(bytes(state.buf[:idx]))
+        del state.buf[:idx + 4]
+
+
+def worker(work_queue):
+    # unmarked: workers may block (they own one request, not the loop)
+    head = work_queue.get()
+    return head.split(b"\r\n")

@@ -328,7 +328,12 @@ def test_stream_failover_replica_killed_mid_decode(params):
     finally:
         _teardown_fleet(proxy, engines, servers)
 
-    chaos = FleetChaos(FleetFaultConfig(kill=(0, 1), kill_after_tokens=6))
+    # slow ticks make "mid-decode" deterministic: the chaos trigger counts
+    # RELAYED tokens, and the event-loop data plane relays at engine pace —
+    # a full-speed toy decode can finish before event N is relayed, so the
+    # scenario's premise (decode outlives the kill) is encoded explicitly
+    chaos = FleetChaos(FleetFaultConfig(kill=(0, 1), kill_after_tokens=6,
+                                        slow=(0, 1), slow_tick_s=0.01))
     api, proxy, svc_port, engines, servers = _mk_fleet(params, 2, chaos)
     # ONE victim — whichever replica serves 6 relayed tokens first dies
     # (routing decides who that is); the guard keeps the failover target
@@ -384,7 +389,9 @@ def test_stream_cut_mid_flight_reconnects_token_exact(params):
 def test_stream_terminal_error_event_when_fleet_exhausted(params):
     """Satellite: a stream with no failover target ends with a STRUCTURED
     error event — never a silent truncation that parses as success."""
-    chaos = FleetChaos(FleetFaultConfig(kill=(0,), kill_after_tokens=4))
+    # slow ticks: same mid-decode determinism note as the failover test
+    chaos = FleetChaos(FleetFaultConfig(kill=(0,), kill_after_tokens=4,
+                                        slow=(0,), slow_tick_s=0.01))
     api, proxy, svc_port, engines, servers = _mk_fleet(
         params, 1, chaos, ann={RETRY_BUDGET_ANNOTATION: "1"})
     chaos.register_replica(0, servers[0].port,

@@ -42,6 +42,7 @@ FIXTURE_PAIRS = {
     "release-guarantee": ("release_guarantee_bad.py", 1,
                           "release_guarantee_ok.py"),
     "hot-path": ("hot_path_bad.py", 4, "hot_path_ok.py"),
+    "event-loop-blocking": ("event_loop_bad.py", 4, "event_loop_ok.py"),
     "gather-ban": ("gather_ban_bad.py", 2, "gather_ban_ok.py"),
     "bounded-growth": ("bounded_growth_bad.py", 1, "bounded_growth_ok.py"),
     "atomic-write": ("atomic_write_bad.py", 1, "atomic_write_ok.py"),

@@ -1,0 +1,563 @@
+"""Ingress data-plane tests (README "Ingress data plane"): the event-loop
+relay core, the pooled keepalive transport, zero-copy SSE passthrough, and
+the relay-semantics pins that must hold identically on either core."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.core.api import APIServer
+from kubeflow_tpu.serving import ingress_core, transport
+from kubeflow_tpu.serving.api import LABEL_ISVC
+from kubeflow_tpu.serving.controllers import (POD_PORT_ANNOTATION,
+                                              PROXY_PORT_ANNOTATION)
+from kubeflow_tpu.serving.router import (RELAY_TIMEOUT_ANNOTATION,
+                                         ServiceProxy)
+from kubeflow_tpu.utils.net import find_free_ports
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def start_ingress(handler, workers=4):
+    srv = ingress_core.IngressServer(("127.0.0.1", 0), handler,
+                                     workers=workers)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def stop_ingress(srv):
+    srv.shutdown()
+    srv.server_close()
+
+
+def raw_exchange(sock, payload, n_responses=1):
+    """Send bytes, read until ``n_responses`` complete framed responses
+    (Content-Length framing only — what the ingress core emits)."""
+    sock.sendall(payload)
+    buf = b""
+    bodies = []
+    while len(bodies) < n_responses:
+        head_end = buf.find(b"\r\n\r\n")
+        if head_end < 0:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+            continue
+        head = buf[:head_end].decode("latin-1")
+        clen = 0
+        for line in head.split("\r\n")[1:]:
+            k, _, v = line.partition(":")
+            if k.strip().lower() == "content-length":
+                clen = int(v.strip())
+        while len(buf) < head_end + 4 + clen:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        bodies.append((head, buf[head_end + 4:head_end + 4 + clen]))
+        buf = buf[head_end + 4 + clen:]
+    return bodies
+
+
+def post_bytes(path, body, clen=None, close=False):
+    clen = len(body) if clen is None else clen
+    conn_hdr = b"Connection: close\r\n" if close else b""
+    return (b"POST " + path + b" HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Length: " + str(clen).encode() + b"\r\n"
+            + conn_hdr + b"\r\n" + body)
+
+
+def make_proxy(api, name, backend_ports, timeout="10.0"):
+    svc_port = find_free_ports(1)[0]
+    api.create({"apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": name, "labels": {LABEL_ISVC: name},
+                             "annotations": {
+                                 PROXY_PORT_ANNOTATION: str(svc_port),
+                                 RELAY_TIMEOUT_ANNOTATION: timeout}},
+                "spec": {"selector": {"app": name}}})
+    for i, bp in enumerate(backend_ports):
+        api.create({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"{name}-{i}",
+                                 "labels": {"app": name},
+                                 "annotations": {POD_PORT_ANNOTATION:
+                                                 str(bp)}},
+                    "spec": {},
+                    "status": {"phase": "Running",
+                               "conditions": [{"type": "Ready",
+                                               "status": "True"}]}})
+    proxy = ServiceProxy(api)
+    proxy.sync()
+    return proxy, svc_port
+
+
+def reuse_totals():
+    out = {"reused": 0.0, "fresh": 0.0, "evicted": 0.0}
+    for key, v in transport.CONN_REUSE.series().items():
+        for lbl, val in key:
+            if lbl == "outcome" and val in out:
+                out[val] += v
+    return out
+
+
+# ------------------------------------------------- event-loop server core
+
+
+def test_ingress_server_keepalive_two_requests_one_connection():
+    seen = []
+
+    def handler(conn):
+        body = conn.rfile.read(int(conn.headers.get("Content-Length", 0)))
+        seen.append((conn.command, conn.path, body))
+        conn._reply(200, b"ok:" + body)
+
+    srv = start_ingress(handler)
+    try:
+        s = socket.create_connection(srv.server_address, timeout=5)
+        try:
+            (h1, b1), = raw_exchange(s, post_bytes(b"/a", b"one"))
+            (h2, b2), = raw_exchange(s, post_bytes(b"/b", b"two"))
+        finally:
+            s.close()
+        assert b1 == b"ok:one" and b2 == b"ok:two"
+        assert "Connection: keep-alive" in h1
+        assert [p for _, p, _ in seen] == ["/a", "/b"]
+    finally:
+        stop_ingress(srv)
+
+
+def test_ingress_server_pipelined_requests_in_one_write():
+    def handler(conn):
+        body = conn.rfile.read(int(conn.headers.get("Content-Length", 0)))
+        conn._reply(200, body.upper())
+
+    srv = start_ingress(handler)
+    try:
+        s = socket.create_connection(srv.server_address, timeout=5)
+        try:
+            # both requests land in one segment: the second is framed off
+            # the re-armed connection's residual buffer, not a new recv
+            two = post_bytes(b"/x", b"aa") + post_bytes(b"/y", b"bb")
+            got = raw_exchange(s, two, n_responses=2)
+        finally:
+            s.close()
+        assert [b for _, b in got] == [b"AA", b"BB"]
+    finally:
+        stop_ingress(srv)
+
+
+def test_ingress_server_connection_close_honored():
+    def handler(conn):
+        conn.rfile.read()
+        conn._reply(200, b"bye")
+
+    srv = start_ingress(handler)
+    try:
+        s = socket.create_connection(srv.server_address, timeout=5)
+        try:
+            (_, body), = raw_exchange(s, post_bytes(b"/", b"", close=True))
+            assert body == b"bye"
+            assert s.recv(1) == b""  # server closed its side
+        finally:
+            s.close()
+    finally:
+        stop_ingress(srv)
+
+
+def test_ingress_server_handler_crash_answers_500_and_closes():
+    def handler(conn):
+        raise RuntimeError("boom")
+
+    srv = start_ingress(handler)
+    try:
+        s = socket.create_connection(srv.server_address, timeout=5)
+        try:
+            (head, body), = raw_exchange(s, post_bytes(b"/", b""))
+            assert head.startswith("HTTP/1.1 500")
+            assert b"internal" in body
+            assert s.recv(1) == b""
+        finally:
+            s.close()
+    finally:
+        stop_ingress(srv)
+
+
+def test_ingress_server_oversized_head_dropped_not_buffered():
+    srv = start_ingress(lambda conn: conn._reply(200, b""))
+    try:
+        s = socket.create_connection(srv.server_address, timeout=5)
+        try:
+            # junk with no blank line: the loop must cut the connection
+            # once the head cap is hit instead of buffering forever
+            s.sendall(b"GET / HTTP/1.1\r\nX: " + b"a" * 70000)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                try:
+                    if s.recv(4096) == b"":
+                        break
+                except OSError:
+                    break
+            else:
+                pytest.fail("oversized head was not dropped")
+        finally:
+            s.close()
+    finally:
+        stop_ingress(srv)
+
+
+# ------------------------------------------------------ pooled transport
+
+
+def echo_backend():
+    def handler(conn):
+        conn.rfile.read()
+        conn._reply(200, b'{"pong": true}')
+    return start_ingress(handler)
+
+
+def test_transport_reuses_keepalive_connection():
+    be = echo_backend()
+    port = be.server_address[1]
+    pool = transport.ConnectionPool()
+    try:
+        with pool.request("GET", port, "/ping") as r:
+            assert r.status == 200 and r.read() == b'{"pong": true}'
+            assert r.timing["outcome"] == "fresh"
+        assert pool.idle_count(port) == 1
+        with pool.request("GET", port, "/ping") as r:
+            r.read()
+            assert r.timing["outcome"] == "reused"
+        assert pool.idle_count(port) == 1
+    finally:
+        pool.close_all()
+        stop_ingress(be)
+
+
+def test_transport_idle_ttl_evicts_cold_sockets():
+    be = echo_backend()
+    port = be.server_address[1]
+    pool = transport.ConnectionPool(idle_ttl_s=0.0)
+    try:
+        with pool.request("GET", port, "/a") as r:
+            r.read()
+        assert pool.idle_count(port) == 1
+        # TTL 0: the idle socket is stale at checkout — evicted, fresh dial
+        with pool.request("GET", port, "/b") as r:
+            r.read()
+            assert r.timing["outcome"] == "fresh"
+    finally:
+        pool.close_all()
+        stop_ingress(be)
+
+
+def test_transport_pool_bound_retires_not_grows():
+    pool = transport.ConnectionPool(max_idle=2)
+    be = echo_backend()
+    port = be.server_address[1]
+    try:
+        conns = []
+        for _ in range(4):
+            c = __import__("http.client", fromlist=["HTTPConnection"]) \
+                .HTTPConnection("127.0.0.1", port, timeout=5)
+            conns.append(c)
+        for c in conns:
+            pool._checkin(port, c)
+        assert pool.idle_count(port) == 2  # hard bound, coldest retired
+    finally:
+        pool.close_all()
+        stop_ingress(be)
+
+
+def test_transport_legacy_mode_never_pools(monkeypatch):
+    monkeypatch.setenv("KUBEFLOW_TPU_INGRESS_CORE", "legacy")
+    be = echo_backend()
+    port = be.server_address[1]
+    pool = transport.ConnectionPool()
+    try:
+        for _ in range(2):
+            with pool.request("GET", port, "/p") as r:
+                r.read()
+                assert r.timing["outcome"] == "fresh"
+        assert pool.idle_count() == 0
+    finally:
+        pool.close_all()
+        stop_ingress(be)
+
+
+def test_transport_stale_pooled_socket_retried_fresh():
+    """Degradation contract: a pooled socket the backend closed is
+    retired and the request transparently retried — never surfaced."""
+    be = echo_backend()
+    port = be.server_address[1]
+    pool = transport.ConnectionPool()
+    try:
+        with pool.request("GET", port, "/a") as r:
+            r.read()
+        assert pool.idle_count(port) == 1
+        # sever the idle socket under the pool (backend-side close race)
+        conn, _since = pool._idle[port][0]
+        conn.sock.close()
+        with pool.request("GET", port, "/b") as r:
+            assert r.status == 200
+            r.read()
+            assert r.timing["outcome"] == "fresh"
+    finally:
+        pool.close_all()
+        stop_ingress(be)
+
+
+def test_transport_4xx_raises_httperror_with_body():
+    def handler(conn):
+        conn.rfile.read()
+        conn._reply(429, b'{"err": "slow down"}',
+                    extra={"Retry-After": "0.25"})
+
+    be = start_ingress(handler)
+    port = be.server_address[1]
+    pool = transport.ConnectionPool()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            pool.request("GET", port, "/x")
+        assert ei.value.code == 429
+        assert ei.value.headers.get("Retry-After") == "0.25"
+    finally:
+        pool.close_all()
+        stop_ingress(be)
+
+
+# ------------------------------------------- proxy on the event-loop core
+
+
+SSE_SCRIPT = (b'data: {"token_id": 7, "text": "a"}\n\n'
+              b': comment keepalive frame\n\n'
+              b'data: {"text": "caf\xc3\xa9 \xe2\x9c\x93"}\n\n'
+              b'data: first line of a multi-line event\n'
+              b'data: second line of the same event\n\n'
+              b'data: {"done": true, "tokens": 4}\n\n')
+
+
+def scripted_backend():
+    def handler(conn):
+        if conn.path.endswith("/generate_stream"):
+            conn.send_response(200)
+            conn.send_header("Content-Type", "text/event-stream")
+            conn.send_header("Cache-Control", "no-cache")
+            conn.send_header("Connection", "close")
+            conn.end_headers()
+            conn.wfile.write(SSE_SCRIPT)
+            conn.close_connection = True
+        else:
+            conn.rfile.read()
+            conn._reply(200, b'{"ok": true}')
+    return start_ingress(handler)
+
+
+def stream_response(port, name):
+    # body deliberately NOT resume-eligible (no "text_input"): this pins
+    # the raw passthrough/reframe path, not the resumable token parser
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v2/models/{name}/generate_stream",
+        data=json.dumps({"inputs": "s"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return dict(r.headers), r.read()
+
+
+def test_sse_passthrough_byte_identity_and_zero_reframe(monkeypatch):
+    be = scripted_backend()
+    try:
+        api = APIServer()
+        proxy, svc = make_proxy(api, "sse", [be.server_address[1]])
+        try:
+            hdrs, body = stream_response(svc, "sse")
+            assert body == SSE_SCRIPT
+            # zero-copy passthrough: the backend's own framing is spliced
+            # through verbatim — close-delimited, never re-chunked
+            assert "Transfer-Encoding" not in hdrs
+            assert hdrs.get("Connection", "").lower() == "close"
+        finally:
+            proxy.shutdown()
+    finally:
+        stop_ingress(be)
+
+
+def test_sse_byte_identity_matches_legacy_reframe(monkeypatch):
+    """Same script through the legacy core: payload bytes identical (the
+    reframe arm re-chunks the wire format but never touches payload)."""
+    be = scripted_backend()
+    try:
+        monkeypatch.setenv("KUBEFLOW_TPU_INGRESS_CORE", "legacy")
+        transport.default_pool().close_all()
+        api = APIServer()
+        proxy, svc = make_proxy(api, "sseleg", [be.server_address[1]])
+        try:
+            hdrs, body = stream_response(svc, "sseleg")
+            assert body == SSE_SCRIPT
+            assert hdrs.get("Transfer-Encoding") == "chunked"
+        finally:
+            proxy.shutdown()
+            monkeypatch.delenv("KUBEFLOW_TPU_INGRESS_CORE")
+            transport.default_pool().close_all()
+    finally:
+        stop_ingress(be)
+
+
+def test_resume_ctx_gating_matches_passthrough_contract():
+    """The passthrough fast path serves exactly the streams that are NOT
+    resume-eligible; pin the gate so a routing change can't silently
+    move traffic off the zero-copy path."""
+    ctx = ServiceProxy._resume_context
+    assert ctx("/v2/models/m/generate_stream", {"text_input": "p"}) \
+        is not None
+    assert ctx("/v2/models/m/generate_stream?x=1", {"text_input": "p"}) \
+        is not None
+    # not the stream surface
+    assert ctx("/v2/models/m/generate", {"text_input": "p"}) is None
+    # no text prompt -> raw passthrough
+    assert ctx("/v2/models/m/generate_stream", {"inputs": "p"}) is None
+    assert ctx("/v2/models/m/generate_stream", "raw string body") is None
+    assert ctx("/v2/models/m/generate_stream", None) is None
+
+
+def test_relay_failover_on_new_core_reuses_keepalive():
+    """One dead-ish backend (always 500), one healthy: every request
+    lands 200 through the retry loop, and the healthy backend's
+    connection is reused across requests (pooled keepalive transport
+    under the relay's failover state machine)."""
+    def bad(conn):
+        conn.rfile.read()
+        conn._reply(500, b'{"err": "broken"}')
+
+    def good(conn):
+        conn.rfile.read()
+        conn._reply(200, b'{"ok": true}')
+
+    be_bad, be_good = start_ingress(bad), start_ingress(good)
+    try:
+        api = APIServer()
+        proxy, svc = make_proxy(
+            api, "fo",
+            [be_bad.server_address[1], be_good.server_address[1]])
+        try:
+            before = reuse_totals()
+            for _ in range(6):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{svc}/v2/models/fo/infer",
+                    data=b"{}",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    assert r.status == 200
+                    assert r.read() == b'{"ok": true}'
+            after = reuse_totals()
+            assert after["reused"] > before["reused"]
+        finally:
+            proxy.shutdown()
+    finally:
+        stop_ingress(be_bad)
+        stop_ingress(be_good)
+
+
+def test_retry_after_honored_on_new_core():
+    """A 503 + Retry-After backend answer delays the relay's retry by at
+    least the hint (semantics pin: the seed's Retry-After contract
+    survives the transport swap)."""
+    state = {"n": 0, "times": []}
+
+    def handler(conn):
+        conn.rfile.read()
+        if not conn.path.endswith("/infer"):
+            # load scrapes / probes must not consume the script
+            conn._reply(200, b"{}")
+            return
+        state["n"] += 1
+        state["times"].append(time.monotonic())
+        if state["n"] == 1:
+            conn._reply(503, b'{"err": "busy"}',
+                        extra={"Retry-After": "0.2"})
+        else:
+            conn._reply(200, b'{"ok": true}')
+
+    be = start_ingress(handler)
+    try:
+        api = APIServer()
+        proxy, svc = make_proxy(api, "ra", [be.server_address[1]])
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{svc}/v2/models/ra/infer",
+                data=b"{}", headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.read() == b'{"ok": true}'
+            assert state["n"] == 2
+            # the relay jitters the hint by uniform(0.5, 1.0) so a shed
+            # burst doesn't re-arrive in lockstep: the floor is hint/2
+            assert state["times"][1] - state["times"][0] >= 0.095
+        finally:
+            proxy.shutdown()
+    finally:
+        stop_ingress(be)
+
+
+# ----------------------------------------- snapshot cache / store version
+
+
+def test_store_version_bumps_on_every_write_kind():
+    api = APIServer()
+    v0 = api.store_version()
+    pod = api.create({"apiVersion": "v1", "kind": "Pod",
+                      "metadata": {"name": "p"}, "spec": {}})
+    v1 = api.store_version()
+    assert v1 > v0
+    api.patch("Pod", "p", {"metadata": {"annotations": {"x": "1"}}})
+    v2 = api.store_version()
+    assert v2 > v1
+    api.delete("Pod", "p")
+    assert api.store_version() > v2
+    del pod
+
+
+def test_proxy_routes_new_pod_after_store_write():
+    """The hot-path snapshot cache must never serve a stale pod list:
+    adding a pod and deleting the old one reroutes the very next
+    request (store-version invalidation, including on delete)."""
+    def mk(handler_body):
+        def handler(conn):
+            conn.rfile.read()
+            conn._reply(200, handler_body)
+        return start_ingress(handler)
+
+    be_a, be_b = mk(b'{"who": "a"}'), mk(b'{"who": "b"}')
+    try:
+        api = APIServer()
+        proxy, svc = make_proxy(api, "swap", [be_a.server_address[1]])
+        try:
+            def ask():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{svc}/v2/models/swap/infer",
+                    data=b"{}",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.loads(r.read())["who"]
+
+            assert ask() == "a"
+            api.create({"apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": "swap-1",
+                                     "labels": {"app": "swap"},
+                                     "annotations": {
+                                         POD_PORT_ANNOTATION:
+                                         str(be_b.server_address[1])}},
+                        "spec": {},
+                        "status": {"phase": "Running",
+                                   "conditions": [{"type": "Ready",
+                                                   "status": "True"}]}})
+            api.delete("Pod", "swap-0")
+            assert ask() == "b"
+        finally:
+            proxy.shutdown()
+    finally:
+        stop_ingress(be_a)
+        stop_ingress(be_b)

@@ -476,7 +476,12 @@ def test_failover_keeps_one_trace_with_resumed_links(params):
     assembled trace containing the failed hop, the failover hop, and the
     engine spans on BOTH replicas, with the continuation linking the
     failed hop."""
-    chaos = FleetChaos(FleetFaultConfig(kill=(0, 1), kill_after_tokens=6))
+    # slow ticks keep decode slower than the relay so the kill callback
+    # fires mid-stream (fast transport would otherwise batch the whole
+    # stream into the socket before the relay sees token 6)
+    chaos = FleetChaos(
+        FleetFaultConfig(kill=(0, 1), kill_after_tokens=6, slow=(0, 1), slow_tick_s=0.01)
+    )
     api, proxy, svc_port, engines, servers = _mk_fleet(params, 2, chaos)
     killed = []
 
